@@ -1,0 +1,29 @@
+"""celestia_app_tpu — a TPU-native data-availability framework.
+
+A from-scratch rebuild of the capabilities of celestia-app (the Celestia
+DA network's state machine) designed TPU-first:
+
+- The compute core — 2D Reed-Solomon extension of the data square, namespaced
+  Merkle tree (NMT) hashing, share commitments and inclusion proofs — runs as
+  batched GF(256) bit-matrix matmuls (MXU) and vectorized SHA-256 (VPU/Pallas)
+  under ``jax.jit``, with static power-of-two shape buckets.
+- The protocol plane — deterministic square layout, PrepareProposal /
+  ProcessProposal / CheckTx semantics, the PayForBlobs state machine, gas and
+  fee rules — runs host-side in deterministic Python.
+- Multi-chip scaling shards the extended square per-row over a
+  ``jax.sharding.Mesh`` with XLA collectives (all-to-all transpose between the
+  row and column passes, all-gather of axis roots).
+
+Layout:
+  appconsts   protocol constants (immutable / versioned / governed layers)
+  ops         device kernels: GF(256) RS codec, SHA-256, NMT reduction, Merkle
+  da          data-availability pipeline: namespaces, shares, square layout,
+              EDS extension, DA header, commitments, proofs
+  chain       ABCI-shaped state machine: app, ante, modules (blob/bank/auth/
+              mint/signal/minfee), tx codec
+  parallel    device-mesh sharded execution of the DA pipeline
+  client      tx signer / client
+  utils       host-side reference implementations and helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,140 @@
+"""Protocol constants, mirroring the reference's four config layers.
+
+Reference parity (celestia-app):
+- immutable share geometry: ``pkg/appconsts/global_consts.go:15-78``
+- versioned consts keyed by app version: ``pkg/appconsts/{v1,v2,v3}/app_consts.go``
+  + accessors ``pkg/appconsts/versioned_consts.go:20-27``
+- governance-mutable initial values: ``pkg/appconsts/initial_consts.go``
+- consensus timing: ``pkg/appconsts/consensus_consts.go:6-13``
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# ---------------------------------------------------------------------------
+# Layer 1: immutable share geometry (global_consts.go)
+# ---------------------------------------------------------------------------
+
+SHARE_SIZE = 512
+NAMESPACE_VERSION_SIZE = 1
+NAMESPACE_ID_SIZE = 28
+NAMESPACE_SIZE = NAMESPACE_VERSION_SIZE + NAMESPACE_ID_SIZE  # 29
+SHARE_INFO_BYTES = 1
+SEQUENCE_LEN_BYTES = 4
+SHARE_RESERVED_BYTES = 4
+
+# Content capacity of each share variant (spec: specs/src/specs/shares.md).
+FIRST_SPARSE_SHARE_CONTENT_SIZE = (
+    SHARE_SIZE - NAMESPACE_SIZE - SHARE_INFO_BYTES - SEQUENCE_LEN_BYTES
+)  # 478
+CONTINUATION_SPARSE_SHARE_CONTENT_SIZE = (
+    SHARE_SIZE - NAMESPACE_SIZE - SHARE_INFO_BYTES
+)  # 482
+FIRST_COMPACT_SHARE_CONTENT_SIZE = (
+    SHARE_SIZE
+    - NAMESPACE_SIZE
+    - SHARE_INFO_BYTES
+    - SEQUENCE_LEN_BYTES
+    - SHARE_RESERVED_BYTES
+)  # 474
+CONTINUATION_COMPACT_SHARE_CONTENT_SIZE = (
+    SHARE_SIZE - NAMESPACE_SIZE - SHARE_INFO_BYTES - SHARE_RESERVED_BYTES
+)  # 478
+
+SUPPORTED_SHARE_VERSIONS = (0,)
+SHARE_VERSION_ZERO = 0
+
+MIN_SQUARE_SIZE = 1
+# Upper bound on axis length of the *extended* square = 2 * 128.
+MAX_EXTENDED_SQUARE_WIDTH = 256
+
+# NMT node serialization: minNs(29) || maxNs(29) || sha256 digest(32).
+NMT_ROOT_SIZE = 2 * NAMESPACE_SIZE + 32  # 90
+HASH_SIZE = 32
+
+# ---------------------------------------------------------------------------
+# Layer 2: versioned constants (pkg/appconsts/{v1,v2,v3}/app_consts.go)
+# ---------------------------------------------------------------------------
+
+LATEST_VERSION = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class VersionedConsts:
+    square_size_upper_bound: int
+    subtree_root_threshold: int
+    # v2+ only; ignored (None) at v1 (minfee module absent).
+    network_min_gas_price: float | None
+    tx_size_cost_per_byte: int
+    gas_per_blob_byte: int
+
+
+_VERSIONED: dict[int, VersionedConsts] = {
+    1: VersionedConsts(
+        square_size_upper_bound=128,
+        subtree_root_threshold=64,
+        network_min_gas_price=None,
+        tx_size_cost_per_byte=10,
+        gas_per_blob_byte=8,
+    ),
+    2: VersionedConsts(
+        square_size_upper_bound=128,
+        subtree_root_threshold=64,
+        network_min_gas_price=0.000001,
+        tx_size_cost_per_byte=10,
+        gas_per_blob_byte=8,
+    ),
+    3: VersionedConsts(
+        square_size_upper_bound=128,
+        subtree_root_threshold=64,
+        network_min_gas_price=0.000001,
+        tx_size_cost_per_byte=10,
+        gas_per_blob_byte=8,
+    ),
+}
+
+
+def versioned(app_version: int) -> VersionedConsts:
+    try:
+        return _VERSIONED[app_version]
+    except KeyError:
+        raise ValueError(f"unsupported app version {app_version}") from None
+
+
+def square_size_upper_bound(app_version: int) -> int:
+    return versioned(app_version).square_size_upper_bound
+
+
+def subtree_root_threshold(app_version: int) -> int:
+    return versioned(app_version).subtree_root_threshold
+
+
+# ---------------------------------------------------------------------------
+# Layer 3: governance-mutable initial values (initial_consts.go)
+# ---------------------------------------------------------------------------
+
+DEFAULT_GOV_MAX_SQUARE_SIZE = 64
+DEFAULT_GAS_PER_BLOB_BYTE = 8
+DEFAULT_MAX_BYTES = DEFAULT_GOV_MAX_SQUARE_SIZE**2 * CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+DEFAULT_MIN_GAS_PRICE = 0.002
+DEFAULT_NETWORK_MIN_GAS_PRICE = 0.000001
+DEFAULT_UPGRADE_HEIGHT_DELAY = 50_400  # ~7 days of 12s blocks (x/signal)
+
+# x/blob gas model (x/blob/types/payforblob.go:20-42,158-179)
+PFB_GAS_FIXED_COST = 75_000
+BYTES_PER_BLOB_INFO = 70
+
+# ---------------------------------------------------------------------------
+# Layer 4: consensus timing defaults (consensus_consts.go, default_overrides.go)
+# ---------------------------------------------------------------------------
+
+TIMEOUT_PROPOSE_SECONDS = 10.0
+TIMEOUT_COMMIT_SECONDS = 11.0
+GOAL_BLOCK_TIME_SECONDS = 15.0
+MEMPOOL_TX_TTL_BLOCKS = 5
+MEMPOOL_MAX_TX_BYTES = 128**2 * CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+SNAPSHOT_INTERVAL_BLOCKS = 1500
+SNAPSHOT_KEEP_RECENT = 2
+
+BOND_DENOM = "utia"

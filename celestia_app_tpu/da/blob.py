@@ -1,0 +1,127 @@
+"""Blob containers and tx envelopes.
+
+Reference parity: go-square's `blob` package — `Blob`, `BlobTx` (a signed tx
+plus the blobs it pays for, travelling together through the mempool and block
+data but stripped before execution, app/check_tx.go:16-54) and `IndexWrapper`
+(a PFB tx wrapped with the share indices of its blobs, as placed in the
+PAY_FOR_BLOB_NAMESPACE compact shares).
+
+Wire format is this framework's own deterministic encoding (not protobuf):
+4-byte magic, uvarint length prefixes, and FIXED 4-byte big-endian share
+indices — fixed width so a wrapped tx's byte length never depends on the
+index values, which keeps square layout a one-pass computation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from celestia_app_tpu.da import shares as shares_mod
+from celestia_app_tpu.da.namespace import Namespace
+from celestia_app_tpu.da.shares import read_uvarint, uvarint
+
+BLOB_TX_MAGIC = b"BLOB"
+INDEX_WRAPPER_MAGIC = b"INDX"
+
+
+@dataclasses.dataclass(frozen=True)
+class Blob:
+    namespace: Namespace
+    data: bytes
+    share_version: int = 0
+
+    def share_count(self) -> int:
+        return shares_mod.sparse_shares_needed(len(self.data))
+
+    def validate(self) -> None:
+        self.namespace.validate_for_blob()
+        if self.share_version not in (0,):
+            raise ValueError(f"unsupported share version {self.share_version}")
+        if len(self.data) == 0:
+            raise ValueError("blob data must not be empty")
+
+
+@dataclasses.dataclass(frozen=True)
+class BlobTx:
+    tx: bytes  # the signed PFB tx, blobs stripped
+    blobs: tuple[Blob, ...]
+
+
+def marshal_blob_tx(tx: bytes, blobs: list[Blob]) -> bytes:
+    out = bytearray(BLOB_TX_MAGIC)
+    out += uvarint(len(tx)) + tx
+    out += uvarint(len(blobs))
+    for b in blobs:
+        out += b.namespace.raw
+        out += uvarint(b.share_version)
+        out += uvarint(len(b.data)) + b.data
+    return bytes(out)
+
+
+def is_blob_tx(raw: bytes) -> bool:
+    return raw[:4] == BLOB_TX_MAGIC
+
+
+def unmarshal_blob_tx(raw: bytes) -> BlobTx:
+    if not is_blob_tx(raw):
+        raise ValueError("not a BlobTx envelope")
+    off = 4
+    tx_len, off = read_uvarint(raw, off)
+    tx = raw[off : off + tx_len]
+    off += tx_len
+    n, off = read_uvarint(raw, off)
+    blobs = []
+    for _ in range(n):
+        ns = Namespace(raw[off : off + 29])
+        off += 29
+        ver, off = read_uvarint(raw, off)
+        dlen, off = read_uvarint(raw, off)
+        data = raw[off : off + dlen]
+        if len(data) != dlen:
+            raise ValueError("truncated blob data")
+        off += dlen
+        blobs.append(Blob(ns, data, ver))
+    if off != len(raw):
+        raise ValueError("trailing bytes in BlobTx")
+    return BlobTx(tx=tx, blobs=tuple(blobs))
+
+
+@dataclasses.dataclass(frozen=True)
+class IndexWrapper:
+    tx: bytes
+    share_indexes: tuple[int, ...]
+
+
+def index_wrapper_size(tx_len: int, n_blobs: int) -> int:
+    """Byte length of a marshalled IndexWrapper — independent of index values."""
+    return 4 + len(uvarint(tx_len)) + tx_len + len(uvarint(n_blobs)) + 4 * n_blobs
+
+
+def marshal_index_wrapper(tx: bytes, share_indexes: list[int]) -> bytes:
+    out = bytearray(INDEX_WRAPPER_MAGIC)
+    out += uvarint(len(tx)) + tx
+    out += uvarint(len(share_indexes))
+    for idx in share_indexes:
+        out += idx.to_bytes(4, "big")
+    return bytes(out)
+
+
+def is_index_wrapper(raw: bytes) -> bool:
+    return raw[:4] == INDEX_WRAPPER_MAGIC
+
+
+def unmarshal_index_wrapper(raw: bytes) -> IndexWrapper:
+    if not is_index_wrapper(raw):
+        raise ValueError("not an IndexWrapper")
+    off = 4
+    tx_len, off = read_uvarint(raw, off)
+    tx = raw[off : off + tx_len]
+    off += tx_len
+    n, off = read_uvarint(raw, off)
+    idxs = []
+    for _ in range(n):
+        idxs.append(int.from_bytes(raw[off : off + 4], "big"))
+        off += 4
+    if off != len(raw):
+        raise ValueError("trailing bytes in IndexWrapper")
+    return IndexWrapper(tx=tx, share_indexes=tuple(idxs))

@@ -1,0 +1,81 @@
+"""Blob share commitments: the Merkle-mountain-range over NMT subtree roots.
+
+Reference parity: go-square `inclusion.CreateCommitment` (called from
+x/blob/types/payforblob.go:53 and blob_tx.go:98) per the spec's "Blob Share
+Commitment Rules" (specs/src/specs/data_square_layout.md:38-58):
+
+  SubtreeWidth  = min(roundUpPow2(ceil(shares / SubtreeRootThreshold)),
+                      minSquareSize(shares))
+  tree sizes    = MMR decomposition of the share count with max width
+                  SubtreeWidth (full-width trees, then descending powers of 2)
+  subtree roots = NMT roots over each chunk's ns-prefixed shares
+  commitment    = RFC-6962 Merkle root over the serialized (90 B) subtree roots
+
+Because blobs start at multiples of SubtreeWidth (non-interactive default,
+square.py), these subtree roots appear verbatim as inner nodes of the row NMTs
+for any square size — commitments are square-size independent (ADR-008/013).
+
+Host path here (hashlib, used per-tx in CheckTx); `commitment_device` batches
+every blob of a block into a few vectorized SHA launches (BASELINE config 3).
+"""
+
+from __future__ import annotations
+
+from celestia_app_tpu.da import shares as shares_mod
+from celestia_app_tpu.da.blob import Blob
+from celestia_app_tpu.utils import merkle_host, nmt_host
+
+
+def round_up_pow2(n: int) -> int:
+    k = 1
+    while k < n:
+        k *= 2
+    return k
+
+
+def min_square_size(share_count: int) -> int:
+    """Smallest power-of-two square edge that fits `share_count` shares."""
+    import math
+
+    return round_up_pow2(math.isqrt(share_count - 1) + 1 if share_count > 1 else 1)
+
+
+def subtree_width(share_count: int, subtree_root_threshold: int) -> int:
+    s = -(-share_count // subtree_root_threshold)  # ceil
+    return min(round_up_pow2(s), min_square_size(share_count))
+
+
+def merkle_mountain_range_sizes(total: int, max_tree_size: int) -> list[int]:
+    """Decompose `total` leaves into MMR tree sizes with cap `max_tree_size`."""
+    sizes = []
+    while total >= max_tree_size:
+        sizes.append(max_tree_size)
+        total -= max_tree_size
+    if total:
+        p = max_tree_size
+        while total:
+            while p > total:
+                p //= 2
+            sizes.append(p)
+            total -= p
+    return sizes
+
+
+def create_commitment(blob: Blob, subtree_root_threshold: int) -> bytes:
+    """32-byte share commitment of a blob."""
+    blob_shares = shares_mod.split_blob(blob.namespace, blob.data, blob.share_version)
+    width = subtree_width(len(blob_shares), subtree_root_threshold)
+    sizes = merkle_mountain_range_sizes(len(blob_shares), width)
+    subtree_roots: list[bytes] = []
+    cursor = 0
+    for size in sizes:
+        tree = nmt_host.NmtTree()
+        for s in blob_shares[cursor : cursor + size]:
+            tree.push(blob.namespace.raw, s.raw)
+        subtree_roots.append(nmt_host.serialize(tree.root()))
+        cursor += size
+    return merkle_host.hash_from_leaves(subtree_roots)
+
+
+def create_commitments(blobs: list[Blob], subtree_root_threshold: int) -> list[bytes]:
+    return [create_commitment(b, subtree_root_threshold) for b in blobs]

@@ -1,0 +1,126 @@
+"""DataAvailabilityHeader: the per-block commitment to the extended square.
+
+Reference parity: pkg/da/data_availability_header.go —
+`DataAvailabilityHeader{RowRoots, ColumnRoots}` (:32-40), `Hash()` = binary
+Merkle root over rowRoots || colRoots (:92-108), `ValidateBasic` bounds (:134-162),
+`MinDataAvailabilityHeader` (:176-190). The heavy lifting (extension, NMT
+hashing, root reduction) happens on device via da/eds.py; this module is the
+host-side protocol object.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.da import eds as eds_mod
+from celestia_app_tpu.da import shares as shares_mod
+from celestia_app_tpu.utils import merkle_host
+
+# Axis bounds on the *extended* square (data_availability_header.go:17-27).
+MIN_EXTENDED_SQUARE_WIDTH = 2 * appconsts.MIN_SQUARE_SIZE
+MAX_EXTENDED_SQUARE_WIDTH = appconsts.MAX_EXTENDED_SQUARE_WIDTH
+
+
+@dataclasses.dataclass(frozen=True)
+class ExtendedDataSquare:
+    """Host handle to a 2k x 2k extended square (kept as one u8 array)."""
+
+    squares: np.ndarray  # (2k, 2k, SHARE_SIZE) uint8
+
+    @property
+    def width(self) -> int:
+        return self.squares.shape[0]
+
+    def row(self, i: int) -> np.ndarray:
+        return self.squares[i]
+
+    def col(self, i: int) -> np.ndarray:
+        return self.squares[:, i, :]
+
+    def flattened_ods(self) -> list[bytes]:
+        k = self.width // 2
+        return [self.squares[r, c].tobytes() for r in range(k) for c in range(k)]
+
+
+@dataclasses.dataclass(frozen=True)
+class DataAvailabilityHeader:
+    row_roots: tuple[bytes, ...]  # 90-byte serialized NMT roots
+    col_roots: tuple[bytes, ...]
+
+    def hash(self) -> bytes:
+        return merkle_host.hash_from_leaves(list(self.row_roots) + list(self.col_roots))
+
+    @property
+    def square_size(self) -> int:
+        return len(self.row_roots) // 2
+
+    def validate_basic(self) -> None:
+        for name, roots in (("row", self.row_roots), ("column", self.col_roots)):
+            if len(roots) < MIN_EXTENDED_SQUARE_WIDTH:
+                raise ValueError(
+                    f"too few {name} roots: {len(roots)} < {MIN_EXTENDED_SQUARE_WIDTH}"
+                )
+            if len(roots) > MAX_EXTENDED_SQUARE_WIDTH:
+                raise ValueError(
+                    f"too many {name} roots: {len(roots)} > {MAX_EXTENDED_SQUARE_WIDTH}"
+                )
+            for r in roots:
+                if len(r) != appconsts.NMT_ROOT_SIZE:
+                    raise ValueError(f"{name} root has size {len(r)} != 90")
+        if len(self.row_roots) != len(self.col_roots):
+            raise ValueError("row/column root counts differ")
+
+
+def square_size_from_share_count(n: int) -> int:
+    """Smallest power-of-two k with k*k >= n (da.SquareSize in the reference)."""
+    k = 1
+    while k * k < n:
+        k *= 2
+    return k
+
+
+def shares_to_ods(share_bytes: list[bytes]) -> np.ndarray:
+    """Row-major (k, k, 512) array from a perfect-square list of shares."""
+    n = len(share_bytes)
+    k = int(math.isqrt(n))
+    if k * k != n or k & (k - 1):
+        raise ValueError(f"share count {n} is not a power-of-two perfect square")
+    flat = np.frombuffer(b"".join(share_bytes), dtype=np.uint8)
+    return flat.reshape(k, k, appconsts.SHARE_SIZE)
+
+
+def extend_shares(share_bytes: list[bytes]) -> ExtendedDataSquare:
+    """da.ExtendShares equivalent (data_availability_header.go:65-75).
+
+    Extension only — callers that also need roots should use
+    `new_dah_from_ods` (one dispatch) instead of paying the NMT hashing here.
+    """
+    from celestia_app_tpu.ops import rs
+
+    ods = shares_to_ods(share_bytes)
+    k = ods.shape[0]
+    eds = rs.jitted_extend(k)(jnp.asarray(ods))
+    return ExtendedDataSquare(np.asarray(eds))
+
+
+def new_dah_from_ods(ods: np.ndarray) -> tuple[DataAvailabilityHeader, ExtendedDataSquare, bytes]:
+    """One device dispatch: ODS -> (DAH, EDS, data_root)."""
+    k = ods.shape[0]
+    eds, row_roots, col_roots, data_root = eds_mod.jitted_pipeline(k)(jnp.asarray(ods))
+    dah = DataAvailabilityHeader(
+        row_roots=tuple(bytes(np.asarray(r)) for r in np.asarray(row_roots)),
+        col_roots=tuple(bytes(np.asarray(r)) for r in np.asarray(col_roots)),
+    )
+    return dah, ExtendedDataSquare(np.asarray(eds)), bytes(np.asarray(data_root))
+
+
+def min_dah() -> DataAvailabilityHeader:
+    """DAH of the minimum square: one tail-padding share (reference :176-190)."""
+    share = shares_mod.tail_padding_share()
+    dah, _, _ = new_dah_from_ods(shares_to_ods([share]))
+    return dah

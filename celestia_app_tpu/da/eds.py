@@ -1,0 +1,85 @@
+"""The device compute core: ODS -> extended square -> axis roots -> data root.
+
+This is the TPU-native replacement for the reference's
+`da.ExtendShares` + `da.NewDataAvailabilityHeader` + `DAH.Hash()` chain
+(pkg/da/data_availability_header.go:44-108): one jitted program per
+power-of-two square-size bucket that
+
+  1. 2D Reed-Solomon-extends the (k, k, 512) original square on the MXU
+     (ops/rs.py bit-matrix matmuls),
+  2. hashes all 2k row NMTs and 2k column NMTs level-synchronously on the VPU
+     (ops/nmt.py), with Q0 leaves namespaced by their own share prefix and
+     parity leaves by PARITY_SHARE_NAMESPACE
+     (pkg/wrapper/nmt_wrapper.go:93-114 semantics), and
+  3. reduces the 4k axis roots to the 32-byte data root with the RFC-6962
+     binary Merkle tree (rowRoots || colRoots, data_availability_header.go:100-107).
+
+Everything stays on device between stages; a single dispatch per block.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.da import namespace as ns_mod
+from celestia_app_tpu.ops import merkle, nmt, rs
+
+NS = appconsts.NAMESPACE_SIZE
+
+
+def _axis_leaf_ns(eds: jax.Array, k: int) -> jax.Array:
+    """Leaf namespaces for row trees of an EDS: own prefix in Q0, else parity.
+
+    Symmetric under transpose (position (r, c) is in Q0 iff r < k and c < k),
+    so the same function serves column trees on the transposed square.
+    """
+    two_k = 2 * k
+    idx = jnp.arange(two_k)
+    in_q0 = (idx[:, None] < k) & (idx[None, :] < k)  # (2k, 2k)
+    parity = jnp.asarray(np.frombuffer(ns_mod.PARITY_NS_RAW, dtype=np.uint8))
+    return jnp.where(in_q0[..., None], eds[:, :, :NS], parity)
+
+
+def pipeline_fn(k: int):
+    """Jittable: (k, k, 512) u8 ODS -> (eds, row_roots, col_roots, data_root)."""
+    extend = rs.extend_square_fn(k)
+
+    def run(ods: jax.Array):
+        eds = extend(ods)  # (2k, 2k, 512)
+        row_roots = nmt.nmt_roots(_axis_leaf_ns(eds, k), eds)  # (2k, 90)
+        eds_t = jnp.swapaxes(eds, 0, 1)
+        col_roots = nmt.nmt_roots(_axis_leaf_ns(eds_t, k), eds_t)  # (2k, 90)
+        data_root = merkle.merkle_root_pow2(
+            jnp.concatenate([row_roots, col_roots], axis=0)
+        )
+        return eds, row_roots, col_roots, data_root
+
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_pipeline(k: int):
+    """Compiled pipeline for square size k (cached per bucket)."""
+    return jax.jit(pipeline_fn(k))
+
+
+def roots_only_fn(k: int):
+    """Variant that keeps the EDS on device and returns only roots (less HBM
+    traffic back to host for the PrepareProposal fast path)."""
+    full = pipeline_fn(k)
+
+    def run(ods: jax.Array):
+        _, row_roots, col_roots, data_root = full(ods)
+        return row_roots, col_roots, data_root
+
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_roots_only(k: int):
+    return jax.jit(roots_only_fn(k))

@@ -1,0 +1,88 @@
+"""Namespaces: 29-byte (version || id) identifiers ordering the data square.
+
+Reference parity: go-square ``namespace`` package as specified in
+``specs/src/specs/namespace.md`` (reserved values, version-0 validity rules).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from celestia_app_tpu import appconsts
+
+NS_VER_0 = 0
+NS_VER_MAX = 255
+# Version-0 ids must carry 18 leading zero bytes; 10 bytes are user-chosen.
+NS_V0_PREFIX_ZEROS = 18
+NS_V0_USER_BYTES = appconsts.NAMESPACE_ID_SIZE - NS_V0_PREFIX_ZEROS  # 10
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Namespace:
+    """A 29-byte namespace; ordering is bytewise lexicographic over version||id."""
+
+    raw: bytes  # version(1) || id(28)
+
+    def __post_init__(self):
+        if len(self.raw) != appconsts.NAMESPACE_SIZE:
+            raise ValueError(
+                f"namespace must be {appconsts.NAMESPACE_SIZE} bytes, got {len(self.raw)}"
+            )
+
+    @property
+    def version(self) -> int:
+        return self.raw[0]
+
+    @property
+    def id(self) -> bytes:
+        return self.raw[1:]
+
+    @classmethod
+    def from_version_id(cls, version: int, ns_id: bytes) -> "Namespace":
+        if len(ns_id) != appconsts.NAMESPACE_ID_SIZE:
+            raise ValueError(f"namespace id must be 28 bytes, got {len(ns_id)}")
+        return cls(bytes([version]) + ns_id)
+
+    @classmethod
+    def v0(cls, user_id: bytes) -> "Namespace":
+        """Build a version-0 namespace from up to 10 user bytes (left-padded)."""
+        if len(user_id) > NS_V0_USER_BYTES:
+            raise ValueError(f"version-0 user id is at most {NS_V0_USER_BYTES} bytes")
+        padded = user_id.rjust(NS_V0_USER_BYTES, b"\x00")
+        return cls.from_version_id(NS_VER_0, b"\x00" * NS_V0_PREFIX_ZEROS + padded)
+
+    def is_reserved(self) -> bool:
+        return self <= MAX_PRIMARY_RESERVED or self >= MIN_SECONDARY_RESERVED
+
+    def validate_for_blob(self) -> None:
+        """A user blob namespace must be version 0, well-formed, unreserved."""
+        if self.version != NS_VER_0:
+            raise ValueError(f"blob namespace version must be 0, got {self.version}")
+        if self.id[:NS_V0_PREFIX_ZEROS] != b"\x00" * NS_V0_PREFIX_ZEROS:
+            raise ValueError("version-0 namespace id must have 18 leading zero bytes")
+        if self.is_reserved():
+            raise ValueError(f"blob namespace {self.raw.hex()} is reserved")
+
+    def __repr__(self) -> str:
+        return f"Namespace({self.raw.hex()})"
+
+
+def _primary(last_byte: int) -> Namespace:
+    return Namespace(b"\x00" * (appconsts.NAMESPACE_SIZE - 1) + bytes([last_byte]))
+
+
+def _secondary(last_byte: int) -> Namespace:
+    return Namespace(b"\xff" * (appconsts.NAMESPACE_SIZE - 1) + bytes([last_byte]))
+
+
+# Reserved namespaces (specs/src/specs/namespace.md "Reserved Namespaces").
+TX_NAMESPACE = _primary(0x01)
+INTERMEDIATE_STATE_ROOT_NAMESPACE = _primary(0x02)
+PAY_FOR_BLOB_NAMESPACE = _primary(0x04)
+PRIMARY_RESERVED_PADDING_NAMESPACE = _primary(0xFF)
+MAX_PRIMARY_RESERVED = _primary(0xFF)
+MIN_SECONDARY_RESERVED = _secondary(0x00)
+TAIL_PADDING_NAMESPACE = _secondary(0xFE)
+PARITY_SHARE_NAMESPACE = _secondary(0xFF)
+
+PARITY_NS_RAW = PARITY_SHARE_NAMESPACE.raw

@@ -1,0 +1,222 @@
+"""Deterministic data-square layout: the go-square `square.Build`/`Construct`
+equivalent (reference call sites: app/prepare_proposal.go:50,
+app/process_proposal.go:122, app/extend_block.go:16).
+
+Layout rules implemented (specs/src/specs/data_square_layout.md):
+- normal txs -> one compact-share sequence in TRANSACTION_NAMESPACE,
+  IndexWrapper-wrapped PFB txs -> one in PAY_FOR_BLOB_NAMESPACE;
+- blobs sorted by namespace (stable: ties keep PFB priority order), each
+  starting at a multiple of its SubtreeWidth (non-interactive default,
+  `next_share_index`), with primary-reserved / namespace / tail padding;
+- the square edge k is the smallest power of two fitting all shares
+  (alignment is k-independent, so the share count is computed once).
+
+`build` mirrors go-square Build: greedily include txs in priority order,
+skipping any that would overflow the max square. `construct` mirrors
+Construct: all txs must fit or the whole layout fails (ProcessProposal path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.da import blob as blob_mod
+from celestia_app_tpu.da import namespace as ns_mod
+from celestia_app_tpu.da import shares as shares_mod
+from celestia_app_tpu.da.blob import Blob
+from celestia_app_tpu.da.commitment import round_up_pow2, subtree_width
+from celestia_app_tpu.da.shares import Share, uvarint
+
+
+def next_share_index(cursor: int, blob_share_count: int, subtree_root_threshold: int) -> int:
+    """Non-interactive default: first aligned index >= cursor for this blob."""
+    width = subtree_width(blob_share_count, subtree_root_threshold)
+    return -(-cursor // width) * width
+
+
+def compact_shares_needed(total_bytes: int) -> int:
+    """Shares for a compact sequence of `total_bytes` (incl. varint prefixes)."""
+    if total_bytes == 0:
+        return 0
+    if total_bytes <= appconsts.FIRST_COMPACT_SHARE_CONTENT_SIZE:
+        return 1
+    rest = total_bytes - appconsts.FIRST_COMPACT_SHARE_CONTENT_SIZE
+    return 1 + -(-rest // appconsts.CONTINUATION_COMPACT_SHARE_CONTENT_SIZE)
+
+
+def _sequence_len(txs: list[bytes]) -> int:
+    return sum(len(uvarint(len(t))) + len(t) for t in txs)
+
+
+@dataclasses.dataclass(frozen=True)
+class PfbEntry:
+    """A blob tx admitted to layout: the unwrapped signed tx + its blobs."""
+
+    tx: bytes
+    blobs: tuple[Blob, ...]
+
+
+@dataclasses.dataclass
+class Square:
+    """A built original data square plus the layout metadata proofs need."""
+
+    size: int  # k
+    shares: list[Share]  # k*k shares, row-major
+    txs: list[bytes]  # normal txs included
+    pfbs: list[PfbEntry]  # blob txs included (priority order)
+    # start share index of each blob, parallel to the namespace-sorted order
+    blob_start_indexes: dict[tuple[int, int], int]  # (pfb_idx, blob_idx) -> start
+    tx_shares_len: int  # shares in TRANSACTION_NAMESPACE
+    pfb_shares_len: int  # shares in PAY_FOR_BLOB_NAMESPACE
+
+    def share_bytes(self) -> list[bytes]:
+        return [s.raw for s in self.shares]
+
+    def wrapped_pfb_txs(self) -> list[bytes]:
+        """IndexWrapper-encoded PFB txs as placed in the square."""
+        out = []
+        for i, e in enumerate(self.pfbs):
+            idxs = [self.blob_start_indexes[(i, j)] for j in range(len(e.blobs))]
+            out.append(blob_mod.marshal_index_wrapper(e.tx, idxs))
+        return out
+
+
+class _Layout:
+    """One deterministic layout pass over a candidate tx set."""
+
+    def __init__(self, txs: list[bytes], pfbs: list[PfbEntry], threshold: int):
+        self.txs = txs
+        self.pfbs = pfbs
+        self.threshold = threshold
+        self.wrapped_sizes = [
+            blob_mod.index_wrapper_size(len(e.tx), len(e.blobs)) for e in pfbs
+        ]
+        self.tx_shares = compact_shares_needed(_sequence_len(txs))
+        self.pfb_shares = compact_shares_needed(
+            sum(len(uvarint(s)) + s for s in self.wrapped_sizes)
+        )
+        # Stable namespace sort preserves PFB priority order within a namespace
+        # and blob order within a PFB (data_square_layout.md "Ordering").
+        self.ordered = sorted(
+            [
+                (e.blobs[j].namespace.raw, i, j)
+                for i, e in enumerate(pfbs)
+                for j in range(len(e.blobs))
+            ],
+            key=lambda t: (t[0],),
+        )
+        self.starts: dict[tuple[int, int], int] = {}
+        cursor = self.tx_shares + self.pfb_shares
+        self.first_blob_index = None
+        for ns_raw, i, j in self.ordered:
+            count = pfbs[i].blobs[j].share_count()
+            start = next_share_index(cursor, count, threshold)
+            if self.first_blob_index is None:
+                self.first_blob_index = start
+            self.starts[(i, j)] = start
+            cursor = start + count
+        self.total = cursor
+
+    def square_size(self) -> int:
+        k = 1
+        while k * k < self.total:
+            k *= 2
+        return k
+
+
+def _export(layout: _Layout, k: int) -> Square:
+    """Materialize the share list for a computed layout."""
+    shares: list[Share] = []
+    if layout.tx_shares:
+        shares += shares_mod.split_txs(ns_mod.TX_NAMESPACE, layout.txs)
+    if layout.pfb_shares:
+        wrapped = [
+            blob_mod.marshal_index_wrapper(
+                e.tx,
+                [layout.starts[(i, j)] for j in range(len(e.blobs))],
+            )
+            for i, e in enumerate(layout.pfbs)
+        ]
+        shares += shares_mod.split_txs(ns_mod.PAY_FOR_BLOB_NAMESPACE, wrapped)
+    assert len(shares) == layout.tx_shares + layout.pfb_shares
+
+    cursor = len(shares)
+    prev_ns: ns_mod.Namespace | None = None
+    for ns_raw, i, j in layout.ordered:
+        b = layout.pfbs[i].blobs[j]
+        start = layout.starts[(i, j)]
+        if start > cursor:
+            pad = (
+                [shares_mod.reserved_padding_share()] * (start - cursor)
+                if prev_ns is None
+                else [shares_mod.namespace_padding_share(prev_ns)] * (start - cursor)
+            )
+            shares += pad
+        shares += shares_mod.split_blob(b.namespace, b.data, b.share_version)
+        cursor = start + b.share_count()
+        prev_ns = b.namespace
+    shares += shares_mod.tail_padding_shares(k * k - len(shares))
+    return Square(
+        size=k,
+        shares=shares,
+        txs=layout.txs,
+        pfbs=layout.pfbs,
+        blob_start_indexes=layout.starts,
+        tx_shares_len=layout.tx_shares,
+        pfb_shares_len=layout.pfb_shares,
+    )
+
+
+def construct(
+    txs: list[bytes],
+    pfbs: list[PfbEntry],
+    max_square_size: int,
+    subtree_root_threshold: int,
+) -> Square:
+    """All txs must fit in max_square_size or ValueError (ProcessProposal)."""
+    layout = _Layout(txs, pfbs, subtree_root_threshold)
+    k = max(layout.square_size(), 1)
+    if k > max_square_size:
+        raise ValueError(
+            f"block does not fit: needs square {k} > max {max_square_size}"
+        )
+    return _export(layout, k)
+
+
+def build(
+    txs: list[bytes],
+    pfbs: list[PfbEntry],
+    max_square_size: int,
+    subtree_root_threshold: int,
+) -> Square:
+    """Greedy fill in priority order, dropping txs that overflow (proposer).
+
+    TODO(perf): each admission re-runs a full _Layout (O(n^2 log n) overall);
+    switch to incremental cursor/share accounting for large mempools.
+    """
+    kept_txs: list[bytes] = []
+    kept_pfbs: list[PfbEntry] = []
+    for t in txs:
+        candidate = _Layout(kept_txs + [t], kept_pfbs, subtree_root_threshold)
+        if candidate.square_size() <= max_square_size:
+            kept_txs.append(t)
+    for e in pfbs:
+        candidate = _Layout(kept_txs, kept_pfbs + [e], subtree_root_threshold)
+        if candidate.square_size() <= max_square_size:
+            kept_pfbs.append(e)
+    layout = _Layout(kept_txs, kept_pfbs, subtree_root_threshold)
+    return _export(layout, max(layout.square_size(), 1))
+
+
+def empty_square() -> Square:
+    """The k=1 square holding a single tail-padding share."""
+    return Square(
+        size=1,
+        shares=shares_mod.tail_padding_shares(1),
+        txs=[],
+        pfbs=[],
+        blob_start_indexes={},
+        tx_shares_len=0,
+        pfb_shares_len=0,
+    )

@@ -1,0 +1,46 @@
+"""RFC-6962-style binary Merkle tree (CometBFT flavor) — device-batched.
+
+Reference parity: go-square/merkle `HashFromByteSlices` as specified in
+specs/src/specs/data_structures.md:173-203 — leaf `SHA256(0x00 || d)`, inner
+`SHA256(0x01 || l || r)`, empty tree `SHA256("")`, split point for n leaves =
+largest power of two < n.
+
+`merkle_root_pow2` is the device fast path for power-of-two leaf counts (the
+DAH hash over 4k axis roots); `utils.merkle_host` carries the general
+arbitrary-n implementation plus proofs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from celestia_app_tpu.ops import sha256
+
+
+def leaf_hashes(leaves: jax.Array) -> jax.Array:
+    """(N, D) u8 leaves -> (N, 32) leaf-node hashes SHA256(0x00 || leaf)."""
+    n = leaves.shape[0]
+    prefix = jnp.zeros((n, 1), dtype=jnp.uint8)
+    return sha256.sha256(jnp.concatenate([prefix, leaves], axis=1))
+
+
+def inner_hashes(left: jax.Array, right: jax.Array) -> jax.Array:
+    """(N, 32) x (N, 32) -> (N, 32) inner hashes SHA256(0x01 || l || r)."""
+    n = left.shape[0]
+    prefix = jnp.ones((n, 1), dtype=jnp.uint8)
+    return sha256.sha256(jnp.concatenate([prefix, left, right], axis=1))
+
+
+def merkle_root_pow2(leaves: jax.Array) -> jax.Array:
+    """Merkle root of a power-of-two number of equal-length leaves -> (32,) u8.
+
+    With n a power of two the RFC-6962 split rule always bisects, so the tree
+    is complete and reduces level-synchronously in log2(n) batched launches.
+    """
+    n = leaves.shape[0]
+    assert n >= 1 and n & (n - 1) == 0, f"leaf count {n} not a power of two"
+    nodes = leaf_hashes(leaves)
+    while nodes.shape[0] > 1:
+        nodes = inner_hashes(nodes[0::2], nodes[1::2])
+    return nodes[0]

@@ -1,0 +1,122 @@
+"""Namespaced Merkle Tree reduction on device, batched over many trees.
+
+Implements the NMT node semantics of celestiaorg/nmt as configured by the
+reference (pkg/wrapper/nmt_wrapper.go:59-61: sha256, NamespaceIDSize=29,
+IgnoreMaxNamespace=true), per specs/src/specs/data_structures.md:236-263:
+
+  leaf:  n_min = n_max = ns;  v = SHA256(0x00 || ns || data)
+  inner: n_min = min(l.n_min, r.n_min)
+         n_max = PARITY            if l.n_min == PARITY
+               = l.n_max           elif r.n_min == PARITY   (IgnoreMaxNamespace)
+               = max(l.n_max, r.n_max) otherwise
+         v = SHA256(0x01 || l.n_min || l.n_max || l.v || r.n_min || r.n_max || r.v)
+
+The reduction is level-synchronous: every level of every tree in the batch is
+hashed in one vectorized SHA-256 launch. Roots serialize as min||max||v (90 B)
+— the axis-root format stored in the DataAvailabilityHeader.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.da import namespace as ns_mod
+from celestia_app_tpu.ops import sha256
+
+NS = appconsts.NAMESPACE_SIZE  # 29
+PARITY_NS = np.frombuffer(ns_mod.PARITY_NS_RAW, dtype=np.uint8)
+
+
+def _ns_words(ns_u8: jax.Array) -> jax.Array:
+    """(..., 29) u8 -> (..., 8) u32 big-endian words (3 zero bytes appended).
+
+    Equal-length byte strings compare identically under BE-word lexicographic
+    order, so 29-byte namespace comparisons become 8 u32 compares.
+    """
+    pad = jnp.zeros((*ns_u8.shape[:-1], 3), dtype=jnp.uint8)
+    padded = jnp.concatenate([ns_u8, pad], axis=-1).astype(jnp.uint32)
+    quads = padded.reshape(*ns_u8.shape[:-1], 8, 4)
+    be = jnp.array([1 << 24, 1 << 16, 1 << 8, 1], dtype=jnp.uint32)
+    return jnp.sum(quads * be, axis=-1, dtype=jnp.uint32)
+
+
+def ns_less(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Lexicographic a < b over (..., 29) u8 namespaces -> (...) bool."""
+    aw, bw = _ns_words(a), _ns_words(b)
+    lt = jnp.zeros(aw.shape[:-1], dtype=bool)
+    eq = jnp.ones(aw.shape[:-1], dtype=bool)
+    for i in range(8):
+        lt = lt | (eq & (aw[..., i] < bw[..., i]))
+        eq = eq & (aw[..., i] == bw[..., i])
+    return lt
+
+
+def ns_min(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.where(ns_less(a, b)[..., None], a, b)
+
+
+def ns_max(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.where(ns_less(a, b)[..., None], b, a)
+
+
+def _is_parity(ns_u8: jax.Array) -> jax.Array:
+    return jnp.all(ns_u8 == jnp.asarray(PARITY_NS), axis=-1)
+
+
+def leaf_nodes(
+    leaf_ns: jax.Array, leaf_data: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Hash all leaves: (T, L, 29) ns + (T, L, D) data -> (min, max, v) arrays."""
+    t, l, d = leaf_data.shape
+    prefix = jnp.zeros((t * l, 1), dtype=jnp.uint8)
+    preimage = jnp.concatenate(
+        [prefix, leaf_ns.reshape(t * l, NS), leaf_data.reshape(t * l, d)], axis=1
+    )
+    v = sha256.sha256(preimage).reshape(t, l, 32)
+    return leaf_ns, leaf_ns, v
+
+
+def reduce_level(
+    mins: jax.Array, maxs: jax.Array, vs: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Combine adjacent node pairs: (T, L, .) -> (T, L/2, .)."""
+    l_min, r_min = mins[:, 0::2], mins[:, 1::2]
+    l_max, r_max = maxs[:, 0::2], maxs[:, 1::2]
+    l_v, r_v = vs[:, 0::2], vs[:, 1::2]
+    t, half = l_v.shape[0], l_v.shape[1]
+
+    prefix = jnp.ones((t * half, 1), dtype=jnp.uint8)
+    preimage = jnp.concatenate(
+        [
+            prefix,
+            l_min.reshape(-1, NS), l_max.reshape(-1, NS), l_v.reshape(-1, 32),
+            r_min.reshape(-1, NS), r_max.reshape(-1, NS), r_v.reshape(-1, 32),
+        ],
+        axis=1,
+    )  # (T*half, 181)
+    v = sha256.sha256(preimage).reshape(t, half, 32)
+
+    node_min = ns_min(l_min, r_min)
+    parity = jnp.broadcast_to(jnp.asarray(PARITY_NS), l_max.shape)
+    node_max = jnp.where(
+        _is_parity(l_min)[..., None],
+        parity,
+        jnp.where(_is_parity(r_min)[..., None], l_max, ns_max(l_max, r_max)),
+    )
+    return node_min, node_max, v
+
+
+def nmt_roots(leaf_ns: jax.Array, leaf_data: jax.Array) -> jax.Array:
+    """Batched NMT roots: (T, L, 29) ns + (T, L, D) leaves -> (T, 90) u8 roots.
+
+    L must be a power of two (axis lengths of the extended square always are).
+    """
+    t, l, _ = leaf_data.shape
+    assert l & (l - 1) == 0 and l >= 1, f"leaf count {l} not a power of two"
+    mins, maxs, vs = leaf_nodes(leaf_ns, leaf_data)
+    while mins.shape[1] > 1:
+        mins, maxs, vs = reduce_level(mins, maxs, vs)
+    return jnp.concatenate([mins[:, 0], maxs[:, 0], vs[:, 0]], axis=1)

@@ -1,0 +1,119 @@
+"""Vectorized SHA-256 for JAX: hash N same-length messages in one launch.
+
+The DA pipeline's hashing workload (reference: `crypto/sha256` inside the nmt
+hasher, pkg/wrapper/nmt_wrapper.go) is millions of *independent* fixed-length
+messages per block — NMT leaves are 542-byte preimages, inner nodes 181 bytes,
+binary-Merkle nodes 65 bytes. That maps to the TPU VPU as pure u32 lane
+arithmetic: one traced program hashing a whole tree level at a time, with the
+64-round compression unrolled so XLA fuses it into a single elementwise chain.
+
+Semantics match FIPS 180-4 exactly (golden-tested against hashlib).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_K = np.array(
+    [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+        0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+        0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+        0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+        0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+        0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ],
+    dtype=np.uint32,
+)
+
+_H0 = np.array(
+    [0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+     0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19],
+    dtype=np.uint32,
+)
+
+
+def _rotr(x: jax.Array, n) -> jax.Array:
+    n = jnp.asarray(n, dtype=jnp.uint32)
+    return (x >> n) | (x << (np.uint32(32) - n))
+
+
+def _compress(state: jax.Array, block_words: jax.Array) -> jax.Array:
+    """One SHA-256 block over N lanes: state (8, N) u32, block (16, N) u32.
+
+    Rolled with fori_loop so the traced graph stays small — hashing is called
+    at every tree level of every pipeline, and an unrolled 64-round body
+    multiplies XLA compile time by ~100x for zero VPU runtime benefit.
+    """
+    n = state.shape[1]
+    w = jnp.zeros((64, n), dtype=jnp.uint32).at[:16].set(block_words)
+
+    def schedule(i, w):
+        s0 = _rotr(w[i - 15], 7) ^ _rotr(w[i - 15], 18) ^ (w[i - 15] >> np.uint32(3))
+        s1 = _rotr(w[i - 2], 17) ^ _rotr(w[i - 2], 19) ^ (w[i - 2] >> np.uint32(10))
+        return w.at[i].set(w[i - 16] + s0 + w[i - 7] + s1)
+
+    w = jax.lax.fori_loop(16, 64, schedule, w)
+    k_const = jnp.asarray(_K)
+
+    def round_fn(i, s):
+        a, b, c, d, e, f, g, h = s
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + k_const[i] + w[i]
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        return (t1 + t2, a, b, c, d + t1, e, f, g)
+
+    out = jax.lax.fori_loop(0, 64, round_fn, tuple(state))
+    return state + jnp.stack(out)
+
+
+def _pad_len(msg_len: int) -> int:
+    return ((msg_len + 8) // 64 + 1) * 64
+
+
+def sha256(msgs: jax.Array) -> jax.Array:
+    """SHA-256 of N equal-length messages: (N, L) uint8 -> (N, 32) uint8.
+
+    L is static; padding and block count are resolved at trace time. Blocks
+    are consumed with lax.scan (compile-time O(1) in block count).
+    """
+    n, msg_len = msgs.shape
+    total = _pad_len(msg_len)
+    tail = np.zeros(total - msg_len, dtype=np.uint8)
+    tail[0] = 0x80
+    bit_len = msg_len * 8
+    tail[-8:] = np.frombuffer(bit_len.to_bytes(8, "big"), dtype=np.uint8)
+    padded = jnp.concatenate(
+        [msgs, jnp.broadcast_to(jnp.asarray(tail), (n, tail.shape[0]))], axis=1
+    )
+    # Big-endian u32 words, grouped per block: (nblocks, 16, N)
+    quads = padded.reshape(n, total // 4, 4).astype(jnp.uint32)
+    be = jnp.array([1 << 24, 1 << 16, 1 << 8, 1], dtype=jnp.uint32)
+    words = jnp.sum(quads * be, axis=-1, dtype=jnp.uint32)  # (N, total/4)
+    blocks = jnp.transpose(words.reshape(n, total // 64, 16), (1, 2, 0))
+
+    state0 = jnp.broadcast_to(jnp.asarray(_H0)[:, None], (8, n))
+
+    def step(state, block_words):
+        return _compress(state, block_words), None
+
+    state, _ = jax.lax.scan(step, state0, blocks)
+    digest_words = jnp.transpose(state)  # (N, 8) u32
+    shifts = jnp.array([24, 16, 8, 0], dtype=jnp.uint32)
+    out = (digest_words[:, :, None] >> shifts[None, None, :]) & jnp.uint32(0xFF)
+    return out.reshape(n, 32).astype(jnp.uint8)
+
+
+EMPTY_SHA256 = bytes.fromhex(
+    "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855"
+)

@@ -1,0 +1,39 @@
+"""Test harness config: force CPU JAX with 8 virtual devices.
+
+Must run before jax initializes a backend — pytest imports conftest first.
+Multi-chip sharding tests use the virtual 8-device CPU mesh; the driver
+separately dry-runs the multi-chip path via __graft_entry__.dryrun_multichip.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--run-slow",
+        action="store_true",
+        default=False,
+        help="run tests marked slow (large square sizes; minutes on CPU)",
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: heavy square sizes, skipped by default")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--run-slow"):
+        return
+    skip = pytest.mark.skip(reason="needs --run-slow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

@@ -1,0 +1,44 @@
+"""RFC-6962 Merkle: device pow2 path vs host, proofs."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from celestia_app_tpu.ops import merkle
+from celestia_app_tpu.utils import merkle_host
+
+
+@pytest.mark.parametrize("n", [1, 2, 4, 8, 16])
+def test_device_matches_host_pow2(n):
+    rng = np.random.default_rng(n)
+    leaves = rng.integers(0, 256, size=(n, 90), dtype=np.uint8)
+    dev = np.asarray(merkle.merkle_root_pow2(jnp.asarray(leaves)))
+    host = merkle_host.hash_from_leaves([leaf.tobytes() for leaf in leaves])
+    assert dev.tobytes() == host
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 7, 12])
+def test_proofs_verify(n):
+    rng = np.random.default_rng(100 + n)
+    leaves = [rng.integers(0, 256, 32, dtype=np.uint8).tobytes() for _ in range(n)]
+    root, proofs = merkle_host.proofs_from_leaves(leaves)
+    assert root == merkle_host.hash_from_leaves(leaves)
+    for i, p in enumerate(proofs):
+        assert p.verify(root, leaves[i]), i
+        # Wrong leaf must fail
+        assert not p.verify(root, b"\x00" * 32) or leaves[i] == b"\x00" * 32
+
+
+def test_empty_tree():
+    import hashlib
+
+    assert merkle_host.hash_from_leaves([]) == hashlib.sha256(b"").digest()
+
+
+def test_tampered_proof_fails():
+    rng = np.random.default_rng(5)
+    leaves = [rng.integers(0, 256, 32, dtype=np.uint8).tobytes() for _ in range(5)]
+    root, proofs = merkle_host.proofs_from_leaves(leaves)
+    p = proofs[2]
+    p.aunts[0] = b"\x00" * 32
+    assert not p.verify(root, leaves[2])

@@ -1,0 +1,81 @@
+"""Device NMT reduction vs the host reference; namespace compare helpers."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from celestia_app_tpu.da import namespace as ns_mod
+from celestia_app_tpu.ops import nmt
+from celestia_app_tpu.utils import nmt_host
+
+
+def _random_sorted_ns(rng, count, with_parity_tail=0):
+    ns = []
+    for _ in range(count - with_parity_tail):
+        ns.append(bytes([0]) + b"\x00" * 18 + rng.integers(0, 256, 10, dtype=np.uint8).tobytes())
+    ns.sort()
+    ns += [ns_mod.PARITY_NS_RAW] * with_parity_tail
+    return ns
+
+
+@pytest.mark.parametrize("leaves,parity_tail", [(4, 0), (4, 2), (8, 4), (8, 8), (2, 1)])
+def test_device_matches_host(leaves, parity_tail):
+    rng = np.random.default_rng(leaves * 10 + parity_tail)
+    data_len = 64
+    trees = 3
+    all_ns, all_data = [], []
+    for _ in range(trees):
+        ns_list = _random_sorted_ns(rng, leaves, parity_tail)
+        data = [rng.integers(0, 256, data_len, dtype=np.uint8).tobytes() for _ in range(leaves)]
+        all_ns.append(ns_list)
+        all_data.append(data)
+
+    ns_arr = jnp.asarray(
+        np.array([[np.frombuffer(n, np.uint8) for n in t] for t in all_ns])
+    )
+    data_arr = jnp.asarray(
+        np.array([[np.frombuffer(d, np.uint8) for d in t] for t in all_data])
+    )
+    roots = np.asarray(nmt.nmt_roots(ns_arr, data_arr))
+
+    for t in range(trees):
+        tree = nmt_host.NmtTree()
+        for n, d in zip(all_ns[t], all_data[t]):
+            tree.push(n, d)
+        expected = nmt_host.serialize(tree.root())
+        assert roots[t].tobytes() == expected, f"tree {t}"
+
+
+def test_ignore_max_namespace_semantics():
+    """A root over [user, parity] must keep max_ns = user namespace."""
+    user = ns_mod.Namespace.v0(b"\x07").raw
+    tree = nmt_host.NmtTree()
+    tree.push(user, b"a" * 32)
+    tree.push(ns_mod.PARITY_NS_RAW, b"b" * 32)
+    root = tree.root()
+    assert root[0] == user and root[1] == user  # min == max == user ns
+
+
+def test_all_parity_root():
+    tree = nmt_host.NmtTree()
+    tree.push(ns_mod.PARITY_NS_RAW, b"x" * 16)
+    tree.push(ns_mod.PARITY_NS_RAW, b"y" * 16)
+    root = tree.root()
+    assert root[0] == root[1] == ns_mod.PARITY_NS_RAW
+
+
+def test_ns_less():
+    rng = np.random.default_rng(0)
+    raw = rng.integers(0, 256, size=(16, 29), dtype=np.uint8)
+    a = jnp.asarray(raw[:8])
+    b = jnp.asarray(raw[8:])
+    got = np.asarray(nmt.ns_less(a, b))
+    for i in range(8):
+        assert got[i] == (raw[i].tobytes() < raw[8 + i].tobytes())
+
+
+def test_push_out_of_order_rejected():
+    tree = nmt_host.NmtTree()
+    tree.push(ns_mod.Namespace.v0(b"\x05").raw, b"")
+    with pytest.raises(ValueError):
+        tree.push(ns_mod.Namespace.v0(b"\x04").raw, b"")

@@ -1,0 +1,92 @@
+"""Share & tx inclusion proofs over the host pipeline (no device needed)."""
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.da import dah as dah_mod
+from celestia_app_tpu.da import namespace as ns_mod
+from celestia_app_tpu.da import proof as proof_mod
+from celestia_app_tpu.da import square as square_mod
+from celestia_app_tpu.da.blob import Blob
+from celestia_app_tpu.da.square import PfbEntry
+from celestia_app_tpu.utils import refimpl
+
+
+@pytest.fixture(scope="module")
+def block():
+    rng = np.random.default_rng(42)
+    txs = [rng.integers(0, 256, 120, dtype=np.uint8).tobytes() for _ in range(2)]
+    blobs = [
+        Blob(ns_mod.Namespace.v0(b"aa"), rng.integers(0, 256, 900, dtype=np.uint8).tobytes()),
+        Blob(ns_mod.Namespace.v0(b"bb"), rng.integers(0, 256, 200, dtype=np.uint8).tobytes()),
+    ]
+    pfbs = [PfbEntry(b"pfb1", (blobs[0],)), PfbEntry(b"pfb2", (blobs[1],))]
+    sq = square_mod.build(txs, pfbs, 16, 64)
+    ods = dah_mod.shares_to_ods(sq.share_bytes())
+    eds_np, rows, cols, data_root = refimpl.pipeline_host(ods)
+    eds = dah_mod.ExtendedDataSquare(eds_np)
+    d = dah_mod.DataAvailabilityHeader(row_roots=tuple(rows), col_roots=tuple(cols))
+    assert d.hash() == data_root
+    return sq, eds, d, data_root
+
+
+def test_blob_share_proof_verifies(block):
+    sq, eds, d, root = block
+    start, end = proof_mod.blob_share_range(sq, 0, 0)
+    ns = sq.pfbs[0].blobs[0].namespace.raw
+    p = proof_mod.new_share_inclusion_proof(eds, d, start, end, ns)
+    assert p.verify(root)
+    # proven bytes reassemble to the blob
+    from celestia_app_tpu.da import shares as shares_mod
+
+    got = shares_mod.parse_sparse_shares([shares_mod.Share(b) for b in p.data])
+    assert got == sq.pfbs[0].blobs[0].data
+
+
+def test_share_proof_wrong_root_fails(block):
+    sq, eds, d, root = block
+    start, end = proof_mod.blob_share_range(sq, 1, 0)
+    ns = sq.pfbs[1].blobs[0].namespace.raw
+    p = proof_mod.new_share_inclusion_proof(eds, d, start, end, ns)
+    assert not p.verify(b"\x00" * 32)
+
+
+def test_share_proof_tampered_data_fails(block):
+    sq, eds, d, root = block
+    start, end = proof_mod.blob_share_range(sq, 0, 0)
+    ns = sq.pfbs[0].blobs[0].namespace.raw
+    p = proof_mod.new_share_inclusion_proof(eds, d, start, end, ns)
+    p.data[0] = b"\xff" * 512
+    assert not p.verify(root)
+
+
+def test_tx_inclusion_proofs(block):
+    sq, eds, d, root = block
+    total_txs = len(sq.txs) + len(sq.pfbs)
+    for i in range(total_txs):
+        p = proof_mod.new_tx_inclusion_proof(sq, eds, d, i)
+        assert p.verify(root), f"tx {i}"
+
+
+def test_multirow_share_proof(block):
+    """A range spanning several rows produces one NMT proof per row."""
+    sq, eds, d, root = block
+    k = sq.size
+    start, end = 0, min(2 * k + 1, k * k)  # spans >= 2 rows
+    # use the tx namespace for row 0; mixed-range proofs carry raw shares, the
+    # namespace field is only checked by callers — pass TX ns.
+    p = proof_mod.new_share_inclusion_proof(eds, d, start, end, ns_mod.TX_NAMESPACE.raw)
+    assert len(p.share_proofs) == (end - 1) // k + 1
+    # row proof alone must verify
+    assert p.row_proof.verify(root)
+
+
+def test_tx_share_range_sane(block):
+    sq, _, _, _ = block
+    for i in range(len(sq.txs) + len(sq.pfbs)):
+        s, e = proof_mod.tx_share_range(sq, i)
+        assert 0 <= s < e <= sq.size**2
+        if i < len(sq.txs):
+            assert e <= sq.tx_shares_len
+        else:
+            assert sq.tx_shares_len <= s < e <= sq.tx_shares_len + sq.pfb_shares_len

@@ -1,0 +1,32 @@
+"""Vectorized SHA-256 vs hashlib."""
+
+import hashlib
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from celestia_app_tpu.ops import sha256
+
+
+@pytest.mark.parametrize("length", [0, 1, 31, 55, 56, 63, 64, 65, 91, 181, 542])
+def test_matches_hashlib(length):
+    rng = np.random.default_rng(length)
+    msgs = rng.integers(0, 256, size=(6, length), dtype=np.uint8)
+    got = np.asarray(sha256.sha256(jnp.asarray(msgs)))
+    for i in range(msgs.shape[0]):
+        assert got[i].tobytes() == hashlib.sha256(msgs[i].tobytes()).digest()
+
+
+def test_empty_message_constant():
+    got = np.asarray(sha256.sha256(jnp.zeros((1, 0), dtype=jnp.uint8)))
+    assert got[0].tobytes() == sha256.EMPTY_SHA256
+
+
+def test_large_batch():
+    rng = np.random.default_rng(9)
+    msgs = rng.integers(0, 256, size=(512, 90), dtype=np.uint8)
+    got = np.asarray(sha256.sha256(jnp.asarray(msgs)))
+    idx = [0, 100, 511]
+    for i in idx:
+        assert got[i].tobytes() == hashlib.sha256(msgs[i].tobytes()).digest()

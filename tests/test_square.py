@@ -1,0 +1,127 @@
+"""Square layout: determinism, alignment, ordering, parsing back."""
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.da import namespace as ns_mod
+from celestia_app_tpu.da import shares as shares_mod
+from celestia_app_tpu.da import square as square_mod
+from celestia_app_tpu.da.blob import Blob, unmarshal_index_wrapper
+from celestia_app_tpu.da.commitment import subtree_width
+from celestia_app_tpu.da.square import PfbEntry
+
+THRESHOLD = 64
+
+
+def _blob(rng, ns_byte: int, size: int) -> Blob:
+    ns = ns_mod.Namespace.v0(bytes([ns_byte]) * 5)
+    return Blob(ns, rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+
+
+def test_empty_square():
+    sq = square_mod.build([], [], 64, THRESHOLD)
+    assert sq.size == 1
+    assert len(sq.shares) == 1
+    assert sq.shares[0].raw == shares_mod.tail_padding_share()
+
+
+def test_txs_only_roundtrip():
+    rng = np.random.default_rng(0)
+    txs = [rng.integers(0, 256, s, dtype=np.uint8).tobytes() for s in (50, 700, 30)]
+    sq = square_mod.build(txs, [], 64, THRESHOLD)
+    tx_shares = sq.shares[: sq.tx_shares_len]
+    assert shares_mod.parse_compact_shares(tx_shares) == txs
+    # everything after is tail padding
+    for s in sq.shares[sq.tx_shares_len :]:
+        assert s.namespace == ns_mod.TAIL_PADDING_NAMESPACE
+
+
+def test_blob_alignment_and_order():
+    rng = np.random.default_rng(1)
+    pfbs = [
+        PfbEntry(b"pfb-b", (_blob(rng, 9, 3000),)),
+        PfbEntry(b"pfb-a", (_blob(rng, 3, 1000), _blob(rng, 7, 600))),
+    ]
+    sq = square_mod.build([b"tx1"], pfbs, 64, THRESHOLD)
+    # every blob starts at a multiple of its subtree width
+    for (i, j), start in sq.blob_start_indexes.items():
+        blob = sq.pfbs[i].blobs[j]
+        width = subtree_width(blob.share_count(), THRESHOLD)
+        assert start % width == 0, (i, j, start, width)
+    # square is namespace-sorted
+    ns_order = [s.namespace.raw for s in sq.shares]
+    assert ns_order == sorted(ns_order)
+    # blob namespaces appear in ascending order: 3, 7, 9
+    starts = sorted(sq.blob_start_indexes.items(), key=lambda kv: kv[1])
+    ns_bytes = [sq.pfbs[i].blobs[j].namespace.raw[-5] for (i, j), _ in starts]
+    assert ns_bytes == [3, 7, 9]
+
+
+def test_blob_data_recoverable():
+    rng = np.random.default_rng(2)
+    blob = _blob(rng, 5, 2500)
+    sq = square_mod.build([], [PfbEntry(b"pfb", (blob,))], 64, THRESHOLD)
+    start = sq.blob_start_indexes[(0, 0)]
+    count = blob.share_count()
+    got = shares_mod.parse_sparse_shares(sq.shares[start : start + count])
+    assert got == blob.data
+
+
+def test_wrapped_pfb_roundtrip():
+    rng = np.random.default_rng(3)
+    blob = _blob(rng, 4, 100)
+    sq = square_mod.build([], [PfbEntry(b"mypfb", (blob,))], 64, THRESHOLD)
+    pfb_shares = sq.shares[sq.tx_shares_len : sq.tx_shares_len + sq.pfb_shares_len]
+    wrapped = shares_mod.parse_compact_shares(pfb_shares)
+    assert len(wrapped) == 1
+    iw = unmarshal_index_wrapper(wrapped[0])
+    assert iw.tx == b"mypfb"
+    assert iw.share_indexes == (sq.blob_start_indexes[(0, 0)],)
+
+
+def test_construct_equals_build():
+    """The proposer's square and every validator's reconstruction must agree
+    byte for byte (the PrepareProposal/ProcessProposal consistency core)."""
+    rng = np.random.default_rng(4)
+    txs = [rng.integers(0, 256, 80, dtype=np.uint8).tobytes() for _ in range(3)]
+    pfbs = [
+        PfbEntry(b"p1", (_blob(rng, 8, 1200),)),
+        PfbEntry(b"p2", (_blob(rng, 2, 400), _blob(rng, 2, 90))),
+    ]
+    built = square_mod.build(txs, pfbs, 32, THRESHOLD)
+    constructed = square_mod.construct(built.txs, built.pfbs, 32, THRESHOLD)
+    assert built.size == constructed.size
+    assert [s.raw for s in built.shares] == [s.raw for s in constructed.shares]
+
+
+def test_construct_rejects_overflow():
+    rng = np.random.default_rng(5)
+    big = _blob(rng, 6, 1000 * 478)  # ~1000 shares
+    with pytest.raises(ValueError):
+        square_mod.construct([], [PfbEntry(b"p", (big,))], 16, THRESHOLD)
+
+
+def test_build_drops_overflowing_tx():
+    rng = np.random.default_rng(6)
+    big = PfbEntry(b"big", (_blob(rng, 6, 200 * 478),))
+    small = PfbEntry(b"small", (_blob(rng, 7, 100),))
+    sq = square_mod.build([], [big, small], 4, THRESHOLD)  # max 16 shares
+    assert [e.tx for e in sq.pfbs] == [b"small"]
+    assert sq.size <= 4
+
+
+def test_compact_shares_needed():
+    assert square_mod.compact_shares_needed(0) == 0
+    assert square_mod.compact_shares_needed(474) == 1
+    assert square_mod.compact_shares_needed(475) == 2
+    assert square_mod.compact_shares_needed(474 + 478) == 2
+    assert square_mod.compact_shares_needed(474 + 478 + 1) == 3
+
+
+def test_square_is_perfect_and_pow2():
+    rng = np.random.default_rng(7)
+    for n_blobs in (1, 3, 6):
+        pfbs = [PfbEntry(b"p%d" % i, (_blob(rng, 3 + i, 700),)) for i in range(n_blobs)]
+        sq = square_mod.build([], pfbs, 64, THRESHOLD)
+        assert len(sq.shares) == sq.size**2
+        assert sq.size & (sq.size - 1) == 0
