@@ -1,0 +1,172 @@
+"""The ante handler chain: every tx admission/execution gate, in order.
+
+Reference parity: app/ante/ante.go:15-82's 17-decorator chain, reduced to the
+decorators with observable effect in this framework (panic wrapping lives in
+the app; IBC decorators arrive with the IBC subsystem):
+
+  1. validate basic (sig present, fee sane)
+  2. msg-version gatekeeper — circuit breaker by app version
+     (app/ante/msg_gatekeeper.go)
+  3. consume tx-size gas (10 gas/byte)
+  4. fee checker: gas price >= max(network min, local min) then deduct
+     (app/ante/fee_checker.go; network floor from x/minfee)
+  5. signature verification (pubkey binding, account number, sequence)
+  6. increment sequence
+  7. blob decorators: MinGasPFBDecorator + BlobShareDecorator
+     (x/blob/ante/ante.go:15-52, blob_share_decorator.go:28-63)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.chain import modules
+from celestia_app_tpu.chain.state import Context
+from celestia_app_tpu.chain.tx import (
+    MsgPayForBlobs,
+    MsgRegisterEVMAddress,
+    MsgSend,
+    MsgSignalVersion,
+    MsgTryUpgrade,
+    Tx,
+)
+from celestia_app_tpu.chain.crypto import PublicKey
+from celestia_app_tpu.da import shares as shares_mod
+
+
+class AnteError(Exception):
+    pass
+
+
+# Msg acceptance by app version (app/module configurator GetAcceptedMessages:
+# signal msgs exist from v2; blobstream registration only at v1).
+MSG_VERSIONS: dict[str, tuple[int, int]] = {
+    MsgSend.TYPE: (1, 99),
+    MsgPayForBlobs.TYPE: (1, 99),
+    MsgRegisterEVMAddress.TYPE: (1, 1),
+    MsgSignalVersion.TYPE: (2, 99),
+    MsgTryUpgrade.TYPE: (2, 99),
+}
+
+
+@dataclasses.dataclass
+class AnteHandler:
+    auth: modules.AuthKeeper
+    bank: modules.BankKeeper
+    blob: modules.BlobKeeper
+    minfee: modules.MinFeeKeeper
+    min_gas_price: float = appconsts.DEFAULT_MIN_GAS_PRICE
+
+    def run(self, ctx: Context, tx: Tx, simulate: bool = False) -> None:
+        """Raises AnteError when the tx must be rejected; consumes gas."""
+        body = tx.body
+        # 1. basic validation
+        if not body.msgs:
+            raise AnteError("empty tx")
+        if body.gas_limit <= 0:
+            raise AnteError("zero gas limit")
+        if body.chain_id != ctx.chain_id:
+            raise AnteError(f"wrong chain id {body.chain_id!r}")
+        if body.timeout_height and ctx.height > body.timeout_height:
+            raise AnteError("tx timed out")
+
+        # 2. version gatekeeper (circuit breaker)
+        for m in body.msgs:
+            lo, hi = MSG_VERSIONS.get(m.TYPE, (99, 99))
+            if not (lo <= ctx.app_version <= hi):
+                raise AnteError(
+                    f"message {m.TYPE} not accepted at app version {ctx.app_version}"
+                )
+
+        # 3. tx size gas
+        size = len(tx.encode())
+        ctx.gas_meter.consume(
+            size * appconsts.versioned(ctx.app_version).tx_size_cost_per_byte,
+            "tx size",
+        )
+
+        # 4. fee check + deduction
+        floor = self.min_gas_price
+        if ctx.app_version >= 2:
+            floor = max(floor, self.minfee.network_min_gas_price(ctx))
+        if not ctx.is_check_tx:
+            # at delivery only the network floor binds (fee_checker.go)
+            floor = (
+                self.minfee.network_min_gas_price(ctx) if ctx.app_version >= 2 else 0.0
+            )
+        gas_price = body.fee / body.gas_limit
+        if gas_price < floor:
+            raise AnteError(
+                f"insufficient gas price: {gas_price:.9f} < min {floor:.9f}"
+            )
+
+        signer = self._signer(body)
+        if not simulate:
+            try:
+                self.bank.send(ctx, signer, modules.FEE_COLLECTOR, body.fee)
+            except ValueError as e:
+                raise AnteError(f"cannot pay fee: {e}") from None
+
+        # 5. signature verification
+        if not simulate:
+            if PublicKey(tx.pubkey).address() != signer:
+                raise AnteError("pubkey does not match signer address")
+            acc = self.auth.ensure_account(ctx, signer)
+            if acc["number"] != body.account_number:
+                raise AnteError(
+                    f"account number mismatch: got {body.account_number}, want {acc['number']}"
+                )
+            if acc["sequence"] != body.sequence:
+                raise AnteError(
+                    f"account sequence mismatch, expected {acc['sequence']}, got {body.sequence}"
+                )
+            if not tx.verify_signature():
+                raise AnteError("signature verification failed")
+            self.auth.set_pubkey(ctx, signer, tx.pubkey)
+
+            # 6. sequence increment
+            self.auth.increment_sequence(ctx, signer)
+
+        # 7. blob decorators
+        for m in body.msgs:
+            if isinstance(m, MsgPayForBlobs):
+                self._check_pfb(ctx, m, body)
+
+    def _signer(self, body) -> bytes:
+        addrs = set()
+        for m in body.msgs:
+            if isinstance(m, MsgSend):
+                addrs.add(m.from_addr)
+            elif isinstance(m, MsgPayForBlobs):
+                addrs.add(m.signer)
+            elif isinstance(m, (MsgSignalVersion,)):
+                addrs.add(m.validator)
+            elif isinstance(m, (MsgTryUpgrade,)):
+                addrs.add(m.signer)
+            elif isinstance(m, MsgRegisterEVMAddress):
+                addrs.add(m.validator)
+        if len(addrs) != 1:
+            raise AnteError(f"tx must have exactly one signer, got {len(addrs)}")
+        return next(iter(addrs))
+
+    def _check_pfb(self, ctx: Context, msg: MsgPayForBlobs, body) -> None:
+        # MinGasPFBDecorator: enough gas for the blob bytes
+        params = self.blob.params(ctx)
+        needed = self.blob.gas_to_consume(msg.blob_sizes, params["gas_per_blob_byte"])
+        if body.gas_limit < needed:
+            raise AnteError(
+                f"gas limit {body.gas_limit} below blob gas requirement {needed}"
+            )
+        # BlobShareDecorator: blobs must fit the governed square
+        max_sq = min(
+            params["gov_max_square_size"],
+            appconsts.square_size_upper_bound(ctx.app_version),
+        )
+        total_shares = sum(
+            shares_mod.sparse_shares_needed(s) for s in msg.blob_sizes
+        )
+        if total_shares > max_sq * max_sq:
+            raise AnteError(
+                f"blob shares {total_shares} exceed square capacity {max_sq * max_sq}"
+            )

@@ -1,0 +1,485 @@
+"""The application: celestia-app's ABCI surface rebuilt around the TPU pipeline.
+
+Reference parity (SURVEY.md §3 call stacks):
+- CheckTx           app/check_tx.go:16-54   — unwrap BlobTx, ValidateBlobTx,
+                    strip blobs, run ante chain in check mode
+- PrepareProposal   app/prepare_proposal.go:22-91 — ante-filter txs, build the
+                    square, extend + DAH on device, return txs/size/data root
+- ProcessProposal   app/process_proposal.go:24-158 — re-validate every tx,
+                    deterministically reconstruct the square, recompute the
+                    data root, byte-compare vs the header; ANY failure/panic
+                    votes reject (liveness-first, :29-35)
+- FinalizeBlock     BeginBlock (mint) -> DeliverTx each -> EndBlock (signal
+                    upgrade flip, height-based v1->v2) -> Commit (app hash)
+- multi-version behavior: one App serves versions 1..3 with per-version msg
+  acceptance (ante.MSG_VERSIONS) and store migrations on upgrade
+  (app/app.go:458-508 analog).
+
+The square pipeline runs on device (da/eds.py, one jitted dispatch) when a
+JAX backend is available, with a bit-identical host fallback (utils/refimpl).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time as time_mod
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.chain import ante as ante_mod
+from celestia_app_tpu.chain import modules
+from celestia_app_tpu.chain.block import Block, Header, TxResult
+from celestia_app_tpu.chain.blob_validation import BlobTxError, validate_blob_tx
+from celestia_app_tpu.chain.state import Context, GasMeter, InfiniteGasMeter, KVStore, OutOfGas
+from celestia_app_tpu.chain.tx import (
+    MsgPayForBlobs,
+    MsgRegisterEVMAddress,
+    MsgSend,
+    MsgSignalVersion,
+    MsgTryUpgrade,
+    Tx,
+)
+from celestia_app_tpu.da import blob as blob_mod
+from celestia_app_tpu.da import dah as dah_mod
+from celestia_app_tpu.da import square as square_mod
+from celestia_app_tpu.da.square import PfbEntry
+
+
+@dataclasses.dataclass
+class ProposalResult:
+    block: Block
+    square: square_mod.Square
+    dah: dah_mod.DataAvailabilityHeader
+
+
+class App:
+    def __init__(
+        self,
+        chain_id: str = "celestia-tpu-1",
+        app_version: int = 1,
+        engine: str = "auto",  # "device" | "host" | "auto"
+        min_gas_price: float = appconsts.DEFAULT_MIN_GAS_PRICE,
+        v2_upgrade_height: int | None = None,
+    ):
+        self.chain_id = chain_id
+        self.app_version = app_version
+        self.engine = engine
+        self.v2_upgrade_height = v2_upgrade_height
+        self.store = KVStore()
+        self.height = 0
+        self.last_app_hash = self.store.app_hash()
+        self.last_block_hash = b"\x00" * 32
+        self.genesis_time: float | None = None
+
+        self.auth = modules.AuthKeeper()
+        self.bank = modules.BankKeeper()
+        self.blob = modules.BlobKeeper()
+        self.mint = modules.MintKeeper()
+        self.staking = modules.StakingKeeper()
+        self.signal = modules.SignalKeeper(self.staking)
+        self.minfee = modules.MinFeeKeeper()
+        self.ante = ante_mod.AnteHandler(
+            self.auth, self.bank, self.blob, self.minfee, min_gas_price
+        )
+        # committed-state snapshots for load_height rollback (app/app.go:592)
+        self._history: dict[int, dict] = {}
+
+    # ------------------------------------------------------------------
+    # pipeline selection
+    # ------------------------------------------------------------------
+
+    def _pipeline(self, ods):
+        """ODS -> (row_roots, col_roots, data_root); device when possible."""
+        if self.engine in ("device", "auto"):
+            try:
+                import jax.numpy as jnp
+
+                from celestia_app_tpu.da import eds as eds_mod
+
+                _, rows, cols, root = eds_mod.jitted_pipeline(ods.shape[0])(
+                    jnp.asarray(ods)
+                )
+                import numpy as np
+
+                return (
+                    [bytes(r) for r in np.asarray(rows)],
+                    [bytes(c) for c in np.asarray(cols)],
+                    bytes(np.asarray(root)),
+                )
+            except Exception:
+                if self.engine == "device":
+                    raise
+        from celestia_app_tpu.utils import refimpl
+
+        _, rows, cols, root = refimpl.pipeline_host(ods)
+        return rows, cols, root
+
+    def _data_root(self, square: square_mod.Square) -> tuple[dah_mod.DataAvailabilityHeader, bytes]:
+        ods = dah_mod.shares_to_ods(square.share_bytes())
+        rows, cols, root = self._pipeline(ods)
+        return dah_mod.DataAvailabilityHeader(tuple(rows), tuple(cols)), root
+
+    # ------------------------------------------------------------------
+    # genesis
+    # ------------------------------------------------------------------
+
+    def init_chain(self, genesis: dict) -> None:
+        """genesis = {accounts: [{address(hex), balance}], validators:
+        [{operator(hex), power}], time_unix, params...}"""
+        ctx = self._deliver_ctx(InfiniteGasMeter())
+        self.genesis_time = genesis.get("time_unix", time_mod.time())
+        for acc in genesis.get("accounts", []):
+            addr = bytes.fromhex(acc["address"])
+            self.auth.ensure_account(ctx, addr)
+            self.bank.mint(ctx, addr, acc["balance"])
+        for val in genesis.get("validators", []):
+            self.staking.set_validator(ctx, bytes.fromhex(val["operator"]), val["power"])
+        if "gov_max_square_size" in genesis:
+            p = self.blob.params(ctx)
+            p["gov_max_square_size"] = genesis["gov_max_square_size"]
+            self.blob.set_params(ctx, p)
+        ctx.store.write()
+        self.last_app_hash = self.store.app_hash()
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+
+    def _ctx(self, store, gas_meter, *, check: bool, height=None, t=None) -> Context:
+        return Context(
+            store,
+            gas_meter,
+            height if height is not None else self.height + 1,
+            t if t is not None else time_mod.time(),
+            self.chain_id,
+            self.app_version,
+            is_check_tx=check,
+        )
+
+    def _deliver_ctx(self, gas_meter, height=None, t=None) -> Context:
+        return self._ctx(self.store.branch(), gas_meter, check=False, height=height, t=t)
+
+    def max_effective_square_size(self, ctx: Context) -> int:
+        """min(gov param, versioned hard cap) — app/square_size.go:9-23."""
+        return min(
+            self.blob.params(ctx)["gov_max_square_size"],
+            appconsts.square_size_upper_bound(self.app_version),
+        )
+
+    # ------------------------------------------------------------------
+    # CheckTx (mempool admission)
+    # ------------------------------------------------------------------
+
+    def check_tx(self, raw: bytes) -> TxResult:
+        ctx = self._ctx(self.store.branch(), GasMeter(1 << 40), check=True)
+        threshold = appconsts.subtree_root_threshold(self.app_version)
+        try:
+            if blob_mod.is_blob_tx(raw):
+                btx = blob_mod.unmarshal_blob_tx(raw)
+                tx, _ = validate_blob_tx(btx, threshold)
+            else:
+                tx = Tx.decode(raw)
+                if any(isinstance(m, MsgPayForBlobs) for m in tx.body.msgs):
+                    raise BlobTxError("MsgPayForBlobs without blobs (ErrNoBlobs)")
+            gas = GasMeter(tx.body.gas_limit)
+            ctx.gas_meter = gas
+            self.ante.run(ctx, tx)
+            return TxResult(0, "", tx.body.gas_limit, gas.consumed, ctx.events)
+        except (ante_mod.AnteError, BlobTxError, OutOfGas, ValueError) as e:
+            return TxResult(1, str(e), 0, ctx.gas_meter.consumed, [])
+
+    # ------------------------------------------------------------------
+    # PrepareProposal (proposer)
+    # ------------------------------------------------------------------
+
+    def prepare_proposal(
+        self, raw_txs: list[bytes], proposer: bytes = b"", t: float | None = None
+    ) -> ProposalResult:
+        t = t if t is not None else time_mod.time()
+        height = self.height + 1
+        threshold = appconsts.subtree_root_threshold(self.app_version)
+
+        # Split first; ante-filter ALL normal txs before ANY blob tx, exactly
+        # mirroring FilterTxs (validate_txs.go:32-98). This ordering is what
+        # makes ProcessProposal's replay (block order: normal then blob)
+        # observe identical sequence numbers.
+        normal_candidates: list[bytes] = []
+        blob_candidates: list[tuple[bytes, PfbEntry]] = []
+        for raw in raw_txs:
+            if blob_mod.is_blob_tx(raw):
+                try:
+                    btx = blob_mod.unmarshal_blob_tx(raw)
+                    validate_blob_tx(btx, threshold)
+                    blob_candidates.append((raw, PfbEntry(btx.tx, btx.blobs)))
+                except (BlobTxError, ValueError):
+                    continue
+            else:
+                try:
+                    tx = Tx.decode(raw)
+                except ValueError:
+                    continue
+                if any(isinstance(m, MsgPayForBlobs) for m in tx.body.msgs):
+                    continue  # PFB without blobs never enters a block
+                normal_candidates.append(raw)
+
+        def ante_filter(
+            normals: list[bytes], blobs: list[tuple[bytes, PfbEntry]]
+        ) -> tuple[list[bytes], list[tuple[bytes, PfbEntry]]]:
+            ctx = self._ctx(
+                self.store.branch(), InfiniteGasMeter(), check=False,
+                height=height, t=t,
+            )
+            kept_n, kept_b = [], []
+            for raw in normals:
+                tx = Tx.decode(raw)
+                per_tx = ctx.branch()
+                per_tx.gas_meter = GasMeter(tx.body.gas_limit)
+                try:
+                    self.ante.run(per_tx, tx)
+                    per_tx.store.write()
+                    kept_n.append(raw)
+                except (ante_mod.AnteError, OutOfGas, ValueError):
+                    continue
+            for raw, entry in blobs:
+                tx = Tx.decode(entry.tx)
+                per_tx = ctx.branch()
+                per_tx.gas_meter = GasMeter(tx.body.gas_limit)
+                try:
+                    self.ante.run(per_tx, tx)
+                    per_tx.store.write()
+                    kept_b.append((raw, entry))
+                except (ante_mod.AnteError, OutOfGas, ValueError):
+                    continue
+            return kept_n, kept_b
+
+        normal_txs, kept_blobs = ante_filter(normal_candidates, blob_candidates)
+        max_sq = self.max_effective_square_size(
+            self._ctx(self.store.branch(), InfiniteGasMeter(), check=False)
+        )
+        # square.build may drop txs; admission (sequence chain) depends on the
+        # final tx set, so re-filter and rebuild until a fixed point.
+        while True:
+            square = square_mod.build(
+                normal_txs, [e for _, e in kept_blobs], max_sq, threshold
+            )
+            kept_tx_set = set(square.txs)
+            kept_pfb_set = {e.tx for e in square.pfbs}
+            next_normals = [r for r in normal_txs if r in kept_tx_set]
+            next_blobs = [(r, e) for r, e in kept_blobs if e.tx in kept_pfb_set]
+            if len(next_normals) == len(normal_txs) and len(next_blobs) == len(kept_blobs):
+                break
+            normal_txs, kept_blobs = ante_filter(next_normals, next_blobs)
+        kept_blob_raws = [r for r, _ in kept_blobs]
+        d, root = self._data_root(square)
+
+        header = Header(
+            chain_id=self.chain_id,
+            height=height,
+            time_unix=t,
+            data_hash=root,
+            square_size=square.size,
+            app_hash=self.last_app_hash,
+            proposer=proposer,
+            app_version=self.app_version,
+            last_block_hash=self.last_block_hash,
+        )
+        block = Block(header=header, txs=tuple(square.txs + kept_blob_raws))
+        return ProposalResult(block=block, square=square, dah=d)
+
+    # ------------------------------------------------------------------
+    # ProcessProposal (every validator)
+    # ------------------------------------------------------------------
+
+    def process_proposal(self, block: Block) -> bool:
+        """True = accept. Any validation failure or internal panic rejects
+        (process_proposal.go:29-35 defer/recover)."""
+        try:
+            self._process_proposal_inner(block)
+            return True
+        except Exception:
+            return False
+
+    def _process_proposal_inner(self, block: Block) -> None:
+        threshold = appconsts.subtree_root_threshold(self.app_version)
+        h = block.header
+        if h.chain_id != self.chain_id or h.height != self.height + 1:
+            raise ValueError("wrong chain or height")
+        if h.app_version != self.app_version:
+            raise ValueError("app version mismatch")
+        if h.app_hash != self.last_app_hash:
+            raise ValueError("app hash mismatch")
+
+        ctx = self._ctx(
+            self.store.branch(), InfiniteGasMeter(), check=False,
+            height=h.height, t=h.time_unix,
+        )
+        normal_txs: list[bytes] = []
+        pfb_entries: list[PfbEntry] = []
+        seen_blob = False
+        for raw in block.txs:
+            if blob_mod.is_blob_tx(raw):
+                seen_blob = True
+                btx = blob_mod.unmarshal_blob_tx(raw)
+                tx, _ = validate_blob_tx(btx, threshold)
+                # the full ante chain runs for blob txs too — sig, fee funds,
+                # sequence (process_proposal.go:100-117); block order (normal
+                # before blob) matches PrepareProposal's filter order, so the
+                # sequence chain observed here is identical.
+                per_tx = ctx.branch()
+                per_tx.gas_meter = GasMeter(tx.body.gas_limit)
+                self.ante.run(per_tx, tx)
+                per_tx.store.write()
+                pfb_entries.append(PfbEntry(btx.tx, btx.blobs))
+            else:
+                if seen_blob:
+                    raise ValueError("normal tx after blob tx (ordering violation)")
+                tx = Tx.decode(raw)  # v2+: undecodable tx rejects the block
+                if any(isinstance(m, MsgPayForBlobs) for m in tx.body.msgs):
+                    raise ValueError("PFB message in non-blob tx")
+                per_tx = ctx.branch()
+                per_tx.gas_meter = GasMeter(tx.body.gas_limit)
+                self.ante.run(per_tx, tx)
+                per_tx.store.write()
+                normal_txs.append(raw)
+
+        square = square_mod.construct(
+            normal_txs, pfb_entries, self.max_effective_square_size(ctx), threshold
+        )
+        if square.size != h.square_size:
+            raise ValueError(
+                f"square size mismatch: computed {square.size}, header {h.square_size}"
+            )
+        _, root = self._data_root(square)
+        if root != h.data_hash:
+            raise ValueError("data root mismatch")
+
+    # ------------------------------------------------------------------
+    # FinalizeBlock + Commit
+    # ------------------------------------------------------------------
+
+    def finalize_block(self, block: Block) -> list[TxResult]:
+        h = block.header
+        ctx = self._deliver_ctx(InfiniteGasMeter(), height=h.height, t=h.time_unix)
+
+        # BeginBlock: mint first (app/modules.go block order)
+        self.mint.begin_blocker(ctx, self.bank)
+
+        results: list[TxResult] = []
+        for raw in block.txs:
+            results.append(self._deliver_tx(ctx, raw))
+
+        # EndBlock: upgrades
+        self._end_blocker(ctx, h.height)
+
+        ctx.store.write()
+        return results
+
+    def _deliver_tx(self, block_ctx: Context, raw: bytes) -> TxResult:
+        if blob_mod.is_blob_tx(raw):
+            raw_tx = blob_mod.unmarshal_blob_tx(raw).tx  # strip blobs
+        else:
+            raw_tx = raw
+        try:
+            tx = Tx.decode(raw_tx)
+        except ValueError as e:
+            return TxResult(1, f"undecodable tx: {e}", 0, 0, [])
+        gas = GasMeter(tx.body.gas_limit)
+        tx_ctx = block_ctx.branch()
+        tx_ctx.gas_meter = gas
+        try:
+            self.ante.run(tx_ctx, tx)
+            for m in tx.body.msgs:
+                self._dispatch(tx_ctx, m)
+            tx_ctx.store.write()
+            return TxResult(0, "", tx.body.gas_limit, gas.consumed, tx_ctx.events)
+        except (ante_mod.AnteError, OutOfGas, ValueError) as e:
+            # failed txs keep their fee + sequence bump (cosmos semantics):
+            # re-run just the ante effects on a fresh branch
+            fee_ctx = block_ctx.branch()
+            fee_ctx.gas_meter = GasMeter(tx.body.gas_limit)
+            try:
+                self.ante.run(fee_ctx, tx)
+                fee_ctx.store.write()
+            except Exception:
+                pass
+            return TxResult(1, str(e), tx.body.gas_limit, gas.consumed, [])
+
+    def _dispatch(self, ctx: Context, msg) -> None:
+        if isinstance(msg, MsgSend):
+            self.bank.send(ctx, msg.from_addr, msg.to_addr, msg.amount)
+        elif isinstance(msg, MsgPayForBlobs):
+            self.blob.pay_for_blobs(ctx, msg)
+        elif isinstance(msg, MsgSignalVersion):
+            self.signal.signal_version(ctx, msg.validator, msg.version)
+        elif isinstance(msg, MsgTryUpgrade):
+            self.signal.try_upgrade(ctx)
+        elif isinstance(msg, MsgRegisterEVMAddress):
+            if self.app_version != 1:
+                raise ValueError("blobstream disabled after v1")
+            ctx.store.set(b"blobstream/evm/" + msg.validator, msg.evm_address)
+        else:
+            raise ValueError(f"unroutable message {type(msg).__name__}")
+
+    def _end_blocker(self, ctx: Context, height: int) -> None:
+        # height-based v1 -> v2 (app/app.go:458-470)
+        if (
+            self.app_version == 1
+            and self.v2_upgrade_height is not None
+            and height >= self.v2_upgrade_height
+        ):
+            self._migrate(ctx, 2)
+            return
+        # signal-based v2+ (app/app.go:472-478)
+        if self.app_version >= 2:
+            target = self.signal.should_upgrade(ctx)
+            if target is not None:
+                self.signal.clear_upgrade(ctx)
+                self._migrate(ctx, target)
+
+    def _migrate(self, ctx: Context, new_version: int) -> None:
+        """Store migrations on upgrade (app/app.go:484-508 analog)."""
+        if new_version >= 2 and self.app_version < 2:
+            # blobstream retires at v2 (modules.go:171); minfee param seeded
+            for k, _ in list(ctx.store.iterate_prefix(b"blobstream/")):
+                ctx.store.delete(k)
+            self.minfee.set_network_min_gas_price(
+                ctx, appconsts.DEFAULT_NETWORK_MIN_GAS_PRICE
+            )
+        self.app_version = new_version
+
+    SNAPSHOT_KEEP = 100  # bounded rollback window (reference keeps pruned IAVL versions)
+
+    def commit(self, block: Block) -> bytes:
+        self.height = block.header.height
+        self.last_app_hash = self.store.app_hash()
+        self.last_block_hash = block.header.hash()
+        # snapshot full post-commit identity, keyed by height, pruned to a window
+        self._history[self.height] = {
+            "store": self.store.snapshot(),
+            "app_version": self.app_version,
+            "last_app_hash": self.last_app_hash,
+            "last_block_hash": self.last_block_hash,
+        }
+        for h in [h for h in self._history if h <= self.height - self.SNAPSHOT_KEEP]:
+            del self._history[h]
+        return self.last_app_hash
+
+    def load_height(self, height: int) -> None:
+        """Rollback to a committed height (reference LoadHeight): restores the
+        store AND the version/hash identity so re-execution matches the
+        original chain."""
+        snap = self._history.get(height)
+        if snap is None:
+            raise ValueError(f"no snapshot for height {height}")
+        self.store.restore(snap["store"])
+        self.height = height
+        self.app_version = snap["app_version"]
+        self.last_app_hash = snap["last_app_hash"]
+        self.last_block_hash = snap["last_block_hash"]
+
+    # convenience: one full consensus round in-process
+    def produce_block(self, raw_txs: list[bytes], t: float | None = None) -> tuple[Block, list[TxResult]]:
+        prop = self.prepare_proposal(raw_txs, t=t)
+        assert self.process_proposal(prop.block), "own proposal rejected"
+        results = self.finalize_block(prop.block)
+        self.commit(prop.block)
+        return prop.block, results

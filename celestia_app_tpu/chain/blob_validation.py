@@ -1,0 +1,54 @@
+"""Stateless BlobTx validation: the mempool/proposal admission gate.
+
+Reference parity: x/blob/types/blob_tx.go:37-108 `ValidateBlobTx` — the tx
+must decode to exactly one MsgPayForBlobs whose per-blob namespace, size,
+share version, and recomputed share commitment all match the attached blobs.
+Called from CheckTx (app/check_tx.go:43) and ProcessProposal
+(app/process_proposal.go:107), i.e. commitments are recomputed on every
+admission — which is why da/commitment.py batching is a benchmark config.
+"""
+
+from __future__ import annotations
+
+from celestia_app_tpu.chain.tx import MsgPayForBlobs, Tx
+from celestia_app_tpu.da import commitment as commitment_mod
+from celestia_app_tpu.da.blob import BlobTx
+
+
+class BlobTxError(Exception):
+    pass
+
+
+def validate_blob_tx(btx: BlobTx, subtree_root_threshold: int) -> tuple[Tx, MsgPayForBlobs]:
+    """Validate and return the decoded signed tx + its PFB message."""
+    if not btx.blobs:
+        raise BlobTxError("blob tx contains no blobs")
+    try:
+        tx = Tx.decode(btx.tx)
+    except ValueError as e:
+        raise BlobTxError(f"undecodable tx in blob tx: {e}") from None
+
+    pfbs = [m for m in tx.body.msgs if isinstance(m, MsgPayForBlobs)]
+    if len(pfbs) != 1 or len(tx.body.msgs) != 1:
+        raise BlobTxError("blob tx must contain exactly one MsgPayForBlobs")
+    msg = pfbs[0]
+    msg.validate_basic()
+
+    if len(btx.blobs) != len(msg.namespaces):
+        raise BlobTxError(
+            f"blob count mismatch: {len(btx.blobs)} attached, {len(msg.namespaces)} in msg"
+        )
+    for i, blob in enumerate(btx.blobs):
+        blob.validate()
+        if blob.namespace.raw != msg.namespaces[i]:
+            raise BlobTxError(f"blob {i} namespace does not match msg")
+        if len(blob.data) != msg.blob_sizes[i]:
+            raise BlobTxError(
+                f"blob {i} size mismatch: {len(blob.data)} != {msg.blob_sizes[i]}"
+            )
+        if blob.share_version != msg.share_versions[i]:
+            raise BlobTxError(f"blob {i} share version mismatch")
+        want = commitment_mod.create_commitment(blob, subtree_root_threshold)
+        if want != msg.share_commitments[i]:
+            raise BlobTxError(f"blob {i} share commitment mismatch")
+    return tx, msg

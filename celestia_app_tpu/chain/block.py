@@ -1,0 +1,53 @@
+"""Block structures: header with data root, block hash chain."""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+from celestia_app_tpu.da.shares import uvarint
+
+
+@dataclasses.dataclass(frozen=True)
+class Header:
+    chain_id: str
+    height: int
+    time_unix: float
+    data_hash: bytes  # DAH root — commits to the extended square
+    square_size: int
+    app_hash: bytes  # state root AFTER the previous block
+    proposer: bytes
+    app_version: int
+    last_block_hash: bytes = b"\x00" * 32
+
+    def encode(self) -> bytes:
+        cid = self.chain_id.encode()
+        out = bytearray()
+        out += uvarint(len(cid)) + cid
+        out += uvarint(self.height)
+        out += int(self.time_unix * 1e9).to_bytes(8, "big")
+        out += self.data_hash
+        out += uvarint(self.square_size)
+        out += self.app_hash
+        out += uvarint(len(self.proposer)) + self.proposer
+        out += uvarint(self.app_version)
+        out += self.last_block_hash
+        return bytes(out)
+
+    def hash(self) -> bytes:
+        return hashlib.sha256(self.encode()).digest()
+
+
+@dataclasses.dataclass(frozen=True)
+class Block:
+    header: Header
+    txs: tuple[bytes, ...]  # normal txs then BlobTx envelopes
+
+
+@dataclasses.dataclass
+class TxResult:
+    code: int  # 0 = ok
+    log: str
+    gas_wanted: int
+    gas_used: int
+    events: list[dict]

@@ -1,0 +1,94 @@
+"""Account keys, addresses, signing.
+
+Reference parity: cosmos-sdk secp256k1 account keys (the reference's account
+auth) — here via the `cryptography` library's SECP256K1 ECDSA with SHA-256,
+with deterministic low-level DER unwrapping to 64-byte (r || s) signatures.
+Addresses are the first 20 bytes of SHA-256(compressed pubkey) (the reference
+uses ripemd160(sha256(pk)); ripemd160 is unavailable in this OpenSSL build,
+and the address derivation is not consensus-relevant across frameworks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    Prehashed,
+    decode_dss_signature,
+    encode_dss_signature,
+)
+
+ADDRESS_LEN = 20
+_CURVE = ec.SECP256K1()
+# secp256k1 group order, for low-S normalization (signature malleability).
+_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+
+def _sha(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+@dataclasses.dataclass(frozen=True)
+class PublicKey:
+    compressed: bytes  # 33-byte SEC1 compressed point
+
+    def address(self) -> bytes:
+        return _sha(self.compressed)[:ADDRESS_LEN]
+
+    def verify(self, signature: bytes, message: bytes) -> bool:
+        """Verify a 64-byte (r || s) signature over sha256(message)."""
+        if len(signature) != 64:
+            return False
+        try:
+            pub = ec.EllipticCurvePublicKey.from_encoded_point(_CURVE, self.compressed)
+            r = int.from_bytes(signature[:32], "big")
+            s = int.from_bytes(signature[32:], "big")
+            if s > _N // 2:
+                return False  # reject high-S: tx bytes must not be malleable
+            der = encode_dss_signature(r, s)
+            pub.verify(der, _sha(message), ec.ECDSA(Prehashed(hashes.SHA256())))
+            return True
+        except Exception:
+            return False
+
+
+@dataclasses.dataclass(frozen=True)
+class PrivateKey:
+    scalar: int
+
+    @classmethod
+    def from_seed(cls, seed: bytes) -> "PrivateKey":
+        """Deterministic key derivation from a seed (test fixtures, wallets)."""
+        d = int.from_bytes(_sha(b"celestia_tpu/key" + seed), "big") % (_N - 1) + 1
+        return cls(d)
+
+    @classmethod
+    def generate(cls) -> "PrivateKey":
+        import secrets
+
+        return cls(secrets.randbelow(_N - 1) + 1)
+
+    def _key(self) -> ec.EllipticCurvePrivateKey:
+        return ec.derive_private_key(self.scalar, _CURVE)
+
+    def public_key(self) -> PublicKey:
+        pub = self._key().public_key()
+        compressed = pub.public_bytes(
+            serialization.Encoding.X962, serialization.PublicFormat.CompressedPoint
+        )
+        return PublicKey(compressed)
+
+    def sign(self, message: bytes) -> bytes:
+        """64-byte (r || s) low-S signature over sha256(message)."""
+        der = self._key().sign(_sha(message), ec.ECDSA(Prehashed(hashes.SHA256())))
+        r, s = decode_dss_signature(der)
+        if s > _N // 2:
+            s = _N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+def address_str(addr: bytes) -> str:
+    return "tia1" + addr.hex()

@@ -1,0 +1,318 @@
+"""State-machine modules: auth, bank, blob, mint, signal, minfee, staking.
+
+Reference parity (SURVEY.md §2.1): x/blob (keeper/keeper.go:43-57, gas model
+payforblob.go:158-179), x/mint time-based inflation (types/constants.go:17-25,
+types/minter.go:56-66, abci.go:14-60), x/signal rolling upgrades
+(keeper.go:18,26-36,65-116), x/minfee network floor price (params.go:16-27),
+plus the SDK auth/bank/staking subset the reference wires through its
+versioned module manager. Records are stored as canonical JSON under
+per-module key prefixes (deterministic: sorted keys, no whitespace).
+"""
+
+from __future__ import annotations
+
+import json
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.chain.state import Context
+from celestia_app_tpu.da import shares as shares_mod
+
+
+def _put(ctx: Context, key: bytes, obj) -> None:
+    ctx.store.set(key, json.dumps(obj, sort_keys=True, separators=(",", ":")).encode())
+
+
+def _get(ctx: Context, key: bytes):
+    raw = ctx.store.get(key)
+    return None if raw is None else json.loads(raw)
+
+
+# ---------------------------------------------------------------------------
+# auth: accounts with numbers and sequences
+# ---------------------------------------------------------------------------
+
+
+class AuthKeeper:
+    PREFIX = b"auth/acc/"
+    COUNTER = b"auth/next_account_number"
+
+    def account(self, ctx: Context, addr: bytes):
+        return _get(ctx, self.PREFIX + addr)
+
+    def ensure_account(self, ctx: Context, addr: bytes):
+        acc = self.account(ctx, addr)
+        if acc is None:
+            num = _get(ctx, self.COUNTER) or 0
+            _put(ctx, self.COUNTER, num + 1)
+            acc = {"number": num, "sequence": 0, "pubkey": None}
+            _put(ctx, self.PREFIX + addr, acc)
+        return acc
+
+    def set_pubkey(self, ctx: Context, addr: bytes, pubkey: bytes) -> None:
+        acc = self.ensure_account(ctx, addr)
+        if acc["pubkey"] is None:
+            acc["pubkey"] = pubkey.hex()
+            _put(ctx, self.PREFIX + addr, acc)
+
+    def increment_sequence(self, ctx: Context, addr: bytes) -> None:
+        acc = self.ensure_account(ctx, addr)
+        acc["sequence"] += 1
+        _put(ctx, self.PREFIX + addr, acc)
+
+
+# ---------------------------------------------------------------------------
+# bank: balances in utia
+# ---------------------------------------------------------------------------
+
+
+class BankKeeper:
+    PREFIX = b"bank/bal/"
+    SUPPLY = b"bank/supply"
+
+    def balance(self, ctx: Context, addr: bytes) -> int:
+        return _get(ctx, self.PREFIX + addr) or 0
+
+    def set_balance(self, ctx: Context, addr: bytes, amount: int) -> None:
+        _put(ctx, self.PREFIX + addr, amount)
+
+    def send(self, ctx: Context, from_addr: bytes, to_addr: bytes, amount: int) -> None:
+        if amount < 0:
+            raise ValueError("negative send amount")
+        bal = self.balance(ctx, from_addr)
+        if bal < amount:
+            raise ValueError(f"insufficient funds: {bal} < {amount}")
+        self.set_balance(ctx, from_addr, bal - amount)
+        self.set_balance(ctx, to_addr, self.balance(ctx, to_addr) + amount)
+
+    def mint(self, ctx: Context, to_addr: bytes, amount: int) -> None:
+        self.set_balance(ctx, to_addr, self.balance(ctx, to_addr) + amount)
+        _put(ctx, self.SUPPLY, (self.supply(ctx)) + amount)
+
+    def burn(self, ctx: Context, from_addr: bytes, amount: int) -> None:
+        bal = self.balance(ctx, from_addr)
+        if bal < amount:
+            raise ValueError("insufficient funds to burn")
+        self.set_balance(ctx, from_addr, bal - amount)
+        _put(ctx, self.SUPPLY, self.supply(ctx) - amount)
+
+    def supply(self, ctx: Context) -> int:
+        return _get(ctx, self.SUPPLY) or 0
+
+
+FEE_COLLECTOR = b"\x00" * 19 + b"\x01"  # module account for fees + inflation
+
+
+# ---------------------------------------------------------------------------
+# blob: the PayForBlobs module
+# ---------------------------------------------------------------------------
+
+
+class BlobKeeper:
+    """x/blob: burns gas proportional to blob shares; blobs never touch state
+    (keeper/keeper.go:43-57)."""
+
+    PARAMS = b"blob/params"
+
+    def params(self, ctx: Context) -> dict:
+        return _get(ctx, self.PARAMS) or {
+            "gas_per_blob_byte": appconsts.DEFAULT_GAS_PER_BLOB_BYTE,
+            "gov_max_square_size": appconsts.DEFAULT_GOV_MAX_SQUARE_SIZE,
+        }
+
+    def set_params(self, ctx: Context, params: dict) -> None:
+        _put(ctx, self.PARAMS, params)
+
+    @staticmethod
+    def gas_to_consume(blob_sizes, gas_per_blob_byte: int) -> int:
+        """payforblob.go:158-165: shares x 512 x gasPerBlobByte."""
+        total_shares = sum(shares_mod.sparse_shares_needed(s) for s in blob_sizes)
+        return total_shares * appconsts.SHARE_SIZE * gas_per_blob_byte
+
+    def pay_for_blobs(self, ctx: Context, msg) -> None:
+        gas = self.gas_to_consume(
+            msg.blob_sizes, self.params(ctx)["gas_per_blob_byte"]
+        )
+        ctx.gas_meter.consume(gas, "pay for blobs")
+        ctx.emit_event(
+            "celestia.blob.v1.EventPayForBlobs",
+            signer=msg.signer.hex(),
+            blob_sizes=list(msg.blob_sizes),
+            namespaces=[n.hex() for n in msg.namespaces],
+        )
+
+
+def estimate_pfb_gas(blob_sizes, gas_per_blob_byte: int = appconsts.DEFAULT_GAS_PER_BLOB_BYTE) -> int:
+    """Client-side linear gas model (payforblob.go:171-179)."""
+    shares_gas = BlobKeeper.gas_to_consume(blob_sizes, gas_per_blob_byte)
+    return (
+        shares_gas
+        + appconsts.BYTES_PER_BLOB_INFO * len(blob_sizes) * appconsts.versioned(2).tx_size_cost_per_byte
+        + appconsts.PFB_GAS_FIXED_COST
+    )
+
+
+# ---------------------------------------------------------------------------
+# mint: time-based inflation (x/mint)
+# ---------------------------------------------------------------------------
+
+INITIAL_INFLATION = 0.08
+DISINFLATION_RATE = 0.1  # inflation shrinks 10% per year
+TARGET_INFLATION = 0.015
+SECONDS_PER_YEAR = 365.2425 * 24 * 3600  # matching constants.go DaysPerYear=365.2425
+
+
+class MintKeeper:
+    STATE = b"mint/minter"
+
+    def minter(self, ctx: Context) -> dict:
+        return _get(ctx, self.STATE) or {
+            "inflation": INITIAL_INFLATION,
+            "genesis_time": None,
+            "previous_block_time": None,
+            "annual_provisions": 0.0,
+            "bond_denom": appconsts.BOND_DENOM,
+        }
+
+    def set_minter(self, ctx: Context, m: dict) -> None:
+        _put(ctx, self.STATE, m)
+
+    @staticmethod
+    def inflation_rate(years_since_genesis: float) -> float:
+        """constants.go:17-25: 8% x 0.9^floor(years), floored at 1.5%."""
+        rate = INITIAL_INFLATION * (1 - DISINFLATION_RATE) ** int(max(0.0, years_since_genesis))
+        return max(rate, TARGET_INFLATION)
+
+    def begin_blocker(self, ctx: Context, bank: BankKeeper) -> int:
+        """Mint block provision ∝ wall-clock since last block (minter.go:56-66)."""
+        m = self.minter(ctx)
+        if m["genesis_time"] is None:
+            m["genesis_time"] = ctx.time_unix
+            m["previous_block_time"] = ctx.time_unix
+            m["annual_provisions"] = m["inflation"] * bank.supply(ctx)
+            self.set_minter(ctx, m)
+            return 0
+        years = (ctx.time_unix - m["genesis_time"]) / SECONDS_PER_YEAR
+        m["inflation"] = self.inflation_rate(years)
+        m["annual_provisions"] = m["inflation"] * bank.supply(ctx)
+        elapsed = max(0.0, ctx.time_unix - (m["previous_block_time"] or ctx.time_unix))
+        provision = int(m["annual_provisions"] * (elapsed / SECONDS_PER_YEAR))
+        if provision > 0:
+            bank.mint(ctx, FEE_COLLECTOR, provision)
+            ctx.emit_event("mint", amount=provision, inflation=m["inflation"])
+        m["previous_block_time"] = ctx.time_unix
+        self.set_minter(ctx, m)
+        return provision
+
+
+# ---------------------------------------------------------------------------
+# staking (minimal): validator powers, for signal tallying & blobstream
+# ---------------------------------------------------------------------------
+
+
+class StakingKeeper:
+    PREFIX = b"staking/val/"
+
+    def set_validator(self, ctx: Context, operator: bytes, power: int) -> None:
+        _put(ctx, self.PREFIX + operator, {"power": power})
+
+    def validator_power(self, ctx: Context, operator: bytes) -> int:
+        v = _get(ctx, self.PREFIX + operator)
+        return 0 if v is None else v["power"]
+
+    def total_power(self, ctx: Context) -> int:
+        return sum(
+            json.loads(v)["power"] for _, v in ctx.store.iterate_prefix(self.PREFIX)
+        )
+
+    def validators(self, ctx: Context) -> list[tuple[bytes, int]]:
+        out = []
+        for k, v in ctx.store.iterate_prefix(self.PREFIX):
+            out.append((k[len(self.PREFIX) :], json.loads(v)["power"]))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# signal: rolling upgrade coordination (x/signal)
+# ---------------------------------------------------------------------------
+
+UPGRADE_THRESHOLD_NUM = 5
+UPGRADE_THRESHOLD_DEN = 6
+
+
+class SignalKeeper:
+    PREFIX = b"signal/sig/"
+    UPGRADE = b"signal/pending_upgrade"
+
+    def __init__(self, staking: StakingKeeper):
+        self.staking = staking
+
+    def signal_version(self, ctx: Context, validator: bytes, version: int) -> None:
+        if self.staking.validator_power(ctx, validator) == 0:
+            raise ValueError("signal from unknown validator")
+        if version <= ctx.app_version:
+            raise ValueError(
+                f"cannot signal version {version} <= current {ctx.app_version}"
+            )
+        if version > appconsts.LATEST_VERSION:
+            raise ValueError(f"unsupported version {version}")
+        _put(ctx, self.PREFIX + validator, {"version": version})
+
+    def tally(self, ctx: Context, version: int) -> tuple[int, int]:
+        voting = 0
+        for k, v in ctx.store.iterate_prefix(self.PREFIX):
+            if json.loads(v)["version"] == version:
+                voting += self.staking.validator_power(ctx, k[len(self.PREFIX) :])
+        return voting, self.staking.total_power(ctx)
+
+    def try_upgrade(self, ctx: Context) -> bool:
+        """keeper.go:96-116: >= 5/6 power on some version schedules it
+        DEFAULT_UPGRADE_HEIGHT_DELAY blocks out."""
+        if _get(ctx, self.UPGRADE) is not None:
+            raise ValueError("upgrade already pending")
+        for version in range(ctx.app_version + 1, appconsts.LATEST_VERSION + 1):
+            power, total = self.tally(ctx, version)
+            if total > 0 and power * UPGRADE_THRESHOLD_DEN >= total * UPGRADE_THRESHOLD_NUM:
+                _put(
+                    ctx,
+                    self.UPGRADE,
+                    {
+                        "version": version,
+                        "height": ctx.height + appconsts.DEFAULT_UPGRADE_HEIGHT_DELAY,
+                    },
+                )
+                ctx.emit_event("signal.upgrade_scheduled", version=version)
+                return True
+        return False
+
+    def pending_upgrade(self, ctx: Context):
+        return _get(ctx, self.UPGRADE)
+
+    def should_upgrade(self, ctx: Context) -> int | None:
+        """EndBlocker check (app/app.go:472-478): version to flip to, if due."""
+        up = _get(ctx, self.UPGRADE)
+        if up is not None and ctx.height >= up["height"]:
+            return up["version"]
+        return None
+
+    def clear_upgrade(self, ctx: Context) -> None:
+        ctx.store.delete(self.UPGRADE)
+        for k, _ in list(ctx.store.iterate_prefix(self.PREFIX)):
+            ctx.store.delete(k)
+
+
+# ---------------------------------------------------------------------------
+# minfee: network-wide minimum gas price (v2+)
+# ---------------------------------------------------------------------------
+
+
+class MinFeeKeeper:
+    KEY = b"minfee/network_min_gas_price"
+
+    def network_min_gas_price(self, ctx: Context) -> float:
+        v = _get(ctx, self.KEY)
+        if v is not None:
+            return v
+        return appconsts.DEFAULT_NETWORK_MIN_GAS_PRICE
+
+    def set_network_min_gas_price(self, ctx: Context, price: float) -> None:
+        _put(ctx, self.KEY, price)

@@ -1,0 +1,98 @@
+"""Single-process node: mempool + block production loop around the App.
+
+Reference parity: test/util/testnode (an in-process chain producing blocks
+against a real app via the local ABCI client, full_node.go:20-49) plus the
+mempool behavior celestia tunes in app/default_overrides.go:258-284 (priority
+mempool with per-tx TTL of 5 blocks, gas-price priority ordering).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time as time_mod
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.chain.app import App
+from celestia_app_tpu.chain.block import Block, TxResult
+from celestia_app_tpu.chain.tx import Tx
+from celestia_app_tpu.da import blob as blob_mod
+
+
+@dataclasses.dataclass
+class MempoolTx:
+    raw: bytes
+    gas_price: float
+    height_added: int
+
+
+class Node:
+    def __init__(self, app: App, mempool_ttl: int = appconsts.MEMPOOL_TX_TTL_BLOCKS):
+        self.app = app
+        self.mempool: list[MempoolTx] = []
+        self.mempool_ttl = mempool_ttl
+        self.committed: dict[bytes, tuple[int, TxResult]] = {}  # tx hash -> (height, result)
+        self.blocks: list[Block] = []
+
+    # -- mempool -------------------------------------------------------
+
+    def broadcast_tx(self, raw: bytes) -> TxResult:
+        """BroadcastMode_SYNC: run CheckTx, admit to the mempool on success."""
+        if len(raw) > appconsts.MEMPOOL_MAX_TX_BYTES:
+            return TxResult(1, "tx exceeds mempool max bytes", 0, 0, [])
+        res = self.app.check_tx(raw)
+        if res.code == 0:
+            inner = blob_mod.unmarshal_blob_tx(raw).tx if blob_mod.is_blob_tx(raw) else raw
+            tx = Tx.decode(inner)
+            self.mempool.append(
+                MempoolTx(
+                    raw=raw,
+                    gas_price=tx.body.fee / tx.body.gas_limit,
+                    height_added=self.app.height,
+                )
+            )
+        return res
+
+    def _reap(self) -> list[bytes]:
+        """Priority order: gas price desc, arrival order as tiebreak."""
+        self.mempool = [
+            m
+            for m in self.mempool
+            if self.app.height - m.height_added < self.mempool_ttl
+        ]
+        ordered = sorted(
+            enumerate(self.mempool), key=lambda im: (-im[1].gas_price, im[0])
+        )
+        return [m.raw for _, m in ordered]
+
+    # -- consensus loop ------------------------------------------------
+
+    def produce_block(self, t: float | None = None) -> tuple[Block, list[TxResult]]:
+        t = t if t is not None else time_mod.time()
+        prop = self.app.prepare_proposal(self._reap(), t=t)
+        if not self.app.process_proposal(prop.block):
+            raise RuntimeError("node rejected its own proposal")
+        results = self.app.finalize_block(prop.block)
+        self.app.commit(prop.block)
+        self.blocks.append(prop.block)
+
+        included = set(prop.block.txs)
+        self.mempool = [m for m in self.mempool if m.raw not in included]
+        import hashlib
+
+        for raw, res in zip(prop.block.txs, results):
+            self.committed[hashlib.sha256(raw).digest()] = (
+                prop.block.header.height,
+                res,
+            )
+        return prop.block, results
+
+    def confirm_tx(self, raw: bytes):
+        """ConfirmTx: drive blocks until the tx commits (tx_client.go:412)."""
+        import hashlib
+
+        h = hashlib.sha256(raw).digest()
+        for _ in range(self.mempool_ttl + 1):
+            if h in self.committed:
+                return self.committed[h]
+            self.produce_block()
+        raise TimeoutError("tx not committed within TTL")

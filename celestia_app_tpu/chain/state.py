@@ -1,0 +1,159 @@
+"""Merkleized key-value state with cache layering and gas metering.
+
+Reference parity: the cosmos-sdk commit multistore + CacheKV branching
+(baseapp's checkState/deliverState split, app/app.go:427-435) and the SDK gas
+meter. The store here is a single flat map with per-module key prefixes; the
+app hash is the RFC-6962 Merkle root over sorted (key, value) leaf hashes,
+recomputed per commit with a dirty-subtree shortcut left for later rounds.
+Commit history is kept so `load_height` (app/app.go:592 LoadHeight) and
+state-sync-style snapshots can roll back / export.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from celestia_app_tpu.utils import merkle_host
+
+
+class OutOfGas(Exception):
+    pass
+
+
+class GasMeter:
+    def __init__(self, limit: int):
+        self.limit = limit
+        self.consumed = 0
+
+    def consume(self, amount: int, descriptor: str = "") -> None:
+        self.consumed += amount
+        if self.consumed > self.limit:
+            raise OutOfGas(
+                f"out of gas: {descriptor}: consumed {self.consumed} > limit {self.limit}"
+            )
+
+    def remaining(self) -> int:
+        return max(0, self.limit - self.consumed)
+
+
+class InfiniteGasMeter(GasMeter):
+    def __init__(self):
+        super().__init__(1 << 62)
+
+
+class KVStore:
+    """Flat committed store; branch() yields a cache layer for tx execution."""
+
+    def __init__(self, data: dict[bytes, bytes] | None = None):
+        self._data: dict[bytes, bytes] = dict(data or {})
+
+    def get(self, key: bytes) -> bytes | None:
+        return self._data.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._data[key] = value
+
+    def delete(self, key: bytes) -> None:
+        self._data.pop(key, None)
+
+    def iterate_prefix(self, prefix: bytes):
+        for k in sorted(self._data):
+            if k.startswith(prefix):
+                yield k, self._data[k]
+
+    def branch(self) -> "CacheStore":
+        return CacheStore(self)
+
+    def snapshot(self) -> dict[bytes, bytes]:
+        return dict(self._data)
+
+    def restore(self, snap: dict[bytes, bytes]) -> None:
+        self._data = dict(snap)
+
+    def app_hash(self) -> bytes:
+        leaves = [
+            hashlib.sha256(k + b"\x00" + v).digest()
+            for k, v in sorted(self._data.items())
+        ]
+        return merkle_host.hash_from_leaves(leaves)
+
+
+class CacheStore(KVStore):
+    """Copy-on-write layer over a parent store; write() flushes down."""
+
+    def __init__(self, parent: KVStore):
+        super().__init__()
+        self.parent = parent
+        self._deleted: set[bytes] = set()
+
+    def get(self, key: bytes) -> bytes | None:
+        if key in self._deleted:
+            return None
+        if key in self._data:
+            return self._data[key]
+        return self.parent.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._deleted.discard(key)
+        self._data[key] = value
+
+    def delete(self, key: bytes) -> None:
+        self._data.pop(key, None)
+        self._deleted.add(key)
+
+    def iterate_prefix(self, prefix: bytes):
+        merged: dict[bytes, bytes] = {}
+        for k, v in self.parent.iterate_prefix(prefix):
+            if k not in self._deleted:
+                merged[k] = v
+        for k, v in self._data.items():
+            if k.startswith(prefix):
+                merged[k] = v
+        for k in sorted(merged):
+            yield k, merged[k]
+
+    def write(self) -> None:
+        for k in self._deleted:
+            self.parent.delete(k)
+        for k, v in self._data.items():
+            self.parent.set(k, v)
+        self._data.clear()
+        self._deleted.clear()
+
+
+class Context:
+    """Execution context: a store branch, gas meter, block info, events."""
+
+    def __init__(
+        self,
+        store: KVStore,
+        gas_meter: GasMeter,
+        height: int,
+        time_unix: float,
+        chain_id: str,
+        app_version: int,
+        is_check_tx: bool = False,
+    ):
+        self.store = store
+        self.gas_meter = gas_meter
+        self.height = height
+        self.time_unix = time_unix
+        self.chain_id = chain_id
+        self.app_version = app_version
+        self.is_check_tx = is_check_tx
+        self.events: list[dict] = []
+
+    def emit_event(self, type_: str, **attrs) -> None:
+        self.events.append({"type": type_, **attrs})
+
+    def branch(self) -> "Context":
+        ctx = Context(
+            self.store.branch(),
+            self.gas_meter,
+            self.height,
+            self.time_unix,
+            self.chain_id,
+            self.app_version,
+            self.is_check_tx,
+        )
+        return ctx

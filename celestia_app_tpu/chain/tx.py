@@ -1,0 +1,285 @@
+"""Transaction codec: messages, bodies, signatures.
+
+Reference parity: the cosmos-sdk Tx (body + auth info + signatures) carrying
+sdk.Msgs — here with this framework's deterministic binary encoding (fields
+in fixed order, uvarint length prefixes) instead of protobuf. Message set
+mirrors the modules the reference wires (SURVEY.md §2): bank MsgSend, blob
+MsgPayForBlobs (x/blob/types/payforblob.go:48-77), signal MsgSignalVersion /
+MsgTryUpgrade (x/signal), blobstream MsgRegisterEVMAddress (x/blobstream).
+
+Sign doc = sha256(chain_id || account_number || body_bytes); signatures are
+64-byte secp256k1 (r || s).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+from celestia_app_tpu.chain.crypto import PublicKey
+from celestia_app_tpu.da.namespace import Namespace
+from celestia_app_tpu.da.shares import read_uvarint, uvarint
+
+
+def _b(data: bytes) -> bytes:
+    return uvarint(len(data)) + data
+
+
+def _s(text: str) -> bytes:
+    return _b(text.encode())
+
+
+class _Reader:
+    def __init__(self, raw: bytes, off: int = 0):
+        self.raw = raw
+        self.off = off
+
+    def u(self) -> int:
+        v, self.off = read_uvarint(self.raw, self.off)
+        return v
+
+    def b(self) -> bytes:
+        n = self.u()
+        out = self.raw[self.off : self.off + n]
+        if len(out) != n:
+            raise ValueError("truncated field")
+        self.off += n
+        return out
+
+    def s(self) -> str:
+        return self.b().decode()
+
+    def done(self) -> bool:
+        return self.off == len(self.raw)
+
+
+# ---------------------------------------------------------------------------
+# Messages
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MsgSend:
+    TYPE = "bank/MsgSend"
+    from_addr: bytes
+    to_addr: bytes
+    amount: int  # utia
+
+    def encode(self) -> bytes:
+        return _b(self.from_addr) + _b(self.to_addr) + uvarint(self.amount)
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MsgSend":
+        r = _Reader(raw)
+        return cls(r.b(), r.b(), r.u())
+
+
+@dataclasses.dataclass(frozen=True)
+class MsgPayForBlobs:
+    """x/blob MsgPayForBlobs (payforblob.go:48-77)."""
+
+    TYPE = "blob/MsgPayForBlobs"
+    signer: bytes  # 20-byte address
+    namespaces: tuple[bytes, ...]  # 29-byte raws
+    blob_sizes: tuple[int, ...]
+    share_commitments: tuple[bytes, ...]  # 32-byte
+    share_versions: tuple[int, ...]
+
+    def encode(self) -> bytes:
+        out = bytearray(_b(self.signer))
+        out += uvarint(len(self.namespaces))
+        for ns in self.namespaces:
+            out += ns
+        out += uvarint(len(self.blob_sizes))
+        for s in self.blob_sizes:
+            out += uvarint(s)
+        out += uvarint(len(self.share_commitments))
+        for c in self.share_commitments:
+            out += _b(c)
+        out += uvarint(len(self.share_versions))
+        for v in self.share_versions:
+            out += uvarint(v)
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MsgPayForBlobs":
+        r = _Reader(raw)
+        signer = r.b()
+        ns = []
+        for _ in range(r.u()):
+            ns.append(r.raw[r.off : r.off + 29])
+            if len(ns[-1]) != 29:
+                raise ValueError("truncated namespace")
+            r.off += 29
+        sizes = tuple(r.u() for _ in range(r.u()))
+        commits = tuple(r.b() for _ in range(r.u()))
+        versions = tuple(r.u() for _ in range(r.u()))
+        return cls(signer, tuple(ns), sizes, commits, versions)
+
+    def validate_basic(self) -> None:
+        n = len(self.namespaces)
+        if n == 0:
+            raise ValueError("no blobs in MsgPayForBlobs")
+        if not (len(self.blob_sizes) == len(self.share_commitments) == len(self.share_versions) == n):
+            raise ValueError("MsgPayForBlobs field lengths mismatch")
+        if len(self.signer) != 20:
+            raise ValueError("bad signer address")
+        for ns_raw in self.namespaces:
+            Namespace(ns_raw).validate_for_blob()
+        for c in self.share_commitments:
+            if len(c) != 32:
+                raise ValueError("bad share commitment size")
+
+
+@dataclasses.dataclass(frozen=True)
+class MsgSignalVersion:
+    """x/signal: a validator signals readiness for an app version."""
+
+    TYPE = "signal/MsgSignalVersion"
+    validator: bytes
+    version: int
+
+    def encode(self) -> bytes:
+        return _b(self.validator) + uvarint(self.version)
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MsgSignalVersion":
+        r = _Reader(raw)
+        return cls(r.b(), r.u())
+
+
+@dataclasses.dataclass(frozen=True)
+class MsgTryUpgrade:
+    """x/signal: tally signals; schedule the upgrade if >= 5/6 power."""
+
+    TYPE = "signal/MsgTryUpgrade"
+    signer: bytes
+
+    def encode(self) -> bytes:
+        return _b(self.signer)
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MsgTryUpgrade":
+        return cls(_Reader(raw).b())
+
+
+@dataclasses.dataclass(frozen=True)
+class MsgRegisterEVMAddress:
+    """x/blobstream (v1 only): validator registers its EVM address."""
+
+    TYPE = "blobstream/MsgRegisterEVMAddress"
+    validator: bytes
+    evm_address: bytes  # 20 bytes
+
+    def encode(self) -> bytes:
+        return _b(self.validator) + _b(self.evm_address)
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MsgRegisterEVMAddress":
+        r = _Reader(raw)
+        return cls(r.b(), r.b())
+
+
+MSG_TYPES = {
+    m.TYPE: m
+    for m in (MsgSend, MsgPayForBlobs, MsgSignalVersion, MsgTryUpgrade, MsgRegisterEVMAddress)
+}
+
+
+def decode_msg(type_url: str, payload: bytes):
+    cls = MSG_TYPES.get(type_url)
+    if cls is None:
+        raise ValueError(f"unknown msg type {type_url!r}")
+    return cls.decode(payload)
+
+
+# ---------------------------------------------------------------------------
+# Tx
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TxBody:
+    msgs: tuple  # decoded msg objects
+    chain_id: str
+    account_number: int
+    sequence: int
+    fee: int  # utia
+    gas_limit: int
+    memo: str = ""
+    timeout_height: int = 0
+
+    def encode(self) -> bytes:
+        out = bytearray(uvarint(len(self.msgs)))
+        for m in self.msgs:
+            out += _s(m.TYPE) + _b(m.encode())
+        out += _s(self.chain_id)
+        out += uvarint(self.account_number)
+        out += uvarint(self.sequence)
+        out += uvarint(self.fee)
+        out += uvarint(self.gas_limit)
+        out += _s(self.memo)
+        out += uvarint(self.timeout_height)
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, raw: bytes) -> tuple["TxBody", int]:
+        r = _Reader(raw)
+        msgs = []
+        for _ in range(r.u()):
+            t = r.s()
+            msgs.append(decode_msg(t, r.b()))
+        body = cls(
+            msgs=tuple(msgs),
+            chain_id=r.s(),
+            account_number=r.u(),
+            sequence=r.u(),
+            fee=r.u(),
+            gas_limit=r.u(),
+            memo=r.s(),
+            timeout_height=r.u(),
+        )
+        return body, r.off
+
+
+@dataclasses.dataclass(frozen=True)
+class Tx:
+    body: TxBody
+    pubkey: bytes  # 33-byte compressed secp256k1
+    signature: bytes  # 64-byte r||s
+
+    def encode(self) -> bytes:
+        return _b(self.body.encode()) + _b(self.pubkey) + _b(self.signature)
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "Tx":
+        r = _Reader(raw)
+        body_raw = r.b()
+        body, used = TxBody.decode(body_raw)
+        if used != len(body_raw):
+            raise ValueError("trailing bytes in tx body")
+        tx = cls(body=body, pubkey=r.b(), signature=r.b())
+        if not r.done():
+            raise ValueError("trailing bytes in tx")
+        return tx
+
+    def hash(self) -> bytes:
+        return hashlib.sha256(self.encode()).digest()
+
+    def sign_doc(self) -> bytes:
+        return sign_doc(self.body)
+
+    def verify_signature(self) -> bool:
+        return PublicKey(self.pubkey).verify(self.signature, self.sign_doc())
+
+
+def sign_doc(body: TxBody) -> bytes:
+    return (
+        _s(body.chain_id) + uvarint(body.account_number) + _b(body.encode())
+    )
+
+
+def sign_tx(body: TxBody, priv) -> Tx:
+    """Sign a body with a chain.crypto.PrivateKey."""
+    sig = priv.sign(sign_doc(body))
+    return Tx(body=body, pubkey=priv.public_key().compressed, signature=sig)

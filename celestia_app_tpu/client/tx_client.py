@@ -1,0 +1,137 @@
+"""Programmatic tx submission: Signer + TxClient.
+
+Reference parity: pkg/user — `Signer` (multi-account sequence tracking,
+signer.go:23-35), `TxClient` (gas estimation, fee calc, broadcast, ConfirmTx,
+sequence-mismatch resubmission, tx_client.go:87-104,202-250,320-420). The
+transport here is in-process against a Node (gRPC arrives with the service
+layer); the resubmission loop mirrors app/errors/nonce_mismatch.go by parsing
+the expected sequence out of the ante error string.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.chain import modules
+from celestia_app_tpu.chain.crypto import PrivateKey
+from celestia_app_tpu.chain.tx import MsgPayForBlobs, MsgSend, Tx, TxBody, sign_tx
+from celestia_app_tpu.da import blob as blob_mod
+from celestia_app_tpu.da.blob import Blob
+from celestia_app_tpu.da import commitment as commitment_mod
+
+_SEQ_RE = re.compile(r"expected (\d+), got (\d+)")
+
+
+def parse_expected_sequence(err: str) -> int | None:
+    """app/errors/nonce_mismatch.go:13-30 equivalent."""
+    m = _SEQ_RE.search(err)
+    return int(m.group(1)) if m else None
+
+
+@dataclasses.dataclass
+class Account:
+    priv: PrivateKey
+    number: int
+    sequence: int
+
+    @property
+    def address(self) -> bytes:
+        return self.priv.public_key().address()
+
+
+class Signer:
+    """Tracks account numbers/sequences and signs tx bodies (pkg/user Signer)."""
+
+    def __init__(self, chain_id: str):
+        self.chain_id = chain_id
+        self.accounts: dict[bytes, Account] = {}
+
+    def add_account(self, priv: PrivateKey, number: int, sequence: int = 0) -> bytes:
+        acc = Account(priv, number, sequence)
+        self.accounts[acc.address] = acc
+        return acc.address
+
+    def create_tx(self, addr: bytes, msgs, fee: int, gas_limit: int, memo: str = "") -> Tx:
+        acc = self.accounts[addr]
+        body = TxBody(
+            msgs=tuple(msgs),
+            chain_id=self.chain_id,
+            account_number=acc.number,
+            sequence=acc.sequence,
+            fee=fee,
+            gas_limit=gas_limit,
+            memo=memo,
+        )
+        return sign_tx(body, acc.priv)
+
+    def create_pay_for_blobs(
+        self, addr: bytes, blobs: list[Blob], fee: int, gas_limit: int,
+        subtree_root_threshold: int = 64,
+    ) -> bytes:
+        """Build MsgPayForBlobs + sign + wrap in a BlobTx envelope
+        (x/blob/types/payforblob.go:48-77 + blob.MarshalBlobTx)."""
+        msg = MsgPayForBlobs(
+            signer=addr,
+            namespaces=tuple(b.namespace.raw for b in blobs),
+            blob_sizes=tuple(len(b.data) for b in blobs),
+            share_commitments=tuple(
+                commitment_mod.create_commitment(b, subtree_root_threshold) for b in blobs
+            ),
+            share_versions=tuple(b.share_version for b in blobs),
+        )
+        tx = self.create_tx(addr, [msg], fee, gas_limit)
+        return blob_mod.marshal_blob_tx(tx.encode(), blobs)
+
+
+class TxClient:
+    """High-level submission against an in-process node."""
+
+    def __init__(self, node, signer: Signer, gas_multiplier: float = 1.1):
+        self.node = node
+        self.signer = signer
+        self.gas_multiplier = gas_multiplier
+
+    def _gas_price(self) -> float:
+        return max(
+            appconsts.DEFAULT_MIN_GAS_PRICE,
+            appconsts.DEFAULT_NETWORK_MIN_GAS_PRICE,
+        )
+
+    def submit_pay_for_blob(self, addr: bytes, blobs: list[Blob]):
+        """Estimate gas, sign, broadcast, confirm; resubmit once on a
+        sequence mismatch (tx_client.go:357 + nonce parsing)."""
+        gas = int(
+            modules.estimate_pfb_gas([len(b.data) for b in blobs]) * self.gas_multiplier
+        )
+        fee = max(1, int(gas * self._gas_price()) + 1)
+
+        for _attempt in range(2):
+            raw = self.signer.create_pay_for_blobs(addr, blobs, fee=fee, gas_limit=gas)
+            res = self.node.broadcast_tx(raw)
+            if res.code == 0:
+                self.signer.accounts[addr].sequence += 1
+                return self.node.confirm_tx(raw)
+            expected = parse_expected_sequence(res.log)
+            if expected is None:
+                raise RuntimeError(f"broadcast failed: {res.log}")
+            self.signer.accounts[addr].sequence = expected
+        raise RuntimeError("sequence resubmission failed")
+
+    def submit_send(self, addr: bytes, to: bytes, amount: int):
+        gas = 100_000
+        fee = max(1, int(gas * self._gas_price()) + 1)
+        for _attempt in range(2):
+            tx = self.signer.create_tx(
+                addr, [MsgSend(addr, to, amount)], fee=fee, gas_limit=gas
+            )
+            res = self.node.broadcast_tx(tx.encode())
+            if res.code == 0:
+                self.signer.accounts[addr].sequence += 1
+                return self.node.confirm_tx(tx.encode())
+            expected = parse_expected_sequence(res.log)
+            if expected is None:
+                raise RuntimeError(f"broadcast failed: {res.log}")
+            self.signer.accounts[addr].sequence = expected
+        raise RuntimeError("sequence resubmission failed")

@@ -1,0 +1,315 @@
+"""App lifecycle: check/prepare/process/deliver/commit, upgrades, malicious
+proposals. Mirrors the reference's app/test suite strategy (SURVEY.md §4.2,5)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.chain.app import App
+from celestia_app_tpu.chain.block import Block, Header
+from celestia_app_tpu.chain.crypto import PrivateKey
+from celestia_app_tpu.chain.node import Node
+from celestia_app_tpu.chain.tx import MsgSend, MsgSignalVersion, MsgTryUpgrade
+from celestia_app_tpu.client.tx_client import Signer, TxClient
+from celestia_app_tpu.da.blob import Blob
+from celestia_app_tpu.da.namespace import Namespace
+
+CHAIN = "test-tpu-1"
+
+
+def make_app(n_accounts=3, **kw):
+    app = App(chain_id=CHAIN, engine="host", **kw)
+    privs = [PrivateKey.from_seed(bytes([i])) for i in range(n_accounts)]
+    genesis = {
+        "time_unix": 1_700_000_000.0,
+        "accounts": [
+            {"address": p.public_key().address().hex(), "balance": 10**12}
+            for p in privs
+        ],
+        "validators": [
+            {"operator": p.public_key().address().hex(), "power": 10}
+            for p in privs
+        ],
+    }
+    app.init_chain(genesis)
+    signer = Signer(CHAIN)
+    for i, p in enumerate(privs):
+        signer.add_account(p, number=i)
+    return app, signer, privs
+
+
+def _blob(rng, tag: bytes, size: int) -> Blob:
+    return Blob(Namespace.v0(tag), rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+
+
+def test_empty_block_lifecycle():
+    app, signer, _ = make_app()
+    block, results = app.produce_block([], t=1_700_000_100.0)
+    assert block.header.square_size == 1
+    assert results == []
+    assert app.height == 1
+    # data root of the empty block == min DAH hash pinned from the reference
+    assert block.header.data_hash == bytes.fromhex(
+        "3d96b7d238e7e0456f6af8e7cdf0a67bd6cf9c2089ecb559c659dcaa1f880353"
+    )
+
+
+def test_send_tx_end_to_end():
+    app, signer, privs = make_app()
+    node = Node(app)
+    client = TxClient(node, signer)
+    a = privs[0].public_key().address()
+    b = privs[1].public_key().address()
+    height, res = client.submit_send(a, b, 12345)
+    assert res.code == 0, res.log
+    from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+
+    ctx = Context(app.store, InfiniteGasMeter(), app.height, 0, CHAIN, 1)
+    assert app.bank.balance(ctx, b) == 10**12 + 12345
+
+
+def test_pfb_end_to_end():
+    rng = np.random.default_rng(0)
+    app, signer, privs = make_app()
+    node = Node(app)
+    client = TxClient(node, signer)
+    addr = privs[0].public_key().address()
+    blobs = [_blob(rng, b"app", 2000), _blob(rng, b"app", 50)]
+    height, res = client.submit_pay_for_blob(addr, blobs)
+    assert res.code == 0, res.log
+    assert res.gas_used > 0
+    assert any(e["type"].endswith("EventPayForBlobs") for e in res.events)
+    # block carries the square with the blob recoverable
+    block = node.blocks[-1]
+    assert block.header.square_size >= 2
+
+
+def test_checktx_rejects_bad_commitment():
+    rng = np.random.default_rng(1)
+    app, signer, privs = make_app()
+    addr = privs[0].public_key().address()
+    raw = signer.create_pay_for_blobs(addr, [_blob(rng, b"xx", 100)], fee=10**7, gas_limit=10**6)
+    # corrupt one byte of the blob payload inside the envelope
+    bad = bytearray(raw)
+    bad[-1] ^= 0xFF
+    res = app.check_tx(bytes(bad))
+    assert res.code != 0
+    assert "commitment" in res.log or "truncated" in res.log
+
+
+def test_checktx_rejects_wrong_sequence():
+    app, signer, privs = make_app()
+    addr = privs[0].public_key().address()
+    signer.accounts[addr].sequence = 5  # wrong; chain expects 0
+    tx = signer.create_tx(addr, [MsgSend(addr, b"\x09" * 20, 1)], fee=10**6, gas_limit=10**5)
+    res = app.check_tx(tx.encode())
+    assert res.code != 0
+    assert "sequence" in res.log
+    from celestia_app_tpu.client.tx_client import parse_expected_sequence
+
+    assert parse_expected_sequence(res.log) == 0
+
+
+def test_checktx_rejects_low_fee():
+    app, signer, privs = make_app()
+    addr = privs[0].public_key().address()
+    tx = signer.create_tx(addr, [MsgSend(addr, b"\x09" * 20, 1)], fee=1, gas_limit=10**6)
+    res = app.check_tx(tx.encode())
+    assert res.code != 0
+    assert "gas price" in res.log
+
+
+def test_process_rejects_tampered_data_root():
+    app, signer, privs = make_app()
+    prop = app.prepare_proposal([], t=1_700_000_050.0)
+    h = prop.block.header
+    bad_header = dataclasses.replace(h, data_hash=b"\x00" * 32)
+    assert not app.process_proposal(Block(header=bad_header, txs=prop.block.txs))
+    # untampered still accepted
+    assert app.process_proposal(prop.block)
+
+
+def test_process_rejects_wrong_square_size():
+    app, signer, privs = make_app()
+    prop = app.prepare_proposal([], t=1.0)
+    bad = dataclasses.replace(prop.block.header, square_size=4)
+    assert not app.process_proposal(Block(header=bad, txs=prop.block.txs))
+
+
+def test_process_rejects_tx_ordering_violation():
+    """Blob txs must come after all normal txs (block validity rule)."""
+    rng = np.random.default_rng(2)
+    app, signer, privs = make_app()
+    addr = privs[0].public_key().address()
+    send = signer.create_tx(addr, [MsgSend(addr, b"\x08" * 20, 5)], fee=10**6, gas_limit=10**5).encode()
+    signer.accounts[addr].sequence = 1
+    pfb = signer.create_pay_for_blobs(addr, [_blob(rng, b"oo", 400)], fee=10**7, gas_limit=10**7)
+    prop = app.prepare_proposal([send, pfb], t=2.0)
+    assert app.process_proposal(prop.block)
+    # swap order: blob before normal
+    swapped = Block(header=prop.block.header, txs=tuple(reversed(prop.block.txs)))
+    assert not app.process_proposal(swapped)
+
+
+def test_failed_tx_charges_fee_and_bumps_sequence():
+    app, signer, privs = make_app()
+    a = privs[0].public_key().address()
+    # sending more than the balance fails at delivery but fee is still taken
+    tx = signer.create_tx(a, [MsgSend(a, b"\x07" * 20, 10**18)], fee=10**6, gas_limit=10**5)
+    block, results = app.produce_block([tx.encode()], t=3.0)
+    # tx passed checkless prepare filtering (ante ok), failed in delivery
+    assert len(results) == 1 and results[0].code != 0
+    from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+
+    ctx = Context(app.store, InfiniteGasMeter(), app.height, 0, CHAIN, 1)
+    acc = app.auth.account(ctx, a)
+    assert acc["sequence"] == 1  # bumped despite failure
+    assert app.bank.balance(ctx, a) == 10**12 - 10**6  # fee gone, send refunded
+
+
+def test_v2_upgrade_at_height():
+    app, signer, privs = make_app(v2_upgrade_height=2)
+    app.produce_block([], t=10.0)
+    assert app.app_version == 1
+    app.produce_block([], t=20.0)
+    assert app.app_version == 2  # flipped at the configured height
+    from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+
+    ctx = Context(app.store, InfiniteGasMeter(), app.height, 0, CHAIN, 2)
+    assert app.minfee.network_min_gas_price(ctx) > 0
+
+
+def test_signal_upgrade_path():
+    import celestia_app_tpu.appconsts as appconsts
+
+    app, signer, privs = make_app(app_version=2)
+    node = Node(app)
+    # all three validators (equal power) signal v3, then TryUpgrade
+    for i, p in enumerate(privs):
+        addr = p.public_key().address()
+        tx = signer.create_tx(addr, [MsgSignalVersion(addr, 3)], fee=10**6, gas_limit=10**5)
+        res = node.broadcast_tx(tx.encode())
+        assert res.code == 0, res.log
+        signer.accounts[addr].sequence += 1
+    node.produce_block(t=100.0)
+    addr = privs[0].public_key().address()
+    tx = signer.create_tx(addr, [MsgTryUpgrade(addr)], fee=10**6, gas_limit=10**5)
+    assert node.broadcast_tx(tx.encode()).code == 0
+    signer.accounts[addr].sequence += 1
+    node.produce_block(t=101.0)
+    # upgrade scheduled DEFAULT_UPGRADE_HEIGHT_DELAY out; fast-forward
+    from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+
+    ctx = Context(app.store, InfiniteGasMeter(), app.height, 0, CHAIN, 2)
+    pending = app.signal.pending_upgrade(ctx)
+    assert pending == {
+        "version": 3,
+        "height": 2 + appconsts.DEFAULT_UPGRADE_HEIGHT_DELAY,
+    }
+
+
+def test_mint_inflation_schedule():
+    from celestia_app_tpu.chain.modules import MintKeeper
+
+    assert MintKeeper.inflation_rate(0.0) == pytest.approx(0.08)
+    assert MintKeeper.inflation_rate(1.5) == pytest.approx(0.08 * 0.9)
+    assert MintKeeper.inflation_rate(10.0) == pytest.approx(0.08 * 0.9**10)
+    assert MintKeeper.inflation_rate(40.0) == pytest.approx(0.015)  # floor
+
+
+def test_mint_provision_proportional_to_time():
+    app, signer, privs = make_app()
+    from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+
+    app.produce_block([], t=1_700_000_000.0)  # initializes minter
+    supply0 = 3 * 10**12
+    app.produce_block([], t=1_700_000_000.0 + 15.0)  # 15s later
+    ctx = Context(app.store, InfiniteGasMeter(), app.height, 0, CHAIN, 1)
+    from celestia_app_tpu.chain.modules import FEE_COLLECTOR, SECONDS_PER_YEAR
+
+    minted = app.bank.balance(ctx, FEE_COLLECTOR)
+    expected = int(0.08 * supply0 * (15.0 / SECONDS_PER_YEAR))
+    assert abs(minted - expected) <= 1
+
+
+def test_load_height_rollback():
+    app, signer, privs = make_app()
+    app.produce_block([], t=1.0)
+    h1_hash = app.last_app_hash
+    app.produce_block([], t=2.0)
+    app.load_height(1)
+    assert app.height == 1
+    assert app.last_app_hash == h1_hash
+
+
+def test_same_account_send_then_pfb_consistent():
+    """Send(seq 0) + BlobTx(seq 1) from one account: filter order (normal
+    before blob) matches process replay order -> both admitted, accepted."""
+    rng = np.random.default_rng(11)
+    app, signer, privs = make_app()
+    a = privs[0].public_key().address()
+    send = signer.create_tx(a, [MsgSend(a, b"\x01" * 20, 5)], fee=10**6, gas_limit=10**5).encode()
+    signer.accounts[a].sequence = 1
+    pfb = signer.create_pay_for_blobs(a, [_blob(rng, b"dep", 300)], fee=10**8, gas_limit=10**8)
+    prop = app.prepare_proposal([pfb, send], t=5.0)  # mempool order: pfb first
+    assert len(prop.block.txs) == 2
+    assert app.process_proposal(prop.block), "own proposal must be accepted"
+
+
+def test_same_account_pfb_then_send_drops_dependent():
+    """BlobTx(seq 0) + Send(seq 1): normal txs filter FIRST, so the send's
+    seq-1 fails against committed seq 0 and is dropped — never a liveness
+    halt (the regression the review found)."""
+    rng = np.random.default_rng(12)
+    app, signer, privs = make_app()
+    a = privs[0].public_key().address()
+    pfb = signer.create_pay_for_blobs(a, [_blob(rng, b"dep", 300)], fee=10**8, gas_limit=10**8)
+    signer.accounts[a].sequence = 1
+    send = signer.create_tx(a, [MsgSend(a, b"\x01" * 20, 5)], fee=10**6, gas_limit=10**5).encode()
+    prop = app.prepare_proposal([pfb, send], t=5.0)
+    assert len(prop.block.txs) == 1  # only the pfb
+    assert app.process_proposal(prop.block)
+
+
+def test_process_rejects_forged_blob_tx():
+    """A proposer cannot smuggle an unsigned/unfunded PFB past validators."""
+    rng = np.random.default_rng(13)
+    app, signer, privs = make_app()
+    a = privs[0].public_key().address()
+    good = signer.create_pay_for_blobs(a, [_blob(rng, b"fr", 300)], fee=10**8, gas_limit=10**8)
+    prop = app.prepare_proposal([good], t=6.0)
+    assert app.process_proposal(prop.block)
+    # forge: flip a signature byte inside the enveloped tx
+    from celestia_app_tpu.da import blob as blob_mod
+    from celestia_app_tpu.chain.tx import Tx
+
+    btx = blob_mod.unmarshal_blob_tx(prop.block.txs[0])
+    tx = Tx.decode(btx.tx)
+    bad_sig = bytes([tx.signature[0] ^ 1]) + tx.signature[1:]
+    forged_tx = dataclasses.replace(tx, signature=bad_sig)
+    forged_raw = blob_mod.marshal_blob_tx(forged_tx.encode(), list(btx.blobs))
+    forged_block = Block(header=prop.block.header, txs=(forged_raw,))
+    assert not app.process_proposal(forged_block)
+
+
+def test_load_height_restores_app_version():
+    app, signer, privs = make_app(v2_upgrade_height=2)
+    app.produce_block([], t=1.0)  # h1, v1
+    app.produce_block([], t=2.0)  # h2 -> flips to v2
+    assert app.app_version == 2
+    app.load_height(1)
+    assert app.app_version == 1
+    assert app.height == 1
+
+
+def test_high_s_signature_rejected():
+    from celestia_app_tpu.chain.crypto import PrivateKey, _N
+
+    priv = PrivateKey.from_seed(b"mall")
+    sig = priv.sign(b"msg")
+    r = sig[:32]
+    s = int.from_bytes(sig[32:], "big")
+    high = r + (_N - s).to_bytes(32, "big")
+    assert priv.public_key().verify(sig, b"msg")
+    assert not priv.public_key().verify(high, b"msg")

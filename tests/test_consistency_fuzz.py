@@ -1,0 +1,119 @@
+"""Prepare->Process consistency fuzz.
+
+Reference parity: app/test/fuzz_abci_test.go:27 TestPrepareProposalConsistency
+— "All blocks produced by PrepareProposal should be accepted by
+ProcessProposal", across randomized blob txs (sizes, counts, namespaces),
+plain sends, junk txs, stale sequences, multi-tx bursts per account, and
+square-size limits. The single most important invariant for a
+reimplementation (SURVEY.md §4 takeaway)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.chain.app import App
+from celestia_app_tpu.chain.crypto import PrivateKey
+from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+from celestia_app_tpu.chain.tx import MsgSend, sign_tx
+from celestia_app_tpu.client.tx_client import Signer
+from celestia_app_tpu.da.blob import Blob
+from celestia_app_tpu.da.namespace import Namespace
+
+CHAIN = "fuzz-1"
+N_ACCOUNTS = 8
+
+
+def _setup(gov_max_square_size=None):
+    app = App(chain_id=CHAIN, engine="host")
+    privs = [PrivateKey.from_seed(b"fuzz" + bytes([i])) for i in range(N_ACCOUNTS)]
+    genesis = {
+        "time_unix": 1_700_000_000.0,
+        "accounts": [
+            {"address": p.public_key().address().hex(), "balance": 10**14}
+            for p in privs
+        ],
+        "validators": [],
+    }
+    if gov_max_square_size:
+        genesis["gov_max_square_size"] = gov_max_square_size
+    app.init_chain(genesis)
+    signer = Signer(CHAIN)
+    for i, p in enumerate(privs):
+        signer.add_account(p, number=i)
+    return app, signer, privs
+
+
+def _random_blob(rng) -> Blob:
+    tag = bytes(rng.integers(1, 256, size=int(rng.integers(2, 10)), dtype=np.uint8))
+    size = int(rng.integers(1, 4 * 478))
+    return Blob(Namespace.v0(tag), bytes(rng.integers(0, 256, size, dtype=np.uint8)))
+
+
+def _one_tx(rng, signer, addr) -> tuple[list[bytes], bool]:
+    """Generate one (or two) txs; returns (raws, consumed_sequence)."""
+    choice = int(rng.integers(0, 10))
+    fee_scale = int(rng.integers(1, 5))
+    if choice < 6:
+        blobs = [_random_blob(rng) for _ in range(int(rng.integers(1, 4)))]
+        raw = signer.create_pay_for_blobs(
+            addr, blobs, fee=fee_scale * 10**8, gas_limit=10**8
+        )
+        return [raw], True
+    if choice < 8:
+        to = bytes(rng.integers(0, 256, 20, dtype=np.uint8))
+        tx = signer.create_tx(
+            addr,
+            [MsgSend(addr, to, int(rng.integers(1, 1000)))],
+            fee=fee_scale * 10**5,
+            gas_limit=10**5,
+        )
+        return [tx.encode()], True
+    if choice < 9:
+        return [bytes(rng.integers(0, 256, 40, dtype=np.uint8))], False  # junk
+    # stale-sequence tx (ante-dropped) alongside a valid one
+    tx = signer.create_tx(addr, [MsgSend(addr, addr, 1)], fee=10**5, gas_limit=10**5)
+    stale = dataclasses.replace(tx.body, sequence=tx.body.sequence + 7)
+    stale_raw = sign_tx(stale, signer.accounts[addr].priv).encode()
+    return [stale_raw, tx.encode()], True
+
+
+@pytest.mark.parametrize("gov_max,seed", [(None, 0), (4, 1), (8, 2), (None, 3)])
+def test_prepare_process_consistency(gov_max, seed):
+    rng = np.random.default_rng(seed)
+    app, signer, privs = _setup(gov_max)
+
+    for round_i in range(3):
+        raw_txs = []
+        for p in privs:
+            addr = p.public_key().address()
+            # bursts: several txs per account with consecutive sequences,
+            # mixing blob and normal txs (their filter order interacts)
+            for _ in range(int(rng.integers(1, 4))):
+                raws, consumed = _one_tx(rng, signer, addr)
+                raw_txs.extend(raws)
+                if consumed:
+                    signer.accounts[addr].sequence += 1
+
+        order = rng.permutation(len(raw_txs))
+        shuffled = [raw_txs[i] for i in order]
+
+        prop = app.prepare_proposal(shuffled, t=1_700_000_000.0 + 15 * (round_i + 1))
+        assert app.process_proposal(prop.block), (
+            f"round {round_i}: ProcessProposal rejected PrepareProposal's block "
+            f"(size {prop.block.header.square_size}, {len(prop.block.txs)} txs)"
+        )
+        if gov_max:
+            assert prop.block.header.square_size <= gov_max
+        app.finalize_block(prop.block)
+        app.commit(prop.block)
+
+        # resync signer sequences to committed state (dropped txs desync them)
+        ctx = Context(app.store, InfiniteGasMeter(), app.height, 0, CHAIN, 1)
+        for p in privs:
+            addr = p.public_key().address()
+            acc = app.auth.account(ctx, addr)
+            if acc is not None:
+                signer.accounts[addr].sequence = acc["sequence"]
+
+    assert app.height == 3

@@ -14,6 +14,8 @@ import pytest
 from celestia_app_tpu.da import dah
 from celestia_app_tpu.da.namespace import Namespace
 
+pytestmark = pytest.mark.backend
+
 MIN_DAH_HASH = bytes.fromhex(
     "3d96b7d238e7e0456f6af8e7cdf0a67bd6cf9c2089ecb559c659dcaa1f880353"
 )

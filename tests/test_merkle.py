@@ -8,6 +8,7 @@ from celestia_app_tpu.ops import merkle
 from celestia_app_tpu.utils import merkle_host
 
 
+@pytest.mark.backend
 @pytest.mark.parametrize("n", [1, 2, 4, 8, 16])
 def test_device_matches_host_pow2(n):
     rng = np.random.default_rng(n)

@@ -8,6 +8,8 @@ from celestia_app_tpu.da import namespace as ns_mod
 from celestia_app_tpu.ops import nmt
 from celestia_app_tpu.utils import nmt_host
 
+pytestmark = pytest.mark.backend
+
 
 def _random_sorted_ns(rng, count, with_parity_tail=0):
     ns = []

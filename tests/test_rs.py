@@ -7,6 +7,7 @@ import pytest
 from celestia_app_tpu.ops import rs
 
 
+@pytest.mark.backend
 @pytest.mark.parametrize("k", [1, 2, 4])
 def test_device_matches_numpy(k):
     rng = np.random.default_rng(k)
@@ -52,6 +53,7 @@ def test_repair_needs_half():
         rs.repair_axis(row, list(range(k - 1)))
 
 
+@pytest.mark.backend
 def test_bits_roundtrip():
     rng = np.random.default_rng(3)
     x = jnp.asarray(rng.integers(0, 256, size=(3, 4, 16), dtype=np.uint8))

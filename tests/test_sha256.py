@@ -8,6 +8,8 @@ import pytest
 
 from celestia_app_tpu.ops import sha256
 
+pytestmark = pytest.mark.backend
+
 
 @pytest.mark.parametrize("length", [0, 1, 31, 55, 56, 63, 64, 65, 91, 181, 542])
 def test_matches_hashlib(length):

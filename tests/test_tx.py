@@ -1,0 +1,109 @@
+"""Tx codec, signing, and message validation."""
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.chain.crypto import PrivateKey, PublicKey
+from celestia_app_tpu.chain.tx import (
+    MsgPayForBlobs,
+    MsgSend,
+    MsgSignalVersion,
+    Tx,
+    TxBody,
+    sign_tx,
+)
+
+
+def _body(msgs, seq=0):
+    return TxBody(
+        msgs=tuple(msgs),
+        chain_id="test-1",
+        account_number=3,
+        sequence=seq,
+        fee=1000,
+        gas_limit=100_000,
+        memo="hello",
+    )
+
+
+def test_keys_and_addresses():
+    priv = PrivateKey.from_seed(b"alice")
+    pub = priv.public_key()
+    assert len(pub.compressed) == 33
+    assert len(pub.address()) == 20
+    # deterministic
+    assert PrivateKey.from_seed(b"alice").public_key().address() == pub.address()
+    assert PrivateKey.from_seed(b"bob").public_key().address() != pub.address()
+
+
+def test_sign_verify_roundtrip():
+    priv = PrivateKey.from_seed(b"alice")
+    sig = priv.sign(b"message")
+    assert len(sig) == 64
+    assert priv.public_key().verify(sig, b"message")
+    assert not priv.public_key().verify(sig, b"other")
+    assert not PrivateKey.from_seed(b"bob").public_key().verify(sig, b"message")
+
+
+def test_tx_encode_decode_roundtrip():
+    priv = PrivateKey.from_seed(b"alice")
+    addr = priv.public_key().address()
+    msg = MsgSend(addr, b"\x01" * 20, 500)
+    tx = sign_tx(_body([msg]), priv)
+    raw = tx.encode()
+    back = Tx.decode(raw)
+    assert back == tx
+    assert back.verify_signature()
+
+
+def test_tampered_tx_fails_verification():
+    priv = PrivateKey.from_seed(b"alice")
+    addr = priv.public_key().address()
+    tx = sign_tx(_body([MsgSend(addr, b"\x01" * 20, 500)]), priv)
+    tampered = Tx(
+        body=TxBody(
+            msgs=(MsgSend(addr, b"\x01" * 20, 9999),),
+            chain_id=tx.body.chain_id,
+            account_number=tx.body.account_number,
+            sequence=tx.body.sequence,
+            fee=tx.body.fee,
+            gas_limit=tx.body.gas_limit,
+            memo=tx.body.memo,
+        ),
+        pubkey=tx.pubkey,
+        signature=tx.signature,
+    )
+    assert not tampered.verify_signature()
+
+
+def test_pfb_roundtrip_and_validation():
+    rng = np.random.default_rng(0)
+    msg = MsgPayForBlobs(
+        signer=b"\x02" * 20,
+        namespaces=(b"\x00" + b"\x00" * 18 + rng.integers(0, 256, 10, dtype=np.uint8).tobytes(),),
+        blob_sizes=(100,),
+        share_commitments=(b"\x03" * 32,),
+        share_versions=(0,),
+    )
+    assert MsgPayForBlobs.decode(msg.encode()) == msg
+    msg.validate_basic()
+
+    bad = MsgPayForBlobs(
+        signer=b"\x02" * 20,
+        namespaces=(),
+        blob_sizes=(),
+        share_commitments=(),
+        share_versions=(),
+    )
+    with pytest.raises(ValueError):
+        bad.validate_basic()
+
+
+def test_signal_msg_roundtrip():
+    m = MsgSignalVersion(b"\x04" * 20, 2)
+    assert MsgSignalVersion.decode(m.encode()) == m
+
+
+def test_decode_garbage_fails():
+    with pytest.raises(ValueError):
+        Tx.decode(b"\xff\xfe\xfd")
