@@ -1,0 +1,109 @@
+"""Benchmark: full 128×128 block extend+commit, device vs CPU baseline.
+
+Measures the flagship device program (da/eds.py: 2D GF(256) RS extension +
+4k NMT axis roots + data root — the reference's `da.ExtendShares` +
+`DAH.Hash()` chain, pkg/da/data_availability_header.go:65-108) on the default
+JAX backend, and reports speedup vs the strongest CPU implementation in-tree
+(utils/fast_host: BLAS bit-matmul RS + OpenSSL SHA-256). The reference's own
+Go path cannot run here (no Go toolchain); fast_host is our measured stand-in
+for BASELINE.md config 0, cached in bench_baseline.json.
+
+Prints ONE JSON line:
+  {"metric": "extend_commit_128_ms", "value": <device ms/block>,
+   "unit": "ms", "vs_baseline": <cpu_ms / device_ms>}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+K = 128
+BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bench_baseline.json")
+
+
+def _bench_ods(k: int) -> np.ndarray:
+    rng = np.random.default_rng(0)
+    ods = rng.integers(0, 256, size=(k, k, 512), dtype=np.uint8)
+    ods[..., :29] = 0
+    ods[..., 28] = 7  # one user namespace, sorted layout
+    return ods
+
+
+def measure_baseline() -> float:
+    """CPU fast-host pipeline, ms/block (one untimed warmup, best of 2)."""
+    from celestia_app_tpu.ops import gf256
+    from celestia_app_tpu.utils import fast_host
+
+    ods = _bench_ods(K)
+    gf256.bit_matrix(K)  # warm the cached generator matrix off the clock
+    times = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        eds = fast_host.extend_square_fast(ods)
+        fast_host.axis_roots_fast(eds)
+        times.append(time.perf_counter() - t0)
+    return min(times) * 1000.0
+
+
+def measure_device(reps: int = 10) -> float:
+    import jax
+
+    from celestia_app_tpu.da import eds as eds_mod
+
+    run = eds_mod.jitted_pipeline(K)
+    ods = jax.device_put(_bench_ods(K))
+    jax.block_until_ready(run(ods))  # compile + warm
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(ods))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times)) * 1000.0
+
+
+def main() -> None:
+    if "--measure-baseline" in sys.argv:
+        ms = measure_baseline()
+        with open(BASELINE_FILE, "w") as f:
+            json.dump(
+                {
+                    "metric": "extend_commit_128_ms",
+                    "cpu_ms": ms,
+                    "impl": "utils/fast_host (numpy BLAS bit-matmul RS + "
+                            "hashlib SHA-256)",
+                },
+                f,
+                indent=2,
+            )
+            f.write("\n")
+        print(f"baseline measured: {ms:.1f} ms -> {BASELINE_FILE}",
+              file=sys.stderr)
+        return
+
+    if os.path.exists(BASELINE_FILE):
+        with open(BASELINE_FILE) as f:
+            cpu_ms = json.load(f)["cpu_ms"]
+    else:
+        cpu_ms = measure_baseline()
+
+    device_ms = measure_device()
+    print(
+        json.dumps(
+            {
+                "metric": "extend_commit_128_ms",
+                "value": round(device_ms, 3),
+                "unit": "ms",
+                "vs_baseline": round(cpu_ms / device_ms, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Device-mesh construction for the sharded DA pipeline.
+
+The reference scales a validator with goroutine pools inside one process
+(SURVEY.md §2.4); the TPU build scales over an ICI mesh instead. Two logical
+axes:
+
+- ``data``  — block-level data parallelism: independent squares (blocks /
+  proposal candidates) land on different mesh slices.
+- ``seq``   — the sequence-parallel analog: rows of one square are sharded
+  across devices; the row↔column duality of the 2D RS code becomes an
+  all-to-all transpose over this axis (SURVEY.md §5.7/§5.8).
+
+``make_mesh`` factors the available devices into (data, seq), keeping the
+``seq`` extent a power of two that divides the square size so every shard_map
+block is shape-static.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+
+
+def _largest_pow2_divisor(n: int) -> int:
+    p = 1
+    while n % (2 * p) == 0:
+        p *= 2
+    return p
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    *,
+    k: int | None = None,
+    devices=None,
+) -> Mesh:
+    """Build a (data, seq) mesh over ``n_devices`` (default: all devices).
+
+    ``seq`` gets the largest power-of-two factor that still divides ``k``
+    (when given) so row sharding of a k×k square is exact; the remainder goes
+    to ``data``.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = devices[:n_devices]
+    seq = _largest_pow2_divisor(n_devices)
+    if k is not None:
+        while seq > k:
+            seq //= 2
+    data = n_devices // seq
+    assert data * seq == n_devices  # seq is always a divisor of n_devices
+    grid = np.array(devices).reshape(data, seq)
+    return Mesh(grid, (DATA_AXIS, SEQ_AXIS))

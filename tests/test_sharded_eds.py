@@ -1,0 +1,82 @@
+"""Sharded pipeline == single-device pipeline, bit for bit.
+
+Runs on the virtual 8-device CPU mesh (conftest sets
+xla_force_host_platform_device_count=8). The reference has no multi-device
+mode at all (SURVEY.md §2.4); correctness here means the mesh-sharded
+extension + NMT roots reproduce the exact codewords and roots of the
+single-chip path, which is itself golden-pinned against the Go stack.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from celestia_app_tpu.da import eds as eds_mod
+from celestia_app_tpu.da import namespace as ns_mod
+from celestia_app_tpu.parallel import mesh as mesh_mod
+from celestia_app_tpu.parallel import sharded_eds
+
+
+def _cpu_devices():
+    return jax.devices("cpu")
+
+
+def _random_ods(rng: np.random.Generator, k: int) -> np.ndarray:
+    """A plausible ODS: shares with valid-looking namespace prefixes."""
+    ods = rng.integers(0, 256, size=(k, k, 512), dtype=np.uint8)
+    # Keep namespaces in the user range so parity/reserved semantics differ.
+    ods[:, :, 0] = 0  # namespace version 0
+    ods[:, :, 1:19] = 0  # leading zeros of the 28-byte id
+    return ods
+
+
+@pytest.mark.parametrize("k,batch", [(8, 2), (4, 2)])
+def test_sharded_matches_single_device(k, batch):
+    if len(_cpu_devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = mesh_mod.make_mesh(8, k=k, devices=_cpu_devices())
+    assert mesh.shape[mesh_mod.SEQ_AXIS] >= 2, "test must actually shard rows"
+
+    rng = np.random.default_rng(1234 + k)
+    ods_batch = np.stack([_random_ods(rng, k) for _ in range(batch)])
+
+    run = sharded_eds.jitted_sharded_pipeline(mesh, k)
+    eds_s, row_s, col_s, root_s = jax.tree.map(np.asarray, run(ods_batch))
+
+    single = eds_mod.jitted_pipeline(k)
+    for b in range(batch):
+        with jax.default_device(_cpu_devices()[0]):
+            eds1, row1, col1, root1 = jax.tree.map(np.asarray, single(ods_batch[b]))
+        np.testing.assert_array_equal(eds_s[b], eds1)
+        np.testing.assert_array_equal(row_s[b], row1)
+        np.testing.assert_array_equal(col_s[b], col1)
+        np.testing.assert_array_equal(root_s[b], root1)
+
+
+def test_mesh_factoring():
+    devs = _cpu_devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = mesh_mod.make_mesh(8, k=4, devices=devs)
+    assert mesh.shape[mesh_mod.SEQ_AXIS] <= 4
+    total = mesh.shape[mesh_mod.DATA_AXIS] * mesh.shape[mesh_mod.SEQ_AXIS]
+    assert total == 8
+
+    mesh2 = mesh_mod.make_mesh(8, k=128, devices=devs)
+    assert mesh2.shape[mesh_mod.SEQ_AXIS] == 8
+
+
+def test_parity_namespace_in_sharded_roots():
+    """Q3-only rows must carry the parity namespace range in their roots."""
+    if len(_cpu_devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    k = 8
+    mesh = mesh_mod.make_mesh(8, k=k, devices=_cpu_devices())
+    rng = np.random.default_rng(7)
+    ods = _random_ods(rng, k)[None]
+    run = sharded_eds.jitted_sharded_pipeline(mesh, k)
+    _, row_roots, _, _ = jax.tree.map(np.asarray, run(ods))
+    parity = np.frombuffer(ns_mod.PARITY_NS_RAW, dtype=np.uint8)
+    for r in range(k, 2 * k):  # parity rows: min == max == parity namespace
+        np.testing.assert_array_equal(row_roots[0, r, :29], parity)
+        np.testing.assert_array_equal(row_roots[0, r, 29:58], parity)
