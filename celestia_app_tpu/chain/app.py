@@ -26,6 +26,7 @@ import time as time_mod
 
 from celestia_app_tpu import appconsts
 from celestia_app_tpu.chain import ante as ante_mod
+from celestia_app_tpu.chain import blobstream as blobstream_mod
 from celestia_app_tpu.chain import modules
 from celestia_app_tpu.chain.block import Block, Header, TxResult
 from celestia_app_tpu.chain.blob_validation import BlobTxError, validate_blob_tx
@@ -77,6 +78,8 @@ class App:
         self.staking = modules.StakingKeeper()
         self.signal = modules.SignalKeeper(self.staking)
         self.minfee = modules.MinFeeKeeper()
+        self.blobstream = blobstream_mod.BlobstreamKeeper(self.staking)
+        self.staking.hooks.append(self.blobstream)
         self.ante = ante_mod.AnteHandler(
             self.auth, self.bank, self.blob, self.minfee, min_gas_price
         )
@@ -415,11 +418,15 @@ class App:
         elif isinstance(msg, MsgRegisterEVMAddress):
             if self.app_version != 1:
                 raise ValueError("blobstream disabled after v1")
-            ctx.store.set(b"blobstream/evm/" + msg.validator, msg.evm_address)
+            self.blobstream.register_evm_address(ctx, msg.validator, msg.evm_address)
         else:
             raise ValueError(f"unroutable message {type(msg).__name__}")
 
     def _end_blocker(self, ctx: Context, height: int) -> None:
+        # blobstream attestations run first, v1 only (x/blobstream/abci.go:29,
+        # module version range app/modules.go:171)
+        if self.app_version == 1:
+            self.blobstream.end_blocker(ctx)
         # height-based v1 -> v2 (app/app.go:458-470)
         if (
             self.app_version == 1

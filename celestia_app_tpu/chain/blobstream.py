@@ -1,0 +1,431 @@
+"""x/blobstream analog: EVM-bridge attestations (app version 1 only).
+
+Reference parity (SURVEY.md §2.1 "x/blobstream"):
+- EndBlocker order — valset request first, then data commitments, then pruning
+  (x/blobstream/abci.go:29-35).
+- Valset attestations on: no previous valset, a validator starting to unbond
+  this block, or a normalized bridge-power change > 5%
+  (x/blobstream/abci.go:85-137, SignificantPowerDifferenceThreshold 0.05).
+- Data-commitment attestations covering [begin, end) ranges every
+  DataCommitmentWindow blocks (default 400, minimum 100), with a catch-up loop
+  (x/blobstream/abci.go:37-82, keeper/keeper_data_commitment.go:15-42,
+  types/genesis.go:18,29).
+- Pruning of attestations older than 3 weeks, advancing the earliest-available
+  nonce (x/blobstream/abci.go:141-198, AttestationExpiryTime).
+- MsgRegisterEVMAddress with EVM-address uniqueness; a default EVM address is
+  derived from the validator operator address on creation
+  (keeper/hooks.go:45-60, keeper/msg_server.go, types/types.go:13-15).
+- Staking hook: a validator starting to unbond records the height so one
+  valset request covers all unbonds in the block (keeper/hooks.go:24-40).
+- Bridge powers normalized so member power / u32_max == cosmos power share
+  (types/validator.go:101-140 PowerDiff docs); members sorted by power desc,
+  EVM-address hex asc as tiebreak (types/validator.go:85-99).
+
+The data-commitment *root* (what orchestrators sign and the EVM contract
+stores) is the RFC-6962 Merkle root over `abi.encode(height, dataRoot)`-style
+tuples — here 32-byte big-endian height ‖ 32-byte data root, mirroring
+DataRootTuple in the Blobstream contract used by x/blobstream/client/verify.go.
+`verify_share_inclusion` chains share proof → data root → tuple root exactly
+like the reference's verify CLI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from celestia_app_tpu.chain.state import Context
+from celestia_app_tpu.utils import merkle_host
+
+U32_MAX = 0xFFFFFFFF
+SIGNIFICANT_POWER_DIFF = 0.05  # abci.go SignificantPowerDifferenceThreshold
+ATTESTATION_EXPIRY_SECONDS = 3 * 7 * 24 * 3600  # abci.go AttestationExpiryTime
+DEFAULT_DATA_COMMITMENT_WINDOW = 400  # types/genesis.go:29
+MINIMUM_DATA_COMMITMENT_WINDOW = 100  # types/genesis.go:18
+# celestia-core consts.DataCommitmentBlocksLimit (pkg/appconsts/global_consts.go:81-86)
+DATA_COMMITMENT_BLOCKS_LIMIT = 10_000
+
+
+@dataclasses.dataclass(frozen=True)
+class BridgeValidator:
+    """Normalized bridge member: power/U32_MAX == share of cosmos power."""
+
+    power: int  # u32-normalized
+    evm_address: bytes  # 20 bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class Valset:
+    nonce: int
+    members: tuple[BridgeValidator, ...]
+    height: int
+    time_unix: float
+
+
+@dataclasses.dataclass(frozen=True)
+class DataCommitment:
+    nonce: int
+    begin_block: int  # inclusive
+    end_block: int  # exclusive
+    time_unix: float
+
+
+def _att_to_json(att) -> dict:
+    if isinstance(att, Valset):
+        return {
+            "type": "valset",
+            "nonce": att.nonce,
+            "members": [
+                {"power": m.power, "evm_address": m.evm_address.hex()}
+                for m in att.members
+            ],
+            "height": att.height,
+            "time_unix": att.time_unix,
+        }
+    return {
+        "type": "data_commitment",
+        "nonce": att.nonce,
+        "begin_block": att.begin_block,
+        "end_block": att.end_block,
+        "time_unix": att.time_unix,
+    }
+
+
+def _att_from_json(obj: dict):
+    if obj["type"] == "valset":
+        return Valset(
+            nonce=obj["nonce"],
+            members=tuple(
+                BridgeValidator(m["power"], bytes.fromhex(m["evm_address"]))
+                for m in obj["members"]
+            ),
+            height=obj["height"],
+            time_unix=obj["time_unix"],
+        )
+    return DataCommitment(
+        nonce=obj["nonce"],
+        begin_block=obj["begin_block"],
+        end_block=obj["end_block"],
+        time_unix=obj["time_unix"],
+    )
+
+
+def default_evm_address(operator: bytes) -> bytes:
+    """types/types.go:13-15 — the operator address bytes as an EVM address."""
+    return operator[-20:].rjust(20, b"\x00")
+
+
+class BlobstreamKeeper:
+    PREFIX = b"blobstream/"
+    LATEST_NONCE = b"blobstream/latest_nonce"
+    EARLIEST_NONCE = b"blobstream/earliest_nonce"
+    LATEST_VALSET_NONCE = b"blobstream/latest_valset_nonce"
+    LATEST_DC_NONCE = b"blobstream/latest_dc_nonce"
+    ATT = b"blobstream/att/"
+    EVM = b"blobstream/evm/"
+    EVM_BY_ADDR = b"blobstream/evm_by_addr/"
+    UNBONDING_HEIGHT = b"blobstream/unbonding_height"
+    PARAMS = b"blobstream/params"
+
+    def __init__(self, staking):
+        self.staking = staking
+
+    # -- params --------------------------------------------------------
+
+    def data_commitment_window(self, ctx: Context) -> int:
+        raw = ctx.store.get(self.PARAMS)
+        if raw is None:
+            return DEFAULT_DATA_COMMITMENT_WINDOW
+        return json.loads(raw)["data_commitment_window"]
+
+    def set_data_commitment_window(self, ctx: Context, window: int) -> None:
+        if window < MINIMUM_DATA_COMMITMENT_WINDOW:
+            raise ValueError(
+                f"data commitment window {window} < minimum "
+                f"{MINIMUM_DATA_COMMITMENT_WINDOW}"
+            )
+        if window > DATA_COMMITMENT_BLOCKS_LIMIT:
+            raise ValueError(
+                f"data commitment window {window} > blocks limit "
+                f"{DATA_COMMITMENT_BLOCKS_LIMIT}"
+            )
+        ctx.store.set(
+            self.PARAMS, json.dumps({"data_commitment_window": window}).encode()
+        )
+
+    # -- EVM address registry ------------------------------------------
+
+    def evm_address(self, ctx: Context, operator: bytes) -> bytes | None:
+        return ctx.store.get(self.EVM + operator)
+
+    def is_evm_address_unique(self, ctx: Context, evm: bytes) -> bool:
+        return ctx.store.get(self.EVM_BY_ADDR + evm) is None
+
+    def set_evm_address(self, ctx: Context, operator: bytes, evm: bytes) -> None:
+        if len(evm) != 20:
+            raise ValueError("EVM address must be 20 bytes")
+        old = ctx.store.get(self.EVM + operator)
+        if old is not None:
+            ctx.store.delete(self.EVM_BY_ADDR + old)
+        ctx.store.set(self.EVM + operator, evm)
+        ctx.store.set(self.EVM_BY_ADDR + evm, operator)
+
+    def register_evm_address(self, ctx: Context, operator: bytes, evm: bytes) -> None:
+        """MsgRegisterEVMAddress handler (keeper/msg_server.go)."""
+        if self.staking.validator_power(ctx, operator) == 0:
+            raise ValueError("EVM address registration from unknown validator")
+        if not self.is_evm_address_unique(ctx, evm):
+            raise ValueError("EVM address already registered")
+        self.set_evm_address(ctx, operator, evm)
+        ctx.emit_event(
+            "blobstream.register_evm_address",
+            validator=operator.hex(),
+            evm_address=evm.hex(),
+        )
+
+    # -- staking hooks (keeper/hooks.go) --------------------------------
+
+    def after_validator_created(self, ctx: Context, operator: bytes) -> None:
+        if ctx.app_version > 1:
+            return
+        evm = default_evm_address(operator)
+        if not self.is_evm_address_unique(ctx, evm):
+            raise ValueError(
+                "default EVM address collision; use a different operator address"
+            )
+        self.set_evm_address(ctx, operator, evm)
+
+    def after_validator_begin_unbonding(self, ctx: Context) -> None:
+        if ctx.app_version > 1:
+            return
+        ctx.store.set(
+            self.UNBONDING_HEIGHT, ctx.height.to_bytes(8, "big")
+        )
+
+    def latest_unbonding_height(self, ctx: Context) -> int:
+        raw = ctx.store.get(self.UNBONDING_HEIGHT)
+        return 0 if raw is None else int.from_bytes(raw, "big")
+
+    # -- attestation store ---------------------------------------------
+
+    def latest_attestation_nonce(self, ctx: Context) -> int | None:
+        raw = ctx.store.get(self.LATEST_NONCE)
+        return None if raw is None else int.from_bytes(raw, "big")
+
+    def earliest_available_nonce(self, ctx: Context) -> int | None:
+        raw = ctx.store.get(self.EARLIEST_NONCE)
+        return None if raw is None else int.from_bytes(raw, "big")
+
+    def attestation_by_nonce(self, ctx: Context, nonce: int):
+        raw = ctx.store.get(self.ATT + nonce.to_bytes(8, "big"))
+        return None if raw is None else _att_from_json(json.loads(raw))
+
+    def set_attestation_request(self, ctx: Context, att) -> None:
+        nonce = att.nonce
+        ctx.store.set(
+            self.ATT + nonce.to_bytes(8, "big"),
+            json.dumps(_att_to_json(att), sort_keys=True).encode(),
+        )
+        ctx.store.set(self.LATEST_NONCE, nonce.to_bytes(8, "big"))
+        if self.earliest_available_nonce(ctx) is None:
+            ctx.store.set(self.EARLIEST_NONCE, nonce.to_bytes(8, "big"))
+        # O(1) lookups for the per-block EndBlocker (the reference keeper
+        # likewise tracks the latest nonce instead of rescanning)
+        kind_key = (
+            self.LATEST_VALSET_NONCE
+            if isinstance(att, Valset)
+            else self.LATEST_DC_NONCE
+        )
+        ctx.store.set(kind_key, nonce.to_bytes(8, "big"))
+        ctx.emit_event(
+            "blobstream.attestation_request",
+            nonce=nonce,
+            kind=_att_to_json(att)["type"],
+        )
+
+    def _next_nonce(self, ctx: Context) -> int:
+        latest = self.latest_attestation_nonce(ctx)
+        return 1 if latest is None else latest + 1
+
+    # -- valsets --------------------------------------------------------
+
+    def current_valset(self, ctx: Context) -> Valset:
+        """Normalized bridge valset (keeper/keeper_valset.go GetCurrentValset)."""
+        vals = self.staking.validators(ctx)
+        total = sum(p for _, p in vals)
+        if total == 0:
+            raise ValueError("no bonded validators")
+        members = []
+        for operator, power in vals:
+            evm = self.evm_address(ctx, operator) or default_evm_address(operator)
+            members.append(BridgeValidator(power * U32_MAX // total, evm))
+        # power desc, EVM hex asc tiebreak (types/validator.go:85-99)
+        members.sort(key=lambda m: (-m.power, m.evm_address.hex()))
+        return Valset(
+            nonce=self._next_nonce(ctx),
+            members=tuple(members),
+            height=ctx.height,
+            time_unix=ctx.time_unix,
+        )
+
+    def _latest_of_kind(self, ctx: Context, kind_key: bytes):
+        raw = ctx.store.get(kind_key)
+        earliest = self.earliest_available_nonce(ctx)
+        if raw is None or earliest is None:
+            return None
+        nonce = int.from_bytes(raw, "big")
+        if nonce < earliest:
+            return None  # pruned
+        return self.attestation_by_nonce(ctx, nonce)
+
+    def latest_valset(self, ctx: Context) -> Valset | None:
+        return self._latest_of_kind(ctx, self.LATEST_VALSET_NONCE)
+
+    def latest_data_commitment(self, ctx: Context) -> DataCommitment | None:
+        return self._latest_of_kind(ctx, self.LATEST_DC_NONCE)
+
+    def data_commitment_for_height(self, ctx: Context, height: int) -> DataCommitment:
+        """Attestation whose [begin, end) range covers `height`
+        (keeper_data_commitment.go GetDataCommitmentForHeight)."""
+        latest = self.latest_data_commitment(ctx)
+        if latest is None or latest.end_block <= height:
+            raise ValueError(f"no data commitment generated for height {height}")
+        earliest = self.earliest_available_nonce(ctx)
+        for nonce in range(self.latest_attestation_nonce(ctx), earliest - 1, -1):
+            att = self.attestation_by_nonce(ctx, nonce)
+            if (
+                isinstance(att, DataCommitment)
+                and att.begin_block <= height < att.end_block
+            ):
+                return att
+        raise ValueError(f"data commitment for height {height} pruned or missing")
+
+    # -- end blocker ----------------------------------------------------
+
+    @staticmethod
+    def power_diff(a: Valset, b: Valset) -> float:
+        """Sum of absolute normalized power changes / U32_MAX
+        (types/validator.go:118-140)."""
+        powers: dict[bytes, int] = {m.evm_address: m.power for m in a.members}
+        for m in b.members:
+            powers[m.evm_address] = powers.get(m.evm_address, 0) - m.power
+        return sum(abs(v) for v in powers.values()) / U32_MAX
+
+    def end_blocker(self, ctx: Context) -> None:
+        """abci.go:29-35 — valset first, then data commitments, then pruning."""
+        self._handle_valset_request(ctx)
+        self._handle_data_commitment_request(ctx)
+        self._prune_attestations(ctx)
+
+    def _handle_valset_request(self, ctx: Context) -> None:
+        latest = self.latest_valset(ctx)
+        unbonding_now = self.latest_unbonding_height(ctx) == ctx.height
+        try:
+            current = self.current_valset(ctx)
+        except ValueError:
+            return  # no bonded validators (abci.go:101-108)
+        significant = (
+            latest is not None
+            and self.power_diff(current, latest) > SIGNIFICANT_POWER_DIFF
+        )
+        if latest is None or unbonding_now or significant:
+            self.set_attestation_request(ctx, current)
+
+    def _handle_data_commitment_request(self, ctx: Context) -> None:
+        window = self.data_commitment_window(ctx)
+        while True:
+            latest = self.latest_data_commitment(ctx)
+            if latest is not None:
+                # abci.go:63 — next range [end, end+window) is created one
+                # block after it completes: height - end_block >= window
+                if ctx.height - latest.end_block >= window:
+                    self.set_attestation_request(
+                        ctx,
+                        DataCommitment(
+                            nonce=self._next_nonce(ctx),
+                            begin_block=latest.end_block,
+                            end_block=latest.end_block + window,
+                            time_unix=ctx.time_unix,
+                        ),
+                    )
+                else:
+                    break
+            else:
+                if ctx.height >= window:
+                    # first range is [1, window+1) (keeper_data_commitment.go:35-38)
+                    self.set_attestation_request(
+                        ctx,
+                        DataCommitment(
+                            nonce=self._next_nonce(ctx),
+                            begin_block=1,
+                            end_block=window + 1,
+                            time_unix=ctx.time_unix,
+                        ),
+                    )
+                else:
+                    break
+
+    def _prune_attestations(self, ctx: Context) -> None:
+        latest = self.latest_attestation_nonce(ctx)
+        earliest = self.earliest_available_nonce(ctx)
+        if latest is None or earliest is None:
+            return
+        new_earliest = earliest
+        while new_earliest < latest:
+            att = self.attestation_by_nonce(ctx, new_earliest)
+            if att is None:
+                return
+            if att.time_unix + ATTESTATION_EXPIRY_SECONDS > ctx.time_unix:
+                break
+            ctx.store.delete(self.ATT + new_earliest.to_bytes(8, "big"))
+            new_earliest += 1
+        if new_earliest > earliest:
+            ctx.store.set(self.EARLIEST_NONCE, new_earliest.to_bytes(8, "big"))
+
+
+# ---------------------------------------------------------------------------
+# Data-commitment roots + client-side verification (x/blobstream/client/verify.go)
+# ---------------------------------------------------------------------------
+
+
+def encode_data_root_tuple(height: int, data_root: bytes) -> bytes:
+    """DataRootTuple as the Blobstream EVM contract encodes it: 32-byte
+    big-endian height ‖ 32-byte data root."""
+    if len(data_root) != 32:
+        raise ValueError("data root must be 32 bytes")
+    return height.to_bytes(32, "big") + data_root
+
+
+def data_commitment_root(
+    commitment: DataCommitment, data_roots: dict[int, bytes]
+) -> bytes:
+    """Merkle root the orchestrators attest to for a commitment's range."""
+    leaves = [
+        encode_data_root_tuple(h, data_roots[h])
+        for h in range(commitment.begin_block, commitment.end_block)
+    ]
+    return merkle_host.hash_from_leaves(leaves)
+
+
+def data_root_tuple_proof(
+    commitment: DataCommitment, data_roots: dict[int, bytes], height: int
+) -> merkle_host.Proof:
+    """Inclusion proof of one height's tuple in the commitment root."""
+    if not commitment.begin_block <= height < commitment.end_block:
+        raise ValueError("height outside commitment range")
+    leaves = [
+        encode_data_root_tuple(h, data_roots[h])
+        for h in range(commitment.begin_block, commitment.end_block)
+    ]
+    _, proofs = merkle_host.proofs_from_leaves(leaves)
+    return proofs[height - commitment.begin_block]
+
+
+def verify_data_root_inclusion(
+    height: int,
+    data_root: bytes,
+    commitment_root: bytes,
+    proof: merkle_host.Proof,
+) -> bool:
+    """client/verify.go VerifyDataRootInclusion: tuple → commitment root."""
+    return proof.verify(commitment_root, encode_data_root_tuple(height, data_root))

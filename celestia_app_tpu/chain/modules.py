@@ -212,8 +212,30 @@ class MintKeeper:
 class StakingKeeper:
     PREFIX = b"staking/val/"
 
+    def __init__(self):
+        # staking hooks (AfterValidatorCreated / AfterValidatorBeginUnbonding),
+        # registered like app/app.go:271-277 registers blobstream's
+        self.hooks: list = []
+
     def set_validator(self, ctx: Context, operator: bytes, power: int) -> None:
+        created = _get(ctx, self.PREFIX + operator) is None
         _put(ctx, self.PREFIX + operator, {"power": power})
+        if created:
+            for h in self.hooks:
+                after = getattr(h, "after_validator_created", None)
+                if after is not None:
+                    after(ctx, operator)
+
+    def begin_unbonding(self, ctx: Context, operator: bytes) -> None:
+        """A validator leaves the active set; hooks record the height so the
+        blobstream EndBlocker emits one valset request (keeper/hooks.go:24-40)."""
+        if _get(ctx, self.PREFIX + operator) is None:
+            raise ValueError("unknown validator")
+        ctx.store.delete(self.PREFIX + operator)
+        for h in self.hooks:
+            after = getattr(h, "after_validator_begin_unbonding", None)
+            if after is not None:
+                after(ctx)
 
     def validator_power(self, ctx: Context, operator: bytes) -> int:
         v = _get(ctx, self.PREFIX + operator)

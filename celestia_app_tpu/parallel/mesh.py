@@ -51,7 +51,7 @@ def make_mesh(
     devices = devices[:n_devices]
     seq = _largest_pow2_divisor(n_devices)
     if k is not None:
-        while seq > k:
+        while seq > 1 and k % seq != 0:
             seq //= 2
     data = n_devices // seq
     assert data * seq == n_devices  # seq is always a divisor of n_devices

@@ -1,0 +1,219 @@
+"""x/blobstream analog: attestations, valsets, data commitments, pruning,
+EVM address registry, and the client-side verify chain (SURVEY.md §2.1)."""
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.chain import blobstream as bs
+from celestia_app_tpu.chain.app import App
+from celestia_app_tpu.chain.crypto import PrivateKey
+from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+from celestia_app_tpu.chain.tx import MsgRegisterEVMAddress, TxBody, sign_tx
+from celestia_app_tpu.da import proof as proof_mod
+
+CHAIN = "bstream-test-1"
+T0 = 1_700_000_000.0
+
+
+def make_app(powers=(10, 10, 10), window=None, **kw):
+    app = App(chain_id=CHAIN, engine="host", **kw)
+    privs = [PrivateKey.from_seed(bytes([i])) for i in range(len(powers))]
+    app.init_chain(
+        {
+            "time_unix": T0,
+            "accounts": [
+                {"address": p.public_key().address().hex(), "balance": 10**12}
+                for p in privs
+            ],
+            "validators": [
+                {"operator": p.public_key().address().hex(), "power": pw}
+                for p, pw in zip(privs, powers)
+            ],
+        }
+    )
+    if window is not None:
+        ctx = _ctx(app)
+        app.blobstream.set_data_commitment_window(ctx, window)
+        ctx.store.write()
+    return app, privs
+
+
+def _ctx(app, height=None, t=None):
+    return Context(
+        app.store.branch(),
+        InfiniteGasMeter(),
+        height if height is not None else app.height,
+        t if t is not None else T0,
+        CHAIN,
+        app.app_version,
+    )
+
+
+def test_first_endblock_creates_valset():
+    app, privs = make_app()
+    app.produce_block([], t=T0 + 1)
+    ctx = _ctx(app)
+    assert app.blobstream.latest_attestation_nonce(ctx) == 1
+    vs = app.blobstream.attestation_by_nonce(ctx, 1)
+    assert isinstance(vs, bs.Valset)
+    assert len(vs.members) == 3
+    # equal powers normalize to ~u32_max/3 each, sorted by EVM hex tiebreak
+    assert all(m.power == bs.U32_MAX * 10 // 30 for m in vs.members)
+    hexes = [m.evm_address.hex() for m in vs.members]
+    assert hexes == sorted(hexes)
+    # stable valset -> no second valset on the next block
+    app.produce_block([], t=T0 + 2)
+    ctx = _ctx(app)
+    assert app.blobstream.latest_attestation_nonce(ctx) == 1
+
+
+def test_default_evm_addresses_registered_at_genesis():
+    app, privs = make_app()
+    ctx = _ctx(app)
+    for p in privs:
+        op = p.public_key().address()
+        assert app.blobstream.evm_address(ctx, op) == bs.default_evm_address(op)
+
+
+def test_power_change_triggers_new_valset():
+    app, privs = make_app()
+    app.produce_block([], t=T0 + 1)  # valset nonce 1
+    # >5% normalized power change: bump one validator 10 -> 20
+    ctx = app._deliver_ctx(InfiniteGasMeter(), height=app.height + 1, t=T0 + 2)
+    app.staking.set_validator(ctx, privs[0].public_key().address(), 20)
+    ctx.store.write()
+    app.produce_block([], t=T0 + 3)
+    ctx = _ctx(app)
+    assert app.blobstream.latest_attestation_nonce(ctx) == 2
+    vs = app.blobstream.attestation_by_nonce(ctx, 2)
+    assert vs.members[0].power == bs.U32_MAX * 20 // 40
+
+
+def test_unbonding_triggers_valset():
+    app, privs = make_app()
+    app.produce_block([], t=T0 + 1)
+    # begin unbonding inside block 2's execution: hook records height 2,
+    # EndBlocker at height 2 sees it and emits a valset
+    h = app.height + 1
+    ctx = app._deliver_ctx(InfiniteGasMeter(), height=h, t=T0 + 2)
+    app.staking.begin_unbonding(ctx, privs[2].public_key().address())
+    app._end_blocker(ctx, h)
+    ctx.store.write()
+    from celestia_app_tpu.chain.block import Block, Header
+
+    app.height = h  # commit the synthetic block height
+    ctx = _ctx(app)
+    assert app.blobstream.latest_attestation_nonce(ctx) == 2
+    vs = app.blobstream.attestation_by_nonce(ctx, 2)
+    assert len(vs.members) == 2
+
+
+def test_data_commitments_window_and_catchup():
+    app, privs = make_app(window=100)
+    # drive to height 99: no data commitment yet
+    for i in range(99):
+        app.produce_block([], t=T0 + i)
+    ctx = _ctx(app)
+    assert app.blobstream.latest_data_commitment(ctx) is None
+    # height 100 crosses the window: first range [1, 101)
+    app.produce_block([], t=T0 + 100)
+    ctx = _ctx(app)
+    dc = app.blobstream.latest_data_commitment(ctx)
+    assert (dc.begin_block, dc.end_block) == (1, 101)
+    # next at height >= 201 (abci.go:63 catch-up condition)
+    for i in range(101, 201):
+        app.produce_block([], t=T0 + i)
+    ctx = _ctx(app)
+    assert app.blobstream.latest_data_commitment(ctx).end_block == 101
+    app.produce_block([], t=T0 + 201)
+    ctx = _ctx(app)
+    dc = app.blobstream.latest_data_commitment(ctx)
+    assert (dc.begin_block, dc.end_block) == (101, 201)
+    assert app.blobstream.data_commitment_for_height(ctx, 150) == dc
+
+
+def test_pruning_after_expiry():
+    app, privs = make_app()
+    app.produce_block([], t=T0)
+    ctx = _ctx(app)
+    assert app.blobstream.earliest_available_nonce(ctx) == 1
+    # trigger a second attestation 4 weeks later (power change), then check
+    # the first valset is pruned (3-week expiry) but the latest survives
+    ctx = app._deliver_ctx(InfiniteGasMeter(), height=app.height + 1)
+    app.staking.set_validator(ctx, privs[0].public_key().address(), 100)
+    ctx.store.write()
+    four_weeks = 4 * 7 * 24 * 3600
+    app.produce_block([], t=T0 + four_weeks)
+    ctx = _ctx(app, t=T0 + four_weeks)
+    assert app.blobstream.latest_attestation_nonce(ctx) == 2
+    assert app.blobstream.earliest_available_nonce(ctx) == 2
+    assert app.blobstream.attestation_by_nonce(ctx, 1) is None
+
+
+def test_register_evm_address_msg_and_uniqueness():
+    app, privs = make_app()
+    op = privs[0].public_key().address()
+    new_evm = b"\xaa" * 20
+    body = TxBody(
+        msgs=(MsgRegisterEVMAddress(op, new_evm),),
+        chain_id=CHAIN,
+        account_number=0,
+        sequence=0,
+        fee=100_000,
+        gas_limit=200_000,
+    )
+    tx = sign_tx(body, privs[0])
+    block, results = app.produce_block([tx.encode()], t=T0 + 1)
+    assert results[0].code == 0, results[0].log
+    ctx = _ctx(app)
+    assert app.blobstream.evm_address(ctx, op) == new_evm
+    # reusing another validator's address must fail
+    ctx2 = _ctx(app)
+    with pytest.raises(ValueError, match="already registered"):
+        app.blobstream.register_evm_address(
+            ctx2, privs[1].public_key().address(), new_evm
+        )
+
+
+def test_blobstream_disabled_after_v2_upgrade():
+    app, privs = make_app(v2_upgrade_height=2)
+    app.produce_block([], t=T0 + 1)
+    ctx = _ctx(app)
+    assert app.blobstream.latest_attestation_nonce(ctx) == 1
+    app.produce_block([], t=T0 + 2)  # upgrade fires; blobstream store wiped
+    assert app.app_version == 2
+    ctx = _ctx(app)
+    assert app.blobstream.latest_attestation_nonce(ctx) is None
+    app.produce_block([], t=T0 + 3)  # no new attestations at v2
+    ctx = _ctx(app)
+    assert app.blobstream.latest_attestation_nonce(ctx) is None
+
+
+def test_data_commitment_root_and_verify_chain():
+    """Share proof -> data root -> tuple proof -> commitment root, the chain
+    x/blobstream/client/verify.go walks against the EVM contract."""
+    rng = np.random.default_rng(7)
+    app, privs = make_app(window=100)
+    data_roots = {}
+    for i in range(100):
+        block, _ = app.produce_block([], t=T0 + i)
+        data_roots[block.header.height] = block.header.data_hash
+    ctx = _ctx(app)
+    dc = app.blobstream.latest_data_commitment(ctx)
+    root = bs.data_commitment_root(dc, data_roots)
+    for h in (1, 50, 100):
+        p = bs.data_root_tuple_proof(dc, data_roots, h)
+        assert bs.verify_data_root_inclusion(h, data_roots[h], root, p)
+    # tampered data root fails
+    p = bs.data_root_tuple_proof(dc, data_roots, 50)
+    assert not bs.verify_data_root_inclusion(50, b"\x00" * 32, root, p)
+
+
+def test_power_diff_math():
+    a = bs.Valset(1, (bs.BridgeValidator(bs.U32_MAX // 2, b"\x01" * 20),
+                      bs.BridgeValidator(bs.U32_MAX // 2, b"\x02" * 20)), 1, T0)
+    b = bs.Valset(2, (bs.BridgeValidator(bs.U32_MAX // 2, b"\x01" * 20),
+                      bs.BridgeValidator(bs.U32_MAX // 2, b"\x02" * 20)), 2, T0)
+    assert bs.BlobstreamKeeper.power_diff(a, b) == 0.0
+    c = bs.Valset(3, (bs.BridgeValidator(bs.U32_MAX, b"\x01" * 20),), 3, T0)
+    assert bs.BlobstreamKeeper.power_diff(a, c) == pytest.approx(1.0, abs=1e-6)
