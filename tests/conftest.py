@@ -1,48 +1,36 @@
 """Test harness config: force CPU JAX with 8 virtual devices.
 
-Must run before jax initializes a backend — pytest imports conftest first.
-Multi-chip sharding tests use the virtual 8-device CPU mesh; the driver
-separately dry-runs the multi-chip path via __graft_entry__.dryrun_multichip.
+The container injects an axon TPU plugin via sitecustomize (gated on
+``PALLAS_AXON_POOL_IPS``). Once registered, backend init dials the TPU relay
+and hangs forever when the tunnel is down — even under ``JAX_PLATFORMS=cpu``.
+Tests never need the real chip (the driver benches on it separately), so
+before any backend initializes we drop the axon backend factory and pin jax
+to an 8-virtual-device CPU platform. Subprocesses spawned by tests inherit a
+cleaned env (no ``PALLAS_AXON_POOL_IPS``), so their sitecustomize skips the
+plugin entirely.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses spawned by tests
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
-import subprocess  # noqa: E402
-import sys  # noqa: E402
+import jax  # noqa: E402
+
+from jax._src import xla_bridge  # noqa: E402
+
+xla_bridge._backend_factories.pop("axon", None)
+# sitecustomize imported jax at interpreter start (before this file ran), so
+# jax's config already latched JAX_PLATFORMS=axon from the container env; the
+# env var assignment above cannot fix this process — only config.update can.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
-
-_BACKEND_OK: bool | None = None
-
-
-def _backend_available() -> bool:
-    """Probe JAX backend init in a subprocess with a timeout.
-
-    The axon TPU plugin initializes during the first jax op even under
-    JAX_PLATFORMS=cpu; when its tunnel is wedged, backend init hangs forever.
-    Probing out-of-process lets the suite skip device tests instead of
-    hanging (see .claude/skills/verify/SKILL.md).
-    """
-    global _BACKEND_OK
-    if _BACKEND_OK is None:
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=90,
-                env=dict(os.environ),
-                capture_output=True,
-            )
-            _BACKEND_OK = r.returncode == 0
-        except subprocess.TimeoutExpired:
-            _BACKEND_OK = False
-    return _BACKEND_OK
 
 
 def pytest_addoption(parser):
@@ -57,21 +45,14 @@ def pytest_addoption(parser):
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: heavy square sizes, skipped by default")
     config.addinivalue_line(
-        "markers", "backend: needs a live JAX backend (skipped if init hangs)"
+        "markers", "backend: exercises the jitted device path (CPU backend suffices)"
     )
 
 
 def pytest_collection_modifyitems(config, items):
-    run_slow = config.getoption("--run-slow")
+    if config.getoption("--run-slow"):
+        return
     skip_slow = pytest.mark.skip(reason="needs --run-slow")
-    needs_backend = [i for i in items if "backend" in i.keywords]
-    skip_backend = None
-    if needs_backend and not _backend_available():
-        skip_backend = pytest.mark.skip(
-            reason="JAX backend init unavailable (axon tunnel down)"
-        )
     for item in items:
-        if not run_slow and "slow" in item.keywords:
+        if "slow" in item.keywords:
             item.add_marker(skip_slow)
-        if skip_backend is not None and "backend" in item.keywords:
-            item.add_marker(skip_backend)
