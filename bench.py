@@ -37,11 +37,11 @@ def _bench_ods(k: int) -> np.ndarray:
 
 def measure_baseline() -> float:
     """CPU fast-host pipeline, ms/block (one untimed warmup, best of 2)."""
-    from celestia_app_tpu.ops import gf256
+    from celestia_app_tpu.ops import leopard
     from celestia_app_tpu.utils import fast_host
 
     ods = _bench_ods(K)
-    gf256.bit_matrix(K)  # warm the cached generator matrix off the clock
+    leopard.bit_matrix(K)  # warm the cached generator matrix off the clock
     times = []
     for _ in range(2):
         t0 = time.perf_counter()

@@ -1,31 +1,19 @@
-"""GF(2^8) arithmetic and Reed-Solomon generator construction (host side).
+"""GF(2^8) field arithmetic in the standard polynomial representation.
 
-The TPU-native RS formulation: a systematic code whose k data symbols are the
-evaluations of a degree-<k polynomial at field points 0..k-1 and whose k parity
-symbols are its evaluations at points k..2k-1 (Lagrange basis). Any k of the 2k
-codeword symbols reconstruct the data (MDS), which is the property the DA
-scheme requires (specs/src/specs/data_structures.md "Reed-Solomon Erasure
-Coding": any 50% of 2k pieces recover the original).
-
-Reference parity note: the reference chains to `rsmt2d.NewLeoRSCodec`
-(pkg/appconsts/global_consts.go:92), a Leopard-FFT systematic RS over GF(2^8).
-Both codes are systematic RS over GF(2^8); the parity bytes differ because the
-evaluation-point bases differ. This framework is self-consistent end-to-end
-(encode, decode, roots, proofs all agree); the codec is pluggable behind
-`ops.rs` should bit-compatibility with LeoRS codewords be required.
-
-Field: GF(2^8) with the standard primitive polynomial x^8+x^4+x^3+x^2+1
-(0x11D), generator 2 — the same field used by klauspost/reedsolomon.
-
-Device mapping: GF(256) multiply-accumulate is GF(2)-linear in the bits of the
-input, so the whole row-extension `parity = E · data` becomes one (8k × 8k)
-0/1 bit-matrix matmul per row batch — an MXU-friendly int8 matmul followed by
-`& 1` (see ops/rs.py).
+Field: GF(2^8) with the primitive polynomial x^8+x^4+x^3+x^2+1 (0x11D),
+generator 2 — the field underlying both the reference's Leopard codec and
+this framework's tables. These helpers operate on the *standard* (polynomial
+coefficient) byte representation; the production RS codec lives in
+ops/leopard.py, whose byte labels are related to this representation by the
+GF(2)-linear Cantor change of basis and therefore carry their own multiply
+tables. Use this module for standard-representation math (e.g. verifying
+the Cantor basis recurrence); use ops/leopard.py for anything touching
+codewords.
 """
+
 
 from __future__ import annotations
 
-import functools
 
 import numpy as np
 
@@ -59,91 +47,3 @@ def inv(a: int) -> int:
     if a == 0:
         raise ZeroDivisionError("GF(256) inverse of 0")
     return int(EXP[255 - LOG[a]])
-
-
-def mul_vec(a: np.ndarray, b: np.ndarray) -> np.ndarray:
-    """Elementwise GF(256) product of two uint8 arrays."""
-    a = a.astype(np.int32)
-    b = b.astype(np.int32)
-    out = EXP[LOG[a] + LOG[b]]
-    return np.where((a == 0) | (b == 0), 0, out).astype(np.uint8)
-
-
-def matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
-    """GF(256) matrix product (host reference; used for tests and setup)."""
-    assert a.ndim == 2 and b.ndim >= 2
-    out = np.zeros((a.shape[0],) + b.shape[1:], dtype=np.uint8)
-    for i in range(a.shape[0]):
-        acc = np.zeros(b.shape[1:], dtype=np.uint8)
-        for j in range(a.shape[1]):
-            if a[i, j]:
-                acc ^= mul_vec(np.full(b.shape[1:], a[i, j], np.uint8), b[j])
-        out[i] = acc
-    return out
-
-
-def _lagrange_row(xs: np.ndarray, i: int, x: int) -> int:
-    """ℓ_i(x) over evaluation points xs, in GF(256)."""
-    num, den = 1, 1
-    xi = int(xs[i])
-    for j, xj in enumerate(xs):
-        if j == i:
-            continue
-        num = mul(num, x ^ int(xj))
-        den = mul(den, xi ^ int(xj))
-    return mul(num, inv(den))
-
-
-@functools.lru_cache(maxsize=None)
-def encode_matrix(k: int) -> np.ndarray:
-    """(k, k) uint8 matrix E with parity = E ·gf data.
-
-    Data symbols sit at field points 0..k-1; parity j is the interpolating
-    polynomial evaluated at point k+j: E[j, i] = ℓ_i(k + j).
-    """
-    if not (1 <= k <= 128):
-        raise ValueError(f"k must be in [1, 128], got {k}")
-    xs = np.arange(k, dtype=np.int32)
-    e = np.zeros((k, k), dtype=np.uint8)
-    for j in range(k):
-        for i in range(k):
-            e[j, i] = _lagrange_row(xs, i, k + j)
-    return e
-
-
-@functools.lru_cache(maxsize=None)
-def decode_matrix(k: int, present: tuple[int, ...]) -> np.ndarray:
-    """(k, k) matrix mapping k present codeword symbols -> k data symbols.
-
-    `present` are codeword positions in [0, 2k) (field points), exactly k of
-    them. Row d of the result gives data symbol d = Σ M[d, t] · c[present[t]].
-    """
-    if len(present) != k:
-        raise ValueError(f"need exactly {k} present positions")
-    xs = np.array(present, dtype=np.int32)
-    m = np.zeros((k, k), dtype=np.uint8)
-    for d in range(k):  # data point d
-        for t in range(k):
-            m[d, t] = _lagrange_row(xs, t, d)
-    return m
-
-
-@functools.lru_cache(maxsize=None)
-def bit_matrix(k: int) -> np.ndarray:
-    """(8k, 8k) 0/1 int8 expansion of encode_matrix(k) over GF(2).
-
-    y = c ·gf x is linear in x's bits: y = XOR_b x_b · (c ·gf 2^b). With bits
-    packed LSB-first within each byte, B[8j+i, 8l+b] = bit i of
-    mul(E[j,l], 1<<b), and parity_bits = (B @ data_bits) mod 2.
-    """
-    e = encode_matrix(k)
-    powers = (1 << np.arange(8)).astype(np.uint8)  # 2^b
-    # prod[j, l, b] = E[j,l] ·gf 2^b
-    prod = mul_vec(
-        np.broadcast_to(e[:, :, None], (k, k, 8)).copy(),
-        np.broadcast_to(powers[None, None, :], (k, k, 8)).copy(),
-    ).astype(np.int32)
-    # bits[j, i, l, b] = bit i of prod[j, l, b]; row index (j,i) -> 8j+i,
-    # column index (l,b) -> 8l+b fall out of the reshape directly.
-    bits = (prod[:, None, :, :] >> np.arange(8)[None, :, None, None]) & 1
-    return bits.reshape(8 * k, 8 * k).astype(np.int8)

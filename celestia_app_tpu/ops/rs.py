@@ -10,7 +10,9 @@ TPU-native formulation of what the reference does with
 
 Instead of per-row scalar GF loops, each pass is ONE bit-matrix matmul on the
 MXU: bytes are unpacked to bits (LSB-first), parity_bits = (B @ data_bits) & 1
-with B = gf256.bit_matrix(k) of shape (8k, 8k), batched over all k rows /
+with B = leopard.bit_matrix(k) of shape (8k, 8k) — the reference's Leopard-RS
+code (rsmt2d.NewLeoRSCodec) collapsed to a GF(2) matrix, so varied-data
+squares produce the reference's exact codewords — batched over all k rows /
 columns at once. For k=128 that is 3 matmuls of (1024,1024)x(1024,512) per
 batch of 128 — ~0.4 TFLOP total, well inside a v5e chip's budget.
 
@@ -26,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from celestia_app_tpu import appconsts
-from celestia_app_tpu.ops import gf256
+from celestia_app_tpu.ops import leopard
 
 SHARE = appconsts.SHARE_SIZE
 
@@ -57,7 +59,7 @@ def _gf_mix(bit_mat: jax.Array, x_bits: jax.Array) -> jax.Array:
 
 def extend_square_fn(k: int):
     """Return a jittable fn: (k, k, 512) uint8 ODS -> (2k, 2k, 512) uint8 EDS."""
-    bit_mat = jnp.asarray(gf256.bit_matrix(k))  # constant folded into the jaxpr
+    bit_mat = jnp.asarray(leopard.bit_matrix(k))  # constant folded into the jaxpr
 
     def extend(ods: jax.Array) -> jax.Array:
         assert ods.shape == (k, k, SHARE), ods.shape
@@ -92,12 +94,12 @@ def extend_square_np(ods: np.ndarray) -> np.ndarray:
     """Byte-domain numpy reference of the same extension."""
     k = ods.shape[0]
     assert ods.shape == (k, k, SHARE)
-    e = gf256.encode_matrix(k)
-    q1 = np.stack([gf256.matmul(e, ods[r]) for r in range(k)])  # rows
+    e = leopard.encode_matrix(k)
+    q1 = np.stack([leopard.matmul(e, ods[r]) for r in range(k)])  # rows
     q2 = np.stack(
-        [gf256.matmul(e, ods[:, c, :]) for c in range(k)], axis=1
+        [leopard.matmul(e, ods[:, c, :]) for c in range(k)], axis=1
     )  # columns
-    q3 = np.stack([gf256.matmul(e, q2[r]) for r in range(k)])
+    q3 = np.stack([leopard.matmul(e, q2[r]) for r in range(k)])
     top = np.concatenate([ods, q1], axis=1)
     bottom = np.concatenate([q2, q3], axis=1)
     return np.concatenate([top, bottom], axis=0)
@@ -114,7 +116,7 @@ def repair_axis(symbols: np.ndarray, present: list[int]) -> np.ndarray:
     if len(present) < k:
         raise ValueError(f"need at least {k} of {two_k} symbols, got {len(present)}")
     use = tuple(sorted(present)[:k])
-    m = gf256.decode_matrix(k, use)
-    data = gf256.matmul(m, symbols[list(use)])
-    parity = gf256.matmul(gf256.encode_matrix(k), data)
+    m = leopard.decode_matrix(k, use)
+    data = leopard.matmul(m, symbols[list(use)])
+    parity = leopard.matmul(leopard.encode_matrix(k), data)
     return np.concatenate([data, parity], axis=0)

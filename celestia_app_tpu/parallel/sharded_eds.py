@@ -42,7 +42,7 @@ from jax.sharding import PartitionSpec as P
 
 from celestia_app_tpu import appconsts
 from celestia_app_tpu.da import namespace as ns_mod
-from celestia_app_tpu.ops import gf256, merkle, nmt, rs
+from celestia_app_tpu.ops import leopard, merkle, nmt, rs
 from celestia_app_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
 
 NS = appconsts.NAMESPACE_SIZE
@@ -81,7 +81,7 @@ def _roots_local(sq_local: jax.Array, k: int, major_start: jax.Array) -> jax.Arr
 
 def _local_pipeline(k: int, n_seq: int):
     """The per-device program run under shard_map."""
-    bit_mat = jnp.asarray(gf256.bit_matrix(k))
+    bit_mat = jnp.asarray(leopard.bit_matrix(k))
 
     def run(ods_local: jax.Array):
         # ods_local: (B_l, k/n, k, SHARE) — this device's slab of original rows.

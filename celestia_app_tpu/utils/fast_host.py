@@ -22,7 +22,7 @@ import numpy as np
 
 from celestia_app_tpu import appconsts
 from celestia_app_tpu.da import namespace as ns_mod
-from celestia_app_tpu.ops import gf256
+from celestia_app_tpu.ops import leopard
 from celestia_app_tpu.utils import merkle_host
 
 NS = appconsts.NAMESPACE_SIZE
@@ -47,7 +47,7 @@ def _bytes(b: np.ndarray) -> np.ndarray:
 def extend_square_fast(ods: np.ndarray) -> np.ndarray:
     """(k, k, 512) -> (2k, 2k, 512); same codewords as ops/rs.extend_square_fn."""
     k = ods.shape[0]
-    bm = gf256.bit_matrix(k).astype(np.float32)  # (8k, 8k)
+    bm = leopard.bit_matrix(k).astype(np.float32)  # (8k, 8k)
 
     def mix(rows: np.ndarray) -> np.ndarray:
         # rows: (m, k, S) -> parity (m, k, S); one (8k,8k)@(8k, m*S) matmul.

@@ -17,7 +17,7 @@ import numpy as np
 
 from celestia_app_tpu import appconsts
 from celestia_app_tpu.da import namespace as ns_mod
-from celestia_app_tpu.ops import gf256
+from celestia_app_tpu.ops import leopard
 from celestia_app_tpu.utils import merkle_host, nmt_host
 
 NS = appconsts.NAMESPACE_SIZE
@@ -26,10 +26,10 @@ NS = appconsts.NAMESPACE_SIZE
 def extend_square_host(ods: np.ndarray) -> np.ndarray:
     """(k, k, 512) -> (2k, 2k, 512), identical to ops/rs.py extension."""
     k = ods.shape[0]
-    e = gf256.encode_matrix(k)
-    q1 = np.stack([gf256.matmul(e, ods[r]) for r in range(k)])
-    q2 = np.stack([gf256.matmul(e, ods[:, c, :]) for c in range(k)], axis=1)
-    q3 = np.stack([gf256.matmul(e, q2[r]) for r in range(k)])
+    e = leopard.encode_matrix(k)
+    q1 = np.stack([leopard.matmul(e, ods[r]) for r in range(k)])
+    q2 = np.stack([leopard.matmul(e, ods[:, c, :]) for c in range(k)], axis=1)
+    q3 = np.stack([leopard.matmul(e, q2[r]) for r in range(k)])
     top = np.concatenate([ods, q1], axis=1)
     bottom = np.concatenate([q2, q3], axis=1)
     return np.concatenate([top, bottom], axis=0)

@@ -24,7 +24,7 @@ import jax  # noqa: E402
 
 from jax._src import xla_bridge  # noqa: E402
 
-xla_bridge._backend_factories.pop("axon", None)
+getattr(xla_bridge, "_backend_factories", {}).pop("axon", None)
 # sitecustomize imported jax at interpreter start (before this file ran), so
 # jax's config already latched JAX_PLATFORMS=axon from the container env; the
 # env var assignment above cannot fix this process — only config.update can.

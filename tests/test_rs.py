@@ -25,10 +25,10 @@ def test_quadrant_consistency():
     eds = rs.extend_square_np(ods)
     q1 = eds[:k, k:, :]
     q3 = eds[k:, k:, :]
-    from celestia_app_tpu.ops import gf256
+    from celestia_app_tpu.ops import leopard
 
-    e = gf256.encode_matrix(k)
-    q3_from_q1 = np.stack([gf256.matmul(e, q1[:, c, :]) for c in range(k)], axis=1)
+    e = leopard.encode_matrix(k)
+    q3_from_q1 = np.stack([leopard.matmul(e, q1[:, c, :]) for c in range(k)], axis=1)
     assert (q3_from_q1 == q3).all()
 
 
