@@ -51,13 +51,9 @@ def measure_baseline() -> float:
     return min(times) * 1000.0
 
 
-def measure_device(reps: int = 10) -> float:
+def _time_fn(run, ods, reps: int) -> float:
     import jax
 
-    from celestia_app_tpu.da import eds as eds_mod
-
-    run = eds_mod.jitted_pipeline(K)
-    ods = jax.device_put(_bench_ods(K))
     jax.block_until_ready(run(ods))  # compile + warm
     times = []
     for _ in range(reps):
@@ -67,7 +63,78 @@ def measure_device(reps: int = 10) -> float:
     return float(np.median(times)) * 1000.0
 
 
+def measure_device(reps: int = 10) -> float:
+    """Device pipeline ms/block. The SHA-256 stage uses the Pallas register
+    kernel by default on accelerators; if that fails to compile on the
+    current toolchain, fall back to the jnp scan path and still report."""
+    import jax
+
+    from celestia_app_tpu.da import eds as eds_mod
+
+    from celestia_app_tpu.ops import sha256 as sha_mod
+
+    ods = jax.device_put(_bench_ods(K))
+    if not sha_mod.use_pallas():
+        return _time_fn(eds_mod.jitted_pipeline(K), ods, reps)
+    try:
+        pallas_ms = _time_fn(eds_mod.jitted_pipeline(K), ods, reps)
+        root_pallas = bytes(np.asarray(eds_mod.jitted_pipeline(K)(ods)[3]))
+    except Exception as e:  # Pallas lowering/compile failure: degrade, don't die
+        print(f"pallas path failed ({type(e).__name__}: {e}); "
+              "retrying with CELESTIA_SHA256_IMPL=jnp", file=sys.stderr)
+        pallas_ms, root_pallas = None, None
+    # Cross-check the kernel against the jnp scan path before trusting it.
+    saved = os.environ.get("CELESTIA_SHA256_IMPL")
+    os.environ["CELESTIA_SHA256_IMPL"] = "jnp"
+    try:
+        eds_mod.jitted_pipeline.cache_clear()
+        jnp_pipeline = eds_mod.jitted_pipeline(K)
+        root_jnp = bytes(np.asarray(jnp_pipeline(ods)[3]))
+        if root_pallas == root_jnp:
+            return pallas_ms
+        if root_pallas is not None:
+            print("pallas/jnp data-root MISMATCH; reporting jnp path",
+                  file=sys.stderr)
+        return _time_fn(jnp_pipeline, ods, reps)
+    finally:
+        if saved is None:
+            os.environ.pop("CELESTIA_SHA256_IMPL", None)
+        else:
+            os.environ["CELESTIA_SHA256_IMPL"] = saved
+        eds_mod.jitted_pipeline.cache_clear()
+
+
+def measure_stages(reps: int = 10) -> None:
+    """Report per-stage device timings to stderr (--stages)."""
+    import jax
+
+    from celestia_app_tpu.da import eds as eds_mod
+    from celestia_app_tpu.ops import rs
+
+    ods = jax.device_put(_bench_ods(K))
+    extend_ms = _time_fn(jax.jit(rs.extend_square_fn(K)), ods, reps)
+    try:
+        full_ms = _time_fn(eds_mod.jitted_pipeline(K), ods, reps)
+    except Exception as e:
+        print(f"pallas path failed in --stages ({type(e).__name__}); "
+              "using jnp", file=sys.stderr)
+        os.environ["CELESTIA_SHA256_IMPL"] = "jnp"
+        eds_mod.jitted_pipeline.cache_clear()
+        full_ms = _time_fn(eds_mod.jitted_pipeline(K), ods, reps)
+
+    # NMT+root stage ≈ full − extend (stages fuse inside one dispatch, so
+    # subtraction is the honest attribution available without a profiler).
+    print(
+        f"stages: extend={extend_ms:.2f} ms, full={full_ms:.2f} ms, "
+        f"nmt+root≈{full_ms - extend_ms:.2f} ms",
+        file=sys.stderr,
+    )
+
+
 def main() -> None:
+    if "--stages" in sys.argv:
+        measure_stages()
+        return
     if "--measure-baseline" in sys.argv:
         ms = measure_baseline()
         with open(BASELINE_FILE, "w") as f:

@@ -12,32 +12,16 @@ Semantics match FIPS 180-4 exactly (golden-tested against hashlib).
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-_K = np.array(
-    [
-        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
-        0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
-        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
-        0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
-        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
-        0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
-        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
-        0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
-        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
-        0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
-        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
-    ],
-    dtype=np.uint32,
-)
+from celestia_app_tpu.ops.sha256_consts import H0_WORDS, K_WORDS
 
-_H0 = np.array(
-    [0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
-     0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19],
-    dtype=np.uint32,
-)
+_K = np.array(K_WORDS, dtype=np.uint32)
+_H0 = np.array(H0_WORDS, dtype=np.uint32)
 
 
 def _rotr(x: jax.Array, n) -> jax.Array:
@@ -81,11 +65,28 @@ def _pad_len(msg_len: int) -> int:
     return ((msg_len + 8) // 64 + 1) * 64
 
 
+def use_pallas() -> bool:
+    """Pallas kernel on accelerator backends; jnp scan path on CPU.
+
+    Override with CELESTIA_SHA256_IMPL=pallas|jnp (the bench harness uses
+    this to fall back if the kernel fails to compile on a new toolchain).
+    """
+    impl = os.environ.get("CELESTIA_SHA256_IMPL", "")
+    if impl == "pallas":
+        return True
+    if impl == "jnp":
+        return False
+    # axon is the tunneled TPU platform (its MLIR lowerings alias to tpu's);
+    # anything else (cpu, gpu) takes the portable jnp path.
+    return jax.default_backend() in ("tpu", "axon")
+
+
 def sha256(msgs: jax.Array) -> jax.Array:
     """SHA-256 of N equal-length messages: (N, L) uint8 -> (N, 32) uint8.
 
     L is static; padding and block count are resolved at trace time. Blocks
-    are consumed with lax.scan (compile-time O(1) in block count).
+    are consumed by the Pallas register kernel on TPU (sha256_pallas.py) or
+    a lax.scan of compressions on CPU.
     """
     n, msg_len = msgs.shape
     total = _pad_len(msg_len)
@@ -102,12 +103,20 @@ def sha256(msgs: jax.Array) -> jax.Array:
     words = jnp.sum(quads * be, axis=-1, dtype=jnp.uint32)  # (N, total/4)
     blocks = jnp.transpose(words.reshape(n, total // 64, 16), (1, 2, 0))
 
-    state0 = jnp.broadcast_to(jnp.asarray(_H0)[:, None], (8, n))
+    if use_pallas() and n >= 1024:
+        # Pallas register kernel for the big batched levels; tiny upper tree
+        # levels (N < one 1024-lane tile) stay on the jnp path rather than
+        # paying a nearly-all-padding kernel dispatch per level.
+        from celestia_app_tpu.ops import sha256_pallas
 
-    def step(state, block_words):
-        return _compress(state, block_words), None
+        state = sha256_pallas.compress_words(blocks)
+    else:
+        state0 = jnp.broadcast_to(jnp.asarray(_H0)[:, None], (8, n))
 
-    state, _ = jax.lax.scan(step, state0, blocks)
+        def step(state, block_words):
+            return _compress(state, block_words), None
+
+        state, _ = jax.lax.scan(step, state0, blocks)
     digest_words = jnp.transpose(state)  # (N, 8) u32
     shifts = jnp.array([24, 16, 8, 0], dtype=jnp.uint32)
     out = (digest_words[:, :, None] >> shifts[None, None, :]) & jnp.uint32(0xFF)

@@ -1,0 +1,109 @@
+"""Pallas TPU kernel for batched SHA-256 (BASELINE.md config 2).
+
+The jnp path in ops/sha256.py materializes the 64-entry message schedule as a
+(64, N) array and round-trips it through fori_loop dynamic updates — XLA keeps
+that buffer live across all 112 sequential steps, so for the ~1.6M-compression
+workload of a 128x128 block the VPU stalls on VMEM/HBM traffic instead of
+doing register arithmetic. This kernel is the classic register formulation:
+
+- the message schedule lives in a rolling window of 16 (8, 128) u32 vregs
+  (slot i%16 is rewritten with w[i+16] right after round i consumes it),
+- the working state is 8 more vregs, fully unrolled over the 64 rounds,
+- each grid step hashes a 1024-message lane tile; the multi-block loop over a
+  message's 64-byte blocks is a fori_loop with dynamic leading-dim reads.
+
+Input layout is word-major — (total_words, n_tiles, 8, 128) u32, i.e. word w
+of message m lives at [w, m//1024, (m%1024)//128, m%128] — so every round's
+w[i] read is one contiguous vreg, not a gather. HBM traffic is exactly
+"read each padded block once, write 32 bytes per digest".
+
+Reference workload shape: NMT leaves are 542-byte preimages (9 blocks),
+inner nodes 181 bytes (3 blocks) — pkg/wrapper/nmt_wrapper.go hashing via
+crypto/sha256; see SURVEY.md §7.2.2.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from celestia_app_tpu.ops.sha256_consts import H0_WORDS, K_WORDS
+
+LANES = 128
+SUBLANES = 8
+TILE = LANES * SUBLANES  # messages per grid step
+
+
+def _rotr(x: jax.Array, n: int) -> jax.Array:
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _kernel(nblocks: int, x_ref, o_ref):
+    """x_ref: (16*nblocks, 1, 8, 128) u32; o_ref: (8, 1, 8, 128) u32."""
+    h0 = tuple(
+        jnp.full((SUBLANES, LANES), np.uint32(H0_WORDS[j]), jnp.uint32)
+        for j in range(8)
+    )
+
+    def block_step(b, hs):
+        w = [x_ref[b * 16 + i, 0] for i in range(16)]
+        a, bb, c, d, e, f, g, hh = hs
+        for i in range(64):
+            wi = w[i % 16]
+            s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+            ch = (e & f) ^ (~e & g)
+            t1 = hh + s1 + ch + np.uint32(K_WORDS[i]) + wi
+            s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+            maj = (a & bb) ^ (a & c) ^ (bb & c)
+            t2 = s0 + maj
+            hh, g, f, e, d, c, bb, a = g, f, e, d + t1, c, bb, a, t1 + t2
+            if i < 48:
+                wl = w[(i + 1) % 16]
+                wh = w[(i + 14) % 16]
+                sig0 = _rotr(wl, 7) ^ _rotr(wl, 18) ^ (wl >> np.uint32(3))
+                sig1 = _rotr(wh, 17) ^ _rotr(wh, 19) ^ (wh >> np.uint32(10))
+                w[i % 16] = wi + sig0 + w[(i + 9) % 16] + sig1
+        out = (a, bb, c, d, e, f, g, hh)
+        return tuple(hs[j] + out[j] for j in range(8))
+
+    hs = jax.lax.fori_loop(0, nblocks, block_step, h0, unroll=False)
+    for j in range(8):
+        o_ref[j, 0] = hs[j]
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_call(nblocks: int, n_tiles: int, interpret: bool):
+    kernel = functools.partial(_kernel, nblocks)
+    return pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec(
+                (16 * nblocks, 1, SUBLANES, LANES), lambda m: (0, m, 0, 0)
+            )
+        ],
+        out_specs=pl.BlockSpec((8, 1, SUBLANES, LANES), lambda m: (0, m, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, n_tiles, SUBLANES, LANES), jnp.uint32),
+        interpret=interpret,
+    )
+
+
+def compress_words(blocks: jax.Array, interpret: bool = False) -> jax.Array:
+    """(nblocks, 16, N) u32 big-endian words -> (8, N) u32 digest state.
+
+    Drop-in replacement for the jnp scan-of-compressions in ops/sha256.py;
+    N is padded up to a multiple of 1024 lanes internally.
+    """
+    nblocks, sixteen, n = blocks.shape
+    assert sixteen == 16, blocks.shape
+    n_pad = -(-n // TILE) * TILE
+    x = jnp.zeros((nblocks * 16, n_pad), dtype=jnp.uint32)
+    x = x.at[:, :n].set(blocks.reshape(nblocks * 16, n))
+    n_tiles = n_pad // TILE
+    x = x.reshape(nblocks * 16, n_tiles, SUBLANES, LANES)
+    out = _compiled_call(nblocks, n_tiles, interpret)(x)
+    return out.reshape(8, n_pad)[:, :n]
