@@ -131,7 +131,45 @@ def measure_stages(reps: int = 10) -> None:
     )
 
 
+def measure_proofs(n_proofs: int = 10_000) -> None:
+    """BASELINE config 3: batched share-proof generation, proofs/sec.
+
+    Builds the 128x128 block's row trees in one device pass
+    (da/proof_device.BlockProver), then times assembling n_proofs share
+    proofs (pure index arithmetic per proof). Prints its own JSON line;
+    the driver's headline metric remains the default mode.
+    """
+    from celestia_app_tpu.da import dah as dah_mod
+    from celestia_app_tpu.da import proof_device
+
+    ods = _bench_ods(K)
+    d, eds_obj, _ = dah_mod.new_dah_from_ods(ods)
+    t0 = time.perf_counter()
+    prover = proof_device.BlockProver(eds_obj, d)
+    build_ms = (time.perf_counter() - t0) * 1000
+    rng = np.random.default_rng(0)
+    starts = rng.integers(0, K * K - 4, n_proofs)
+    ns = bytes(29)
+    t0 = time.perf_counter()
+    for s0 in starts:
+        prover.prove_shares(int(s0), int(s0) + 4, ns)
+    dt = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "metric": "share_proofs_per_sec_128",
+                "value": round(n_proofs / dt, 1),
+                "unit": "proofs/s",
+                "tree_build_ms": round(build_ms, 1),
+            }
+        )
+    )
+
+
 def main() -> None:
+    if "--proofs" in sys.argv:
+        measure_proofs()
+        return
     if "--stages" in sys.argv:
         measure_stages()
         return

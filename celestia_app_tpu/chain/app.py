@@ -29,7 +29,11 @@ from celestia_app_tpu.chain import ante as ante_mod
 from celestia_app_tpu.chain import blobstream as blobstream_mod
 from celestia_app_tpu.chain import modules
 from celestia_app_tpu.chain.block import Block, Header, TxResult
-from celestia_app_tpu.chain.blob_validation import BlobTxError, validate_blob_tx
+from celestia_app_tpu.chain.blob_validation import (
+    BlobTxError,
+    batch_commitments,
+    validate_blob_tx,
+)
 from celestia_app_tpu.chain.state import Context, GasMeter, InfiniteGasMeter, KVStore, OutOfGas
 from celestia_app_tpu.chain.tx import (
     MsgPayForBlobs,
@@ -317,12 +321,35 @@ class App:
         )
         normal_txs: list[bytes] = []
         pfb_entries: list[PfbEntry] = []
+        # Batch all blob commitments of the block in one device pass
+        # (da/commitment_device.py) instead of per-blob host recomputation.
+        parsed: dict[int, object] = {}
+        all_blobs: list = []
+        seen_blob_scan = False
+        for i, raw in enumerate(block.txs):
+            if blob_mod.is_blob_tx(raw):
+                seen_blob_scan = True
+                try:
+                    btx = blob_mod.unmarshal_blob_tx(raw)
+                except ValueError as e:
+                    raise ValueError(f"undecodable blob tx: {e}") from None
+                parsed[i] = btx
+                all_blobs.extend(btx.blobs)
+            elif seen_blob_scan:
+                # cheap reject before paying the device commitment batch
+                raise ValueError("normal tx after blob tx (ordering violation)")
+        all_commitments = batch_commitments(all_blobs, threshold)
+        cursor = 0
         seen_blob = False
-        for raw in block.txs:
+        for i, raw in enumerate(block.txs):
             if blob_mod.is_blob_tx(raw):
                 seen_blob = True
-                btx = blob_mod.unmarshal_blob_tx(raw)
-                tx, _ = validate_blob_tx(btx, threshold)
+                btx = parsed[i]
+                n = len(btx.blobs)
+                tx, _ = validate_blob_tx(
+                    btx, threshold, all_commitments[cursor : cursor + n]
+                )
+                cursor += n
                 # the full ante chain runs for blob txs too — sig, fee funds,
                 # sequence (process_proposal.go:100-117); block order (normal
                 # before blob) matches PrepareProposal's filter order, so the

@@ -19,8 +19,27 @@ class BlobTxError(Exception):
     pass
 
 
-def validate_blob_tx(btx: BlobTx, subtree_root_threshold: int) -> tuple[Tx, MsgPayForBlobs]:
-    """Validate and return the decoded signed tx + its PFB message."""
+def batch_commitments(blobs: list, subtree_root_threshold: int) -> list[bytes]:
+    """Commitments for many blobs at once: device-batched when the workload
+    is big enough to amortize a dispatch (BASELINE config 3), host otherwise."""
+    if len(blobs) >= 4:
+        from celestia_app_tpu.da import commitment_device
+
+        return commitment_device.commitments_device(blobs, subtree_root_threshold)
+    return commitment_mod.create_commitments(blobs, subtree_root_threshold)
+
+
+def validate_blob_tx(
+    btx: BlobTx,
+    subtree_root_threshold: int,
+    commitments: list[bytes] | None = None,
+) -> tuple[Tx, MsgPayForBlobs]:
+    """Validate and return the decoded signed tx + its PFB message.
+
+    ``commitments`` optionally supplies this tx's precomputed blob
+    commitments (from batch_commitments over the whole block) so
+    ProcessProposal doesn't recompute per blob on the host.
+    """
     if not btx.blobs:
         raise BlobTxError("blob tx contains no blobs")
     try:
@@ -48,7 +67,10 @@ def validate_blob_tx(btx: BlobTx, subtree_root_threshold: int) -> tuple[Tx, MsgP
             )
         if blob.share_version != msg.share_versions[i]:
             raise BlobTxError(f"blob {i} share version mismatch")
-        want = commitment_mod.create_commitment(blob, subtree_root_threshold)
+        if commitments is not None:
+            want = commitments[i]
+        else:
+            want = commitment_mod.create_commitment(blob, subtree_root_threshold)
         if want != msg.share_commitments[i]:
             raise BlobTxError(f"blob {i} share commitment mismatch")
     return tx, msg

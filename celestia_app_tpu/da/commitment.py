@@ -15,8 +15,9 @@ Because blobs start at multiples of SubtreeWidth (non-interactive default,
 square.py), these subtree roots appear verbatim as inner nodes of the row NMTs
 for any square size — commitments are square-size independent (ADR-008/013).
 
-Host path here (hashlib, used per-tx in CheckTx); `commitment_device` batches
-every blob of a block into a few vectorized SHA launches (BASELINE config 3).
+Host path here (hashlib, used per-tx in CheckTx); da/commitment_device.py
+batches every blob of a block into a few vectorized SHA launches (BASELINE
+config 3) and is what ProcessProposal uses via blob_validation.batch_commitments.
 """
 
 from __future__ import annotations

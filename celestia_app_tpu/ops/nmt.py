@@ -109,14 +109,27 @@ def reduce_level(
     return node_min, node_max, v
 
 
+def nmt_levels(
+    leaf_ns: jax.Array, leaf_data: jax.Array
+) -> list[tuple[jax.Array, jax.Array, jax.Array]]:
+    """All tree levels, leaves first: [(T, L/2^i, .) for i in 0..log2(L)].
+
+    The level list is what batched proof generation consumes — every node of
+    every row tree in one device pass (da/proof_device.py); nmt_roots is the
+    tail of it.
+    """
+    t, l, _ = leaf_data.shape
+    assert l & (l - 1) == 0 and l >= 1, f"leaf count {l} not a power of two"
+    levels = [leaf_nodes(leaf_ns, leaf_data)]
+    while levels[-1][0].shape[1] > 1:
+        levels.append(reduce_level(*levels[-1]))
+    return levels
+
+
 def nmt_roots(leaf_ns: jax.Array, leaf_data: jax.Array) -> jax.Array:
     """Batched NMT roots: (T, L, 29) ns + (T, L, D) leaves -> (T, 90) u8 roots.
 
     L must be a power of two (axis lengths of the extended square always are).
     """
-    t, l, _ = leaf_data.shape
-    assert l & (l - 1) == 0 and l >= 1, f"leaf count {l} not a power of two"
-    mins, maxs, vs = leaf_nodes(leaf_ns, leaf_data)
-    while mins.shape[1] > 1:
-        mins, maxs, vs = reduce_level(mins, maxs, vs)
+    mins, maxs, vs = nmt_levels(leaf_ns, leaf_data)[-1]
     return jnp.concatenate([mins[:, 0], maxs[:, 0], vs[:, 0]], axis=1)

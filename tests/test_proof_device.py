@@ -1,0 +1,73 @@
+"""Device-batched commitments and proofs vs the host reference paths."""
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.da import commitment, commitment_device, dah, proof, proof_device, square
+from celestia_app_tpu.da.blob import Blob
+from celestia_app_tpu.da.dah import ExtendedDataSquare
+from celestia_app_tpu.da.namespace import Namespace
+from celestia_app_tpu import appconsts
+
+
+def _blobs(rng, spec):
+    out = []
+    for i, size in enumerate(spec):
+        ns = Namespace.v0(bytes([i + 1]) * 8)
+        out.append(Blob(ns, rng.integers(0, 256, size, dtype=np.uint8).tobytes()))
+    return out
+
+
+@pytest.mark.backend
+def test_commitments_device_match_host():
+    rng = np.random.default_rng(0)
+    # sizes chosen to hit 1-share, multi-share, multi-subtree, and
+    # non-power-of-two MMR decompositions
+    blobs = _blobs(rng, [10, 500, 2000, 480 * 9, 480 * 30, 7])
+    thr = appconsts.subtree_root_threshold(appconsts.LATEST_VERSION)
+    host = commitment.create_commitments(blobs, thr)
+    dev = commitment_device.commitments_device(blobs, thr)
+    assert dev == host
+
+
+@pytest.mark.backend
+def test_block_prover_matches_host_proofs():
+    rng = np.random.default_rng(1)
+    blobs = _blobs(rng, [700, 1500, 300])
+    sq = square.build(
+        [b"\x09sometx"],
+        [square.PfbEntry(tx=bytes([i]) * 8, blobs=[b]) for i, b in enumerate(blobs)],
+        64,
+        64,
+    )
+    ods = dah.shares_to_ods(sq.share_bytes())
+    d, eds_obj, root = dah.new_dah_from_ods(ods)
+    prover = proof_device.BlockProver(eds_obj, d)
+    k = sq.size
+
+    # every blob's range + a few arbitrary ranges: byte-identical proofs
+    ranges = [proof.blob_share_range(sq, i, 0) for i in range(len(blobs))]
+    ranges += [(0, 1), (0, k * k), (k - 1, k + 1 if k > 1 else k)]
+    for lo, hi in ranges:
+        ns = b"\x00" * 29
+        dev_p = prover.prove_shares(lo, hi, ns)
+        host_p = proof.new_share_inclusion_proof(eds_obj, d, lo, hi, ns)
+        assert dev_p == host_p, (lo, hi)
+        assert dev_p.verify(root)
+
+    # tx proof parity
+    dev_t = prover.prove_tx(sq, 0)
+    host_t = proof.new_tx_inclusion_proof(sq, eds_obj, d, 0)
+    assert dev_t == host_t
+    assert dev_t.verify(root)
+
+
+@pytest.mark.backend
+def test_block_prover_rejects_bad_range():
+    rng = np.random.default_rng(2)
+    sq = square.build([], [square.PfbEntry(tx=b"x", blobs=_blobs(rng, [100]))], 64, 64)
+    ods = dah.shares_to_ods(sq.share_bytes())
+    d, eds_obj, _ = dah.new_dah_from_ods(ods)
+    prover = proof_device.BlockProver(eds_obj, d)
+    with pytest.raises(ValueError):
+        prover.prove_shares(0, sq.size * sq.size + 1, b"\x00" * 29)
