@@ -340,10 +340,8 @@ class App:
                 raise ValueError("normal tx after blob tx (ordering violation)")
         all_commitments = batch_commitments(all_blobs, threshold)
         cursor = 0
-        seen_blob = False
         for i, raw in enumerate(block.txs):
             if blob_mod.is_blob_tx(raw):
-                seen_blob = True
                 btx = parsed[i]
                 n = len(btx.blobs)
                 tx, _ = validate_blob_tx(
@@ -360,8 +358,7 @@ class App:
                 per_tx.store.write()
                 pfb_entries.append(PfbEntry(btx.tx, btx.blobs))
             else:
-                if seen_blob:
-                    raise ValueError("normal tx after blob tx (ordering violation)")
+                # normal-after-blob ordering is enforced by the pre-scan above
                 tx = Tx.decode(raw)  # v2+: undecodable tx rejects the block
                 if any(isinstance(m, MsgPayForBlobs) for m in tx.body.msgs):
                     raise ValueError("PFB message in non-blob tx")

@@ -82,8 +82,18 @@ class PrivateKey:
         return PublicKey(compressed)
 
     def sign(self, message: bytes) -> bytes:
-        """64-byte (r || s) low-S signature over sha256(message)."""
-        der = self._key().sign(_sha(message), ec.ECDSA(Prehashed(hashes.SHA256())))
+        """64-byte (r || s) low-S signature over sha256(message).
+
+        Deterministic per RFC 6979 (HMAC-SHA256 nonce), like the reference's
+        cosmos-sdk secp256k1 signer (btcec) — identical inputs produce
+        identical tx bytes, which keeps block data roots reproducible and
+        signatures non-malleable. OpenSSL's randomized-nonce ECDSA is kept
+        for verification only.
+        """
+        der = self._key().sign(
+            _sha(message),
+            ec.ECDSA(Prehashed(hashes.SHA256()), deterministic_signing=True),
+        )
         r, s = decode_dss_signature(der)
         if s > _N // 2:
             s = _N - s

@@ -1,0 +1,1 @@
+"""Adversarial test fixtures (the reference's test/util/malicious analog)."""

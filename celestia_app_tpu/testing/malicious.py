@@ -1,0 +1,104 @@
+"""Malicious-proposer fixtures: build blocks that honest validators must reject.
+
+Reference parity: test/util/malicious/ —
+  tree.go:19-60            BlindTree: an NMT that skips namespace-ordering
+                           verification (ForceAddLeaf instead of Push), so a
+                           malicious proposer can still produce axis roots
+                           over an invalid share ordering.
+  out_of_order_builder.go  OutOfOrderExport: swaps two blobs in the square.
+  out_of_order_prepare.go  OutOfOrderPrepareProposal: honest tx filtering,
+                           malicious square + commitment.
+
+These fixtures exist so tests can assert the *honest* ProcessProposal path
+rejects each class of malice (the reference additionally uses them to source
+fraud proofs)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.chain.block import Block, Header
+from celestia_app_tpu.da import dah as dah_mod
+from celestia_app_tpu.da import namespace as ns_mod
+from celestia_app_tpu.utils import merkle_host, nmt_host
+
+NS = appconsts.NAMESPACE_SIZE
+
+
+class BlindNmtTree(nmt_host.NmtTree):
+    """NMT that accepts leaves in any namespace order (malicious/tree.go)."""
+
+    def push(self, ns: bytes, data: bytes) -> None:  # ForceAddLeaf
+        self.leaves.append((ns, data))
+
+
+def swap_first_two_blobs(square) -> list[bytes]:
+    """Square share list with the first two blobs' share ranges swapped
+    (OutOfOrderExport, out_of_order_builder.go:62-79). Requires >= 2 blobs."""
+    shares = list(square.share_bytes())
+    keys = sorted(square.blob_start_indexes.keys())
+    if len(keys) < 2:
+        raise ValueError("need at least two blobs to swap")
+    (i0, j0), (i1, j1) = keys[0], keys[1]
+    s0 = square.blob_start_indexes[(i0, j0)]
+    c0 = square.pfbs[i0].blobs[j0].share_count()
+    s1 = square.blob_start_indexes[(i1, j1)]
+    c1 = square.pfbs[i1].blobs[j1].share_count()
+    if c0 != c1:
+        # swap equal-length prefixes so the layout geometry stays identical
+        c0 = c1 = min(c0, c1)
+    a, b = shares[s0 : s0 + c0], shares[s1 : s1 + c1]
+    shares[s0 : s0 + c0], shares[s1 : s1 + c1] = b, a
+    return shares
+
+
+def blind_dah(ods: np.ndarray):
+    """DAH over an (invalidly ordered) ODS using blind trees: the malicious
+    analog of utils/refimpl.pipeline_host — an honest NmtTree would raise."""
+    from celestia_app_tpu.utils import refimpl
+
+    eds = refimpl.extend_square_host(ods)
+    two_k = eds.shape[0]
+    k = two_k // 2
+
+    def tree_root(axis_get, axis_index) -> bytes:
+        tree = BlindNmtTree()
+        for j in range(two_k):
+            share = axis_get(j).tobytes()
+            in_q0 = axis_index < k and j < k
+            ns = share[:NS] if in_q0 else ns_mod.PARITY_NS_RAW
+            tree.push(ns, share)
+        return nmt_host.serialize(tree.root())
+
+    rows = [tree_root(lambda j, r=r: eds[r, j], r) for r in range(two_k)]
+    cols = [tree_root(lambda j, c=c: eds[j, c], c) for c in range(two_k)]
+    root = merkle_host.hash_from_leaves(rows + cols)
+    return dah_mod.DataAvailabilityHeader(tuple(rows), tuple(cols)), root
+
+
+def out_of_order_prepare(app, raw_txs: list[bytes], t: float) -> Block:
+    """Malicious PrepareProposal: honest filtering and square build, then the
+    first two blobs swapped and the data root recomputed with blind trees
+    (out_of_order_prepare.go:18-76)."""
+    honest = app.prepare_proposal(raw_txs, t=t)
+    sq = honest.square if hasattr(honest, "square") else None
+    block = honest.block if hasattr(honest, "block") else honest
+    if sq is None:
+        raise ValueError("prepare_proposal result carries no square")
+    shares = swap_first_two_blobs(sq)
+    ods = dah_mod.shares_to_ods(shares)
+    _, root = blind_dah(ods)
+    h = block.header
+    forged = Header(
+        chain_id=h.chain_id,
+        height=h.height,
+        time_unix=h.time_unix,
+        data_hash=root,
+        square_size=h.square_size,
+        app_hash=h.app_hash,
+        proposer=h.proposer,
+        app_version=h.app_version,
+        last_block_hash=h.last_block_hash,
+    )
+    return Block(header=forged, txs=block.txs)

@@ -1,0 +1,75 @@
+"""App-hash regression pin across a deterministic multi-block scenario.
+
+The reference's equivalent is app/test/consistent_apphash_test.go: freeze a
+known tx sequence and assert the resulting state hashes never drift. Any
+intentional state-machine change must update these pins consciously.
+
+Determinism rests on RFC 6979 signing (chain/crypto.py) — randomized ECDSA
+nonces would scramble tx bytes and thus the data roots."""
+
+import numpy as np
+
+from celestia_app_tpu.chain.node import Node
+from celestia_app_tpu.chain.tx import MsgSend
+from celestia_app_tpu.da.blob import Blob
+from celestia_app_tpu.da.namespace import Namespace
+
+from test_app import make_app
+
+PINS = {
+    "app_hash_h1_send": "9b0ae4899bad72a7542ca519c1b317fb23d0c0efc1d12e294f7189b0d26965a3",
+    "app_hash_h2_pfb": "985e2f3ca5709bf4648c95ea1cb33d8b2c522bac4b80abf72d567a41a05dfbe8",
+    "data_root_h2": "0087ad871fddcdb676ee490c5e12bb1ba82481bcd9a9135f6c52a93f865a39f8",
+    "app_hash_h3_empty": "b2c65dba9fab678d81bf4b5c6e89dc5a85a3855e2bee255285efeaaaa098a7dc",
+    "block_hash_h3": "bbd64a10e6f49d0aedb11465dca9ebe88c55c67d30197b2a3d1f7b8728b1bca4",
+}
+
+
+def test_apphash_regression_pin():
+    app, signer, privs = make_app()
+    node = Node(app)
+    a0 = privs[0].public_key().address()
+    a1 = privs[1].public_key().address()
+    rng = np.random.default_rng(99)
+
+    tx = signer.create_tx(a0, [MsgSend(a0, a1, 12345)], fee=2000, gas_limit=100_000)
+    assert node.broadcast_tx(tx.encode()).code == 0
+    node.produce_block(t=1_700_000_100.0)
+    signer.accounts[a0].sequence += 1
+    assert app.last_app_hash.hex() == PINS["app_hash_h1_send"]
+
+    blobs = [
+        Blob(
+            Namespace.v0(bytes([i + 1]) * 5),
+            rng.integers(0, 256, 777, dtype=np.uint8).tobytes(),
+        )
+        for i in range(2)
+    ]
+    raw = signer.create_pay_for_blobs(a0, blobs, fee=200_000, gas_limit=1_200_000)
+    assert node.broadcast_tx(raw).code == 0
+    blk2, _ = node.produce_block(t=1_700_000_200.0)
+    signer.accounts[a0].sequence += 1
+    assert app.last_app_hash.hex() == PINS["app_hash_h2_pfb"]
+    assert blk2.header.data_hash.hex() == PINS["data_root_h2"]
+
+    blk3, _ = node.produce_block(t=1_700_000_300.0)
+    assert app.last_app_hash.hex() == PINS["app_hash_h3_empty"]
+    assert blk3.header.hash().hex() == PINS["block_hash_h3"]
+
+
+def test_signing_is_deterministic():
+    from celestia_app_tpu.chain.crypto import PrivateKey
+
+    pk = PrivateKey.from_seed(b"\x07")
+    assert pk.sign(b"same message") == pk.sign(b"same message")
+
+
+def test_rfc6979_known_vector():
+    """Community-standard secp256k1 RFC 6979 vector: d=1, M='Satoshi Nakamoto'."""
+    from celestia_app_tpu.chain import crypto
+
+    pk = crypto.PrivateKey(1)
+    sig = pk.sign(b"Satoshi Nakamoto")
+    assert sig[:32].hex() == (
+        "934b1ea10a4b3c1757e2b0c017d0b6143ce3c9a7e6a4a49860d7a6ab210ee3d8"
+    )
