@@ -1,0 +1,49 @@
+"""Build + invoke the native C++ baseline pipeline (native/baseline_pipeline.cc).
+
+Shared by bench.py (baseline measurement) and tests (cross-validation of the
+independent C++ reimplementation against the Python pipelines)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+NATIVE_DIR = os.path.join(REPO, "native")
+BINARY = os.path.join(NATIVE_DIR, "baseline_pipeline")
+
+
+def build(timeout: int = 180) -> bool:
+    """(Re)build via make — the Makefile's dependency tracking means a stale
+    binary is rebuilt whenever the source changed. False if no toolchain."""
+    try:
+        subprocess.run(
+            ["make", "-C", NATIVE_DIR], check=True, capture_output=True,
+            timeout=timeout,
+        )
+        return os.path.exists(BINARY)
+    except Exception:
+        return False
+
+
+def run(ods: np.ndarray, reps: int = 3, timeout: int = 600) -> dict:
+    """Run the pipeline on a (k, k, 512) ODS: {"cpu_ms": ..., "data_root": hex}."""
+    k = ods.shape[0]
+    assert ods.shape == (k, k, 512) and ods.dtype == np.uint8
+    if not build():
+        raise RuntimeError("native baseline toolchain unavailable")
+    with tempfile.NamedTemporaryFile(delete=False, suffix=".ods") as f:
+        f.write(ods.tobytes())
+        path = f.name
+    try:
+        out = subprocess.run(
+            [BINARY, path, str(k), str(reps)],
+            check=True, capture_output=True, text=True, timeout=timeout,
+        )
+    finally:
+        os.unlink(path)
+    return json.loads(out.stdout)
