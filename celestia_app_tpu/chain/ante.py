@@ -24,11 +24,18 @@ from celestia_app_tpu import appconsts
 from celestia_app_tpu.chain import modules
 from celestia_app_tpu.chain.state import Context
 from celestia_app_tpu.chain.tx import (
+    MsgBeginRedelegate,
+    MsgCreateValidator,
+    MsgDelegate,
+    MsgDeposit,
     MsgPayForBlobs,
     MsgRegisterEVMAddress,
     MsgSend,
     MsgSignalVersion,
+    MsgSubmitProposal,
     MsgTryUpgrade,
+    MsgUndelegate,
+    MsgVote,
     Tx,
 )
 from celestia_app_tpu.chain.crypto import PublicKey
@@ -47,6 +54,13 @@ MSG_VERSIONS: dict[str, tuple[int, int]] = {
     MsgRegisterEVMAddress.TYPE: (1, 1),
     MsgSignalVersion.TYPE: (2, 99),
     MsgTryUpgrade.TYPE: (2, 99),
+    MsgDelegate.TYPE: (1, 99),
+    MsgUndelegate.TYPE: (1, 99),
+    MsgBeginRedelegate.TYPE: (1, 99),
+    MsgCreateValidator.TYPE: (1, 99),
+    MsgSubmitProposal.TYPE: (1, 99),
+    MsgDeposit.TYPE: (1, 99),
+    MsgVote.TYPE: (1, 99),
 }
 
 
@@ -146,6 +160,16 @@ class AnteHandler:
                 addrs.add(m.signer)
             elif isinstance(m, MsgRegisterEVMAddress):
                 addrs.add(m.validator)
+            elif isinstance(m, (MsgDelegate, MsgUndelegate, MsgBeginRedelegate)):
+                addrs.add(m.delegator)
+            elif isinstance(m, MsgCreateValidator):
+                addrs.add(m.operator)
+            elif isinstance(m, MsgSubmitProposal):
+                addrs.add(m.proposer)
+            elif isinstance(m, MsgDeposit):
+                addrs.add(m.depositor)
+            elif isinstance(m, MsgVote):
+                addrs.add(m.voter)
         if len(addrs) != 1:
             raise AnteError(f"tx must have exactly one signer, got {len(addrs)}")
         return next(iter(addrs))

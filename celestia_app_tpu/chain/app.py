@@ -27,6 +27,7 @@ import time as time_mod
 from celestia_app_tpu import appconsts
 from celestia_app_tpu.chain import ante as ante_mod
 from celestia_app_tpu.chain import blobstream as blobstream_mod
+from celestia_app_tpu.chain import gov as gov_mod
 from celestia_app_tpu.chain import modules
 from celestia_app_tpu.chain.block import Block, Header, TxResult
 from celestia_app_tpu.chain.blob_validation import (
@@ -42,6 +43,13 @@ from celestia_app_tpu.chain.tx import (
     MsgSignalVersion,
     MsgTryUpgrade,
     Tx,
+    MsgDelegate,
+    MsgUndelegate,
+    MsgBeginRedelegate,
+    MsgCreateValidator,
+    MsgSubmitProposal,
+    MsgDeposit,
+    MsgVote,
 )
 from celestia_app_tpu.da import blob as blob_mod
 from celestia_app_tpu.da import dah as dah_mod
@@ -79,11 +87,63 @@ class App:
         self.bank = modules.BankKeeper()
         self.blob = modules.BlobKeeper()
         self.mint = modules.MintKeeper()
-        self.staking = modules.StakingKeeper()
+        self.staking = modules.StakingKeeper(self.bank)
         self.signal = modules.SignalKeeper(self.staking)
         self.minfee = modules.MinFeeKeeper()
         self.blobstream = blobstream_mod.BlobstreamKeeper(self.staking)
         self.staking.hooks.append(self.blobstream)
+        # gov param routing: every governable param goes through here;
+        # x/paramfilter's blocklist is enforced inside GovKeeper
+        def _require(value, kind, lo, hi):
+            """Setters validate types/ranges: a passed proposal must never be
+            able to write a value that breaks the state machine (the
+            paramfilter guards WHICH params change; this guards to WHAT)."""
+            if kind is int and (type(value) is not int):
+                raise ValueError(f"param value {value!r} must be an integer")
+            if kind is float and not isinstance(value, (int, float)) \
+                    or isinstance(value, bool):
+                raise ValueError(f"param value {value!r} must be numeric")
+            if not (lo <= value <= hi):
+                raise ValueError(f"param value {value!r} out of range [{lo}, {hi}]")
+            return value
+
+        def _blob_param(key, lo, hi):
+            def setter(ctx, value):
+                params = self.blob.params(ctx)
+                params[key] = _require(value, int, lo, hi)
+                self.blob.set_params(ctx, params)
+            return setter
+
+        def _gov_param(key, lo, hi):
+            def setter(ctx, value):
+                params = self.gov.params(ctx)
+                params[key] = _require(value, float, lo, hi)
+                self.gov.set_params(ctx, params)
+            return setter
+
+        param_router = {
+            "blob/gas_per_blob_byte": _blob_param("gas_per_blob_byte", 1, 1 << 20),
+            "blob/gov_max_square_size": _blob_param(
+                "gov_max_square_size", 1, appconsts.MAX_EXTENDED_SQUARE_WIDTH // 2
+            ),
+            "minfee/network_min_gas_price": lambda ctx, v:
+                self.minfee.set_network_min_gas_price(
+                    ctx, _require(v, float, 0.0, 1e12)
+                ),
+            "blobstream/data_commitment_window": lambda ctx, v:
+                self.blobstream.set_data_commitment_window(
+                    ctx, _require(v, int, 100, 10_000)
+                ),
+            "gov/min_deposit": lambda ctx, v: _gov_min_deposit(ctx, v),
+            "gov/voting_period": _gov_param("voting_period", 1.0, 1e9),
+            "gov/max_deposit_period": _gov_param("max_deposit_period", 1.0, 1e9),
+        }
+
+        def _gov_min_deposit(ctx, v):
+            params = self.gov.params(ctx)
+            params["min_deposit"] = _require(v, int, 1, 1 << 62)
+            self.gov.set_params(ctx, params)
+        self.gov = gov_mod.GovKeeper(self.staking, self.bank, param_router)
         self.ante = ante_mod.AnteHandler(
             self.auth, self.bank, self.blob, self.minfee, min_gas_price
         )
@@ -443,11 +503,39 @@ class App:
             if self.app_version != 1:
                 raise ValueError("blobstream disabled after v1")
             self.blobstream.register_evm_address(ctx, msg.validator, msg.evm_address)
+        elif isinstance(msg, MsgDelegate):
+            self.staking.delegate(ctx, msg.validator, msg.delegator, msg.amount)
+        elif isinstance(msg, MsgUndelegate):
+            self.staking.undelegate(ctx, msg.validator, msg.delegator, msg.amount)
+        elif isinstance(msg, MsgBeginRedelegate):
+            self.staking.redelegate(
+                ctx, msg.src_validator, msg.dst_validator, msg.delegator, msg.amount
+            )
+        elif isinstance(msg, MsgCreateValidator):
+            self.staking.create_validator(ctx, msg.operator, msg.self_stake)
+        elif isinstance(msg, MsgSubmitProposal):
+            import json as json_mod
+
+            self.gov.submit_proposal(
+                ctx,
+                msg.proposer,
+                json_mod.loads(msg.changes_json),
+                msg.initial_deposit,
+                msg.title,
+            )
+        elif isinstance(msg, MsgDeposit):
+            self.gov.deposit(ctx, msg.proposal_id, msg.depositor, msg.amount)
+        elif isinstance(msg, MsgVote):
+            self.gov.vote(ctx, msg.proposal_id, msg.voter, msg.option)
         else:
             raise ValueError(f"unroutable message {type(msg).__name__}")
 
     def _end_blocker(self, ctx: Context, height: int) -> None:
-        # blobstream attestations run first, v1 only (x/blobstream/abci.go:29,
+        # staking unbonding queue matures, then gov proposals resolve, then
+        # blobstream attestations (module EndBlocker order app/modules.go)
+        self.staking.end_blocker(ctx)
+        self.gov.end_blocker(ctx)
+        # blobstream attestations, v1 only (x/blobstream/abci.go:29,
         # module version range app/modules.go:171)
         if self.app_version == 1:
             self.blobstream.end_blocker(ctx)

@@ -1,0 +1,289 @@
+"""Governance: param-change proposals with celestia's paramfilter blocklist.
+
+Reference parity:
+  - cosmos-sdk x/gov v1 lifecycle (submit -> deposit period -> voting period
+    -> tally -> execute/reject) with celestia's overrides
+    (app/default_overrides.go:207-227: MinDeposit 10_000 TIA, one-week
+    voting period — scaled here in seconds).
+  - x/paramfilter (x/paramfilter/gov_handler.go:17-60 + the blocked-param
+    list wired at app/app.go:739-773): proposals that touch consensus-
+    critical params are rejected at execution, so "governance" can never
+    flip them without a hard fork.
+
+Tally (gov keeper semantics): validators vote with their full power;
+delegators who vote override their share of their validator's vote.
+Outcomes per the SDK: quorum 1/3 of bonded power must vote, else rejected;
+veto >= 1/3 of votes rejects (deposit burned); yes > 1/2 of non-abstain
+passes.
+
+Param routing: targets are "<module>/<key>" strings applied through the
+keepers (blob params, minfee floor, blobstream window, gov's own params,
+staking params). The BLOCKED set mirrors the reference's forbidden params.
+"""
+
+from __future__ import annotations
+
+import json
+
+from celestia_app_tpu.chain.state import Context
+
+# celestia mainnet-flavored defaults (scaled: periods in seconds)
+DEFAULT_MIN_DEPOSIT = 10_000_000_000  # 10,000 TIA in utia
+DEFAULT_MAX_DEPOSIT_PERIOD = 7 * 24 * 3600.0
+DEFAULT_VOTING_PERIOD = 7 * 24 * 3600.0
+QUORUM = 1 / 3
+THRESHOLD = 1 / 2
+VETO_THRESHOLD = 1 / 3
+
+# x/paramfilter: the reference blocks these from governance
+# (app/app.go:739-773 blockedParams)
+BLOCKED_PARAMS = frozenset(
+    {
+        "bank/send_enabled",
+        "staking/bond_denom",
+        "staking/unbonding_time",
+        "staking/max_validators",
+        "consensus/validator_pubkey_types",
+    }
+)
+
+VOTE_OPTIONS = ("yes", "no", "abstain", "veto")
+
+
+GOV_POOL = b"\x00" * 19 + b"\x04"  # module account escrowing deposits
+
+
+class ParamFilterError(ValueError):
+    """A proposal tried to change a blocked parameter (tx-level rejection,
+    hence ValueError: DeliverTx converts it into a failed TxResult)."""
+
+
+def _put(ctx: Context, key: bytes, obj) -> None:
+    ctx.store.set(key, json.dumps(obj, sort_keys=True, separators=(",", ":")).encode())
+
+
+def _get(ctx: Context, key: bytes):
+    raw = ctx.store.get(key)
+    return None if raw is None else json.loads(raw)
+
+
+class GovKeeper:
+    PROPOSAL = b"gov/prop/"
+    ACTIVE = b"gov/active"
+    VOTE = b"gov/vote/"  # gov/vote/<prop_id 8B BE><voter 20B>
+    NEXT_ID = b"gov/next_proposal_id"
+    PARAMS = b"gov/params"
+
+    def __init__(self, staking, bank, param_router):
+        self.staking = staking
+        self.bank = bank
+        # param_router: dict "<module>/<key>" -> setter(ctx, value)
+        self.param_router = param_router
+
+    # -- params ---------------------------------------------------------
+
+    def params(self, ctx: Context) -> dict:
+        return _get(ctx, self.PARAMS) or {
+            "min_deposit": DEFAULT_MIN_DEPOSIT,
+            "max_deposit_period": DEFAULT_MAX_DEPOSIT_PERIOD,
+            "voting_period": DEFAULT_VOTING_PERIOD,
+        }
+
+    def set_params(self, ctx: Context, params: dict) -> None:
+        _put(ctx, self.PARAMS, params)
+
+    # -- lifecycle -------------------------------------------------------
+
+    @staticmethod
+    def _pid_key(pid: int) -> bytes:
+        if not isinstance(pid, int) or not (0 <= pid < 1 << 63):
+            raise ValueError(f"invalid proposal id {pid!r}")
+        return pid.to_bytes(8, "big")
+
+    def proposal(self, ctx: Context, pid: int):
+        return _get(ctx, self.PROPOSAL + self._pid_key(pid))
+
+    def _set_proposal(self, ctx: Context, p: dict) -> None:
+        _put(ctx, self.PROPOSAL + self._pid_key(p["id"]), p)
+
+    def submit_proposal(
+        self,
+        ctx: Context,
+        proposer: bytes,
+        changes: list[dict],
+        initial_deposit: int,
+        title: str = "",
+    ) -> int:
+        """changes = [{"param": "<module>/<key>", "value": ...}]."""
+        if not isinstance(changes, list) or not changes:
+            raise ValueError("proposal changes must be a non-empty list")
+        for c in changes:
+            if not isinstance(c, dict) or not isinstance(c.get("param"), str) \
+                    or "value" not in c:
+                raise ValueError("each change needs a string 'param' and a 'value'")
+            # paramfilter rejects blocked params up front as well as at
+            # execution (gov_handler.go returns an error either way)
+            if c["param"] in BLOCKED_PARAMS:
+                raise ParamFilterError(f"param {c['param']!r} is not governable")
+            if c["param"] not in self.param_router:
+                raise ValueError(f"unroutable param {c['param']!r}")
+        if initial_deposit < 0:
+            raise ValueError("negative deposit")
+        self.bank.send(ctx, proposer, GOV_POOL, initial_deposit)
+        pid = (_get(ctx, self.NEXT_ID) or 1)
+        _put(ctx, self.NEXT_ID, pid + 1)
+        p = {
+            "id": pid,
+            "proposer": proposer.hex(),
+            "title": title,
+            "changes": changes,
+            "deposit": initial_deposit,
+            "depositors": {proposer.hex(): initial_deposit},
+            "status": "deposit_period",
+            "submit_time": ctx.time_unix,
+            "voting_start": None,
+            "voting_end": None,
+        }
+        if initial_deposit >= self.params(ctx)["min_deposit"]:
+            self._activate_voting(ctx, p)
+        self._set_proposal(ctx, p)
+        active = _get(ctx, self.ACTIVE) or []
+        active.append(pid)
+        _put(ctx, self.ACTIVE, active)
+        ctx.emit_event("gov.submit_proposal", id=pid)
+        return pid
+
+    def _activate_voting(self, ctx: Context, p: dict) -> None:
+        p["status"] = "voting_period"
+        p["voting_start"] = ctx.time_unix
+        p["voting_end"] = ctx.time_unix + self.params(ctx)["voting_period"]
+
+    def deposit(self, ctx: Context, pid: int, depositor: bytes, amount: int) -> None:
+        p = self.proposal(ctx, pid)
+        if p is None or p["status"] != "deposit_period":
+            raise ValueError("proposal not in deposit period")
+        self.bank.send(ctx, depositor, GOV_POOL, amount)
+        p["deposit"] += amount
+        d = p["depositors"]
+        d[depositor.hex()] = d.get(depositor.hex(), 0) + amount
+        if p["deposit"] >= self.params(ctx)["min_deposit"]:
+            self._activate_voting(ctx, p)
+        self._set_proposal(ctx, p)
+
+    def vote(self, ctx: Context, pid: int, voter: bytes, option: str) -> None:
+        if option not in VOTE_OPTIONS:
+            raise ValueError(f"invalid vote option {option!r}")
+        p = self.proposal(ctx, pid)
+        if p is None or p["status"] != "voting_period":
+            raise ValueError("proposal not in voting period")
+        key = self.VOTE + self._pid_key(pid) + voter
+        _put(ctx, key, {"option": option})
+
+    # -- tally -----------------------------------------------------------
+
+    def _votes(self, ctx: Context, pid: int) -> dict[bytes, str]:
+        out = {}
+        prefix = self.VOTE + self._pid_key(pid)
+        for k, raw in ctx.store.iterate_prefix(prefix):
+            out[k[len(prefix) :]] = json.loads(raw)["option"]
+        return out
+
+    def tally(self, ctx: Context, pid: int) -> dict:
+        """SDK keeper/tally.go: delegator votes override their slice of the
+        validator's inherited vote; counts are in token units."""
+        votes = self._votes(ctx, pid)
+        counts = {o: 0.0 for o in VOTE_OPTIONS}
+        total_bonded = 0.0
+        # validator base votes minus shares of delegators who voted directly
+        for op, _power in self.staking.validators(ctx):
+            v = self.staking.validator(ctx, op)
+            total_bonded += v["tokens"]
+            if v["shares"] == 0:
+                continue
+            rate = v["tokens"] / v["shares"]
+            # shares of delegators who voted directly get deducted from the
+            # validator's inherited vote
+            deducted = 0.0
+            for voter, option in votes.items():
+                if voter == op:
+                    continue
+                shares = self.staking.delegation(ctx, op, voter)
+                if shares > 0:
+                    counts[option] += shares * rate
+                    deducted += shares
+            if op in votes:
+                counts[votes[op]] += (v["shares"] - deducted) * rate
+        voted = sum(counts.values())
+        result = {
+            "counts": counts,
+            "voted": voted,
+            "total_bonded": total_bonded,
+        }
+        if total_bonded == 0 or voted / total_bonded < QUORUM:
+            result["outcome"] = "rejected_quorum"
+        elif voted > 0 and counts["veto"] / voted >= VETO_THRESHOLD:
+            result["outcome"] = "rejected_veto"
+        else:
+            non_abstain = voted - counts["abstain"]
+            if non_abstain > 0 and counts["yes"] / non_abstain > THRESHOLD:
+                result["outcome"] = "passed"
+            else:
+                result["outcome"] = "rejected"
+        return result
+
+    # -- execution / end blocker -----------------------------------------
+
+    def _execute(self, ctx: Context, p: dict) -> None:
+        """Apply param changes through the filter (the paramfilter's guarded
+        handler, gov_handler.go:31-41: any blocked param fails the whole
+        proposal atomically)."""
+        for c in p["changes"]:
+            if c["param"] in BLOCKED_PARAMS:
+                raise ParamFilterError(f"param {c['param']!r} is not governable")
+        for c in p["changes"]:
+            self.param_router[c["param"]](ctx, c["value"])
+
+    def end_blocker(self, ctx: Context) -> None:
+        active = _get(ctx, self.ACTIVE) or []
+        still_active = []
+        for pid in active:
+            p = self.proposal(ctx, pid)
+            terminal = False
+            if p["status"] == "deposit_period":
+                if ctx.time_unix > p["submit_time"] + self.params(ctx)["max_deposit_period"]:
+                    p["status"] = "rejected_deposit"  # deposit burned
+                    self.bank.burn(ctx, GOV_POOL, p["deposit"])
+                    self._set_proposal(ctx, p)
+                    terminal = True
+            elif p["status"] == "voting_period":
+                if ctx.time_unix >= p["voting_end"]:
+                    result = self.tally(ctx, p["id"])
+                    outcome = result["outcome"]
+                    if outcome == "passed":
+                        try:
+                            self._execute(ctx, p)
+                            p["status"] = "passed"
+                        except Exception as e:
+                            p["status"] = "failed"
+                            p["failure"] = str(e)
+                    else:
+                        p["status"] = outcome
+                    # deposit: burned on veto, else refunded per depositor
+                    # (the SDK refunds each depositor, keeper/deposit.go)
+                    if outcome == "rejected_veto":
+                        self.bank.burn(ctx, GOV_POOL, p["deposit"])
+                    else:
+                        for addr_hex, amt in p["depositors"].items():
+                            self.bank.send(
+                                ctx, GOV_POOL, bytes.fromhex(addr_hex), amt
+                            )
+                    self._set_proposal(ctx, p)
+                    terminal = True
+                    ctx.emit_event(
+                        "gov.proposal_result", id=p["id"], status=p["status"]
+                    )
+            if not terminal:
+                still_active.append(pid)
+        if still_active != active:
+            _put(ctx, self.ACTIVE, still_active)
+

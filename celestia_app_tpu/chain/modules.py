@@ -1,4 +1,7 @@
-"""State-machine modules: auth, bank, blob, mint, signal, minfee, staking.
+"""State-machine modules: auth, bank, blob, mint, signal, minfee.
+
+Staking lives in chain/staking.py (full delegation/unbonding mechanics);
+governance + paramfilter in chain/gov.py.
 
 Reference parity (SURVEY.md §2.1): x/blob (keeper/keeper.go:43-57, gas model
 payforblob.go:158-179), x/mint time-based inflation (types/constants.go:17-25,
@@ -15,6 +18,7 @@ import json
 
 from celestia_app_tpu import appconsts
 from celestia_app_tpu.chain.state import Context
+from celestia_app_tpu.chain.staking import StakingKeeper  # full mechanics
 from celestia_app_tpu.da import shares as shares_mod
 
 
@@ -202,55 +206,6 @@ class MintKeeper:
         m["previous_block_time"] = ctx.time_unix
         self.set_minter(ctx, m)
         return provision
-
-
-# ---------------------------------------------------------------------------
-# staking (minimal): validator powers, for signal tallying & blobstream
-# ---------------------------------------------------------------------------
-
-
-class StakingKeeper:
-    PREFIX = b"staking/val/"
-
-    def __init__(self):
-        # staking hooks (AfterValidatorCreated / AfterValidatorBeginUnbonding),
-        # registered like app/app.go:271-277 registers blobstream's
-        self.hooks: list = []
-
-    def set_validator(self, ctx: Context, operator: bytes, power: int) -> None:
-        created = _get(ctx, self.PREFIX + operator) is None
-        _put(ctx, self.PREFIX + operator, {"power": power})
-        if created:
-            for h in self.hooks:
-                after = getattr(h, "after_validator_created", None)
-                if after is not None:
-                    after(ctx, operator)
-
-    def begin_unbonding(self, ctx: Context, operator: bytes) -> None:
-        """A validator leaves the active set; hooks record the height so the
-        blobstream EndBlocker emits one valset request (keeper/hooks.go:24-40)."""
-        if _get(ctx, self.PREFIX + operator) is None:
-            raise ValueError("unknown validator")
-        ctx.store.delete(self.PREFIX + operator)
-        for h in self.hooks:
-            after = getattr(h, "after_validator_begin_unbonding", None)
-            if after is not None:
-                after(ctx)
-
-    def validator_power(self, ctx: Context, operator: bytes) -> int:
-        v = _get(ctx, self.PREFIX + operator)
-        return 0 if v is None else v["power"]
-
-    def total_power(self, ctx: Context) -> int:
-        return sum(
-            json.loads(v)["power"] for _, v in ctx.store.iterate_prefix(self.PREFIX)
-        )
-
-    def validators(self, ctx: Context) -> list[tuple[bytes, int]]:
-        out = []
-        for k, v in ctx.store.iterate_prefix(self.PREFIX):
-            out.append((k[len(self.PREFIX) :], json.loads(v)["power"]))
-        return out
 
 
 # ---------------------------------------------------------------------------

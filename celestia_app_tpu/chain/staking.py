@@ -1,0 +1,363 @@
+"""Full staking mechanics: validators, delegations, unbonding, redelegation.
+
+Reference parity: the cosmos-sdk x/staking subset celestia-app wires through
+its versioned module manager (app/app.go:262-277 — including registering the
+blobstream hooks on validator lifecycle events) with celestia's parameters
+(21-day unbonding, utia bond denom, power reduction 1e6). State layout:
+
+  staking/val/<operator>            validator record (tokens, shares, status)
+  staking/del/<operator>/<delegator>  delegation shares
+  staking/ubd/<operator>/<delegator>  unbonding entries [{amount, completion}]
+  staking/red/...                     redelegation entries
+
+Semantics mirrored from the SDK keeper:
+  - delegate: tokens -> shares at the validator's current exchange rate
+    (tokens/delegator_shares); bonded tokens leave the delegator's balance.
+  - undelegate: shares -> tokens enter the unbonding queue; returned to the
+    delegator's balance once ctx.time passes completion (EndBlocker).
+  - redelegate: instant move between validators (no unbonding wait, but
+    tracked so the source validator's power drop fires the blobstream hook).
+  - slash: burns a fraction of tokens (and pro-rata from unbonding entries),
+    jails the validator.
+  - power = bonded_tokens // POWER_REDUCTION; power changes feed
+    x/blobstream's SignificantPowerDiff valset cadence (abci.go:84-136) and
+    x/signal tallies.
+
+The genesis-style `set_validator(operator, power)` entry point is kept for
+fixtures: it creates a validator with self-delegated tokens = power * 1e6.
+"""
+
+from __future__ import annotations
+
+import json
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.chain.state import Context
+
+POWER_REDUCTION = 1_000_000  # utia per unit of consensus power (sdk default)
+UNBONDING_TIME_SECONDS = 21 * 24 * 3600  # celestia mainnet: 21 days
+MAX_ENTRIES = 7  # sdk default: simultaneous unbonding entries per pair
+
+BONDED_POOL = b"\x00" * 19 + b"\x02"  # module account holding bonded tokens
+NOT_BONDED_POOL = b"\x00" * 19 + b"\x03"  # holds unbonding tokens
+
+
+def _put(ctx: Context, key: bytes, obj) -> None:
+    ctx.store.set(key, json.dumps(obj, sort_keys=True, separators=(",", ":")).encode())
+
+
+def _get(ctx: Context, key: bytes):
+    raw = ctx.store.get(key)
+    return None if raw is None else json.loads(raw)
+
+
+class StakingKeeper:
+    VAL = b"staking/val/"
+    DEL = b"staking/del/"
+    UBD = b"staking/ubd/"
+    PARAMS = b"staking/params"
+
+    def __init__(self, bank=None):
+        self.bank = bank  # optional: fixtures without balances skip transfers
+        # hooks (blobstream registers here, app/app.go:271-277)
+        self.hooks: list = []
+
+    # -- params ---------------------------------------------------------
+
+    def params(self, ctx: Context) -> dict:
+        return _get(ctx, self.PARAMS) or {
+            "unbonding_time": UNBONDING_TIME_SECONDS,
+            "bond_denom": appconsts.BOND_DENOM,
+            "max_entries": MAX_ENTRIES,
+        }
+
+    def set_params(self, ctx: Context, params: dict) -> None:
+        _put(ctx, self.PARAMS, params)
+
+    # -- validators -----------------------------------------------------
+
+    def validator(self, ctx: Context, operator: bytes):
+        return _get(ctx, self.VAL + operator)
+
+    def _set_val(self, ctx: Context, operator: bytes, v: dict) -> None:
+        _put(ctx, self.VAL + operator, v)
+
+    def create_validator(
+        self, ctx: Context, operator: bytes, self_stake: int
+    ) -> None:
+        """MsgCreateValidator: operator self-delegates `self_stake` utia."""
+        if self.validator(ctx, operator) is not None:
+            raise ValueError("validator already exists")
+        if self_stake <= 0:
+            raise ValueError("self stake must be positive")
+        self._set_val(
+            ctx,
+            operator,
+            {"tokens": 0, "shares": 0.0, "jailed": False, "bonded": True},
+        )
+        for h in self.hooks:
+            fn = getattr(h, "after_validator_created", None)
+            if fn is not None:
+                fn(ctx, operator)
+        self.delegate(ctx, operator, operator, self_stake)
+
+    def set_validator(self, ctx: Context, operator: bytes, power: int) -> None:
+        """Genesis entry point: validator with `power` units of self-stake.
+
+        The stake is MINTED (genesis staked supply is separate from genesis
+        account balances, as in reference genesis files), not debited from
+        the operator's spendable balance.
+        """
+        if self.validator(ctx, operator) is None:
+            if self.bank is not None:
+                self.bank.mint(ctx, operator, power * POWER_REDUCTION)
+            self.create_validator(ctx, operator, power * POWER_REDUCTION)
+        else:
+            v = self.validator(ctx, operator)
+            new_tokens = power * POWER_REDUCTION
+            delta = new_tokens - v["tokens"]
+            # scale shares with tokens so the exchange rate is preserved,
+            # and keep the bonded pool + supply consistent via mint/burn
+            if v["tokens"] > 0:
+                v["shares"] *= new_tokens / v["tokens"]
+            elif new_tokens > 0:
+                v["shares"] = float(new_tokens)
+            v["tokens"] = new_tokens
+            self._set_val(ctx, operator, v)
+            if self.bank is not None:
+                if delta > 0:
+                    self.bank.mint(ctx, BONDED_POOL, delta)
+                elif delta < 0:
+                    self.bank.burn(ctx, BONDED_POOL, -delta)
+
+    def validator_power(self, ctx: Context, operator: bytes) -> int:
+        v = self.validator(ctx, operator)
+        if v is None or v["jailed"] or not v["bonded"]:
+            return 0
+        return v["tokens"] // POWER_REDUCTION
+
+    def total_power(self, ctx: Context) -> int:
+        return sum(p for _, p in self.validators(ctx))
+
+    def validators(self, ctx: Context) -> list[tuple[bytes, int]]:
+        out = []
+        for k, raw in ctx.store.iterate_prefix(self.VAL):
+            op = k[len(self.VAL) :]
+            p = self.validator_power(ctx, op)
+            if p > 0:
+                out.append((op, p))
+        return out
+
+    # -- delegations ----------------------------------------------------
+
+    def _del_key(self, operator: bytes, delegator: bytes) -> bytes:
+        # addresses are fixed 20-byte strings; no separator (raw bytes may
+        # contain any value, so a delimiter would be ambiguous)
+        return self.DEL + operator + delegator
+
+    def delegation(self, ctx: Context, operator: bytes, delegator: bytes) -> float:
+        return _get(ctx, self._del_key(operator, delegator)) or 0.0
+
+    def delegations_of(self, ctx: Context, delegator: bytes):
+        """[(operator, shares)] for one delegator (gov tally input)."""
+        out = []
+        for k, raw in ctx.store.iterate_prefix(self.DEL):
+            rest = k[len(self.DEL) :]
+            op, dl = rest[:20], rest[20:]
+            if dl == delegator:
+                out.append((op, json.loads(raw)))
+        return out
+
+    def shares_to_tokens(self, v: dict, shares: float) -> int:
+        if v["shares"] == 0:
+            return 0
+        return int(shares * v["tokens"] / v["shares"])
+
+    def delegate(
+        self, ctx: Context, operator: bytes, delegator: bytes, amount: int
+    ) -> None:
+        v = self.validator(ctx, operator)
+        if v is None:
+            raise ValueError("unknown validator")
+        if amount <= 0:
+            raise ValueError("delegation must be positive")
+        if self.bank is not None:
+            self.bank.send(ctx, delegator, BONDED_POOL, amount)
+        # shares at current exchange rate (1:1 when no shares outstanding)
+        new_shares = (
+            float(amount)
+            if v["shares"] == 0
+            else amount * v["shares"] / v["tokens"]
+        )
+        v["tokens"] += amount
+        v["shares"] += new_shares
+        self._set_val(ctx, operator, v)
+        key = self._del_key(operator, delegator)
+        _put(ctx, key, (self.delegation(ctx, operator, delegator)) + new_shares)
+        ctx.emit_event("staking.delegate", validator=operator.hex(), amount=amount)
+
+    def undelegate(
+        self, ctx: Context, operator: bytes, delegator: bytes, amount: int
+    ) -> float:
+        """Begin unbonding `amount` utia; returns completion time."""
+        v = self.validator(ctx, operator)
+        if v is None:
+            raise ValueError("unknown validator")
+        if amount <= 0:
+            raise ValueError("amount must be positive")
+        if v["tokens"] <= 0 or v["shares"] <= 0:
+            raise ValueError("validator has no bonded tokens")
+        shares_held = self.delegation(ctx, operator, delegator)
+        shares_needed = amount * v["shares"] / v["tokens"]
+        if shares_needed > shares_held * (1 + 1e-12):
+            raise ValueError("not enough delegated")
+        ubd_key = self.UBD + operator + delegator
+        entries = _get(ctx, ubd_key) or []
+        if len(entries) >= self.params(ctx)["max_entries"]:
+            raise ValueError("too many unbonding entries")
+        completion = ctx.time_unix + self.params(ctx)["unbonding_time"]
+        entries.append({"amount": amount, "completion": completion})
+        _put(ctx, ubd_key, entries)
+        self._remove_shares(ctx, operator, delegator, shares_needed, amount)
+        if self.bank is not None:
+            self.bank.send(ctx, BONDED_POOL, NOT_BONDED_POOL, amount)
+        for h in self.hooks:
+            fn = getattr(h, "after_validator_begin_unbonding", None)
+            if fn is not None:
+                fn(ctx)
+        ctx.emit_event(
+            "staking.unbond",
+            validator=operator.hex(),
+            amount=amount,
+            completion=completion,
+        )
+        return completion
+
+    def redelegate(
+        self,
+        ctx: Context,
+        src: bytes,
+        dst: bytes,
+        delegator: bytes,
+        amount: int,
+    ) -> None:
+        """Instant move src -> dst (sdk allows without unbonding wait)."""
+        v_src = self.validator(ctx, src)
+        v_dst = self.validator(ctx, dst)
+        if v_src is None or v_dst is None:
+            raise ValueError("unknown validator")
+        if amount <= 0:
+            raise ValueError("amount must be positive")
+        if v_src["tokens"] <= 0 or v_src["shares"] <= 0:
+            raise ValueError("source validator has no bonded tokens")
+        shares_needed = amount * v_src["shares"] / v_src["tokens"]
+        if shares_needed > self.delegation(ctx, src, delegator) * (1 + 1e-12):
+            raise ValueError("not enough delegated")
+        self._remove_shares(ctx, src, delegator, shares_needed, amount)
+        # credit dst at its exchange rate
+        v_dst = self.validator(ctx, dst)
+        new_shares = (
+            float(amount)
+            if v_dst["shares"] == 0
+            else amount * v_dst["shares"] / v_dst["tokens"]
+        )
+        v_dst["tokens"] += amount
+        v_dst["shares"] += new_shares
+        self._set_val(ctx, dst, v_dst)
+        key = self._del_key(dst, delegator)
+        _put(ctx, key, self.delegation(ctx, dst, delegator) + new_shares)
+        # source power dropped: same hook the reference fires on redelegations
+        for h in self.hooks:
+            fn = getattr(h, "after_validator_begin_unbonding", None)
+            if fn is not None:
+                fn(ctx)
+
+    def _remove_shares(
+        self, ctx: Context, operator: bytes, delegator: bytes,
+        shares: float, tokens: int,
+    ) -> None:
+        v = self.validator(ctx, operator)
+        key = self._del_key(operator, delegator)
+        remaining = self.delegation(ctx, operator, delegator) - shares
+        if remaining < 1e-9:
+            ctx.store.delete(key)
+        else:
+            _put(ctx, key, remaining)
+        v["tokens"] -= tokens
+        v["shares"] -= shares
+        if v["shares"] < 1e-9:
+            v["shares"] = 0.0
+            v["tokens"] = max(v["tokens"], 0)
+        self._set_val(ctx, operator, v)
+
+    # -- unbonding queue / slashing -------------------------------------
+
+    def begin_unbonding(self, ctx: Context, operator: bytes) -> None:
+        """Whole-validator exit (legacy fixture API): undelegate everything."""
+        v = self.validator(ctx, operator)
+        if v is None:
+            raise ValueError("unknown validator")
+        ctx.store.delete(self.VAL + operator)
+        for k, _ in list(ctx.store.iterate_prefix(self.DEL + operator)):
+            ctx.store.delete(k)
+        for h in self.hooks:
+            fn = getattr(h, "after_validator_begin_unbonding", None)
+            if fn is not None:
+                fn(ctx)
+
+    def slash(self, ctx: Context, operator: bytes, fraction: float) -> int:
+        """Burn `fraction` of the validator's bonded tokens AND of its
+        pending unbonding entries (the SDK slashes both so undelegating
+        cannot front-run a slash), then jail it."""
+        v = self.validator(ctx, operator)
+        if v is None:
+            raise ValueError("unknown validator")
+        burned = int(v["tokens"] * fraction)
+        v["tokens"] -= burned
+        v["jailed"] = True
+        self._set_val(ctx, operator, v)
+        if self.bank is not None and burned > 0:
+            self.bank.burn(ctx, BONDED_POOL, burned)
+        for k, raw in list(ctx.store.iterate_prefix(self.UBD + operator)):
+            entries = json.loads(raw)
+            for e in entries:
+                cut = int(e["amount"] * fraction)
+                e["amount"] -= cut
+                burned += cut
+                if self.bank is not None and cut > 0:
+                    self.bank.burn(ctx, NOT_BONDED_POOL, cut)
+            _put(ctx, k, entries)
+        for h in self.hooks:
+            fn = getattr(h, "after_validator_begin_unbonding", None)
+            if fn is not None:
+                fn(ctx)
+        ctx.emit_event("staking.slash", validator=operator.hex(), burned=burned)
+        return burned
+
+    def unjail(self, ctx: Context, operator: bytes) -> None:
+        v = self.validator(ctx, operator)
+        if v is None:
+            raise ValueError("unknown validator")
+        v["jailed"] = False
+        self._set_val(ctx, operator, v)
+
+    def end_blocker(self, ctx: Context) -> list[tuple[bytes, int]]:
+        """Mature unbonding entries whose completion time has passed."""
+        released = []
+        for k, raw in list(ctx.store.iterate_prefix(self.UBD)):
+            entries = json.loads(raw)
+            rest = k[len(self.UBD) :]
+            _op, delegator = rest[:20], rest[20:]
+            keep = []
+            for e in entries:
+                if e["completion"] <= ctx.time_unix:
+                    if self.bank is not None:
+                        self.bank.send(ctx, NOT_BONDED_POOL, delegator, e["amount"])
+                    released.append((delegator, e["amount"]))
+                else:
+                    keep.append(e)
+            if keep:
+                _put(ctx, k, keep)
+            else:
+                ctx.store.delete(k)
+        return released

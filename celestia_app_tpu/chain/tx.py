@@ -180,9 +180,144 @@ class MsgRegisterEVMAddress:
         return cls(r.b(), r.b())
 
 
+@dataclasses.dataclass(frozen=True)
+class MsgDelegate:
+    """x/staking MsgDelegate: bond utia to a validator."""
+
+    TYPE = "staking/MsgDelegate"
+    delegator: bytes
+    validator: bytes
+    amount: int
+
+    def encode(self) -> bytes:
+        return _b(self.delegator) + _b(self.validator) + uvarint(self.amount)
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MsgDelegate":
+        r = _Reader(raw)
+        return cls(r.b(), r.b(), r.u())
+
+
+@dataclasses.dataclass(frozen=True)
+class MsgUndelegate:
+    """x/staking MsgUndelegate: begin unbonding (21-day queue)."""
+
+    TYPE = "staking/MsgUndelegate"
+    delegator: bytes
+    validator: bytes
+    amount: int
+
+    def encode(self) -> bytes:
+        return _b(self.delegator) + _b(self.validator) + uvarint(self.amount)
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MsgUndelegate":
+        r = _Reader(raw)
+        return cls(r.b(), r.b(), r.u())
+
+
+@dataclasses.dataclass(frozen=True)
+class MsgBeginRedelegate:
+    """x/staking MsgBeginRedelegate: move stake between validators."""
+
+    TYPE = "staking/MsgBeginRedelegate"
+    delegator: bytes
+    src_validator: bytes
+    dst_validator: bytes
+    amount: int
+
+    def encode(self) -> bytes:
+        return (
+            _b(self.delegator) + _b(self.src_validator)
+            + _b(self.dst_validator) + uvarint(self.amount)
+        )
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MsgBeginRedelegate":
+        r = _Reader(raw)
+        return cls(r.b(), r.b(), r.b(), r.u())
+
+
+@dataclasses.dataclass(frozen=True)
+class MsgCreateValidator:
+    """x/staking MsgCreateValidator (operator key = account key here)."""
+
+    TYPE = "staking/MsgCreateValidator"
+    operator: bytes
+    self_stake: int
+
+    def encode(self) -> bytes:
+        return _b(self.operator) + uvarint(self.self_stake)
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MsgCreateValidator":
+        r = _Reader(raw)
+        return cls(r.b(), r.u())
+
+
+@dataclasses.dataclass(frozen=True)
+class MsgSubmitProposal:
+    """x/gov MsgSubmitProposal carrying param changes (the reference routes
+    param-change content through x/paramfilter's guarded handler)."""
+
+    TYPE = "gov/MsgSubmitProposal"
+    proposer: bytes
+    changes_json: bytes  # canonical JSON [{"param": ..., "value": ...}]
+    initial_deposit: int
+    title: str = ""
+
+    def encode(self) -> bytes:
+        t = self.title.encode()
+        return (
+            _b(self.proposer) + _b(self.changes_json)
+            + uvarint(self.initial_deposit) + _b(t)
+        )
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MsgSubmitProposal":
+        r = _Reader(raw)
+        return cls(r.b(), r.b(), r.u(), r.b().decode())
+
+
+@dataclasses.dataclass(frozen=True)
+class MsgDeposit:
+    TYPE = "gov/MsgDeposit"
+    depositor: bytes
+    proposal_id: int
+    amount: int
+
+    def encode(self) -> bytes:
+        return _b(self.depositor) + uvarint(self.proposal_id) + uvarint(self.amount)
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MsgDeposit":
+        r = _Reader(raw)
+        return cls(r.b(), r.u(), r.u())
+
+
+@dataclasses.dataclass(frozen=True)
+class MsgVote:
+    TYPE = "gov/MsgVote"
+    voter: bytes
+    proposal_id: int
+    option: str  # yes | no | abstain | veto
+
+    def encode(self) -> bytes:
+        return _b(self.voter) + uvarint(self.proposal_id) + _b(self.option.encode())
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MsgVote":
+        r = _Reader(raw)
+        return cls(r.b(), r.u(), r.b().decode())
+
+
 MSG_TYPES = {
     m.TYPE: m
-    for m in (MsgSend, MsgPayForBlobs, MsgSignalVersion, MsgTryUpgrade, MsgRegisterEVMAddress)
+    for m in (
+        MsgSend, MsgPayForBlobs, MsgSignalVersion, MsgTryUpgrade,
+        MsgRegisterEVMAddress, MsgDelegate, MsgUndelegate, MsgBeginRedelegate,
+        MsgCreateValidator, MsgSubmitProposal, MsgDeposit, MsgVote,
+    )
 }
 
 

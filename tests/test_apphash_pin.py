@@ -17,11 +17,11 @@ from celestia_app_tpu.da.namespace import Namespace
 from test_app import make_app
 
 PINS = {
-    "app_hash_h1_send": "9b0ae4899bad72a7542ca519c1b317fb23d0c0efc1d12e294f7189b0d26965a3",
-    "app_hash_h2_pfb": "985e2f3ca5709bf4648c95ea1cb33d8b2c522bac4b80abf72d567a41a05dfbe8",
+    "app_hash_h1_send": "e175c4dac100c49d9227289aa041028f87578a1cb30acf12ded6dce31cca4535",
+    "app_hash_h2_pfb": "a6907d22ee684cc6f794fff2837460d1c8857d1df09ec06ddca2a2103934d9f2",
     "data_root_h2": "0087ad871fddcdb676ee490c5e12bb1ba82481bcd9a9135f6c52a93f865a39f8",
-    "app_hash_h3_empty": "b2c65dba9fab678d81bf4b5c6e89dc5a85a3855e2bee255285efeaaaa098a7dc",
-    "block_hash_h3": "bbd64a10e6f49d0aedb11465dca9ebe88c55c67d30197b2a3d1f7b8728b1bca4",
+    "app_hash_h3_empty": "b49d046915d6cc6e41a6b4d08b2cd8e2c176d886d20dd6727918398a2b429dec",
+    "block_hash_h3": "f9c89e02b0e6f6e9ec595095bb8208ece0732ab604546da43226bf5a57f23d0d",
 }
 
 
