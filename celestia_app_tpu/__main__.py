@@ -1,0 +1,4 @@
+from celestia_app_tpu.cli import main
+import sys
+
+sys.exit(main())

@@ -29,6 +29,8 @@ from celestia_app_tpu.chain import ante as ante_mod
 from celestia_app_tpu.chain import blobstream as blobstream_mod
 from celestia_app_tpu.chain import gov as gov_mod
 from celestia_app_tpu.chain import modules
+from celestia_app_tpu.chain import storage
+from celestia_app_tpu.utils import telemetry
 from celestia_app_tpu.chain.block import Block, Header, TxResult
 from celestia_app_tpu.chain.blob_validation import (
     BlobTxError,
@@ -72,12 +74,16 @@ class App:
         engine: str = "auto",  # "device" | "host" | "auto"
         min_gas_price: float = appconsts.DEFAULT_MIN_GAS_PRICE,
         v2_upgrade_height: int | None = None,
+        data_dir: str | None = None,
     ):
         self.chain_id = chain_id
         self.app_version = app_version
         self.engine = engine
         self.v2_upgrade_height = v2_upgrade_height
         self.store = KVStore()
+        # durable storage: commits + blocks persist under data_dir; a
+        # restarted App resumes at the latest committed height (see load()).
+        self.db = storage.ChainDB(data_dir) if data_dir else None
         self.height = 0
         self.last_app_hash = self.store.app_hash()
         self.last_block_hash = b"\x00" * 32
@@ -147,8 +153,12 @@ class App:
         self.ante = ante_mod.AnteHandler(
             self.auth, self.bank, self.blob, self.minfee, min_gas_price
         )
-        # committed-state snapshots for load_height rollback (app/app.go:592)
+        # committed-state snapshots for load_height rollback (app/app.go:592);
+        # when a ChainDB is attached the window lives on disk instead
         self._history: dict[int, dict] = {}
+        # baseapp checkState: a cache branch over committed state that
+        # accumulates CheckTx effects; reset at every commit
+        self._check_state = None
 
     # ------------------------------------------------------------------
     # pipeline selection
@@ -237,7 +247,13 @@ class App:
     # ------------------------------------------------------------------
 
     def check_tx(self, raw: bytes) -> TxResult:
-        ctx = self._ctx(self.store.branch(), GasMeter(1 << 40), check=True)
+        """Mempool admission against a PERSISTENT check state (baseapp's
+        checkState, reset on every commit): successive txs from one account
+        observe each other's sequence bumps and fee deductions, so a client
+        can queue several txs between blocks (app/check_tx.go semantics)."""
+        if self._check_state is None:
+            self._check_state = self.store.branch()
+        ctx = self._ctx(self._check_state.branch(), GasMeter(1 << 40), check=True)
         threshold = appconsts.subtree_root_threshold(self.app_version)
         try:
             if blob_mod.is_blob_tx(raw):
@@ -250,6 +266,7 @@ class App:
             gas = GasMeter(tx.body.gas_limit)
             ctx.gas_meter = gas
             self.ante.run(ctx, tx)
+            ctx.store.write()  # admitted: later CheckTx sees the state
             return TxResult(0, "", tx.body.gas_limit, gas.consumed, ctx.events)
         except (ante_mod.AnteError, BlobTxError, OutOfGas, ValueError) as e:
             return TxResult(1, str(e), 0, ctx.gas_meter.consumed, [])
@@ -261,6 +278,7 @@ class App:
     def prepare_proposal(
         self, raw_txs: list[bytes], proposer: bytes = b"", t: float | None = None
     ) -> ProposalResult:
+        _t0 = time_mod.perf_counter()
         t = t if t is not None else time_mod.time()
         height = self.height + 1
         threshold = appconsts.subtree_root_threshold(self.app_version)
@@ -350,6 +368,7 @@ class App:
             last_block_hash=self.last_block_hash,
         )
         block = Block(header=header, txs=tuple(square.txs + kept_blob_raws))
+        telemetry.measure_since("prepare_proposal", _t0)
         return ProposalResult(block=block, square=square, dah=d)
 
     # ------------------------------------------------------------------
@@ -359,11 +378,16 @@ class App:
     def process_proposal(self, block: Block) -> bool:
         """True = accept. Any validation failure or internal panic rejects
         (process_proposal.go:29-35 defer/recover)."""
+        _t0 = time_mod.perf_counter()
         try:
             self._process_proposal_inner(block)
+            telemetry.incr("process_proposal.accepted")
             return True
         except Exception:
+            telemetry.incr("process_proposal.rejected")
             return False
+        finally:
+            telemetry.measure_since("process_proposal", _t0)
 
     def _process_proposal_inner(self, block: Block) -> None:
         threshold = appconsts.subtree_root_threshold(self.app_version)
@@ -568,24 +592,76 @@ class App:
     SNAPSHOT_KEEP = 100  # bounded rollback window (reference keeps pruned IAVL versions)
 
     def commit(self, block: Block) -> bytes:
+        t0 = time_mod.perf_counter()
         self.height = block.header.height
         self.last_app_hash = self.store.app_hash()
         self.last_block_hash = block.header.hash()
-        # snapshot full post-commit identity, keyed by height, pruned to a window
-        self._history[self.height] = {
-            "store": self.store.snapshot(),
-            "app_version": self.app_version,
-            "last_app_hash": self.last_app_hash,
-            "last_block_hash": self.last_block_hash,
-        }
-        for h in [h for h in self._history if h <= self.height - self.SNAPSHOT_KEEP]:
-            del self._history[h]
+        meta = self._commit_meta()
+        if self.db is not None:
+            # durable commit: state + block hit disk atomically before the
+            # commit is acknowledged (a killed process resumes here)
+            self.db.save_block(block)  # block first: LATEST implies block exists
+            self.db.save_commit(self.height, self.store.snapshot(), meta)
+        else:
+            self._history[self.height] = {
+                "store": self.store.snapshot(),
+                "app_version": self.app_version,
+                "last_app_hash": self.last_app_hash,
+                "last_block_hash": self.last_block_hash,
+            }
+            for h in [
+                h for h in self._history if h <= self.height - self.SNAPSHOT_KEEP
+            ]:
+                del self._history[h]
+        self._check_state = None  # baseapp resetState on commit
+        telemetry.measure_since("commit", t0)
         return self.last_app_hash
+
+    def _commit_meta(self) -> dict:
+        """The identity document persisted beside every durable commit."""
+        return {
+            "app_version": self.app_version,
+            "last_app_hash": self.last_app_hash.hex(),
+            "last_block_hash": self.last_block_hash.hex(),
+            "chain_id": self.chain_id,
+            "genesis_time": self.genesis_time,
+        }
+
+    def persist_identity(self) -> None:
+        """Re-point the durable LATEST at the current in-memory identity
+        (used by rollback to make a load_height durable)."""
+        if self.db is None:
+            raise ValueError("no data_dir attached")
+        self.db.save_commit(self.height, self.store.snapshot(), self._commit_meta())
+
+    def load(self, height: int | None = None) -> None:
+        """Resume from the durable store (reference LoadLatestVersion /
+        LoadHeight, app/app.go:427-435): restores state, chain identity,
+        and app version from disk."""
+        if self.db is None:
+            raise ValueError("no data_dir attached")
+        try:
+            h, store_data, meta = self.db.load_commit(height)
+        except FileNotFoundError:
+            raise ValueError(
+                f"no committed state for height {height} (missing or pruned)"
+            ) from None
+        self.store.restore(store_data)
+        self.height = h
+        self.app_version = meta["app_version"]
+        self.last_app_hash = bytes.fromhex(meta["last_app_hash"])
+        self.last_block_hash = bytes.fromhex(meta["last_block_hash"])
+        self.chain_id = meta["chain_id"]
+        self.genesis_time = meta["genesis_time"]
+        self._check_state = None  # stale mempool overlay dies with the old timeline
 
     def load_height(self, height: int) -> None:
         """Rollback to a committed height (reference LoadHeight): restores the
         store AND the version/hash identity so re-execution matches the
         original chain."""
+        if self.db is not None:
+            self.load(height)
+            return
         snap = self._history.get(height)
         if snap is None:
             raise ValueError(f"no snapshot for height {height}")
@@ -594,6 +670,7 @@ class App:
         self.app_version = snap["app_version"]
         self.last_app_hash = snap["last_app_hash"]
         self.last_block_hash = snap["last_block_hash"]
+        self._check_state = None
 
     # convenience: one full consensus round in-process
     def produce_block(self, raw_txs: list[bytes], t: float | None = None) -> tuple[Block, list[TxResult]]:

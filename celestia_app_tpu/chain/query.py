@@ -1,0 +1,217 @@
+"""ABCI-style query routing, including the custom proof routes.
+
+Reference parity: app/app.go:393-394 registers the custom query routes
+``custom/txInclusionProof`` and ``custom/shareInclusionProof``
+(pkg/proof/querier.go:20-67), which re-extend the square from the stored
+block's txs and emit a ShareProof. This router does the same against the
+ChainDB block store, using the batched device prover
+(da/proof_device.BlockProver) with per-height caching, plus the standard
+keeper queries (bank balance, auth account, gov proposal, staking
+validator, blob params, signal tally).
+
+All requests/responses are JSON dicts so the HTTP service layer
+(service/server.py) and the CLI can route them verbatim.
+"""
+
+from __future__ import annotations
+
+import base64
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+from celestia_app_tpu.da import dah as dah_mod
+from celestia_app_tpu.da import proof_device
+from celestia_app_tpu.da import square as square_mod
+from celestia_app_tpu.da.blob import is_blob_tx, unmarshal_blob_tx
+from celestia_app_tpu.da.square import PfbEntry
+from celestia_app_tpu.utils import telemetry
+
+
+class QueryError(Exception):
+    pass
+
+
+class QueryRouter:
+    def __init__(self, app):
+        self.app = app
+        self._prover_cache: dict[int, tuple] = {}
+
+    def _ctx(self) -> Context:
+        return Context(
+            self.app.store, InfiniteGasMeter(), self.app.height, 0.0,
+            self.app.chain_id, self.app.app_version,
+        )
+
+    # -- proof plumbing --------------------------------------------------
+
+    def _rebuild_square(self, height: int):
+        """Reconstruct the square from the stored block (querier.go:88-116:
+        proofs are derived from block data, not cached trees)."""
+        if self.app.db is None:
+            raise QueryError("no block store attached (need data_dir)")
+        block = self.app.db.load_block(height)
+        normal, pfbs = [], []
+        for raw in block.txs:
+            if is_blob_tx(raw):
+                btx = unmarshal_blob_tx(raw)
+                pfbs.append(PfbEntry(btx.tx, btx.blobs))
+            else:
+                normal.append(raw)
+        threshold = appconsts.subtree_root_threshold(block.header.app_version)
+        upper = appconsts.square_size_upper_bound(block.header.app_version)
+        square = square_mod.construct(normal, pfbs, upper, threshold)
+        return block, square
+
+    def _prover(self, height: int):
+        if height in self._prover_cache:
+            entry = self._prover_cache[height]
+            # rollback guard: the stored block may have been replaced; the
+            # cache is only valid while its data root still matches disk
+            current = self.app.db.load_block(height)
+            if current.header.data_hash == entry[3]:
+                return entry
+            self._prover_cache.clear()
+        block, square = self._rebuild_square(height)
+        ods = dah_mod.shares_to_ods(square.share_bytes())
+        d, eds_obj, root = dah_mod.new_dah_from_ods(ods)
+        if root != block.header.data_hash:
+            raise QueryError("recomputed data root mismatches stored header")
+        prover = proof_device.BlockProver(eds_obj, d)
+        entry = (block, square, prover, root)
+        self._prover_cache.clear()  # keep at most one height resident
+        self._prover_cache[height] = entry
+        return entry
+
+    # -- routes ----------------------------------------------------------
+
+    def query(self, path: str, data: dict) -> dict:
+        out = self._route(path, data)
+        # count only after routing succeeds: attacker-varied junk paths must
+        # not grow the telemetry registry unboundedly
+        telemetry.incr(f"query.{path.replace('/', '_')}")
+        return out
+
+    def _route(self, path: str, data: dict) -> dict:
+        if path == "custom/txInclusionProof":
+            return self._tx_inclusion(data)
+        if path == "custom/shareInclusionProof":
+            return self._share_inclusion(data)
+        if path == "bank/balance":
+            addr = bytes.fromhex(data["address"])
+            return {"balance": self.app.bank.balance(self._ctx(), addr)}
+        if path == "auth/account":
+            acc = self.app.auth.account(self._ctx(), bytes.fromhex(data["address"]))
+            return {"account": acc}
+        if path == "gov/proposal":
+            return {"proposal": self.app.gov.proposal(self._ctx(), int(data["id"]))}
+        if path == "staking/validators":
+            ctx = self._ctx()
+            return {
+                "validators": [
+                    {"operator": op.hex(), "power": p}
+                    for op, p in self.app.staking.validators(ctx)
+                ]
+            }
+        if path == "blob/params":
+            return {"params": self.app.blob.params(self._ctx())}
+        if path == "minfee/params":
+            return {
+                "network_min_gas_price":
+                    self.app.minfee.network_min_gas_price(self._ctx())
+            }
+        if path == "status":
+            return {
+                "chain_id": self.app.chain_id,
+                "height": self.app.height,
+                "app_version": self.app.app_version,
+                "last_app_hash": self.app.last_app_hash.hex(),
+                "last_block_hash": self.app.last_block_hash.hex(),
+                "telemetry": telemetry.snapshot(),
+            }
+        raise QueryError(f"unknown query path {path!r}")
+
+    def _tx_inclusion(self, data: dict) -> dict:
+        height = int(data["height"])
+        tx_index = int(data["tx_index"])
+        block, square, prover, root = self._prover(height)
+        pf = prover.prove_tx(square, tx_index)
+        return {"proof": _share_proof_json(pf), "data_root": root.hex()}
+
+    def _share_inclusion(self, data: dict) -> dict:
+        height = int(data["height"])
+        start, end = int(data["start"]), int(data["end"])
+        namespace = bytes.fromhex(data["namespace"])
+        block, square, prover, root = self._prover(height)
+        pf = prover.prove_shares(start, end, namespace)
+        return {"proof": _share_proof_json(pf), "data_root": root.hex()}
+
+
+def _share_proof_json(pf) -> dict:
+    """Serialize a ShareProof for transport; verifiable via
+    proof.share_proof_from_json."""
+    return {
+        "data": [base64.b64encode(d).decode() for d in pf.data],
+        "namespace": pf.namespace.hex(),
+        "start_share": pf.start_share,
+        "end_share": pf.end_share,
+        "share_proofs": [
+            {
+                "start": sp.start,
+                "end": sp.end,
+                "total": sp.total,
+                "nodes": [base64.b64encode(n).decode() for n in sp.nodes],
+            }
+            for sp in pf.share_proofs
+        ],
+        "row_proof": {
+            "row_roots": [r.hex() for r in pf.row_proof.row_roots],
+            "proofs": [
+                {
+                    "index": p.index,
+                    "total": p.total,
+                    "leaf_hash": base64.b64encode(p.leaf_hash).decode(),
+                    "aunts": [base64.b64encode(a).decode() for a in p.aunts],
+                }
+                for p in pf.row_proof.proofs
+            ],
+            "start_row": pf.row_proof.start_row,
+            "end_row": pf.row_proof.end_row,
+        },
+    }
+
+
+def share_proof_from_json(doc: dict):
+    """Rebuild a verifiable ShareProof from its JSON transport form."""
+    from celestia_app_tpu.da.proof import RowProof, ShareProof
+    from celestia_app_tpu.utils import merkle_host, nmt_host
+
+    row_proof = RowProof(
+        row_roots=[bytes.fromhex(r) for r in doc["row_proof"]["row_roots"]],
+        proofs=[
+            merkle_host.Proof(
+                index=p["index"],
+                total=p["total"],
+                leaf_hash=base64.b64decode(p["leaf_hash"]),
+                aunts=[base64.b64decode(a) for a in p["aunts"]],
+            )
+            for p in doc["row_proof"]["proofs"]
+        ],
+        start_row=doc["row_proof"]["start_row"],
+        end_row=doc["row_proof"]["end_row"],
+    )
+    return ShareProof(
+        data=[base64.b64decode(d) for d in doc["data"]],
+        share_proofs=[
+            nmt_host.NmtRangeProof(
+                start=sp["start"],
+                end=sp["end"],
+                total=sp["total"],
+                nodes=[base64.b64decode(n) for n in sp["nodes"]],
+            )
+            for sp in doc["share_proofs"]
+        ],
+        namespace=bytes.fromhex(doc["namespace"]),
+        row_proof=row_proof,
+        start_share=doc["start_share"],
+        end_share=doc["end_share"],
+    )

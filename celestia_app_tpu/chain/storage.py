@@ -1,0 +1,153 @@
+"""Disk-backed chain database: committed state + block store.
+
+Reference parity: the durable side of the reference node — cosmos-sdk's
+commit multistore persisted via IAVL/LevelDB plus celestia-core's block
+store (app/app.go:427-435 LoadLatestVersion, default_overrides.go pruning
+windows). The storage model here matches the framework's flat merkleized
+KV: every commit atomically persists the full store (gzip'd canonical JSON,
+hex keys/values) plus the chain identity, pruned to a rollback window, and
+every block (header + txs) is kept so proofs for past heights can be
+re-derived (pkg/proof/querier.go re-extends the square from block data).
+
+Layout under ``data_dir``:
+
+    state/<height:020d>.json.gz   committed store + identity at height
+    blocks/<height:020d>.json.gz  block: header fields + base64 txs
+    LATEST                        latest committed height (atomic rename)
+
+Atomicity: temp-file + os.replace per artifact, LATEST written last — a
+crash mid-commit leaves the previous height intact and the node resumes
+from it (state-sync-style restore is just copying these files).
+"""
+
+from __future__ import annotations
+
+import base64
+import gzip
+import json
+import os
+
+from celestia_app_tpu.chain.block import Block, Header
+
+PRUNE_KEEP = 100  # same rollback window the in-memory history kept
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class ChainDB:
+    def __init__(self, data_dir: str):
+        self.dir = data_dir
+        os.makedirs(os.path.join(data_dir, "state"), exist_ok=True)
+        os.makedirs(os.path.join(data_dir, "blocks"), exist_ok=True)
+
+    # -- commits ---------------------------------------------------------
+
+    def _state_path(self, height: int) -> str:
+        return os.path.join(self.dir, "state", f"{height:020d}.json.gz")
+
+    def save_commit(
+        self, height: int, store_data: dict[bytes, bytes], meta: dict
+    ) -> None:
+        doc = {
+            "height": height,
+            "meta": meta,
+            "store": {k.hex(): v.hex() for k, v in store_data.items()},
+        }
+        blob = gzip.compress(
+            json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+        )
+        _atomic_write(self._state_path(height), blob)
+        _atomic_write(os.path.join(self.dir, "LATEST"), str(height).encode())
+        self._prune(height)
+
+    def latest_height(self) -> int | None:
+        try:
+            with open(os.path.join(self.dir, "LATEST"), "rb") as f:
+                return int(f.read().decode())
+        except FileNotFoundError:
+            return None
+
+    def load_commit(self, height: int | None = None):
+        """-> (height, store_data, meta); latest when height is None."""
+        if height is None:
+            height = self.latest_height()
+            if height is None:
+                raise FileNotFoundError("no committed state on disk")
+        with gzip.open(self._state_path(height), "rb") as f:
+            doc = json.loads(f.read())
+        store = {
+            bytes.fromhex(k): bytes.fromhex(v) for k, v in doc["store"].items()
+        }
+        return doc["height"], store, doc["meta"]
+
+    def _prune(self, latest: int) -> None:
+        state_dir = os.path.join(self.dir, "state")
+        for name in os.listdir(state_dir):
+            if not name.endswith(".json.gz"):
+                continue
+            try:
+                h = int(name.split(".")[0])
+            except ValueError:
+                continue
+            if h <= latest - PRUNE_KEEP:
+                os.unlink(os.path.join(state_dir, name))
+
+    # -- blocks ----------------------------------------------------------
+
+    def _block_path(self, height: int) -> str:
+        return os.path.join(self.dir, "blocks", f"{height:020d}.json.gz")
+
+    def save_block(self, block: Block) -> None:
+        h = block.header
+        doc = {
+            "header": {
+                "chain_id": h.chain_id,
+                "height": h.height,
+                "time_unix": h.time_unix,
+                "data_hash": h.data_hash.hex(),
+                "square_size": h.square_size,
+                "app_hash": h.app_hash.hex(),
+                "proposer": h.proposer.hex(),
+                "app_version": h.app_version,
+                "last_block_hash": h.last_block_hash.hex(),
+            },
+            "txs": [base64.b64encode(t).decode() for t in block.txs],
+        }
+        blob = gzip.compress(
+            json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+        )
+        _atomic_write(self._block_path(h.height), blob)
+
+    def load_block(self, height: int) -> Block:
+        with gzip.open(self._block_path(height), "rb") as f:
+            doc = json.loads(f.read())
+        hd = doc["header"]
+        header = Header(
+            chain_id=hd["chain_id"],
+            height=hd["height"],
+            time_unix=hd["time_unix"],
+            data_hash=bytes.fromhex(hd["data_hash"]),
+            square_size=hd["square_size"],
+            app_hash=bytes.fromhex(hd["app_hash"]),
+            proposer=bytes.fromhex(hd["proposer"]),
+            app_version=hd["app_version"],
+            last_block_hash=bytes.fromhex(hd["last_block_hash"]),
+        )
+        return Block(header=header, txs=[base64.b64decode(t) for t in doc["txs"]])
+
+    def block_heights(self) -> list[int]:
+        out = []
+        for name in os.listdir(os.path.join(self.dir, "blocks")):
+            if name.endswith(".json.gz"):
+                try:
+                    out.append(int(name.split(".")[0]))
+                except ValueError:
+                    pass
+        return sorted(out)

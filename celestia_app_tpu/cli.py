@@ -1,0 +1,268 @@
+"""celestia-appd-style CLI: init, start, status, query, keys, tools.
+
+Reference parity: cmd/celestia-appd/cmd/root.go:53-154 assembles the node
+commands (init/start/query/keys/rollback) plus the tools/ binaries. Here:
+
+    python -m celestia_app_tpu init  --home DIR --chain-id ID \
+        [--account HEXADDR=BALANCE ...] [--validator HEXADDR=POWER ...]
+    python -m celestia_app_tpu start --home DIR [--listen PORT] \
+        [--block-time SECONDS] [--blocks N]
+    python -m celestia_app_tpu status --home DIR
+    python -m celestia_app_tpu query --home DIR PATH [JSON_DATA]
+    python -m celestia_app_tpu keys derive SEED
+    python -m celestia_app_tpu rollback --home DIR HEIGHT
+    python -m celestia_app_tpu blocktime --home DIR [--last N]
+    python -m celestia_app_tpu blockscan --home DIR
+    python -m celestia_app_tpu txsim --home DIR [--rounds N ...]
+
+`start` runs the single-process node loop (chain/node.py) with the HTTP
+service attached; state persists under --home/data and survives restarts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _make_app(home: str):
+    from celestia_app_tpu.chain.app import App
+
+    cfg_path = os.path.join(home, "config.json")
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    app = App(
+        chain_id=cfg["chain_id"],
+        app_version=cfg.get("app_version", 1),
+        engine=cfg.get("engine", "auto"),
+        data_dir=os.path.join(home, "data"),
+    )
+    latest = app.db.latest_height()
+    if latest is None:
+        with open(os.path.join(home, "genesis.json")) as f:
+            genesis = json.load(f)
+        app.init_chain(genesis)
+    else:
+        app.load()
+    return app, cfg
+
+
+def cmd_init(args) -> int:
+    os.makedirs(args.home, exist_ok=True)
+    accounts = []
+    for spec in args.account or []:
+        addr, bal = spec.split("=")
+        accounts.append({"address": addr, "balance": int(bal)})
+    validators = []
+    for spec in args.validator or []:
+        addr, power = spec.split("=")
+        validators.append({"operator": addr, "power": int(power)})
+    genesis = {
+        "time_unix": time.time(),
+        "accounts": accounts,
+        "validators": validators,
+    }
+    with open(os.path.join(args.home, "genesis.json"), "w") as f:
+        json.dump(genesis, f, indent=2)
+    with open(os.path.join(args.home, "config.json"), "w") as f:
+        json.dump(
+            {"chain_id": args.chain_id, "app_version": 1, "engine": args.engine},
+            f, indent=2,
+        )
+    print(f"initialized {args.home} (chain-id {args.chain_id})")
+    return 0
+
+
+def cmd_start(args) -> int:
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.service.server import NodeService
+
+    app, cfg = _make_app(args.home)
+    node = Node(app)
+    svc = NodeService(node, port=args.listen)
+    svc.serve_background()
+    print(
+        f"node started: chain {app.chain_id} at height {app.height}, "
+        f"http on 127.0.0.1:{svc.port}, block time {args.block_time}s",
+        file=sys.stderr,
+    )
+    produced = 0
+    try:
+        while args.blocks is None or produced < args.blocks:
+            time.sleep(args.block_time)
+            with svc.lock:
+                blk, results = node.produce_block()
+            produced += 1
+            print(
+                f"height {blk.header.height}: {len(blk.txs)} txs, "
+                f"square {blk.header.square_size}, "
+                f"data root {blk.header.data_hash.hex()[:16]}",
+                file=sys.stderr,
+            )
+    except KeyboardInterrupt:
+        pass
+    finally:
+        svc.shutdown()
+    return 0
+
+
+def cmd_status(args) -> int:
+    from celestia_app_tpu.chain.query import QueryRouter
+
+    app, _ = _make_app(args.home)
+    print(json.dumps(QueryRouter_for(app).query("status", {}), indent=2))
+    return 0
+
+
+def QueryRouter_for(app):
+    from celestia_app_tpu.chain.query import QueryRouter
+
+    return QueryRouter(app)
+
+
+def cmd_query(args) -> int:
+    app, _ = _make_app(args.home)
+    data = json.loads(args.data) if args.data else {}
+    out = QueryRouter_for(app).query(args.path, data)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_keys(args) -> int:
+    from celestia_app_tpu.chain.crypto import PrivateKey
+
+    pk = PrivateKey.from_seed(args.seed.encode())
+    pub = pk.public_key()
+    print(json.dumps({
+        "address": pub.address().hex(),
+        "pubkey": pub.compressed.hex(),
+    }, indent=2))
+    return 0
+
+
+def cmd_rollback(args) -> int:
+    app, _ = _make_app(args.home)
+    app.load_height(args.height)
+    app.persist_identity()  # point LATEST back so starts resume here
+    print(f"rolled back to height {app.height}")
+    return 0
+
+
+def cmd_blocktime(args) -> int:
+    from celestia_app_tpu.tools import blocktime
+
+    print(json.dumps(blocktime.report(os.path.join(args.home, "data"), args.last), indent=2))
+    return 0
+
+
+def cmd_blockscan(args) -> int:
+    from celestia_app_tpu.tools import blockscan
+
+    for row in blockscan.scan(os.path.join(args.home, "data")):
+        print(json.dumps(row))
+    return 0
+
+
+def cmd_txsim(args) -> int:
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.client.tx_client import Signer
+    from celestia_app_tpu.tools import txsim
+
+    app, cfg = _make_app(args.home)
+    node = Node(app)
+    signer = Signer(app.chain_id)
+    accounts = []
+    for i in range(args.accounts):
+        # seeds are the decimal strings "0", "1", ... so `keys derive 0`
+        # prints the matching address for genesis funding
+        pk = PrivateKey.from_seed(str(i).encode())
+        addr = pk.public_key().address()
+        from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+
+        ctx = Context(app.store, InfiniteGasMeter(), app.height, 0,
+                      app.chain_id, app.app_version)
+        acc = app.auth.account(ctx, addr)
+        number = acc["number"] if acc else i
+        sequence = acc["sequence"] if acc else 0
+        signer.add_account(pk, number, sequence)
+        accounts.append(addr)
+    rep = txsim.run(
+        node, signer, accounts,
+        rounds=args.rounds,
+        blob_sequences=args.blob_sequences,
+        send_sequences=args.send_sequences,
+        blob_sizes=tuple(int(x) for x in args.blob_sizes.split("-")),
+        blobs_per_pfb=tuple(int(x) for x in args.blobs_per_pfb.split("-")),
+    )
+    print(json.dumps(rep.as_dict(), indent=2))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="celestia_app_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("init")
+    p.add_argument("--home", required=True)
+    p.add_argument("--chain-id", default="celestia-tpu-1")
+    p.add_argument("--engine", default="auto")
+    p.add_argument("--account", action="append")
+    p.add_argument("--validator", action="append")
+    p.set_defaults(fn=cmd_init)
+
+    p = sub.add_parser("start")
+    p.add_argument("--home", required=True)
+    p.add_argument("--listen", type=int, default=26658)
+    p.add_argument("--block-time", type=float, default=6.0)
+    p.add_argument("--blocks", type=int, default=None)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("status")
+    p.add_argument("--home", required=True)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("query")
+    p.add_argument("--home", required=True)
+    p.add_argument("path")
+    p.add_argument("data", nargs="?")
+    p.set_defaults(fn=cmd_query)
+
+    p = sub.add_parser("keys")
+    p.add_argument("action", choices=["derive"])
+    p.add_argument("seed")
+    p.set_defaults(fn=cmd_keys)
+
+    p = sub.add_parser("rollback")
+    p.add_argument("--home", required=True)
+    p.add_argument("height", type=int)
+    p.set_defaults(fn=cmd_rollback)
+
+    p = sub.add_parser("blocktime")
+    p.add_argument("--home", required=True)
+    p.add_argument("--last", type=int, default=None)
+    p.set_defaults(fn=cmd_blocktime)
+
+    p = sub.add_parser("blockscan")
+    p.add_argument("--home", required=True)
+    p.set_defaults(fn=cmd_blockscan)
+
+    p = sub.add_parser("txsim")
+    p.add_argument("--home", required=True)
+    p.add_argument("--rounds", type=int, default=5)
+    p.add_argument("--accounts", type=int, default=3)
+    p.add_argument("--blob-sequences", type=int, default=2)
+    p.add_argument("--send-sequences", type=int, default=1)
+    p.add_argument("--blob-sizes", default="100-2000")
+    p.add_argument("--blobs-per-pfb", default="1-3")
+    p.set_defaults(fn=cmd_txsim)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

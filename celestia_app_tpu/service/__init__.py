@@ -1,0 +1,1 @@
+"""Out-of-process service plane (HTTP JSON API over the node)."""

@@ -1,0 +1,119 @@
+"""HTTP JSON service over an in-process node: the out-of-process boundary.
+
+Reference parity: the reference node exposes gRPC + RPC endpoints (tx
+broadcast, ABCI queries incl. the custom proof routes at app/app.go:393-394,
+block fetch). grpcio is not available in this environment, so the service
+speaks JSON over HTTP/1.1 via the stdlib ThreadingHTTPServer — same routes,
+same payloads as chain/query.py. A Go (or any-language) host process can
+drive ExtendAndCommit/ProveShares through these endpoints, which is the
+SURVEY §7.1.7 shim boundary.
+
+Endpoints:
+  GET  /status                         chain identity + telemetry
+  GET  /block/<height>                 stored block (header + b64 txs)
+  POST /broadcast_tx   {"tx": b64}     CheckTx + mempool admission
+  POST /produce_block  {"time": t?}    devnet convenience: one round
+  POST /abci_query     {"path": ..., "data": {...}}
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from celestia_app_tpu.chain.query import QueryError, QueryRouter
+
+
+class NodeService:
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 26658):
+        self.node = node
+        self.router = QueryRouter(node.app)
+        self.lock = threading.Lock()  # node state is single-writer
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _send(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    if self.path == "/status":
+                        with service.lock:
+                            self._send(200, service.router.query("status", {}))
+                    elif self.path.startswith("/block/"):
+                        height = int(self.path.split("/")[2])
+                        blk = service.node.app.db.load_block(height)
+                        self._send(200, {
+                            "height": blk.header.height,
+                            "data_hash": blk.header.data_hash.hex(),
+                            "square_size": blk.header.square_size,
+                            "app_hash": blk.header.app_hash.hex(),
+                            "time_unix": blk.header.time_unix,
+                            "txs": [base64.b64encode(t).decode() for t in blk.txs],
+                        })
+                    else:
+                        self._send(404, {"error": f"no route {self.path}"})
+                except Exception as e:
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    if self.path == "/broadcast_tx":
+                        raw = base64.b64decode(payload["tx"])
+                        with service.lock:
+                            res = service.node.broadcast_tx(raw)
+                        self._send(200, {
+                            "code": res.code, "log": res.log,
+                            "gas_wanted": res.gas_wanted,
+                            "gas_used": res.gas_used,
+                        })
+                    elif self.path == "/produce_block":
+                        with service.lock:
+                            blk, results = service.node.produce_block(
+                                t=payload.get("time")
+                            )
+                        self._send(200, {
+                            "height": blk.header.height,
+                            "data_hash": blk.header.data_hash.hex(),
+                            "app_hash": service.node.app.last_app_hash.hex(),
+                            "n_txs": len(blk.txs),
+                            "results": [
+                                {"code": r.code, "log": r.log} for r in results
+                            ],
+                        })
+                    elif self.path == "/abci_query":
+                        with service.lock:
+                            out = service.router.query(
+                                payload["path"], payload.get("data", {})
+                            )
+                        self._send(200, out)
+                    else:
+                        self._send(404, {"error": f"no route {self.path}"})
+                except QueryError as e:
+                    self._send(400, {"error": str(e)})
+                except Exception as e:
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+
+    def serve_background(self) -> threading.Thread:
+        th = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        th.start()
+        return th
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

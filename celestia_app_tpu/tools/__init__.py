@@ -1,0 +1,1 @@
+"""Operator tooling: txsim load generator, blocktime, blockscan."""

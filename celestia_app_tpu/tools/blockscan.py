@@ -1,0 +1,32 @@
+"""blockscan: summarize stored blocks (tools/blockscan analog)."""
+
+from __future__ import annotations
+
+from celestia_app_tpu.chain.storage import ChainDB
+from celestia_app_tpu.da.blob import is_blob_tx, unmarshal_blob_tx
+
+
+def scan(data_dir: str, from_height: int | None = None, to_height: int | None = None):
+    db = ChainDB(data_dir)
+    for h in db.block_heights():
+        if from_height is not None and h < from_height:
+            continue
+        if to_height is not None and h > to_height:
+            continue
+        blk = db.load_block(h)
+        n_blob_txs = sum(1 for t in blk.txs if is_blob_tx(t))
+        blob_bytes = sum(
+            len(b.data)
+            for t in blk.txs
+            if is_blob_tx(t)
+            for b in unmarshal_blob_tx(t).blobs
+        )
+        yield {
+            "height": h,
+            "time_unix": blk.header.time_unix,
+            "square_size": blk.header.square_size,
+            "txs": len(blk.txs),
+            "blob_txs": n_blob_txs,
+            "blob_bytes": blob_bytes,
+            "data_hash": blk.header.data_hash.hex(),
+        }

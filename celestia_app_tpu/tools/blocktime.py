@@ -1,0 +1,25 @@
+"""blocktime: block interval statistics from a chain data dir
+(tools/blocktime/main.go analog: average time between consecutive blocks)."""
+
+from __future__ import annotations
+
+from celestia_app_tpu.chain.storage import ChainDB
+
+
+def report(data_dir: str, last_n: int | None = None) -> dict:
+    db = ChainDB(data_dir)
+    heights = db.block_heights()
+    if last_n:
+        heights = heights[-last_n - 1 :]
+    if len(heights) < 2:
+        return {"blocks": len(heights), "avg_interval_s": None}
+    times = [db.load_block(h).header.time_unix for h in heights]
+    deltas = [b - a for a, b in zip(times, times[1:])]
+    return {
+        "blocks": len(heights),
+        "from_height": heights[0],
+        "to_height": heights[-1],
+        "avg_interval_s": sum(deltas) / len(deltas),
+        "min_interval_s": min(deltas),
+        "max_interval_s": max(deltas),
+    }

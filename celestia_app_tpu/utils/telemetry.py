@@ -1,0 +1,58 @@
+"""Minimal telemetry registry: counters + timing histograms.
+
+Reference parity: the reference instruments its hot paths with
+``telemetry.MeasureSince`` (app/prepare_proposal.go:23,
+app/process_proposal.go:25) and go-metrics counters. This registry is
+process-local and lock-free (CPython dict ops are atomic enough for the
+single-threaded node loop; the HTTP service reads a snapshot copy).
+
+Usage:
+    t0 = time.perf_counter()
+    ...
+    telemetry.measure_since("prepare_proposal", t0)
+    telemetry.incr("process_proposal.rejected")
+Snapshot via telemetry.snapshot() — surfaced in /status and the CLI.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Registry:
+    def __init__(self):
+        self.counters: dict[str, int] = {}
+        self.timers: dict[str, dict] = {}
+
+    def incr(self, name: str, by: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + by
+
+    def measure_since(self, name: str, t0: float) -> float:
+        dt = time.perf_counter() - t0
+        t = self.timers.setdefault(
+            name, {"count": 0, "total_s": 0.0, "max_s": 0.0, "last_s": 0.0}
+        )
+        t["count"] += 1
+        t["total_s"] += dt
+        t["max_s"] = max(t["max_s"], dt)
+        t["last_s"] = dt
+        return dt
+
+    def snapshot(self) -> dict:
+        out = {"counters": dict(self.counters), "timers": {}}
+        for name, t in self.timers.items():
+            avg = t["total_s"] / t["count"] if t["count"] else 0.0
+            out["timers"][name] = {**t, "avg_s": avg}
+        return out
+
+    def reset(self) -> None:
+        self.counters.clear()
+        self.timers.clear()
+
+
+_global = Registry()
+
+incr = _global.incr
+measure_since = _global.measure_since
+snapshot = _global.snapshot
+reset = _global.reset
