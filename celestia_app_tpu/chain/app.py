@@ -159,6 +159,9 @@ class App:
         # baseapp checkState: a cache branch over committed state that
         # accumulates CheckTx effects; reset at every commit
         self._check_state = None
+        # bumped on every rollback/resume so read-side caches (QueryRouter)
+        # can invalidate without re-reading disk
+        self.state_generation = 0
 
     # ------------------------------------------------------------------
     # pipeline selection
@@ -628,11 +631,13 @@ class App:
         }
 
     def persist_identity(self) -> None:
-        """Re-point the durable LATEST at the current in-memory identity
-        (used by rollback to make a load_height durable)."""
+        """Re-point the durable LATEST at the current in-memory identity and
+        discard the abandoned fork above it (rollback semantics: the
+        reference deletes store versions above the target)."""
         if self.db is None:
             raise ValueError("no data_dir attached")
         self.db.save_commit(self.height, self.store.snapshot(), self._commit_meta())
+        self.db.delete_above(self.height)
 
     def load(self, height: int | None = None) -> None:
         """Resume from the durable store (reference LoadLatestVersion /
@@ -654,6 +659,7 @@ class App:
         self.chain_id = meta["chain_id"]
         self.genesis_time = meta["genesis_time"]
         self._check_state = None  # stale mempool overlay dies with the old timeline
+        self.state_generation += 1
 
     def load_height(self, height: int) -> None:
         """Rollback to a committed height (reference LoadHeight): restores the
@@ -671,6 +677,7 @@ class App:
         self.last_app_hash = snap["last_app_hash"]
         self.last_block_hash = snap["last_block_hash"]
         self._check_state = None
+        self.state_generation += 1
 
     # convenience: one full consensus round in-process
     def produce_block(self, raw_txs: list[bytes], t: float | None = None) -> tuple[Block, list[TxResult]]:

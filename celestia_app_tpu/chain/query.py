@@ -35,6 +35,7 @@ class QueryRouter:
     def __init__(self, app):
         self.app = app
         self._prover_cache: dict[int, tuple] = {}
+        self._cache_generation = getattr(app, "state_generation", 0)
 
     def _ctx(self) -> Context:
         return Context(
@@ -63,14 +64,14 @@ class QueryRouter:
         return block, square
 
     def _prover(self, height: int):
-        if height in self._prover_cache:
-            entry = self._prover_cache[height]
-            # rollback guard: the stored block may have been replaced; the
-            # cache is only valid while its data root still matches disk
-            current = self.app.db.load_block(height)
-            if current.header.data_hash == entry[3]:
-                return entry
+        # rollback guard: any load()/load_height() bumps the app's state
+        # generation; cached provers from before then may describe a
+        # replaced block
+        if getattr(self.app, "state_generation", 0) != self._cache_generation:
             self._prover_cache.clear()
+            self._cache_generation = self.app.state_generation
+        if height in self._prover_cache:
+            return self._prover_cache[height]
         block, square = self._rebuild_square(height)
         ods = dah_mod.shares_to_ods(square.share_bytes())
         d, eds_obj, root = dah_mod.new_dah_from_ods(ods)

@@ -39,6 +39,14 @@ def _atomic_write(path: str, data: bytes) -> None:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    # fsync the directory so the rename itself is durable: without this a
+    # power loss can persist a later artifact (LATEST) while losing an
+    # earlier rename, breaking the write-ordering guarantee
+    dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
 
 
 class ChainDB:
@@ -86,6 +94,21 @@ class ChainDB:
             bytes.fromhex(k): bytes.fromhex(v) for k, v in doc["store"].items()
         }
         return doc["height"], store, doc["meta"]
+
+    def delete_above(self, height: int) -> None:
+        """Remove commits and blocks above `height` (rollback discards the
+        abandoned fork, like the reference's rollback deleting versions)."""
+        for sub in ("state", "blocks"):
+            d = os.path.join(self.dir, sub)
+            for name in os.listdir(d):
+                if not name.endswith(".json.gz"):
+                    continue
+                try:
+                    h = int(name.split(".")[0])
+                except ValueError:
+                    continue
+                if h > height:
+                    os.unlink(os.path.join(d, name))
 
     def _prune(self, latest: int) -> None:
         state_dir = os.path.join(self.dir, "state")

@@ -113,21 +113,16 @@ def cmd_status(args) -> int:
     from celestia_app_tpu.chain.query import QueryRouter
 
     app, _ = _make_app(args.home)
-    print(json.dumps(QueryRouter_for(app).query("status", {}), indent=2))
+    print(json.dumps(QueryRouter(app).query("status", {}), indent=2))
     return 0
 
 
-def QueryRouter_for(app):
+def cmd_query(args) -> int:
     from celestia_app_tpu.chain.query import QueryRouter
 
-    return QueryRouter(app)
-
-
-def cmd_query(args) -> int:
     app, _ = _make_app(args.home)
     data = json.loads(args.data) if args.data else {}
-    out = QueryRouter_for(app).query(args.path, data)
-    print(json.dumps(out, indent=2))
+    print(json.dumps(QueryRouter(app).query(args.path, data), indent=2))
     return 0
 
 

@@ -322,3 +322,233 @@ def decode_matrix(k: int, present: tuple[int, ...]) -> np.ndarray:
         raise ValueError(f"need exactly {k} present positions")
     sub = generator_matrix(k)[list(present)]
     return _gf_invert(sub)
+
+# ---------------------------------------------------------------------------
+# GF(2^16): squares wider than 128 (>256 shards/row need the 16-bit code,
+# as klauspost's WithLeopardGF picks FF16 beyond 256 total shards).
+# Shards are interpreted as little-endian uint16 symbols (256 per share).
+# ---------------------------------------------------------------------------
+
+K_BITS16 = 16
+ORDER16 = 1 << K_BITS16
+MODULUS16 = ORDER16 - 1
+POLY16 = 0x1002D
+
+# Cantor basis over GF(2^16)/0x1002D, derived from the defining recurrence
+# beta_0 = 1, beta_{i+1}^2 + beta_{i+1} = beta_i, choosing the even root at
+# each step — the same construction that exactly reproduces the verified
+# 8-bit basis above (tests re-derive and cross-check it). No reference pins
+# exist for >128 squares, so the selection rule is the documented convention.
+CANTOR_BASIS16 = (
+    0x0001, 0xACCA, 0x3C0E, 0x163E, 0xC582, 0xED2E, 0x914C, 0x4012,
+    0x6C98, 0x10D8, 0x6A72, 0xB900, 0xFDB8, 0xFB34, 0xFF38, 0x991E,
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _tables16() -> tuple[np.ndarray, np.ndarray]:
+    """(LOG, EXP) on 16-bit labels, same construction as _tables()."""
+    lfsr_log = np.zeros(ORDER16, dtype=np.int64)
+    state = 1
+    for i in range(MODULUS16):
+        lfsr_log[state] = i
+        state <<= 1
+        if state & ORDER16:
+            state ^= POLY16
+    lfsr_log[0] = MODULUS16
+
+    cantor = np.zeros(ORDER16, dtype=np.int64)
+    for b in range(K_BITS16):
+        w = 1 << b
+        cantor[w : 2 * w] = cantor[:w] ^ CANTOR_BASIS16[b]
+
+    log = lfsr_log[cantor]
+    exp = np.zeros(ORDER16, dtype=np.int64)
+    exp[log] = np.arange(ORDER16)
+    return log, exp
+
+
+def mul16(a: int, b: int) -> int:
+    if a == 0 or b == 0:
+        return 0
+    log, exp = _tables16()
+    return int(exp[(int(log[a]) + int(log[b])) % MODULUS16])
+
+
+def inv16(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("GF(2^16) inverse of 0")
+    log, exp = _tables16()
+    return int(exp[(MODULUS16 - int(log[a])) % MODULUS16])
+
+
+def mul_vec16(w: int, x: np.ndarray) -> np.ndarray:
+    if w == 0:
+        return np.zeros_like(x)
+    log, exp = _tables16()
+    out = exp[(int(log[w]) + log[x.astype(np.int64)]) % MODULUS16]
+    return np.where(x == 0, 0, out).astype(np.uint16)
+
+
+@functools.lru_cache(maxsize=None)
+def _skew_basis16() -> np.ndarray:
+    """S[d, b] = ŝ_d(label 2^b), b >= d, over the 16-bit field.
+
+    s_d evaluated via its linearized form (s_d(x) = XOR over set bits of the
+    precomputed s_d(2^c) for c < d plus the product definition at basis
+    points) — the direct product over U_d is infeasible at 2^15 elements, so
+    s_{d+1}(x) = s_d(x) ·gf s_d(x ^ beta_d) is used (the standard subspace
+    polynomial recursion: U_{d+1} = U_d ∪ (beta_d ⊕ U_d))."""
+    s = np.zeros((K_BITS16, K_BITS16), dtype=np.int64)
+    # s_d evaluated at all basis points 2^b via the recursion; track
+    # s_d(2^b) and s_d(2^b ^ 2^d) style values lazily with a dict cache
+    cache: dict[tuple[int, int], int] = {}
+
+    def s_d_at(d: int, x: int) -> int:
+        if d == 0:
+            return x
+        key = (d, x)
+        if key not in cache:
+            cache[key] = mul16(s_d_at(d - 1, x), s_d_at(d - 1, x ^ (1 << (d - 1))))
+        return cache[key]
+
+    for d in range(K_BITS16):
+        norm = inv16(s_d_at(d, 1 << d))
+        for b in range(d, K_BITS16):
+            s[d, b] = mul16(s_d_at(d, 1 << b), norm)
+    return s
+
+
+def skew16(d: int, gamma: int) -> int:
+    s = _skew_basis16()
+    acc = 0
+    b = d
+    g = gamma >> d
+    while g:
+        if g & 1:
+            acc ^= int(s[d, b])
+        g >>= 1
+        b += 1
+    return acc
+
+
+def fft16(buf: np.ndarray, offset: int) -> np.ndarray:
+    """(n, ...) uint16 stacks; mirrors fft() over the 16-bit field."""
+    n = buf.shape[0]
+    out = buf.copy()
+    d = n.bit_length() - 2
+    while d >= 0:
+        half = 1 << d
+        for j in range(0, n, 2 * half):
+            w = skew16(d, offset + j)
+            x = out[j : j + half]
+            y = out[j + half : j + 2 * half]
+            if w:
+                x ^= mul_vec16(w, y)
+            y ^= x
+        d -= 1
+    return out
+
+
+def ifft16(buf: np.ndarray, offset: int) -> np.ndarray:
+    n = buf.shape[0]
+    out = buf.copy()
+    for d in range(n.bit_length() - 1):
+        half = 1 << d
+        for j in range(0, n, 2 * half):
+            w = skew16(d, offset + j)
+            x = out[j : j + half]
+            y = out[j + half : j + 2 * half]
+            y ^= x
+            if w:
+                x ^= mul_vec16(w, y)
+    return out
+
+
+def encode16(data: np.ndarray) -> np.ndarray:
+    """(k, ...) uint16 data shards -> (k, ...) recovery shards."""
+    k = data.shape[0]
+    if k & (k - 1) or not (1 <= k <= ORDER16 // 2):
+        raise ValueError(f"k must be a power of two in [1, {ORDER16 // 2}], got {k}")
+    if k == 1:
+        return data.copy()
+    coeffs = ifft16(np.ascontiguousarray(data, dtype=np.uint16), k)
+    return fft16(coeffs, 0)
+
+
+@functools.lru_cache(maxsize=None)
+def encode_matrix16(k: int) -> np.ndarray:
+    """(k, k) uint16 E16 with recovery = E16 ·gf data (16-bit label space)."""
+    eye = np.eye(k, dtype=np.uint16)
+    return encode16(eye)
+
+
+def matmul16(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """16-bit label-space matrix product (host reference for tests/repair)."""
+    assert a.ndim == 2 and b.ndim >= 2 and a.shape[1] == b.shape[0]
+    out = np.zeros((a.shape[0],) + b.shape[1:], dtype=np.uint16)
+    for i in range(a.shape[0]):
+        acc = np.zeros(b.shape[1:], dtype=np.uint16)
+        for j in range(a.shape[1]):
+            if a[i, j]:
+                acc ^= mul_vec16(int(a[i, j]), b[j])
+        out[i] = acc
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def bit_matrix16(k: int) -> np.ndarray:
+    """(16k, 16k) 0/1 int8 GF(2) expansion of encode_matrix16(k).
+
+    B[16j+i, 16l+b] = bit i of mul16(E16[j,l], 1<<b); with shares unpacked
+    as little-endian uint16 symbols this drops into the same MXU bit-matmul
+    as the 8-bit code (ops/rs.py picks the matrix by k)."""
+    e = encode_matrix16(k).astype(np.int64)
+    log, exp = _tables16()
+    powers = (1 << np.arange(16)).astype(np.int64)
+    prod = exp[(log[e][:, :, None] + log[powers][None, None, :]) % MODULUS16]
+    prod = np.where(e[:, :, None] == 0, 0, prod)
+    bits = (prod[:, None, :, :] >> np.arange(16)[None, :, None, None]) & 1
+    return bits.reshape(16 * k, 16 * k).astype(np.int8)
+
+
+def _gf_invert16(a: np.ndarray) -> np.ndarray:
+    n = a.shape[0]
+    m = a.astype(np.uint16).copy()
+    out = np.eye(n, dtype=np.uint16)
+    for col in range(n):
+        piv = col + int(np.argmax(m[col:, col] != 0))
+        if m[piv, col] == 0:
+            raise np.linalg.LinAlgError(f"singular GF(2^16) matrix at column {col}")
+        if piv != col:
+            m[[col, piv]] = m[[piv, col]]
+            out[[col, piv]] = out[[piv, col]]
+        ipv = inv16(int(m[col, col]))
+        m[col] = mul_vec16(ipv, m[col])
+        out[col] = mul_vec16(ipv, out[col])
+        for r in np.nonzero((m[:, col] != 0) & (np.arange(n) != col))[0]:
+            f = int(m[r, col])
+            m[r] ^= mul_vec16(f, m[col])
+            out[r] ^= mul_vec16(f, out[col])
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def generator_matrix16(k: int) -> np.ndarray:
+    return np.concatenate([np.eye(k, dtype=np.uint16), encode_matrix16(k)], axis=0)
+
+
+@functools.lru_cache(maxsize=None)
+def decode_matrix16(k: int, present: tuple[int, ...]) -> np.ndarray:
+    if len(present) != k:
+        raise ValueError(f"need exactly {k} present positions")
+    return _gf_invert16(generator_matrix16(k)[list(present)])
+
+
+MAX_K8 = ORDER // 2  # widest square the 8-bit code covers
+
+
+def uses_gf16(k: int) -> bool:
+    """Codec selection: 8-bit up to 256 total shards, 16-bit beyond —
+    klauspost reedsolomon's WithLeopardGF threshold."""
+    return k > MAX_K8

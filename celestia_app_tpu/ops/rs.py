@@ -57,21 +57,58 @@ def _gf_mix(bit_mat: jax.Array, x_bits: jax.Array) -> jax.Array:
     return (out & 1).astype(jnp.int8)
 
 
+def bytes_to_bits16(x: jax.Array) -> jax.Array:
+    """(..., n, D) uint8 -> (..., 16n, D//2) int8 bits of LE uint16 symbols.
+
+    Symbol p of a share is bytes (2p, 2p+1) little-endian; symbol-bit b is
+    bit b%8 of byte 2p + b//8. Row 16l+b = bit b of shard l's symbols."""
+    n, d = x.shape[-2], x.shape[-1]
+    sym = x.reshape(*x.shape[:-2], n, d // 2, 2)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    # (..., n, d/2, byte(2), bit(8)): symbol-bit order is byte0 bits 0..7
+    # then byte1 bits 0..7, so flattening (byte, bit) is already LE order
+    bits = (sym[..., None] >> shifts) & 1
+    bits = bits.reshape(*x.shape[:-2], n, d // 2, 16)
+    bits = jnp.swapaxes(bits, -2, -1)  # (..., n, 16, d/2)
+    return bits.reshape(*x.shape[:-2], 16 * n, d // 2).astype(jnp.int8)
+
+
+def bits_to_bytes16(b: jax.Array) -> jax.Array:
+    """Inverse of bytes_to_bits16: (..., 16n, D//2) -> (..., n, D) uint8."""
+    n = b.shape[-2] // 16
+    half = b.shape[-1]
+    bits = b.reshape(*b.shape[:-2], n, 16, half).astype(jnp.int32)
+    bits = jnp.swapaxes(bits, -2, -1)  # (..., n, half, 16)
+    bits = bits.reshape(*b.shape[:-2], n, half, 2, 8)
+    weights = (1 << jnp.arange(8, dtype=jnp.int32))
+    by = jnp.sum(bits * weights, axis=-1).astype(jnp.uint8)  # (..., n, half, 2)
+    return by.reshape(*b.shape[:-2], n, 2 * half)
+
+
+def _codec(k: int):
+    """(bit_matrix, to_bits, from_bits) for the square size's field."""
+    if leopard.uses_gf16(k):
+        return leopard.bit_matrix16(k), bytes_to_bits16, bits_to_bytes16
+    return leopard.bit_matrix(k), bytes_to_bits, bits_to_bytes
+
+
 def extend_square_fn(k: int):
-    """Return a jittable fn: (k, k, 512) uint8 ODS -> (2k, 2k, 512) uint8 EDS."""
-    bit_mat = jnp.asarray(leopard.bit_matrix(k))  # constant folded into the jaxpr
+    """Return a jittable fn: (k, k, 512) uint8 ODS -> (2k, 2k, 512) uint8 EDS.
+
+    k <= 128 uses the GF(2^8) code; k >= 256 the GF(2^16) code (leopard16),
+    both as one bit-matrix MXU matmul per pass."""
+    mat, to_bits, from_bits = _codec(k)
+    bit_mat = jnp.asarray(mat)  # constant folded into the jaxpr
 
     def extend(ods: jax.Array) -> jax.Array:
         assert ods.shape == (k, k, SHARE), ods.shape
         # Row pass: mix across the share index within each row.
-        q0_row_bits = bytes_to_bits(ods)  # (k rows, 8k, S)
-        q1 = bits_to_bytes(_gf_mix(bit_mat, q0_row_bits))  # (k, k, S)
+        q1 = from_bits(_gf_mix(bit_mat, to_bits(ods)))  # (k, k, S)
         # Column pass: transpose so columns become the mixing axis.
-        q0_col_bits = bytes_to_bits(jnp.swapaxes(ods, 0, 1))  # (k cols, 8k, S)
-        q2_t = bits_to_bytes(_gf_mix(bit_mat, q0_col_bits))  # (k cols, k, S)
+        q2_t = from_bits(_gf_mix(bit_mat, to_bits(jnp.swapaxes(ods, 0, 1))))
         q2 = jnp.swapaxes(q2_t, 0, 1)  # (k rows of parity, k cols, S)
         # Q3 = row-extend Q2 (== column-extend Q1, data_structures.md:304-310).
-        q3 = bits_to_bytes(_gf_mix(bit_mat, bytes_to_bits(q2)))
+        q3 = from_bits(_gf_mix(bit_mat, to_bits(q2)))
         top = jnp.concatenate([ods, q1], axis=1)
         bottom = jnp.concatenate([q2, q3], axis=1)
         return jnp.concatenate([top, bottom], axis=0)
@@ -90,16 +127,25 @@ def jitted_extend(k: int):
 # ---------------------------------------------------------------------------
 
 
+def _encode_axis_np(block: np.ndarray) -> np.ndarray:
+    """(k, D) data shards -> (k, D) parity, byte domain, codec by k."""
+    k = block.shape[0]
+    if leopard.uses_gf16(k):
+        u16 = np.ascontiguousarray(block).view("<u2").reshape(k, -1)
+        return leopard.encode16(u16).view(np.uint8).reshape(k, -1)
+    return leopard.encode(block)
+
+
 def extend_square_np(ods: np.ndarray) -> np.ndarray:
-    """Byte-domain numpy reference of the same extension."""
+    """Byte-domain numpy reference of the same extension (FFT-based encode,
+    quasilinear: fast enough for k=256 host tests)."""
     k = ods.shape[0]
     assert ods.shape == (k, k, SHARE)
-    e = leopard.encode_matrix(k)
-    q1 = np.stack([leopard.matmul(e, ods[r]) for r in range(k)])  # rows
+    q1 = np.stack([_encode_axis_np(ods[r]) for r in range(k)])  # rows
     q2 = np.stack(
-        [leopard.matmul(e, ods[:, c, :]) for c in range(k)], axis=1
+        [_encode_axis_np(ods[:, c, :]) for c in range(k)], axis=1
     )  # columns
-    q3 = np.stack([leopard.matmul(e, q2[r]) for r in range(k)])
+    q3 = np.stack([_encode_axis_np(q2[r]) for r in range(k)])
     top = np.concatenate([ods, q1], axis=1)
     bottom = np.concatenate([q2, q3], axis=1)
     return np.concatenate([top, bottom], axis=0)
@@ -116,6 +162,13 @@ def repair_axis(symbols: np.ndarray, present: list[int]) -> np.ndarray:
     if len(present) < k:
         raise ValueError(f"need at least {k} of {two_k} symbols, got {len(present)}")
     use = tuple(sorted(present)[:k])
+    if leopard.uses_gf16(k):
+        m = leopard.decode_matrix16(k, use)
+        sym16 = np.ascontiguousarray(symbols).view("<u2").reshape(2 * k, -1)
+        data16 = leopard.matmul16(m, sym16[list(use)])
+        parity16 = leopard.encode16(data16)
+        out = np.concatenate([data16, parity16], axis=0)
+        return out.view(np.uint8).reshape(2 * k, -1)
     m = leopard.decode_matrix(k, use)
     data = leopard.matmul(m, symbols[list(use)])
     parity = leopard.matmul(leopard.encode_matrix(k), data)

@@ -81,7 +81,8 @@ def _roots_local(sq_local: jax.Array, k: int, major_start: jax.Array) -> jax.Arr
 
 def _local_pipeline(k: int, n_seq: int):
     """The per-device program run under shard_map."""
-    bit_mat = jnp.asarray(leopard.bit_matrix(k))
+    mat, to_bits, from_bits = rs._codec(k)  # GF(2^8) or GF(2^16) by k
+    bit_mat = jnp.asarray(mat)
 
     def run(ods_local: jax.Array):
         # ods_local: (B_l, k/n, k, SHARE) — this device's slab of original rows.
@@ -89,8 +90,8 @@ def _local_pipeline(k: int, n_seq: int):
 
         # 1. Row pass: extend local rows. Mixing is over the share index
         #    within each row, which is fully local.
-        row_bits = rs.bytes_to_bits(ods_local)  # (B_l, k/n, 8k, S)
-        q1_local = rs.bits_to_bytes(rs._gf_mix(bit_mat, row_bits))
+        row_bits = to_bits(ods_local)
+        q1_local = from_bits(rs._gf_mix(bit_mat, row_bits))
         top_local = jnp.concatenate([ods_local, q1_local], axis=2)
         # (B_l, k/n, 2k, S)
 
@@ -103,8 +104,8 @@ def _local_pipeline(k: int, n_seq: int):
 
         # 3. Column pass: extend each owned column over its k data symbols.
         #    Original columns yield Q2; parity columns yield Q3 (== E·Q0·Eᵀ).
-        par_major = rs.bits_to_bytes(
-            rs._gf_mix(bit_mat, rs.bytes_to_bits(col_major))
+        par_major = from_bits(
+            rs._gf_mix(bit_mat, to_bits(col_major))
         )  # (B_l, 2k/n, k, S)
         eds_cols = jnp.concatenate([col_major, par_major], axis=2)
         # (B_l, 2k/n, 2k, S): full columns, column-major

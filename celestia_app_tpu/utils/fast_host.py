@@ -47,6 +47,11 @@ def _bytes(b: np.ndarray) -> np.ndarray:
 def extend_square_fast(ods: np.ndarray) -> np.ndarray:
     """(k, k, 512) -> (2k, 2k, 512); same codewords as ops/rs.extend_square_fn."""
     k = ods.shape[0]
+    if leopard.uses_gf16(k):
+        raise ValueError(
+            "fast_host's BLAS formulation covers the GF(2^8) range (k <= 128);"
+            " use ops.rs.extend_square_np for wider squares"
+        )
     bm = leopard.bit_matrix(k).astype(np.float32)  # (8k, 8k)
 
     def mix(rows: np.ndarray) -> np.ndarray:

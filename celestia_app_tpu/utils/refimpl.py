@@ -26,6 +26,11 @@ NS = appconsts.NAMESPACE_SIZE
 def extend_square_host(ods: np.ndarray) -> np.ndarray:
     """(k, k, 512) -> (2k, 2k, 512), identical to ops/rs.py extension."""
     k = ods.shape[0]
+    if k > 128:
+        raise ValueError(
+            "refimpl covers the GF(2^8) range (k <= 128, all protocol-legal "
+            "squares); use ops.rs.extend_square_np for benchmark-scale squares"
+        )
     e = leopard.encode_matrix(k)
     q1 = np.stack([leopard.matmul(e, ods[r]) for r in range(k)])
     q2 = np.stack([leopard.matmul(e, ods[:, c, :]) for c in range(k)], axis=1)

@@ -1,0 +1,128 @@
+"""GF(2^16) leopard16: the k>=256 codec (BASELINE config 5 scale-out)."""
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.ops import gf256, leopard, rs
+
+
+def _gmul16(a, b):
+    r = 0
+    while b:
+        if b & 1:
+            r ^= a
+        a <<= 1
+        if a & (1 << 16):
+            a ^= leopard.POLY16
+        b >>= 1
+    return r
+
+
+def test_cantor_basis16_recurrence():
+    basis = leopard.CANTOR_BASIS16
+    assert basis[0] == 1
+    for i in range(15):
+        b = basis[i + 1]
+        assert _gmul16(b, b) ^ b == basis[i], i
+        assert b % 2 == 0  # the documented even-root selection rule
+
+
+def test_field16_laws():
+    rng = np.random.default_rng(0)
+    for _ in range(60):
+        a, b, c = (int(x) for x in rng.integers(1, 65536, 3))
+        assert leopard.mul16(a, b) == leopard.mul16(b, a)
+        assert leopard.mul16(a, leopard.mul16(b, c)) == leopard.mul16(
+            leopard.mul16(a, b), c
+        )
+        assert leopard.mul16(a, b ^ c) == leopard.mul16(a, b) ^ leopard.mul16(a, c)
+        assert leopard.mul16(a, leopard.inv16(a)) == 1
+
+
+def test_fft16_roundtrip_and_constant():
+    rng = np.random.default_rng(1)
+    for n in [2, 32, 256]:
+        v = rng.integers(0, 65536, (n, 3), dtype=np.uint16)
+        assert np.array_equal(leopard.fft16(leopard.ifft16(v, n), n), v)
+    c = np.full((256, 2), 0xBEEF, np.uint16)
+    assert np.all(leopard.encode16(c) == 0xBEEF)
+
+
+def test_mds16_random_k256():
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 65536, (256, 4), dtype=np.uint16)
+    cw = np.concatenate([data, leopard.encode16(data)], axis=0)
+    for _ in range(2):
+        present = tuple(sorted(rng.choice(512, 256, replace=False).tolist()))
+        m = leopard.decode_matrix16(256, present)
+        assert np.array_equal(leopard.matmul16(m, cw[list(present)]), data)
+
+
+def test_bit_matrix16_equals_symbol_domain():
+    rng = np.random.default_rng(3)
+    k = 4  # small k: the formulation is k-independent
+    data16 = rng.integers(0, 65536, (k, 6), dtype=np.uint16)
+    parity16 = leopard.matmul16(leopard.encode_matrix16(k), data16)
+    bits = ((data16[:, None, :] >> np.arange(16)[None, :, None]) & 1).reshape(
+        16 * k, -1
+    )
+    out_bits = (leopard.bit_matrix16(k).astype(np.int64) @ bits) & 1
+    out = (
+        (out_bits.reshape(k, 16, -1) * (1 << np.arange(16))[None, :, None])
+        .sum(axis=1)
+        .astype(np.uint16)
+    )
+    assert np.array_equal(out, parity16)
+
+
+@pytest.mark.backend
+def test_device_bits16_pack_roundtrip_and_extend():
+    """The LE-symbol bit pack/unpack and the device extension using the
+    16-bit matrix agree with the host FFT encode (small payload, forced
+    16-bit formulation via direct kernel plumbing at test scale)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.integers(0, 256, (3, 4, 16), dtype=np.uint8))
+    back = rs.bits_to_bytes16(rs.bytes_to_bits16(x))
+    assert np.array_equal(np.asarray(back), np.asarray(x))
+
+    # one row-extension pass with the 16-bit matrix at k=8 vs host encode16
+    k, d = 8, 32
+    block = rng.integers(0, 256, (k, d), dtype=np.uint8)
+    bits = rs.bytes_to_bits16(jnp.asarray(block)[None])  # (1, 16k, d/2)
+    mixed = rs._gf_mix(jnp.asarray(leopard.bit_matrix16(k)), bits)
+    got = np.asarray(rs.bits_to_bytes16(mixed))[0]
+    want_u16 = leopard.encode16(block.view("<u2").reshape(k, -1))
+    assert np.array_equal(got, want_u16.view(np.uint8).reshape(k, d))
+
+
+def test_repair_axis_gf16():
+    rng = np.random.default_rng(5)
+    k = 256
+    data = rng.integers(0, 256, (k, 8), dtype=np.uint8)
+    parity = rs._encode_axis_np(data)
+    row = np.concatenate([data, parity], axis=0)
+    present = sorted(rng.choice(2 * k, k, replace=False).tolist())
+    corrupted = row.copy()
+    for i in range(2 * k):
+        if i not in present:
+            corrupted[i] = 0
+    rec = rs.repair_axis(corrupted, present)
+    assert np.array_equal(rec, row)
+
+
+@pytest.mark.slow
+@pytest.mark.backend
+def test_extend_square_256_device_vs_host():
+    """Full k=256 square: device bit-matrix extension == host FFT encode.
+
+    Payload kept at full 512 B but run once (slow: ~4096-wide bit matmuls
+    on CPU)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(6)
+    ods = rng.integers(0, 256, (256, 256, 512), dtype=np.uint8)
+    eds_host = rs.extend_square_np(ods)
+    eds_dev = np.asarray(rs.jitted_extend(256)(jnp.asarray(ods)))
+    assert np.array_equal(eds_dev, eds_host)
