@@ -28,12 +28,14 @@ from celestia_app_tpu.chain.tx import (
     MsgCreateValidator,
     MsgDelegate,
     MsgDeposit,
+    MsgExec,
     MsgPayForBlobs,
     MsgRegisterEVMAddress,
     MsgSend,
     MsgSignalVersion,
     MsgSubmitProposal,
     MsgTryUpgrade,
+    MsgTransfer,
     MsgUndelegate,
     MsgVote,
     Tx,
@@ -61,7 +63,39 @@ MSG_VERSIONS: dict[str, tuple[int, int]] = {
     MsgSubmitProposal.TYPE: (1, 99),
     MsgDeposit.TYPE: (1, 99),
     MsgVote.TYPE: (1, 99),
+    MsgTransfer.TYPE: (1, 99),
+    MsgExec.TYPE: (1, 99),
 }
+
+
+def msg_signer(m) -> bytes | None:
+    """The message's native signer address (authz exec checks the inner
+    message's signer against its grant)."""
+    if isinstance(m, MsgSend):
+        return m.from_addr
+    if isinstance(m, MsgPayForBlobs):
+        return m.signer
+    if isinstance(m, MsgSignalVersion):
+        return m.validator
+    if isinstance(m, MsgTryUpgrade):
+        return m.signer
+    if isinstance(m, MsgRegisterEVMAddress):
+        return m.validator
+    if isinstance(m, (MsgDelegate, MsgUndelegate, MsgBeginRedelegate)):
+        return m.delegator
+    if isinstance(m, MsgCreateValidator):
+        return m.operator
+    if isinstance(m, MsgSubmitProposal):
+        return m.proposer
+    if isinstance(m, MsgDeposit):
+        return m.depositor
+    if isinstance(m, MsgVote):
+        return m.voter
+    if isinstance(m, MsgTransfer):
+        return m.sender
+    if isinstance(m, MsgExec):
+        return m.grantee
+    return None
 
 
 @dataclasses.dataclass
@@ -71,6 +105,7 @@ class AnteHandler:
     blob: modules.BlobKeeper
     minfee: modules.MinFeeKeeper
     min_gas_price: float = appconsts.DEFAULT_MIN_GAS_PRICE
+    feegrant: object | None = None  # FeeGrantKeeper when enabled
 
     def run(self, ctx: Context, tx: Tx, simulate: bool = False) -> None:
         """Raises AnteError when the tx must be rejected; consumes gas."""
@@ -85,13 +120,28 @@ class AnteHandler:
         if body.timeout_height and ctx.height > body.timeout_height:
             raise AnteError("tx timed out")
 
-        # 2. version gatekeeper (circuit breaker)
-        for m in body.msgs:
-            lo, hi = MSG_VERSIONS.get(m.TYPE, (99, 99))
-            if not (lo <= ctx.app_version <= hi):
-                raise AnteError(
-                    f"message {m.TYPE} not accepted at app version {ctx.app_version}"
-                )
+        # 2. version gatekeeper (circuit breaker). Walks authz-nested
+        # messages too — the reference's MsgVersioningGateKeeper inspects
+        # MsgExec contents (app/ante/msg_gatekeeper.go), so wrapping a gated
+        # message in MsgExec must not smuggle it past the breaker.
+        def _gate(msgs, nested: bool):
+            for m in msgs:
+                lo, hi = MSG_VERSIONS.get(m.TYPE, (99, 99))
+                if not (lo <= ctx.app_version <= hi):
+                    raise AnteError(
+                        f"message {m.TYPE} not accepted at app version "
+                        f"{ctx.app_version}"
+                    )
+                if isinstance(m, MsgExec):
+                    if nested:
+                        raise AnteError("nested MsgExec is not allowed")
+                    _gate(m.inner, nested=True)
+                elif nested and isinstance(m, MsgPayForBlobs):
+                    # a PFB must ride in a BlobTx with its blobs; authz
+                    # wrapping would break the DA pairing invariant
+                    raise AnteError("MsgPayForBlobs cannot be nested in MsgExec")
+
+        _gate(body.msgs, nested=False)
 
         # 3. tx size gas
         size = len(tx.encode())
@@ -117,8 +167,19 @@ class AnteHandler:
 
         signer = self._signer(body)
         if not simulate:
+            payer = signer
+            if body.fee_granter:
+                # feegrant DeductFeeDecorator: the granter pays if a live
+                # allowance covers the fee
+                if self.feegrant is None:
+                    raise AnteError("fee grants are not enabled")
+                try:
+                    self.feegrant.use_grant(ctx, body.fee_granter, signer, body.fee)
+                except ValueError as e:
+                    raise AnteError(f"fee grant: {e}") from None
+                payer = body.fee_granter
             try:
-                self.bank.send(ctx, signer, modules.FEE_COLLECTOR, body.fee)
+                self.bank.send(ctx, payer, modules.FEE_COLLECTOR, body.fee)
             except ValueError as e:
                 raise AnteError(f"cannot pay fee: {e}") from None
 
@@ -148,28 +209,8 @@ class AnteHandler:
                 self._check_pfb(ctx, m, body)
 
     def _signer(self, body) -> bytes:
-        addrs = set()
-        for m in body.msgs:
-            if isinstance(m, MsgSend):
-                addrs.add(m.from_addr)
-            elif isinstance(m, MsgPayForBlobs):
-                addrs.add(m.signer)
-            elif isinstance(m, (MsgSignalVersion,)):
-                addrs.add(m.validator)
-            elif isinstance(m, (MsgTryUpgrade,)):
-                addrs.add(m.signer)
-            elif isinstance(m, MsgRegisterEVMAddress):
-                addrs.add(m.validator)
-            elif isinstance(m, (MsgDelegate, MsgUndelegate, MsgBeginRedelegate)):
-                addrs.add(m.delegator)
-            elif isinstance(m, MsgCreateValidator):
-                addrs.add(m.operator)
-            elif isinstance(m, MsgSubmitProposal):
-                addrs.add(m.proposer)
-            elif isinstance(m, MsgDeposit):
-                addrs.add(m.depositor)
-            elif isinstance(m, MsgVote):
-                addrs.add(m.voter)
+        addrs = {msg_signer(m) for m in body.msgs}
+        addrs.discard(None)
         if len(addrs) != 1:
             raise AnteError(f"tx must have exactly one signer, got {len(addrs)}")
         return next(iter(addrs))

@@ -28,6 +28,8 @@ from celestia_app_tpu import appconsts
 from celestia_app_tpu.chain import ante as ante_mod
 from celestia_app_tpu.chain import blobstream as blobstream_mod
 from celestia_app_tpu.chain import gov as gov_mod
+from celestia_app_tpu.chain import ibc as ibc_mod
+from celestia_app_tpu.chain import sdk_modules
 from celestia_app_tpu.chain import modules
 from celestia_app_tpu.chain import storage
 from celestia_app_tpu.utils import telemetry
@@ -52,6 +54,8 @@ from celestia_app_tpu.chain.tx import (
     MsgSubmitProposal,
     MsgDeposit,
     MsgVote,
+    MsgTransfer,
+    MsgExec,
 )
 from celestia_app_tpu.da import blob as blob_mod
 from celestia_app_tpu.da import dah as dah_mod
@@ -75,7 +79,10 @@ class App:
         min_gas_price: float = appconsts.DEFAULT_MIN_GAS_PRICE,
         v2_upgrade_height: int | None = None,
         data_dir: str | None = None,
+        invariant_check_period: int = 0,  # crisis: 0 = only at genesis/on demand
     ):
+        self.invariant_check_period = invariant_check_period
+        self.absent_validators: set[bytes] = set()
         self.chain_id = chain_id
         self.app_version = app_version
         self.engine = engine
@@ -150,8 +157,19 @@ class App:
             params["min_deposit"] = _require(v, int, 1, 1 << 62)
             self.gov.set_params(ctx, params)
         self.gov = gov_mod.GovKeeper(self.staking, self.bank, param_router)
+        self.ibc = ibc_mod.IBCStack(self.bank)
+        self.distribution = sdk_modules.DistributionKeeper(self.staking, self.bank)
+        self.slashing = sdk_modules.SlashingKeeper(self.staking)
+        self.authz = sdk_modules.AuthzKeeper()
+        self.feegrant = sdk_modules.FeeGrantKeeper()
+        self.vesting = sdk_modules.VestingKeeper()
+        self.crisis = sdk_modules.CrisisKeeper()
+        sdk_modules.register_default_invariants(self.crisis, self)
+        self.bank.vesting = self.vesting  # locked funds gate inside bank.send
+        self.staking.hooks.append(self.distribution)  # F1 settlement hook
         self.ante = ante_mod.AnteHandler(
-            self.auth, self.bank, self.blob, self.minfee, min_gas_price
+            self.auth, self.bank, self.blob, self.minfee, min_gas_price,
+            feegrant=self.feegrant,
         )
         # committed-state snapshots for load_height rollback (app/app.go:592);
         # when a ChainDB is attached the window lives on disk instead
@@ -218,6 +236,9 @@ class App:
             p["gov_max_square_size"] = genesis["gov_max_square_size"]
             self.blob.set_params(ctx, p)
         ctx.store.write()
+        # genesis invariant assertion (crisis module's init-genesis check)
+        check_ctx = self._ctx(self.store, InfiniteGasMeter(), check=False)
+        self.crisis.assert_invariants(check_ctx)
         self.last_app_hash = self.store.app_hash()
 
     # ------------------------------------------------------------------
@@ -474,8 +495,18 @@ class App:
         h = block.header
         ctx = self._deliver_ctx(InfiniteGasMeter(), height=h.height, t=h.time_unix)
 
-        # BeginBlock: mint first (app/modules.go block order)
+        # BeginBlock: mint first, then distribution allocates last block's
+        # fees + provisions to validator reward indices (app/modules.go
+        # order), then slashing records liveness from the last commit
+        # (validators in self.absent_validators are treated as not signing —
+        # the single-process analog of LastCommitInfo)
         self.mint.begin_blocker(ctx, self.bank)
+        self.distribution.allocate(ctx)
+        for op, _power in self.staking.validators(ctx):
+            self.slashing.handle_signature(
+                ctx, op, signed=op not in self.absent_validators
+            )
+        self.absent_validators = set()
 
         results: list[TxResult] = []
         for raw in block.txs:
@@ -483,6 +514,8 @@ class App:
 
         # EndBlock: upgrades
         self._end_blocker(ctx, h.height)
+        if self.invariant_check_period and h.height % self.invariant_check_period == 0:
+            self.crisis.assert_invariants(ctx)
 
         ctx.store.write()
         return results
@@ -554,6 +587,31 @@ class App:
             self.gov.deposit(ctx, msg.proposal_id, msg.depositor, msg.amount)
         elif isinstance(msg, MsgVote):
             self.gov.vote(ctx, msg.proposal_id, msg.voter, msg.option)
+        elif isinstance(msg, MsgTransfer):
+            self.ibc.transfer.send_transfer(
+                ctx, msg.source_channel, msg.sender, msg.receiver,
+                msg.denom, msg.amount,
+            )
+        elif isinstance(msg, MsgExec):
+            # x/authz: every inner message's native signer must have granted
+            # the tx signer (grantee) authorization for that msg type
+            if not msg.inner:
+                raise ValueError("MsgExec with no inner messages")
+            for inner in msg.inner:
+                if isinstance(inner, MsgExec):
+                    raise ValueError("nested MsgExec is not allowed")
+                if isinstance(inner, MsgPayForBlobs):
+                    raise ValueError("MsgPayForBlobs cannot be nested in MsgExec")
+                granter = ante_mod.msg_signer(inner)
+                if granter is None:
+                    raise ValueError("inner message has no signer")
+                if granter != msg.grantee and not self.authz.has_authorization(
+                    ctx, granter, msg.grantee, inner.TYPE
+                ):
+                    raise ValueError(
+                        f"no authorization for {inner.TYPE} from {granter.hex()}"
+                    )
+                self._dispatch(ctx, inner)
         else:
             raise ValueError(f"unroutable message {type(msg).__name__}")
 
@@ -678,6 +736,25 @@ class App:
         self.last_block_hash = snap["last_block_hash"]
         self._check_state = None
         self.state_generation += 1
+
+    def relay_recv_packet(self, packet: dict) -> dict:
+        """Core-relay boundary: deliver an inbound IBC packet (the reference
+        receives these as relayer-submitted MsgRecvPacket through consensus;
+        the single-process node applies them directly to committed state)."""
+        ctx = self._deliver_ctx(InfiniteGasMeter())
+        ack = self.ibc.recv_packet(ctx, packet)
+        ctx.store.write()
+        return ack
+
+    def relay_acknowledge(self, packet: dict, ack: dict) -> None:
+        ctx = self._deliver_ctx(InfiniteGasMeter())
+        self.ibc.transfer.on_acknowledgement(ctx, packet, ack)
+        ctx.store.write()
+
+    def relay_timeout(self, packet: dict) -> None:
+        ctx = self._deliver_ctx(InfiniteGasMeter())
+        self.ibc.transfer.on_timeout(ctx, packet)
+        ctx.store.write()
 
     # convenience: one full consensus round in-process
     def produce_block(self, raw_txs: list[bytes], t: float | None = None) -> tuple[Block, list[TxResult]]:

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import json
 
-from celestia_app_tpu.chain.state import Context
+from celestia_app_tpu.chain.state import Context, get_json, put_json
 
 # celestia mainnet-flavored defaults (scaled: periods in seconds)
 DEFAULT_MIN_DEPOSIT = 10_000_000_000  # 10,000 TIA in utia
@@ -58,13 +58,12 @@ class ParamFilterError(ValueError):
     hence ValueError: DeliverTx converts it into a failed TxResult)."""
 
 
-def _put(ctx: Context, key: bytes, obj) -> None:
-    ctx.store.set(key, json.dumps(obj, sort_keys=True, separators=(",", ":")).encode())
+def _put(ctx, key: bytes, obj) -> None:
+    put_json(ctx, key, obj)
 
 
-def _get(ctx: Context, key: bytes):
-    raw = ctx.store.get(key)
-    return None if raw is None else json.loads(raw)
+def _get(ctx, key: bytes):
+    return get_json(ctx, key)
 
 
 class GovKeeper:

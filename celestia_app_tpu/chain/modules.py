@@ -17,18 +17,17 @@ from __future__ import annotations
 import json
 
 from celestia_app_tpu import appconsts
-from celestia_app_tpu.chain.state import Context
+from celestia_app_tpu.chain.state import Context, get_json, put_json
 from celestia_app_tpu.chain.staking import StakingKeeper  # full mechanics
 from celestia_app_tpu.da import shares as shares_mod
 
 
-def _put(ctx: Context, key: bytes, obj) -> None:
-    ctx.store.set(key, json.dumps(obj, sort_keys=True, separators=(",", ":")).encode())
+def _put(ctx, key: bytes, obj) -> None:
+    put_json(ctx, key, obj)
 
 
-def _get(ctx: Context, key: bytes):
-    raw = ctx.store.get(key)
-    return None if raw is None else json.loads(raw)
+def _get(ctx, key: bytes):
+    return get_json(ctx, key)
 
 
 # ---------------------------------------------------------------------------
@@ -73,6 +72,12 @@ class BankKeeper:
     PREFIX = b"bank/bal/"
     SUPPLY = b"bank/supply"
 
+    def __init__(self):
+        # optional VestingKeeper: when set, send() refuses to move locked
+        # tokens — enforced HERE so fees, delegations, deposits, and any
+        # future message all hit the same gate (no per-message special cases)
+        self.vesting = None
+
     def balance(self, ctx: Context, addr: bytes) -> int:
         return _get(ctx, self.PREFIX + addr) or 0
 
@@ -82,6 +87,8 @@ class BankKeeper:
     def send(self, ctx: Context, from_addr: bytes, to_addr: bytes, amount: int) -> None:
         if amount < 0:
             raise ValueError("negative send amount")
+        if self.vesting is not None:
+            self.vesting.check_spendable(ctx, self, from_addr, amount)
         bal = self.balance(ctx, from_addr)
         if bal < amount:
             raise ValueError(f"insufficient funds: {bal} < {amount}")

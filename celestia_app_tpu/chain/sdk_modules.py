@@ -1,0 +1,333 @@
+"""The remaining cosmos-sdk module set the reference wires: distribution,
+slashing, evidence, authz, feegrant, vesting, crisis.
+
+Reference parity: app/app.go's module manager registers these around the
+celestia-specific modules. Semantics follow the SDK keepers, sized to this
+framework's flat store:
+
+- distribution: F1-style reward accounting — every validator carries a
+  cumulative rewards-per-share index; a delegation records the index at its
+  last touch, so pending rewards = shares * (index_now − index_then). Fees
+  + inflation collected in FEE_COLLECTOR are distributed per block
+  proportional to power (allocation, x/distribution/keeper/allocation.go).
+- slashing: per-validator signing info over a sliding window; falling below
+  the minimum signed ratio jails + slashes slash_fraction_downtime; double
+  signs (evidence) slash slash_fraction_double_sign and tombstone
+  (x/slashing/keeper/infractions.go, x/evidence handler).
+- authz: (granter, grantee, msg_type) grants with expiry; exec runs a
+  message with the granter as effective signer.
+- feegrant: (granter, grantee) allowances with spend limit + expiry,
+  honored by the ante fee deduction when a tx names a fee_granter.
+- vesting: continuous vesting locks a linear fraction of original_vesting
+  until end_time; bank transfers of locked funds are rejected.
+- crisis: registered invariants (supply == Σ balances + module pools,
+  staking pool consistency) assertable per block or on demand.
+"""
+
+from __future__ import annotations
+
+import json
+
+from celestia_app_tpu.chain.staking import BONDED_POOL, NOT_BONDED_POOL
+from celestia_app_tpu.chain.state import Context, get_json, put_json
+
+from celestia_app_tpu.chain.modules import FEE_COLLECTOR
+
+
+def _put(ctx, key: bytes, obj) -> None:
+    put_json(ctx, key, obj)
+
+
+def _get(ctx, key: bytes):
+    return get_json(ctx, key)
+
+
+# ---------------------------------------------------------------------------
+# distribution (F1-lite)
+# ---------------------------------------------------------------------------
+
+DISTRIBUTION_POOL = b"\x00" * 19 + b"\x05"
+
+
+class DistributionKeeper:
+    IDX = b"dist/val_index/"  # cumulative rewards-per-share (float)
+    REF = b"dist/del_ref/"  # (operator+delegator) -> index at last touch
+    ACC = b"dist/del_acc/"  # accrued-but-unclaimed rewards
+
+    def __init__(self, staking, bank):
+        self.staking = staking
+        self.bank = bank
+
+    def _index(self, ctx: Context, op: bytes) -> float:
+        return _get(ctx, self.IDX + op) or 0.0
+
+    def allocate(self, ctx: Context) -> int:
+        """BeginBlocker: move the fee collector's balance into per-validator
+        reward indices, proportional to power (allocation.go:14-80)."""
+        pot = self.bank.balance(ctx, FEE_COLLECTOR)
+        if pot <= 0:
+            return 0
+        # only validators with outstanding shares can be credited; excluding
+        # the rest from the denominator keeps every utia of the pot reachable
+        vals = [
+            (op, p, self.staking.validator(ctx, op))
+            for op, p in self.staking.validators(ctx)
+        ]
+        vals = [(op, p, v) for op, p, v in vals if v["shares"] > 0]
+        total = sum(p for _, p, _ in vals)
+        if total == 0:
+            return 0
+        self.bank.send(ctx, FEE_COLLECTOR, DISTRIBUTION_POOL, pot)
+        for op, power, v in vals:
+            share = pot * power / total
+            _put(ctx, self.IDX + op, self._index(ctx, op) + share / v["shares"])
+        return pot
+
+    def _settle(self, ctx: Context, op: bytes, delegator: bytes) -> float:
+        """Bank accrued rewards up to the current index (called before any
+        delegation change and by withdraw)."""
+        shares = self.staking.delegation(ctx, op, delegator)
+        key = self.REF + op + delegator
+        ref = _get(ctx, key) or 0.0
+        idx = self._index(ctx, op)
+        accrued = shares * (idx - ref)
+        if accrued:
+            acc_key = self.ACC + op + delegator
+            _put(ctx, acc_key, (_get(ctx, acc_key) or 0.0) + accrued)
+        _put(ctx, key, idx)
+        return accrued
+
+    def pending_rewards(self, ctx: Context, op: bytes, delegator: bytes) -> int:
+        shares = self.staking.delegation(ctx, op, delegator)
+        ref = _get(ctx, self.REF + op + delegator) or 0.0
+        acc = _get(ctx, self.ACC + op + delegator) or 0.0
+        return int(acc + shares * (self._index(ctx, op) - ref))
+
+    # staking hook (registered in staking.hooks): settle before any
+    # delegation change so new shares never accrue retroactive rewards and
+    # removed shares bank what they earned (the SDK's F1 hook pattern)
+    def before_delegation_modified(self, ctx: Context, op: bytes, delegator: bytes) -> None:
+        self._settle(ctx, op, delegator)
+
+    def withdraw(self, ctx: Context, op: bytes, delegator: bytes) -> int:
+        self._settle(ctx, op, delegator)
+        acc_key = self.ACC + op + delegator
+        amount = int(_get(ctx, acc_key) or 0.0)
+        if amount > 0:
+            self.bank.send(ctx, DISTRIBUTION_POOL, delegator, amount)
+        ctx.store.delete(acc_key)
+        return amount
+
+
+# ---------------------------------------------------------------------------
+# slashing + evidence
+# ---------------------------------------------------------------------------
+
+SIGNED_BLOCKS_WINDOW = 5000
+MIN_SIGNED_PER_WINDOW = 0.75
+SLASH_FRACTION_DOWNTIME = 0.01
+SLASH_FRACTION_DOUBLE_SIGN = 0.05
+DOWNTIME_JAIL_SECONDS = 600.0
+
+
+class SlashingKeeper:
+    INFO = b"slashing/info/"
+
+    def __init__(self, staking):
+        self.staking = staking
+
+    def info(self, ctx: Context, op: bytes) -> dict:
+        return _get(ctx, self.INFO + op) or {
+            "missed": 0,
+            "window_start": ctx.height,
+            "jailed_until": 0.0,
+            "tombstoned": False,
+        }
+
+    def handle_signature(self, ctx: Context, op: bytes, signed: bool) -> None:
+        """Per-block liveness accounting (infractions.go HandleValidatorSignature)."""
+        info = self.info(ctx, op)
+        if ctx.height - info["window_start"] >= SIGNED_BLOCKS_WINDOW:
+            info["missed"] = 0
+            info["window_start"] = ctx.height
+        if not signed:
+            info["missed"] += 1
+            allowed = SIGNED_BLOCKS_WINDOW * (1 - MIN_SIGNED_PER_WINDOW)
+            if info["missed"] > allowed and not info["tombstoned"]:
+                self.staking.slash(ctx, op, SLASH_FRACTION_DOWNTIME)
+                info["jailed_until"] = ctx.time_unix + DOWNTIME_JAIL_SECONDS
+                info["missed"] = 0
+                info["window_start"] = ctx.height
+                ctx.emit_event("slashing.downtime", validator=op.hex())
+        _put(ctx, self.INFO + op, info)
+
+    def handle_equivocation(self, ctx: Context, op: bytes) -> None:
+        """x/evidence: double-sign slashes harder and tombstones forever."""
+        info = self.info(ctx, op)
+        if info["tombstoned"]:
+            return
+        self.staking.slash(ctx, op, SLASH_FRACTION_DOUBLE_SIGN)
+        info["tombstoned"] = True
+        info["jailed_until"] = float("inf")
+        _put(ctx, self.INFO + op, info)
+        ctx.emit_event("slashing.double_sign", validator=op.hex())
+
+    def unjail(self, ctx: Context, op: bytes) -> None:
+        info = self.info(ctx, op)
+        if info["tombstoned"]:
+            raise ValueError("validator is tombstoned")
+        if ctx.time_unix < info["jailed_until"]:
+            raise ValueError("still jailed")
+        self.staking.unjail(ctx, op)
+
+
+# ---------------------------------------------------------------------------
+# authz
+# ---------------------------------------------------------------------------
+
+
+class AuthzKeeper:
+    GRANT = b"authz/"
+
+    def grant(self, ctx: Context, granter: bytes, grantee: bytes,
+              msg_type: str, expiration: float | None = None) -> None:
+        _put(ctx, self.GRANT + granter + grantee + msg_type.encode(),
+             {"expiration": expiration})
+
+    def revoke(self, ctx: Context, granter: bytes, grantee: bytes, msg_type: str) -> None:
+        ctx.store.delete(self.GRANT + granter + grantee + msg_type.encode())
+
+    def has_authorization(self, ctx: Context, granter: bytes, grantee: bytes,
+                          msg_type: str) -> bool:
+        g = _get(ctx, self.GRANT + granter + grantee + msg_type.encode())
+        if g is None:
+            return False
+        exp = g.get("expiration")
+        if exp is not None and ctx.time_unix > exp:
+            return False
+        return True
+
+
+# ---------------------------------------------------------------------------
+# feegrant
+# ---------------------------------------------------------------------------
+
+
+class FeeGrantKeeper:
+    GRANT = b"feegrant/"
+
+    def grant(self, ctx: Context, granter: bytes, grantee: bytes,
+              spend_limit: int | None = None, expiration: float | None = None) -> None:
+        _put(ctx, self.GRANT + granter + grantee,
+             {"spend_limit": spend_limit, "expiration": expiration})
+
+    def revoke(self, ctx: Context, granter: bytes, grantee: bytes) -> None:
+        ctx.store.delete(self.GRANT + granter + grantee)
+
+    def use_grant(self, ctx: Context, granter: bytes, grantee: bytes, fee: int) -> None:
+        """Charge `fee` against the allowance (ante DeductFeeDecorator with a
+        fee_granter set); raises ValueError if absent/expired/exceeded."""
+        key = self.GRANT + granter + grantee
+        g = _get(ctx, key)
+        if g is None:
+            raise ValueError("no fee allowance")
+        exp = g.get("expiration")
+        if exp is not None and ctx.time_unix > exp:
+            raise ValueError("fee allowance expired")
+        limit = g.get("spend_limit")
+        if limit is not None:
+            if fee > limit:
+                raise ValueError("fee exceeds allowance")
+            remaining = limit - fee
+            if remaining == 0:
+                ctx.store.delete(key)
+            else:
+                g["spend_limit"] = remaining
+                _put(ctx, key, g)
+
+
+# ---------------------------------------------------------------------------
+# vesting
+# ---------------------------------------------------------------------------
+
+
+class VestingKeeper:
+    """Continuous vesting accounts: a linear fraction of original_vesting
+    stays locked between start and end times."""
+
+    ACC = b"vesting/"
+
+    def create(self, ctx: Context, addr: bytes, original_vesting: int,
+               start_time: float, end_time: float) -> None:
+        if end_time <= start_time:
+            raise ValueError("vesting end must follow start")
+        _put(ctx, self.ACC + addr, {
+            "original_vesting": original_vesting,
+            "start_time": start_time,
+            "end_time": end_time,
+        })
+
+    def locked(self, ctx: Context, addr: bytes) -> int:
+        v = _get(ctx, self.ACC + addr)
+        if v is None:
+            return 0
+        t = ctx.time_unix
+        if t >= v["end_time"]:
+            return 0
+        if t <= v["start_time"]:
+            return v["original_vesting"]
+        frac = (v["end_time"] - t) / (v["end_time"] - v["start_time"])
+        return int(v["original_vesting"] * frac)
+
+    def check_spendable(self, ctx: Context, bank, addr: bytes, amount: int) -> None:
+        locked = self.locked(ctx, addr)
+        if locked and bank.balance(ctx, addr) - amount < locked:
+            raise ValueError(
+                f"insufficient spendable balance: {locked} utia still vesting"
+            )
+
+
+# ---------------------------------------------------------------------------
+# crisis
+# ---------------------------------------------------------------------------
+
+
+class CrisisKeeper:
+    def __init__(self):
+        self.invariants: list = []  # [(name, fn(ctx) -> error_string|None)]
+
+    def register(self, name: str, fn) -> None:
+        self.invariants.append((name, fn))
+
+    def assert_invariants(self, ctx: Context) -> None:
+        for name, fn in self.invariants:
+            err = fn(ctx)
+            if err:
+                raise AssertionError(f"invariant {name!r} broken: {err}")
+
+
+def register_default_invariants(crisis: CrisisKeeper, app) -> None:
+    """The supply and staking-pool invariants the reference's crisis module
+    asserts (bank + staking module invariants)."""
+
+    def supply_matches_balances(ctx: Context) -> str | None:
+        total = 0
+        for k, v in ctx.store.iterate_prefix(b"bank/bal/"):
+            total += json.loads(v)
+        supply = app.bank.supply(ctx)
+        if total != supply:
+            return f"sum of balances {total} != supply {supply}"
+        return None
+
+    def bonded_pool_covers_validators(ctx: Context) -> str | None:
+        tokens = sum(
+            json.loads(v)["tokens"]
+            for _, v in ctx.store.iterate_prefix(b"staking/val/")
+        )
+        pool = app.bank.balance(ctx, BONDED_POOL)
+        if pool < tokens:
+            return f"bonded pool {pool} < validator tokens {tokens}"
+        return None
+
+    crisis.register("bank/supply", supply_matches_balances)
+    crisis.register("staking/bonded-pool", bonded_pool_covers_validators)

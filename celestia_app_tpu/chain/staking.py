@@ -32,7 +32,7 @@ from __future__ import annotations
 import json
 
 from celestia_app_tpu import appconsts
-from celestia_app_tpu.chain.state import Context
+from celestia_app_tpu.chain.state import Context, get_json, put_json
 
 POWER_REDUCTION = 1_000_000  # utia per unit of consensus power (sdk default)
 UNBONDING_TIME_SECONDS = 21 * 24 * 3600  # celestia mainnet: 21 days
@@ -42,13 +42,12 @@ BONDED_POOL = b"\x00" * 19 + b"\x02"  # module account holding bonded tokens
 NOT_BONDED_POOL = b"\x00" * 19 + b"\x03"  # holds unbonding tokens
 
 
-def _put(ctx: Context, key: bytes, obj) -> None:
-    ctx.store.set(key, json.dumps(obj, sort_keys=True, separators=(",", ":")).encode())
+def _put(ctx, key: bytes, obj) -> None:
+    put_json(ctx, key, obj)
 
 
-def _get(ctx: Context, key: bytes):
-    raw = ctx.store.get(key)
-    return None if raw is None else json.loads(raw)
+def _get(ctx, key: bytes):
+    return get_json(ctx, key)
 
 
 class StakingKeeper:
@@ -183,6 +182,7 @@ class StakingKeeper:
             raise ValueError("delegation must be positive")
         if self.bank is not None:
             self.bank.send(ctx, delegator, BONDED_POOL, amount)
+        self._fire_delegation_hook(ctx, operator, delegator)
         # shares at current exchange rate (1:1 when no shares outstanding)
         new_shares = (
             float(amount)
@@ -255,6 +255,7 @@ class StakingKeeper:
             raise ValueError("not enough delegated")
         self._remove_shares(ctx, src, delegator, shares_needed, amount)
         # credit dst at its exchange rate
+        self._fire_delegation_hook(ctx, dst, delegator)
         v_dst = self.validator(ctx, dst)
         new_shares = (
             float(amount)
@@ -272,10 +273,17 @@ class StakingKeeper:
             if fn is not None:
                 fn(ctx)
 
+    def _fire_delegation_hook(self, ctx: Context, operator: bytes, delegator: bytes) -> None:
+        for h in self.hooks:
+            fn = getattr(h, "before_delegation_modified", None)
+            if fn is not None:
+                fn(ctx, operator, delegator)
+
     def _remove_shares(
         self, ctx: Context, operator: bytes, delegator: bytes,
         shares: float, tokens: int,
     ) -> None:
+        self._fire_delegation_hook(ctx, operator, delegator)
         v = self.validator(ctx, operator)
         key = self._del_key(operator, delegator)
         remaining = self.delegation(ctx, operator, delegator) - shares

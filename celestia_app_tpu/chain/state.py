@@ -16,6 +16,24 @@ import hashlib
 from celestia_app_tpu.utils import merkle_host
 
 
+def put_json(ctx_or_none, key: bytes, obj, *, store=None) -> None:
+    """Canonical-JSON store write (sorted keys, no whitespace). EVERY module
+    must encode through here: the byte encoding feeds the app hash, so a
+    divergent copy would silently fork consensus state."""
+    import json
+
+    target = store if store is not None else ctx_or_none.store
+    target.set(key, json.dumps(obj, sort_keys=True, separators=(",", ":")).encode())
+
+
+def get_json(ctx_or_none, key: bytes, *, store=None):
+    import json
+
+    target = store if store is not None else ctx_or_none.store
+    raw = target.get(key)
+    return None if raw is None else json.loads(raw)
+
+
 class OutOfGas(Exception):
     pass
 

@@ -311,12 +311,65 @@ class MsgVote:
         return cls(r.b(), r.u(), r.b().decode())
 
 
+@dataclasses.dataclass(frozen=True)
+class MsgTransfer:
+    """ibc-go transfer MsgTransfer (token filter guards the inbound side)."""
+
+    TYPE = "ibc/MsgTransfer"
+    sender: bytes
+    source_channel: str
+    receiver: str  # address encoding of the counterparty chain
+    denom: str
+    amount: int
+
+    def encode(self) -> bytes:
+        return (
+            _b(self.sender) + _b(self.source_channel.encode())
+            + _b(self.receiver.encode()) + _b(self.denom.encode())
+            + uvarint(self.amount)
+        )
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MsgTransfer":
+        r = _Reader(raw)
+        return cls(r.b(), r.b().decode(), r.b().decode(), r.b().decode(), r.u())
+
+
+@dataclasses.dataclass(frozen=True)
+class MsgExec:
+    """x/authz MsgExec: the grantee executes messages on the granter's
+    behalf; each inner message's native signer must have granted the tx
+    signer authorization for that message type."""
+
+    TYPE = "authz/MsgExec"
+    grantee: bytes
+    inner: tuple  # decoded msg objects
+
+    def encode(self) -> bytes:
+        out = bytearray(_b(self.grantee))
+        out += uvarint(len(self.inner))
+        for m in self.inner:
+            out += _s(m.TYPE) + _b(m.encode())
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MsgExec":
+        r = _Reader(raw)
+        grantee = r.b()
+        inner = []
+        for _ in range(r.u()):
+            t = r.s()
+            inner.append(decode_msg(t, r.b()))
+        return cls(grantee, tuple(inner))
+
+
 MSG_TYPES = {
     m.TYPE: m
     for m in (
         MsgSend, MsgPayForBlobs, MsgSignalVersion, MsgTryUpgrade,
         MsgRegisterEVMAddress, MsgDelegate, MsgUndelegate, MsgBeginRedelegate,
-        MsgCreateValidator, MsgSubmitProposal, MsgDeposit, MsgVote,
+        MsgCreateValidator, MsgSubmitProposal, MsgDeposit, MsgVote, MsgTransfer,
+        MsgExec,
     )
 }
 
@@ -343,6 +396,7 @@ class TxBody:
     gas_limit: int
     memo: str = ""
     timeout_height: int = 0
+    fee_granter: bytes = b""  # feegrant: empty = the signer pays
 
     def encode(self) -> bytes:
         out = bytearray(uvarint(len(self.msgs)))
@@ -355,6 +409,7 @@ class TxBody:
         out += uvarint(self.gas_limit)
         out += _s(self.memo)
         out += uvarint(self.timeout_height)
+        out += _b(self.fee_granter)
         return bytes(out)
 
     @classmethod
@@ -373,6 +428,7 @@ class TxBody:
             gas_limit=r.u(),
             memo=r.s(),
             timeout_height=r.u(),
+            fee_granter=r.b(),
         )
         return body, r.off
 

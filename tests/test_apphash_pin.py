@@ -17,11 +17,11 @@ from celestia_app_tpu.da.namespace import Namespace
 from test_app import make_app
 
 PINS = {
-    "app_hash_h1_send": "e175c4dac100c49d9227289aa041028f87578a1cb30acf12ded6dce31cca4535",
-    "app_hash_h2_pfb": "a6907d22ee684cc6f794fff2837460d1c8857d1df09ec06ddca2a2103934d9f2",
-    "data_root_h2": "0087ad871fddcdb676ee490c5e12bb1ba82481bcd9a9135f6c52a93f865a39f8",
-    "app_hash_h3_empty": "b49d046915d6cc6e41a6b4d08b2cd8e2c176d886d20dd6727918398a2b429dec",
-    "block_hash_h3": "f9c89e02b0e6f6e9ec595095bb8208ece0732ab604546da43226bf5a57f23d0d",
+    "app_hash_h1_send": "db67419ce08fbd229c98ff7a2a549c17e4639ddbcb27a854d0746866ef767b55",
+    "app_hash_h2_pfb": "26aa0e88ef2587b9325f30d2c8f0841d12c285e9476df261ce906b6abc18d9e1",
+    "data_root_h2": "2cca49f5eeba5556af288fac0163a74965d79eb65b265adf4b6db022e1f8b72d",
+    "app_hash_h3_empty": "f41efe88cf0a2794eeb108e1e0e6f37f711499c9421e316b8dee72c847c0aec7",
+    "block_hash_h3": "14cf3b0be65da017c7c181ba9425be54bb0192fa2e43505798fe1637017ea8bb",
 }
 
 

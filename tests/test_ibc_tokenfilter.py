@@ -1,0 +1,204 @@
+"""IBC transfer + x/tokenfilter: only native denoms cross the bridge.
+
+Reference analog: x/tokenfilter/ibc_middleware_test.go — inbound foreign
+denoms get an error acknowledgement; returning native tokens unescrow."""
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.chain import ibc
+from celestia_app_tpu.chain.node import Node
+from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+from celestia_app_tpu.chain.tx import MsgTransfer
+
+from test_app import CHAIN, make_app
+
+
+def _ctx(app):
+    return Context(app.store, InfiniteGasMeter(), app.height, 0, CHAIN, 1)
+
+
+def _open_channel(app):
+    ctx = _ctx(app)
+    app.ibc.channels.open_channel(ctx, "transfer", "channel-0", "transfer", "channel-1")
+
+
+def test_outbound_native_escrows_and_emits_packet():
+    app, signer, privs = make_app()
+    _open_channel(app)
+    node = Node(app)
+    a0 = privs[0].public_key().address()
+    bal0 = app.bank.balance(_ctx(app), a0)
+
+    msg = MsgTransfer(a0, "channel-0", "cosmos1receiver", "utia", 50_000)
+    tx = signer.create_tx(a0, [msg], fee=2000, gas_limit=300_000)
+    assert node.broadcast_tx(tx.encode()).code == 0
+    _, results = node.produce_block(t=1_700_000_100.0)
+    signer.accounts[a0].sequence += 1
+    assert results[0].code == 0, results[0].log
+
+    ctx = _ctx(app)
+    esc = ibc.escrow_address("transfer", "channel-0")
+    assert app.bank.balance(ctx, esc) == 50_000
+    assert app.bank.balance(ctx, a0) == bal0 - 50_000 - 2000
+
+
+def test_inbound_foreign_denom_rejected_by_tokenfilter():
+    app, signer, privs = make_app()
+    _open_channel(app)
+    recv = privs[1].public_key().address()
+    packet = {
+        "source_port": "transfer",
+        "source_channel": "channel-1",
+        "destination_port": "transfer",
+        "destination_channel": "channel-0",
+        "sequence": 1,
+        "data": {
+            "denom": "uatom",  # foreign: did not originate here
+            "amount": "999",
+            "sender": "00" * 20,
+            "receiver": recv.hex(),
+        },
+    }
+    ack = app.relay_recv_packet(packet)
+    assert "error" in ack and "only native denom" in ack["error"]
+    assert app.bank.balance(_ctx(app), recv) == 10**12  # nothing minted
+
+
+def test_native_token_round_trip():
+    """utia leaves via transfer, comes back with the unwound denom path,
+    and unescrows to the receiver (ReceiverChainIsSource)."""
+    app, signer, privs = make_app()
+    _open_channel(app)
+    ctx = _ctx(app)
+    a0 = privs[0].public_key().address()
+    a2 = privs[2].public_key().address()
+    pkt = app.ibc.transfer.send_transfer(ctx, "channel-0", a0, "remote-addr", "utia", 7_000)
+    esc = ibc.escrow_address("transfer", "channel-0")
+    assert app.bank.balance(ctx, esc) == 7_000
+
+    # the counterparty sends it back: denom now carries OUR port/channel as
+    # the first hop from ITS perspective -> source is channel-1, and the
+    # denom path unwinds through the packet's source
+    back = {
+        "source_port": "transfer",
+        "source_channel": "channel-1",
+        "destination_port": "transfer",
+        "destination_channel": "channel-0",
+        "sequence": 1,
+        "data": {
+            "denom": "transfer/channel-1/utia",
+            "amount": "7000",
+            "sender": "ff" * 20,
+            "receiver": a2.hex(),
+        },
+    }
+    bal2 = app.bank.balance(ctx, a2)
+    ack = app.relay_recv_packet(back)
+    assert "error" not in ack, ack
+    ctx = _ctx(app)
+    assert app.bank.balance(ctx, a2) == bal2 + 7_000
+    assert app.bank.balance(ctx, esc) == 0
+
+
+def test_error_ack_refunds_sender():
+    app, signer, privs = make_app()
+    _open_channel(app)
+    ctx = _ctx(app)
+    a0 = privs[0].public_key().address()
+    bal = app.bank.balance(ctx, a0)
+    pkt = app.ibc.transfer.send_transfer(ctx, "channel-0", a0, "remote", "utia", 3_000)
+    assert app.bank.balance(ctx, a0) == bal - 3_000
+    app.relay_acknowledge(pkt, {"error": "counterparty rejected"})
+    assert app.bank.balance(_ctx(app), a0) == bal
+
+
+def test_timeout_refunds_sender():
+    app, signer, privs = make_app()
+    _open_channel(app)
+    ctx = _ctx(app)
+    a0 = privs[0].public_key().address()
+    bal = app.bank.balance(ctx, a0)
+    pkt = app.ibc.transfer.send_transfer(ctx, "channel-0", a0, "remote", "utia", 3_000)
+    app.relay_timeout(pkt)
+    assert app.bank.balance(_ctx(app), a0) == bal
+
+
+def test_unknown_channel_rejected():
+    app, signer, privs = make_app()
+    ctx = _ctx(app)
+    a0 = privs[0].public_key().address()
+    with pytest.raises(ibc.IBCError):
+        app.ibc.transfer.send_transfer(ctx, "channel-9", a0, "r", "utia", 1)
+
+
+def test_replayed_recv_does_not_double_unescrow():
+    app, signer, privs = make_app()
+    _open_channel(app)
+    ctx = _ctx(app)
+    a0 = privs[0].public_key().address()
+    a2 = privs[2].public_key().address()
+    app.ibc.transfer.send_transfer(ctx, "channel-0", a0, "remote", "utia", 5_000)
+    back = {
+        "source_port": "transfer", "source_channel": "channel-1",
+        "destination_port": "transfer", "destination_channel": "channel-0",
+        "sequence": 1,
+        "data": {"denom": "transfer/channel-1/utia", "amount": "5000",
+                 "sender": "ff" * 20, "receiver": a2.hex()},
+    }
+    bal = app.bank.balance(ctx, a2)
+    ack1 = app.relay_recv_packet(back)
+    ack2 = app.relay_recv_packet(back)  # replay: same recorded ack, no effect
+    assert ack1 == ack2
+    assert app.bank.balance(_ctx(app), a2) == bal + 5_000  # once, not twice
+
+
+def test_duplicate_ack_does_not_double_refund():
+    app, signer, privs = make_app()
+    _open_channel(app)
+    ctx = _ctx(app)
+    a0 = privs[0].public_key().address()
+    bal = app.bank.balance(ctx, a0)
+    pkt = app.ibc.transfer.send_transfer(ctx, "channel-0", a0, "r", "utia", 2_000)
+    app.relay_acknowledge(pkt, {"error": "x"})
+    assert app.bank.balance(_ctx(app), a0) == bal  # refunded once
+    with pytest.raises(ibc.IBCError):
+        app.relay_acknowledge(pkt, {"error": "x"})  # replay rejected
+    with pytest.raises(ibc.IBCError):
+        app.relay_timeout(pkt)  # timeout after ack also rejected
+    assert app.bank.balance(_ctx(app), a0) == bal
+
+
+def test_malformed_packet_gets_error_ack_not_crash():
+    app, signer, privs = make_app()
+    _open_channel(app)
+    bad = {
+        "source_port": "transfer", "source_channel": "channel-1",
+        "destination_port": "transfer", "destination_channel": "channel-0",
+        "sequence": 9,
+        "data": {"denom": "transfer/channel-1/utia", "amount": "not-a-number",
+                 "sender": "zz", "receiver": "also-not-hex"},
+    }
+    ack = app.relay_recv_packet(bad)
+    assert "error" in ack
+
+
+def test_forged_ack_packet_cannot_drain_escrow():
+    """A timeout/ack whose packet bytes differ from the committed packet
+    (forged amount/sender) must not refund."""
+    app, signer, privs = make_app()
+    _open_channel(app)
+    ctx = _ctx(app)
+    a0 = privs[0].public_key().address()
+    attacker = privs[2].public_key().address()
+    pkt = app.ibc.transfer.send_transfer(ctx, "channel-0", a0, "r", "utia", 9_000)
+    forged = dict(pkt)
+    forged["data"] = dict(pkt["data"], amount="9000", sender=attacker.hex())
+    abal = app.bank.balance(ctx, attacker)
+    with pytest.raises(ibc.IBCError):
+        app.relay_timeout(forged)
+    assert app.bank.balance(_ctx(app), attacker) == abal
+    # the genuine packet still refunds the real sender
+    bal = app.bank.balance(_ctx(app), a0)
+    app.relay_timeout(pkt)
+    assert app.bank.balance(_ctx(app), a0) == bal + 9_000
