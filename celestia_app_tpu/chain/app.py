@@ -39,7 +39,14 @@ from celestia_app_tpu.chain.blob_validation import (
     batch_commitments,
     validate_blob_tx,
 )
-from celestia_app_tpu.chain.state import Context, GasMeter, InfiniteGasMeter, KVStore, OutOfGas
+from celestia_app_tpu.chain.state import (
+    Context,
+    GasMeter,
+    InfiniteGasMeter,
+    KVStore,
+    OutOfGas,
+    put_json,
+)
 from celestia_app_tpu.chain.tx import (
     MsgPayForBlobs,
     MsgRegisterEVMAddress,
@@ -221,20 +228,34 @@ class App:
     # ------------------------------------------------------------------
 
     def init_chain(self, genesis: dict) -> None:
-        """genesis = {accounts: [{address(hex), balance}], validators:
-        [{operator(hex), power}], time_unix, params...}"""
+        """genesis = {accounts: [{address(hex), balance, sequence?}],
+        validators: [{operator(hex), power}], time_unix, params...}.
+        Documents produced by export_genesis additionally carry
+        ``raw_modules`` (verbatim module state: delegations, params, grants,
+        attestations, ...), which replaces the fresh-validator setup and
+        restores account sequences so old-chain txs cannot replay."""
         ctx = self._deliver_ctx(InfiniteGasMeter())
         self.genesis_time = genesis.get("time_unix", time_mod.time())
         for acc in genesis.get("accounts", []):
             addr = bytes.fromhex(acc["address"])
-            self.auth.ensure_account(ctx, addr)
+            record = self.auth.ensure_account(ctx, addr)
             self.bank.mint(ctx, addr, acc["balance"])
-        for val in genesis.get("validators", []):
-            self.staking.set_validator(ctx, bytes.fromhex(val["operator"]), val["power"])
-        if "gov_max_square_size" in genesis:
-            p = self.blob.params(ctx)
-            p["gov_max_square_size"] = genesis["gov_max_square_size"]
-            self.blob.set_params(ctx, p)
+            seq = acc.get("sequence", 0)
+            if seq:
+                record["sequence"] = seq
+                put_json(ctx, self.auth.PREFIX + addr, record)
+        if "raw_modules" in genesis:
+            for khex, vhex in genesis["raw_modules"].items():
+                ctx.store.set(bytes.fromhex(khex), bytes.fromhex(vhex))
+        else:
+            for val in genesis.get("validators", []):
+                self.staking.set_validator(
+                    ctx, bytes.fromhex(val["operator"]), val["power"]
+                )
+            if "gov_max_square_size" in genesis:
+                p = self.blob.params(ctx)
+                p["gov_max_square_size"] = genesis["gov_max_square_size"]
+                self.blob.set_params(ctx, p)
         ctx.store.write()
         # genesis invariant assertion (crisis module's init-genesis check)
         check_ctx = self._ctx(self.store, InfiniteGasMeter(), check=False)
@@ -736,6 +757,53 @@ class App:
         self.last_block_hash = snap["last_block_hash"]
         self._check_state = None
         self.state_generation += 1
+
+    # module prefixes whose state is not derivable from balances and must be
+    # carried verbatim by an export (delegations, unbonding queues, params,
+    # reward indices, grants, attestations, signing info, channels, ...)
+    EXPORT_PREFIXES = (
+        b"staking/", b"dist/", b"gov/", b"blob/", b"minfee/", b"vesting/",
+        b"feegrant/", b"authz/", b"slashing/", b"signal/", b"blobstream/",
+        b"ibc/", b"mint/",
+    )
+
+    def export_genesis(self) -> dict:
+        """ExportAppStateAndValidators (reference app/export.go): a genesis
+        document that reproduces the committed state.
+
+        Balances come from the BANK records (every funded address, including
+        module pools and addresses that never signed), sequences from auth
+        (restored on init so old-chain txs cannot replay), and everything
+        non-derivable — delegations, unbonding queues, governed params,
+        reward indices, grants, attestations — rides verbatim in
+        ``raw_modules`` and is restored key-for-key."""
+        ctx = self._ctx(self.store, InfiniteGasMeter(), check=False)
+        accounts = []
+        for k, _v in ctx.store.iterate_prefix(b"bank/bal/"):
+            addr = k[len(b"bank/bal/"):]
+            acc = self.auth.account(ctx, addr)
+            accounts.append({
+                "address": addr.hex(),
+                "balance": self.bank.balance(ctx, addr),
+                "sequence": acc["sequence"] if acc else 0,
+            })
+        raw_modules = {}
+        for prefix in self.EXPORT_PREFIXES:
+            for k, v in ctx.store.iterate_prefix(prefix):
+                raw_modules[k.hex()] = v.hex()
+        validators = [
+            {"operator": op.hex(), "power": power}
+            for op, power in self.staking.validators(ctx)
+        ]
+        return {
+            "chain_id": self.chain_id,
+            "app_version": self.app_version,
+            "exported_height": self.height,
+            "time_unix": self.genesis_time,
+            "accounts": accounts,
+            "validators": validators,  # informational; raw_modules carries state
+            "raw_modules": raw_modules,
+        }
 
     def relay_recv_packet(self, packet: dict) -> dict:
         """Core-relay boundary: deliver an inbound IBC packet (the reference

@@ -11,6 +11,7 @@ commands (init/start/query/keys/rollback) plus the tools/ binaries. Here:
     python -m celestia_app_tpu query --home DIR PATH [JSON_DATA]
     python -m celestia_app_tpu keys derive SEED
     python -m celestia_app_tpu rollback --home DIR HEIGHT
+    python -m celestia_app_tpu export --home DIR
     python -m celestia_app_tpu blocktime --home DIR [--last N]
     python -m celestia_app_tpu blockscan --home DIR
     python -m celestia_app_tpu txsim --home DIR [--rounds N ...]
@@ -146,6 +147,12 @@ def cmd_rollback(args) -> int:
     return 0
 
 
+def cmd_export(args) -> int:
+    app, _ = _make_app(args.home)
+    print(json.dumps(app.export_genesis(), indent=2, sort_keys=True))
+    return 0
+
+
 def cmd_blocktime(args) -> int:
     from celestia_app_tpu.tools import blocktime
 
@@ -235,6 +242,10 @@ def main(argv=None) -> int:
     p.add_argument("--home", required=True)
     p.add_argument("height", type=int)
     p.set_defaults(fn=cmd_rollback)
+
+    p = sub.add_parser("export")
+    p.add_argument("--home", required=True)
+    p.set_defaults(fn=cmd_export)
 
     p = sub.add_parser("blocktime")
     p.add_argument("--home", required=True)

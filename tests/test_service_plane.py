@@ -212,3 +212,40 @@ def test_txsim_full_acceptance(tmp_path):
     assert rep.pfbs_accepted == rep.pfbs_submitted == 6
     assert rep.sends_accepted == rep.sends_submitted == 3
     assert rep.blocks == 3
+
+
+def test_export_genesis_reproduces_state(tmp_path):
+    from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+    from celestia_app_tpu.chain.staking import POWER_REDUCTION
+
+    app, signer, privs = _persistent_app(tmp_path)
+    _run_blocks(app, signer, privs)
+    ctx1 = Context(app.store, InfiniteGasMeter(), 0, 0, CHAIN, 1)
+    # non-operator delegation + a governed param change + a never-signing
+    # recipient balance must all survive the export round trip
+    d = privs[2].public_key().address()
+    v0 = privs[0].public_key().address()
+    app.staking.delegate(ctx1, v0, d, 2 * POWER_REDUCTION)
+    params = app.blob.params(ctx1)
+    params["gov_max_square_size"] = 32
+    app.blob.set_params(ctx1, params)
+    stranger = b"\x42" * 20  # bank balance, no auth account
+    app.bank.mint(ctx1, stranger, 777)
+
+    doc = app.export_genesis()
+    assert doc["exported_height"] == app.height
+    assert len(doc["validators"]) == 3
+
+    app2 = App(chain_id=doc["chain_id"], engine="host")
+    app2.init_chain(doc)
+    ctx2 = Context(app2.store, InfiniteGasMeter(), 0, 0, doc["chain_id"], 1)
+    for acc in doc["accounts"]:
+        addr = bytes.fromhex(acc["address"])
+        assert app2.bank.balance(ctx2, addr) == app.bank.balance(ctx1, addr)
+    assert app2.bank.balance(ctx2, stranger) == 777
+    assert app2.staking.delegation(ctx2, v0, d) == app.staking.delegation(ctx1, v0, d)
+    assert app2.blob.params(ctx2)["gov_max_square_size"] == 32
+    # sequences restored: the old chain's txs cannot replay at sequence 0
+    a0 = privs[0].public_key().address()
+    assert app2.auth.account(ctx2, a0)["sequence"] == app.auth.account(ctx1, a0)["sequence"] > 0
+    app2.crisis.assert_invariants(ctx2)
