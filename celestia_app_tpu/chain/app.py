@@ -238,15 +238,16 @@ class App:
         self.genesis_time = genesis.get("time_unix", time_mod.time())
         for acc in genesis.get("accounts", []):
             addr = bytes.fromhex(acc["address"])
-            record = self.auth.ensure_account(ctx, addr)
+            self.auth.ensure_account(ctx, addr)
             self.bank.mint(ctx, addr, acc["balance"])
-            seq = acc.get("sequence", 0)
-            if seq:
-                record["sequence"] = seq
-                put_json(ctx, self.auth.PREFIX + addr, record)
         if "raw_modules" in genesis:
+            # verbatim restore — includes auth/ (account numbers, pubkeys,
+            # sequences: anti-replay) overriding the fresh records above
             for khex, vhex in genesis["raw_modules"].items():
                 ctx.store.set(bytes.fromhex(khex), bytes.fromhex(vhex))
+            # height-anchored module state (blobstream ranges, unbonding
+            # heights) stays consistent by resuming the height counter
+            self.height = genesis.get("exported_height", 0)
         else:
             for val in genesis.get("validators", []):
                 self.staking.set_validator(
@@ -762,9 +763,9 @@ class App:
     # carried verbatim by an export (delegations, unbonding queues, params,
     # reward indices, grants, attestations, signing info, channels, ...)
     EXPORT_PREFIXES = (
-        b"staking/", b"dist/", b"gov/", b"blob/", b"minfee/", b"vesting/",
-        b"feegrant/", b"authz/", b"slashing/", b"signal/", b"blobstream/",
-        b"ibc/", b"mint/",
+        b"auth/", b"staking/", b"dist/", b"gov/", b"blob/", b"minfee/",
+        b"vesting/", b"feegrant/", b"authz/", b"slashing/", b"signal/",
+        b"blobstream/", b"ibc/", b"mint/",
     )
 
     def export_genesis(self) -> dict:
@@ -772,11 +773,13 @@ class App:
         document that reproduces the committed state.
 
         Balances come from the BANK records (every funded address, including
-        module pools and addresses that never signed), sequences from auth
-        (restored on init so old-chain txs cannot replay), and everything
-        non-derivable — delegations, unbonding queues, governed params,
-        reward indices, grants, attestations — rides verbatim in
-        ``raw_modules`` and is restored key-for-key."""
+        module pools and addresses that never signed); auth records (numbers,
+        pubkeys, sequences — anti-replay), delegations, unbonding queues,
+        governed params, reward indices, grants, and attestations ride
+        verbatim in ``raw_modules``. On import the height counter resumes at
+        exported_height so height-anchored state (blobstream windows,
+        unbonding heights) stays consistent (the reference's export.go
+        initial-height handling)."""
         ctx = self._ctx(self.store, InfiniteGasMeter(), check=False)
         accounts = []
         for k, _v in ctx.store.iterate_prefix(b"bank/bal/"):

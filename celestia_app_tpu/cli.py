@@ -30,6 +30,7 @@ import time
 
 
 def _make_app(home: str):
+    from celestia_app_tpu import appconsts
     from celestia_app_tpu.chain.app import App
 
     cfg_path = os.path.join(home, "config.json")
@@ -40,6 +41,9 @@ def _make_app(home: str):
         app_version=cfg.get("app_version", 1),
         engine=cfg.get("engine", "auto"),
         data_dir=os.path.join(home, "data"),
+        min_gas_price=cfg.get("min_gas_price", appconsts.DEFAULT_MIN_GAS_PRICE),
+        invariant_check_period=cfg.get("invariant_check_period", 0),
+        v2_upgrade_height=cfg.get("v2_upgrade_height"),
     )
     latest = app.db.latest_height()
     if latest is None:
@@ -52,6 +56,8 @@ def _make_app(home: str):
 
 
 def cmd_init(args) -> int:
+    from celestia_app_tpu import appconsts
+
     os.makedirs(args.home, exist_ok=True)
     accounts = []
     for spec in args.account or []:
@@ -70,7 +76,17 @@ def cmd_init(args) -> int:
         json.dump(genesis, f, indent=2)
     with open(os.path.join(args.home, "config.json"), "w") as f:
         json.dump(
-            {"chain_id": args.chain_id, "app_version": 1, "engine": args.engine},
+            {
+                # node-local config layer (SURVEY §5.6 layer 4 — the
+                # reference's app.toml/config.toml knobs)
+                "chain_id": args.chain_id,
+                "app_version": 1,
+                "engine": args.engine,
+                "min_gas_price": appconsts.DEFAULT_MIN_GAS_PRICE,
+                "invariant_check_period": 0,
+                "v2_upgrade_height": None,
+                "mempool_ttl_blocks": appconsts.MEMPOOL_TX_TTL_BLOCKS,
+            },
             f, indent=2,
         )
     print(f"initialized {args.home} (chain-id {args.chain_id})")
@@ -82,7 +98,12 @@ def cmd_start(args) -> int:
     from celestia_app_tpu.service.server import NodeService
 
     app, cfg = _make_app(args.home)
-    node = Node(app)
+    from celestia_app_tpu import appconsts
+
+    node = Node(
+        app,
+        mempool_ttl=cfg.get("mempool_ttl_blocks", appconsts.MEMPOOL_TX_TTL_BLOCKS),
+    )
     svc = NodeService(node, port=args.listen)
     svc.serve_background()
     print(

@@ -245,7 +245,13 @@ def test_export_genesis_reproduces_state(tmp_path):
     assert app2.bank.balance(ctx2, stranger) == 777
     assert app2.staking.delegation(ctx2, v0, d) == app.staking.delegation(ctx1, v0, d)
     assert app2.blob.params(ctx2)["gov_max_square_size"] == 32
-    # sequences restored: the old chain's txs cannot replay at sequence 0
+    # auth records restored verbatim: numbers AND sequences (anti-replay)
     a0 = privs[0].public_key().address()
-    assert app2.auth.account(ctx2, a0)["sequence"] == app.auth.account(ctx1, a0)["sequence"] > 0
+    assert app2.auth.account(ctx2, a0) == app.auth.account(ctx1, a0)
+    assert app2.auth.account(ctx2, a0)["sequence"] > 0
+    # height-anchored state stays consistent: the new chain resumes there
+    assert app2.height == doc["exported_height"]
+    blk, _ = app2.produce_block([], t=1_700_009_000.0)
+    assert blk.header.height == doc["exported_height"] + 1
+    ctx2 = Context(app2.store, InfiniteGasMeter(), app2.height, 0, doc["chain_id"], 1)
     app2.crisis.assert_invariants(ctx2)
