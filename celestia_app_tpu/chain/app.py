@@ -236,19 +236,27 @@ class App:
         restores account sequences so old-chain txs cannot replay."""
         ctx = self._deliver_ctx(InfiniteGasMeter())
         self.genesis_time = genesis.get("time_unix", time_mod.time())
-        for acc in genesis.get("accounts", []):
-            addr = bytes.fromhex(acc["address"])
-            self.auth.ensure_account(ctx, addr)
-            self.bank.mint(ctx, addr, acc["balance"])
         if "raw_modules" in genesis:
-            # verbatim restore — includes auth/ (account numbers, pubkeys,
-            # sequences: anti-replay) overriding the fresh records above
+            # verbatim module restore FIRST — auth/ (account numbers,
+            # pubkeys, sequences, the next-number counter) must be in place
+            # before ensure_account runs, or fresh numbers would collide
+            # with restored ones
             for khex, vhex in genesis["raw_modules"].items():
                 ctx.store.set(bytes.fromhex(khex), bytes.fromhex(vhex))
             # height-anchored module state (blobstream ranges, unbonding
             # heights) stays consistent by resuming the height counter
             self.height = genesis.get("exported_height", 0)
-        else:
+        for acc in genesis.get("accounts", []):
+            addr = bytes.fromhex(acc["address"])
+            record = self.auth.ensure_account(ctx, addr)
+            self.bank.mint(ctx, addr, acc["balance"])
+            seq = acc.get("sequence", 0)
+            if seq and record["sequence"] < seq:
+                # hand-authored genesis without raw_modules can still pin
+                # sequences (anti-replay); verbatim auth restore wins if both
+                record["sequence"] = seq
+                put_json(ctx, self.auth.PREFIX + addr, record)
+        if "raw_modules" not in genesis:
             for val in genesis.get("validators", []):
                 self.staking.set_validator(
                     ctx, bytes.fromhex(val["operator"]), val["power"]

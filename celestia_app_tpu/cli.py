@@ -195,8 +195,12 @@ def cmd_txsim(args) -> int:
     from celestia_app_tpu.client.tx_client import Signer
     from celestia_app_tpu.tools import txsim
 
+    from celestia_app_tpu import appconsts as _consts
+
     app, cfg = _make_app(args.home)
-    node = Node(app)
+    node = Node(
+        app, mempool_ttl=cfg.get("mempool_ttl_blocks", _consts.MEMPOOL_TX_TTL_BLOCKS)
+    )
     signer = Signer(app.chain_id)
     accounts = []
     for i in range(args.accounts):

@@ -121,6 +121,37 @@ class BlockProver:
             end_share=end_share,
         )
 
+    def commitment_from_eds(
+        self, square: Square, pfb_index: int, blob_index: int,
+        subtree_root_threshold: int,
+    ) -> bytes:
+        """Blob share commitment recomputed from the committed EDS's cached
+        row trees — zero hashing beyond the final MMR fold.
+
+        Reference: pkg/inclusion/get_commit.go:12-30 with the
+        EDSSubTreeRootCacher — the non-interactive defaults guarantee each
+        MMR chunk of the blob aligns to a subtree of its row NMT, so every
+        subtree root is a node the device pass already computed."""
+        from celestia_app_tpu.da import commitment as commitment_mod
+
+        start, end = proof_mod.blob_share_range(square, pfb_index, blob_index)
+        n_shares = end - start
+        width = commitment_mod.subtree_width(n_shares, subtree_root_threshold)
+        sizes = commitment_mod.merkle_mountain_range_sizes(n_shares, width)
+        k = self.k
+        subtree_roots: list[bytes] = []
+        cursor = start
+        for size in sizes:
+            row, col = cursor // k, cursor % k
+            if col % size != 0 or col + size > k:
+                raise ValueError(
+                    "blob chunk not aligned to a row subtree (layout violation)"
+                )
+            level = size.bit_length() - 1
+            subtree_roots.append(self._node(row, level, col >> level))
+            cursor += size
+        return merkle_host.hash_from_leaves(subtree_roots)
+
     def prove_tx(self, square: Square, tx_index: int) -> ShareProof:
         """Tx inclusion proof (pkg/proof/proof.go:NewTxInclusionProof)."""
         from celestia_app_tpu.da import namespace as ns_mod

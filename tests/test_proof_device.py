@@ -71,3 +71,25 @@ def test_block_prover_rejects_bad_range():
     prover = proof_device.BlockProver(eds_obj, d)
     with pytest.raises(ValueError):
         prover.prove_shares(0, sq.size * sq.size + 1, b"\x00" * 29)
+
+
+@pytest.mark.backend
+def test_commitment_from_eds_matches_direct():
+    """pkg/inclusion GetCommitment analog: the commitment recomputed from
+    the committed EDS's cached row-tree nodes equals the one computed
+    directly from the blob bytes, for every blob in the block."""
+    rng = np.random.default_rng(7)
+    thr = appconsts.subtree_root_threshold(appconsts.LATEST_VERSION)
+    blobs = _blobs(rng, [100, 700, 480 * 3, 2500, 30])
+    sq = square.build(
+        [b"\x05tx"],
+        [square.PfbEntry(tx=bytes([i]) * 6, blobs=[b]) for i, b in enumerate(blobs)],
+        64, thr,
+    )
+    ods = dah.shares_to_ods(sq.share_bytes())
+    d, eds_obj, _ = dah.new_dah_from_ods(ods)
+    prover = proof_device.BlockProver(eds_obj, d)
+    for i, b in enumerate(blobs):
+        want = commitment.create_commitment(b, thr)
+        got = prover.commitment_from_eds(sq, i, 0, thr)
+        assert got == want, i
