@@ -119,6 +119,23 @@ DEFAULT_GAS_PER_BLOB_BYTE = 8
 DEFAULT_MAX_BYTES = DEFAULT_GOV_MAX_SQUARE_SIZE**2 * CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
 DEFAULT_MIN_GAS_PRICE = 0.002
 DEFAULT_NETWORK_MIN_GAS_PRICE = 0.000001
+
+# Gas prices that enter CONSENSUS state / decisions are fixed-point integers
+# in "atto" units (1e18 per utia-per-gas — the cosmos sdk.Dec precision).
+# Floats remain only at node-local boundaries (config files, display).
+ATTO = 10**18
+
+
+def gas_price_to_atto(price) -> int:
+    """Exact float-literal → integer atto conversion (0.002 → 2*10**15).
+
+    Uses the decimal string of the float so config literals convert to the
+    rational a human wrote, not the nearest binary double."""
+    from fractions import Fraction
+
+    if isinstance(price, int):
+        return price * ATTO
+    return int(Fraction(str(price)) * ATTO)
 DEFAULT_UPGRADE_HEIGHT_DELAY = 50_400  # ~7 days of 12s blocks (x/signal)
 
 # x/blob gas model (x/blob/types/payforblob.go:20-42,158-179)

@@ -107,6 +107,10 @@ class AnteHandler:
     min_gas_price: float = appconsts.DEFAULT_MIN_GAS_PRICE
     feegrant: object | None = None  # FeeGrantKeeper when enabled
 
+    def __post_init__(self):
+        # node-local floor, parsed once (it is fixed for the handler's life)
+        self._min_gas_price_atto = appconsts.gas_price_to_atto(self.min_gas_price)
+
     def run(self, ctx: Context, tx: Tx, simulate: bool = False) -> None:
         """Raises AnteError when the tx must be rejected; consumes gas."""
         body = tx.body
@@ -150,19 +154,26 @@ class AnteHandler:
             "tx size",
         )
 
-        # 4. fee check + deduction
-        floor = self.min_gas_price
-        if ctx.app_version >= 2:
-            floor = max(floor, self.minfee.network_min_gas_price(ctx))
-        if not ctx.is_check_tx:
+        # 4. fee check + deduction. The comparison is exact integer
+        # cross-multiplication in atto units — no float ever decides
+        # admission (fee_checker.go uses sdk.Dec the same way).
+        if ctx.is_check_tx:
+            floor_atto = self._min_gas_price_atto
+            if ctx.app_version >= 2:
+                floor_atto = max(
+                    floor_atto, self.minfee.network_min_gas_price_atto(ctx)
+                )
+        else:
             # at delivery only the network floor binds (fee_checker.go)
-            floor = (
-                self.minfee.network_min_gas_price(ctx) if ctx.app_version >= 2 else 0.0
+            floor_atto = (
+                self.minfee.network_min_gas_price_atto(ctx)
+                if ctx.app_version >= 2
+                else 0
             )
-        gas_price = body.fee / body.gas_limit
-        if gas_price < floor:
+        if body.fee * appconsts.ATTO < body.gas_limit * floor_atto:
             raise AnteError(
-                f"insufficient gas price: {gas_price:.9f} < min {floor:.9f}"
+                f"insufficient gas price: {body.fee / body.gas_limit:.9f} "
+                f"< min {floor_atto / appconsts.ATTO:.9f}"
             )
 
         signer = self._signer(body)

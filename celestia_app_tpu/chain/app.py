@@ -135,9 +135,11 @@ class App:
             return setter
 
         def _gov_param(key, lo, hi):
+            # periods are stored as whole seconds: ints only, so no float
+            # ever reaches the gov/params app-hash preimage
             def setter(ctx, value):
                 params = self.gov.params(ctx)
-                params[key] = _require(value, float, lo, hi)
+                params[key] = _require(value, int, lo, hi)
                 self.gov.set_params(ctx, params)
             return setter
 
@@ -155,8 +157,8 @@ class App:
                     ctx, _require(v, int, 100, 10_000)
                 ),
             "gov/min_deposit": lambda ctx, v: _gov_min_deposit(ctx, v),
-            "gov/voting_period": _gov_param("voting_period", 1.0, 1e9),
-            "gov/max_deposit_period": _gov_param("max_deposit_period", 1.0, 1e9),
+            "gov/voting_period": _gov_param("voting_period", 1, 10**9),
+            "gov/max_deposit_period": _gov_param("max_deposit_period", 1, 10**9),
         }
 
         def _gov_min_deposit(ctx, v):

@@ -59,7 +59,7 @@ class Valset:
     nonce: int
     members: tuple[BridgeValidator, ...]
     height: int
-    time_unix: float
+    time_unix: int  # whole seconds: attestations live in consensus state
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,7 +67,7 @@ class DataCommitment:
     nonce: int
     begin_block: int  # inclusive
     end_block: int  # exclusive
-    time_unix: float
+    time_unix: int  # whole seconds (see Valset)
 
 
 def _att_to_json(att) -> dict:
@@ -265,7 +265,7 @@ class BlobstreamKeeper:
             nonce=self._next_nonce(ctx),
             members=tuple(members),
             height=ctx.height,
-            time_unix=ctx.time_unix,
+            time_unix=int(ctx.time_unix),
         )
 
     def _latest_of_kind(self, ctx: Context, kind_key: bytes):
@@ -345,7 +345,7 @@ class BlobstreamKeeper:
                             nonce=self._next_nonce(ctx),
                             begin_block=latest.end_block,
                             end_block=latest.end_block + window,
-                            time_unix=ctx.time_unix,
+                            time_unix=int(ctx.time_unix),
                         ),
                     )
                 else:
@@ -359,7 +359,7 @@ class BlobstreamKeeper:
                             nonce=self._next_nonce(ctx),
                             begin_block=1,
                             end_block=window + 1,
-                            time_unix=ctx.time_unix,
+                            time_unix=int(ctx.time_unix),
                         ),
                     )
                 else:

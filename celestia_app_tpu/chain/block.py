@@ -25,7 +25,13 @@ class Header:
         out = bytearray()
         out += uvarint(len(cid)) + cid
         out += uvarint(self.height)
-        out += int(self.time_unix * 1e9).to_bytes(8, "big")
+        # exact ns encoding: float*1e9 would exceed f64 integer range for
+        # unix times (1.7e18 > 2^53); split whole seconds from the sub-second
+        # fraction. Half-up rounding (int(x+0.5)), NOT Python's half-even
+        # round(), so Go's math.Round / C's round() reproduce the same hash.
+        whole = int(self.time_unix)
+        frac_ns = int((self.time_unix - whole) * 1e9 + 0.5)
+        out += (whole * 1_000_000_000 + frac_ns).to_bytes(8, "big")
         out += self.data_hash
         out += uvarint(self.square_size)
         out += self.app_hash

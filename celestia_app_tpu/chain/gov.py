@@ -29,11 +29,13 @@ from celestia_app_tpu.chain.state import Context, get_json, put_json
 
 # celestia mainnet-flavored defaults (scaled: periods in seconds)
 DEFAULT_MIN_DEPOSIT = 10_000_000_000  # 10,000 TIA in utia
-DEFAULT_MAX_DEPOSIT_PERIOD = 7 * 24 * 3600.0
-DEFAULT_VOTING_PERIOD = 7 * 24 * 3600.0
-QUORUM = 1 / 3
-THRESHOLD = 1 / 2
-VETO_THRESHOLD = 1 / 3
+DEFAULT_MAX_DEPOSIT_PERIOD = 7 * 24 * 3600
+DEFAULT_VOTING_PERIOD = 7 * 24 * 3600
+# Exact rationals (num, den): ratio tests are integer cross-multiplications
+# so no float ever decides a consensus outcome.
+QUORUM = (1, 3)
+THRESHOLD = (1, 2)
+VETO_THRESHOLD = (1, 3)
 
 # x/paramfilter: the reference blocks these from governance
 # (app/app.go:739-773 blockedParams)
@@ -139,7 +141,7 @@ class GovKeeper:
             "deposit": initial_deposit,
             "depositors": {proposer.hex(): initial_deposit},
             "status": "deposit_period",
-            "submit_time": ctx.time_unix,
+            "submit_time": int(ctx.time_unix),
             "voting_start": None,
             "voting_end": None,
         }
@@ -154,8 +156,8 @@ class GovKeeper:
 
     def _activate_voting(self, ctx: Context, p: dict) -> None:
         p["status"] = "voting_period"
-        p["voting_start"] = ctx.time_unix
-        p["voting_end"] = ctx.time_unix + self.params(ctx)["voting_period"]
+        p["voting_start"] = int(ctx.time_unix)
+        p["voting_end"] = int(ctx.time_unix) + int(self.params(ctx)["voting_period"])
 
     def deposit(self, ctx: Context, pid: int, depositor: bytes, amount: int) -> None:
         p = self.proposal(ctx, pid)
@@ -191,40 +193,42 @@ class GovKeeper:
         """SDK keeper/tally.go: delegator votes override their slice of the
         validator's inherited vote; counts are in token units."""
         votes = self._votes(ctx, pid)
-        counts = {o: 0.0 for o in VOTE_OPTIONS}
-        total_bonded = 0.0
+        counts = {o: 0 for o in VOTE_OPTIONS}  # integer utia token units
+        total_bonded = 0
         # validator base votes minus shares of delegators who voted directly
         for op, _power in self.staking.validators(ctx):
             v = self.staking.validator(ctx, op)
             total_bonded += v["tokens"]
             if v["shares"] == 0:
                 continue
-            rate = v["tokens"] / v["shares"]
             # shares of delegators who voted directly get deducted from the
             # validator's inherited vote
-            deducted = 0.0
+            deducted = 0
             for voter, option in votes.items():
                 if voter == op:
                     continue
                 shares = self.staking.delegation(ctx, op, voter)
                 if shares > 0:
-                    counts[option] += shares * rate
+                    counts[option] += shares * v["tokens"] // v["shares"]
                     deducted += shares
             if op in votes:
-                counts[votes[op]] += (v["shares"] - deducted) * rate
+                counts[votes[op]] += (v["shares"] - deducted) * v["tokens"] // v["shares"]
         voted = sum(counts.values())
         result = {
             "counts": counts,
             "voted": voted,
             "total_bonded": total_bonded,
         }
-        if total_bonded == 0 or voted / total_bonded < QUORUM:
+        q_num, q_den = QUORUM
+        v_num, v_den = VETO_THRESHOLD
+        t_num, t_den = THRESHOLD
+        if total_bonded == 0 or voted * q_den < total_bonded * q_num:
             result["outcome"] = "rejected_quorum"
-        elif voted > 0 and counts["veto"] / voted >= VETO_THRESHOLD:
+        elif voted > 0 and counts["veto"] * v_den >= voted * v_num:
             result["outcome"] = "rejected_veto"
         else:
             non_abstain = voted - counts["abstain"]
-            if non_abstain > 0 and counts["yes"] / non_abstain > THRESHOLD:
+            if non_abstain > 0 and counts["yes"] * t_den > non_abstain * t_num:
                 result["outcome"] = "passed"
             else:
                 result["outcome"] = "rejected"

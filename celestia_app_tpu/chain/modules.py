@@ -166,10 +166,14 @@ def estimate_pfb_gas(blob_sizes, gas_per_blob_byte: int = appconsts.DEFAULT_GAS_
 # mint: time-based inflation (x/mint)
 # ---------------------------------------------------------------------------
 
-INITIAL_INFLATION = 0.08
-DISINFLATION_RATE = 0.1  # inflation shrinks 10% per year
-TARGET_INFLATION = 0.015
-SECONDS_PER_YEAR = 365.2425 * 24 * 3600  # matching constants.go DaysPerYear=365.2425
+# Inflation as an exact rational in parts-per-million: 8% = 80_000 ppm,
+# shrinking ×0.9 per whole elapsed year, floored at 1.5% = 15_000 ppm
+# (x/mint/types/constants.go:17-25). All minter state is integers so the
+# app hash has no float semantics baked in.
+INITIAL_INFLATION_PPM = 80_000
+TARGET_INFLATION_PPM = 15_000
+PPM = 1_000_000
+SECONDS_PER_YEAR = 31_556_952  # 365.2425 days (constants.go DaysPerYear), exact
 
 
 class MintKeeper:
@@ -177,10 +181,10 @@ class MintKeeper:
 
     def minter(self, ctx: Context) -> dict:
         return _get(ctx, self.STATE) or {
-            "inflation": INITIAL_INFLATION,
+            "inflation_ppm": INITIAL_INFLATION_PPM,
             "genesis_time": None,
             "previous_block_time": None,
-            "annual_provisions": 0.0,
+            "annual_provisions": 0,
             "bond_denom": appconsts.BOND_DENOM,
         }
 
@@ -188,29 +192,36 @@ class MintKeeper:
         _put(ctx, self.STATE, m)
 
     @staticmethod
-    def inflation_rate(years_since_genesis: float) -> float:
-        """constants.go:17-25: 8% x 0.9^floor(years), floored at 1.5%."""
-        rate = INITIAL_INFLATION * (1 - DISINFLATION_RATE) ** int(max(0.0, years_since_genesis))
-        return max(rate, TARGET_INFLATION)
+    def inflation_rate_ppm(years_since_genesis: int) -> int:
+        """constants.go:17-25: 8% × 0.9^floor(years), floored at 1.5% —
+        computed exactly as 80_000 · 9^y / 10^y (floor)."""
+        y = max(0, int(years_since_genesis))
+        rate = INITIAL_INFLATION_PPM * 9**y // 10**y
+        return max(rate, TARGET_INFLATION_PPM)
 
     def begin_blocker(self, ctx: Context, bank: BankKeeper) -> int:
         """Mint block provision ∝ wall-clock since last block (minter.go:56-66)."""
         m = self.minter(ctx)
+        now = int(ctx.time_unix)
         if m["genesis_time"] is None:
-            m["genesis_time"] = ctx.time_unix
-            m["previous_block_time"] = ctx.time_unix
-            m["annual_provisions"] = m["inflation"] * bank.supply(ctx)
+            m["genesis_time"] = now
+            m["previous_block_time"] = now
+            m["annual_provisions"] = (
+                m["inflation_ppm"] * bank.supply(ctx) // PPM
+            )
             self.set_minter(ctx, m)
             return 0
-        years = (ctx.time_unix - m["genesis_time"]) / SECONDS_PER_YEAR
-        m["inflation"] = self.inflation_rate(years)
-        m["annual_provisions"] = m["inflation"] * bank.supply(ctx)
-        elapsed = max(0.0, ctx.time_unix - (m["previous_block_time"] or ctx.time_unix))
-        provision = int(m["annual_provisions"] * (elapsed / SECONDS_PER_YEAR))
+        years = (now - m["genesis_time"]) // SECONDS_PER_YEAR
+        m["inflation_ppm"] = self.inflation_rate_ppm(years)
+        m["annual_provisions"] = m["inflation_ppm"] * bank.supply(ctx) // PPM
+        elapsed = max(0, now - (m["previous_block_time"] or now))
+        provision = m["annual_provisions"] * elapsed // SECONDS_PER_YEAR
         if provision > 0:
             bank.mint(ctx, FEE_COLLECTOR, provision)
-            ctx.emit_event("mint", amount=provision, inflation=m["inflation"])
-        m["previous_block_time"] = ctx.time_unix
+            ctx.emit_event(
+                "mint", amount=provision, inflation_ppm=m["inflation_ppm"]
+            )
+        m["previous_block_time"] = now
         self.set_minter(ctx, m)
         return provision
 
@@ -290,13 +301,21 @@ class SignalKeeper:
 
 
 class MinFeeKeeper:
-    KEY = b"minfee/network_min_gas_price"
+    KEY = b"minfee/network_min_gas_price"  # int atto (1e18) utia-per-gas
 
-    def network_min_gas_price(self, ctx: Context) -> float:
+    def network_min_gas_price_atto(self, ctx: Context) -> int:
+        """The consensus value: integer atto units (1e18 per utia/gas)."""
         v = _get(ctx, self.KEY)
         if v is not None:
             return v
-        return appconsts.DEFAULT_NETWORK_MIN_GAS_PRICE
+        return appconsts.gas_price_to_atto(appconsts.DEFAULT_NETWORK_MIN_GAS_PRICE)
 
-    def set_network_min_gas_price(self, ctx: Context, price: float) -> None:
-        _put(ctx, self.KEY, price)
+    def network_min_gas_price(self, ctx: Context) -> float:
+        """Display-only float view (status endpoints, logs)."""
+        return self.network_min_gas_price_atto(ctx) / appconsts.ATTO
+
+    def set_network_min_gas_price(self, ctx: Context, price) -> None:
+        """Accepts a float/decimal literal or an already-scaled int is NOT
+        assumed: any numeric input is interpreted as utia-per-gas and scaled
+        exactly (0.000001 → 10**12 atto)."""
+        _put(ctx, self.KEY, appconsts.gas_price_to_atto(price))

@@ -48,22 +48,30 @@ def _get(ctx, key: bytes):
 
 DISTRIBUTION_POOL = b"\x00" * 19 + b"\x05"
 
+# Reward indices are integers scaled by INDEX_SCALE: utia-per-share-unit in
+# 1e18ths (the SDK's sdk.Dec precision). Shares themselves are integer share
+# units (staking.SHARE_SCALE per utia), so every value the store sees is an
+# int and the app hash is reproducible by any implementation.
+INDEX_SCALE = 10**18
+
 
 class DistributionKeeper:
-    IDX = b"dist/val_index/"  # cumulative rewards-per-share (float)
+    IDX = b"dist/val_index/"  # cumulative rewards-per-share (int, 1e18 scale)
     REF = b"dist/del_ref/"  # (operator+delegator) -> index at last touch
-    ACC = b"dist/del_acc/"  # accrued-but-unclaimed rewards
+    ACC = b"dist/del_acc/"  # accrued-but-unclaimed rewards (int utia)
 
     def __init__(self, staking, bank):
         self.staking = staking
         self.bank = bank
 
-    def _index(self, ctx: Context, op: bytes) -> float:
-        return _get(ctx, self.IDX + op) or 0.0
+    def _index(self, ctx: Context, op: bytes) -> int:
+        return _get(ctx, self.IDX + op) or 0
 
     def allocate(self, ctx: Context) -> int:
         """BeginBlocker: move the fee collector's balance into per-validator
-        reward indices, proportional to power (allocation.go:14-80)."""
+        reward indices, proportional to power (allocation.go:14-80).
+        Flooring leaves dust in DISTRIBUTION_POOL — the SDK's community-pool
+        remainder analog."""
         pot = self.bank.balance(ctx, FEE_COLLECTOR)
         if pot <= 0:
             return 0
@@ -79,29 +87,33 @@ class DistributionKeeper:
             return 0
         self.bank.send(ctx, FEE_COLLECTOR, DISTRIBUTION_POOL, pot)
         for op, power, v in vals:
-            share = pot * power / total
-            _put(ctx, self.IDX + op, self._index(ctx, op) + share / v["shares"])
+            share = pot * power // total
+            _put(
+                ctx,
+                self.IDX + op,
+                self._index(ctx, op) + share * INDEX_SCALE // v["shares"],
+            )
         return pot
 
-    def _settle(self, ctx: Context, op: bytes, delegator: bytes) -> float:
+    def _settle(self, ctx: Context, op: bytes, delegator: bytes) -> int:
         """Bank accrued rewards up to the current index (called before any
         delegation change and by withdraw)."""
         shares = self.staking.delegation(ctx, op, delegator)
         key = self.REF + op + delegator
-        ref = _get(ctx, key) or 0.0
+        ref = _get(ctx, key) or 0
         idx = self._index(ctx, op)
-        accrued = shares * (idx - ref)
+        accrued = shares * (idx - ref) // INDEX_SCALE
         if accrued:
             acc_key = self.ACC + op + delegator
-            _put(ctx, acc_key, (_get(ctx, acc_key) or 0.0) + accrued)
+            _put(ctx, acc_key, (_get(ctx, acc_key) or 0) + accrued)
         _put(ctx, key, idx)
         return accrued
 
     def pending_rewards(self, ctx: Context, op: bytes, delegator: bytes) -> int:
         shares = self.staking.delegation(ctx, op, delegator)
-        ref = _get(ctx, self.REF + op + delegator) or 0.0
-        acc = _get(ctx, self.ACC + op + delegator) or 0.0
-        return int(acc + shares * (self._index(ctx, op) - ref))
+        ref = _get(ctx, self.REF + op + delegator) or 0
+        acc = _get(ctx, self.ACC + op + delegator) or 0
+        return acc + shares * (self._index(ctx, op) - ref) // INDEX_SCALE
 
     # staking hook (registered in staking.hooks): settle before any
     # delegation change so new shares never accrue retroactive rewards and
@@ -112,7 +124,7 @@ class DistributionKeeper:
     def withdraw(self, ctx: Context, op: bytes, delegator: bytes) -> int:
         self._settle(ctx, op, delegator)
         acc_key = self.ACC + op + delegator
-        amount = int(_get(ctx, acc_key) or 0.0)
+        amount = _get(ctx, acc_key) or 0
         if amount > 0:
             self.bank.send(ctx, DISTRIBUTION_POOL, delegator, amount)
         ctx.store.delete(acc_key)
@@ -124,10 +136,11 @@ class DistributionKeeper:
 # ---------------------------------------------------------------------------
 
 SIGNED_BLOCKS_WINDOW = 5000
-MIN_SIGNED_PER_WINDOW = 0.75
-SLASH_FRACTION_DOWNTIME = 0.01
-SLASH_FRACTION_DOUBLE_SIGN = 0.05
-DOWNTIME_JAIL_SECONDS = 600.0
+MIN_SIGNED_PER_WINDOW = (3, 4)  # exact rational, sdk Dec "0.75"
+SLASH_FRACTION_DOWNTIME = (1, 100)
+SLASH_FRACTION_DOUBLE_SIGN = (5, 100)
+DOWNTIME_JAIL_SECONDS = 600
+JAILED_FOREVER = 1 << 62  # tombstone sentinel (JSON-safe, no float inf)
 
 
 class SlashingKeeper:
@@ -140,7 +153,7 @@ class SlashingKeeper:
         return _get(ctx, self.INFO + op) or {
             "missed": 0,
             "window_start": ctx.height,
-            "jailed_until": 0.0,
+            "jailed_until": 0,
             "tombstoned": False,
         }
 
@@ -152,23 +165,38 @@ class SlashingKeeper:
             info["window_start"] = ctx.height
         if not signed:
             info["missed"] += 1
-            allowed = SIGNED_BLOCKS_WINDOW * (1 - MIN_SIGNED_PER_WINDOW)
+            num, den = MIN_SIGNED_PER_WINDOW
+            allowed = SIGNED_BLOCKS_WINDOW * (den - num) // den
             if info["missed"] > allowed and not info["tombstoned"]:
-                self.staking.slash(ctx, op, SLASH_FRACTION_DOWNTIME)
-                info["jailed_until"] = ctx.time_unix + DOWNTIME_JAIL_SECONDS
+                # the SDK passes distributionHeight ≈ the current height to
+                # Slash for downtime (keeper/infractions.go), so existing
+                # unbonding/redelegation entries — created strictly earlier —
+                # are all spared; only the bonded stake is cut
+                self.staking.slash(
+                    ctx, op, SLASH_FRACTION_DOWNTIME,
+                    infraction_height=ctx.height,
+                )
+                info["jailed_until"] = int(ctx.time_unix) + DOWNTIME_JAIL_SECONDS
                 info["missed"] = 0
                 info["window_start"] = ctx.height
                 ctx.emit_event("slashing.downtime", validator=op.hex())
         _put(ctx, self.INFO + op, info)
 
-    def handle_equivocation(self, ctx: Context, op: bytes) -> None:
-        """x/evidence: double-sign slashes harder and tombstones forever."""
+    def handle_equivocation(
+        self, ctx: Context, op: bytes, infraction_height: int | None = None
+    ) -> None:
+        """x/evidence: double-sign slashes harder and tombstones forever.
+        `infraction_height` is the evidence's height (x/evidence handler
+        passes it so entries predating the double-sign are untouched)."""
         info = self.info(ctx, op)
         if info["tombstoned"]:
             return
-        self.staking.slash(ctx, op, SLASH_FRACTION_DOUBLE_SIGN)
+        self.staking.slash(
+            ctx, op, SLASH_FRACTION_DOUBLE_SIGN,
+            infraction_height=infraction_height,
+        )
         info["tombstoned"] = True
-        info["jailed_until"] = float("inf")
+        info["jailed_until"] = JAILED_FOREVER
         _put(ctx, self.INFO + op, info)
         ctx.emit_event("slashing.double_sign", validator=op.hex())
 
@@ -263,21 +291,24 @@ class VestingKeeper:
             raise ValueError("vesting end must follow start")
         _put(ctx, self.ACC + addr, {
             "original_vesting": original_vesting,
-            "start_time": start_time,
-            "end_time": end_time,
+            "start_time": int(start_time),
+            "end_time": int(end_time),
         })
 
     def locked(self, ctx: Context, addr: bytes) -> int:
         v = _get(ctx, self.ACC + addr)
         if v is None:
             return 0
-        t = ctx.time_unix
+        t = int(ctx.time_unix)
         if t >= v["end_time"]:
             return 0
         if t <= v["start_time"]:
             return v["original_vesting"]
-        frac = (v["end_time"] - t) / (v["end_time"] - v["start_time"])
-        return int(v["original_vesting"] * frac)
+        # integer pro-rata: locked = orig * remaining / total (floor)
+        return (
+            v["original_vesting"] * (v["end_time"] - t)
+            // (v["end_time"] - v["start_time"])
+        )
 
     def check_spendable(self, ctx: Context, bank, addr: bytes, amount: int) -> None:
         locked = self.locked(ctx, addr)

@@ -7,18 +7,35 @@ blobstream hooks on validator lifecycle events) with celestia's parameters
 
   staking/val/<operator>            validator record (tokens, shares, status)
   staking/del/<operator>/<delegator>  delegation shares
-  staking/ubd/<operator>/<delegator>  unbonding entries [{amount, completion}]
-  staking/red/...                     redelegation entries
+  staking/ubd/<operator>/<delegator>  unbonding entries
+                                      [{amount, completion, creation_height}]
+  staking/red/<src><dst><delegator>   redelegation entries
+                                      [{amount, completion, creation_height}]
+
+All consensus-state arithmetic is FIXED-POINT INTEGER: shares are integer
+"share units" (SHARE_SCALE units per utia at a 1:1 exchange rate), mirroring
+the SDK's 18-decimal `sdk.Dec` shares. No float ever enters `put_json`
+state, so a second implementation (e.g. the SURVEY §7.1.7 Go shim) can
+reproduce the app hash bit-for-bit.
 
 Semantics mirrored from the SDK keeper:
   - delegate: tokens -> shares at the validator's current exchange rate
-    (tokens/delegator_shares); bonded tokens leave the delegator's balance.
-  - undelegate: shares -> tokens enter the unbonding queue; returned to the
-    delegator's balance once ctx.time passes completion (EndBlocker).
-  - redelegate: instant move between validators (no unbonding wait, but
-    tracked so the source validator's power drop fires the blobstream hook).
-  - slash: burns a fraction of tokens (and pro-rata from unbonding entries),
-    jails the validator.
+    (shares/tokens, floor division); bonded tokens leave the delegator's
+    balance.
+  - undelegate: shares -> tokens enter the unbonding queue with the entry's
+    creation height recorded (x/staking UnbondingDelegationEntry.CreationHeight);
+    returned to the delegator once ctx.time passes completion (EndBlocker).
+  - redelegate: instant move between validators, but TRACKED as a
+    redelegation entry for MAX_ENTRIES limiting and destination slashing
+    (x/staking Redelegation.Entries), and the source power drop fires the
+    blobstream hook.
+  - slash(fraction, infraction_height): burns `fraction` of bonded tokens;
+    unbonding entries and redelegations are slashed ONLY if created at or
+    after the infraction height (x/staking keeper/slash.go SlashUnbondingDelegation
+    / SlashRedelegation — entries that predate the infraction are innocent).
+    Redelegated stake is slashed at the DESTINATION validator, pro-rata in
+    its shares. `infraction_height=None` (direct keeper calls/fixtures)
+    slashes all live entries.
   - power = bonded_tokens // POWER_REDUCTION; power changes feed
     x/blobstream's SignificantPowerDiff valset cadence (abci.go:84-136) and
     x/signal tallies.
@@ -30,6 +47,7 @@ fixtures: it creates a validator with self-delegated tokens = power * 1e6.
 from __future__ import annotations
 
 import json
+from fractions import Fraction
 
 from celestia_app_tpu import appconsts
 from celestia_app_tpu.chain.state import Context, get_json, put_json
@@ -37,6 +55,12 @@ from celestia_app_tpu.chain.state import Context, get_json, put_json
 POWER_REDUCTION = 1_000_000  # utia per unit of consensus power (sdk default)
 UNBONDING_TIME_SECONDS = 21 * 24 * 3600  # celestia mainnet: 21 days
 MAX_ENTRIES = 7  # sdk default: simultaneous unbonding entries per pair
+
+# Integer share units per utia at a 1:1 exchange rate (the SDK's sdk.Dec
+# carries 18 decimals; 12 here keeps share*token products well inside int64
+# ranges a native reimplementation would use, while leaving no observable
+# rounding at utia granularity).
+SHARE_SCALE = 10**12
 
 BONDED_POOL = b"\x00" * 19 + b"\x02"  # module account holding bonded tokens
 NOT_BONDED_POOL = b"\x00" * 19 + b"\x03"  # holds unbonding tokens
@@ -50,10 +74,21 @@ def _get(ctx, key: bytes):
     return get_json(ctx, key)
 
 
+def _as_fraction(fraction) -> Fraction:
+    """Accept 0.5, (1, 4), or Fraction; floats go through str() so literals
+    like 0.01 become exactly 1/100 (not the binary float's true value)."""
+    if isinstance(fraction, Fraction):
+        return fraction
+    if isinstance(fraction, tuple):
+        return Fraction(fraction[0], fraction[1])
+    return Fraction(str(fraction))
+
+
 class StakingKeeper:
     VAL = b"staking/val/"
     DEL = b"staking/del/"
     UBD = b"staking/ubd/"
+    RED = b"staking/red/"
     PARAMS = b"staking/params"
 
     def __init__(self, bank=None):
@@ -92,7 +127,7 @@ class StakingKeeper:
         self._set_val(
             ctx,
             operator,
-            {"tokens": 0, "shares": 0.0, "jailed": False, "bonded": True},
+            {"tokens": 0, "shares": 0, "jailed": False, "bonded": True},
         )
         for h in self.hooks:
             fn = getattr(h, "after_validator_created", None)
@@ -118,9 +153,9 @@ class StakingKeeper:
             # scale shares with tokens so the exchange rate is preserved,
             # and keep the bonded pool + supply consistent via mint/burn
             if v["tokens"] > 0:
-                v["shares"] *= new_tokens / v["tokens"]
+                v["shares"] = v["shares"] * new_tokens // v["tokens"]
             elif new_tokens > 0:
-                v["shares"] = float(new_tokens)
+                v["shares"] = new_tokens * SHARE_SCALE
             v["tokens"] = new_tokens
             self._set_val(ctx, operator, v)
             if self.bank is not None:
@@ -154,8 +189,9 @@ class StakingKeeper:
         # contain any value, so a delimiter would be ambiguous)
         return self.DEL + operator + delegator
 
-    def delegation(self, ctx: Context, operator: bytes, delegator: bytes) -> float:
-        return _get(ctx, self._del_key(operator, delegator)) or 0.0
+    def delegation(self, ctx: Context, operator: bytes, delegator: bytes) -> int:
+        """Delegated shares in integer share units (SHARE_SCALE per utia at 1:1)."""
+        return _get(ctx, self._del_key(operator, delegator)) or 0
 
     def delegations_of(self, ctx: Context, delegator: bytes):
         """[(operator, shares)] for one delegator (gov tally input)."""
@@ -167,10 +203,17 @@ class StakingKeeper:
                 out.append((op, json.loads(raw)))
         return out
 
-    def shares_to_tokens(self, v: dict, shares: float) -> int:
+    def shares_to_tokens(self, v: dict, shares: int) -> int:
         if v["shares"] == 0:
             return 0
-        return int(shares * v["tokens"] / v["shares"])
+        return shares * v["tokens"] // v["shares"]
+
+    def _shares_from_tokens(self, v: dict, amount: int) -> int:
+        """Shares worth `amount` utia at the current rate (floor, SDK
+        SharesFromTokens)."""
+        if v["shares"] == 0:
+            return amount * SHARE_SCALE
+        return amount * v["shares"] // v["tokens"]
 
     def delegate(
         self, ctx: Context, operator: bytes, delegator: bytes, amount: int
@@ -184,11 +227,7 @@ class StakingKeeper:
             self.bank.send(ctx, delegator, BONDED_POOL, amount)
         self._fire_delegation_hook(ctx, operator, delegator)
         # shares at current exchange rate (1:1 when no shares outstanding)
-        new_shares = (
-            float(amount)
-            if v["shares"] == 0
-            else amount * v["shares"] / v["tokens"]
-        )
+        new_shares = self._shares_from_tokens(v, amount)
         v["tokens"] += amount
         v["shares"] += new_shares
         self._set_val(ctx, operator, v)
@@ -196,10 +235,13 @@ class StakingKeeper:
         _put(ctx, key, (self.delegation(ctx, operator, delegator)) + new_shares)
         ctx.emit_event("staking.delegate", validator=operator.hex(), amount=amount)
 
-    def undelegate(
+    def _unbond_shares(
         self, ctx: Context, operator: bytes, delegator: bytes, amount: int
-    ) -> float:
-        """Begin unbonding `amount` utia; returns completion time."""
+    ) -> int:
+        """Validate + compute the share cost of removing `amount` utia.
+
+        A full exit (amount covering the delegation's whole token value)
+        removes ALL held shares so no dust delegation records linger."""
         v = self.validator(ctx, operator)
         if v is None:
             raise ValueError("unknown validator")
@@ -208,15 +250,30 @@ class StakingKeeper:
         if v["tokens"] <= 0 or v["shares"] <= 0:
             raise ValueError("validator has no bonded tokens")
         shares_held = self.delegation(ctx, operator, delegator)
-        shares_needed = amount * v["shares"] / v["tokens"]
-        if shares_needed > shares_held * (1 + 1e-12):
+        max_tokens = self.shares_to_tokens(v, shares_held)
+        if amount > max_tokens:
             raise ValueError("not enough delegated")
+        if amount == max_tokens:
+            return shares_held
+        return self._shares_from_tokens(v, amount)
+
+    def undelegate(
+        self, ctx: Context, operator: bytes, delegator: bytes, amount: int
+    ) -> int:
+        """Begin unbonding `amount` utia; returns completion time."""
+        shares_needed = self._unbond_shares(ctx, operator, delegator, amount)
         ubd_key = self.UBD + operator + delegator
         entries = _get(ctx, ubd_key) or []
         if len(entries) >= self.params(ctx)["max_entries"]:
             raise ValueError("too many unbonding entries")
-        completion = ctx.time_unix + self.params(ctx)["unbonding_time"]
-        entries.append({"amount": amount, "completion": completion})
+        completion = int(ctx.time_unix) + self.params(ctx)["unbonding_time"]
+        entries.append(
+            {
+                "amount": amount,
+                "completion": completion,
+                "creation_height": ctx.height,
+            }
+        )
         _put(ctx, ubd_key, entries)
         self._remove_shares(ctx, operator, delegator, shares_needed, amount)
         if self.bank is not None:
@@ -233,6 +290,9 @@ class StakingKeeper:
         )
         return completion
 
+    def _red_key(self, src: bytes, dst: bytes, delegator: bytes) -> bytes:
+        return self.RED + src + dst + delegator
+
     def redelegate(
         self,
         ctx: Context,
@@ -241,32 +301,35 @@ class StakingKeeper:
         delegator: bytes,
         amount: int,
     ) -> None:
-        """Instant move src -> dst (sdk allows without unbonding wait)."""
-        v_src = self.validator(ctx, src)
+        """Instant move src -> dst (no unbonding wait), tracked as a
+        redelegation entry so a later slash of src for an infraction that
+        predates the move still reaches the stake at dst (SlashRedelegation)."""
         v_dst = self.validator(ctx, dst)
-        if v_src is None or v_dst is None:
+        if v_dst is None:
             raise ValueError("unknown validator")
-        if amount <= 0:
-            raise ValueError("amount must be positive")
-        if v_src["tokens"] <= 0 or v_src["shares"] <= 0:
-            raise ValueError("source validator has no bonded tokens")
-        shares_needed = amount * v_src["shares"] / v_src["tokens"]
-        if shares_needed > self.delegation(ctx, src, delegator) * (1 + 1e-12):
-            raise ValueError("not enough delegated")
+        shares_needed = self._unbond_shares(ctx, src, delegator, amount)
+        red_key = self._red_key(src, dst, delegator)
+        red_entries = _get(ctx, red_key) or []
+        if len(red_entries) >= self.params(ctx)["max_entries"]:
+            raise ValueError("too many redelegation entries")
         self._remove_shares(ctx, src, delegator, shares_needed, amount)
         # credit dst at its exchange rate
         self._fire_delegation_hook(ctx, dst, delegator)
         v_dst = self.validator(ctx, dst)
-        new_shares = (
-            float(amount)
-            if v_dst["shares"] == 0
-            else amount * v_dst["shares"] / v_dst["tokens"]
-        )
+        new_shares = self._shares_from_tokens(v_dst, amount)
         v_dst["tokens"] += amount
         v_dst["shares"] += new_shares
         self._set_val(ctx, dst, v_dst)
         key = self._del_key(dst, delegator)
         _put(ctx, key, self.delegation(ctx, dst, delegator) + new_shares)
+        red_entries.append(
+            {
+                "amount": amount,
+                "completion": int(ctx.time_unix) + self.params(ctx)["unbonding_time"],
+                "creation_height": ctx.height,
+            }
+        )
+        _put(ctx, red_key, red_entries)
         # source power dropped: same hook the reference fires on redelegations
         for h in self.hooks:
             fn = getattr(h, "after_validator_begin_unbonding", None)
@@ -281,20 +344,20 @@ class StakingKeeper:
 
     def _remove_shares(
         self, ctx: Context, operator: bytes, delegator: bytes,
-        shares: float, tokens: int,
+        shares: int, tokens: int,
     ) -> None:
         self._fire_delegation_hook(ctx, operator, delegator)
         v = self.validator(ctx, operator)
         key = self._del_key(operator, delegator)
         remaining = self.delegation(ctx, operator, delegator) - shares
-        if remaining < 1e-9:
+        if remaining <= 0:
             ctx.store.delete(key)
         else:
             _put(ctx, key, remaining)
         v["tokens"] -= tokens
         v["shares"] -= shares
-        if v["shares"] < 1e-9:
-            v["shares"] = 0.0
+        if v["shares"] <= 0:
+            v["shares"] = 0
             v["tokens"] = max(v["tokens"], 0)
         self._set_val(ctx, operator, v)
 
@@ -313,34 +376,100 @@ class StakingKeeper:
             if fn is not None:
                 fn(ctx)
 
-    def slash(self, ctx: Context, operator: bytes, fraction: float) -> int:
-        """Burn `fraction` of the validator's bonded tokens AND of its
-        pending unbonding entries (the SDK slashes both so undelegating
-        cannot front-run a slash), then jail it."""
+    def slash(
+        self,
+        ctx: Context,
+        operator: bytes,
+        fraction,
+        infraction_height: int | None = None,
+    ) -> int:
+        """Burn `fraction` of the validator's bonded tokens, of unbonding
+        entries created at/after the infraction, and of stake redelegated
+        away at/after the infraction (slashed at the destination validator,
+        x/staking keeper/slash.go) — then jail the validator.
+
+        `fraction` may be a float literal (0.01 → exactly 1/100), a
+        (num, den) tuple, or a Fraction. `infraction_height=None` slashes
+        every live entry (fixture/legacy behavior)."""
+        frac = _as_fraction(fraction)
+        num, den = frac.numerator, frac.denominator
         v = self.validator(ctx, operator)
         if v is None:
             raise ValueError("unknown validator")
-        burned = int(v["tokens"] * fraction)
+        burned = v["tokens"] * num // den
         v["tokens"] -= burned
         v["jailed"] = True
         self._set_val(ctx, operator, v)
         if self.bank is not None and burned > 0:
             self.bank.burn(ctx, BONDED_POOL, burned)
+        # unbonding entries: only those created at/after the infraction
+        # (x/staking SlashUnbondingDelegation — older entries were already
+        # out when the offense happened)
         for k, raw in list(ctx.store.iterate_prefix(self.UBD + operator)):
             entries = json.loads(raw)
             for e in entries:
-                cut = int(e["amount"] * fraction)
+                if (
+                    infraction_height is not None
+                    and e.get("creation_height", 0) < infraction_height
+                ):
+                    continue
+                cut = e["amount"] * num // den
                 e["amount"] -= cut
                 burned += cut
                 if self.bank is not None and cut > 0:
                     self.bank.burn(ctx, NOT_BONDED_POOL, cut)
             _put(ctx, k, entries)
+        # redelegations out of this validator: slash the moved stake at its
+        # destination (SlashRedelegation), pro-rata in dst shares
+        for k, raw in list(ctx.store.iterate_prefix(self.RED + operator)):
+            rest = k[len(self.RED) + len(operator) :]
+            dst, delegator = rest[:20], rest[20:]
+            entries = json.loads(raw)
+            changed = False
+            for e in entries:
+                if (
+                    infraction_height is not None
+                    and e.get("creation_height", 0) < infraction_height
+                ):
+                    continue
+                cut = e["amount"] * num // den
+                if cut <= 0:
+                    continue
+                cut = self._slash_at_destination(ctx, dst, delegator, cut)
+                e["amount"] -= cut
+                burned += cut
+                changed = True
+            if changed:
+                _put(ctx, k, entries)
         for h in self.hooks:
             fn = getattr(h, "after_validator_begin_unbonding", None)
             if fn is not None:
                 fn(ctx)
         ctx.emit_event("staking.slash", validator=operator.hex(), burned=burned)
         return burned
+
+    def _slash_at_destination(
+        self, ctx: Context, dst: bytes, delegator: bytes, cut: int
+    ) -> int:
+        """Burn up to `cut` utia of `delegator`'s stake at validator `dst`;
+        returns the amount actually burned (bounded by what is still there)."""
+        v = self.validator(ctx, dst)
+        if v is None or v["tokens"] <= 0 or v["shares"] <= 0:
+            return 0
+        shares_held = self.delegation(ctx, dst, delegator)
+        if shares_held <= 0:
+            return 0
+        max_tokens = self.shares_to_tokens(v, shares_held)
+        cut = min(cut, max_tokens)
+        if cut <= 0:
+            return 0
+        shares_cut = (
+            shares_held if cut == max_tokens else self._shares_from_tokens(v, cut)
+        )
+        self._remove_shares(ctx, dst, delegator, shares_cut, cut)
+        if self.bank is not None:
+            self.bank.burn(ctx, BONDED_POOL, cut)
+        return cut
 
     def unjail(self, ctx: Context, operator: bytes) -> None:
         v = self.validator(ctx, operator)
@@ -350,7 +479,8 @@ class StakingKeeper:
         self._set_val(ctx, operator, v)
 
     def end_blocker(self, ctx: Context) -> list[tuple[bytes, int]]:
-        """Mature unbonding entries whose completion time has passed."""
+        """Mature unbonding entries whose completion time has passed, and
+        prune matured redelegation entries (their slash window closed)."""
         released = []
         for k, raw in list(ctx.store.iterate_prefix(self.UBD)):
             entries = json.loads(raw)
@@ -364,6 +494,12 @@ class StakingKeeper:
                     released.append((delegator, e["amount"]))
                 else:
                     keep.append(e)
+            if keep:
+                _put(ctx, k, keep)
+            else:
+                ctx.store.delete(k)
+        for k, raw in list(ctx.store.iterate_prefix(self.RED)):
+            keep = [e for e in json.loads(raw) if e["completion"] > ctx.time_unix]
             if keep:
                 _put(ctx, k, keep)
             else:

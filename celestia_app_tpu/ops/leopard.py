@@ -310,7 +310,7 @@ def _gf_invert(a: np.ndarray) -> np.ndarray:
     return out
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=1024)  # keyed by erasure pattern: bounded, unlike the k-keyed caches
 def decode_matrix(k: int, present: tuple[int, ...]) -> np.ndarray:
     """(k, k) matrix mapping k present codeword symbols → k data symbols.
 
@@ -538,7 +538,7 @@ def generator_matrix16(k: int) -> np.ndarray:
     return np.concatenate([np.eye(k, dtype=np.uint16), encode_matrix16(k)], axis=0)
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=1024)  # pattern-keyed: bounded (see decode_matrix)
 def decode_matrix16(k: int, present: tuple[int, ...]) -> np.ndarray:
     if len(present) != k:
         raise ValueError(f"need exactly {k} present positions")

@@ -10,10 +10,13 @@ TPU-native formulation of what the reference does with
 
 Instead of per-row scalar GF loops, each pass is ONE bit-matrix matmul on the
 MXU: bytes are unpacked to bits (LSB-first), parity_bits = (B @ data_bits) & 1
-with B = leopard.bit_matrix(k) of shape (8k, 8k) — the reference's Leopard-RS
-code (rsmt2d.NewLeoRSCodec) collapsed to a GF(2) matrix, so varied-data
-squares produce the reference's exact codewords — batched over all k rows /
-columns at once. For k=128 that is 3 matmuls of (1024,1024)x(1024,512) per
+with B = leopard.bit_matrix(k) of shape (8k, 8k) — the Leopard-RS
+construction the reference uses (rsmt2d.NewLeoRSCodec) collapsed to a GF(2)
+matrix — batched over all k rows / columns at once. Codeword bit-compat for
+varied data is argued structurally (see ops/leopard.py "residual risk": the
+FFT-output-to-parity ordering and no-bit-reversal conventions are pinned by
+construction and by the independent C++ reimplementation + round-trip
+decoder, not yet by an external rsmt2d-generated vector). For k=128 that is 3 matmuls of (1024,1024)x(1024,512) per
 batch of 128 — ~0.4 TFLOP total, well inside a v5e chip's budget.
 
 All functions are shape-static per power-of-two k bucket and cached per k.

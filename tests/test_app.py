@@ -212,10 +212,10 @@ def test_signal_upgrade_path():
 def test_mint_inflation_schedule():
     from celestia_app_tpu.chain.modules import MintKeeper
 
-    assert MintKeeper.inflation_rate(0.0) == pytest.approx(0.08)
-    assert MintKeeper.inflation_rate(1.5) == pytest.approx(0.08 * 0.9)
-    assert MintKeeper.inflation_rate(10.0) == pytest.approx(0.08 * 0.9**10)
-    assert MintKeeper.inflation_rate(40.0) == pytest.approx(0.015)  # floor
+    assert MintKeeper.inflation_rate_ppm(0) == 80_000
+    assert MintKeeper.inflation_rate_ppm(1) == 72_000  # 8% * 0.9
+    assert MintKeeper.inflation_rate_ppm(10) == 80_000 * 9**10 // 10**10
+    assert MintKeeper.inflation_rate_ppm(40) == 15_000  # floor
 
 
 def test_mint_provision_proportional_to_time():

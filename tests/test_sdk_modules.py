@@ -48,8 +48,9 @@ def test_slashing_downtime_jails_and_unjail_after_wait():
     app, signer, privs = make_app()
     op = privs[0].public_key().address()
     ctx = _ctx(app, t=1000.0)
-    allowed = sdk_modules.SIGNED_BLOCKS_WINDOW * (1 - sdk_modules.MIN_SIGNED_PER_WINDOW)
-    for i in range(int(allowed) + 1):
+    num, den = sdk_modules.MIN_SIGNED_PER_WINDOW
+    allowed = sdk_modules.SIGNED_BLOCKS_WINDOW * (den - num) // den
+    for i in range(allowed + 1):
         app.slashing.handle_signature(ctx, op, signed=False)
     assert app.staking.validator(ctx, op)["jailed"]
     with pytest.raises(ValueError):
@@ -67,7 +68,8 @@ def test_evidence_double_sign_tombstones():
     app.slashing.handle_equivocation(ctx, op)
     v = app.staking.validator(ctx, op)
     assert v["jailed"]
-    assert v["tokens"] == tokens - int(tokens * sdk_modules.SLASH_FRACTION_DOUBLE_SIGN)
+    num, den = sdk_modules.SLASH_FRACTION_DOUBLE_SIGN
+    assert v["tokens"] == tokens - tokens * num // den
     with pytest.raises(ValueError):
         app.slashing.unjail(_ctx(app, t=1e12), op)  # tombstoned forever
     # idempotent: a second report does not slash again
