@@ -13,12 +13,20 @@ and its data root is asserted bit-identical to this framework's pipelines.
 Prints ONE JSON line:
   {"metric": "extend_commit_128_ms", "value": <device ms/block>,
    "unit": "ms", "vs_baseline": <cpu_ms / device_ms>}
+
+Resilience (round-2 postmortem: the axon TPU relay can refuse to initialize,
+which killed the r02 measurement entirely): the default mode re-execs the
+measurement in a CHILD process and retries with backoff when the backend
+dies, so a transient relay flake cannot forfeit the round's number. On total
+failure it still prints one parseable JSON line with "value": null and the
+error tail, so the driver records WHY.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -27,6 +35,8 @@ import numpy as np
 K = 128
 BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_baseline.json")
+RETRIES = 3
+BACKOFF_S = (5, 30, 90)
 
 
 def _bench_ods(k: int) -> np.ndarray:
@@ -107,10 +117,11 @@ def _check_baseline_root(root: bytes) -> None:
 _ROOT_MISMATCH = False
 
 
-def measure_device(reps: int = 10) -> float:
-    """Device pipeline ms/block. The SHA-256 stage uses the Pallas register
-    kernel by default on accelerators; if that fails to compile on the
-    current toolchain, fall back to the jnp scan path and still report."""
+def measure_device(reps: int = 10) -> tuple[float, str]:
+    """Device pipeline (ms/block, sha_impl). The SHA-256 stage uses the
+    Pallas register kernel by default on accelerators; if that fails to
+    compile on the current toolchain, fall back to the jnp scan path and
+    still report."""
     import jax
 
     from celestia_app_tpu.da import eds as eds_mod
@@ -122,7 +133,7 @@ def measure_device(reps: int = 10) -> float:
         ms = _time_fn(eds_mod.jitted_pipeline(K), ods, reps)
         root = bytes(np.asarray(eds_mod.jitted_pipeline(K)(ods)[3]))
         _check_baseline_root(root)
-        return ms
+        return ms, "jnp"
     try:
         pallas_ms = _time_fn(eds_mod.jitted_pipeline(K), ods, reps)
         root_pallas = bytes(np.asarray(eds_mod.jitted_pipeline(K)(ods)[3]))
@@ -139,11 +150,11 @@ def measure_device(reps: int = 10) -> float:
         root_jnp = bytes(np.asarray(jnp_pipeline(ods)[3]))
         _check_baseline_root(root_jnp)
         if root_pallas == root_jnp:
-            return pallas_ms
+            return pallas_ms, "pallas"
         if root_pallas is not None:
             print("pallas/jnp data-root MISMATCH; reporting jnp path",
                   file=sys.stderr)
-        return _time_fn(jnp_pipeline, ods, reps)
+        return _time_fn(jnp_pipeline, ods, reps), "jnp"
     finally:
         if saved is None:
             os.environ.pop("CELESTIA_SHA256_IMPL", None)
@@ -214,9 +225,100 @@ def measure_proofs(n_proofs: int = 10_000) -> None:
     )
 
 
+def _run_child() -> None:
+    """One measurement attempt in THIS process (spawned by the parent)."""
+    if os.path.exists(BASELINE_FILE):
+        with open(BASELINE_FILE) as f:
+            cpu_ms = json.load(f)["cpu_ms"]
+    else:
+        cpu_ms, _, _ = measure_baseline()
+
+    device_ms, sha_impl = measure_device()
+    import jax
+
+    out = {
+        "metric": "extend_commit_128_ms",
+        "value": round(device_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(cpu_ms / device_ms, 2),
+        "sha_impl": sha_impl,
+        "backend": jax.devices()[0].platform,
+    }
+    if _ROOT_MISMATCH:
+        out["baseline_root_match"] = False
+    print(json.dumps(out))
+
+
+def _parse_last_json(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _run_parent() -> None:
+    """Re-exec the measurement in child processes with retry + backoff, so a
+    flaky TPU-relay init (the round-2 failure mode) gets fresh attempts in a
+    clean runtime. ALWAYS prints exactly one JSON line."""
+    errors = []
+    for attempt in range(RETRIES):
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                capture_output=True,
+                text=True,
+                timeout=1200,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            errors.append(f"attempt {attempt + 1}: timeout after 1200s")
+            r = None
+        if r is not None:
+            if r.returncode == 0:
+                parsed = _parse_last_json(r.stdout)
+                if parsed is not None:
+                    print(json.dumps(parsed))
+                    return
+                errors.append(
+                    f"attempt {attempt + 1}: rc=0 but no JSON in stdout: "
+                    f"{r.stdout[-300:]!r}"
+                )
+            else:
+                tail = (r.stderr or "").strip().splitlines()
+                errors.append(
+                    f"attempt {attempt + 1}: rc={r.returncode}: "
+                    + " | ".join(tail[-3:])
+                )
+        if attempt + 1 < RETRIES:
+            delay = BACKOFF_S[min(attempt, len(BACKOFF_S) - 1)]
+            print(f"bench attempt {attempt + 1} failed; retrying in "
+                  f"{delay}s", file=sys.stderr)
+            time.sleep(delay)
+    print(
+        json.dumps(
+            {
+                "metric": "extend_commit_128_ms",
+                "value": None,
+                "unit": "ms",
+                "error": "; ".join(errors)[-2000:],
+            }
+        )
+    )
+
+
 def main() -> None:
+    if "--child" in sys.argv:
+        _run_child()
+        return
     if "--proofs" in sys.argv:
         measure_proofs()
+        return
+    if "--stream" in sys.argv:
+        measure_stream()
         return
     if "--stages" in sys.argv:
         measure_stages()
@@ -238,23 +340,16 @@ def main() -> None:
         print(f"baseline measured: {ms:.1f} ms ({impl}) -> {BASELINE_FILE}",
               file=sys.stderr)
         return
+    _run_parent()
 
-    if os.path.exists(BASELINE_FILE):
-        with open(BASELINE_FILE) as f:
-            cpu_ms = json.load(f)["cpu_ms"]
-    else:
-        cpu_ms, _, _ = measure_baseline()
 
-    device_ms = measure_device()
-    out = {
-        "metric": "extend_commit_128_ms",
-        "value": round(device_ms, 3),
-        "unit": "ms",
-        "vs_baseline": round(cpu_ms / device_ms, 2),
-    }
-    if _ROOT_MISMATCH:
-        out["baseline_root_match"] = False
-    print(json.dumps(out))
+def measure_stream() -> None:
+    """BASELINE config 4/5: streaming PrepareProposal — overlap host layout
+    of block N+1 with device extend+commit of block N; prints blocks/s.
+    See parallel/streaming.py."""
+    from celestia_app_tpu.parallel import streaming
+
+    print(json.dumps(streaming.bench_stream()))
 
 
 if __name__ == "__main__":

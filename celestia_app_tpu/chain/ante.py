@@ -114,12 +114,16 @@ class AnteHandler:
     def run(self, ctx: Context, tx: Tx, simulate: bool = False) -> None:
         """Raises AnteError when the tx must be rejected; consumes gas."""
         body = tx.body
+        # protobuf txs carry no chain_id/account_number in their bytes —
+        # both bind through the SIGN_MODE_DIRECT sign doc, verified below
+        # with ctx values (the SDK's SigVerificationDecorator pattern)
+        is_proto = getattr(tx, "wire_format", "native") == "proto"
         # 1. basic validation
         if not body.msgs:
             raise AnteError("empty tx")
         if body.gas_limit <= 0:
             raise AnteError("zero gas limit")
-        if body.chain_id != ctx.chain_id:
+        if not is_proto and body.chain_id != ctx.chain_id:
             raise AnteError(f"wrong chain id {body.chain_id!r}")
         if body.timeout_height and ctx.height > body.timeout_height:
             raise AnteError("tx timed out")
@@ -199,7 +203,7 @@ class AnteHandler:
             if PublicKey(tx.pubkey).address() != signer:
                 raise AnteError("pubkey does not match signer address")
             acc = self.auth.ensure_account(ctx, signer)
-            if acc["number"] != body.account_number:
+            if not is_proto and acc["number"] != body.account_number:
                 raise AnteError(
                     f"account number mismatch: got {body.account_number}, want {acc['number']}"
                 )
@@ -207,7 +211,12 @@ class AnteHandler:
                 raise AnteError(
                     f"account sequence mismatch, expected {acc['sequence']}, got {body.sequence}"
                 )
-            if not tx.verify_signature():
+            if is_proto:
+                # sign doc covers chain id + account number: a tx signed for
+                # another chain or account number fails right here
+                if not tx.verify_signature(ctx.chain_id, acc["number"]):
+                    raise AnteError("signature verification failed")
+            elif not tx.verify_signature():
                 raise AnteError("signature verification failed")
             self.auth.set_pubkey(ctx, signer, tx.pubkey)
 

@@ -63,6 +63,7 @@ from celestia_app_tpu.chain.tx import (
     MsgVote,
     MsgTransfer,
     MsgExec,
+    decode_tx,
 )
 from celestia_app_tpu.da import blob as blob_mod
 from celestia_app_tpu.da import dah as dah_mod
@@ -312,11 +313,11 @@ class App:
         ctx = self._ctx(self._check_state.branch(), GasMeter(1 << 40), check=True)
         threshold = appconsts.subtree_root_threshold(self.app_version)
         try:
-            if blob_mod.is_blob_tx(raw):
-                btx = blob_mod.unmarshal_blob_tx(raw)
+            btx = blob_mod.try_unmarshal_blob_tx(raw)  # single parse
+            if btx is not None:
                 tx, _ = validate_blob_tx(btx, threshold)
             else:
-                tx = Tx.decode(raw)
+                tx = decode_tx(raw)
                 if any(isinstance(m, MsgPayForBlobs) for m in tx.body.msgs):
                     raise BlobTxError("MsgPayForBlobs without blobs (ErrNoBlobs)")
             gas = GasMeter(tx.body.gas_limit)
@@ -346,16 +347,19 @@ class App:
         normal_candidates: list[bytes] = []
         blob_candidates: list[tuple[bytes, PfbEntry]] = []
         for raw in raw_txs:
-            if blob_mod.is_blob_tx(raw):
+            try:
+                btx = blob_mod.try_unmarshal_blob_tx(raw)  # single parse
+            except ValueError:
+                continue
+            if btx is not None:
                 try:
-                    btx = blob_mod.unmarshal_blob_tx(raw)
                     validate_blob_tx(btx, threshold)
                     blob_candidates.append((raw, PfbEntry(btx.tx, btx.blobs)))
                 except (BlobTxError, ValueError):
                     continue
             else:
                 try:
-                    tx = Tx.decode(raw)
+                    tx = decode_tx(raw)
                 except ValueError:
                     continue
                 if any(isinstance(m, MsgPayForBlobs) for m in tx.body.msgs):
@@ -371,7 +375,7 @@ class App:
             )
             kept_n, kept_b = [], []
             for raw in normals:
-                tx = Tx.decode(raw)
+                tx = decode_tx(raw)
                 per_tx = ctx.branch()
                 per_tx.gas_meter = GasMeter(tx.body.gas_limit)
                 try:
@@ -381,7 +385,7 @@ class App:
                 except (ante_mod.AnteError, OutOfGas, ValueError):
                     continue
             for raw, entry in blobs:
-                tx = Tx.decode(entry.tx)
+                tx = decode_tx(entry.tx)
                 per_tx = ctx.branch()
                 per_tx.gas_meter = GasMeter(tx.body.gas_limit)
                 try:
@@ -467,12 +471,12 @@ class App:
         all_blobs: list = []
         seen_blob_scan = False
         for i, raw in enumerate(block.txs):
-            if blob_mod.is_blob_tx(raw):
+            try:
+                btx = blob_mod.try_unmarshal_blob_tx(raw)  # single parse
+            except ValueError as e:
+                raise ValueError(f"undecodable blob tx: {e}") from None
+            if btx is not None:
                 seen_blob_scan = True
-                try:
-                    btx = blob_mod.unmarshal_blob_tx(raw)
-                except ValueError as e:
-                    raise ValueError(f"undecodable blob tx: {e}") from None
                 parsed[i] = btx
                 all_blobs.extend(btx.blobs)
             elif seen_blob_scan:
@@ -481,7 +485,7 @@ class App:
         all_commitments = batch_commitments(all_blobs, threshold)
         cursor = 0
         for i, raw in enumerate(block.txs):
-            if blob_mod.is_blob_tx(raw):
+            if i in parsed:
                 btx = parsed[i]
                 n = len(btx.blobs)
                 tx, _ = validate_blob_tx(
@@ -499,7 +503,7 @@ class App:
                 pfb_entries.append(PfbEntry(btx.tx, btx.blobs))
             else:
                 # normal-after-blob ordering is enforced by the pre-scan above
-                tx = Tx.decode(raw)  # v2+: undecodable tx rejects the block
+                tx = decode_tx(raw)  # v2+: undecodable tx rejects the block
                 if any(isinstance(m, MsgPayForBlobs) for m in tx.body.msgs):
                     raise ValueError("PFB message in non-blob tx")
                 per_tx = ctx.branch()
@@ -553,12 +557,13 @@ class App:
         return results
 
     def _deliver_tx(self, block_ctx: Context, raw: bytes) -> TxResult:
-        if blob_mod.is_blob_tx(raw):
-            raw_tx = blob_mod.unmarshal_blob_tx(raw).tx  # strip blobs
-        else:
-            raw_tx = raw
         try:
-            tx = Tx.decode(raw_tx)
+            btx = blob_mod.try_unmarshal_blob_tx(raw)  # single parse
+        except ValueError as e:
+            return TxResult(1, f"undecodable blob tx: {e}", 0, 0, [])
+        raw_tx = btx.tx if btx is not None else raw  # strip blobs
+        try:
+            tx = decode_tx(raw_tx)
         except ValueError as e:
             return TxResult(1, f"undecodable tx: {e}", 0, 0, [])
         gas = GasMeter(tx.body.gas_limit)
@@ -694,8 +699,9 @@ class App:
             # durable commit: state + block hit disk atomically before the
             # commit is acknowledged (a killed process resumes here)
             self.db.save_block(block)  # block first: LATEST implies block exists
-            self.db.save_commit(self.height, self.store.snapshot(), meta)
+            self.db.save_commit(self.height, self.store, meta)
         else:
+            self.store.drain_changes()  # keep the change log bounded
             self._history[self.height] = {
                 "store": self.store.snapshot(),
                 "app_version": self.app_version,
@@ -726,7 +732,9 @@ class App:
         reference deletes store versions above the target)."""
         if self.db is None:
             raise ValueError("no data_dir attached")
-        self.db.save_commit(self.height, self.store.snapshot(), self._commit_meta())
+        self.db.save_commit(
+            self.height, self.store, self._commit_meta(), force_full=True
+        )
         self.db.delete_above(self.height)
 
     def load(self, height: int | None = None) -> None:

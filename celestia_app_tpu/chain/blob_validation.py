@@ -10,7 +10,7 @@ admission — which is why da/commitment.py batching is a benchmark config.
 
 from __future__ import annotations
 
-from celestia_app_tpu.chain.tx import MsgPayForBlobs, Tx
+from celestia_app_tpu.chain.tx import MsgPayForBlobs, Tx, decode_tx
 from celestia_app_tpu.da import commitment as commitment_mod
 from celestia_app_tpu.da.blob import BlobTx
 
@@ -43,7 +43,7 @@ def validate_blob_tx(
     if not btx.blobs:
         raise BlobTxError("blob tx contains no blobs")
     try:
-        tx = Tx.decode(btx.tx)
+        tx = decode_tx(btx.tx)
     except ValueError as e:
         raise BlobTxError(f"undecodable tx in blob tx: {e}") from None
 

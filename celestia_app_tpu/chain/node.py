@@ -14,7 +14,7 @@ import time as time_mod
 from celestia_app_tpu import appconsts
 from celestia_app_tpu.chain.app import App
 from celestia_app_tpu.chain.block import Block, TxResult
-from celestia_app_tpu.chain.tx import Tx
+from celestia_app_tpu.chain.tx import Tx, decode_tx
 from celestia_app_tpu.da import blob as blob_mod
 
 
@@ -41,8 +41,8 @@ class Node:
             return TxResult(1, "tx exceeds mempool max bytes", 0, 0, [])
         res = self.app.check_tx(raw)
         if res.code == 0:
-            inner = blob_mod.unmarshal_blob_tx(raw).tx if blob_mod.is_blob_tx(raw) else raw
-            tx = Tx.decode(inner)
+            btx = blob_mod.try_unmarshal_blob_tx(raw)  # single parse
+            tx = decode_tx(btx.tx if btx is not None else raw)
             self.mempool.append(
                 MempoolTx(
                     raw=raw,

@@ -2,11 +2,22 @@
 
 Reference parity: the cosmos-sdk commit multistore + CacheKV branching
 (baseapp's checkState/deliverState split, app/app.go:427-435) and the SDK gas
-meter. The store here is a single flat map with per-module key prefixes; the
-app hash is the RFC-6962 Merkle root over sorted (key, value) leaf hashes,
-recomputed per commit with a dirty-subtree shortcut left for later rounds.
+meter. The store here is a single flat map with per-module key prefixes.
+
+App hash (INCREMENTAL — the IAVL-commit-cost analog, app/app.go:427-435):
+keys hash into 65,536 buckets by the first two bytes of sha256(key); each
+bucket's hash is the RFC-6962 Merkle root over its sorted (key, value) leaf
+hashes; the app hash is the root of the fixed-depth-16 binary Merkle tree
+over all bucket hashes. Buckets and tree nodes are cached sparsely (only
+non-default entries stored), and writes mark buckets dirty, so commit cost
+is O(dirty keys × (bucket size + 16)) hash ops — independent of total state
+size. A 1M-key store re-commits a few touched keys in well under a
+millisecond, where the previous whole-store recompute was O(n).
+
 Commit history is kept so `load_height` (app/app.go:592 LoadHeight) and
-state-sync-style snapshots can roll back / export.
+state-sync-style snapshots can roll back / export. Writes are also recorded
+as a change log (`drain_changes`) so durable storage can persist per-commit
+deltas instead of full snapshots (chain/storage.py).
 """
 
 from __future__ import annotations
@@ -14,6 +25,23 @@ from __future__ import annotations
 import hashlib
 
 from celestia_app_tpu.utils import merkle_host
+
+_N_BUCKETS = 1 << 16
+_TREE_DEPTH = 16  # binary levels above the bucket layer
+
+
+def _default_level_hashes() -> list[bytes]:
+    """default[l] = hash of an all-empty subtree whose leaves are empty
+    buckets, for l = 16 (bucket layer) .. 0 (root)."""
+    out = [b""] * (_TREE_DEPTH + 1)
+    out[_TREE_DEPTH] = hashlib.sha256(b"").digest()  # RFC-6962 empty root
+    for level in range(_TREE_DEPTH - 1, -1, -1):
+        child = out[level + 1]
+        out[level] = hashlib.sha256(b"\x01" + child + child).digest()
+    return out
+
+
+_DEFAULTS = _default_level_hashes()
 
 
 def put_json(ctx_or_none, key: bytes, obj, *, store=None) -> None:
@@ -60,19 +88,57 @@ class InfiniteGasMeter(GasMeter):
 
 
 class KVStore:
-    """Flat committed store; branch() yields a cache layer for tx execution."""
+    """Flat committed store; branch() yields a cache layer for tx execution.
+
+    Carries the incremental app-hash tree (see module docstring) and a
+    change log for delta persistence."""
 
     def __init__(self, data: dict[bytes, bytes] | None = None):
         self._data: dict[bytes, bytes] = dict(data or {})
+        self._key_bucket: dict[bytes, int] = {}  # sha256-prefix cache
+        self._bucket_keys: dict[int, set[bytes]] | None = None  # lazy index
+        self._bucket_hash: dict[int, bytes] = {}  # non-empty buckets only
+        self._tree: list[dict[int, bytes]] = [dict() for _ in range(_TREE_DEPTH)]
+        self._dirty: set[int] = set()
+        self._tree_valid = False
+        self._changes: dict[bytes, bytes | None] = {}
+
+    def _bucket_of(self, key: bytes) -> int:
+        b = self._key_bucket.get(key)
+        if b is None:
+            d = hashlib.sha256(key).digest()
+            b = (d[0] << 8) | d[1]
+            self._key_bucket[key] = b
+        return b
+
+    def _index(self) -> dict[int, set[bytes]]:
+        if self._bucket_keys is None:
+            idx: dict[int, set[bytes]] = {}
+            for k in self._data:
+                idx.setdefault(self._bucket_of(k), set()).add(k)
+            self._bucket_keys = idx
+        return self._bucket_keys
 
     def get(self, key: bytes) -> bytes | None:
         return self._data.get(key)
 
     def set(self, key: bytes, value: bytes) -> None:
         self._data[key] = value
+        self._changes[key] = value
+        b = self._bucket_of(key)
+        if self._bucket_keys is not None:
+            self._bucket_keys.setdefault(b, set()).add(key)
+        self._dirty.add(b)
 
     def delete(self, key: bytes) -> None:
-        self._data.pop(key, None)
+        if self._data.pop(key, None) is not None:
+            self._changes[key] = None
+            b = self._bucket_of(key)
+            if self._bucket_keys is not None:
+                ks = self._bucket_keys.get(b)
+                if ks is not None:
+                    ks.discard(key)
+            self._dirty.add(b)
 
     def iterate_prefix(self, prefix: bytes):
         for k in sorted(self._data):
@@ -87,13 +153,68 @@ class KVStore:
 
     def restore(self, snap: dict[bytes, bytes]) -> None:
         self._data = dict(snap)
+        self._bucket_keys = None
+        self._bucket_hash = {}
+        self._tree = [dict() for _ in range(_TREE_DEPTH)]
+        self._dirty = set()
+        self._tree_valid = False
+        self._changes = {}
+
+    # -- change log (delta persistence) ---------------------------------
+
+    def drain_changes(self) -> dict[bytes, bytes | None]:
+        """Writes (value) and deletions (None) since the last drain."""
+        out = self._changes
+        self._changes = {}
+        return out
+
+    # -- incremental app hash -------------------------------------------
+
+    def _rehash_bucket(self, b: int) -> None:
+        keys = self._index().get(b)
+        if not keys:
+            self._bucket_hash.pop(b, None)
+            return
+        leaves = [
+            hashlib.sha256(k + b"\x00" + self._data[k]).digest()
+            for k in sorted(keys)
+        ]
+        self._bucket_hash[b] = merkle_host.hash_from_leaves(leaves)
+
+    def _node(self, level: int, i: int) -> bytes:
+        if level == _TREE_DEPTH:
+            return self._bucket_hash.get(i, _DEFAULTS[_TREE_DEPTH])
+        return self._tree[level].get(i, _DEFAULTS[level])
 
     def app_hash(self) -> bytes:
-        leaves = [
-            hashlib.sha256(k + b"\x00" + v).digest()
-            for k, v in sorted(self._data.items())
-        ]
-        return merkle_host.hash_from_leaves(leaves)
+        if not self._tree_valid:
+            # full (re)build: hash every non-empty bucket once
+            self._bucket_hash = {}
+            self._tree = [dict() for _ in range(_TREE_DEPTH)]
+            dirty = set(self._index().keys())
+            self._tree_valid = True
+        else:
+            dirty = self._dirty
+        for b in dirty:
+            self._rehash_bucket(b)
+        # ancestor updates LEVEL BY LEVEL (a node's two children must both be
+        # final before the parent hashes them — per-bucket path walks would
+        # read stale siblings when dirty buckets share ancestors)
+        touched = {b for b in dirty}
+        for level in range(_TREE_DEPTH - 1, -1, -1):
+            parents = {i >> 1 for i in touched}
+            for i in parents:
+                left = self._node(level + 1, 2 * i)
+                right = self._node(level + 1, 2 * i + 1)
+                if left == _DEFAULTS[level + 1] and right == _DEFAULTS[level + 1]:
+                    self._tree[level].pop(i, None)
+                else:
+                    self._tree[level][i] = hashlib.sha256(
+                        b"\x01" + left + right
+                    ).digest()
+            touched = parents
+        self._dirty = set()
+        return self._node(0, 0)
 
 
 class CacheStore(KVStore):

@@ -3,15 +3,21 @@
 Reference parity: the durable side of the reference node — cosmos-sdk's
 commit multistore persisted via IAVL/LevelDB plus celestia-core's block
 store (app/app.go:427-435 LoadLatestVersion, default_overrides.go pruning
-windows). The storage model here matches the framework's flat merkleized
-KV: every commit atomically persists the full store (gzip'd canonical JSON,
-hex keys/values) plus the chain identity, pruned to a rollback window, and
-every block (header + txs) is kept so proofs for past heights can be
-re-derived (pkg/proof/querier.go re-extends the square from block data).
+windows).
+
+Commit persistence is DELTA-BASED (the IAVL versioned-tree analog): most
+commits write only the keys touched since the previous commit (writes +
+deletions); a full snapshot is written every FULL_INTERVAL commits (and at
+the first durable commit), so loading height ``h`` = nearest full snapshot
+≤ h plus the delta chain up to h. Commit IO therefore scales with touched
+keys, not total state size. Every block (header + txs) is kept so proofs
+for past heights can be re-derived (pkg/proof/querier.go re-extends the
+square from block data).
 
 Layout under ``data_dir``:
 
-    state/<height:020d>.json.gz   committed store + identity at height
+    state/<height:020d>.json.gz   full store + identity at height
+    delta/<height:020d>.json.gz   changed/deleted keys + identity at height
     blocks/<height:020d>.json.gz  block: header fields + base64 txs
     LATEST                        latest committed height (atomic rename)
 
@@ -30,6 +36,7 @@ import os
 from celestia_app_tpu.chain.block import Block, Header
 
 PRUNE_KEEP = 100  # same rollback window the in-memory history kept
+FULL_INTERVAL = 64  # full snapshot cadence (state-sync interval analog)
 
 
 def _atomic_write(path: str, data: bytes) -> None:
@@ -53,6 +60,7 @@ class ChainDB:
     def __init__(self, data_dir: str):
         self.dir = data_dir
         os.makedirs(os.path.join(data_dir, "state"), exist_ok=True)
+        os.makedirs(os.path.join(data_dir, "delta"), exist_ok=True)
         os.makedirs(os.path.join(data_dir, "blocks"), exist_ok=True)
 
     # -- commits ---------------------------------------------------------
@@ -60,18 +68,69 @@ class ChainDB:
     def _state_path(self, height: int) -> str:
         return os.path.join(self.dir, "state", f"{height:020d}.json.gz")
 
+    def _delta_path(self, height: int) -> str:
+        return os.path.join(self.dir, "delta", f"{height:020d}.json.gz")
+
+    def _heights_in(self, sub: str) -> list[int]:
+        out = []
+        for name in os.listdir(os.path.join(self.dir, sub)):
+            if name.endswith(".json.gz"):
+                try:
+                    out.append(int(name.split(".")[0]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
     def save_commit(
-        self, height: int, store_data: dict[bytes, bytes], meta: dict
+        self,
+        height: int,
+        store,
+        meta: dict,
+        *,
+        force_full: bool = False,
     ) -> None:
-        doc = {
-            "height": height,
-            "meta": meta,
-            "store": {k.hex(): v.hex() for k, v in store_data.items()},
-        }
-        blob = gzip.compress(
-            json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+        """Persist one commit. ``store`` is the live KVStore: its change log
+        (drain_changes) becomes the delta; a full snapshot is written at the
+        first durable commit, every FULL_INTERVAL commits, or on demand."""
+        changes = store.drain_changes()
+        prior = self.latest_height()
+        if prior is not None and height <= prior:
+            # timeline rewrite (rollback then re-commit): stale state/delta/
+            # block files from the abandoned fork must not survive above this
+            # height, or a later load would chain the new fork's deltas into
+            # the old fork's (reconstructing a state that existed on neither)
+            self.delete_above(height)
+            force_full = True
+        fulls = self._heights_in("state")
+        write_full = (
+            force_full
+            or not fulls
+            or height % FULL_INTERVAL == 0
+            or height < max(fulls)  # fork guard belt-and-suspenders
         )
-        _atomic_write(self._state_path(height), blob)
+        if write_full:
+            doc = {
+                "height": height,
+                "meta": meta,
+                "store": {k.hex(): v.hex() for k, v in store.snapshot().items()},
+            }
+            blob = gzip.compress(
+                json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+            )
+            _atomic_write(self._state_path(height), blob)
+        else:
+            doc = {
+                "height": height,
+                "meta": meta,
+                "changes": {
+                    k.hex(): (None if v is None else v.hex())
+                    for k, v in changes.items()
+                },
+            }
+            blob = gzip.compress(
+                json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+            )
+            _atomic_write(self._delta_path(height), blob)
         _atomic_write(os.path.join(self.dir, "LATEST"), str(height).encode())
         self._prune(height)
 
@@ -82,23 +141,51 @@ class ChainDB:
         except FileNotFoundError:
             return None
 
+    def _read_doc(self, path: str) -> dict:
+        with gzip.open(path, "rb") as f:
+            return json.loads(f.read())
+
     def load_commit(self, height: int | None = None):
-        """-> (height, store_data, meta); latest when height is None."""
+        """-> (height, store_data, meta); latest when height is None.
+
+        Reconstructs: nearest full snapshot ≤ height, then the delta chain
+        (full, height]. Raises FileNotFoundError when the chain is broken
+        (pruned past, missing delta)."""
         if height is None:
             height = self.latest_height()
             if height is None:
                 raise FileNotFoundError("no committed state on disk")
-        with gzip.open(self._state_path(height), "rb") as f:
-            doc = json.loads(f.read())
+        fulls = [h for h in self._heights_in("state") if h <= height]
+        if not fulls:
+            raise FileNotFoundError(f"no snapshot at or below height {height}")
+        base = max(fulls)
+        doc = self._read_doc(self._state_path(base))
         store = {
             bytes.fromhex(k): bytes.fromhex(v) for k, v in doc["store"].items()
         }
-        return doc["height"], store, doc["meta"]
+        meta = doc["meta"]
+        deltas = [h for h in self._heights_in("delta") if base < h <= height]
+        expected = list(range(base + 1, height + 1))
+        if deltas != expected:
+            raise FileNotFoundError(
+                f"broken delta chain for height {height}: have {deltas[:5]}..., "
+                f"need {base + 1}..{height}"
+            )
+        for h in deltas:
+            d = self._read_doc(self._delta_path(h))
+            for k_hex, v_hex in d["changes"].items():
+                k = bytes.fromhex(k_hex)
+                if v_hex is None:
+                    store.pop(k, None)
+                else:
+                    store[k] = bytes.fromhex(v_hex)
+            meta = d["meta"]
+        return height, store, meta
 
     def delete_above(self, height: int) -> None:
         """Remove commits and blocks above `height` (rollback discards the
         abandoned fork, like the reference's rollback deleting versions)."""
-        for sub in ("state", "blocks"):
+        for sub in ("state", "delta", "blocks"):
             d = os.path.join(self.dir, sub)
             for name in os.listdir(d):
                 if not name.endswith(".json.gz"):
@@ -111,16 +198,20 @@ class ChainDB:
                     os.unlink(os.path.join(d, name))
 
     def _prune(self, latest: int) -> None:
-        state_dir = os.path.join(self.dir, "state")
-        for name in os.listdir(state_dir):
-            if not name.endswith(".json.gz"):
-                continue
-            try:
-                h = int(name.split(".")[0])
-            except ValueError:
-                continue
-            if h <= latest - PRUNE_KEEP:
-                os.unlink(os.path.join(state_dir, name))
+        """Prune outside the rollback window, keeping every height in
+        [latest-PRUNE_KEEP, latest] reconstructible: the newest full
+        snapshot at or below the window floor anchors the delta chain."""
+        floor = latest - PRUNE_KEEP
+        fulls = self._heights_in("state")
+        anchors = [h for h in fulls if h <= floor]
+        anchor = max(anchors) if anchors else None
+        for h in fulls:
+            if h != anchor and h <= floor:
+                os.unlink(self._state_path(h))
+        if anchor is not None:
+            for h in self._heights_in("delta"):
+                if h <= anchor:
+                    os.unlink(self._delta_path(h))
 
     # -- blocks ----------------------------------------------------------
 

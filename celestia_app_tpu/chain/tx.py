@@ -474,3 +474,11 @@ def sign_tx(body: TxBody, priv) -> Tx:
     """Sign a body with a chain.crypto.PrivateKey."""
     sig = priv.sign(sign_doc(body))
     return Tx(body=body, pubkey=priv.public_key().compressed, signature=sig)
+
+
+def decode_tx(raw: bytes):
+    """Wire dispatcher: protobuf TxRaw (the wire default, reference-
+    compatible — see wire/codec.py) or this framework's legacy codec."""
+    from celestia_app_tpu.wire import codec as wire_codec
+
+    return wire_codec.decode_any_tx(raw)

@@ -67,6 +67,22 @@ def cmd_init(args) -> int:
     for spec in args.validator or []:
         addr, power = spec.split("=")
         validators.append({"operator": addr, "power": int(power)})
+    if not accounts:
+        # fund the default txsim/dev key ring (`keys derive 0..9` seeds) so
+        # a fresh home is immediately usable — the reference's testnode
+        # genesis funds its well-known accounts the same way
+        from celestia_app_tpu.chain.crypto import PrivateKey
+
+        for i in range(10):
+            pk = PrivateKey.from_seed(str(i).encode())
+            accounts.append(
+                {
+                    "address": pk.public_key().address().hex(),
+                    "balance": 10**12,  # 1M TIA
+                }
+            )
+    if not validators:
+        validators.append({"operator": accounts[0]["address"], "power": 10})
     genesis = {
         "time_unix": time.time(),
         "accounts": accounts,

@@ -42,10 +42,15 @@ class Account:
 
 
 class Signer:
-    """Tracks account numbers/sequences and signs tx bodies (pkg/user Signer)."""
+    """Tracks account numbers/sequences and signs tx bodies (pkg/user Signer).
 
-    def __init__(self, chain_id: str):
+    `wire="proto"` (default) produces cosmos TxRaw bytes with
+    SIGN_MODE_DIRECT sign docs — what the reference's pkg/user/signer.go
+    emits; `wire="native"` keeps the framework's legacy codec."""
+
+    def __init__(self, chain_id: str, wire: str = "proto"):
         self.chain_id = chain_id
+        self.wire = wire
         self.accounts: dict[bytes, Account] = {}
 
     def add_account(self, priv: PrivateKey, number: int, sequence: int = 0) -> bytes:
@@ -53,7 +58,7 @@ class Signer:
         self.accounts[acc.address] = acc
         return acc.address
 
-    def create_tx(self, addr: bytes, msgs, fee: int, gas_limit: int, memo: str = "") -> Tx:
+    def create_tx(self, addr: bytes, msgs, fee: int, gas_limit: int, memo: str = ""):
         acc = self.accounts[addr]
         body = TxBody(
             msgs=tuple(msgs),
@@ -64,6 +69,10 @@ class Signer:
             gas_limit=gas_limit,
             memo=memo,
         )
+        if self.wire == "proto":
+            from celestia_app_tpu.wire import codec as wire_codec
+
+            return wire_codec.sign_tx_proto(body, acc.priv)
         return sign_tx(body, acc.priv)
 
     def create_pay_for_blobs(

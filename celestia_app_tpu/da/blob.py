@@ -6,10 +6,16 @@ data but stripped before execution, app/check_tx.go:16-54) and `IndexWrapper`
 (a PFB tx wrapped with the share indices of its blobs, as placed in the
 PAY_FOR_BLOB_NAMESPACE compact shares).
 
-Wire format is this framework's own deterministic encoding (not protobuf):
-4-byte magic, uvarint length prefixes, and FIXED 4-byte big-endian share
-indices — fixed width so a wrapped tx's byte length never depends on the
-index values, which keeps square layout a one-pass computation.
+BlobTx wire format: protobuf (celestia.core.v1.blob.BlobTx, type_id "BLOB" —
+x/blob/types/blob_tx.go:37-108 semantics) is the DEFAULT and what reference
+clients produce; the framework's legacy 4-byte-magic encoding is still
+accepted on unmarshal for old fixtures.
+
+IndexWrapper keeps the framework's fixed-width encoding inside squares
+(4-byte big-endian indices, so a wrapped tx's length never depends on index
+values and layout stays one-pass); the protobuf IndexWrapper codec lives in
+wire/txpb.py for interop tooling. This is a deliberate, documented deviation
+from go-square's in-square bytes.
 """
 
 from __future__ import annotations
@@ -48,6 +54,16 @@ class BlobTx:
 
 
 def marshal_blob_tx(tx: bytes, blobs: list[Blob]) -> bytes:
+    """Protobuf BlobTx (the reference wire format, blob.proto + type_id
+    "BLOB") — the default envelope."""
+    from celestia_app_tpu.wire import txpb
+
+    return txpb.blob_tx_pb(
+        tx, [(b.namespace.raw, b.data, b.share_version) for b in blobs]
+    )
+
+
+def marshal_blob_tx_legacy(tx: bytes, blobs: list[Blob]) -> bytes:
     out = bytearray(BLOB_TX_MAGIC)
     out += uvarint(len(tx)) + tx
     out += uvarint(len(blobs))
@@ -58,12 +74,52 @@ def marshal_blob_tx(tx: bytes, blobs: list[Blob]) -> bytes:
     return bytes(out)
 
 
+def _try_parse_proto_blob_tx(raw: bytes):
+    if not raw or raw[0] != 0x0A:  # protobuf field 1 (tx), length-delimited
+        return None
+    from celestia_app_tpu.wire import txpb
+
+    try:
+        tx, blob_tuples = txpb.parse_blob_tx(raw)
+    except (ValueError, UnicodeDecodeError):
+        return None
+    try:
+        blobs = tuple(
+            Blob(Namespace(ns), data, ver) for ns, data, ver in blob_tuples
+        )
+    except ValueError as e:
+        raise ValueError(f"invalid blob in BlobTx: {e}") from None
+    return BlobTx(tx=tx, blobs=blobs)
+
+
+def try_unmarshal_blob_tx(raw: bytes) -> BlobTx | None:
+    """One-shot envelope sniff+parse: the BlobTx when `raw` is one (either
+    wire format), None when it is a plain tx. Raises ValueError for a
+    well-formed envelope carrying invalid contents. Hot call sites should
+    use THIS once instead of is_blob_tx()+unmarshal_blob_tx() (which would
+    parse multi-megabyte envelopes twice)."""
+    proto = _try_parse_proto_blob_tx(raw)
+    if proto is not None:
+        return proto
+    if raw[:4] != BLOB_TX_MAGIC:
+        return None
+    return unmarshal_blob_tx(raw)
+
+
 def is_blob_tx(raw: bytes) -> bool:
-    return raw[:4] == BLOB_TX_MAGIC
+    if raw[:4] == BLOB_TX_MAGIC:
+        return True
+    try:
+        return _try_parse_proto_blob_tx(raw) is not None
+    except ValueError:
+        return True  # well-formed proto BlobTx envelope with a bad blob
 
 
 def unmarshal_blob_tx(raw: bytes) -> BlobTx:
-    if not is_blob_tx(raw):
+    proto = _try_parse_proto_blob_tx(raw)
+    if proto is not None:
+        return proto
+    if raw[:4] != BLOB_TX_MAGIC:
         raise ValueError("not a BlobTx envelope")
     off = 4
     tx_len, off = read_uvarint(raw, off)

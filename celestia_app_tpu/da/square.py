@@ -192,21 +192,47 @@ def build(
 ) -> Square:
     """Greedy fill in priority order, dropping txs that overflow (proposer).
 
-    TODO(perf): each admission re-runs a full _Layout (O(n^2 log n) overall);
-    switch to incremental cursor/share accounting for large mempools.
-    """
+    Admission is O(1) per candidate via running counters with WORST-CASE
+    padding accounting (each blob costs share_count + width−1: the maximum
+    non-interactive-default alignment gap, `next_share_index` math), the
+    same pessimistic-append design as go-square's Builder
+    (go-square square/builder.go, ref app/prepare_proposal.go:50). Since
+    worst-case ≥ exact, every admitted set is guaranteed to fit and the
+    single exact layout pass at the end never needs an eviction loop —
+    O(n log n) overall (the final sort) instead of the old per-admission
+    full relayout (O(n² log n))."""
+    cap = max_square_size * max_square_size
     kept_txs: list[bytes] = []
-    kept_pfbs: list[PfbEntry] = []
+    seq_len = 0
     for t in txs:
-        candidate = _Layout(kept_txs + [t], kept_pfbs, subtree_root_threshold)
-        if candidate.square_size() <= max_square_size:
+        cand_len = seq_len + len(uvarint(len(t))) + len(t)
+        if compact_shares_needed(cand_len) <= cap:
             kept_txs.append(t)
+            seq_len = cand_len
+    tx_shares = compact_shares_needed(seq_len)
+
+    kept_pfbs: list[PfbEntry] = []
+    pfb_seq_len = 0
+    blob_shares_worst = 0
     for e in pfbs:
-        candidate = _Layout(kept_txs, kept_pfbs + [e], subtree_root_threshold)
-        if candidate.square_size() <= max_square_size:
+        wrapped = blob_mod.index_wrapper_size(len(e.tx), len(e.blobs))
+        cand_pfb_len = pfb_seq_len + len(uvarint(wrapped)) + wrapped
+        cand_blob_worst = blob_shares_worst
+        for b in e.blobs:
+            count = b.share_count()
+            width = subtree_width(count, subtree_root_threshold)
+            cand_blob_worst += count + width - 1
+        total_worst = (
+            tx_shares + compact_shares_needed(cand_pfb_len) + cand_blob_worst
+        )
+        if total_worst <= cap:
             kept_pfbs.append(e)
+            pfb_seq_len = cand_pfb_len
+            blob_shares_worst = cand_blob_worst
     layout = _Layout(kept_txs, kept_pfbs, subtree_root_threshold)
-    return _export(layout, max(layout.square_size(), 1))
+    k = max(layout.square_size(), 1)
+    assert k <= max_square_size, "worst-case accounting must over-approximate"
+    return _export(layout, k)
 
 
 def empty_square() -> Square:

@@ -1,0 +1,3 @@
+"""Protobuf wire plane: byte-compatible encoding of the reference's tx
+formats (proto/celestia/blob/v1/tx.proto, proto/celestia/core/v1/blob/
+blob.proto, cosmos tx.proto) so reference clients/signers interoperate."""

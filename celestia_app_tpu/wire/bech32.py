@@ -1,0 +1,81 @@
+"""Bech32 (BIP-173) address encoding with Celestia's HRPs.
+
+The reference's protobuf messages carry bech32 STRINGS for addresses
+(e.g. MsgPayForBlobs.signer, proto/celestia/blob/v1/tx.proto:20), derived
+from 20-byte account bytes with HRP "celestia" (cosmos-sdk bech32 config).
+"""
+
+from __future__ import annotations
+
+CHARSET = "qpzry9x8gf2tvdw0s3jn54khce6mua7l"
+HRP_ACCOUNT = "celestia"
+HRP_VALOPER = "celestiavaloper"
+
+_GEN = (0x3B6A57B2, 0x26508E6D, 0x1EA119FA, 0x3D4233DD, 0x2A1462B3)
+
+
+def _polymod(values) -> int:
+    chk = 1
+    for v in values:
+        top = chk >> 25
+        chk = (chk & 0x1FFFFFF) << 5 ^ v
+        for i in range(5):
+            if (top >> i) & 1:
+                chk ^= _GEN[i]
+    return chk
+
+
+def _hrp_expand(hrp: str) -> list[int]:
+    return [ord(c) >> 5 for c in hrp] + [0] + [ord(c) & 31 for c in hrp]
+
+
+def _create_checksum(hrp: str, data: list[int]) -> list[int]:
+    values = _hrp_expand(hrp) + data
+    polymod = _polymod(values + [0, 0, 0, 0, 0, 0]) ^ 1
+    return [(polymod >> 5 * (5 - i)) & 31 for i in range(6)]
+
+
+def _convertbits(data, frombits: int, tobits: int, pad: bool) -> list[int]:
+    acc = 0
+    bits = 0
+    ret = []
+    maxv = (1 << tobits) - 1
+    for value in data:
+        if value < 0 or value >> frombits:
+            raise ValueError("invalid data value")
+        acc = (acc << frombits) | value
+        bits += frombits
+        while bits >= tobits:
+            bits -= tobits
+            ret.append((acc >> bits) & maxv)
+    if pad:
+        if bits:
+            ret.append((acc << (tobits - bits)) & maxv)
+    elif bits >= frombits or ((acc << (tobits - bits)) & maxv):
+        raise ValueError("invalid padding")
+    return ret
+
+
+def encode(data: bytes, hrp: str = HRP_ACCOUNT) -> str:
+    d5 = _convertbits(data, 8, 5, True)
+    checksum = _create_checksum(hrp, d5)
+    return hrp + "1" + "".join(CHARSET[d] for d in d5 + checksum)
+
+
+def decode(addr: str, expected_hrp: str | None = HRP_ACCOUNT) -> bytes:
+    if addr != addr.lower() and addr != addr.upper():
+        raise ValueError("mixed-case bech32")
+    addr = addr.lower()
+    pos = addr.rfind("1")
+    if pos < 1 or pos + 7 > len(addr):
+        raise ValueError("invalid bech32 structure")
+    hrp, rest = addr[:pos], addr[pos + 1 :]
+    if expected_hrp is not None and hrp != expected_hrp:
+        raise ValueError(f"wrong bech32 prefix {hrp!r} (want {expected_hrp!r})")
+    try:
+        data = [CHARSET.index(c) for c in rest]
+    except ValueError:
+        raise ValueError("invalid bech32 character") from None
+    if _polymod(_hrp_expand(hrp) + data) != 1:
+        raise ValueError("bad bech32 checksum")
+    return bytes(_convertbits(data[:-6], 5, 8, False))

@@ -1,0 +1,166 @@
+"""Minimal protobuf (proto3) wire-format primitives.
+
+Hand-rolled instead of a generated stack: the message set is small, the
+container has no protoc-python runtime guarantees, and — critically — the
+encoder must be canonical: fields emitted in ascending field-number order,
+default values omitted, repeated scalars packed. That matches what gogoproto
+`Marshal` produces for the reference's types (celestia-app's generated
+*.pb.go), so byte vectors pin compatibility.
+
+Wire types: 0 = varint, 1 = 64-bit, 2 = length-delimited, 5 = 32-bit.
+"""
+
+from __future__ import annotations
+
+
+def encode_varint(v: int) -> bytes:
+    if v < 0:
+        # proto3 negative int32/int64 are 10-byte two's-complement varints
+        v += 1 << 64
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def decode_varint(raw: bytes, off: int) -> tuple[int, int]:
+    shift = 0
+    result = 0
+    while True:
+        if off >= len(raw):
+            raise ValueError("truncated varint")
+        b = raw[off]
+        off += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, off
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint too long")
+
+
+def tag(field: int, wire_type: int) -> bytes:
+    return encode_varint((field << 3) | wire_type)
+
+
+def field_varint(field: int, v: int, *, emit_default: bool = False) -> bytes:
+    """Varint field; proto3 omits zero values."""
+    if v == 0 and not emit_default:
+        return b""
+    return tag(field, 0) + encode_varint(v)
+
+
+def field_bytes(field: int, data: bytes, *, emit_default: bool = False) -> bytes:
+    if not data and not emit_default:
+        return b""
+    return tag(field, 2) + encode_varint(len(data)) + data
+
+
+def field_string(field: int, s: str, *, emit_default: bool = False) -> bytes:
+    return field_bytes(field, s.encode(), emit_default=emit_default)
+
+
+def field_message(field: int, data: bytes, *, emit_default: bool = False) -> bytes:
+    """Nested message: emitted even when empty only if emit_default (proto3
+    distinguishes unset from empty for message fields; gogoproto emits set
+    submessages regardless of content)."""
+    if not data and not emit_default:
+        return b""
+    return tag(field, 2) + encode_varint(len(data)) + data
+
+
+def field_packed_uint(field: int, values) -> bytes:
+    """repeated uint32/uint64 — packed (proto3 default)."""
+    values = list(values)
+    if not values:
+        return b""
+    payload = b"".join(encode_varint(v) for v in values)
+    return tag(field, 2) + encode_varint(len(payload)) + payload
+
+
+def field_repeated_bytes(field: int, items) -> bytes:
+    return b"".join(field_bytes(field, it, emit_default=True) for it in items)
+
+
+class Fields:
+    """Parsed view of one message level: field number -> list of raw values.
+
+    Varint fields parse to int; length-delimited to bytes; 32/64-bit to raw
+    little-endian bytes. Unknown fields are preserved (kept in order) so a
+    decode-reencode of a message we fully model is byte-identical."""
+
+    def __init__(self, raw: bytes):
+        self.order: list[tuple[int, int, object]] = []  # (field, wt, value)
+        by_field: dict[int, list] = {}
+        off = 0
+        while off < len(raw):
+            key, off = decode_varint(raw, off)
+            field, wt = key >> 3, key & 7
+            if wt == 0:
+                v, off = decode_varint(raw, off)
+            elif wt == 2:
+                n, off2 = decode_varint(raw, off)
+                v = raw[off2 : off2 + n]
+                if len(v) != n:
+                    raise ValueError("truncated length-delimited field")
+                off = off2 + n
+            elif wt == 5:
+                v = raw[off : off + 4]
+                if len(v) != 4:
+                    raise ValueError("truncated fixed32")
+                off += 4
+            elif wt == 1:
+                v = raw[off : off + 8]
+                if len(v) != 8:
+                    raise ValueError("truncated fixed64")
+                off += 8
+            else:
+                raise ValueError(f"unsupported wire type {wt}")
+            self.order.append((field, wt, v))
+            by_field.setdefault(field, []).append(v)
+        self._by_field = by_field
+
+    def get_int(self, field: int, default: int = 0) -> int:
+        vs = self._by_field.get(field)
+        if not vs:
+            return default
+        v = vs[-1]
+        if not isinstance(v, int):
+            raise ValueError(f"field {field} is not a varint")
+        return v
+
+    def get_bytes(self, field: int, default: bytes = b"") -> bytes:
+        vs = self._by_field.get(field)
+        if not vs:
+            return default
+        v = vs[-1]
+        if not isinstance(v, bytes):
+            raise ValueError(f"field {field} is not length-delimited")
+        return v
+
+    def get_string(self, field: int, default: str = "") -> str:
+        return self.get_bytes(field, default.encode()).decode()
+
+    def repeated_bytes(self, field: int) -> list[bytes]:
+        return [v for v in self._by_field.get(field, []) if isinstance(v, bytes)]
+
+    def repeated_uint(self, field: int) -> list[int]:
+        """Packed or unpacked repeated varints (decoders must accept both)."""
+        out: list[int] = []
+        for v in self._by_field.get(field, []):
+            if isinstance(v, int):
+                out.append(v)
+            else:
+                off = 0
+                while off < len(v):
+                    x, off = decode_varint(v, off)
+                    out.append(x)
+        return out
+
+    def has(self, field: int) -> bool:
+        return field in self._by_field

@@ -283,15 +283,16 @@ def test_process_rejects_forged_blob_tx():
     good = signer.create_pay_for_blobs(a, [_blob(rng, b"fr", 300)], fee=10**8, gas_limit=10**8)
     prop = app.prepare_proposal([good], t=6.0)
     assert app.process_proposal(prop.block)
-    # forge: flip a signature byte inside the enveloped tx
+    # forge: flip a signature byte inside the enveloped (protobuf) tx
     from celestia_app_tpu.da import blob as blob_mod
-    from celestia_app_tpu.chain.tx import Tx
+    from celestia_app_tpu.chain.tx import decode_tx
+    from celestia_app_tpu.wire import txpb
 
     btx = blob_mod.unmarshal_blob_tx(prop.block.txs[0])
-    tx = Tx.decode(btx.tx)
+    tx = decode_tx(btx.tx)
     bad_sig = bytes([tx.signature[0] ^ 1]) + tx.signature[1:]
-    forged_tx = dataclasses.replace(tx, signature=bad_sig)
-    forged_raw = blob_mod.marshal_blob_tx(forged_tx.encode(), list(btx.blobs))
+    forged_bytes = txpb.tx_raw_pb(tx.body_bytes, tx.auth_info_bytes, bad_sig)
+    forged_raw = blob_mod.marshal_blob_tx(forged_bytes, list(btx.blobs))
     forged_block = Block(header=prop.block.header, txs=(forged_raw,))
     assert not app.process_proposal(forged_block)
 

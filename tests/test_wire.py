@@ -1,0 +1,365 @@
+"""Protobuf wire plane: byte compatibility, round-trips, BlobTx semantics.
+
+The hand-rolled encoder (wire/proto.py + wire/txpb.py) is cross-checked
+byte-for-byte against the REAL protobuf runtime (google.protobuf dynamic
+messages built from the reference's .proto schemas), so the framework's
+wire bytes are pinned to what gogoproto/protobuf produce — the
+reference-compatibility claim is verified, not asserted.
+"""
+
+import hashlib
+import json
+
+import pytest
+
+from celestia_app_tpu.chain import tx as itx
+from celestia_app_tpu.chain.crypto import PrivateKey
+from celestia_app_tpu.wire import bech32, codec, txpb
+from celestia_app_tpu.wire.proto import Fields, encode_varint, decode_varint
+
+
+# ---------------------------------------------------------------------------
+# dynamic protobuf schema (mirrors the reference .proto files)
+# ---------------------------------------------------------------------------
+
+
+def _build_pool():
+    from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+    pool = descriptor_pool.DescriptorPool()
+    f = descriptor_pb2.FileDescriptorProto()
+    f.name = "celestia_test.proto"
+    f.package = "t"
+    f.syntax = "proto3"
+
+    def msg(name, fields):
+        m = f.message_type.add()
+        m.name = name
+        for num, fname, ftype, label in fields:
+            fd = m.field.add()
+            fd.name = fname
+            fd.number = num
+            fd.type = ftype
+            fd.label = label
+        return m
+
+    D = descriptor_pb2.FieldDescriptorProto
+    OPT, REP = D.LABEL_OPTIONAL, D.LABEL_REPEATED
+    # celestia.blob.v1.MsgPayForBlobs (proto/celestia/blob/v1/tx.proto:17-35)
+    msg("MsgPayForBlobs", [
+        (1, "signer", D.TYPE_STRING, OPT),
+        (2, "namespaces", D.TYPE_BYTES, REP),
+        (3, "blob_sizes", D.TYPE_UINT32, REP),
+        (4, "share_commitments", D.TYPE_BYTES, REP),
+        (8, "share_versions", D.TYPE_UINT32, REP),
+    ])
+    # celestia.core.v1.blob.Blob / BlobTx (proto/celestia/core/v1/blob/blob.proto)
+    msg("Blob", [
+        (1, "namespace_id", D.TYPE_BYTES, OPT),
+        (2, "data", D.TYPE_BYTES, OPT),
+        (3, "share_version", D.TYPE_UINT32, OPT),
+        (4, "namespace_version", D.TYPE_UINT32, OPT),
+    ])
+    m = f.message_type.add()
+    m.name = "BlobTx"
+    for num, fname, ftype, label, tname in (
+        (1, "tx", D.TYPE_BYTES, OPT, None),
+        (2, "blobs", D.TYPE_MESSAGE, REP, ".t.Blob"),
+        (3, "type_id", D.TYPE_STRING, OPT, None),
+    ):
+        fd = m.field.add()
+        fd.name, fd.number, fd.type, fd.label = fname, num, ftype, label
+        if tname:
+            fd.type_name = tname
+    msg("IndexWrapper", [
+        (1, "tx", D.TYPE_BYTES, OPT),
+        (2, "share_indexes", D.TYPE_UINT32, REP),
+        (3, "type_id", D.TYPE_STRING, OPT),
+    ])
+    # cosmos tx.proto subset
+    msg("TxRaw", [
+        (1, "body_bytes", D.TYPE_BYTES, OPT),
+        (2, "auth_info_bytes", D.TYPE_BYTES, OPT),
+        (3, "signatures", D.TYPE_BYTES, REP),
+    ])
+    msg("SignDoc", [
+        (1, "body_bytes", D.TYPE_BYTES, OPT),
+        (2, "auth_info_bytes", D.TYPE_BYTES, OPT),
+        (3, "chain_id", D.TYPE_STRING, OPT),
+        (4, "account_number", D.TYPE_UINT64, OPT),
+    ])
+    msg("Coin", [
+        (1, "denom", D.TYPE_STRING, OPT),
+        (2, "amount", D.TYPE_STRING, OPT),
+    ])
+    msg("Any", [
+        (1, "type_url", D.TYPE_STRING, OPT),
+        (2, "value", D.TYPE_BYTES, OPT),
+    ])
+    msg("MsgSend", [
+        (1, "from_address", D.TYPE_STRING, OPT),
+        (2, "to_address", D.TYPE_STRING, OPT),
+    ])  # amount (repeated Coin) added below
+    send = f.message_type[-1]
+    fd = send.field.add()
+    fd.name, fd.number, fd.type, fd.label = "amount", 3, D.TYPE_MESSAGE, REP
+    fd.type_name = ".t.Coin"
+
+    pool.Add(f)
+    classes = {}
+    for name in ("MsgPayForBlobs", "Blob", "BlobTx", "IndexWrapper", "TxRaw",
+                 "SignDoc", "Coin", "Any", "MsgSend"):
+        classes[name] = message_factory.GetMessageClass(
+            pool.FindMessageTypeByName(f"t.{name}")
+        )
+    return classes
+
+
+PB = _build_pool()
+
+ADDR = bytes(range(20))
+ADDR_STR = bech32.encode(ADDR)
+NS = bytes([0]) + bytes(range(1, 11)).rjust(28, b"\x00")
+
+
+def test_bech32_bip173_vectors():
+    # BIP-173 reference vector: bech32 of HRP "bc", witness program
+    assert bech32.decode("A12UEL5L", "a") == b""
+    with pytest.raises(ValueError):
+        bech32.decode("A12UEL5X", "a")  # bad checksum
+    # round-trip with celestia HRPs
+    assert bech32.decode(ADDR_STR) == ADDR
+    val = bech32.encode(ADDR, bech32.HRP_VALOPER)
+    assert val.startswith("celestiavaloper1")
+    assert bech32.decode(val, bech32.HRP_VALOPER) == ADDR
+
+
+def test_varint_roundtrip():
+    for v in (0, 1, 127, 128, 300, 2**32 - 1, 2**63, 2**64 - 1):
+        raw = encode_varint(v)
+        got, off = decode_varint(raw, 0)
+        assert got == v and off == len(raw)
+
+
+def test_msg_pay_for_blobs_matches_protobuf_runtime():
+    m = itx.MsgPayForBlobs(
+        signer=ADDR,
+        namespaces=(NS, NS),
+        blob_sizes=(777, 1),
+        share_commitments=(b"\x01" * 32, b"\x02" * 32),
+        share_versions=(0, 0),
+    )
+    ours = txpb.MSG_CODECS["/celestia.blob.v1.MsgPayForBlobs"][1](m)
+    ref = PB["MsgPayForBlobs"](
+        signer=ADDR_STR,
+        namespaces=[NS, NS],
+        blob_sizes=[777, 1],
+        share_commitments=[b"\x01" * 32, b"\x02" * 32],
+        share_versions=[0, 0],
+    )
+    assert ours == ref.SerializeToString()
+    # share_versions [0,0] is all-defaults: packed empty → omitted by both
+    back = txpb.MSG_CODECS["/celestia.blob.v1.MsgPayForBlobs"][2](ours)
+    assert back.signer == ADDR and back.blob_sizes == (777, 1)
+
+
+def test_blob_tx_envelope_matches_protobuf_runtime():
+    blobs = [(NS, b"hello world", 0)]
+    ours = txpb.blob_tx_pb(b"txbytes", blobs)
+    ref = PB["BlobTx"](
+        tx=b"txbytes",
+        blobs=[PB["Blob"](namespace_id=NS[1:], data=b"hello world",
+                          share_version=0, namespace_version=0)],
+        type_id="BLOB",
+    )
+    assert ours == ref.SerializeToString()
+    tx, parsed = txpb.parse_blob_tx(ours)
+    assert tx == b"txbytes" and parsed == [(NS, b"hello world", 0)]
+
+
+def test_index_wrapper_matches_protobuf_runtime():
+    ours = txpb.index_wrapper_pb(b"ptx", [5, 130, 70000])
+    ref = PB["IndexWrapper"](tx=b"ptx", share_indexes=[5, 130, 70000],
+                             type_id="INDX")
+    assert ours == ref.SerializeToString()
+    tx, idxs = txpb.parse_index_wrapper(ours)
+    assert tx == b"ptx" and idxs == [5, 130, 70000]
+
+
+def test_tx_raw_and_sign_doc_match_protobuf_runtime():
+    priv = PrivateKey.from_seed(b"\x11")
+    body = itx.TxBody(
+        msgs=(itx.MsgSend(ADDR, bytes(20), 12345),),
+        chain_id="celestia-tpu-1",
+        account_number=7,
+        sequence=3,
+        fee=2000,
+        gas_limit=100_000,
+        memo="hi",
+    )
+    ptx = codec.sign_tx_proto(body, priv)
+    ref_raw = PB["TxRaw"](
+        body_bytes=ptx.body_bytes,
+        auth_info_bytes=ptx.auth_info_bytes,
+        signatures=[ptx.signature],
+    )
+    assert ptx.raw == ref_raw.SerializeToString()
+    ref_doc = PB["SignDoc"](
+        body_bytes=ptx.body_bytes,
+        auth_info_bytes=ptx.auth_info_bytes,
+        chain_id="celestia-tpu-1",
+        account_number=7,
+    )
+    assert ptx.sign_doc("celestia-tpu-1", 7) == ref_doc.SerializeToString()
+    # the signature binds chain id + account number
+    assert ptx.verify_signature("celestia-tpu-1", 7)
+    assert not ptx.verify_signature("other-chain", 7)
+    assert not ptx.verify_signature("celestia-tpu-1", 8)
+
+
+def test_msg_send_body_matches_protobuf_runtime():
+    m = itx.MsgSend(ADDR, bytes(20), 12345)
+    ours = txpb.MSG_CODECS["/cosmos.bank.v1beta1.MsgSend"][1](m)
+    ref = PB["MsgSend"](
+        from_address=ADDR_STR,
+        to_address=bech32.encode(bytes(20)),
+        amount=[PB["Coin"](denom="utia", amount="12345")],
+    )
+    assert ours == ref.SerializeToString()
+
+
+def test_every_msg_type_roundtrips_through_any():
+    msgs = [
+        itx.MsgSend(ADDR, bytes(20), 5),
+        itx.MsgPayForBlobs(ADDR, (NS,), (9,), (b"\x03" * 32,), (0,)),
+        itx.MsgDelegate(ADDR, bytes(20), 10**6),
+        itx.MsgUndelegate(ADDR, bytes(20), 10**6),
+        itx.MsgBeginRedelegate(ADDR, bytes(20), b"\x01" * 20, 77),
+        itx.MsgCreateValidator(ADDR, 5 * 10**6),
+        itx.MsgVote(ADDR, 3, "veto"),
+        itx.MsgDeposit(ADDR, 3, 999),
+        itx.MsgSubmitProposal(
+            ADDR,
+            json.dumps(
+                [{"param": "blob/gas_per_blob_byte", "value": 16}],
+                sort_keys=True,
+            ).encode(),
+            10**9,
+            "raise gas",
+        ),
+        itx.MsgSignalVersion(ADDR, 2),
+        itx.MsgTryUpgrade(ADDR),
+        itx.MsgRegisterEVMAddress(ADDR, b"\xaa" * 20),
+        itx.MsgExec(ADDR, (itx.MsgSend(ADDR, bytes(20), 5),)),
+        itx.MsgTransfer(ADDR, "channel-0", "cosmos1xyz", "utia", 44),
+    ]
+    for m in msgs:
+        raw = txpb.encode_msg_any(m)
+        back = txpb.decode_msg_any(raw)
+        assert back == m, f"{type(m).__name__} round-trip mismatch"
+
+
+def test_proto_tx_decode_rejects_malformed():
+    priv = PrivateKey.from_seed(b"\x12")
+    body = itx.TxBody(
+        msgs=(itx.MsgSend(ADDR, bytes(20), 1),),
+        chain_id="c", account_number=0, sequence=0, fee=1, gas_limit=1,
+    )
+    ptx = codec.sign_tx_proto(body, priv)
+    # no signature
+    bad = txpb.tx_raw_pb(ptx.body_bytes, ptx.auth_info_bytes, b"")
+    with pytest.raises(ValueError):
+        codec.decode_proto_tx(bad)
+    # truncated
+    with pytest.raises(ValueError):
+        codec.decode_proto_tx(ptx.raw[:-3])
+
+
+def test_blob_tx_semantics_on_protobuf_inputs():
+    """x/blob/types/blob_tx.go:37-108 on protobuf envelopes."""
+    import numpy as np
+
+    from celestia_app_tpu.chain.blob_validation import (
+        BlobTxError,
+        validate_blob_tx,
+    )
+    from celestia_app_tpu.da import blob as blob_mod
+    from celestia_app_tpu.da import commitment as commitment_mod
+    from celestia_app_tpu.da.blob import Blob
+    from celestia_app_tpu.da.namespace import Namespace
+
+    priv = PrivateKey.from_seed(b"\x13")
+    addr = priv.public_key().address()
+    rng = np.random.default_rng(0)
+    ns = Namespace.v0(b"ns1xx")
+    blob = Blob(ns, rng.integers(0, 256, 300, dtype=np.uint8).tobytes())
+    commit = commitment_mod.create_commitment(blob, 64)
+
+    def make(msg, blobs):
+        body = itx.TxBody(
+            msgs=(msg,), chain_id="c", account_number=0, sequence=0,
+            fee=10**6, gas_limit=10**7,
+        )
+        ptx = codec.sign_tx_proto(body, priv)
+        return blob_mod.unmarshal_blob_tx(
+            blob_mod.marshal_blob_tx(ptx.raw, blobs)
+        )
+
+    good_msg = itx.MsgPayForBlobs(addr, (ns.raw,), (300,), (commit,), (0,))
+    tx, msg = validate_blob_tx(make(good_msg, [blob]), 64)
+    assert msg.share_commitments == (commit,)
+
+    # ErrNoBlobs: envelope with zero blobs
+    with pytest.raises(BlobTxError, match="no blobs"):
+        validate_blob_tx(make(good_msg, []), 64)
+    # blob count mismatch
+    with pytest.raises(BlobTxError, match="count mismatch"):
+        validate_blob_tx(make(good_msg, [blob, blob]), 64)
+    # namespace mismatch
+    other_ns = Namespace.v0(b"other")
+    bad = itx.MsgPayForBlobs(addr, (other_ns.raw,), (300,), (commit,), (0,))
+    with pytest.raises(BlobTxError, match="namespace"):
+        validate_blob_tx(make(bad, [blob]), 64)
+    # commitment mismatch
+    bad = itx.MsgPayForBlobs(addr, (ns.raw,), (300,), (b"\x00" * 32,), (0,))
+    with pytest.raises(BlobTxError, match="commitment"):
+        validate_blob_tx(make(bad, [blob]), 64)
+
+
+def test_wrong_chain_id_proto_tx_rejected_by_node():
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_app import make_app
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.client.tx_client import Signer
+
+    app, signer, privs = make_app()
+    node = Node(app)
+    addr = privs[0].public_key().address()
+    rogue = Signer("some-other-chain")
+    rogue.add_account(privs[0], number=signer.accounts[addr].number)
+    tx = rogue.create_tx(addr, [itx.MsgSend(addr, bytes(20), 1)],
+                         fee=2000, gas_limit=100_000)
+    res = node.broadcast_tx(tx.encode())
+    assert res.code != 0 and "signature" in res.log.lower()
+
+
+def test_legacy_wire_still_accepted():
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_app import make_app
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.client.tx_client import Signer
+
+    app, signer, privs = make_app()
+    node = Node(app)
+    addr = privs[0].public_key().address()
+    legacy = Signer(app.chain_id, wire="native")
+    legacy.add_account(privs[0], number=signer.accounts[addr].number)
+    tx = legacy.create_tx(addr, [itx.MsgSend(addr, privs[1].public_key().address(), 7)],
+                          fee=2000, gas_limit=100_000)
+    assert isinstance(tx, itx.Tx)
+    res = node.broadcast_tx(tx.encode())
+    assert res.code == 0, res.log
