@@ -550,5 +550,34 @@ MAX_K8 = ORDER // 2  # widest square the 8-bit code covers
 
 def uses_gf16(k: int) -> bool:
     """Codec selection: 8-bit up to 256 total shards, 16-bit beyond —
-    klauspost reedsolomon's WithLeopardGF threshold."""
-    return k > MAX_K8
+    klauspost reedsolomon's WithLeopardGF threshold.
+
+    ``CELESTIA_GF16_THRESHOLD`` (test/dryrun knob) LOWERS the cutover so the
+    16-bit codec can be exercised on meshes/CI at affordable square sizes.
+    It is snapshotted at first use (per-k codec caches key on the resolved
+    field, so a mid-process env flip cannot make encode and repair disagree)
+    and validated: only a power of two in [1, MAX_K8] is accepted — raising
+    the cutover past the protocol default could route k>128 into the 8-bit
+    code, which cannot represent it."""
+    return k > _gf16_threshold()
+
+
+@functools.lru_cache(maxsize=None)
+def _gf16_threshold() -> int:
+    import os
+
+    raw = os.environ.get("CELESTIA_GF16_THRESHOLD")
+    if raw in (None, ""):
+        return MAX_K8
+    try:
+        t = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"CELESTIA_GF16_THRESHOLD={raw!r} is not an integer"
+        ) from None
+    if t < 1 or t > MAX_K8 or (t & (t - 1)):
+        raise ValueError(
+            f"CELESTIA_GF16_THRESHOLD must be a power of two in "
+            f"[1, {MAX_K8}], got {t}"
+        )
+    return t

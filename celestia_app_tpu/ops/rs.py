@@ -158,8 +158,27 @@ def repair_axis(symbols: np.ndarray, present: list[int]) -> np.ndarray:
     """Recover all 2k symbols of one row/column from any k known ones.
 
     `symbols` is (2k, S) with arbitrary content at missing positions;
-    `present` lists the >=k known positions (first k are used).
+    `present` lists the >=k known positions. Uses Leopard's own O(n log n)
+    FWHT/error-locator decoder (ops/leopard_decode.py); the O(k^3) matrix-
+    inversion path remains as `repair_axis_matrix` for cross-checking.
     """
+    from celestia_app_tpu.ops import leopard_decode
+
+    two_k = symbols.shape[0]
+    k = two_k // 2
+    if len(present) < k:
+        raise ValueError(f"need at least {k} of {two_k} symbols, got {len(present)}")
+    if leopard.uses_gf16(k):
+        sym16 = np.ascontiguousarray(symbols).view("<u2").reshape(2 * k, -1)
+        out = leopard_decode.decode16(sym16, list(present))
+        return out.view(np.uint8).reshape(2 * k, -1)
+    return leopard_decode.decode8(
+        np.ascontiguousarray(symbols), list(present)
+    )
+
+
+def repair_axis_matrix(symbols: np.ndarray, present: list[int]) -> np.ndarray:
+    """Matrix-inversion repair (independent of the FFT decode path)."""
     two_k = symbols.shape[0]
     k = two_k // 2
     if len(present) < k:
