@@ -167,7 +167,26 @@ class App:
             params["min_deposit"] = _require(v, int, 1, 1 << 62)
             self.gov.set_params(ctx, params)
         self.gov = gov_mod.GovKeeper(self.staking, self.bank, param_router)
-        self.ibc = ibc_mod.IBCStack(self.bank)
+        def _ica_router(ctx, msg: dict, signer: bytes) -> None:
+            """Execute an allowlisted ICA msg with the interchain account as
+            the effective signer (ICS-27 host execution)."""
+            t = msg.get("type")
+            if t == "bank/MsgSend":
+                self.bank.send(ctx, signer, bytes.fromhex(msg["to"]),
+                               int(msg["amount"]))
+            elif t == "staking/MsgDelegate":
+                self.staking.delegate(ctx, bytes.fromhex(msg["validator"]),
+                                      signer, int(msg["amount"]))
+            elif t == "staking/MsgUndelegate":
+                self.staking.undelegate(ctx, bytes.fromhex(msg["validator"]),
+                                        signer, int(msg["amount"]))
+            elif t == "gov/MsgVote":
+                self.gov.vote(ctx, int(msg["proposal_id"]), signer,
+                              msg["option"])
+            else:  # the keeper's allowlist already rejected anything else
+                raise ValueError(f"unroutable ICA msg {t!r}")
+
+        self.ibc = ibc_mod.IBCStack(self.bank, ica_router=_ica_router)
         self.distribution = sdk_modules.DistributionKeeper(self.staking, self.bank)
         self.slashing = sdk_modules.SlashingKeeper(self.staking)
         self.authz = sdk_modules.AuthzKeeper()
@@ -826,12 +845,19 @@ class App:
             "raw_modules": raw_modules,
         }
 
-    def relay_recv_packet(self, packet: dict) -> dict:
+    def relay_recv_packet(
+        self,
+        packet: dict,
+        proof: dict | None = None,
+        proof_height: int | None = None,
+    ) -> dict:
         """Core-relay boundary: deliver an inbound IBC packet (the reference
         receives these as relayer-submitted MsgRecvPacket through consensus;
-        the single-process node applies them directly to committed state)."""
+        the single-process node applies them directly to committed state).
+        Channels bound to a client REQUIRE a commitment proof against a
+        tracked counterparty root (ibc-go VerifyPacketCommitment)."""
         ctx = self._deliver_ctx(InfiniteGasMeter())
-        ack = self.ibc.recv_packet(ctx, packet)
+        ack = self.ibc.recv_packet(ctx, packet, proof, proof_height)
         ctx.store.write()
         return ack
 

@@ -1,19 +1,29 @@
-"""Minimal IBC transfer plane with celestia's token filter middleware.
+"""IBC plane: proof-verified transfer stack with celestia's middlewares.
 
-Reference parity: the reference wires ibc-go's transfer app wrapped by
-x/tokenfilter (app/app.go IBC stack assembly; x/tokenfilter/
-ibc_middleware.go:38-81): celestia accepts ONLY native-denom transfers
-inbound — an incoming packet whose denom did not originate here (i.e. the
-denom path does not unwind through the receiving channel) is answered with
-an error acknowledgement instead of minting a voucher. This keeps foreign
-tokens off the DA chain while allowing utia to round-trip.
+Reference parity — the reference's transfer stack order (app/app.go:329-346)
+is tokenfilter → packet-forward (v2+) → transfer, plus the ICA host module
+(v2+, app/app.go:290-300); all are modelled here:
 
-Scope: ICS-20 fungible token packet semantics over pre-established
-channels (handshakes are out of scope for a single-process node — channels
-are registered via keeper calls, as test fixtures do in the reference).
-Implemented: escrow/unescrow for native denom, voucher burn for outbound
-returns, packet commitments + acknowledgements, error-ack refunds, timeout
-refunds, and the token filter. Packet data is the ICS-20 JSON form.
+- x/tokenfilter (ibc_middleware.go:38-81): celestia accepts ONLY
+  native-denom transfers inbound — a foreign denom is answered with an
+  error acknowledgement instead of minting a voucher.
+- packet-forward middleware (ibc-apps PFM v6): an inbound transfer whose
+  memo carries {"forward": {"receiver", "channel", ...}} is forwarded out
+  on the next hop after being received here.
+- ICS-27 interchain-accounts HOST: counterparty controllers register a
+  deterministic host account and execute whitelisted msgs through it.
+- ClientKeeper: a light-client analog tracking counterparty state roots;
+  `recv_packet` REQUIRES a Merkle membership proof of the packet
+  commitment against a tracked root when the channel is client-backed
+  (ibc-go VerifyPacketCommitment) — a forged packet the counterparty never
+  committed cannot be relayed in. The proof format is the framework's own
+  bucketed app-hash tree (chain/state.py verify_membership), so two
+  instances of THIS framework verify each other end-to-end (tests do
+  exactly that). Channels registered without a client keep the trusted-
+  relay fixture behavior.
+
+Handshakes are keeper-registered (single-process node), as the reference's
+ibctesting fixtures do. Packet data is the ICS-20 JSON form.
 """
 
 from __future__ import annotations
@@ -22,7 +32,12 @@ import hashlib
 import json
 
 from celestia_app_tpu import appconsts
-from celestia_app_tpu.chain.state import Context, get_json, put_json
+from celestia_app_tpu.chain.state import (
+    Context,
+    get_json,
+    put_json,
+    verify_membership,
+)
 
 NATIVE_DENOM = appconsts.BOND_DENOM  # "utia"
 
@@ -47,8 +62,57 @@ def receiver_chain_is_source(source_port: str, source_channel: str, denom: str) 
     return denom.startswith(f"{source_port}/{source_channel}/")
 
 
+def packet_commitment(packet: dict) -> bytes:
+    """THE commitment preimage — the single definition used by commit,
+    refund gating, and cross-chain proof verification (divergent copies
+    would silently break counterparty proofs)."""
+    return hashlib.sha256(json.dumps(packet, sort_keys=True).encode()).digest()
+
+
 class IBCError(ValueError):
     pass
+
+
+class ClientKeeper:
+    """Light-client analog: tracked counterparty state roots by height.
+
+    `update_client` is the header-submission boundary (a real tendermint
+    light client verifies commits/validator sets there; the single-process
+    node trusts the update call — the critical property preserved is that
+    PACKETS cannot be forged: every recv must prove membership against a
+    root recorded BEFORE the packet is relayed)."""
+
+    CONS = b"ibc/client/"
+
+    def create_client(self, ctx: Context, client_id: str) -> None:
+        meta_key = self.CONS + client_id.encode() + b"/meta"
+        if _get(ctx, meta_key) is not None:
+            # re-creation would reset latest_height and let update_client
+            # overwrite recorded roots — the monotonicity guard's whole point
+            raise IBCError(f"client {client_id!r} already exists")
+        _put(ctx, meta_key, {"latest_height": 0})
+
+    def update_client(
+        self, ctx: Context, client_id: str, height: int, root: bytes
+    ) -> None:
+        meta_key = self.CONS + client_id.encode() + b"/meta"
+        meta = _get(ctx, meta_key)
+        if meta is None:
+            raise IBCError(f"unknown client {client_id!r}")
+        if height <= meta["latest_height"]:
+            raise IBCError(
+                f"non-monotonic client update: {height} <= {meta['latest_height']}"
+            )
+        _put(ctx, self.CONS + f"{client_id}/{height}".encode(),
+             {"root": root.hex()})
+        meta["latest_height"] = height
+        _put(ctx, meta_key, meta)
+
+    def consensus_root(
+        self, ctx: Context, client_id: str, height: int
+    ) -> bytes | None:
+        rec = _get(ctx, self.CONS + f"{client_id}/{height}".encode())
+        return bytes.fromhex(rec["root"]) if rec else None
 
 
 class ChannelKeeper:
@@ -60,13 +124,16 @@ class ChannelKeeper:
     def open_channel(
         self, ctx: Context, port: str, channel: str,
         counterparty_port: str, counterparty_channel: str,
+        client_id: str | None = None,
     ) -> None:
         """Register an OPEN channel (handshake result; fixtures call this
-        directly, like the reference's testing pkg channels)."""
+        directly, like the reference's testing pkg channels). A channel
+        bound to `client_id` REQUIRES commitment proofs on receive."""
         _put(ctx, self.CHAN + f"{port}/{channel}".encode(), {
             "state": "OPEN",
             "counterparty_port": counterparty_port,
             "counterparty_channel": counterparty_channel,
+            "client_id": client_id,
         })
 
     def channel(self, ctx: Context, port: str, channel: str):
@@ -84,9 +151,7 @@ class ChannelKeeper:
             + f"{packet['source_port']}/{packet['source_channel']}/"
             f"{packet['sequence']}".encode()
         )
-        ctx.store.set(key, hashlib.sha256(
-            json.dumps(packet, sort_keys=True).encode()
-        ).digest())
+        ctx.store.set(key, packet_commitment(packet))
 
     def take_commitment(self, ctx: Context, packet: dict) -> bool:
         """Delete the packet commitment; False if absent OR if the submitted
@@ -101,10 +166,7 @@ class ChannelKeeper:
         stored = ctx.store.get(key)
         if stored is None:
             return False
-        submitted = hashlib.sha256(
-            json.dumps(packet, sort_keys=True).encode()
-        ).digest()
-        if stored != submitted:
+        if stored != packet_commitment(packet):
             return False
         ctx.store.delete(key)
         return True
@@ -137,7 +199,7 @@ class TransferKeeper:
 
     def send_transfer(
         self, ctx: Context, source_channel: str, sender: bytes,
-        receiver: str, denom: str, amount: int,
+        receiver: str, denom: str, amount: int, memo: str = "",
     ) -> dict:
         """MsgTransfer: escrow native tokens (or burn returning vouchers)
         and emit the ICS-20 packet."""
@@ -166,6 +228,7 @@ class TransferKeeper:
                 "amount": str(amount),
                 "sender": sender.hex(),
                 "receiver": receiver,
+                "memo": memo,
             },
         }
         self.channels.commit_packet(ctx, packet)
@@ -227,12 +290,58 @@ class TransferKeeper:
         )
 
 
-class TokenFilterMiddleware:
-    """x/tokenfilter: reject inbound non-native transfers with an error ack
-    (ibc_middleware.go:38-81). Wraps the transfer app's OnRecvPacket; all
-    other callbacks pass through."""
+class PacketForwardMiddleware:
+    """ibc-apps packet-forward middleware (v2+; app/app.go:335-341): an
+    inbound transfer whose memo is {"forward": {"receiver": ..., "channel":
+    ...}} is delivered to the hop address, then immediately sent onward on
+    the named channel. Sits BELOW the token filter and ABOVE transfer,
+    matching the reference's stack order."""
 
     def __init__(self, app: TransferKeeper):
+        self.app = app
+
+    def on_recv_packet(self, ctx: Context, packet: dict) -> dict:
+        data = packet.get("data")
+        fwd = None
+        if isinstance(data, dict) and ctx.app_version >= 2:
+            # v1 has no packet-forward module (app/modules.go:171 range
+            # analog): the memo is carried but never interpreted
+            memo = data.get("memo", "")
+            if isinstance(memo, str) and memo.startswith("{"):
+                try:
+                    fwd = json.loads(memo).get("forward")
+                except (json.JSONDecodeError, AttributeError):
+                    fwd = None
+        ack = self.app.on_recv_packet(ctx, packet)
+        if fwd is None or "error" in ack:
+            return ack
+        try:
+            receiver = bytes.fromhex(data["receiver"])
+            self.app.send_transfer(
+                ctx,
+                fwd["channel"],
+                receiver,
+                fwd["receiver"],
+                NATIVE_DENOM,
+                int(data["amount"]),
+            )
+        except (IBCError, ValueError, KeyError) as e:
+            # the hop failed: the funds were received by the hop address and
+            # STAY there (the in-flight model of PFM's non-refundable mode);
+            # the ack reports the failure to the origin
+            return {"error": f"packet forward failed: {e}"}
+        ctx.emit_event(
+            "ibc.packet_forward", channel=fwd["channel"], amount=data["amount"]
+        )
+        return ack
+
+
+class TokenFilterMiddleware:
+    """x/tokenfilter: reject inbound non-native transfers with an error ack
+    (ibc_middleware.go:38-81). Wraps the next module's OnRecvPacket; all
+    other callbacks pass through."""
+
+    def __init__(self, app):
         self.app = app
 
     def on_recv_packet(self, ctx: Context, packet: dict) -> dict:
@@ -253,33 +362,130 @@ class TokenFilterMiddleware:
         return {"error": f"only native denom transfers accepted, got {data['denom']}"}
 
 
-class IBCStack:
-    """The assembled stack: channel keeper + transfer app + token filter,
-    mirroring the app.go wiring order."""
+class ICAHostKeeper:
+    """ICS-27 interchain accounts, HOST side (v2+; app/app.go:290-300).
 
-    def __init__(self, bank):
+    Counterparty controller chains register one deterministic host account
+    per (connection, owner) and drive it with packets of whitelisted msgs.
+    The allowlist mirrors the reference's host params (bank send, staking
+    delegate/undelegate, gov vote — default_overrides-style subset)."""
+
+    PORT = "icahost"
+    ACCOUNTS = b"ica/acc/"
+    ALLOWED = ("bank/MsgSend", "staking/MsgDelegate",
+               "staking/MsgUndelegate", "gov/MsgVote")
+
+    def __init__(self, router=None):
+        self.router = router  # msg executor: fn(ctx, msg_dict, signer) -> None
+
+    def account_address(self, channel: str, owner: str) -> bytes:
+        return hashlib.sha256(f"ica/{channel}/{owner}".encode()).digest()[:20]
+
+    def on_recv_packet(self, ctx: Context, packet: dict) -> dict:
+        if ctx.app_version < 2:
+            raise IBCError("ICA host is a v2+ module")
+        data = packet.get("data")
+        if not isinstance(data, dict):
+            raise IBCError("malformed ICA packet")
+        channel = packet["destination_channel"]
+        if data.get("type") == "register":
+            owner = str(data["owner"])
+            addr = self.account_address(channel, owner)
+            _put(ctx, self.ACCOUNTS + addr, {"channel": channel, "owner": owner})
+            ctx.emit_event("ica.register", owner=owner, address=addr.hex())
+            return {"result": addr.hex()}
+        if data.get("type") == "tx":
+            owner = str(data["owner"])
+            addr = self.account_address(channel, owner)
+            if _get(ctx, self.ACCOUNTS + addr) is None:
+                raise IBCError("interchain account not registered")
+            if self.router is None:
+                raise IBCError("ICA msg router not wired")
+            for m in data.get("msgs", []):
+                if m.get("type") not in self.ALLOWED:
+                    raise IBCError(
+                        f"msg type {m.get('type')!r} not in the ICA allowlist"
+                    )
+                self.router(ctx, m, addr)
+            return {"result": "AQ=="}
+        raise IBCError(f"unknown ICA packet type {data.get('type')!r}")
+
+
+class IBCStack:
+    """The assembled stack, mirroring app.go:290-346: clients + channels;
+    transfer wrapped by packet-forward (v2+) wrapped by tokenfilter; the
+    ICA host on its own port (v2+)."""
+
+    def __init__(self, bank, ica_router=None):
+        self.clients = ClientKeeper()
         self.channels = ChannelKeeper()
         self.transfer = TransferKeeper(bank, self.channels)
-        self.module = TokenFilterMiddleware(self.transfer)
+        self.pfm = PacketForwardMiddleware(self.transfer)
+        self.module = TokenFilterMiddleware(self.pfm)
+        self.ica_host = ICAHostKeeper(ica_router)
 
-    def recv_packet(self, ctx: Context, packet: dict) -> dict:
-        """Core relay entry: routes to the middleware stack and records the
-        acknowledgement."""
+    def _verify_commitment_proof(
+        self, ctx: Context, chan: dict, packet: dict,
+        proof: dict | None, proof_height: int | None,
+    ) -> None:
+        """ibc-go VerifyPacketCommitment: the packet must be committed in
+        the counterparty's state at a tracked client height."""
+        client_id = chan.get("client_id")
+        if client_id is None:
+            return  # fixture channel: trusted-relay mode
+        if proof is None or proof_height is None:
+            raise IBCError("channel requires a packet commitment proof")
+        root = self.clients.consensus_root(ctx, client_id, proof_height)
+        if root is None:
+            raise IBCError(
+                f"no consensus state for {client_id!r} at height {proof_height}"
+            )
+        # the counterparty commits under ITS OWN source port/channel key
+        key = ChannelKeeper.COMMIT + (
+            f"{packet['source_port']}/{packet['source_channel']}/"
+            f"{packet['sequence']}".encode()
+        )
+        if not verify_membership(root, key, packet_commitment(packet), proof):
+            raise IBCError("packet commitment proof verification failed")
+
+    def recv_packet(
+        self,
+        ctx: Context,
+        packet: dict,
+        proof: dict | None = None,
+        proof_height: int | None = None,
+    ) -> dict:
+        """Core relay entry: proof-check, route by port to the middleware
+        stack, record the acknowledgement."""
         chan = self.channels.channel(
             ctx, packet["destination_port"], packet["destination_channel"]
         )
         if chan is None or chan["state"] != "OPEN":
             raise IBCError("unknown destination channel")
+        self._verify_commitment_proof(ctx, chan, packet, proof, proof_height)
         # packet receipts: a replayed sequence returns the recorded ack
         # without re-executing (no double unescrow)
         prior = self.channels.get_ack(ctx, packet)
         if prior is not None:
             return prior
+        # the handler runs in a BRANCHED context that is only flushed on a
+        # success ack — ibc-go's cached-context receive: an error ack must
+        # leave zero state behind, or (e.g.) a failed packet-forward would
+        # keep the delivered funds here WHILE the origin refunds the sender
+        # (supply duplication), and a half-executed ICA batch would persist
+        # under an ack that says it failed
+        per_packet = ctx.branch()
         try:
-            ack = self.module.on_recv_packet(ctx, packet)
+            if packet["destination_port"] == ICAHostKeeper.PORT:
+                ack = self.ica_host.on_recv_packet(per_packet, packet)
+            else:
+                ack = self.module.on_recv_packet(per_packet, packet)
         except (IBCError, ValueError, KeyError, TypeError) as e:
             # malformed packet data or failed escrow movement becomes an
             # error acknowledgement, never a relay crash
             ack = {"error": f"{type(e).__name__}: {e}"}
+        if "error" not in ack:
+            per_packet.store.write()
+            ctx.events.extend(per_packet.events)
         self.channels.write_ack(ctx, packet, ack)
         return ack

@@ -216,6 +216,72 @@ class KVStore:
         self._dirty = set()
         return self._node(0, 0)
 
+    # -- membership proofs (the IBC light-client verification primitive) --
+
+    def prove(self, key: bytes) -> dict:
+        """Merkle membership proof of (key, value) against app_hash().
+
+        Two segments: an RFC-6962 audit path inside the key's bucket, then
+        the 16 sibling hashes from the bucket to the root. Verified by
+        :func:`verify_membership` with nothing but the root — this is what
+        an IBC counterparty checks instead of trusting the relayer
+        (ibc-go VerifyPacketCommitment analog)."""
+        if key not in self._data:
+            raise KeyError(f"no value for key {key!r}")
+        self.app_hash()  # ensure the tree is current
+        b = self._bucket_of(key)
+        keys = sorted(self._index()[b])
+        leaf_index = keys.index(key)
+        leaves = [
+            hashlib.sha256(k2 + b"\x00" + self._data[k2]).digest()
+            for k2 in keys
+        ]
+        _root, proofs = merkle_host.proofs_from_leaves(leaves)
+        tree_path = []
+        i = b
+        for level in range(_TREE_DEPTH, 0, -1):
+            tree_path.append(self._node(level, i ^ 1).hex())
+            i >>= 1
+        return {
+            "bucket": b,
+            "leaf_index": leaf_index,
+            "bucket_size": len(leaves),
+            "bucket_path": [h.hex() for h in proofs[leaf_index].aunts],
+            "tree_path": tree_path,
+        }
+
+
+def verify_membership(root: bytes, key: bytes, value: bytes, proof: dict) -> bool:
+    """Check a :meth:`KVStore.prove` proof against an app hash. Pure
+    function of the proof — safe to run against a counterparty's root."""
+    try:
+        d = hashlib.sha256(key).digest()
+        if ((d[0] << 8) | d[1]) != proof["bucket"]:
+            return False
+        if not (0 <= proof["leaf_index"] < proof["bucket_size"]):
+            return False
+        leaf = hashlib.sha256(key + b"\x00" + value).digest()
+        bucket_hash = merkle_host._compute_from_aunts(
+            proof["leaf_index"],
+            proof["bucket_size"],
+            merkle_host.leaf_hash(leaf),
+            [bytes.fromhex(h) for h in proof["bucket_path"]],
+        )
+        node = bucket_hash
+        i = proof["bucket"]
+        if len(proof["tree_path"]) != _TREE_DEPTH:
+            return False
+        for sib_hex in proof["tree_path"]:
+            sib = bytes.fromhex(sib_hex)
+            if i & 1:
+                node = hashlib.sha256(b"\x01" + sib + node).digest()
+            else:
+                node = hashlib.sha256(b"\x01" + node + sib).digest()
+            i >>= 1
+        return node == root
+    except (KeyError, ValueError, IndexError, TypeError):
+        return False
+
 
 class CacheStore(KVStore):
     """Copy-on-write layer over a parent store; write() flushes down."""
