@@ -174,7 +174,9 @@ class AnteHandler:
                 if ctx.app_version >= 2
                 else 0
             )
-        if body.fee * appconsts.ATTO < body.gas_limit * floor_atto:
+        if not simulate and body.fee * appconsts.ATTO < body.gas_limit * floor_atto:
+            # simulation probes carry placeholder fees (the SDK's simulate
+            # mode skips the min-gas-price adequacy check the same way)
             raise AnteError(
                 f"insufficient gas price: {body.fee / body.gas_limit:.9f} "
                 f"< min {floor_atto / appconsts.ATTO:.9f}"

@@ -606,6 +606,31 @@ class App:
                 pass
             return TxResult(1, str(e), tx.body.gas_limit, gas.consumed, [])
 
+    def simulate_tx(self, raw: bytes) -> TxResult:
+        """Dry-run a tx against current committed state and report the gas
+        it consumes (the reference's /cosmos.tx.v1beta1.Service/Simulate,
+        which pkg/user/tx_client.go:320-330 uses for estimateGas). The ante
+        runs in simulate mode — no signature, fee, or sequence requirements
+        — msgs dispatch on a DISCARDED branch, and gas metering is real."""
+        try:
+            btx = blob_mod.try_unmarshal_blob_tx(raw)
+        except ValueError as e:
+            return TxResult(1, f"undecodable blob tx: {e}", 0, 0, [])
+        raw_tx = btx.tx if btx is not None else raw
+        try:
+            tx = decode_tx(raw_tx)
+        except ValueError as e:
+            return TxResult(1, f"undecodable tx: {e}", 0, 0, [])
+        ctx = self._ctx(self.store.branch(), GasMeter(1 << 40), check=False)
+        try:
+            self.ante.run(ctx, tx, simulate=True)
+            for m in tx.body.msgs:
+                self._dispatch(ctx, m)
+        except (ante_mod.AnteError, OutOfGas, ValueError) as e:
+            return TxResult(1, str(e), 0, ctx.gas_meter.consumed, [])
+        # branch is dropped: simulation never mutates state
+        return TxResult(0, "", 0, ctx.gas_meter.consumed, ctx.events)
+
     def _dispatch(self, ctx: Context, msg) -> None:
         if isinstance(msg, MsgSend):
             self.bank.send(ctx, msg.from_addr, msg.to_addr, msg.amount)

@@ -1,0 +1,137 @@
+"""Blobstream EVM-side verification client (x/blobstream/client/verify.go).
+
+The reference's `blobstream verify` CLI proves a tx/blob/share was committed
+to by the Blobstream bridge: share proof → data root → DataRootTuple root →
+the root STORED IN THE ETHEREUM CONTRACT (client/verify.go:27-38, contract
+calls via go-ethereum). No Ethereum endpoint exists in this environment, so
+the contract itself is modelled faithfully as a state machine
+(`BlobstreamContract` — the blobstream-contracts `QuantumGravityBridge`
+semantics): it tracks a validator-set checkpoint and only accepts a
+DataRootTuple root carried by ≥2/3 of the checkpointed voting power's
+signatures. Everything the reference verifies on-chain is verified here;
+swap `BlobstreamContract` for a web3 binding and the client is the CLI.
+
+Orchestrator signing: the contract's ecrecover analog — a signature counts
+toward a valset member's power iff it was made by the KEY whose derived
+address (default_evm_address of the key's account address) equals that
+member's registered EVM address. A validator that registered a CUSTOM EVM
+address (MsgRegisterEVMAddress) must therefore sign with the separate
+orchestrator key owning that address, exactly as on Ethereum where the
+registered address is recovered from the signature itself. A signature
+from a non-member key or over a forged payload contributes zero power.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+from celestia_app_tpu.chain import blobstream as bs
+from celestia_app_tpu.chain.crypto import PublicKey
+from celestia_app_tpu.utils import merkle_host
+
+
+class ContractError(ValueError):
+    pass
+
+
+def valset_checkpoint(valset: bs.Valset) -> bytes:
+    """The contract's domain-separated validator-set commitment."""
+    h = hashlib.sha256(b"blobstream-valset-checkpoint")
+    h.update(valset.nonce.to_bytes(8, "big"))
+    for m in valset.members:
+        h.update(m.power.to_bytes(8, "big"))
+        h.update(m.evm_address)
+    return h.digest()
+
+
+def tuple_root_sign_digest(nonce: int, tuple_root: bytes) -> bytes:
+    """What orchestrators sign for submitDataRootTupleRoot."""
+    return hashlib.sha256(
+        b"blobstream-tuple-root" + nonce.to_bytes(8, "big") + tuple_root
+    ).digest()
+
+
+@dataclasses.dataclass(frozen=True)
+class OrchestratorSignature:
+    pubkey: bytes  # 33-byte compressed secp256k1 (chain key)
+    signature: bytes  # 64-byte r||s over the digest
+
+
+class BlobstreamContract:
+    """The deployed bridge contract's state machine."""
+
+    def __init__(self, initial_valset: bs.Valset):
+        self._checkpoint = valset_checkpoint(initial_valset)
+        self._valset = initial_valset
+        self._tuple_roots: dict[int, bytes] = {}
+        self._last_nonce = 0
+
+    def _tally(self, digest: bytes, sigs: list[OrchestratorSignature]) -> int:
+        power = 0
+        by_evm = {m.evm_address: m.power for m in self._valset.members}
+        seen: set[bytes] = set()
+        for s in sigs:
+            pub = PublicKey(s.pubkey)
+            evm = bs.default_evm_address(pub.address())
+            if evm in seen or evm not in by_evm:
+                continue
+            if not pub.verify(s.signature, digest):
+                continue
+            seen.add(evm)
+            power += by_evm[evm]
+        return power
+
+    def _require_two_thirds(self, digest: bytes,
+                            sigs: list[OrchestratorSignature]) -> None:
+        total = sum(m.power for m in self._valset.members)
+        # strictly more than 2/3, matching the consensus certificate rule
+        if self._tally(digest, sigs) * 3 <= total * 2:
+            raise ContractError("insufficient voting power signed")
+
+    def update_validator_set(
+        self, new_valset: bs.Valset, sigs: list[OrchestratorSignature]
+    ) -> None:
+        """2/3 of the CURRENT set must sign the new checkpoint."""
+        if new_valset.nonce <= self._valset.nonce:
+            raise ContractError("valset nonce must increase")
+        self._require_two_thirds(valset_checkpoint(new_valset), sigs)
+        self._valset = new_valset
+        self._checkpoint = valset_checkpoint(new_valset)
+
+    def submit_data_root_tuple_root(
+        self, nonce: int, tuple_root: bytes,
+        sigs: list[OrchestratorSignature],
+    ) -> None:
+        if nonce <= self._last_nonce:
+            raise ContractError("event nonce must increase")
+        self._require_two_thirds(tuple_root_sign_digest(nonce, tuple_root), sigs)
+        self._tuple_roots[nonce] = tuple_root
+        self._last_nonce = nonce
+
+    def data_root_tuple_root(self, nonce: int) -> bytes | None:
+        return self._tuple_roots.get(nonce)
+
+
+def verify_share_inclusion(
+    contract: BlobstreamContract,
+    nonce: int,
+    height: int,
+    data_root: bytes,
+    share_proof,
+    tuple_proof: merkle_host.Proof,
+) -> bool:
+    """The full verify.go chain: shares → data root → tuple root → the root
+    the contract actually stores for `nonce`. Returns False on ANY broken
+    link (never raises for verification failures)."""
+    try:
+        if not share_proof.verify(data_root):
+            return False
+        stored = contract.data_root_tuple_root(nonce)
+        if stored is None:
+            return False
+        return bs.verify_data_root_inclusion(
+            height, data_root, stored, tuple_proof
+        )
+    except (ValueError, TypeError, AttributeError):
+        return False

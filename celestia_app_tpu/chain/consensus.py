@@ -1,0 +1,335 @@
+"""Multi-node consensus coordination: votes, gossip, WAL replay, state sync.
+
+The reference delegates this plane to celestia-core (Tendermint: p2p gossip
+of txs/proposals/votes, the write-ahead log replayed on crash recovery
+(app/app.go:435 LoadLatestVersion + WAL), and state-sync snapshots serving
+fast bootstrap (default_overrides.go:294-297)). This module coordinates
+N validator instances of THIS framework the same way, with an in-process
+message bus standing in for TCP gossip (the single-container analog of
+test/util/testnode's real-node network):
+
+- **Proposals + votes**: the height's proposer (round-robin by voting
+  power order) runs PrepareProposal; every validator independently replays
+  it through ProcessProposal and casts a SIGNED prevote for the block hash
+  (or nil on rejection). ≥2/3 of voting power on the same hash forms a
+  commit certificate; every node then finalizes + commits the identical
+  block and must land on the identical app hash (divergence raises).
+- **Commit certificates** are persisted with each height and verifiable
+  offline: height, block hash, and the validators' signatures over the
+  canonical vote bytes.
+- **WAL**: each node appends {proposal, votes} to a height-keyed JSON WAL
+  BEFORE applying the block; a node that crashed between WAL write and
+  commit replays the WAL entry on restart and converges without re-running
+  consensus (Tendermint's replay semantics).
+- **State sync**: a fresh node bootstraps from a peer by fetching snapshot
+  CHUNKS (the peer's committed store in deterministic key-ranged pieces),
+  verifying the reassembled store's app hash against the trusted header's
+  app_hash before adopting it — a wrong/altered chunk set is rejected.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+
+from celestia_app_tpu.chain.app import App
+from celestia_app_tpu.chain.block import Block, Header
+from celestia_app_tpu.chain.crypto import PrivateKey, PublicKey
+from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+
+
+@dataclasses.dataclass(frozen=True)
+class Vote:
+    height: int
+    block_hash: bytes | None  # None = nil vote (proposal rejected)
+    validator: bytes  # 20-byte operator address
+    signature: bytes
+
+    @staticmethod
+    def sign_bytes(chain_id: str, height: int, block_hash: bytes | None) -> bytes:
+        doc = {
+            "chain_id": chain_id,
+            "height": height,
+            "block_hash": block_hash.hex() if block_hash else None,
+            "type": "precommit",
+        }
+        return json.dumps(doc, sort_keys=True).encode()
+
+
+@dataclasses.dataclass(frozen=True)
+class CommitCertificate:
+    height: int
+    block_hash: bytes
+    votes: tuple[Vote, ...]
+
+    def verify(self, chain_id: str, validators: dict[bytes, bytes],
+               total_power: int, powers: dict[bytes, int]) -> bool:
+        """Check ≥2/3 of `total_power` signed this block hash. `validators`
+        maps operator address -> 33-byte pubkey."""
+        signed = 0
+        seen: set[bytes] = set()
+        doc = Vote.sign_bytes(chain_id, self.height, self.block_hash)
+        for v in self.votes:
+            if v.validator in seen or v.block_hash != self.block_hash:
+                continue
+            pub = validators.get(v.validator)
+            if pub is None or PublicKey(pub).address() != v.validator:
+                continue
+            if not PublicKey(pub).verify(v.signature, doc):
+                continue
+            seen.add(v.validator)
+            signed += powers.get(v.validator, 0)
+        # STRICTLY more than 2/3 (Tendermint): at exactly 2/3, two
+        # conflicting certificates could overlap in only 1/3 of power —
+        # all of it byzantine — losing the accountability guarantee
+        return signed * 3 > total_power * 2
+
+
+class ValidatorNode:
+    """One validator: an App + key + mempool + WAL."""
+
+    def __init__(self, name: str, priv: PrivateKey, genesis: dict,
+                 chain_id: str, data_dir: str | None = None):
+        self.name = name
+        self.priv = priv
+        self.address = priv.public_key().address()
+        self.app = App(chain_id=chain_id, engine="host", data_dir=data_dir)
+        self.app.init_chain(genesis)
+        self.mempool: list[bytes] = []
+        self.wal_dir = os.path.join(data_dir, "wal") if data_dir else None
+        if self.wal_dir:
+            os.makedirs(self.wal_dir, exist_ok=True)
+        self.certificates: dict[int, CommitCertificate] = {}
+
+    # -- mempool (gossiped) ---------------------------------------------
+
+    def add_tx(self, raw: bytes) -> bool:
+        res = self.app.check_tx(raw)
+        if res.code == 0:
+            self.mempool.append(raw)
+            return True
+        return False
+
+    # -- consensus steps -------------------------------------------------
+
+    def propose(self, t: float):
+        prop = self.app.prepare_proposal(self.mempool, proposer=self.address, t=t)
+        return prop.block
+
+    def vote_on(self, block: Block) -> Vote:
+        ok = self.app.process_proposal(block)
+        bh = block.header.hash() if ok else None
+        sig = self.priv.sign(
+            Vote.sign_bytes(self.app.chain_id, block.header.height, bh)
+        )
+        return Vote(block.header.height, bh, self.address, sig)
+
+    def _wal_path(self, height: int) -> str:
+        return os.path.join(self.wal_dir, f"{height:020d}.json")
+
+    def write_wal(self, block: Block, cert: CommitCertificate) -> None:
+        """Append-before-apply: the crash-recovery record."""
+        if self.wal_dir is None:
+            return
+        import base64
+
+        doc = {
+            "height": block.header.height,
+            "header": {
+                "chain_id": block.header.chain_id,
+                "height": block.header.height,
+                "time_unix": block.header.time_unix,
+                "data_hash": block.header.data_hash.hex(),
+                "square_size": block.header.square_size,
+                "app_hash": block.header.app_hash.hex(),
+                "proposer": block.header.proposer.hex(),
+                "app_version": block.header.app_version,
+                "last_block_hash": block.header.last_block_hash.hex(),
+            },
+            "txs": [base64.b64encode(tx).decode() for tx in block.txs],
+            "votes": [
+                {
+                    "height": v.height,
+                    "block_hash": v.block_hash.hex() if v.block_hash else None,
+                    "validator": v.validator.hex(),
+                    "signature": v.signature.hex(),
+                }
+                for v in cert.votes
+            ],
+        }
+        tmp = self._wal_path(block.header.height) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._wal_path(block.header.height))
+
+    def apply(self, block: Block, cert: CommitCertificate) -> bytes:
+        """Finalize + commit a certified block; returns the app hash."""
+        self.write_wal(block, cert)
+        self.app.finalize_block(block)
+        app_hash = self.app.commit(block)
+        self.certificates[block.header.height] = cert
+        committed = {tx for tx in block.txs}
+        self.mempool = [tx for tx in self.mempool if tx not in committed]
+        return app_hash
+
+    def replay_wal(self) -> int:
+        """Crash recovery: apply WAL entries above the committed height
+        (Tendermint replay). Returns how many blocks were replayed."""
+        if self.wal_dir is None:
+            return 0
+        import base64
+
+        replayed = 0
+        for name in sorted(os.listdir(self.wal_dir)):
+            if not name.endswith(".json"):
+                continue
+            height = int(name.split(".")[0])
+            if height <= self.app.height:
+                continue
+            with open(os.path.join(self.wal_dir, name)) as f:
+                doc = json.load(f)
+            hd = doc["header"]
+            block = Block(
+                header=Header(
+                    chain_id=hd["chain_id"],
+                    height=hd["height"],
+                    time_unix=hd["time_unix"],
+                    data_hash=bytes.fromhex(hd["data_hash"]),
+                    square_size=hd["square_size"],
+                    app_hash=bytes.fromhex(hd["app_hash"]),
+                    proposer=bytes.fromhex(hd["proposer"]),
+                    app_version=hd["app_version"],
+                    last_block_hash=bytes.fromhex(hd["last_block_hash"]),
+                ),
+                txs=[base64.b64decode(t) for t in doc["txs"]],
+            )
+            votes = tuple(
+                Vote(
+                    v["height"],
+                    bytes.fromhex(v["block_hash"]) if v["block_hash"] else None,
+                    bytes.fromhex(v["validator"]),
+                    bytes.fromhex(v["signature"]),
+                )
+                for v in doc["votes"]
+            )
+            cert = CommitCertificate(height, block.header.hash(), votes)
+            self.app.finalize_block(block)
+            self.app.commit(block)
+            self.certificates[height] = cert
+            replayed += 1
+        return replayed
+
+    # -- state sync (serving side) ---------------------------------------
+
+    SNAPSHOT_CHUNK_KEYS = 64
+
+    def snapshot_chunks(self) -> tuple[dict, list[bytes]]:
+        """(manifest, chunks): the committed store split into deterministic
+        key-ranged chunks (state-sync serving, default_overrides.go:294)."""
+        items = sorted(self.app.store.snapshot().items())
+        chunks: list[bytes] = []
+        for i in range(0, max(len(items), 1), self.SNAPSHOT_CHUNK_KEYS):
+            part = items[i : i + self.SNAPSHOT_CHUNK_KEYS]
+            chunks.append(
+                json.dumps(
+                    [[k.hex(), v.hex()] for k, v in part], sort_keys=True
+                ).encode()
+            )
+        manifest = {
+            "height": self.app.height,
+            "app_hash": self.app.last_app_hash.hex(),
+            "app_version": self.app.app_version,
+            "chain_id": self.app.chain_id,
+            "genesis_time": self.app.genesis_time,
+            "last_block_hash": self.app.last_block_hash.hex(),
+            "n_chunks": len(chunks),
+            "chunk_hashes": [hashlib.sha256(c).hexdigest() for c in chunks],
+        }
+        return manifest, chunks
+
+
+def state_sync_bootstrap(
+    node: ValidatorNode, manifest: dict, chunks: list[bytes]
+) -> None:
+    """Adopt a snapshot AFTER verification: every chunk must match the
+    manifest hash, and the reassembled store's app hash must equal the
+    trusted header's app_hash — altered chunks are rejected wholesale."""
+    if len(chunks) != manifest["n_chunks"]:
+        raise ValueError("chunk count mismatch")
+    for i, c in enumerate(chunks):
+        if hashlib.sha256(c).hexdigest() != manifest["chunk_hashes"][i]:
+            raise ValueError(f"chunk {i} hash mismatch")
+    data: dict[bytes, bytes] = {}
+    for c in chunks:
+        for k_hex, v_hex in json.loads(c):
+            data[bytes.fromhex(k_hex)] = bytes.fromhex(v_hex)
+    from celestia_app_tpu.chain.state import KVStore
+
+    probe = KVStore(data)
+    if probe.app_hash().hex() != manifest["app_hash"]:
+        raise ValueError("snapshot app hash does not match trusted header")
+    node.app.store.restore(data)
+    node.app.height = manifest["height"]
+    node.app.app_version = manifest["app_version"]
+    node.app.last_app_hash = bytes.fromhex(manifest["app_hash"])
+    node.app.last_block_hash = bytes.fromhex(manifest["last_block_hash"])
+    node.app.genesis_time = manifest["genesis_time"]
+    node.app._check_state = None
+
+
+class LocalNetwork:
+    """N validators + an in-process gossip bus (tx fan-out, proposal/vote
+    exchange). Proposer rotation is deterministic round-robin over the
+    address-sorted validator set."""
+
+    def __init__(self, nodes: list[ValidatorNode]):
+        if not nodes:
+            raise ValueError("need at least one validator")
+        self.nodes = sorted(nodes, key=lambda n: n.address)
+        self.chain_id = nodes[0].app.chain_id
+        self._round = 0  # advances on failed rounds so the proposer rotates
+
+    def _powers(self, app: App) -> dict[bytes, int]:
+        ctx = Context(app.store, InfiniteGasMeter(), app.height, 0,
+                      app.chain_id, app.app_version)
+        return dict(app.staking.validators(ctx))
+
+    def broadcast_tx(self, raw: bytes) -> bool:
+        """Gossip: every node's mempool sees the tx (first node's CheckTx
+        verdict is authoritative for the caller)."""
+        results = [n.add_tx(raw) for n in self.nodes]
+        return results[0]
+
+    def proposer_for(self, height: int, round_: int = 0) -> ValidatorNode:
+        return self.nodes[(height + round_) % len(self.nodes)]
+
+    def produce_height(self, t: float) -> tuple[Block | None, CommitCertificate | None]:
+        """One consensus round. Returns (block, certificate) on commit, or
+        (None, None) when the proposal failed to reach >2/3 — the round
+        counter then advances, so the NEXT call rotates past a faulty
+        proposer instead of retrying it forever (Tendermint round schedule)."""
+        height = self.nodes[0].app.height + 1
+        proposer = self.proposer_for(height, self._round)
+        block = proposer.propose(t)
+        votes = tuple(n.vote_on(block) for n in self.nodes)
+        bh = block.header.hash()
+        powers = self._powers(self.nodes[0].app)
+        total = sum(powers.values())
+        cert = CommitCertificate(height, bh, votes)
+        validators = {
+            n.address: n.priv.public_key().compressed for n in self.nodes
+        }
+        if not cert.verify(self.chain_id, validators, total, powers):
+            self._round += 1
+            return None, None
+        self._round = 0
+        hashes = {n.apply(block, cert) for n in self.nodes}
+        if len(hashes) != 1:
+            raise AssertionError(
+                f"state divergence after height {height}: {sorted(h.hex() for h in hashes)}"
+            )
+        return block, cert

@@ -462,6 +462,21 @@ class IBCStack:
         )
         if chan is None or chan["state"] != "OPEN":
             raise IBCError("unknown destination channel")
+        # the packet's source must be THIS channel's counterparty (ibc-go
+        # checks this before proof verification): without it, a packet the
+        # counterparty committed for a DIFFERENT channel — channel ids are
+        # per-chain, collisions are normal — would proof-verify here and
+        # pay out a second time from someone else's escrow
+        if (
+            packet["source_port"] != chan["counterparty_port"]
+            or packet["source_channel"] != chan["counterparty_channel"]
+        ):
+            raise IBCError(
+                f"packet source {packet['source_port']}/"
+                f"{packet['source_channel']} does not match the channel's "
+                f"counterparty {chan['counterparty_port']}/"
+                f"{chan['counterparty_channel']}"
+            )
         self._verify_commitment_proof(ctx, chan, packet, proof, proof_height)
         # packet receipts: a replayed sequence returns the recorded ack
         # without re-executing (no double unescrow)
@@ -480,9 +495,10 @@ class IBCStack:
                 ack = self.ica_host.on_recv_packet(per_packet, packet)
             else:
                 ack = self.module.on_recv_packet(per_packet, packet)
-        except (IBCError, ValueError, KeyError, TypeError) as e:
-            # malformed packet data or failed escrow movement becomes an
-            # error acknowledgement, never a relay crash
+        except (IBCError, ValueError, KeyError, TypeError, AttributeError) as e:
+            # malformed packet data (non-dict msgs entries included) or a
+            # failed escrow movement becomes an error acknowledgement,
+            # never a relay crash
             ack = {"error": f"{type(e).__name__}: {e}"}
         if "error" not in ack:
             per_packet.store.write()

@@ -35,6 +35,7 @@ class QueryRouter:
     def __init__(self, app):
         self.app = app
         self._prover_cache: dict[int, tuple] = {}
+        self._tx_hash_cache: dict[int, dict[str, int]] = {}
         self._cache_generation = getattr(app, "state_generation", 0)
 
     def _ctx(self) -> Context:
@@ -120,6 +121,8 @@ class QueryRouter:
                 "network_min_gas_price":
                     self.app.minfee.network_min_gas_price(self._ctx())
             }
+        if path == "tx":
+            return self._tx_by_hash(data)
         if path == "status":
             return {
                 "chain_id": self.app.chain_id,
@@ -130,6 +133,36 @@ class QueryRouter:
                 "telemetry": telemetry.snapshot(),
             }
         raise QueryError(f"unknown query path {path!r}")
+
+    def _tx_by_hash(self, data: dict) -> dict:
+        """Confirmation lookup: find a tx by the sha256 of its broadcast
+        bytes (blocks store the same BlobTx-envelope bytes clients hash) —
+        the reference's /tx RPC that TxClient.ConfirmTx polls,
+        pkg/user/tx_client.go:412. Per-height hash sets are cached so a
+        confirmation polling loop costs O(new heights), not a gzip reload
+        of the whole lookback window per poll."""
+        import hashlib as _hashlib
+
+        want = data["hash"].lower()
+        if self.app.db is None:
+            raise QueryError("no block store attached (need data_dir)")
+        if getattr(self.app, "state_generation", 0) != self._cache_generation:
+            self._prover_cache.clear()
+            self._tx_hash_cache.clear()
+            self._cache_generation = self.app.state_generation
+        heights = self.app.db.block_heights()
+        for h in reversed(heights[-int(data.get("lookback", 50)) :]):
+            cached = self._tx_hash_cache.get(h)
+            if cached is None:
+                block = self.app.db.load_block(h)
+                cached = {
+                    _hashlib.sha256(raw).hexdigest(): i
+                    for i, raw in enumerate(block.txs)
+                }
+                self._tx_hash_cache[h] = cached
+            if want in cached:
+                return {"found": True, "height": h, "index": cached[want]}
+        return {"found": False}
 
     def _tx_inclusion(self, data: dict) -> dict:
         height = int(data["height"])

@@ -75,27 +75,109 @@ class Signer:
             return wire_codec.sign_tx_proto(body, acc.priv)
         return sign_tx(body, acc.priv)
 
-    def create_pay_for_blobs(
-        self, addr: bytes, blobs: list[Blob], fee: int, gas_limit: int,
-        subtree_root_threshold: int = 64,
-    ) -> bytes:
-        """Build MsgPayForBlobs + sign + wrap in a BlobTx envelope
-        (x/blob/types/payforblob.go:48-77 + blob.MarshalBlobTx)."""
-        msg = MsgPayForBlobs(
+    def build_pfb_msg(
+        self, addr: bytes, blobs: list[Blob], subtree_root_threshold: int = 64
+    ) -> MsgPayForBlobs:
+        """MsgPayForBlobs with per-blob share commitments — the expensive
+        part (Merkle trees over all blob shares); build ONCE and re-sign
+        with different fee/gas as needed."""
+        return MsgPayForBlobs(
             signer=addr,
             namespaces=tuple(b.namespace.raw for b in blobs),
             blob_sizes=tuple(len(b.data) for b in blobs),
             share_commitments=tuple(
-                commitment_mod.create_commitment(b, subtree_root_threshold) for b in blobs
+                commitment_mod.create_commitment(b, subtree_root_threshold)
+                for b in blobs
             ),
             share_versions=tuple(b.share_version for b in blobs),
         )
+
+    def create_pay_for_blobs(
+        self, addr: bytes, blobs: list[Blob], fee: int, gas_limit: int,
+        subtree_root_threshold: int = 64, msg: MsgPayForBlobs | None = None,
+    ) -> bytes:
+        """Build MsgPayForBlobs + sign + wrap in a BlobTx envelope
+        (x/blob/types/payforblob.go:48-77 + blob.MarshalBlobTx). Pass a
+        precomputed `msg` to skip recomputing commitments."""
+        if msg is None:
+            msg = self.build_pfb_msg(addr, blobs, subtree_root_threshold)
         tx = self.create_tx(addr, [msg], fee, gas_limit)
         return blob_mod.marshal_blob_tx(tx.encode(), blobs)
 
 
+class HttpNodeClient:
+    """Remote node transport: the same surface TxClient needs, over the
+    HTTP JSON service (service/server.py) — the reference's gRPC remote
+    mode (pkg/user/tx_client.go:320-330 BroadcastMode_SYNC + Simulate)."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _post(self, path: str, payload: dict) -> dict:
+        import json as json_mod
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=json_mod.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json_mod.loads(r.read())
+
+    def broadcast_tx(self, raw: bytes):
+        import base64
+
+        out = self._post("/broadcast_tx", {"tx": base64.b64encode(raw).decode()})
+        from celestia_app_tpu.chain.block import TxResult
+
+        return TxResult(out["code"], out.get("log", ""),
+                        out.get("gas_wanted", 0), out.get("gas_used", 0), [])
+
+    def simulate_tx(self, raw: bytes) -> int:
+        """-> gas_used; raises on a failed simulation."""
+        import base64
+
+        out = self._post("/simulate_tx", {"tx": base64.b64encode(raw).decode()})
+        if out["code"] != 0:
+            raise RuntimeError(f"simulation failed: {out.get('log')}")
+        return out["gas_used"]
+
+    def confirm_tx(
+        self, raw: bytes, attempts: int = 1, interval: float = 3.0
+    ) -> dict:
+        """Poll the tx-by-hash route until found or attempts run out —
+        {'found': bool, height?, index?}. The reference's ConfirmTx polls
+        every 3s (tx_client.go:412); the server's own block loop (`start`)
+        or a devnet /produce_block commits the tx between polls."""
+        import hashlib
+        import time as time_mod
+
+        txhash = hashlib.sha256(raw).hexdigest()
+        for i in range(max(1, attempts)):
+            out = self._post("/abci_query", {"path": "tx", "data": {"hash": txhash}})
+            if out.get("found"):
+                return out
+            if i + 1 < attempts:
+                time_mod.sleep(interval)
+        return out
+
+    def status(self) -> dict:
+        import json as json_mod
+        import urllib.request
+
+        with urllib.request.urlopen(
+            self.base_url + "/status", timeout=self.timeout
+        ) as r:
+            return json_mod.loads(r.read())
+
+
 class TxClient:
-    """High-level submission against an in-process node."""
+    """High-level submission against an in-process Node OR a remote
+    HttpNodeClient (both expose broadcast_tx/confirm_tx; gas estimation
+    prefers true simulation when the transport offers it)."""
 
     def __init__(self, node, signer: Signer, gas_multiplier: float = 1.1):
         self.node = node
@@ -108,19 +190,70 @@ class TxClient:
             appconsts.DEFAULT_NETWORK_MIN_GAS_PRICE,
         )
 
+    def _simulate_gas(self, raw: bytes) -> int | None:
+        """Simulate-based estimation (tx_client.go estimateGas): dry-run the
+        tx and return measured gas, or None when no simulator is reachable
+        (fall back to the linear model)."""
+        sim = getattr(self.node, "simulate_tx", None)
+        if sim is None:
+            app = getattr(self.node, "app", None)
+            sim = getattr(app, "simulate_tx", None)
+        if sim is None:
+            return None
+        try:
+            res = sim(raw)
+        except Exception:
+            # unreachable/failing simulator (HTTP errors, bad body, failed
+            # simulation): fall back to the linear model as documented
+            return None
+        if isinstance(res, int):
+            return res
+        return res.gas_used if res.code == 0 else None
+
+    def estimate_gas(
+        self, addr: bytes, msgs, blobs: list[Blob] | None = None, pfb_msg=None
+    ) -> int:
+        """Measured-gas estimation with the linear PFB model as fallback.
+        Pass `pfb_msg` (from Signer.build_pfb_msg) to avoid recomputing
+        blob commitments for the probe."""
+        if blobs:
+            probe = self.signer.create_pay_for_blobs(
+                addr, blobs, fee=1, gas_limit=1 << 40, msg=pfb_msg
+            )
+        else:
+            probe = self.signer.create_tx(addr, msgs, fee=1, gas_limit=1 << 40).encode()
+        measured = self._simulate_gas(probe)
+        if measured is not None:
+            return int(measured * self.gas_multiplier)
+        if blobs:
+            return int(
+                modules.estimate_pfb_gas([len(b.data) for b in blobs])
+                * self.gas_multiplier
+            )
+        return 100_000
+
     def submit_pay_for_blob(self, addr: bytes, blobs: list[Blob]):
-        """Estimate gas, sign, broadcast, confirm; resubmit once on a
-        sequence mismatch (tx_client.go:357 + nonce parsing)."""
-        gas = int(
-            modules.estimate_pfb_gas([len(b.data) for b in blobs]) * self.gas_multiplier
-        )
+        """Estimate gas (simulate, falling back to the linear model), sign,
+        broadcast, confirm; resubmit once on a sequence mismatch
+        (tx_client.go:357 + nonce parsing). Blob commitments — the dominant
+        client-side hashing cost — are computed exactly once."""
+        pfb_msg = self.signer.build_pfb_msg(addr, blobs)
+        gas = self.estimate_gas(addr, [], blobs, pfb_msg=pfb_msg)
         fee = max(1, int(gas * self._gas_price()) + 1)
 
         for _attempt in range(2):
-            raw = self.signer.create_pay_for_blobs(addr, blobs, fee=fee, gas_limit=gas)
+            raw = self.signer.create_pay_for_blobs(
+                addr, blobs, fee=fee, gas_limit=gas, msg=pfb_msg
+            )
             res = self.node.broadcast_tx(raw)
             if res.code == 0:
                 self.signer.accounts[addr].sequence += 1
+                # in-process Node drives blocks to commit and returns
+                # (height, TxResult); the remote transport POLLS the
+                # server's block production and returns the tx-by-hash
+                # dict — check ['found'] before treating it as committed
+                if isinstance(self.node, HttpNodeClient):
+                    return self.node.confirm_tx(raw, attempts=10, interval=1.0)
                 return self.node.confirm_tx(raw)
             expected = parse_expected_sequence(res.log)
             if expected is None:

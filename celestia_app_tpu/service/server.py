@@ -12,6 +12,7 @@ Endpoints:
   GET  /status                         chain identity + telemetry
   GET  /block/<height>                 stored block (header + b64 txs)
   POST /broadcast_tx   {"tx": b64}     CheckTx + mempool admission
+  POST /simulate_tx    {"tx": b64}     dry-run gas estimation (Simulate rpc)
   POST /produce_block  {"time": t?}    devnet convenience: one round
   POST /abci_query     {"path": ..., "data": {...}}
 """
@@ -77,6 +78,14 @@ class NodeService:
                         self._send(200, {
                             "code": res.code, "log": res.log,
                             "gas_wanted": res.gas_wanted,
+                            "gas_used": res.gas_used,
+                        })
+                    elif self.path == "/simulate_tx":
+                        raw = base64.b64decode(payload["tx"])
+                        with service.lock:
+                            res = service.node.app.simulate_tx(raw)
+                        self._send(200, {
+                            "code": res.code, "log": res.log,
                             "gas_used": res.gas_used,
                         })
                     elif self.path == "/produce_block":
