@@ -164,14 +164,17 @@ def measure_device(reps: int = 10) -> tuple[float, str]:
 
 
 def measure_stages(reps: int = 10) -> None:
-    """Report per-stage device timings to stderr (--stages)."""
+    """Report per-stage device timings to stderr (--stages), including both
+    RS matmul layouts (batched einsum vs one flat GEMM) so the faster
+    schedule on the actual hardware is visible."""
     import jax
 
     from celestia_app_tpu.da import eds as eds_mod
     from celestia_app_tpu.ops import rs
 
     ods = jax.device_put(_bench_ods(K))
-    extend_ms = _time_fn(jax.jit(rs.extend_square_fn(K)), ods, reps)
+    extend_ms = _time_fn(jax.jit(rs.extend_square_fn(K, layout="batched")), ods, reps)
+    flat_ms = _time_fn(jax.jit(rs.extend_square_fn(K, layout="flat")), ods, reps)
     try:
         full_ms = _time_fn(eds_mod.jitted_pipeline(K), ods, reps)
     except Exception as e:
@@ -184,7 +187,8 @@ def measure_stages(reps: int = 10) -> None:
     # NMT+root stage ≈ full − extend (stages fuse inside one dispatch, so
     # subtraction is the honest attribution available without a profiler).
     print(
-        f"stages: extend={extend_ms:.2f} ms, full={full_ms:.2f} ms, "
+        f"stages: extend(batched)={extend_ms:.2f} ms, "
+        f"extend(flat)={flat_ms:.2f} ms, full={full_ms:.2f} ms, "
         f"nmt+root≈{full_ms - extend_ms:.2f} ms",
         file=sys.stderr,
     )

@@ -95,23 +95,48 @@ def _codec(k: int):
     return leopard.bit_matrix(k), bytes_to_bits, bits_to_bytes
 
 
-def extend_square_fn(k: int):
+def _gf_mix_flat(bit_mat: jax.Array, x_bits: jax.Array) -> jax.Array:
+    """Same contraction as _gf_mix but reshaped into ONE large GEMM:
+    (8k, 8k) @ (8k, batch*S). A single big matmul keeps the MXU pipeline
+    full where `batch` small GEMMs each pay their own tiling overhead —
+    the layout the bench's --stages probe compares against the batched
+    einsum on hardware (select with CELESTIA_RS_LAYOUT=flat)."""
+    lead = x_bits.shape[:-2]
+    q, s = x_bits.shape[-2], x_bits.shape[-1]
+    flat = x_bits.reshape(-1, q, s)
+    b = flat.shape[0]
+    x = jnp.transpose(flat, (1, 0, 2)).reshape(q, b * s)
+    out = jnp.matmul(bit_mat, x, preferred_element_type=jnp.int32)
+    out = (out & 1).astype(jnp.int8)
+    return jnp.transpose(out.reshape(q, b, s), (1, 0, 2)).reshape(*lead, q, s)
+
+
+def _rs_layout() -> str:
+    import os
+
+    return os.environ.get("CELESTIA_RS_LAYOUT", "batched")
+
+
+def extend_square_fn(k: int, layout: str | None = None):
     """Return a jittable fn: (k, k, 512) uint8 ODS -> (2k, 2k, 512) uint8 EDS.
 
     k <= 128 uses the GF(2^8) code; k >= 256 the GF(2^16) code (leopard16),
-    both as one bit-matrix MXU matmul per pass."""
+    both as one bit-matrix MXU matmul per pass. `layout` (or env
+    CELESTIA_RS_LAYOUT) picks the matmul shape: "batched" einsum (default)
+    or "flat" single-GEMM — bit-identical outputs, different schedules."""
     mat, to_bits, from_bits = _codec(k)
     bit_mat = jnp.asarray(mat)  # constant folded into the jaxpr
+    mix = _gf_mix_flat if (layout or _rs_layout()) == "flat" else _gf_mix
 
     def extend(ods: jax.Array) -> jax.Array:
         assert ods.shape == (k, k, SHARE), ods.shape
         # Row pass: mix across the share index within each row.
-        q1 = from_bits(_gf_mix(bit_mat, to_bits(ods)))  # (k, k, S)
+        q1 = from_bits(mix(bit_mat, to_bits(ods)))  # (k, k, S)
         # Column pass: transpose so columns become the mixing axis.
-        q2_t = from_bits(_gf_mix(bit_mat, to_bits(jnp.swapaxes(ods, 0, 1))))
+        q2_t = from_bits(mix(bit_mat, to_bits(jnp.swapaxes(ods, 0, 1))))
         q2 = jnp.swapaxes(q2_t, 0, 1)  # (k rows of parity, k cols, S)
         # Q3 = row-extend Q2 (== column-extend Q1, data_structures.md:304-310).
-        q3 = from_bits(_gf_mix(bit_mat, to_bits(q2)))
+        q3 = from_bits(mix(bit_mat, to_bits(q2)))
         top = jnp.concatenate([ods, q1], axis=1)
         bottom = jnp.concatenate([q2, q3], axis=1)
         return jnp.concatenate([top, bottom], axis=0)

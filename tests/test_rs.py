@@ -59,3 +59,18 @@ def test_bits_roundtrip():
     x = jnp.asarray(rng.integers(0, 256, size=(3, 4, 16), dtype=np.uint8))
     back = rs.bits_to_bytes(rs.bytes_to_bits(x))
     assert (np.asarray(back) == np.asarray(x)).all()
+
+
+def test_flat_gemm_layout_bit_identical():
+    """CELESTIA_RS_LAYOUT=flat is a schedule change only: outputs must be
+    bit-identical to the batched einsum for both fields."""
+    import jax
+
+    from celestia_app_tpu.ops import rs as rs_mod
+
+    rng = np.random.default_rng(11)
+    for k in (4, 8):
+        ods = rng.integers(0, 256, size=(k, k, 512), dtype=np.uint8)
+        batched = np.asarray(jax.jit(rs_mod.extend_square_fn(k, layout="batched"))(ods))
+        flat = np.asarray(jax.jit(rs_mod.extend_square_fn(k, layout="flat"))(ods))
+        np.testing.assert_array_equal(batched, flat)
