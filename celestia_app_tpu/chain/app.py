@@ -187,6 +187,7 @@ class App:
                 raise ValueError(f"unroutable ICA msg {t!r}")
 
         self.ibc = ibc_mod.IBCStack(self.bank, ica_router=_ica_router)
+
         self.distribution = sdk_modules.DistributionKeeper(self.staking, self.bank)
         self.slashing = sdk_modules.SlashingKeeper(self.staking)
         self.authz = sdk_modules.AuthzKeeper()
@@ -196,6 +197,64 @@ class App:
         sdk_modules.register_default_invariants(self.crisis, self)
         self.bank.vesting = self.vesting  # locked funds gate inside bank.send
         self.staking.hooks.append(self.distribution)  # F1 settlement hook
+
+        # versioned module manager (app/module/manager.go analog): each
+        # module declares its [From,To] app-version range; Begin/EndBlock
+        # and migrations dispatch through it (see finalize_block/_migrate)
+        from celestia_app_tpu.chain.module_manager import (
+            ModuleManager,
+            VersionedModule,
+        )
+
+        def _slashing_liveness(ctx):
+            # liveness from the last commit: validators in
+            # self.absent_validators are treated as not signing (the
+            # single-process analog of LastCommitInfo)
+            for op, _power in self.staking.validators(ctx):
+                self.slashing.handle_signature(
+                    ctx, op, signed=op not in self.absent_validators
+                )
+
+        mm = ModuleManager()
+        mm.register(VersionedModule(
+            "mint", 1, appconsts.LATEST_VERSION,
+            begin_block=lambda ctx: self.mint.begin_blocker(ctx, self.bank),
+        ))
+        mm.register(VersionedModule(
+            "distribution", 1, appconsts.LATEST_VERSION,
+            begin_block=self.distribution.allocate,
+        ))
+        mm.register(VersionedModule(
+            "slashing", 1, appconsts.LATEST_VERSION,
+            begin_block=_slashing_liveness,
+        ))
+        mm.register(VersionedModule(
+            "staking", 1, appconsts.LATEST_VERSION,
+            end_block=self.staking.end_blocker,
+        ))
+        mm.register(VersionedModule(
+            "gov", 1, appconsts.LATEST_VERSION,
+            end_block=self.gov.end_blocker,
+        ))
+        mm.register(VersionedModule(
+            "blobstream", 1, 1,  # v1 only (app/modules.go:171)
+            end_block=self.blobstream.end_blocker,
+            on_exit=lambda ctx: [
+                ctx.store.delete(k)
+                for k, _ in list(ctx.store.iterate_prefix(b"blobstream/"))
+            ],
+        ))
+        mm.register(VersionedModule(
+            "minfee", 2, appconsts.LATEST_VERSION,  # v2+ (modules.go)
+            on_enter=lambda ctx: self.minfee.set_network_min_gas_price(
+                ctx, appconsts.DEFAULT_NETWORK_MIN_GAS_PRICE
+            ),
+        ))
+        mm.register(VersionedModule("signal", 2, appconsts.LATEST_VERSION))
+        # registration order IS the dispatch order (setModuleOrder analog:
+        # one source of truth; use set_begin_order/set_end_order only to
+        # diverge from it)
+        self.module_manager = mm
         self.ante = ante_mod.AnteHandler(
             self.auth, self.bank, self.blob, self.minfee, min_gas_price,
             feegrant=self.feegrant,
@@ -550,17 +609,11 @@ class App:
         h = block.header
         ctx = self._deliver_ctx(InfiniteGasMeter(), height=h.height, t=h.time_unix)
 
-        # BeginBlock: mint first, then distribution allocates last block's
-        # fees + provisions to validator reward indices (app/modules.go
-        # order), then slashing records liveness from the last commit
-        # (validators in self.absent_validators are treated as not signing —
-        # the single-process analog of LastCommitInfo)
-        self.mint.begin_blocker(ctx, self.bank)
-        self.distribution.allocate(ctx)
-        for op, _power in self.staking.validators(ctx):
-            self.slashing.handle_signature(
-                ctx, op, signed=op not in self.absent_validators
-            )
+        # BeginBlock via the versioned module manager (mint first, then
+        # distribution, then slashing liveness — app/modules.go order);
+        # only modules whose [From,To] range covers the current app
+        # version run (app/module/manager.go dispatch)
+        self.module_manager.begin_block(ctx, self.app_version)
         self.absent_validators = set()
 
         results: list[TxResult] = []
@@ -698,13 +751,10 @@ class App:
 
     def _end_blocker(self, ctx: Context, height: int) -> None:
         # staking unbonding queue matures, then gov proposals resolve, then
-        # blobstream attestations (module EndBlocker order app/modules.go)
-        self.staking.end_blocker(ctx)
-        self.gov.end_blocker(ctx)
-        # blobstream attestations, v1 only (x/blobstream/abci.go:29,
-        # module version range app/modules.go:171)
-        if self.app_version == 1:
-            self.blobstream.end_blocker(ctx)
+        # blobstream attestations (module EndBlocker order app/modules.go;
+        # blobstream's [1,1] range retires it at v2, app/modules.go:171) —
+        # all dispatched by the versioned module manager
+        self.module_manager.end_block(ctx, self.app_version)
         # height-based v1 -> v2 (app/app.go:458-470)
         if (
             self.app_version == 1
@@ -721,14 +771,11 @@ class App:
                 self._migrate(ctx, target)
 
     def _migrate(self, ctx: Context, new_version: int) -> None:
-        """Store migrations on upgrade (app/app.go:484-508 analog)."""
-        if new_version >= 2 and self.app_version < 2:
-            # blobstream retires at v2 (modules.go:171); minfee param seeded
-            for k, _ in list(ctx.store.iterate_prefix(b"blobstream/")):
-                ctx.store.delete(k)
-            self.minfee.set_network_min_gas_price(
-                ctx, appconsts.DEFAULT_NETWORK_MIN_GAS_PRICE
-            )
+        """Store migrations on upgrade (app/app.go:484-508 analog): the
+        module manager runs on_exit for modules leaving their version range
+        (blobstream store teardown) and on_enter for those arriving
+        (minfee param seeding)."""
+        self.module_manager.migrate(ctx, self.app_version, new_version)
         self.app_version = new_version
 
     SNAPSHOT_KEEP = 100  # bounded rollback window (reference keeps pruned IAVL versions)

@@ -1,0 +1,104 @@
+"""Versioned module manager: per-module [FromVersion, ToVersion] dispatch.
+
+Reference parity: app/module/manager.go — celestia's fork of the SDK module
+manager where every module declares the app-version range it serves;
+Begin/EndBlock (and migrations) dispatch ONLY to modules of the current
+version, and flipping the app version runs the entering/leaving modules'
+migrations (store teardown/seeding), exactly how blobstream retires and
+minfee arrives at v2 (app/modules.go:94-193 module ranges,
+app/app.go:484-508 migrateCommitStore).
+
+The App registers its keepers as `VersionedModule`s with explicit
+begin/end-block order (setModuleOrder, app/modules.go:196); the manager is
+the single dispatch point, so "which modules run at version N" is data,
+not scattered `if app_version` checks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+
+@dataclasses.dataclass
+class VersionedModule:
+    """One module's lifecycle surface over its supported version range."""
+
+    name: str
+    from_version: int
+    to_version: int
+    begin_block: Callable | None = None  # fn(ctx)
+    end_block: Callable | None = None  # fn(ctx)
+    on_enter: Callable | None = None  # fn(ctx): version range just entered
+    on_exit: Callable | None = None  # fn(ctx): version range just left
+
+    def in_range(self, version: int) -> bool:
+        return self.from_version <= version <= self.to_version
+
+
+class ModuleManager:
+    def __init__(self):
+        self._modules: dict[str, VersionedModule] = {}
+        self._begin_order: list[str] = []
+        self._end_order: list[str] = []
+
+    def register(self, module: VersionedModule) -> None:
+        if module.name in self._modules:
+            raise ValueError(f"module {module.name!r} already registered")
+        if module.from_version > module.to_version:
+            raise ValueError(f"module {module.name!r} has an empty version range")
+        self._modules[module.name] = module
+        # default order: registration order
+        self._begin_order.append(module.name)
+        self._end_order.append(module.name)
+
+    def set_begin_order(self, names: list[str]) -> None:
+        self._check_order(names)
+        self._begin_order = list(names)
+
+    def set_end_order(self, names: list[str]) -> None:
+        self._check_order(names)
+        self._end_order = list(names)
+
+    def _check_order(self, names: list[str]) -> None:
+        if len(names) != len(set(names)):
+            dupes = sorted({n for n in names if names.count(n) > 1})
+            raise ValueError(f"module order names duplicated: {dupes}")
+        if sorted(names) != sorted(self._modules):
+            missing = set(self._modules) - set(names)
+            extra = set(names) - set(self._modules)
+            raise ValueError(
+                f"module order must name every module exactly once "
+                f"(missing={sorted(missing)}, unknown={sorted(extra)})"
+            )
+
+    def active(self, version: int) -> list[str]:
+        return [n for n in self._begin_order if self._modules[n].in_range(version)]
+
+    def begin_block(self, ctx, version: int) -> None:
+        for name in self._begin_order:
+            m = self._modules[name]
+            if m.begin_block is not None and m.in_range(version):
+                m.begin_block(ctx)
+
+    def end_block(self, ctx, version: int) -> None:
+        for name in self._end_order:
+            m = self._modules[name]
+            if m.end_block is not None and m.in_range(version):
+                m.end_block(ctx)
+
+    def migrate(self, ctx, from_version: int, to_version: int) -> None:
+        """Run range-boundary hooks for a version flip: modules LEAVING
+        their range tear down (blobstream deletes its store at v2,
+        app/app.go:467), modules ENTERING seed state (minfee param,
+        app/app.go:474)."""
+        for name in self._begin_order:
+            m = self._modules[name]
+            if m.in_range(from_version) and not m.in_range(to_version):
+                if m.on_exit is not None:
+                    m.on_exit(ctx)
+        for name in self._begin_order:
+            m = self._modules[name]
+            if not m.in_range(from_version) and m.in_range(to_version):
+                if m.on_enter is not None:
+                    m.on_enter(ctx)

@@ -317,3 +317,64 @@ def test_high_s_signature_rejected():
     high = r + (_N - s).to_bytes(32, "big")
     assert priv.public_key().verify(sig, b"msg")
     assert not priv.public_key().verify(high, b"msg")
+
+
+def test_module_manager_version_ranges():
+    """app/module/manager.go analog: Begin/EndBlock dispatch only to
+    modules whose [From,To] range covers the current app version, and a
+    version flip runs on_exit/on_enter hooks exactly once."""
+    from celestia_app_tpu.chain.module_manager import (
+        ModuleManager,
+        VersionedModule,
+    )
+
+    calls = []
+    mm = ModuleManager()
+    mm.register(VersionedModule(
+        "a", 1, 3,
+        begin_block=lambda ctx: calls.append("a.begin"),
+        end_block=lambda ctx: calls.append("a.end"),
+    ))
+    mm.register(VersionedModule(
+        "b", 1, 1,
+        end_block=lambda ctx: calls.append("b.end"),
+        on_exit=lambda ctx: calls.append("b.exit"),
+    ))
+    mm.register(VersionedModule(
+        "c", 2, 3,
+        begin_block=lambda ctx: calls.append("c.begin"),
+        on_enter=lambda ctx: calls.append("c.enter"),
+    ))
+    mm.begin_block(None, 1)
+    mm.end_block(None, 1)
+    assert calls == ["a.begin", "a.end", "b.end"]
+    calls.clear()
+    mm.migrate(None, 1, 2)
+    assert calls == ["b.exit", "c.enter"]
+    calls.clear()
+    mm.begin_block(None, 2)
+    mm.end_block(None, 2)
+    assert calls == ["a.begin", "c.begin", "a.end"]
+    # ordering must name every module
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="every module"):
+        mm.set_begin_order(["a", "b"])
+
+
+def test_app_module_manager_drives_upgrade_migration():
+    """The v1->v2 flip through the manager: blobstream store torn down,
+    minfee param seeded — same behavior the hardcoded _migrate had."""
+    from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+
+    app, signer, privs = make_app(v2_upgrade_height=2)
+    app.produce_block([], t=1.0)
+    ctx = Context(app.store, InfiniteGasMeter(), app.height, 0, CHAIN, 1)
+    assert any(True for _ in ctx.store.iterate_prefix(b"blobstream/"))
+    app.produce_block([], t=2.0)  # upgrade height
+    assert app.app_version == 2
+    ctx = Context(app.store, InfiniteGasMeter(), app.height, 0, CHAIN, 2)
+    assert not any(True for _ in ctx.store.iterate_prefix(b"blobstream/"))
+    assert app.minfee.network_min_gas_price_atto(ctx) > 0
+    assert "blobstream" not in app.module_manager.active(2)
+    assert "minfee" in app.module_manager.active(2)
