@@ -90,6 +90,7 @@ class App:
         invariant_check_period: int = 0,  # crisis: 0 = only at genesis/on demand
     ):
         self.invariant_check_period = invariant_check_period
+        self.traces = telemetry.TraceTables()  # per-node trace tables (§5.1)
         self.absent_validators: set[bytes] = set()
         self.chain_id = chain_id
         self.app_version = app_version
@@ -805,6 +806,20 @@ class App:
                 del self._history[h]
         self._check_state = None  # baseapp resetState on commit
         telemetry.measure_since("commit", t0)
+        # BlockSummary trace row (celestia-core pkg/trace analog, §5.1):
+        # what the e2e benchmark tooling scrapes per block. PER-NODE table
+        # (self.traces): multi-node in-process networks must not interleave
+        self.traces.write(
+            "block_summary",
+            height=self.height,
+            time_unix=block.header.time_unix,
+            n_txs=len(block.txs),
+            block_bytes=sum(len(t) for t in block.txs),
+            square_size=block.header.square_size,
+            data_hash=block.header.data_hash.hex(),
+            app_hash=self.last_app_hash.hex(),
+            app_version=self.app_version,
+        )
         return self.last_app_hash
 
     def _commit_meta(self) -> dict:

@@ -51,6 +51,29 @@ class NodeService:
                     if self.path == "/status":
                         with service.lock:
                             self._send(200, service.router.query("status", {}))
+                    elif self.path.startswith("/trace/"):
+                        # columnar trace tables (pkg/trace pull, §5.1):
+                        # /trace/<table>?since=<index>&limit=<n> — reads the
+                        # NODE's tables under the service lock (writes come
+                        # from produce_block on another thread)
+                        from urllib.parse import parse_qs, urlparse
+
+                        parsed = urlparse(self.path)
+                        table = parsed.path.split("/")[2]
+                        qs = parse_qs(parsed.query)
+                        traces = service.node.app.traces
+                        with service.lock:
+                            rows = traces.read(
+                                table,
+                                since_index=int(qs.get("since", ["0"])[0]),
+                                limit=int(qs.get("limit", ["1000"])[0]),
+                            )
+                            names = traces.tables()
+                        self._send(200, {
+                            "table": table,
+                            "rows": rows,
+                            "tables": names,
+                        })
                     elif self.path.startswith("/block/"):
                         height = int(self.path.split("/")[2])
                         blk = service.node.app.db.load_block(height)

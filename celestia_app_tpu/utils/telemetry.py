@@ -50,9 +50,50 @@ class Registry:
         self.timers.clear()
 
 
+class TraceTables:
+    """Columnar event tracing — the celestia-core ``pkg/trace`` analog
+    (SURVEY §5.1): PER-NODE tables of schema'd rows (``BlockSummary``,
+    ``RoundState``-style) that e2e tooling pulls over RPC
+    (test/e2e/testnet/node.go:52-75). Each App owns an instance
+    (`app.traces`) so multi-node in-process networks never interleave;
+    the module-level singleton below serves ad-hoc/process-wide use.
+    Tables are bounded ring buffers; rows carry a monotonically
+    increasing index so pullers can resume."""
+
+    MAX_ROWS = 10_000
+
+    def __init__(self):
+        self._tables: dict[str, list[dict]] = {}
+        self._next_index: dict[str, int] = {}
+
+    def write(self, table: str, **row) -> None:
+        rows = self._tables.setdefault(table, [])
+        idx = self._next_index.get(table, 0)
+        rows.append({"_index": idx, **row})
+        self._next_index[table] = idx + 1
+        if len(rows) > self.MAX_ROWS:
+            del rows[: len(rows) - self.MAX_ROWS]
+
+    def read(self, table: str, since_index: int = 0, limit: int = 1000) -> list[dict]:
+        rows = self._tables.get(table, [])
+        return [r for r in rows if r["_index"] >= since_index][:limit]
+
+    def tables(self) -> list[str]:
+        return sorted(self._tables)
+
+    def reset(self) -> None:
+        self._tables.clear()
+        self._next_index.clear()
+
+
 _global = Registry()
+_traces = TraceTables()
 
 incr = _global.incr
 measure_since = _global.measure_since
 snapshot = _global.snapshot
 reset = _global.reset
+trace = _traces.write
+read_trace = _traces.read
+trace_tables = _traces.tables
+reset_traces = _traces.reset

@@ -332,3 +332,33 @@ def test_remote_tx_client_over_http(tmp_path):
         assert conf["found"] is True and conf["height"] == app.height
     finally:
         svc.shutdown()
+
+
+def test_trace_tables_block_summary(tmp_path):
+    """§5.1 pkg/trace analog: per-block columnar rows, pullable over HTTP
+    with resume-from-index."""
+    import urllib.request as _url
+
+    from celestia_app_tpu.service.server import NodeService
+    from celestia_app_tpu.utils import telemetry
+
+    telemetry.reset_traces()
+    app, signer, privs = _persistent_app(tmp_path)
+    node = _run_blocks(app, signer, privs)
+    svc = NodeService(node, port=0)
+    svc.serve_background()
+    try:
+        out = json.loads(_url.urlopen(
+            f"http://127.0.0.1:{svc.port}/trace/block_summary").read())
+        assert "block_summary" in out["tables"]
+        rows = out["rows"]
+        assert len(rows) == app.height
+        assert rows[0]["height"] == 1 and rows[-1]["height"] == app.height
+        assert all("data_hash" in r and "block_bytes" in r for r in rows)
+        # resume from an index
+        out2 = json.loads(_url.urlopen(
+            f"http://127.0.0.1:{svc.port}/trace/block_summary?since={rows[-1]['_index']}"
+        ).read())
+        assert [r["height"] for r in out2["rows"]] == [app.height]
+    finally:
+        svc.shutdown()
