@@ -54,6 +54,17 @@ def bits_to_bytes(b: jax.Array) -> jax.Array:
 
 def _gf_mix(bit_mat: jax.Array, x_bits: jax.Array) -> jax.Array:
     """(8k,8k) x (..., 8k, S) -> (..., 8k, S), all arithmetic mod 2 via int matmul."""
+    if bit_mat.dtype == jnp.bfloat16:
+        # 0/1 products accumulate exactly in f32 up to 2^24 terms (max dot
+        # length here is 16k ≤ 8192), so the mod-2 result is exact while the
+        # matmul runs at the MXU's bf16 rate
+        out = jnp.einsum(
+            "pq,...qs->...ps",
+            bit_mat,
+            x_bits.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        return (out.astype(jnp.int32) & 1).astype(jnp.int8)
     out = jnp.einsum(
         "pq,...qs->...ps", bit_mat, x_bits, preferred_element_type=jnp.int32
     )
@@ -106,8 +117,15 @@ def _gf_mix_flat(bit_mat: jax.Array, x_bits: jax.Array) -> jax.Array:
     flat = x_bits.reshape(-1, q, s)
     b = flat.shape[0]
     x = jnp.transpose(flat, (1, 0, 2)).reshape(q, b * s)
-    out = jnp.matmul(bit_mat, x, preferred_element_type=jnp.int32)
-    out = (out & 1).astype(jnp.int8)
+    if bit_mat.dtype == jnp.bfloat16:
+        out = jnp.matmul(
+            bit_mat, x.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        out = (out.astype(jnp.int32) & 1).astype(jnp.int8)
+    else:
+        out = jnp.matmul(bit_mat, x, preferred_element_type=jnp.int32)
+        out = (out & 1).astype(jnp.int8)
     return jnp.transpose(out.reshape(q, b, s), (1, 0, 2)).reshape(*lead, q, s)
 
 
@@ -117,15 +135,23 @@ def _rs_layout() -> str:
     return os.environ.get("CELESTIA_RS_LAYOUT", "batched")
 
 
-def extend_square_fn(k: int, layout: str | None = None):
+def _rs_dtype() -> str:
+    import os
+
+    return os.environ.get("CELESTIA_RS_DTYPE", "int8")
+
+
+def extend_square_fn(k: int, layout: str | None = None, dtype: str | None = None):
     """Return a jittable fn: (k, k, 512) uint8 ODS -> (2k, 2k, 512) uint8 EDS.
 
     k <= 128 uses the GF(2^8) code; k >= 256 the GF(2^16) code (leopard16),
-    both as one bit-matrix MXU matmul per pass. `layout` (or env
-    CELESTIA_RS_LAYOUT) picks the matmul shape: "batched" einsum (default)
-    or "flat" single-GEMM — bit-identical outputs, different schedules."""
+    both as one bit-matrix MXU matmul per pass. `layout`/`dtype` (or envs
+    CELESTIA_RS_LAYOUT / CELESTIA_RS_DTYPE) pick the matmul schedule:
+    "batched" einsum vs "flat" single-GEMM, int8 accumulate-int32 vs bf16
+    accumulate-f32 — all four bit-identical, different hardware paths."""
     mat, to_bits, from_bits = _codec(k)
-    bit_mat = jnp.asarray(mat)  # constant folded into the jaxpr
+    mm_dtype = jnp.bfloat16 if (dtype or _rs_dtype()) == "bf16" else jnp.int8
+    bit_mat = jnp.asarray(mat, dtype=mm_dtype)  # constant folded into the jaxpr
     mix = _gf_mix_flat if (layout or _rs_layout()) == "flat" else _gf_mix
 
     def extend(ods: jax.Array) -> jax.Array:

@@ -71,6 +71,10 @@ def test_flat_gemm_layout_bit_identical():
     rng = np.random.default_rng(11)
     for k in (4, 8):
         ods = rng.integers(0, 256, size=(k, k, 512), dtype=np.uint8)
-        batched = np.asarray(jax.jit(rs_mod.extend_square_fn(k, layout="batched"))(ods))
-        flat = np.asarray(jax.jit(rs_mod.extend_square_fn(k, layout="flat"))(ods))
-        np.testing.assert_array_equal(batched, flat)
+        ref = np.asarray(jax.jit(rs_mod.extend_square_fn(k, layout="batched", dtype="int8"))(ods))
+        for layout in ("batched", "flat"):
+            for dtype in ("int8", "bf16"):
+                out = np.asarray(
+                    jax.jit(rs_mod.extend_square_fn(k, layout=layout, dtype=dtype))(ods)
+                )
+                np.testing.assert_array_equal(ref, out, err_msg=f"{layout}/{dtype}")
