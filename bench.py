@@ -163,22 +163,38 @@ def measure_device(reps: int = 10) -> tuple[float, str]:
         eds_mod.jitted_pipeline.cache_clear()
 
 
+def _probe_rs_schedules(ods, reps: int) -> dict[str, float]:
+    """Time every (layout × dtype) RS schedule; shared by --stages and the
+    child's calibration so the grid cannot drift between them."""
+    import jax
+
+    from celestia_app_tpu.ops import rs
+
+    probes = {}
+    for layout in ("batched", "flat"):
+        for dtype in ("int8", "bf16"):
+            try:
+                fn = jax.jit(rs.extend_square_fn(K, layout=layout, dtype=dtype))
+                probes[f"{layout}/{dtype}"] = _time_fn(fn, ods, reps)
+            except Exception as e:
+                print(f"rs probe {layout}/{dtype} failed: {e}", file=sys.stderr)
+    return probes
+
+
 def measure_stages(reps: int = 10) -> None:
-    """Report per-stage device timings to stderr (--stages), including both
-    RS matmul layouts (batched einsum vs one flat GEMM) so the faster
-    schedule on the actual hardware is visible."""
+    """Report per-stage device timings to stderr (--stages), including the
+    full RS schedule grid so the faster schedule on the actual hardware is
+    visible."""
     import jax
 
     from celestia_app_tpu.da import eds as eds_mod
     from celestia_app_tpu.ops import rs
 
     ods = jax.device_put(_bench_ods(K))
-    probes = {}
-    for layout in ("batched", "flat"):
-        for dtype in ("int8", "bf16"):
-            fn = jax.jit(rs.extend_square_fn(K, layout=layout, dtype=dtype))
-            probes[f"{layout}/{dtype}"] = _time_fn(fn, ods, reps)
-    extend_ms = probes["batched/int8"]
+    probes = _probe_rs_schedules(ods, reps)
+    # attribute against the schedule the PIPELINE actually uses (env-driven)
+    active = f"{rs._rs_layout()}/{rs._rs_dtype()}"
+    extend_ms = probes.get(active, next(iter(probes.values())))
     try:
         full_ms = _time_fn(eds_mod.jitted_pipeline(K), ods, reps)
     except Exception as e:
@@ -192,7 +208,7 @@ def measure_stages(reps: int = 10) -> None:
     # subtraction is the honest attribution available without a profiler).
     probe_str = ", ".join(f"extend({k})={v:.2f} ms" for k, v in probes.items())
     print(
-        f"stages: {probe_str}, full={full_ms:.2f} ms, "
+        f"stages: {probe_str}, full[{active}]={full_ms:.2f} ms, "
         f"nmt+root≈{full_ms - extend_ms:.2f} ms",
         file=sys.stderr,
     )
@@ -240,29 +256,17 @@ def _calibrate_rs_schedule() -> str:
     hardware the measurement runs on."""
     import jax
 
-    from celestia_app_tpu.ops import rs
-
     ods = jax.device_put(_bench_ods(K))
-    best = None
-    for layout in ("batched", "flat"):
-        for dtype in ("int8", "bf16"):
-            try:
-                ms = _time_fn(
-                    jax.jit(rs.extend_square_fn(K, layout=layout, dtype=dtype)),
-                    ods, reps=3,
-                )
-            except Exception as e:
-                print(f"rs probe {layout}/{dtype} failed: {e}", file=sys.stderr)
-                continue
-            print(f"rs probe {layout}/{dtype}: {ms:.1f} ms", file=sys.stderr)
-            if best is None or ms < best[0]:
-                best = (ms, layout, dtype)
-    if best is None:
+    probes = _probe_rs_schedules(ods, reps=3)
+    for name, ms in probes.items():
+        print(f"rs probe {name}: {ms:.1f} ms", file=sys.stderr)
+    if not probes:
         return "batched/int8"
-    _ms, layout, dtype = best
+    best = min(probes, key=probes.get)
+    layout, dtype = best.split("/")
     os.environ["CELESTIA_RS_LAYOUT"] = layout
     os.environ["CELESTIA_RS_DTYPE"] = dtype
-    return f"{layout}/{dtype}"
+    return best
 
 
 def _run_child() -> None:

@@ -34,6 +34,7 @@ import json
 from celestia_app_tpu import appconsts
 from celestia_app_tpu.chain.state import (
     Context,
+    canonical_json,
     get_json,
     put_json,
     verify_membership,
@@ -128,13 +129,128 @@ class ChannelKeeper:
     ) -> None:
         """Register an OPEN channel (handshake result; fixtures call this
         directly, like the reference's testing pkg channels). A channel
-        bound to `client_id` REQUIRES commitment proofs on receive."""
+        bound to `client_id` REQUIRES commitment proofs on receive.
+        Proof-verified handshakes live in `channel_open_init/try/ack/
+        confirm` below — this is the fixture shortcut."""
         _put(ctx, self.CHAN + f"{port}/{channel}".encode(), {
             "state": "OPEN",
             "counterparty_port": counterparty_port,
             "counterparty_channel": counterparty_channel,
             "client_id": client_id,
         })
+
+    # -- the ICS-4 channel handshake (ibc-go 04-channel keeper) ----------
+    #
+    # Four proof-verified steps between chains A and B:
+    #   A: ChanOpenInit     -> channel in state INIT
+    #   B: ChanOpenTry      -> proves A's INIT record, state TRYOPEN
+    #   A: ChanOpenAck      -> proves B's TRYOPEN record, state OPEN
+    #   B: ChanOpenConfirm  -> proves A's OPEN record, state OPEN
+    # Each proof is a membership proof of the counterparty's channel
+    # record under a client-tracked state root — the same light-client
+    # primitive packet receive uses, so an OPEN channel is one whose
+    # whole lifecycle was proven, not asserted.
+
+    def _chan_key(self, port: str, channel: str) -> bytes:
+        return self.CHAN + f"{port}/{channel}".encode()
+
+    def _verify_counterparty_channel(
+        self, ctx: Context, clients: "ClientKeeper", client_id: str,
+        counterparty_port: str, counterparty_channel: str,
+        expected_states: tuple[str, ...],
+        expect_port: str, expect_channel: str,
+        proof: dict, proof_height: int, counterparty_record: dict,
+    ) -> None:
+        """The proof boundary shared by TRY/ACK/CONFIRM: the submitted
+        counterparty channel RECORD must be committed under a tracked root,
+        be in one of `expected_states`, and name US as ITS counterparty."""
+        root = clients.consensus_root(ctx, client_id, proof_height)
+        if root is None:
+            raise IBCError(
+                f"no consensus state for {client_id!r} at height {proof_height}"
+            )
+        # the exact bytes put_json commits on the counterparty, so the
+        # membership proof binds the full record (single shared encoder)
+        value = canonical_json(counterparty_record)
+        key = self._chan_key(counterparty_port, counterparty_channel)
+        if not verify_membership(root, key, value, proof):
+            raise IBCError("counterparty channel proof verification failed")
+        if counterparty_record.get("state") not in expected_states:
+            raise IBCError(
+                f"counterparty channel in state "
+                f"{counterparty_record.get('state')!r}, need {expected_states}"
+            )
+        if (
+            counterparty_record.get("counterparty_port") != expect_port
+            or counterparty_record.get("counterparty_channel") != expect_channel
+        ):
+            raise IBCError("counterparty channel does not name this channel")
+
+    def channel_open_init(
+        self, ctx: Context, port: str, channel: str,
+        counterparty_port: str, counterparty_channel: str, client_id: str,
+    ) -> None:
+        if self.channel(ctx, port, channel) is not None:
+            raise IBCError(f"channel {port}/{channel} already exists")
+        _put(ctx, self._chan_key(port, channel), {
+            "state": "INIT",
+            "counterparty_port": counterparty_port,
+            "counterparty_channel": counterparty_channel,
+            "client_id": client_id,
+        })
+
+    def channel_open_try(
+        self, ctx: Context, clients: "ClientKeeper",
+        port: str, channel: str,
+        counterparty_port: str, counterparty_channel: str, client_id: str,
+        counterparty_record: dict, proof: dict, proof_height: int,
+    ) -> None:
+        if self.channel(ctx, port, channel) is not None:
+            raise IBCError(f"channel {port}/{channel} already exists")
+        self._verify_counterparty_channel(
+            ctx, clients, client_id, counterparty_port, counterparty_channel,
+            ("INIT",), port, channel, proof, proof_height, counterparty_record,
+        )
+        _put(ctx, self._chan_key(port, channel), {
+            "state": "TRYOPEN",
+            "counterparty_port": counterparty_port,
+            "counterparty_channel": counterparty_channel,
+            "client_id": client_id,
+        })
+
+    def channel_open_ack(
+        self, ctx: Context, clients: "ClientKeeper",
+        port: str, channel: str,
+        counterparty_record: dict, proof: dict, proof_height: int,
+    ) -> None:
+        chan = self.channel(ctx, port, channel)
+        if chan is None or chan["state"] != "INIT":
+            raise IBCError(f"channel {port}/{channel} not in INIT")
+        self._verify_counterparty_channel(
+            ctx, clients, chan["client_id"],
+            chan["counterparty_port"], chan["counterparty_channel"],
+            ("TRYOPEN",), port, channel, proof, proof_height,
+            counterparty_record,
+        )
+        chan["state"] = "OPEN"
+        _put(ctx, self._chan_key(port, channel), chan)
+
+    def channel_open_confirm(
+        self, ctx: Context, clients: "ClientKeeper",
+        port: str, channel: str,
+        counterparty_record: dict, proof: dict, proof_height: int,
+    ) -> None:
+        chan = self.channel(ctx, port, channel)
+        if chan is None or chan["state"] != "TRYOPEN":
+            raise IBCError(f"channel {port}/{channel} not in TRYOPEN")
+        self._verify_counterparty_channel(
+            ctx, clients, chan["client_id"],
+            chan["counterparty_port"], chan["counterparty_channel"],
+            ("OPEN",), port, channel, proof, proof_height,
+            counterparty_record,
+        )
+        chan["state"] = "OPEN"
+        _put(ctx, self._chan_key(port, channel), chan)
 
     def channel(self, ctx: Context, port: str, channel: str):
         return _get(ctx, self.CHAN + f"{port}/{channel}".encode())

@@ -44,14 +44,20 @@ def _default_level_hashes() -> list[bytes]:
 _DEFAULTS = _default_level_hashes()
 
 
-def put_json(ctx_or_none, key: bytes, obj, *, store=None) -> None:
-    """Canonical-JSON store write (sorted keys, no whitespace). EVERY module
-    must encode through here: the byte encoding feeds the app hash, so a
-    divergent copy would silently fork consensus state."""
+def canonical_json(obj) -> bytes:
+    """THE canonical encoding (sorted keys, no whitespace): the bytes that
+    feed the app hash AND that cross-chain proofs verify against. Any
+    consumer re-deriving these bytes must call this, never json.dumps
+    directly — a divergent copy silently forks consensus state."""
     import json
 
+    return json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+
+
+def put_json(ctx_or_none, key: bytes, obj, *, store=None) -> None:
+    """Canonical-JSON store write. EVERY module must encode through here."""
     target = store if store is not None else ctx_or_none.store
-    target.set(key, json.dumps(obj, sort_keys=True, separators=(",", ":")).encode())
+    target.set(key, canonical_json(obj))
 
 
 def get_json(ctx_or_none, key: bytes, *, store=None):

@@ -55,9 +55,10 @@ def bits_to_bytes(b: jax.Array) -> jax.Array:
 def _gf_mix(bit_mat: jax.Array, x_bits: jax.Array) -> jax.Array:
     """(8k,8k) x (..., 8k, S) -> (..., 8k, S), all arithmetic mod 2 via int matmul."""
     if bit_mat.dtype == jnp.bfloat16:
-        # 0/1 products accumulate exactly in f32 up to 2^24 terms (max dot
-        # length here is 16k ≤ 8192), so the mod-2 result is exact while the
-        # matmul runs at the MXU's bf16 rate
+        # 0/1 products accumulate exactly in f32 up to 2^24 terms; the dot
+        # length is 8k (gf8, ≤1024) or 16k (gf16, ≤524288 at the field's
+        # max k=32768) — far below 2^24 — so the mod-2 result is exact
+        # while the matmul runs at the MXU's bf16 rate
         out = jnp.einsum(
             "pq,...qs->...ps",
             bit_mat,
@@ -150,9 +151,15 @@ def extend_square_fn(k: int, layout: str | None = None, dtype: str | None = None
     "batched" einsum vs "flat" single-GEMM, int8 accumulate-int32 vs bf16
     accumulate-f32 — all four bit-identical, different hardware paths."""
     mat, to_bits, from_bits = _codec(k)
-    mm_dtype = jnp.bfloat16 if (dtype or _rs_dtype()) == "bf16" else jnp.int8
+    dtype = dtype or _rs_dtype()
+    layout = layout or _rs_layout()
+    if dtype not in ("int8", "bf16"):
+        raise ValueError(f"RS dtype must be 'int8' or 'bf16', not {dtype!r}")
+    if layout not in ("batched", "flat"):
+        raise ValueError(f"RS layout must be 'batched' or 'flat', not {layout!r}")
+    mm_dtype = jnp.bfloat16 if dtype == "bf16" else jnp.int8
     bit_mat = jnp.asarray(mat, dtype=mm_dtype)  # constant folded into the jaxpr
-    mix = _gf_mix_flat if (layout or _rs_layout()) == "flat" else _gf_mix
+    mix = _gf_mix_flat if layout == "flat" else _gf_mix
 
     def extend(ods: jax.Array) -> jax.Array:
         assert ods.shape == (k, k, SHARE), ods.shape

@@ -342,3 +342,131 @@ def test_recreating_a_client_is_rejected():
         app.ibc.clients.create_client(ctx, "c1")
     # the recorded root is intact
     assert app.ibc.clients.consensus_root(ctx, "c1", 5) == b"\x01" * 32
+
+
+def _chan_record(app, port, channel):
+    ctx = _ctx(app)
+    return app.ibc.channels.channel(ctx, port, channel)
+
+
+def test_full_channel_handshake_between_two_chains():
+    """ICS-4: INIT -> TRY -> ACK -> CONFIRM, every step proving the
+    counterparty's channel record under a client-tracked root — an OPEN
+    channel whose whole lifecycle was proven, not asserted."""
+    chain_a, signer_a, privs_a = make_app()
+    chain_b, signer_b, privs_b = make_app()
+    ctx_a, ctx_b = _ctx(chain_a), _ctx(chain_b)
+    chain_a.ibc.clients.create_client(ctx_a, "client-b")
+    chain_b.ibc.clients.create_client(ctx_b, "client-a")
+
+    # each step updates ONLY the receiving side's client (a relayer
+    # submits the counterparty's header right before the handshake msg);
+    # the proof is generated against exactly that recorded root
+    key_a = ibc.ChannelKeeper.CHAN + b"transfer/channel-0"
+    key_b = ibc.ChannelKeeper.CHAN + b"transfer/channel-1"
+
+    # 1. A: INIT
+    chain_a.ibc.channels.channel_open_init(
+        _ctx(chain_a), "transfer", "channel-0", "transfer", "channel-1",
+        "client-b",
+    )
+    chain_b.ibc.clients.update_client(
+        _ctx(chain_b), "client-a", 1, chain_a.store.app_hash())
+    # 2. B: TRY with proof of A's INIT record
+    chain_b.ibc.channels.channel_open_try(
+        _ctx(chain_b), chain_b.ibc.clients,
+        "transfer", "channel-1", "transfer", "channel-0", "client-a",
+        _chan_record(chain_a, "transfer", "channel-0"),
+        chain_a.store.prove(key_a), 1,
+    )
+    chain_a.ibc.clients.update_client(
+        _ctx(chain_a), "client-b", 2, chain_b.store.app_hash())
+    # 3. A: ACK with proof of B's TRYOPEN record
+    chain_a.ibc.channels.channel_open_ack(
+        _ctx(chain_a), chain_a.ibc.clients, "transfer", "channel-0",
+        _chan_record(chain_b, "transfer", "channel-1"),
+        chain_b.store.prove(key_b), 2,
+    )
+    chain_b.ibc.clients.update_client(
+        _ctx(chain_b), "client-a", 3, chain_a.store.app_hash())
+    # 4. B: CONFIRM with proof of A's OPEN record
+    chain_b.ibc.channels.channel_open_confirm(
+        _ctx(chain_b), chain_b.ibc.clients, "transfer", "channel-1",
+        _chan_record(chain_a, "transfer", "channel-0"),
+        chain_a.store.prove(key_a), 3,
+    )
+    assert _chan_record(chain_a, "transfer", "channel-0")["state"] == "OPEN"
+    assert _chan_record(chain_b, "transfer", "channel-1")["state"] == "OPEN"
+
+    # the handshaken channel carries a real proof-verified transfer
+    sender = privs_a[0].public_key().address()
+    receiver = privs_b[1].public_key().address()
+    packet = chain_a.ibc.transfer.send_transfer(
+        _ctx(chain_a), "channel-0", sender, receiver.hex(), "utia", 5_500
+    )
+    packet["data"]["denom"] = "transfer/channel-0/utia"
+    chain_a.ibc.channels.commit_packet(_ctx(chain_a), packet)
+    chain_b.ibc.clients.update_client(
+        _ctx(chain_b), "client-a", 4, chain_a.store.app_hash())
+    proof = chain_a.store.prove(_commit_key(packet))
+    chain_b.bank.mint(_ctx(chain_b), ibc.escrow_address("transfer", "channel-1"), 5_500)
+    bal0 = chain_b.bank.balance(_ctx(chain_b), receiver)
+    ack = chain_b.relay_recv_packet(packet, proof=proof, proof_height=4)
+    assert "error" not in ack, ack
+    assert chain_b.bank.balance(_ctx(chain_b), receiver) == bal0 + 5_500
+
+
+def test_handshake_rejects_forged_steps():
+    chain_a, signer_a, privs_a = make_app()
+    chain_b, signer_b, privs_b = make_app()
+    ctx_a, ctx_b = _ctx(chain_a), _ctx(chain_b)
+    chain_a.ibc.clients.create_client(ctx_a, "client-b")
+    chain_b.ibc.clients.create_client(ctx_b, "client-a")
+    chain_a.ibc.channels.channel_open_init(
+        ctx_a, "transfer", "channel-0", "transfer", "channel-1", "client-b",
+    )
+    chain_b.ibc.clients.update_client(
+        _ctx(chain_b), "client-a", 1, chain_a.store.app_hash())
+    key_a = ibc.ChannelKeeper.CHAN + b"transfer/channel-0"
+    record = _chan_record(chain_a, "transfer", "channel-0")
+    proof = chain_a.store.prove(key_a)
+
+    # TRY with a record A never committed (state forged to OPEN)
+    forged = dict(record, state="OPEN")
+    with pytest.raises(ibc.IBCError, match="proof verification failed"):
+        chain_b.ibc.channels.channel_open_try(
+            _ctx(chain_b), chain_b.ibc.clients,
+            "transfer", "channel-1", "transfer", "channel-0", "client-a",
+            forged, proof, 1,
+        )
+    # TRY claiming a channel whose counterparty is someone else
+    with pytest.raises(ibc.IBCError, match="does not name"):
+        chain_b.ibc.channels.channel_open_try(
+            _ctx(chain_b), chain_b.ibc.clients,
+            "transfer", "channel-9", "transfer", "channel-0", "client-a",
+            record, proof, 1,
+        )
+    # ACK before TRY (still INIT on B's side — nothing to ack)
+    with pytest.raises(ibc.IBCError, match="not in TRYOPEN"):
+        chain_b.ibc.channels.channel_open_confirm(
+            _ctx(chain_b), chain_b.ibc.clients, "transfer", "channel-1",
+            record, proof, 1,
+        )
+
+
+def test_channel_open_ack_requires_init_state():
+    """The ACK guard itself: acking a channel that never INITed (or that
+    is already OPEN) must fail regardless of proof quality."""
+    app, _, _ = make_app()
+    ctx = _ctx(app)
+    app.ibc.clients.create_client(ctx, "c")
+    with pytest.raises(ibc.IBCError, match="not in INIT"):
+        app.ibc.channels.channel_open_ack(
+            ctx, app.ibc.clients, "transfer", "channel-0", {}, {}, 1,
+        )
+    # an OPEN (fixture) channel cannot be re-acked either
+    app.ibc.channels.open_channel(ctx, "transfer", "channel-0", "transfer", "channel-1")
+    with pytest.raises(ibc.IBCError, match="not in INIT"):
+        app.ibc.channels.channel_open_ack(
+            ctx, app.ibc.clients, "transfer", "channel-0", {}, {}, 1,
+        )
