@@ -129,13 +129,32 @@ class ValidatorNode:
     def _wal_path(self, height: int) -> str:
         return os.path.join(self.wal_dir, f"{height:020d}.json")
 
-    def write_wal(self, block: Block, cert: CommitCertificate) -> None:
-        """Append-before-apply: the crash-recovery record."""
+    def write_wal(
+        self, block: Block, cert: CommitCertificate,
+        evidence: tuple["DuplicateVoteEvidence", ...] = (),
+    ) -> None:
+        """Append-before-apply: the crash-recovery record. Evidence applied
+        with the block is PART of the record — replay must re-apply it or
+        the replayed app hash diverges from live peers."""
         if self.wal_dir is None:
             return
         import base64
 
         doc = {
+            "evidence": [
+                {
+                    "height": ev.height,
+                    "votes": [
+                        {
+                            "block_hash": v.block_hash.hex(),
+                            "validator": v.validator.hex(),
+                            "signature": v.signature.hex(),
+                        }
+                        for v in (ev.vote_a, ev.vote_b)
+                    ],
+                }
+                for ev in evidence
+            ],
             "height": block.header.height,
             "header": {
                 "chain_id": block.header.chain_id,
@@ -166,9 +185,27 @@ class ValidatorNode:
             os.fsync(f.fileno())
         os.replace(tmp, self._wal_path(block.header.height))
 
-    def apply(self, block: Block, cert: CommitCertificate) -> bytes:
-        """Finalize + commit a certified block; returns the app hash."""
-        self.write_wal(block, cert)
+    def _apply_evidence(
+        self, evidence: tuple["DuplicateVoteEvidence", ...]
+    ) -> None:
+        for ev in evidence:
+            ctx = Context(
+                self.app.store, InfiniteGasMeter(), self.app.height, 0,
+                self.app.chain_id, self.app.app_version,
+            )
+            self.app.slashing.handle_equivocation(
+                ctx, ev.vote_a.validator, infraction_height=ev.height
+            )
+
+    def apply(
+        self, block: Block, cert: CommitCertificate,
+        evidence: tuple["DuplicateVoteEvidence", ...] = (),
+    ) -> bytes:
+        """Finalize + commit a certified block (evidence first — the
+        x/evidence BeginBlock position); returns the app hash. Evidence is
+        in the WAL record, so crash replay re-applies it identically."""
+        self.write_wal(block, cert, evidence)
+        self._apply_evidence(evidence)
         self.app.finalize_block(block)
         app_hash = self.app.commit(block)
         self.certificates[block.header.height] = cert
@@ -217,6 +254,22 @@ class ValidatorNode:
                 for v in doc["votes"]
             )
             cert = CommitCertificate(height, block.header.hash(), votes)
+            evidence = tuple(
+                DuplicateVoteEvidence(
+                    e["height"],
+                    *[
+                        Vote(
+                            e["height"],
+                            bytes.fromhex(v["block_hash"]),
+                            bytes.fromhex(v["validator"]),
+                            bytes.fromhex(v["signature"]),
+                        )
+                        for v in e["votes"]
+                    ],
+                )
+                for e in doc.get("evidence", [])
+            )
+            self._apply_evidence(evidence)
             self.app.finalize_block(block)
             self.app.commit(block)
             self.certificates[height] = cert
@@ -281,10 +334,67 @@ def state_sync_bootstrap(
     node.app._check_state = None
 
 
+@dataclasses.dataclass(frozen=True)
+class DuplicateVoteEvidence:
+    """Two signed votes by the same validator for DIFFERENT blocks at one
+    height — the Tendermint double-sign evidence type. Verifiable offline
+    (both signatures check out against the validator's key), and submitted
+    to x/evidence → tombstone + slash (sdk_modules.handle_equivocation)."""
+
+    height: int
+    vote_a: Vote
+    vote_b: Vote
+
+    def verify(self, chain_id: str, pubkey: bytes) -> bool:
+        a, b = self.vote_a, self.vote_b
+        if a.validator != b.validator:
+            return False
+        if a.height != self.height or b.height != self.height:
+            return False  # both votes must be AT the evidence height
+        if a.block_hash == b.block_hash:
+            return False  # same block: not equivocation
+        pub = PublicKey(pubkey)
+        if pub.address() != a.validator:
+            return False
+        return pub.verify(
+            a.signature, Vote.sign_bytes(chain_id, a.height, a.block_hash)
+        ) and pub.verify(
+            b.signature, Vote.sign_bytes(chain_id, b.height, b.block_hash)
+        )
+
+
+def detect_equivocation(
+    chain_id: str, votes_by_round: list[list[Vote]],
+    validators: dict[bytes, bytes],
+) -> list[DuplicateVoteEvidence]:
+    """Scan one height's votes (across rounds) for validators that signed
+    two different block hashes; returns verified evidence only."""
+    seen: dict[tuple[bytes, int], Vote] = {}  # (validator, height) -> vote
+    out: list[DuplicateVoteEvidence] = []
+    accused: set[bytes] = set()
+    for votes in votes_by_round:
+        for v in votes:
+            if v.block_hash is None or v.validator in accused:
+                continue
+            key = (v.validator, v.height)
+            prior = seen.get(key)
+            if prior is None:
+                seen[key] = v
+            elif prior.block_hash != v.block_hash:
+                ev = DuplicateVoteEvidence(v.height, prior, v)
+                pub = validators.get(v.validator)
+                if pub is not None and ev.verify(chain_id, pub):
+                    out.append(ev)
+                    accused.add(v.validator)
+    return out
+
+
 class LocalNetwork:
     """N validators + an in-process gossip bus (tx fan-out, proposal/vote
     exchange). Proposer rotation is deterministic round-robin over the
-    address-sorted validator set."""
+    address-sorted validator set. Votes from failed rounds are retained
+    per height and scanned for equivocation; verified double-sign evidence
+    is submitted to every node's x/evidence handler (tombstone + slash)."""
 
     def __init__(self, nodes: list[ValidatorNode]):
         if not nodes:
@@ -292,6 +402,12 @@ class LocalNetwork:
         self.nodes = sorted(nodes, key=lambda n: n.address)
         self.chain_id = nodes[0].app.chain_id
         self._round = 0  # advances on failed rounds so the proposer rotates
+        # signature-verified votes retained for the evidence window, so a
+        # conflicting vote surfacing a few heights late still pairs up
+        # (Tendermint's evidence max-age analog)
+        self._vote_pool: list[Vote] = []
+
+    EVIDENCE_MAX_AGE = 10  # heights a vote stays eligible for evidence
 
     def _powers(self, app: App) -> dict[bytes, int]:
         ctx = Context(app.store, InfiniteGasMeter(), app.height, 0,
@@ -316,6 +432,8 @@ class LocalNetwork:
         proposer = self.proposer_for(height, self._round)
         block = proposer.propose(t)
         votes = tuple(n.vote_on(block) for n in self.nodes)
+        self._vote_pool.extend(v for v in votes if v.block_hash is not None)
+        self._prune_vote_pool(height)
         bh = block.header.hash()
         powers = self._powers(self.nodes[0].app)
         total = sum(powers.values())
@@ -327,9 +445,39 @@ class LocalNetwork:
             self._round += 1
             return None, None
         self._round = 0
-        hashes = {n.apply(block, cert) for n in self.nodes}
+        # evidence rides the committed block (the x/evidence position):
+        # deterministic across nodes and recorded in every WAL entry
+        evidence = tuple(
+            detect_equivocation(self.chain_id, [self._vote_pool], validators)
+        )
+        if evidence:
+            punished = {ev.vote_a.validator for ev in evidence}
+            self._vote_pool = [
+                v for v in self._vote_pool if v.validator not in punished
+            ]
+        hashes = {n.apply(block, cert, evidence) for n in self.nodes}
         if len(hashes) != 1:
             raise AssertionError(
                 f"state divergence after height {height}: {sorted(h.hex() for h in hashes)}"
             )
         return block, cert
+
+    def _prune_vote_pool(self, current_height: int) -> None:
+        floor = current_height - self.EVIDENCE_MAX_AGE
+        self._vote_pool = [v for v in self._vote_pool if v.height > floor]
+
+    def inject_vote(self, vote: Vote) -> None:
+        """Gossip entry for an externally-received vote. The signature is
+        verified AT THE DOOR against the known validator set — a forged
+        vote must never enter the pool, where it could poison the
+        first-seen slot and mask real equivocation."""
+        by_addr = {n.address: n.priv.public_key().compressed for n in self.nodes}
+        pub = by_addr.get(vote.validator)
+        if pub is None or vote.block_hash is None:
+            raise ValueError("vote from unknown validator or nil vote")
+        if not PublicKey(pub).verify(
+            vote.signature,
+            Vote.sign_bytes(self.chain_id, vote.height, vote.block_hash),
+        ):
+            raise ValueError("vote signature verification failed")
+        self._vote_pool.append(vote)

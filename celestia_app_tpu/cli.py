@@ -164,6 +164,60 @@ def cmd_query(args) -> int:
     return 0
 
 
+def cmd_tx(args) -> int:
+    """tx send / tx pay-for-blob against the local home: sign (protobuf
+    wire), run through the node (CheckTx + one block), print the result —
+    the x/blob CLI `tx blob pay-for-blob` analog (client/cli/payforblob.go)."""
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+    from celestia_app_tpu.client.tx_client import Signer, TxClient
+    from celestia_app_tpu.da.blob import Blob
+    from celestia_app_tpu.da.namespace import Namespace
+
+    if args.action == "send" and (args.to is None or args.amount is None):
+        print("tx send requires --to and --amount", file=sys.stderr)
+        return 2
+    if args.action == "pay-for-blob" and (
+        args.namespace is None or args.data is None
+    ):
+        print("tx pay-for-blob requires --namespace and --data", file=sys.stderr)
+        return 2
+
+    app, _cfg = _make_app(args.home)
+    node = Node(app)
+    priv = PrivateKey.from_seed(args.from_seed.encode())
+    addr = priv.public_key().address()
+    ctx = Context(app.store, InfiniteGasMeter(), app.height, 0,
+                  app.chain_id, app.app_version)
+    acc = app.auth.account(ctx, addr)
+    signer = Signer(app.chain_id)
+    signer.add_account(priv, acc["number"] if acc else 0,
+                       acc["sequence"] if acc else 0)
+    client = TxClient(node, signer)
+    if args.action == "send":
+        height, res = client.submit_send(
+            addr, bytes.fromhex(args.to), int(args.amount)
+        )
+    else:  # pay-for-blob
+        ns = Namespace.v0(bytes.fromhex(args.namespace))
+        if args.data.startswith("@"):
+            with open(args.data[1:], "rb") as f:
+                payload = f.read()
+        else:
+            payload = bytes.fromhex(args.data)
+        height, res = client.submit_pay_for_blob(addr, [Blob(ns, payload)])
+    # commits already hit disk inside produce_block (durable save_commit)
+    print(json.dumps({
+        "height": height,
+        "code": res.code,
+        "log": res.log,
+        "gas_wanted": res.gas_wanted,
+        "gas_used": res.gas_used,
+    }, indent=2))
+    return 0 if res.code == 0 else 1
+
+
 def cmd_keys(args) -> int:
     from celestia_app_tpu.chain.crypto import PrivateKey
 
@@ -273,6 +327,17 @@ def main(argv=None) -> int:
     p.add_argument("path")
     p.add_argument("data", nargs="?")
     p.set_defaults(fn=cmd_query)
+
+    p = sub.add_parser("tx")
+    p.add_argument("action", choices=["send", "pay-for-blob"])
+    p.add_argument("--home", required=True)
+    p.add_argument("--from-seed", required=True,
+                   help="key seed (matches `keys derive`)")
+    p.add_argument("--to", help="recipient address hex (send)")
+    p.add_argument("--amount", help="utia amount (send)")
+    p.add_argument("--namespace", help="10-hex-char v0 namespace id (pfb)")
+    p.add_argument("--data", help="blob hex, or @file for raw bytes (pfb)")
+    p.set_defaults(fn=cmd_tx)
 
     p = sub.add_parser("keys")
     p.add_argument("action", choices=["derive"])

@@ -362,3 +362,37 @@ def test_trace_tables_block_summary(tmp_path):
         assert [r["height"] for r in out2["rows"]] == [app.height]
     finally:
         svc.shutdown()
+
+
+def test_cli_tx_send_and_pfb(tmp_path):
+    """`tx send` / `tx pay-for-blob`: the x/blob CLI analog, end to end
+    against a durable home (resumes, signs protobuf, commits a block)."""
+    from celestia_app_tpu import cli
+
+    home = str(tmp_path / "txhome")
+    assert cli.main(["init", "--home", home]) == 0
+    import io
+    from contextlib import redirect_stdout
+
+    from celestia_app_tpu.chain.crypto import PrivateKey
+
+    to_addr = PrivateKey.from_seed(b"1").public_key().address().hex()
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main([
+            "tx", "send", "--home", home, "--from-seed", "0",
+            "--to", to_addr, "--amount", "555",
+        ])
+    assert rc == 0
+    out = json.loads(buf.getvalue())
+    assert out["code"] == 0 and out["height"] == 1
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main([
+            "tx", "pay-for-blob", "--home", home, "--from-seed", "0",
+            "--namespace", "0a0b0c0d0e", "--data", "00112233445566",
+        ])
+    assert rc == 0
+    out = json.loads(buf.getvalue())
+    assert out["code"] == 0 and out["height"] == 2 and out["gas_used"] > 0
