@@ -185,6 +185,19 @@ class ValidatorNode:
             os.fsync(f.fileno())
         os.replace(tmp, self._wal_path(block.header.height))
 
+    def _mark_absent_from_votes(self, votes) -> None:
+        """LastCommitInfo reconstruction shared by the live commit path and
+        WAL replay: validators without a non-nil precommit are absent."""
+        voted = {v.validator for v in votes if v.block_hash is not None}
+        ctx = Context(
+            self.app.store, InfiniteGasMeter(), self.app.height, 0,
+            self.app.chain_id, self.app.app_version,
+        )
+        self.app.absent_validators = {
+            op for op, _p in self.app.staking.validators(ctx)
+            if op not in voted
+        }
+
     def _apply_evidence(
         self, evidence: tuple["DuplicateVoteEvidence", ...]
     ) -> None:
@@ -203,7 +216,13 @@ class ValidatorNode:
     ) -> bytes:
         """Finalize + commit a certified block (evidence first — the
         x/evidence BeginBlock position); returns the app hash. Evidence is
-        in the WAL record, so crash replay re-applies it identically."""
+        in the WAL record, so crash replay re-applies it identically.
+
+        LastCommitInfo analog: validators whose precommit is absent from
+        the certificate are marked absent, feeding the slashing liveness
+        window in the next BeginBlock — deterministic, since every node
+        applies the same certificate."""
+        self._mark_absent_from_votes(cert.votes)
         self.write_wal(block, cert, evidence)
         self._apply_evidence(evidence)
         self.app.finalize_block(block)
@@ -270,6 +289,9 @@ class ValidatorNode:
                 for e in doc.get("evidence", [])
             )
             self._apply_evidence(evidence)
+            # reconstruct the LastCommitInfo absences from the WAL's cert so
+            # the replayed liveness accounting matches the live run
+            self._mark_absent_from_votes(votes)
             self.app.finalize_block(block)
             self.app.commit(block)
             self.certificates[height] = cert

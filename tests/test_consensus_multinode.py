@@ -278,3 +278,28 @@ def test_double_sign_evidence_tombstones_the_equivocator(tmp_path):
     ev = consensus.DuplicateVoteEvidence(1, honest, conflicting)
     assert ev.verify(CHAIN, byzantine.priv.public_key().compressed)
     assert not ev.verify(CHAIN, net.nodes[0].priv.public_key().compressed)
+
+
+def test_absent_validator_accrues_missed_blocks(tmp_path):
+    """LastCommitInfo analog: a validator whose precommit is missing from
+    the certificate is marked absent, feeding slashing's liveness window
+    on every node — and the network still commits (3 of 4 > 2/3)."""
+    net, signer, privs = _network(tmp_path, n=4, with_disk=False)
+    sleeper = net.nodes[3]
+    real_vote_on = sleeper.vote_on
+    sleeper.vote_on = lambda block: consensus.Vote(
+        block.header.height, None, sleeper.address, b"\x00" * 64
+    )  # nil vote: offline validator
+    blk, cert = net.produce_height(t=1_700_000_010.0)
+    assert blk is not None  # 30 of 40 power > 2/3
+    blk2, _ = net.produce_height(t=1_700_000_020.0)
+
+    from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+
+    for n in net.nodes:
+        ctx = Context(n.app.store, InfiniteGasMeter(), n.app.height, 0,
+                      CHAIN, n.app.app_version)
+        info = n.app.slashing.info(ctx, sleeper.address)
+        assert info["missed"] >= 1  # liveness window sees the absence
+    assert len({n.app.last_app_hash for n in net.nodes}) == 1
+    sleeper.vote_on = real_vote_on
