@@ -185,10 +185,17 @@ class ValidatorNode:
             os.fsync(f.fileno())
         os.replace(tmp, self._wal_path(block.header.height))
 
-    def _mark_absent_from_votes(self, votes) -> None:
+    def _mark_absent_from_votes(self, cert: CommitCertificate) -> None:
         """LastCommitInfo reconstruction shared by the live commit path and
-        WAL replay: validators without a non-nil precommit are absent."""
-        voted = {v.validator for v in votes if v.block_hash is not None}
+        WAL replay: a validator counts as present only with a precommit FOR
+        the committed block at the certificate's height — a vote for a
+        different block / stale height / junk signature is an absence, so
+        misbehaving validators cannot suppress their own liveness window."""
+        voted = {
+            v.validator
+            for v in cert.votes
+            if v.block_hash == cert.block_hash and v.height == cert.height
+        }
         ctx = Context(
             self.app.store, InfiniteGasMeter(), self.app.height, 0,
             self.app.chain_id, self.app.app_version,
@@ -219,12 +226,17 @@ class ValidatorNode:
         in the WAL record, so crash replay re-applies it identically.
 
         LastCommitInfo analog: validators whose precommit is absent from
-        the certificate are marked absent, feeding the slashing liveness
-        window in the next BeginBlock — deterministic, since every node
-        applies the same certificate."""
-        self._mark_absent_from_votes(cert.votes)
+        the certificate are marked absent, consumed by THIS block's
+        BeginBlock liveness accounting (one height earlier than Tendermint
+        wires LastCommitInfo, which carries height H's commit into H+1 —
+        deterministic either way since every node applies the same
+        certificate in the same order)."""
         self.write_wal(block, cert, evidence)
         self._apply_evidence(evidence)
+        # ordering invariant shared with replay_wal: evidence FIRST, then
+        # absences — both paths must compute the absent set against the
+        # same post-evidence validator set or replayed nodes diverge
+        self._mark_absent_from_votes(cert)
         self.app.finalize_block(block)
         app_hash = self.app.commit(block)
         self.certificates[block.header.height] = cert
@@ -290,8 +302,9 @@ class ValidatorNode:
             )
             self._apply_evidence(evidence)
             # reconstruct the LastCommitInfo absences from the WAL's cert so
-            # the replayed liveness accounting matches the live run
-            self._mark_absent_from_votes(votes)
+            # the replayed liveness accounting matches the live run (same
+            # evidence-then-absences order as apply())
+            self._mark_absent_from_votes(cert)
             self.app.finalize_block(block)
             self.app.commit(block)
             self.certificates[height] = cert
