@@ -218,6 +218,94 @@ def cmd_tx(args) -> int:
     return 0 if res.code == 0 else 1
 
 
+def cmd_devnet(args) -> int:
+    """N-validator in-process devnet (the reference's local_devnet
+    docker-compose analog): real consensus (signed precommits, >2/3
+    certificates, WAL, per-node durable state under --home/val<i>), one
+    HTTP service per validator, txsim-style load if requested."""
+    from celestia_app_tpu.chain import consensus
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.client.tx_client import Signer
+    from celestia_app_tpu.service.server import NodeService
+    from celestia_app_tpu.chain.tx import MsgSend
+
+    n = args.validators
+    privs = [PrivateKey.from_seed(f"devnet-{i}".encode()) for i in range(n)]
+    genesis = {
+        "time_unix": time.time(),
+        "accounts": [
+            {"address": p.public_key().address().hex(), "balance": 10**12}
+            for p in privs
+        ],
+        "validators": [
+            {"operator": p.public_key().address().hex(), "power": 10}
+            for p in privs
+        ],
+    }
+    os.makedirs(args.home, exist_ok=True)
+    nodes = [
+        consensus.ValidatorNode(
+            f"val{i}", privs[i], genesis, args.chain_id,
+            data_dir=os.path.join(args.home, f"val{i}"),
+        )
+        for i in range(n)
+    ]
+    net = consensus.LocalNetwork(nodes)
+    services = []
+    for vn in net.nodes:
+        svc = NodeService(Node(vn.app), port=0)
+        svc.serve_background()
+        services.append(svc)
+        print(f"{vn.name}: http://127.0.0.1:{svc.port}", file=sys.stderr)
+
+    signer = Signer(args.chain_id)
+    for i, p in enumerate(privs):
+        signer.add_account(p, number=i)
+    t = time.time()
+    produced = 0
+    a0 = privs[0].public_key().address()
+    a1 = privs[1 % n].public_key().address()
+    try:
+        while args.blocks is None or produced < args.blocks:
+            if args.load and n >= 2:
+                tx = signer.create_tx(
+                    a0, [MsgSend(a0, a1, 1 + produced)],
+                    fee=2000, gas_limit=100_000,
+                )
+                if net.broadcast_tx(tx.encode()):
+                    signer.accounts[a0].sequence += 1
+            t += args.block_time
+            blk, cert = net.produce_height(t=t)
+            if blk is None:
+                print("round failed; rotating proposer", file=sys.stderr)
+                continue
+            produced += 1
+            heights = {vn.app.height for vn in net.nodes}
+            hashes = {vn.app.last_app_hash.hex()[:12] for vn in net.nodes}
+            print(
+                f"height {blk.header.height}: {len(blk.txs)} txs, "
+                f"{len(cert.votes)} votes, nodes at {sorted(heights)}, "
+                f"app hash {sorted(hashes)}",
+                file=sys.stderr,
+            )
+            if args.blocks is None:
+                time.sleep(args.block_time)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        for svc in services:
+            svc.shutdown()
+    assert len({vn.app.last_app_hash for vn in net.nodes}) == 1
+    print(json.dumps({
+        "validators": n,
+        "blocks": produced,
+        "final_height": net.nodes[0].app.height,
+        "app_hash": net.nodes[0].app.last_app_hash.hex(),
+    }))
+    return 0
+
+
 def cmd_keys(args) -> int:
     from celestia_app_tpu.chain.crypto import PrivateKey
 
@@ -338,6 +426,16 @@ def main(argv=None) -> int:
     p.add_argument("--namespace", help="10-hex-char v0 namespace id (pfb)")
     p.add_argument("--data", help="blob hex, or @file for raw bytes (pfb)")
     p.set_defaults(fn=cmd_tx)
+
+    p = sub.add_parser("devnet")
+    p.add_argument("--home", required=True)
+    p.add_argument("--chain-id", default="celestia-devnet-1")
+    p.add_argument("--validators", type=int, default=3)
+    p.add_argument("--blocks", type=int, default=None)
+    p.add_argument("--block-time", type=float, default=1.0)
+    p.add_argument("--load", action="store_true",
+                   help="submit a send per block (txsim-lite)")
+    p.set_defaults(fn=cmd_devnet)
 
     p = sub.add_parser("keys")
     p.add_argument("action", choices=["derive"])
