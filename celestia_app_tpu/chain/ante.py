@@ -38,6 +38,9 @@ from celestia_app_tpu.chain.tx import (
     MsgTransfer,
     MsgUndelegate,
     MsgVote,
+    MsgRecvPacket,
+    MsgAcknowledgePacket,
+    MsgTimeoutPacket,
     Tx,
 )
 from celestia_app_tpu.chain.crypto import PublicKey
@@ -65,6 +68,9 @@ MSG_VERSIONS: dict[str, tuple[int, int]] = {
     MsgVote.TYPE: (1, 99),
     MsgTransfer.TYPE: (1, 99),
     MsgExec.TYPE: (1, 99),
+    MsgRecvPacket.TYPE: (1, 99),
+    MsgAcknowledgePacket.TYPE: (1, 99),
+    MsgTimeoutPacket.TYPE: (1, 99),
 }
 
 
@@ -95,6 +101,8 @@ def msg_signer(m) -> bytes | None:
         return m.sender
     if isinstance(m, MsgExec):
         return m.grantee
+    if isinstance(m, (MsgRecvPacket, MsgAcknowledgePacket, MsgTimeoutPacket)):
+        return m.relayer
     return None
 
 

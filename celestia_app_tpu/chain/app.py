@@ -63,6 +63,9 @@ from celestia_app_tpu.chain.tx import (
     MsgVote,
     MsgTransfer,
     MsgExec,
+    MsgRecvPacket,
+    MsgAcknowledgePacket,
+    MsgTimeoutPacket,
     decode_tx,
 )
 from celestia_app_tpu.da import blob as blob_mod
@@ -648,7 +651,11 @@ class App:
                 self._dispatch(tx_ctx, m)
             tx_ctx.store.write()
             return TxResult(0, "", tx.body.gas_limit, gas.consumed, tx_ctx.events)
-        except (ante_mod.AnteError, OutOfGas, ValueError) as e:
+        except (ante_mod.AnteError, OutOfGas, ValueError, KeyError,
+                TypeError, IndexError) as e:
+            # baseapp's runTx panic recovery: ANY malformed msg payload
+            # (e.g. relay JSON missing fields -> KeyError) becomes a failed
+            # tx, never a deterministic crash of every validator.
             # failed txs keep their fee + sequence bump (cosmos semantics):
             # re-run just the ante effects on a fresh branch
             fee_ctx = block_ctx.branch()
@@ -680,7 +687,8 @@ class App:
             self.ante.run(ctx, tx, simulate=True)
             for m in tx.body.msgs:
                 self._dispatch(ctx, m)
-        except (ante_mod.AnteError, OutOfGas, ValueError) as e:
+        except (ante_mod.AnteError, OutOfGas, ValueError, KeyError,
+                TypeError, IndexError) as e:
             return TxResult(1, str(e), 0, ctx.gas_meter.consumed, [])
         # branch is dropped: simulation never mutates state
         return TxResult(0, "", 0, ctx.gas_meter.consumed, ctx.events)
@@ -726,6 +734,40 @@ class App:
             self.ibc.transfer.send_transfer(
                 ctx, msg.source_channel, msg.sender, msg.receiver,
                 msg.denom, msg.amount,
+            )
+        elif isinstance(msg, MsgRecvPacket):
+            # consensus-routed relay (ibc-go MsgRecvPacket): packet
+            # application is part of the block, so every validator applies
+            # it identically and WAL replay reproduces it
+            import json as json_mod
+
+            packet = json_mod.loads(msg.packet_json)
+            proof = json_mod.loads(msg.proof_json) if msg.proof_json else None
+            ack = self.ibc.recv_packet(
+                ctx, packet, proof,
+                msg.proof_height if proof is not None else None,
+            )
+            ctx.emit_event(
+                "ibc.recv_packet",
+                sequence=packet.get("sequence"),
+                ok="error" not in ack,
+            )
+        elif isinstance(msg, MsgAcknowledgePacket):
+            import json as json_mod
+
+            self.ibc.acknowledge_packet(
+                ctx, json_mod.loads(msg.packet_json),
+                json_mod.loads(msg.ack_json),
+                json_mod.loads(msg.proof_json) if msg.proof_json else None,
+                msg.proof_height if msg.proof_json else None,
+            )
+        elif isinstance(msg, MsgTimeoutPacket):
+            import json as json_mod
+
+            self.ibc.timeout_packet(
+                ctx, json_mod.loads(msg.packet_json),
+                json_mod.loads(msg.proof_json) if msg.proof_json else None,
+                msg.proof_height if msg.proof_json else None,
             )
         elif isinstance(msg, MsgExec):
             # x/authz: every inner message's native signer must have granted
@@ -948,14 +990,20 @@ class App:
         ctx.store.write()
         return ack
 
-    def relay_acknowledge(self, packet: dict, ack: dict) -> None:
+    def relay_acknowledge(
+        self, packet: dict, ack: dict,
+        proof: dict | None = None, proof_height: int | None = None,
+    ) -> None:
         ctx = self._deliver_ctx(InfiniteGasMeter())
-        self.ibc.transfer.on_acknowledgement(ctx, packet, ack)
+        self.ibc.acknowledge_packet(ctx, packet, ack, proof, proof_height)
         ctx.store.write()
 
-    def relay_timeout(self, packet: dict) -> None:
+    def relay_timeout(
+        self, packet: dict,
+        proof: dict | None = None, proof_height: int | None = None,
+    ) -> None:
         ctx = self._deliver_ctx(InfiniteGasMeter())
-        self.ibc.transfer.on_timeout(ctx, packet)
+        self.ibc.timeout_packet(ctx, packet, proof, proof_height)
         ctx.store.write()
 
     # convenience: one full consensus round in-process

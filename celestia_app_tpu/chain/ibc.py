@@ -37,6 +37,7 @@ from celestia_app_tpu.chain.state import (
     canonical_json,
     get_json,
     put_json,
+    verify_absence,
     verify_membership,
 )
 
@@ -316,6 +317,7 @@ class TransferKeeper:
     def send_transfer(
         self, ctx: Context, source_channel: str, sender: bytes,
         receiver: str, denom: str, amount: int, memo: str = "",
+        timeout_height: int = 0,
     ) -> dict:
         """MsgTransfer: escrow native tokens (or burn returning vouchers)
         and emit the ICS-20 packet."""
@@ -339,6 +341,7 @@ class TransferKeeper:
             "destination_port": chan["counterparty_port"],
             "destination_channel": chan["counterparty_channel"],
             "sequence": self.channels.next_sequence(ctx, self.PORT, source_channel),
+            "timeout_height": timeout_height,  # counterparty height; 0 = none
             "data": {
                 "denom": denom,
                 "amount": str(amount),
@@ -563,6 +566,77 @@ class IBCStack:
         )
         if not verify_membership(root, key, packet_commitment(packet), proof):
             raise IBCError("packet commitment proof verification failed")
+
+    def _our_sending_channel(self, ctx: Context, packet: dict) -> dict:
+        """The channel WE sent this packet on (ack/timeout settle our side)."""
+        chan = self.channels.channel(
+            ctx, packet["source_port"], packet["source_channel"]
+        )
+        if chan is None:
+            raise IBCError("unknown source channel")
+        return chan
+
+    def acknowledge_packet(
+        self, ctx: Context, packet: dict, ack: dict,
+        proof: dict | None = None, proof_height: int | None = None,
+    ) -> None:
+        """Outbound settlement (ibc-go MsgAcknowledgement): on a
+        client-backed channel the submitted ack must be PROVEN as the
+        counterparty's written acknowledgement — otherwise any account
+        could forge an error ack and pull an in-flight packet's escrow
+        back while the counterparty delivers it (supply duplication)."""
+        chan = self._our_sending_channel(ctx, packet)
+        client_id = chan.get("client_id")
+        if client_id is not None:
+            if proof is None or proof_height is None:
+                raise IBCError("channel requires an acknowledgement proof")
+            root = self.clients.consensus_root(ctx, client_id, proof_height)
+            if root is None:
+                raise IBCError(
+                    f"no consensus state for {client_id!r} at height {proof_height}"
+                )
+            ack_key = ChannelKeeper.ACK + (
+                f"{packet['destination_port']}/{packet['destination_channel']}/"
+                f"{packet['sequence']}".encode()
+            )
+            if not verify_membership(root, ack_key, canonical_json(ack), proof):
+                raise IBCError("acknowledgement proof verification failed")
+        self.transfer.on_acknowledgement(ctx, packet, ack)
+
+    def timeout_packet(
+        self, ctx: Context, packet: dict,
+        proof: dict | None = None, proof_height: int | None = None,
+    ) -> None:
+        """Timeout refund (ibc-go MsgTimeout): on a client-backed channel
+        the packet must carry a timeout height that has PASSED on the
+        counterparty (tracked root exists at proof_height ≥ timeout) AND
+        the counterparty must provably have NOT processed it (absence of
+        its ack record — the receipt-absence analog)."""
+        chan = self._our_sending_channel(ctx, packet)
+        client_id = chan.get("client_id")
+        if client_id is not None:
+            timeout = int(packet.get("timeout_height") or 0)
+            if timeout <= 0:
+                raise IBCError("packet has no timeout height")
+            if proof is None or proof_height is None:
+                raise IBCError("channel requires a non-receipt proof")
+            if proof_height < timeout:
+                raise IBCError(
+                    f"timeout height {timeout} not reached at proof height "
+                    f"{proof_height}"
+                )
+            root = self.clients.consensus_root(ctx, client_id, proof_height)
+            if root is None:
+                raise IBCError(
+                    f"no consensus state for {client_id!r} at height {proof_height}"
+                )
+            ack_key = ChannelKeeper.ACK + (
+                f"{packet['destination_port']}/{packet['destination_channel']}/"
+                f"{packet['sequence']}".encode()
+            )
+            if not verify_absence(root, ack_key, proof):
+                raise IBCError("non-receipt (ack absence) proof failed")
+        self.transfer.on_timeout(ctx, packet)
 
     def recv_packet(
         self,

@@ -222,7 +222,30 @@ class KVStore:
         self._dirty = set()
         return self._node(0, 0)
 
-    # -- membership proofs (the IBC light-client verification primitive) --
+    # -- membership/absence proofs (IBC light-client verification) --------
+
+    def prove_absence(self, key: bytes) -> dict:
+        """Proof that `key` is NOT in the store under app_hash(): the full
+        content of the key's bucket (buckets are small — sha-sharded to
+        1/65536 of the keyspace) plus the bucket-to-root path. The ibc-go
+        receipt-absence analog that gates timeouts."""
+        if key in self._data:
+            raise KeyError(f"key {key!r} exists — use prove()")
+        self.app_hash()
+        b = self._bucket_of(key)
+        keys = sorted(self._index().get(b, ()))
+        tree_path = []
+        i = b
+        for level in range(_TREE_DEPTH, 0, -1):
+            tree_path.append(self._node(level, i ^ 1).hex())
+            i >>= 1
+        return {
+            "bucket": b,
+            "entries": [
+                [k2.hex(), self._data[k2].hex()] for k2 in keys
+            ],
+            "tree_path": tree_path,
+        }
 
     def prove(self, key: bytes) -> dict:
         """Merkle membership proof of (key, value) against app_hash().
@@ -257,6 +280,11 @@ class KVStore:
         }
 
 
+def _bucket_of_key(key: bytes) -> int:
+    d = hashlib.sha256(key).digest()
+    return (d[0] << 8) | d[1]
+
+
 def verify_membership(root: bytes, key: bytes, value: bytes, proof: dict) -> bool:
     """Check a :meth:`KVStore.prove` proof against an app hash. Pure
     function of the proof — safe to run against a counterparty's root."""
@@ -274,6 +302,41 @@ def verify_membership(root: bytes, key: bytes, value: bytes, proof: dict) -> boo
             [bytes.fromhex(h) for h in proof["bucket_path"]],
         )
         node = bucket_hash
+        i = proof["bucket"]
+        if len(proof["tree_path"]) != _TREE_DEPTH:
+            return False
+        for sib_hex in proof["tree_path"]:
+            sib = bytes.fromhex(sib_hex)
+            if i & 1:
+                node = hashlib.sha256(b"\x01" + sib + node).digest()
+            else:
+                node = hashlib.sha256(b"\x01" + node + sib).digest()
+            i >>= 1
+        return node == root
+    except (KeyError, ValueError, IndexError, TypeError):
+        return False
+
+
+def verify_absence(root: bytes, key: bytes, proof: dict) -> bool:
+    """Check a :meth:`KVStore.prove_absence` proof: the key's bucket is
+    fully disclosed, does not contain the key, and hashes to the root."""
+    try:
+        if _bucket_of_key(key) != proof["bucket"]:
+            return False
+        entries = [
+            (bytes.fromhex(k), bytes.fromhex(v)) for k, v in proof["entries"]
+        ]
+        if any(k == key for k, _v in entries):
+            return False
+        if entries != sorted(entries):  # canonical order: no hidden slots
+            return False
+        # every disclosed entry must genuinely live in this bucket
+        if any(_bucket_of_key(k) != proof["bucket"] for k, _v in entries):
+            return False
+        leaves = [
+            hashlib.sha256(k + b"\x00" + v).digest() for k, v in entries
+        ]
+        node = merkle_host.hash_from_leaves(leaves)
         i = proof["bucket"]
         if len(proof["tree_path"]) != _TREE_DEPTH:
             return False

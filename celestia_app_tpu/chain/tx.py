@@ -336,6 +336,81 @@ class MsgTransfer:
 
 
 @dataclasses.dataclass(frozen=True)
+class MsgRecvPacket:
+    """ibc-go channel MsgRecvPacket: a relayer submits an inbound packet
+    WITH its commitment proof as a transaction, so packet application is
+    part of consensus (deterministic across validators) rather than a
+    node-local side channel. Payloads are the framework's canonical-JSON
+    packet/proof forms (chain/ibc.py)."""
+
+    TYPE = "ibc/MsgRecvPacket"
+    relayer: bytes
+    packet_json: bytes
+    proof_json: bytes  # empty = fixture channel (no client binding)
+    proof_height: int
+
+    def encode(self) -> bytes:
+        return (
+            _b(self.relayer) + _b(self.packet_json)
+            + _b(self.proof_json) + uvarint(self.proof_height)
+        )
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MsgRecvPacket":
+        r = _Reader(raw)
+        return cls(r.b(), r.b(), r.b(), r.u())
+
+
+@dataclasses.dataclass(frozen=True)
+class MsgAcknowledgePacket:
+    """ibc-go MsgAcknowledgement: outbound-packet settlement (refund on
+    error acks), gated by the stored commitment AND — on client-backed
+    channels — a membership proof of the counterparty's written ack."""
+
+    TYPE = "ibc/MsgAcknowledgePacket"
+    relayer: bytes
+    packet_json: bytes
+    ack_json: bytes
+    proof_json: bytes = b""
+    proof_height: int = 0
+
+    def encode(self) -> bytes:
+        return (
+            _b(self.relayer) + _b(self.packet_json) + _b(self.ack_json)
+            + _b(self.proof_json) + uvarint(self.proof_height)
+        )
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MsgAcknowledgePacket":
+        r = _Reader(raw)
+        return cls(r.b(), r.b(), r.b(), r.b(), r.u())
+
+
+@dataclasses.dataclass(frozen=True)
+class MsgTimeoutPacket:
+    """ibc-go MsgTimeout: refund an expired outbound packet — on
+    client-backed channels gated by timeout-height expiry plus an ABSENCE
+    proof of the counterparty's ack (the receipt-absence analog)."""
+
+    TYPE = "ibc/MsgTimeoutPacket"
+    relayer: bytes
+    packet_json: bytes
+    proof_json: bytes = b""
+    proof_height: int = 0
+
+    def encode(self) -> bytes:
+        return (
+            _b(self.relayer) + _b(self.packet_json)
+            + _b(self.proof_json) + uvarint(self.proof_height)
+        )
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MsgTimeoutPacket":
+        r = _Reader(raw)
+        return cls(r.b(), r.b(), r.b(), r.u())
+
+
+@dataclasses.dataclass(frozen=True)
 class MsgExec:
     """x/authz MsgExec: the grantee executes messages on the granter's
     behalf; each inner message's native signer must have granted the tx
@@ -369,7 +444,7 @@ MSG_TYPES = {
         MsgSend, MsgPayForBlobs, MsgSignalVersion, MsgTryUpgrade,
         MsgRegisterEVMAddress, MsgDelegate, MsgUndelegate, MsgBeginRedelegate,
         MsgCreateValidator, MsgSubmitProposal, MsgDeposit, MsgVote, MsgTransfer,
-        MsgExec,
+        MsgExec, MsgRecvPacket, MsgAcknowledgePacket, MsgTimeoutPacket,
     )
 }
 

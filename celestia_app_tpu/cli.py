@@ -296,7 +296,13 @@ def cmd_devnet(args) -> int:
     finally:
         for svc in services:
             svc.shutdown()
-    assert len({vn.app.last_app_hash for vn in net.nodes}) == 1
+    final_hashes = {vn.app.last_app_hash for vn in net.nodes}
+    if len(final_hashes) != 1:
+        print(
+            f"DIVERGENCE: {sorted(h.hex() for h in final_hashes)}",
+            file=sys.stderr,
+        )
+        return 1
     print(json.dumps({
         "validators": n,
         "blocks": produced,

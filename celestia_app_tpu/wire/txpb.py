@@ -359,6 +359,58 @@ def _dec_transfer(raw: bytes) -> itx.MsgTransfer:
     )
 
 
+def _enc_recv_packet(m: itx.MsgRecvPacket) -> bytes:
+    return (
+        field_bytes(1, m.packet_json)
+        + field_bytes(2, m.proof_json)
+        + field_varint(3, m.proof_height)
+        + field_string(4, _addr_str(m.relayer))
+    )
+
+
+def _dec_recv_packet(raw: bytes) -> itx.MsgRecvPacket:
+    f = Fields(raw)
+    return itx.MsgRecvPacket(
+        _addr_bytes(f.get_string(4)), f.get_bytes(1), f.get_bytes(2),
+        f.get_int(3),
+    )
+
+
+def _enc_ack_packet(m: itx.MsgAcknowledgePacket) -> bytes:
+    return (
+        field_bytes(1, m.packet_json)
+        + field_bytes(2, m.ack_json)
+        + field_bytes(3, m.proof_json)
+        + field_varint(4, m.proof_height)
+        + field_string(5, _addr_str(m.relayer))
+    )
+
+
+def _dec_ack_packet(raw: bytes) -> itx.MsgAcknowledgePacket:
+    f = Fields(raw)
+    return itx.MsgAcknowledgePacket(
+        _addr_bytes(f.get_string(5)), f.get_bytes(1), f.get_bytes(2),
+        f.get_bytes(3), f.get_int(4),
+    )
+
+
+def _enc_timeout_packet(m: itx.MsgTimeoutPacket) -> bytes:
+    return (
+        field_bytes(1, m.packet_json)
+        + field_bytes(2, m.proof_json)
+        + field_varint(3, m.proof_height)
+        + field_string(4, _addr_str(m.relayer))
+    )
+
+
+def _dec_timeout_packet(raw: bytes) -> itx.MsgTimeoutPacket:
+    f = Fields(raw)
+    return itx.MsgTimeoutPacket(
+        _addr_bytes(f.get_string(4)), f.get_bytes(1), f.get_bytes(2),
+        f.get_int(3),
+    )
+
+
 # type_url -> (internal class, encoder, decoder)
 MSG_CODECS = {
     "/cosmos.bank.v1beta1.MsgSend": (itx.MsgSend, _enc_send, _dec_send),
@@ -384,6 +436,16 @@ MSG_CODECS = {
     "/cosmos.authz.v1beta1.MsgExec": (itx.MsgExec, _enc_exec, _dec_exec),
     "/ibc.applications.transfer.v1.MsgTransfer": (
         itx.MsgTransfer, _enc_transfer, _dec_transfer),
+    # relay envelopes: consensus-routed packet application. The packet/
+    # proof payloads are the FRAMEWORK's canonical-JSON forms (chain/ibc.py)
+    # — deliberately framework-scoped type URLs, not ibc-go's (whose Packet
+    # proto this framework does not carry on the wire).
+    "/celestia_tpu.ibc.MsgRecvPacket": (
+        itx.MsgRecvPacket, _enc_recv_packet, _dec_recv_packet),
+    "/celestia_tpu.ibc.MsgAcknowledgePacket": (
+        itx.MsgAcknowledgePacket, _enc_ack_packet, _dec_ack_packet),
+    "/celestia_tpu.ibc.MsgTimeoutPacket": (
+        itx.MsgTimeoutPacket, _enc_timeout_packet, _dec_timeout_packet),
 }
 
 _URL_BY_CLASS = {cls: url for url, (cls, _e, _d) in MSG_CODECS.items()}

@@ -470,3 +470,228 @@ def test_channel_open_ack_requires_init_state():
         app.ibc.channels.channel_open_ack(
             ctx, app.ibc.clients, "transfer", "channel-0", {}, {}, 1,
         )
+
+
+def test_consensus_routed_relay_msgs():
+    """MsgRecvPacket as a TRANSACTION: packet application happens inside a
+    block (every validator replays it; WAL reproduces it) instead of the
+    node-local relay side channel — with the proof still enforced."""
+    import json as json_mod
+
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.chain.tx import (
+        MsgAcknowledgePacket,
+        MsgRecvPacket,
+    )
+    from celestia_app_tpu.chain.state import canonical_json
+
+    chain_a, privs_a, chain_b, privs_b = _wire_counterparties()
+    sender = privs_a[0].public_key().address()
+    receiver = privs_b[1].public_key().address()
+    relayer = privs_b[2].public_key().address()
+
+    packet = chain_a.ibc.transfer.send_transfer(
+        _ctx(chain_a), "channel-0", sender, receiver.hex(), "utia", 4_400
+    )
+    packet["data"]["denom"] = "transfer/channel-0/utia"
+    chain_a.ibc.channels.commit_packet(_ctx(chain_a), packet)
+    root_a = chain_a.store.app_hash()
+    chain_b.ibc.clients.update_client(_ctx(chain_b), "client-a", 9, root_a)
+    proof = chain_a.store.prove(_commit_key(packet))
+    chain_b.bank.mint(_ctx(chain_b), ibc.escrow_address("transfer", "channel-1"), 4_400)
+
+    from celestia_app_tpu.client.tx_client import Signer
+
+    node = Node(chain_b)
+    signer = Signer(chain_b.chain_id)
+    signer.add_account(privs_b[2], number=2)
+    msg = MsgRecvPacket(
+        relayer=relayer,
+        packet_json=canonical_json(packet),
+        proof_json=canonical_json(proof),
+        proof_height=9,
+    )
+    tx = signer.create_tx(relayer, [msg], fee=2000, gas_limit=500_000)
+    assert node.broadcast_tx(tx.encode()).code == 0
+    bal0 = chain_b.bank.balance(_ctx(chain_b), receiver)
+    _, results = node.produce_block(t=1_700_000_400.0)
+    assert results[0].code == 0, results[0].log
+    assert chain_b.bank.balance(_ctx(chain_b), receiver) == bal0 + 4_400
+
+    # a relay tx WITHOUT the proof on a client-backed channel fails the TX
+    signer.accounts[relayer].sequence += 1
+    packet2 = json_mod.loads(json_mod.dumps(packet))
+    packet2["sequence"] = 2
+    bad = MsgRecvPacket(relayer, canonical_json(packet2), b"", 0)
+    tx2 = signer.create_tx(relayer, [bad], fee=2000, gas_limit=500_000)
+    assert node.broadcast_tx(tx2.encode()).code == 0
+    _, results = node.produce_block(t=1_700_000_410.0)
+    assert results[0].code != 0
+    assert "proof" in results[0].log
+
+    # ack settlement on A through a consensus tx: error ack refunds sender
+    node_a = Node(chain_a)
+    signer_a = Signer(chain_a.chain_id)
+    rel_a = privs_a[2].public_key().address()
+    signer_a.add_account(privs_a[2], number=2)
+    bal_sender0 = chain_a.bank.balance(_ctx(chain_a), sender)
+    ack_msg = MsgAcknowledgePacket(
+        rel_a, canonical_json(packet), canonical_json({"error": "failed"})
+    )
+    tx3 = signer_a.create_tx(rel_a, [ack_msg], fee=2000, gas_limit=300_000)
+    assert node_a.broadcast_tx(tx3.encode()).code == 0
+    _, results = node_a.produce_block(t=1_700_000_420.0)
+    assert results[0].code == 0, results[0].log
+    assert chain_a.bank.balance(_ctx(chain_a), sender) == bal_sender0 + 4_400
+
+
+def test_ack_requires_proof_on_client_backed_channel():
+    """Review finding: without an ack proof, ANY account could forge an
+    error ack and pull back an in-flight packet's escrow while the
+    counterparty delivers it. Client-backed channels now demand a
+    membership proof of the counterparty's WRITTEN ack."""
+    chain_a, privs_a, chain_b, privs_b = _wire_counterparties()
+    # make A's side client-backed too
+    ctx_a = _ctx(chain_a)
+    chain_a.ibc.clients.create_client(ctx_a, "client-b")
+    rec = chain_a.ibc.channels.channel(ctx_a, "transfer", "channel-0")
+    rec["client_id"] = "client-b"
+    from celestia_app_tpu.chain.state import put_json
+
+    put_json(ctx_a, ibc.ChannelKeeper.CHAN + b"transfer/channel-0", rec)
+
+    sender = privs_a[0].public_key().address()
+    receiver = privs_b[1].public_key().address()
+    packet = chain_a.ibc.transfer.send_transfer(
+        ctx_a, "channel-0", sender, receiver.hex(), "utia", 3_000
+    )
+    esc = ibc.escrow_address("transfer", "channel-0")
+    assert chain_a.bank.balance(ctx_a, esc) == 3_000
+
+    # forged error ack without proof: rejected, escrow intact
+    with pytest.raises(ibc.IBCError, match="acknowledgement proof"):
+        chain_a.relay_acknowledge(packet, {"error": "forged"})
+    assert chain_a.bank.balance(_ctx(chain_a), esc) == 3_000
+
+    # the real flow: B receives (writes its ack), A proves THAT ack
+    packet["data"]["denom"] = "transfer/channel-0/utia"
+    chain_a.ibc.channels.commit_packet(ctx_a, packet)
+    chain_b.ibc.clients.update_client(
+        _ctx(chain_b), "client-a", 3, chain_a.store.app_hash())
+    proof_b = chain_a.store.prove(_commit_key(packet))
+    chain_b.bank.mint(_ctx(chain_b), ibc.escrow_address("transfer", "channel-1"), 3_000)
+    ack = chain_b.relay_recv_packet(packet, proof=proof_b, proof_height=3)
+    assert "error" not in ack
+    # A learns B's root and proves B's ack record
+    chain_a.ibc.clients.update_client(
+        _ctx(chain_a), "client-b", 4, chain_b.store.app_hash())
+    ack_key = ibc.ChannelKeeper.ACK + (
+        f"{packet['destination_port']}/{packet['destination_channel']}/"
+        f"{packet['sequence']}".encode()
+    )
+    ack_proof = chain_b.store.prove(ack_key)
+    chain_a.relay_acknowledge(packet, ack, proof=ack_proof, proof_height=4)
+    # success ack: escrow stays (tokens live on B)
+    assert chain_a.bank.balance(_ctx(chain_a), esc) == 3_000
+    # a DIFFERENT ack under the same proof fails
+    chain_a.ibc.channels.commit_packet(_ctx(chain_a), packet)  # re-arm
+    with pytest.raises(ibc.IBCError, match="proof verification failed"):
+        chain_a.relay_acknowledge(
+            packet, {"error": "forged"}, proof=ack_proof, proof_height=4
+        )
+
+
+def test_timeout_requires_expiry_and_absence_proof():
+    """Timeout refunds demand (a) the packet's timeout height passed on a
+    tracked counterparty root and (b) an ABSENCE proof of the ack record
+    — a packet the counterparty processed can never be timeout-refunded."""
+    chain_a, privs_a, chain_b, privs_b = _wire_counterparties()
+    ctx_a = _ctx(chain_a)
+    chain_a.ibc.clients.create_client(ctx_a, "client-b")
+    rec = chain_a.ibc.channels.channel(ctx_a, "transfer", "channel-0")
+    rec["client_id"] = "client-b"
+    from celestia_app_tpu.chain.state import put_json
+
+    put_json(ctx_a, ibc.ChannelKeeper.CHAN + b"transfer/channel-0", rec)
+
+    sender = privs_a[0].public_key().address()
+    bal0 = chain_a.bank.balance(ctx_a, sender)
+    packet = chain_a.ibc.transfer.send_transfer(
+        ctx_a, "channel-0", sender, "deadbeef" + "00" * 16, "utia", 2_500,
+        timeout_height=10,
+    )
+    esc = ibc.escrow_address("transfer", "channel-0")
+
+    ack_key = ibc.ChannelKeeper.ACK + (
+        f"{packet['destination_port']}/{packet['destination_channel']}/"
+        f"{packet['sequence']}".encode()
+    )
+    # no proof: rejected
+    with pytest.raises(ibc.IBCError, match="non-receipt proof"):
+        chain_a.relay_timeout(packet)
+    # proof at a height BEFORE the timeout: rejected
+    chain_a.ibc.clients.update_client(
+        ctx_a, "client-b", 5, chain_b.store.app_hash())
+    early = chain_b.store.prove_absence(ack_key)
+    with pytest.raises(ibc.IBCError, match="not reached"):
+        chain_a.relay_timeout(packet, proof=early, proof_height=5)
+    # valid: height 12 >= 10, ack provably absent on B -> refund
+    chain_a.ibc.clients.update_client(
+        ctx_a, "client-b", 12, chain_b.store.app_hash())
+    absence = chain_b.store.prove_absence(ack_key)
+    chain_a.relay_timeout(packet, proof=absence, proof_height=12)
+    assert chain_a.bank.balance(_ctx(chain_a), esc) == 0
+    assert chain_a.bank.balance(_ctx(chain_a), sender) == bal0  # refunded
+
+    # packet WITHOUT a timeout height can never be timeout-refunded
+    packet2 = chain_a.ibc.transfer.send_transfer(
+        _ctx(chain_a), "channel-0", sender, "aa" * 20, "utia", 100
+    )
+    with pytest.raises(ibc.IBCError, match="no timeout height"):
+        chain_a.relay_timeout(packet2, proof=absence, proof_height=12)
+
+
+def test_absence_proof_primitives():
+    from celestia_app_tpu.chain.state import (
+        KVStore,
+        verify_absence,
+    )
+
+    s = KVStore()
+    for i in range(200):
+        s.set(b"k/%d" % i, b"v%d" % i)
+    root = s.app_hash()
+    missing = b"not-a-key"
+    p = s.prove_absence(missing)
+    assert verify_absence(root, missing, p)
+    # the proof does not transfer to a key that EXISTS
+    assert not verify_absence(root, b"k/5", p)
+    # nor to a different root
+    assert not verify_absence(b"\x00" * 32, missing, p)
+    # a present key cannot get an absence proof
+    with pytest.raises(KeyError):
+        s.prove_absence(b"k/5")
+
+
+def test_malformed_relay_msgs_fail_tx_not_chain():
+    """Review finding: a relay msg with shape-valid-JSON-but-missing-fields
+    must produce a failed TxResult, never a validator crash."""
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.chain.tx import MsgAcknowledgePacket, MsgRecvPacket
+    from celestia_app_tpu.client.tx_client import Signer
+
+    app, signer, privs = make_app()
+    node = Node(app)
+    relayer = privs[0].public_key().address()
+    for payload in (b"{}", b"null", b"[1]"):
+        msg = MsgRecvPacket(relayer, payload, b"", 0)
+        tx = signer.create_tx(relayer, [msg], fee=2000, gas_limit=300_000)
+        assert node.broadcast_tx(tx.encode()).code == 0
+        _, results = node.produce_block()
+        signer.accounts[relayer].sequence += 1
+        assert results[0].code != 0, payload  # failed tx, chain alive
+    msg = MsgAcknowledgePacket(relayer, b"{}", b"{}")
+    tx = signer.create_tx(relayer, [msg], fee=2000, gas_limit=300_000)
+    assert node.broadcast_tx(tx.encode()).code == 0
+    _, results = node.produce_block()
+    assert results[0].code != 0

@@ -252,6 +252,9 @@ def test_every_msg_type_roundtrips_through_any():
         itx.MsgRegisterEVMAddress(ADDR, b"\xaa" * 20),
         itx.MsgExec(ADDR, (itx.MsgSend(ADDR, bytes(20), 5),)),
         itx.MsgTransfer(ADDR, "channel-0", "cosmos1xyz", "utia", 44),
+        itx.MsgRecvPacket(ADDR, b'{"sequence":1}', b'{"bucket":3}', 9),
+        itx.MsgAcknowledgePacket(ADDR, b'{"sequence":1}', b'{"result":"AQ=="}'),
+        itx.MsgTimeoutPacket(ADDR, b'{"sequence":2}'),
     ]
     for m in msgs:
         raw = txpb.encode_msg_any(m)
