@@ -313,39 +313,44 @@ class ValidatorNode:
 
     # -- state sync (serving side) ---------------------------------------
 
-    SNAPSHOT_CHUNK_KEYS = 64
-
     def snapshot_chunks(self) -> tuple[dict, list[bytes]]:
-        """(manifest, chunks): the committed store split into deterministic
-        key-ranged chunks (state-sync serving, default_overrides.go:294)."""
-        items = sorted(self.app.store.snapshot().items())
-        chunks: list[bytes] = []
-        for i in range(0, max(len(items), 1), self.SNAPSHOT_CHUNK_KEYS):
-            part = items[i : i + self.SNAPSHOT_CHUNK_KEYS]
-            chunks.append(
-                json.dumps(
-                    [[k.hex(), v.hex()] for k, v in part], sort_keys=True
-                ).encode()
-            )
-        manifest = {
-            "height": self.app.height,
-            "app_hash": self.app.last_app_hash.hex(),
-            "app_version": self.app.app_version,
-            "chain_id": self.app.chain_id,
-            "genesis_time": self.app.genesis_time,
-            "last_block_hash": self.app.last_block_hash.hex(),
-            "n_chunks": len(chunks),
-            "chunk_hashes": [hashlib.sha256(c).hexdigest() for c in chunks],
-        }
-        return manifest, chunks
+        return snapshot_app_chunks(self.app)
 
 
-def state_sync_bootstrap(
-    node: ValidatorNode, manifest: dict, chunks: list[bytes]
-) -> None:
+SNAPSHOT_CHUNK_KEYS = 64
+
+
+def snapshot_app_chunks(app: App) -> tuple[dict, list[bytes]]:
+    """(manifest, chunks): the committed store split into deterministic
+    key-ranged chunks (state-sync serving, default_overrides.go:294)."""
+    items = sorted(app.store.snapshot().items())
+    chunks: list[bytes] = []
+    for i in range(0, max(len(items), 1), SNAPSHOT_CHUNK_KEYS):
+        part = items[i : i + SNAPSHOT_CHUNK_KEYS]
+        chunks.append(
+            json.dumps(
+                [[k.hex(), v.hex()] for k, v in part], sort_keys=True
+            ).encode()
+        )
+    manifest = {
+        "height": app.height,
+        "app_hash": app.last_app_hash.hex(),
+        "app_version": app.app_version,
+        "chain_id": app.chain_id,
+        "genesis_time": app.genesis_time,
+        "last_block_hash": app.last_block_hash.hex(),
+        "n_chunks": len(chunks),
+        "chunk_hashes": [hashlib.sha256(c).hexdigest() for c in chunks],
+    }
+    return manifest, chunks
+
+
+def state_sync_bootstrap(node_or_app, manifest: dict, chunks: list[bytes]) -> None:
     """Adopt a snapshot AFTER verification: every chunk must match the
     manifest hash, and the reassembled store's app hash must equal the
-    trusted header's app_hash — altered chunks are rejected wholesale."""
+    trusted header's app_hash — altered chunks are rejected wholesale.
+    Accepts a ValidatorNode or a bare App."""
+    app = getattr(node_or_app, "app", node_or_app)
     if len(chunks) != manifest["n_chunks"]:
         raise ValueError("chunk count mismatch")
     for i, c in enumerate(chunks):
@@ -360,13 +365,13 @@ def state_sync_bootstrap(
     probe = KVStore(data)
     if probe.app_hash().hex() != manifest["app_hash"]:
         raise ValueError("snapshot app hash does not match trusted header")
-    node.app.store.restore(data)
-    node.app.height = manifest["height"]
-    node.app.app_version = manifest["app_version"]
-    node.app.last_app_hash = bytes.fromhex(manifest["app_hash"])
-    node.app.last_block_hash = bytes.fromhex(manifest["last_block_hash"])
-    node.app.genesis_time = manifest["genesis_time"]
-    node.app._check_state = None
+    app.store.restore(data)
+    app.height = manifest["height"]
+    app.app_version = manifest["app_version"]
+    app.last_app_hash = bytes.fromhex(manifest["app_hash"])
+    app.last_block_hash = bytes.fromhex(manifest["last_block_hash"])
+    app.genesis_time = manifest["genesis_time"]
+    app._check_state = None
 
 
 @dataclasses.dataclass(frozen=True)

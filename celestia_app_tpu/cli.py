@@ -312,6 +312,47 @@ def cmd_devnet(args) -> int:
     return 0
 
 
+def cmd_snapshot(args) -> int:
+    """State-sync snapshots (cmd/root.go snapshot commands +
+    default_overrides.go:294-297 semantics): `create` writes the committed
+    state as verified chunks; `restore` bootstraps a FRESH home from them,
+    verifying every chunk hash and the final app hash against the manifest
+    before adopting anything."""
+    from celestia_app_tpu.chain import consensus
+
+    if args.action == "create":
+        app, _ = _make_app(args.home)
+        manifest, chunks = consensus.snapshot_app_chunks(app)
+        os.makedirs(args.out, exist_ok=True)
+        for i, c in enumerate(chunks):
+            with open(os.path.join(args.out, f"chunk_{i:06d}.json"), "wb") as f:
+                f.write(c)
+        with open(os.path.join(args.out, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2)
+        print(json.dumps({
+            "height": manifest["height"],
+            "chunks": manifest["n_chunks"],
+            "app_hash": manifest["app_hash"],
+        }))
+        return 0
+
+    # restore into a fresh home (init must have been run for config/genesis)
+    with open(os.path.join(args.out, "manifest.json")) as f:
+        manifest = json.load(f)
+    chunks = []
+    for i in range(manifest["n_chunks"]):
+        with open(os.path.join(args.out, f"chunk_{i:06d}.json"), "rb") as f:
+            chunks.append(f.read())
+    app, _ = _make_app(args.home)
+    consensus.state_sync_bootstrap(app, manifest, chunks)
+    app.persist_identity()
+    print(json.dumps({
+        "restored_height": app.height,
+        "app_hash": app.last_app_hash.hex(),
+    }))
+    return 0
+
+
 def cmd_keys(args) -> int:
     from celestia_app_tpu.chain.crypto import PrivateKey
 
@@ -442,6 +483,12 @@ def main(argv=None) -> int:
     p.add_argument("--load", action="store_true",
                    help="submit a send per block (txsim-lite)")
     p.set_defaults(fn=cmd_devnet)
+
+    p = sub.add_parser("snapshot")
+    p.add_argument("action", choices=["create", "restore"])
+    p.add_argument("--home", required=True)
+    p.add_argument("--out", required=True, help="snapshot directory")
+    p.set_defaults(fn=cmd_snapshot)
 
     p = sub.add_parser("keys")
     p.add_argument("action", choices=["derive"])
