@@ -15,9 +15,14 @@ commands (init/start/query/keys/rollback) plus the tools/ binaries. Here:
     python -m celestia_app_tpu blocktime --home DIR [--last N]
     python -m celestia_app_tpu blockscan --home DIR
     python -m celestia_app_tpu txsim --home DIR [--rounds N ...]
+    python -m celestia_app_tpu tx send|pay-for-blob --home DIR --from-seed S ...
+    python -m celestia_app_tpu devnet --home DIR [--validators N] [--load]
+    python -m celestia_app_tpu snapshot create|restore --home DIR --out DIR
 
 `start` runs the single-process node loop (chain/node.py) with the HTTP
 service attached; state persists under --home/data and survives restarts.
+`devnet` runs an N-validator consensus network in-process (local_devnet
+analog); `snapshot` is verified state-sync for fresh-home bootstrap.
 """
 
 from __future__ import annotations
