@@ -360,13 +360,35 @@ def cmd_snapshot(args) -> int:
 
 def cmd_keys(args) -> int:
     from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.wire import bech32
 
     pk = PrivateKey.from_seed(args.seed.encode())
     pub = pk.public_key()
     print(json.dumps({
         "address": pub.address().hex(),
+        "bech32": bech32.encode(pub.address()),
         "pubkey": pub.compressed.hex(),
     }, indent=2))
+    return 0
+
+
+def cmd_addr_conversion(args) -> int:
+    """cmd/root.go addr-conversion: bech32 <-> hex for celestia addresses."""
+    from celestia_app_tpu.wire import bech32
+
+    a = args.address
+    if a.startswith("celestia"):
+        pos = a.rfind("1")
+        hrp = a[:pos]
+        raw = bech32.decode(a, hrp)
+        print(json.dumps({"hex": raw.hex(), "bech32": a}))
+    else:
+        raw = bytes.fromhex(a)
+        print(json.dumps({
+            "hex": a,
+            "bech32": bech32.encode(raw),
+            "valoper": bech32.encode(raw, bech32.HRP_VALOPER),
+        }))
     return 0
 
 
@@ -488,6 +510,10 @@ def main(argv=None) -> int:
     p.add_argument("--load", action="store_true",
                    help="submit a send per block (txsim-lite)")
     p.set_defaults(fn=cmd_devnet)
+
+    p = sub.add_parser("addr-conversion")
+    p.add_argument("address", help="bech32 celestia1.../hex address")
+    p.set_defaults(fn=cmd_addr_conversion)
 
     p = sub.add_parser("snapshot")
     p.add_argument("action", choices=["create", "restore"])

@@ -15,7 +15,13 @@ import pytest
 from celestia_app_tpu.chain.app import App
 from celestia_app_tpu.chain.crypto import PrivateKey
 from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
-from celestia_app_tpu.chain.tx import MsgSend, sign_tx
+from celestia_app_tpu.chain.tx import (
+    MsgDelegate,
+    MsgRecvPacket,
+    MsgSend,
+    MsgUndelegate,
+    sign_tx,
+)
 from celestia_app_tpu.client.tx_client import Signer
 from celestia_app_tpu.da.blob import Blob
 from celestia_app_tpu.da.namespace import Namespace
@@ -33,7 +39,11 @@ def _setup(gov_max_square_size=None):
             {"address": p.public_key().address().hex(), "balance": 10**14}
             for p in privs
         ],
-        "validators": [],
+        # a couple of validators so staking msgs have real targets
+        "validators": [
+            {"operator": p.public_key().address().hex(), "power": 10}
+            for p in privs[:2]
+        ],
     }
     if gov_max_square_size:
         genesis["gov_max_square_size"] = gov_max_square_size
@@ -70,7 +80,30 @@ def _one_tx(rng, signer, addr) -> tuple[list[bytes], bool]:
         )
         return [tx.encode()], True
     if choice < 9:
-        return [bytes(rng.integers(0, 256, 40, dtype=np.uint8))], False  # junk
+        sub = int(rng.integers(0, 4))
+        if sub == 0:
+            return [bytes(rng.integers(0, 256, 40, dtype=np.uint8))], False  # junk
+        if sub == 1:
+            # staking churn: delegate/undelegate against a genesis validator
+            val = signer_validators[int(rng.integers(0, len(signer_validators)))]
+            amt = int(rng.integers(1, 5)) * 1_000_000
+            msg = (
+                MsgDelegate(addr, val, amt)
+                if rng.random() < 0.7
+                else MsgUndelegate(addr, val, amt)
+            )
+            tx = signer.create_tx(addr, [msg], fee=10**5, gas_limit=10**6)
+            return [tx.encode()], True
+        if sub == 2:
+            # malformed relay msg: MUST fail the tx, never the block
+            msg = MsgRecvPacket(addr, b"{}", b"", 0)
+            tx = signer.create_tx(addr, [msg], fee=10**5, gas_limit=10**6)
+            return [tx.encode()], True
+        # oversize-gas send (fails in delivery, fee still charged)
+        tx = signer.create_tx(
+            addr, [MsgSend(addr, addr, 10**18)], fee=10**5, gas_limit=10**5
+        )
+        return [tx.encode()], True
     # stale-sequence tx (ante-dropped) alongside a valid one
     tx = signer.create_tx(addr, [MsgSend(addr, addr, 1)], fee=10**5, gas_limit=10**5)
     stale = dataclasses.replace(tx.body, sequence=tx.body.sequence + 7)
@@ -80,8 +113,10 @@ def _one_tx(rng, signer, addr) -> tuple[list[bytes], bool]:
 
 @pytest.mark.parametrize("gov_max,seed", [(None, 0), (4, 1), (8, 2), (None, 3)])
 def test_prepare_process_consistency(gov_max, seed):
+    global signer_validators
     rng = np.random.default_rng(seed)
     app, signer, privs = _setup(gov_max)
+    signer_validators = [p.public_key().address() for p in privs[:2]]
 
     for round_i in range(3):
         raw_txs = []
