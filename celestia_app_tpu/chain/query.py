@@ -123,6 +123,19 @@ class QueryRouter:
             }
         if path == "tx":
             return self._tx_by_hash(data)
+        if path == "blobstream/attestation":
+            from celestia_app_tpu.chain import blobstream as bs_mod
+
+            att = self.app.blobstream.attestation_by_nonce(
+                self._ctx(), int(data["nonce"])
+            )
+            if att is None:
+                return {"attestation": None}
+            return {"attestation": bs_mod._att_to_json(att)}
+        if path == "blobstream/latest_nonce":
+            return {
+                "nonce": self.app.blobstream.latest_attestation_nonce(self._ctx())
+            }
         if path == "status":
             return {
                 "chain_id": self.app.chain_id,

@@ -372,3 +372,18 @@ def test_custom_evm_address_signs_with_orchestrator_key():
     ]
     contract.submit_data_root_tuple_root(dc.nonce, root, sigs)
     assert contract.data_root_tuple_root(dc.nonce) == root
+
+
+def test_blobstream_query_routes():
+    """The attestation query surface orchestrators poll (keeper queries)."""
+    from celestia_app_tpu.chain.query import QueryRouter
+
+    app, privs = make_app(window=100)
+    for i in range(100):
+        app.produce_block([], t=T0 + i)
+    router = QueryRouter(app)
+    latest = router.query("blobstream/latest_nonce", {})["nonce"]
+    assert latest >= 1
+    att = router.query("blobstream/attestation", {"nonce": 1})["attestation"]
+    assert att is not None and att["type"] in ("valset", "data_commitment")
+    assert router.query("blobstream/attestation", {"nonce": 10**6})["attestation"] is None
