@@ -171,7 +171,7 @@ def _probe_rs_schedules(ods, reps: int) -> dict[str, float]:
     from celestia_app_tpu.ops import rs
 
     probes = {}
-    for layout in ("batched", "flat"):
+    for layout in ("batched", "flat", "fused"):
         for dtype in ("int8", "bf16"):
             try:
                 fn = jax.jit(rs.extend_square_fn(K, layout=layout, dtype=dtype))

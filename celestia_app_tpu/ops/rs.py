@@ -101,10 +101,10 @@ def bits_to_bytes16(b: jax.Array) -> jax.Array:
 
 
 def _codec(k: int):
-    """(bit_matrix, to_bits, from_bits) for the square size's field."""
+    """(bit_matrix, to_bits, from_bits, bits_per_symbol) for the field."""
     if leopard.uses_gf16(k):
-        return leopard.bit_matrix16(k), bytes_to_bits16, bits_to_bytes16
-    return leopard.bit_matrix(k), bytes_to_bits, bits_to_bytes
+        return leopard.bit_matrix16(k), bytes_to_bits16, bits_to_bytes16, 16
+    return leopard.bit_matrix(k), bytes_to_bits, bits_to_bytes, 8
 
 
 def _gf_mix_flat(bit_mat: jax.Array, x_bits: jax.Array) -> jax.Array:
@@ -150,26 +150,39 @@ def extend_square_fn(k: int, layout: str | None = None, dtype: str | None = None
     CELESTIA_RS_LAYOUT / CELESTIA_RS_DTYPE) pick the matmul schedule:
     "batched" einsum vs "flat" single-GEMM, int8 accumulate-int32 vs bf16
     accumulate-f32 — all four bit-identical, different hardware paths."""
-    mat, to_bits, from_bits = _codec(k)
+    mat, to_bits, from_bits, sym_bits = _codec(k)
     dtype = dtype or _rs_dtype()
     layout = layout or _rs_layout()
     if dtype not in ("int8", "bf16"):
         raise ValueError(f"RS dtype must be 'int8' or 'bf16', not {dtype!r}")
-    if layout not in ("batched", "flat"):
-        raise ValueError(f"RS layout must be 'batched' or 'flat', not {layout!r}")
+    if layout not in ("batched", "flat", "fused"):
+        raise ValueError(
+            f"RS layout must be 'batched', 'flat' or 'fused', not {layout!r}"
+        )
     mm_dtype = jnp.bfloat16 if dtype == "bf16" else jnp.int8
     bit_mat = jnp.asarray(mat, dtype=mm_dtype)  # constant folded into the jaxpr
-    mix = _gf_mix_flat if layout == "flat" else _gf_mix
+    mix = _gf_mix_flat if layout in ("flat", "fused") else _gf_mix
 
     def extend(ods: jax.Array) -> jax.Array:
         assert ods.shape == (k, k, SHARE), ods.shape
         # Row pass: mix across the share index within each row.
         q1 = from_bits(mix(bit_mat, to_bits(ods)))  # (k, k, S)
         # Column pass: transpose so columns become the mixing axis.
-        q2_t = from_bits(mix(bit_mat, to_bits(jnp.swapaxes(ods, 0, 1))))
-        q2 = jnp.swapaxes(q2_t, 0, 1)  # (k rows of parity, k cols, S)
-        # Q3 = row-extend Q2 (== column-extend Q1, data_structures.md:304-310).
-        q3 = from_bits(mix(bit_mat, to_bits(q2)))
+        col_bits = mix(bit_mat, to_bits(jnp.swapaxes(ods, 0, 1)))
+        q2 = jnp.swapaxes(from_bits(col_bits), 0, 1)  # (k parity rows, k cols, S)
+        if layout == "fused":
+            # Q3 feeds on Q2's BITS directly: the column pass produced
+            # (col, sym_bits*parity_row + i, s); a pure bit-space transpose
+            # gives the row pass's (row, sym_bits*col + i, s) — eliding a
+            # pack+unpack round trip through the byte domain
+            sdim = col_bits.shape[-1]
+            b4 = col_bits.reshape(k, k, sym_bits, sdim)  # (c, r, i, s)
+            q3_in = jnp.transpose(b4, (1, 0, 2, 3)).reshape(k, sym_bits * k, sdim)
+            q3 = from_bits(mix(bit_mat, q3_in))
+        else:
+            # Q3 = row-extend Q2 (== column-extend Q1,
+            # data_structures.md:304-310)
+            q3 = from_bits(mix(bit_mat, to_bits(q2)))
         top = jnp.concatenate([ods, q1], axis=1)
         bottom = jnp.concatenate([q2, q3], axis=1)
         return jnp.concatenate([top, bottom], axis=0)

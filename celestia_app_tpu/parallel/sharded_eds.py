@@ -81,7 +81,7 @@ def _roots_local(sq_local: jax.Array, k: int, major_start: jax.Array) -> jax.Arr
 
 def _local_pipeline(k: int, n_seq: int):
     """The per-device program run under shard_map."""
-    mat, to_bits, from_bits = rs._codec(k)  # GF(2^8) or GF(2^16) by k
+    mat, to_bits, from_bits, _sym_bits = rs._codec(k)  # field by k
     bit_mat = jnp.asarray(mat)
 
     def run(ods_local: jax.Array):

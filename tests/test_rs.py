@@ -72,7 +72,7 @@ def test_flat_gemm_layout_bit_identical():
     for k in (4, 8):
         ods = rng.integers(0, 256, size=(k, k, 512), dtype=np.uint8)
         ref = np.asarray(jax.jit(rs_mod.extend_square_fn(k, layout="batched", dtype="int8"))(ods))
-        for layout in ("batched", "flat"):
+        for layout in ("batched", "flat", "fused"):
             for dtype in ("int8", "bf16"):
                 out = np.asarray(
                     jax.jit(rs_mod.extend_square_fn(k, layout=layout, dtype=dtype))(ods)
