@@ -127,9 +127,16 @@ def cmd_start(args) -> int:
     )
     svc = NodeService(node, port=args.listen)
     svc.serve_background()
+    grpc_srv = None
+    if args.grpc is not None:
+        from celestia_app_tpu.service.grpc_server import GrpcTxServer
+
+        grpc_srv = GrpcTxServer(node, port=args.grpc, lock=svc.lock)
     print(
         f"node started: chain {app.chain_id} at height {app.height}, "
-        f"http on 127.0.0.1:{svc.port}, block time {args.block_time}s",
+        f"http on 127.0.0.1:{svc.port}"
+        + (f", grpc on 127.0.0.1:{grpc_srv.port}" if grpc_srv else "")
+        + f", block time {args.block_time}s",
         file=sys.stderr,
     )
     produced = 0
@@ -149,6 +156,8 @@ def cmd_start(args) -> int:
         pass
     finally:
         svc.shutdown()
+        if grpc_srv is not None:
+            grpc_srv.stop()
     return 0
 
 
@@ -476,6 +485,9 @@ def main(argv=None) -> int:
     p = sub.add_parser("start")
     p.add_argument("--home", required=True)
     p.add_argument("--listen", type=int, default=26658)
+    p.add_argument("--grpc", type=int, default=None,
+                   help="also serve cosmos.tx.v1beta1.Service on this port "
+                        "(9090 in the reference; 0 = ephemeral)")
     p.add_argument("--block-time", type=float, default=6.0)
     p.add_argument("--blocks", type=int, default=None)
     p.set_defaults(fn=cmd_start)

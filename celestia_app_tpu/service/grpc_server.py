@@ -1,0 +1,126 @@
+"""gRPC tx service: the reference's cosmos.tx.v1beta1.Service on :9090.
+
+pkg/user/tx_client.go broadcasts over gRPC (BroadcastMode_SYNC,
+tx_client.go:320-330) and estimates gas via Simulate; GetTx backs
+ConfirmTx polling. This server exposes exactly those methods with the
+real service/method names and the real cosmos wire messages
+(BroadcastTxRequest/TxResponse/SimulateRequest/... — hand-rolled codecs
+in wire/txpb.py, cross-checked against the protobuf runtime), so a
+generated cosmos client stub can point at it unchanged. Handlers run
+under the same single-writer lock as the HTTP service.
+
+No protoc codegen: grpcio's generic method handlers with identity
+serializers carry the raw message bytes; the codecs do the rest.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from concurrent import futures
+
+import grpc
+
+from celestia_app_tpu.wire import txpb
+
+SERVICE = "cosmos.tx.v1beta1.Service"
+
+
+class CosmosTxService:
+    def __init__(self, node, lock: threading.Lock | None = None):
+        self.node = node
+        self.lock = lock or threading.Lock()
+
+    # -- handlers (bytes in, bytes out) ---------------------------------
+
+    def broadcast_tx(self, request: bytes, context) -> bytes:
+        tx_bytes, mode = txpb.parse_broadcast_tx_request(request)
+        if mode not in (0, txpb.BROADCAST_MODE_SYNC):
+            # ASYNC/BLOCK semantics are NOT silently downgraded to SYNC —
+            # a BLOCK-mode caller would misread height=0 as committed
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"only BROADCAST_MODE_SYNC is supported, got mode={mode}",
+            )
+        with self.lock:
+            res = self.node.broadcast_tx(tx_bytes)
+        resp = txpb.tx_response_pb(
+            height=0,  # SYNC mode: not yet in a block
+            txhash=hashlib.sha256(tx_bytes).hexdigest().upper(),
+            code=res.code,
+            raw_log=res.log,
+            gas_wanted=res.gas_wanted,
+            gas_used=res.gas_used,
+        )
+        return txpb.broadcast_tx_response_pb(resp)
+
+    def simulate(self, request: bytes, context) -> bytes:
+        tx_bytes = txpb.parse_simulate_request(request)
+        with self.lock:
+            res = self.node.app.simulate_tx(tx_bytes)
+        if res.code != 0:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"simulation failed: {res.log}")
+        return txpb.simulate_response_pb(0, res.gas_used)
+
+    def get_tx(self, request: bytes, context) -> bytes:
+        want = txpb.parse_get_tx_request(request).lower()
+        try:
+            want_raw = bytes.fromhex(want)
+        except ValueError:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"tx hash must be hex, got {want!r}")
+        with self.lock:
+            entry = self.node.committed.get(want_raw)
+        if entry is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"tx {want} not found")
+        height, res = entry
+        resp = txpb.tx_response_pb(
+            height=height,
+            txhash=want.upper(),
+            code=res.code,
+            raw_log=res.log,
+            gas_wanted=res.gas_wanted,
+            gas_used=res.gas_used,
+        )
+        return txpb.get_tx_response_pb(resp)
+
+
+def _identity(x: bytes) -> bytes:
+    return x
+
+
+class GrpcTxServer:
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 9090,
+                 lock: threading.Lock | None = None):
+        self.service = CosmosTxService(node, lock)
+        handlers = {
+            "BroadcastTx": grpc.unary_unary_rpc_method_handler(
+                self.service.broadcast_tx,
+                request_deserializer=_identity,
+                response_serializer=_identity,
+            ),
+            "Simulate": grpc.unary_unary_rpc_method_handler(
+                self.service.simulate,
+                request_deserializer=_identity,
+                response_serializer=_identity,
+            ),
+            "GetTx": grpc.unary_unary_rpc_method_handler(
+                self.service.get_tx,
+                request_deserializer=_identity,
+                response_serializer=_identity,
+            ),
+        }
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        self.server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+        )
+        self.port = self.server.add_insecure_port(f"{host}:{port}")
+        if self.port == 0:
+            # add_insecure_port returns 0 on bind FAILURE (port taken);
+            # a requested port of 0 legitimately returns an ephemeral one
+            raise OSError(f"could not bind gRPC port {host}:{port}")
+        self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop(grace=0.5)

@@ -531,6 +531,92 @@ def sign_doc_pb(
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# cosmos.tx.v1beta1.Service messages (the gRPC:9090 surface TxClient talks
+# to — pkg/user/tx_client.go:320-330 BroadcastTx/Simulate)
+# ---------------------------------------------------------------------------
+
+BROADCAST_MODE_SYNC = 2
+
+
+def broadcast_tx_request_pb(tx_bytes: bytes, mode: int = BROADCAST_MODE_SYNC) -> bytes:
+    return field_bytes(1, tx_bytes) + field_varint(2, mode)
+
+
+def parse_broadcast_tx_request(raw: bytes) -> tuple[bytes, int]:
+    f = Fields(raw)
+    return f.get_bytes(1), f.get_int(2)
+
+
+def tx_response_pb(
+    height: int, txhash: str, code: int, raw_log: str,
+    gas_wanted: int, gas_used: int,
+) -> bytes:
+    """cosmos.base.abci.v1beta1.TxResponse (the fields clients read)."""
+    return (
+        field_varint(1, height)
+        + field_string(2, txhash)
+        + field_varint(4, code)
+        + field_string(6, raw_log)
+        + field_varint(9, gas_wanted)
+        + field_varint(10, gas_used)
+    )
+
+
+def parse_tx_response(raw: bytes) -> dict:
+    f = Fields(raw)
+    return {
+        "height": f.get_int(1),
+        "txhash": f.get_string(2),
+        "code": f.get_int(4),
+        "raw_log": f.get_string(6),
+        "gas_wanted": f.get_int(9),
+        "gas_used": f.get_int(10),
+    }
+
+
+def broadcast_tx_response_pb(tx_response: bytes) -> bytes:
+    return field_message(1, tx_response, emit_default=True)
+
+
+def parse_broadcast_tx_response(raw: bytes) -> dict:
+    return parse_tx_response(Fields(raw).get_bytes(1))
+
+
+def simulate_request_pb(tx_bytes: bytes) -> bytes:
+    return field_bytes(2, tx_bytes)  # field 1 (Tx) is deprecated upstream
+
+
+def parse_simulate_request(raw: bytes) -> bytes:
+    return Fields(raw).get_bytes(2)
+
+
+def simulate_response_pb(gas_wanted: int, gas_used: int) -> bytes:
+    gas_info = field_varint(1, gas_wanted) + field_varint(2, gas_used)
+    return field_message(1, gas_info, emit_default=True)
+
+
+def parse_simulate_response(raw: bytes) -> dict:
+    g = Fields(Fields(raw).get_bytes(1))
+    return {"gas_wanted": g.get_int(1), "gas_used": g.get_int(2)}
+
+
+def get_tx_request_pb(txhash: str) -> bytes:
+    return field_string(1, txhash)
+
+
+def parse_get_tx_request(raw: bytes) -> str:
+    return Fields(raw).get_string(1)
+
+
+def get_tx_response_pb(tx_response: bytes) -> bytes:
+    return field_message(2, tx_response, emit_default=True)
+
+
+def parse_get_tx_response(raw: bytes) -> dict:
+    return parse_tx_response(Fields(raw).get_bytes(2))
+
+
 def blob_pb(namespace29: bytes, data: bytes, share_version: int) -> bytes:
     """celestia.core.v1.blob.Blob: split 29-byte raw namespace into
     version byte (field 4) + 28-byte id (field 1)."""

@@ -554,3 +554,98 @@ def test_cli_snapshot_create_restore(tmp_path):
     assert cli.main(["init", "--home", dst2]) == 0
     with pytest.raises(ValueError):
         cli.main(["snapshot", "restore", "--home", dst2, "--out", snap])
+
+
+def test_grpc_cosmos_tx_service(tmp_path):
+    """VERDICT r2 row 42: the real gRPC:9090 surface — cosmos.tx.v1beta1
+    Service/BroadcastTx + Simulate + GetTx with the real wire messages,
+    driven by a plain grpcio client the way pkg/user/tx_client.go is."""
+    import grpc as grpc_mod
+
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.chain.tx import MsgSend
+    from celestia_app_tpu.service.grpc_server import GrpcTxServer
+    from celestia_app_tpu.wire import txpb
+
+    app, signer, privs = _persistent_app(tmp_path)
+    node = Node(app)
+    server = GrpcTxServer(node, port=0)
+    try:
+        chan = grpc_mod.insecure_channel(f"127.0.0.1:{server.port}")
+        ident = lambda x: x  # noqa: E731
+        bcast = chan.unary_unary(
+            "/cosmos.tx.v1beta1.Service/BroadcastTx",
+            request_serializer=ident, response_deserializer=ident)
+        sim = chan.unary_unary(
+            "/cosmos.tx.v1beta1.Service/Simulate",
+            request_serializer=ident, response_deserializer=ident)
+        get_tx = chan.unary_unary(
+            "/cosmos.tx.v1beta1.Service/GetTx",
+            request_serializer=ident, response_deserializer=ident)
+
+        a0 = privs[0].public_key().address()
+        a1 = privs[1].public_key().address()
+        tx = signer.create_tx(a0, [MsgSend(a0, a1, 321)], fee=2000,
+                              gas_limit=100_000)
+        raw = tx.encode()
+
+        # Simulate measures gas
+        out = txpb.parse_simulate_response(
+            sim(txpb.simulate_request_pb(raw)))
+        assert out["gas_used"] > 0
+
+        # BroadcastTx admits it
+        resp = txpb.parse_broadcast_tx_response(
+            bcast(txpb.broadcast_tx_request_pb(raw)))
+        assert resp["code"] == 0, resp
+        import hashlib as _h
+
+        txhash = _h.sha256(raw).hexdigest()
+        # not yet committed: NOT_FOUND
+        with pytest.raises(grpc_mod.RpcError) as exc:
+            get_tx(txpb.get_tx_request_pb(txhash))
+        assert exc.value.code() == grpc_mod.StatusCode.NOT_FOUND
+        # commit a block, then GetTx succeeds with the height
+        node.produce_block(t=1_700_000_900.0)
+        got = txpb.parse_get_tx_response(get_tx(txpb.get_tx_request_pb(txhash)))
+        assert got["code"] == 0 and got["height"] == app.height
+        assert got["txhash"].lower() == txhash
+        # a failing simulate maps to INVALID_ARGUMENT
+        bad = signer.create_tx(a0, [MsgSend(a0, a1, 10**18)], fee=2000,
+                               gas_limit=100_000)
+        with pytest.raises(grpc_mod.RpcError) as exc:
+            sim(txpb.simulate_request_pb(bad.encode()))
+        assert exc.value.code() == grpc_mod.StatusCode.INVALID_ARGUMENT
+    finally:
+        server.stop()
+
+
+def test_grpc_service_rejects_bad_inputs(tmp_path):
+    import grpc as grpc_mod
+
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.service.grpc_server import GrpcTxServer
+    from celestia_app_tpu.wire import txpb
+    from celestia_app_tpu.wire.proto import field_string, field_varint
+
+    app, signer, privs = _persistent_app(tmp_path)
+    server = GrpcTxServer(Node(app), port=0)
+    try:
+        chan = grpc_mod.insecure_channel(f"127.0.0.1:{server.port}")
+        ident = lambda x: x  # noqa: E731
+        bcast = chan.unary_unary(
+            "/cosmos.tx.v1beta1.Service/BroadcastTx",
+            request_serializer=ident, response_deserializer=ident)
+        get_tx = chan.unary_unary(
+            "/cosmos.tx.v1beta1.Service/GetTx",
+            request_serializer=ident, response_deserializer=ident)
+        # unsupported broadcast mode -> INVALID_ARGUMENT, not silent SYNC
+        with pytest.raises(grpc_mod.RpcError) as exc:
+            bcast(txpb.broadcast_tx_request_pb(b"tx", mode=1))  # BLOCK
+        assert exc.value.code() == grpc_mod.StatusCode.INVALID_ARGUMENT
+        # malformed hash -> INVALID_ARGUMENT, not UNKNOWN
+        with pytest.raises(grpc_mod.RpcError) as exc:
+            get_tx(field_string(1, "not-hex"))
+        assert exc.value.code() == grpc_mod.StatusCode.INVALID_ARGUMENT
+    finally:
+        server.stop()

@@ -366,3 +366,55 @@ def test_legacy_wire_still_accepted():
     assert isinstance(tx, itx.Tx)
     res = node.broadcast_tx(tx.encode())
     assert res.code == 0, res.log
+
+
+def test_grpc_service_messages_match_protobuf_runtime():
+    """BroadcastTxRequest / TxResponse / Simulate* byte-compat with the
+    cosmos protos (the gRPC:9090 wire surface)."""
+    from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+    pool = descriptor_pool.DescriptorPool()
+    f = descriptor_pb2.FileDescriptorProto()
+    f.name = "svc_test.proto"
+    f.package = "s"
+    f.syntax = "proto3"
+    D = descriptor_pb2.FieldDescriptorProto
+    OPT = D.LABEL_OPTIONAL
+
+    def msg(name, fields):
+        m = f.message_type.add()
+        m.name = name
+        for num, fname, ftype in fields:
+            fd = m.field.add()
+            fd.name, fd.number, fd.type, fd.label = fname, num, ftype, OPT
+        return m
+
+    msg("BroadcastTxRequest", [
+        (1, "tx_bytes", D.TYPE_BYTES), (2, "mode", D.TYPE_INT32)])
+    msg("TxResponse", [
+        (1, "height", D.TYPE_INT64), (2, "txhash", D.TYPE_STRING),
+        (4, "code", D.TYPE_UINT32), (6, "raw_log", D.TYPE_STRING),
+        (9, "gas_wanted", D.TYPE_INT64), (10, "gas_used", D.TYPE_INT64)])
+    msg("GasInfo", [(1, "gas_wanted", D.TYPE_UINT64), (2, "gas_used", D.TYPE_UINT64)])
+    m = f.message_type.add()
+    m.name = "SimulateResponse"
+    fd = m.field.add()
+    fd.name, fd.number, fd.type, fd.label = "gas_info", 1, D.TYPE_MESSAGE, OPT
+    fd.type_name = ".s.GasInfo"
+    pool.Add(f)
+    get = lambda n: message_factory.GetMessageClass(  # noqa: E731
+        pool.FindMessageTypeByName(f"s.{n}"))
+
+    ours = txpb.broadcast_tx_request_pb(b"rawtx", 2)
+    ref = get("BroadcastTxRequest")(tx_bytes=b"rawtx", mode=2)
+    assert ours == ref.SerializeToString()
+
+    ours = txpb.tx_response_pb(7, "AB12", 3, "oops", 100, 88)
+    ref = get("TxResponse")(height=7, txhash="AB12", code=3, raw_log="oops",
+                            gas_wanted=100, gas_used=88)
+    assert ours == ref.SerializeToString()
+
+    ours = txpb.simulate_response_pb(100, 88)
+    ref = get("SimulateResponse")(gas_info=get("GasInfo")(gas_wanted=100,
+                                                          gas_used=88))
+    assert ours == ref.SerializeToString()
