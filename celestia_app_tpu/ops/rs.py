@@ -155,10 +155,24 @@ def extend_square_fn(k: int, layout: str | None = None, dtype: str | None = None
     layout = layout or _rs_layout()
     if dtype not in ("int8", "bf16"):
         raise ValueError(f"RS dtype must be 'int8' or 'bf16', not {dtype!r}")
-    if layout not in ("batched", "flat", "fused"):
+    if layout not in ("batched", "flat", "fused", "pallas"):
         raise ValueError(
-            f"RS layout must be 'batched', 'flat' or 'fused', not {layout!r}"
+            f"RS layout must be 'batched', 'flat', 'fused' or 'pallas', "
+            f"not {layout!r}"
         )
+    if layout == "pallas":
+        # the Pallas pass is inherently bf16-accumulate-f32 (dtype is
+        # implied; an explicit different dtype is a caller error)
+        if dtype not in (None, "bf16") and dtype != _rs_dtype():
+            raise ValueError("layout='pallas' implies dtype='bf16'")
+        if leopard.uses_gf16(k):
+            # the Pallas pass covers the 8-bit field; 16-bit squares use
+            # the XLA formulation
+            layout = "flat"
+        else:
+            from celestia_app_tpu.ops import rs_pallas
+
+            return rs_pallas.extend_square_fn(k)
     mm_dtype = jnp.bfloat16 if dtype == "bf16" else jnp.int8
     bit_mat = jnp.asarray(mat, dtype=mm_dtype)  # constant folded into the jaxpr
     mix = _gf_mix_flat if layout in ("flat", "fused") else _gf_mix

@@ -1,0 +1,84 @@
+"""Pallas fused RS-extend pass: unpack → GF(2) matmul → pack in ONE kernel.
+
+The XLA formulation (ops/rs.py) materializes the unpacked bit tensor and
+the int32 matmul accumulator in HBM between ops; on hardware that showed up
+as the pipeline's dominant cost (round-3 --stages: extend ≈ 73 ms of the
+84 ms block at k=128). This kernel keeps the whole chain — byte unpack,
+(8k,8k)·(8k,tile) MXU matmul (bf16 with exact f32 accumulation of 0/1
+products), mod-2, repack — inside VMEM per (row, share-tile) grid cell, so
+HBM sees only the packed bytes in and out.
+
+Correctness is pinned by interpret-mode equality with the XLA path in
+tests (all fields' logic is identical; gf8 only — k ≤ 128 covers every
+protocol-legal square). The bench's schedule calibration probes it as
+layout "pallas" and falls back automatically if it fails to compile on
+the current toolchain.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.ops import leopard
+
+SHARE = appconsts.SHARE_SIZE
+S_TILE = 256  # share-byte tile per grid cell (VMEM budget)
+
+
+def _extend_pass_kernel(k: int):
+    def kernel(b_ref, x_ref, o_ref):
+        x = x_ref[0]  # (k, S_TILE) u8 — one row of the square, one tile
+        shifts = jnp.arange(8, dtype=jnp.uint8)
+        bits = ((x[:, None, :] >> shifts[None, :, None]) & 1).astype(
+            jnp.bfloat16
+        )  # (k, 8, S_TILE)
+        bits = bits.reshape(8 * k, S_TILE)
+        acc = jnp.dot(
+            b_ref[...], bits, preferred_element_type=jnp.float32
+        )  # (8k, S_TILE); 0/1 products sum ≤ 8k < 2^24: exact
+        pb = (acc.astype(jnp.int32) & 1).reshape(k, 8, S_TILE)
+        weights = (1 << jnp.arange(8, dtype=jnp.int32))[None, :, None]
+        o_ref[0] = jnp.sum(pb * weights, axis=1).astype(jnp.uint8)
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _pass_call(k: int, interpret: bool):
+    """One RS pass: (k, k, 512) data-shard rows -> (k, k, 512) parity."""
+    grid = (k, SHARE // S_TILE)
+    return pl.pallas_call(
+        _extend_pass_kernel(k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((8 * k, 8 * k), lambda r, s: (0, 0)),  # B resident
+            pl.BlockSpec((1, k, S_TILE), lambda r, s: (r, 0, s)),
+        ],
+        out_specs=pl.BlockSpec((1, k, S_TILE), lambda r, s: (r, 0, s)),
+        out_shape=jax.ShapeDtypeStruct((k, k, SHARE), jnp.uint8),
+        interpret=interpret,
+    )
+
+
+def extend_square_fn(k: int, interpret: bool = False):
+    """(k, k, 512) ODS -> (2k, 2k, 512) EDS via three fused-pass launches.
+    GF(2^8) only (k ≤ 128 — every protocol-legal square)."""
+    if leopard.uses_gf16(k):
+        raise ValueError("pallas RS path covers the GF(2^8) field (k <= 128)")
+    bit_mat = jnp.asarray(leopard.bit_matrix(k), dtype=jnp.bfloat16)
+    call = _pass_call(k, interpret)
+
+    def extend(ods: jax.Array) -> jax.Array:
+        q1 = call(bit_mat, ods)
+        q2 = jnp.swapaxes(call(bit_mat, jnp.swapaxes(ods, 0, 1)), 0, 1)
+        q3 = call(bit_mat, q2)
+        top = jnp.concatenate([ods, q1], axis=1)
+        bottom = jnp.concatenate([q2, q3], axis=1)
+        return jnp.concatenate([top, bottom], axis=0)
+
+    return extend
