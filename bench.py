@@ -295,7 +295,22 @@ def _run_child() -> None:
         cpu_ms, _, _ = measure_baseline()
 
     rs_schedule = _calibrate_rs_schedule()
-    device_ms, sha_impl = measure_device()
+    try:
+        device_ms, sha_impl = measure_device()
+    except Exception as e:
+        # the winning probe compiled standalone but broke the FULL pipeline
+        # (e.g. VMEM pressure once fused with the NMT stage): fall back to
+        # the default schedule instead of burning the parent's retries
+        print(f"pipeline failed under schedule {rs_schedule} "
+              f"({type(e).__name__}: {e}); retrying with defaults",
+              file=sys.stderr)
+        os.environ.pop("CELESTIA_RS_LAYOUT", None)
+        os.environ.pop("CELESTIA_RS_DTYPE", None)
+        rs_schedule = "batched/int8 (fallback)"
+        from celestia_app_tpu.da import eds as eds_mod
+
+        eds_mod.jitted_pipeline.cache_clear()
+        device_ms, sha_impl = measure_device()
     import jax
 
     out = {
