@@ -65,11 +65,20 @@ def _pass_call(k: int, interpret: bool):
     )
 
 
-def extend_square_fn(k: int, interpret: bool = False):
+def _interpret_default() -> bool:
+    import os
+
+    return os.environ.get("CELESTIA_PALLAS_INTERPRET", "") == "1"
+
+
+def extend_square_fn(k: int, interpret: bool | None = None):
     """(k, k, 512) ODS -> (2k, 2k, 512) EDS via three fused-pass launches.
-    GF(2^8) only (k ≤ 128 — every protocol-legal square)."""
+    GF(2^8) only (k ≤ 128 — every protocol-legal square). `interpret`
+    defaults from CELESTIA_PALLAS_INTERPRET=1 (CPU composition tests)."""
     if leopard.uses_gf16(k):
         raise ValueError("pallas RS path covers the GF(2^8) field (k <= 128)")
+    if interpret is None:
+        interpret = _interpret_default()
     bit_mat = jnp.asarray(leopard.bit_matrix(k), dtype=jnp.bfloat16)
     call = _pass_call(k, interpret)
 

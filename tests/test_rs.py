@@ -97,3 +97,41 @@ def test_pallas_fused_rs_pass_interpret_mode():
         )
         got = np.asarray(rs_pallas.extend_square_fn(k, interpret=True)(ods))
         np.testing.assert_array_equal(ref, got)
+
+
+def test_pallas_rs_composes_with_full_pipeline():
+    """The whole jitted ODS->DAH pipeline with the Pallas RS pass inside
+    (interpret mode): same data root as the default schedule — de-risks
+    the TPU composition before hardware ever sees it."""
+    import subprocess
+    import sys as _sys
+
+    code = r"""
+import numpy as np
+import jax
+from celestia_app_tpu.da import eds as eds_mod
+
+k = 8
+rng = np.random.default_rng(4)
+ods = rng.integers(0, 256, size=(k, k, 512), dtype=np.uint8)
+ods[..., :29] = 0
+ods[..., 28] = 5
+ref_root = bytes(np.asarray(eds_mod.jitted_pipeline(k)(ods)[3]))
+import os
+os.environ["CELESTIA_RS_LAYOUT"] = "pallas"
+os.environ["CELESTIA_PALLAS_INTERPRET"] = "1"
+eds_mod.jitted_pipeline.cache_clear()
+pallas_root = bytes(np.asarray(eds_mod.jitted_pipeline(k)(ods)[3]))
+assert pallas_root == ref_root, (pallas_root.hex(), ref_root.hex())
+print("PIPELINE-PALLAS-OK")
+"""
+    import os
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([_sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=600,
+                       cwd=os.path.join(os.path.dirname(__file__), ".."))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "PIPELINE-PALLAS-OK" in r.stdout
