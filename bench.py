@@ -37,6 +37,10 @@ BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_baseline.json")
 RETRIES = 3
 BACKOFF_S = (5, 30, 90)
+# escalating per-attempt child timeouts: a HUNG relay (vs erroring) fails
+# fast enough that the structured failure JSON still lands inside the
+# driver's window, while later attempts leave room for slow first compiles
+ATTEMPT_TIMEOUT_S = (900, 1200, 1200)
 
 
 def _bench_ods(k: int) -> np.ndarray:
@@ -344,16 +348,17 @@ def _run_parent() -> None:
     clean runtime. ALWAYS prints exactly one JSON line."""
     errors = []
     for attempt in range(RETRIES):
+        timeout_s = ATTEMPT_TIMEOUT_S[min(attempt, len(ATTEMPT_TIMEOUT_S) - 1)]
         try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--child"],
                 capture_output=True,
                 text=True,
-                timeout=1200,
+                timeout=timeout_s,
                 cwd=os.path.dirname(os.path.abspath(__file__)),
             )
         except subprocess.TimeoutExpired:
-            errors.append(f"attempt {attempt + 1}: timeout after 1200s")
+            errors.append(f"attempt {attempt + 1}: timeout after {timeout_s}s")
             r = None
         if r is not None:
             if r.returncode == 0:
