@@ -98,6 +98,9 @@ class App:
         self.chain_id = chain_id
         self.app_version = app_version
         self.engine = engine
+        # node-local (operator-set) min gas price; served by the gRPC node
+        # Config route the reference's QueryMinimumGasPrice reads first
+        self.min_gas_price = min_gas_price
         self.v2_upgrade_height = v2_upgrade_height
         self.store = KVStore()
         # durable storage: commits + blocks persist under data_dir; a

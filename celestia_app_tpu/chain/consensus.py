@@ -102,6 +102,14 @@ class ValidatorNode:
         if self.wal_dir:
             os.makedirs(self.wal_dir, exist_ok=True)
         self.certificates: dict[int, CommitCertificate] = {}
+        # consensus pubkeys ride the genesis doc (Tendermint genesis
+        # validators carry pub_key the same way) so a rebooted node can
+        # verify WAL'd certificate votes without any peer alive
+        self.validator_pubkeys: dict[bytes, bytes] = {
+            bytes.fromhex(v["operator"]): bytes.fromhex(v["pubkey"])
+            for v in genesis.get("validators", [])
+            if "pubkey" in v
+        }
 
     # -- mempool (gossiped) ---------------------------------------------
 
@@ -190,12 +198,21 @@ class ValidatorNode:
         WAL replay: a validator counts as present only with a precommit FOR
         the committed block at the certificate's height — a vote for a
         different block / stale height / junk signature is an absence, so
-        misbehaving validators cannot suppress their own liveness window."""
-        voted = {
-            v.validator
-            for v in cert.votes
-            if v.block_hash == cert.block_hash and v.height == cert.height
-        }
+        misbehaving validators cannot suppress their own liveness window.
+        Each vote's signature is checked against the genesis-known validator
+        pubkeys (mirroring cert.verify), so a cert padded with forged
+        presence-votes for offline validators cannot suppress their
+        downtime accounting; a validator with no genesis pubkey (legacy
+        fixture genesis) falls back to unverified matching."""
+        doc = Vote.sign_bytes(self.app.chain_id, cert.height, cert.block_hash)
+        voted = set()
+        for v in cert.votes:
+            if v.block_hash != cert.block_hash or v.height != cert.height:
+                continue
+            pub = self.validator_pubkeys.get(v.validator)
+            if pub is not None and not PublicKey(pub).verify(v.signature, doc):
+                continue
+            voted.add(v.validator)
         ctx = Context(
             self.app.store, InfiniteGasMeter(), self.app.height, 0,
             self.app.chain_id, self.app.app_version,
@@ -441,6 +458,11 @@ class LocalNetwork:
             raise ValueError("need at least one validator")
         self.nodes = sorted(nodes, key=lambda n: n.address)
         self.chain_id = nodes[0].app.chain_id
+        # every member learns every member's consensus pubkey (gossiped at
+        # handshake in real p2p); genesis-carried keys take precedence
+        peer_keys = {n.address: n.priv.public_key().compressed for n in nodes}
+        for n in self.nodes:
+            n.validator_pubkeys = {**peer_keys, **n.validator_pubkeys}
         self._round = 0  # advances on failed rounds so the proposer rotates
         # signature-verified votes retained for the evidence window, so a
         # conflicting vote surfacing a few heights late still pairs up

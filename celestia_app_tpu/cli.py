@@ -253,7 +253,11 @@ def cmd_devnet(args) -> int:
             for p in privs
         ],
         "validators": [
-            {"operator": p.public_key().address().hex(), "power": 10}
+            {
+                "operator": p.public_key().address().hex(),
+                "power": 10,
+                "pubkey": p.public_key().compressed.hex(),
+            }
             for p in privs
         ],
     }

@@ -174,17 +174,200 @@ class HttpNodeClient:
             return json_mod.loads(r.read())
 
 
+class GrpcNodeClient:
+    """Remote node transport over REAL gRPC — the reference's only remote
+    mode (pkg/user/tx_client.go talks to :9090 exclusively). Speaks the
+    cosmos service/method names with the byte-compat codecs; the channel
+    plus identity (de)serializers stand in for generated stubs."""
+
+    def __init__(self, target: str, timeout: float = 30.0):
+        import grpc
+
+        self._grpc = grpc
+        self.timeout = timeout
+        self.channel = grpc.insecure_channel(target)
+        self._callables: dict[str, object] = {}
+
+    def _call(self, service: str, method: str, request: bytes) -> bytes:
+        path = f"/{service}/{method}"
+        fn = self._callables.get(path)
+        if fn is None:
+            fn = self.channel.unary_unary(
+                path,
+                request_serializer=lambda x: x,
+                response_deserializer=lambda x: x,
+            )
+            self._callables[path] = fn
+        return fn(request, timeout=self.timeout)
+
+    # -- tx service ------------------------------------------------------
+
+    def broadcast_tx(self, raw: bytes):
+        from celestia_app_tpu.chain.block import TxResult
+        from celestia_app_tpu.wire import txpb
+
+        out = txpb.parse_broadcast_tx_response(self._call(
+            "cosmos.tx.v1beta1.Service", "BroadcastTx",
+            txpb.broadcast_tx_request_pb(raw),
+        ))
+        return TxResult(out["code"], out["raw_log"],
+                        out["gas_wanted"], out["gas_used"], [])
+
+    def simulate_tx(self, raw: bytes) -> int:
+        from celestia_app_tpu.wire import txpb
+
+        out = txpb.parse_simulate_response(self._call(
+            "cosmos.tx.v1beta1.Service", "Simulate",
+            txpb.simulate_request_pb(raw),
+        ))
+        return out["gas_used"]
+
+    def confirm_tx(self, raw: bytes, attempts: int = 10,
+                   interval: float = 1.0) -> dict:
+        """GetTx-polling confirmation (tx_client.go:412 ConfirmTx)."""
+        import hashlib
+        import time as time_mod
+
+        from celestia_app_tpu.wire import txpb
+
+        txhash = hashlib.sha256(raw).hexdigest()
+        last_err = None
+        for i in range(max(1, attempts)):
+            try:
+                out = txpb.parse_get_tx_response(self._call(
+                    "cosmos.tx.v1beta1.Service", "GetTx",
+                    txpb.get_tx_request_pb(txhash),
+                ))
+                return {"found": True, "height": out["height"],
+                        "code": out["code"]}
+            except self._grpc.RpcError as e:
+                if e.code() != self._grpc.StatusCode.NOT_FOUND:
+                    raise
+                last_err = e
+            if i + 1 < attempts:
+                time_mod.sleep(interval)
+        assert last_err is not None
+        return {"found": False}
+
+    # -- bootstrap queries (SetupTxClient surface) -----------------------
+
+    def get_latest_block(self) -> dict:
+        from celestia_app_tpu.wire import txpb
+
+        return txpb.parse_get_latest_block_response(self._call(
+            "cosmos.base.tendermint.v1beta1.Service", "GetLatestBlock", b""
+        ))
+
+    def query_account(self, address: str) -> dict | None:
+        """-> {account_number, sequence, ...} or None when the account does
+        not exist in state (SetupTxClient skips those)."""
+        from celestia_app_tpu.wire import txpb
+
+        try:
+            return txpb.parse_query_account_response(self._call(
+                "cosmos.auth.v1beta1.Query", "Account",
+                txpb.query_account_request_pb(address),
+            ))
+        except self._grpc.RpcError as e:
+            if e.code() == self._grpc.StatusCode.NOT_FOUND:
+                return None
+            raise
+
+    def query_balance(self, address: str, denom: str = "") -> int:
+        from celestia_app_tpu.wire import txpb
+
+        _denom, amount = txpb.parse_query_balance_response(self._call(
+            "cosmos.bank.v1beta1.Query", "Balance",
+            txpb.query_balance_request_pb(address, denom),
+        ))
+        return amount
+
+    def blob_params(self) -> dict:
+        from celestia_app_tpu.wire import txpb
+
+        return txpb.parse_blob_params_response(self._call(
+            "celestia.blob.v1.Query", "Params", b""
+        ))
+
+    def minimum_gas_price(self) -> float:
+        """max(local, network) — QueryMinimumGasPrice (tx_client.go:561-591),
+        including the v1 fallback on 'unknown subspace: minfee'."""
+        import json
+        import re as re_mod
+
+        from celestia_app_tpu.wire import txpb
+
+        cfg = txpb.parse_node_config_response(self._call(
+            "cosmos.base.node.v1beta1.Service", "Config", b""
+        ))
+        m = re_mod.match(r"([0-9.]+)", cfg)
+        local = float(m.group(1)) if m else 0.0
+        try:
+            resp = txpb.parse_query_subspace_params_response(self._call(
+                "cosmos.params.v1beta1.Query", "Params",
+                txpb.query_subspace_params_request_pb(
+                    "minfee", "NetworkMinGasPrice"
+                ),
+            ))
+            network = float(json.loads(resp["value"])) if resp["value"] else 0.0
+        except self._grpc.RpcError as e:
+            if "unknown subspace: minfee" in (e.details() or ""):
+                return local  # v1 chain: local price only
+            raise
+        return max(local, network)
+
+    def close(self) -> None:
+        self.channel.close()
+
+
+def setup_tx_client_grpc(
+    target: str, privs: list[PrivateKey], gas_multiplier: float = 1.1
+) -> "TxClient":
+    """SetupTxClient (pkg/user/tx_client.go:147-198) over gRPC alone:
+    chain-id from GetLatestBlock, account number/sequence from auth Account
+    (accounts missing from state are skipped), default gas price from
+    QueryMinimumGasPrice — then a ready TxClient on the same channel."""
+    from celestia_app_tpu.wire import bech32
+
+    client = GrpcNodeClient(target)
+    try:
+        head = client.get_latest_block()
+        signer = Signer(head["chain_id"])
+        for priv in privs:
+            addr = priv.public_key().address()
+            acc = client.query_account(bech32.encode(addr))
+            if acc is None:
+                continue  # skip accounts that don't exist in state
+            signer.add_account(priv, number=acc["account_number"],
+                               sequence=acc["sequence"])
+        if not signer.accounts:
+            raise RuntimeError(
+                "no provided key has an account in state; fund one first"
+            )
+        price = client.minimum_gas_price()
+    except BaseException:
+        client.close()
+        raise
+    return TxClient(client, signer, gas_multiplier=gas_multiplier,
+                    default_gas_price=price)
+
+
 class TxClient:
     """High-level submission against an in-process Node OR a remote
-    HttpNodeClient (both expose broadcast_tx/confirm_tx; gas estimation
-    prefers true simulation when the transport offers it)."""
+    transport (HttpNodeClient / GrpcNodeClient — all expose
+    broadcast_tx/confirm_tx; gas estimation prefers true simulation when
+    the transport offers it)."""
 
-    def __init__(self, node, signer: Signer, gas_multiplier: float = 1.1):
+    def __init__(self, node, signer: Signer, gas_multiplier: float = 1.1,
+                 default_gas_price: float | None = None):
         self.node = node
         self.signer = signer
         self.gas_multiplier = gas_multiplier
+        self.default_gas_price = default_gas_price
 
     def _gas_price(self) -> float:
+        if self.default_gas_price is not None:
+            return self.default_gas_price
         return max(
             appconsts.DEFAULT_MIN_GAS_PRICE,
             appconsts.DEFAULT_NETWORK_MIN_GAS_PRICE,
@@ -252,7 +435,7 @@ class TxClient:
                 # (height, TxResult); the remote transport POLLS the
                 # server's block production and returns the tx-by-hash
                 # dict — check ['found'] before treating it as committed
-                if isinstance(self.node, HttpNodeClient):
+                if isinstance(self.node, (HttpNodeClient, GrpcNodeClient)):
                     return self.node.confirm_tx(raw, attempts=10, interval=1.0)
                 return self.node.confirm_tx(raw)
             expected = parse_expected_sequence(res.log)

@@ -11,11 +11,16 @@ x/blob/types/blob_tx.go:37-108 semantics) is the DEFAULT and what reference
 clients produce; the framework's legacy 4-byte-magic encoding is still
 accepted on unmarshal for old fixtures.
 
-IndexWrapper keeps the framework's fixed-width encoding inside squares
-(4-byte big-endian indices, so a wrapped tx's length never depends on index
-values and layout stays one-pass); the protobuf IndexWrapper codec lives in
-wire/txpb.py for interop tooling. This is a deliberate, documented deviation
-from go-square's in-square bytes.
+IndexWrapper in-square bytes are the reference's protobuf encoding
+(tendermint IndexWrapper with type_id "INDX" — app/encoding/
+index_wrapper_decoder.go:10, coretypes.UnmarshalIndexWrapper), so a PFB
+block's PAY_FOR_BLOB_NAMESPACE shares carry exactly what go-square writes.
+Because packed-varint index bytes depend on index VALUES, the square
+builder reserves compact shares using `index_wrapper_size_worst_case`
+(every index priced at the max share index of the max square — go-square's
+pessimistic-append, ADR-020) and fills the difference with primary-reserved
+padding shares. The pre-round-4 fixed-width "INDX"-magic encoding is still
+accepted on unmarshal for old fixtures.
 """
 
 from __future__ import annotations
@@ -148,27 +153,51 @@ class IndexWrapper:
     share_indexes: tuple[int, ...]
 
 
-def index_wrapper_size(tx_len: int, n_blobs: int) -> int:
-    """Byte length of a marshalled IndexWrapper — independent of index values."""
-    return 4 + len(uvarint(tx_len)) + tx_len + len(uvarint(n_blobs)) + 4 * n_blobs
+def index_wrapper_size_worst_case(
+    tx_len: int, n_blobs: int, max_square_size: int
+) -> int:
+    """Byte length of a protobuf IndexWrapper with every share index priced
+    at the max share index of the max square (go-square's
+    worstCaseShareIndexes: the one-pass builder must reserve compact shares
+    for the PFB sequence BEFORE blob positions — hence index values — are
+    known, ADR-020 'CompactShareCounter'). Mirrors wire/txpb.index_wrapper_pb
+    field-for-field: bytes tx (1), packed uint32 share_indexes (2),
+    string type_id "INDX" (3)."""
+    idx_bytes = n_blobs * len(uvarint(max_square_size * max_square_size))
+    return (
+        1 + len(uvarint(tx_len)) + tx_len          # field 1: tx
+        + 1 + len(uvarint(idx_bytes)) + idx_bytes  # field 2: packed indexes
+        + 1 + 1 + 4                                # field 3: type_id "INDX"
+    )
 
 
 def marshal_index_wrapper(tx: bytes, share_indexes: list[int]) -> bytes:
-    out = bytearray(INDEX_WRAPPER_MAGIC)
-    out += uvarint(len(tx)) + tx
-    out += uvarint(len(share_indexes))
-    for idx in share_indexes:
-        out += idx.to_bytes(4, "big")
-    return bytes(out)
+    """Protobuf IndexWrapper — the reference's in-square wrapped-PFB bytes
+    (coretypes.MarshalIndexWrapper)."""
+    from celestia_app_tpu.wire import txpb
+
+    return txpb.index_wrapper_pb(tx, share_indexes)
 
 
 def is_index_wrapper(raw: bytes) -> bool:
-    return raw[:4] == INDEX_WRAPPER_MAGIC
+    if raw[:4] == INDEX_WRAPPER_MAGIC:
+        return True
+    try:
+        from celestia_app_tpu.wire import txpb
+
+        txpb.parse_index_wrapper(raw)
+        return True
+    except ValueError:
+        return False
 
 
 def unmarshal_index_wrapper(raw: bytes) -> IndexWrapper:
-    if not is_index_wrapper(raw):
-        raise ValueError("not an IndexWrapper")
+    if raw[:4] != INDEX_WRAPPER_MAGIC:
+        from celestia_app_tpu.wire import txpb
+
+        tx, idxs = txpb.parse_index_wrapper(raw)
+        return IndexWrapper(tx=tx, share_indexes=tuple(idxs))
+    # legacy fixed-width encoding (pre-round-4 fixtures)
     off = 4
     tx_len, off = read_uvarint(raw, off)
     tx = raw[off : off + tx_len]

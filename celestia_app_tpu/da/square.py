@@ -68,7 +68,10 @@ class Square:
     # start share index of each blob, parallel to the namespace-sorted order
     blob_start_indexes: dict[tuple[int, int], int]  # (pfb_idx, blob_idx) -> start
     tx_shares_len: int  # shares in TRANSACTION_NAMESPACE
-    pfb_shares_len: int  # shares in PAY_FOR_BLOB_NAMESPACE
+    pfb_shares_len: int  # shares ACTUALLY written in PAY_FOR_BLOB_NAMESPACE
+    # shares the layout reserved for the PFB sequence (worst-case index
+    # sizing); blobs start after this, the gap is primary-reserved padding
+    pfb_shares_reserved: int = 0
 
     def share_bytes(self) -> list[bytes]:
         return [s.raw for s in self.shares]
@@ -83,17 +86,31 @@ class Square:
 
 
 class _Layout:
-    """One deterministic layout pass over a candidate tx set."""
+    """One deterministic layout pass over a candidate tx set.
 
-    def __init__(self, txs: list[bytes], pfbs: list[PfbEntry], threshold: int):
+    The PFB compact sequence is RESERVED at its worst-case size (every
+    share index priced at the max square's max index,
+    `index_wrapper_size_worst_case`) because blob start indexes — hence the
+    actual packed-varint index bytes — are only known once the sequence
+    length is fixed. go-square breaks the same cycle the same way
+    (ADR-020 CompactShareCounter fed with worst-case-marshalled wrappers);
+    the export pass writes the real (≤ reserved) wrapper bytes and fills
+    the difference with primary-reserved padding shares."""
+
+    def __init__(self, txs: list[bytes], pfbs: list[PfbEntry], threshold: int,
+                 max_square_size: int):
         self.txs = txs
         self.pfbs = pfbs
         self.threshold = threshold
+        self.max_square_size = max_square_size
         self.wrapped_sizes = [
-            blob_mod.index_wrapper_size(len(e.tx), len(e.blobs)) for e in pfbs
+            blob_mod.index_wrapper_size_worst_case(
+                len(e.tx), len(e.blobs), max_square_size
+            )
+            for e in pfbs
         ]
         self.tx_shares = compact_shares_needed(_sequence_len(txs))
-        self.pfb_shares = compact_shares_needed(
+        self.pfb_shares_reserved = compact_shares_needed(
             sum(len(uvarint(s)) + s for s in self.wrapped_sizes)
         )
         # Stable namespace sort preserves PFB priority order within a namespace
@@ -107,8 +124,9 @@ class _Layout:
             key=lambda t: (t[0],),
         )
         self.starts: dict[tuple[int, int], int] = {}
-        cursor = self.tx_shares + self.pfb_shares
+        cursor = self.tx_shares + self.pfb_shares_reserved
         self.first_blob_index = None
+        worst_blob_shares = 0
         for ns_raw, i, j in self.ordered:
             count = pfbs[i].blobs[j].share_count()
             start = next_share_index(cursor, count, threshold)
@@ -116,11 +134,20 @@ class _Layout:
                 self.first_blob_index = start
             self.starts[(i, j)] = start
             cursor = start + count
+            width = subtree_width(count, threshold)
+            worst_blob_shares += count + width - 1
         self.total = cursor
+        # the square size comes from the ESTIMATE (worst-case alignment
+        # padding per blob, order-independent), not the exact layout —
+        # ADR-020: "from the estimation can formulate the minimum square
+        # size". Deterministic on both Prepare and Process sides.
+        self.worst_total = (
+            self.tx_shares + self.pfb_shares_reserved + worst_blob_shares
+        )
 
     def square_size(self) -> int:
         k = 1
-        while k * k < self.total:
+        while k * k < self.worst_total:
             k *= 2
         return k
 
@@ -130,7 +157,8 @@ def _export(layout: _Layout, k: int) -> Square:
     shares: list[Share] = []
     if layout.tx_shares:
         shares += shares_mod.split_txs(ns_mod.TX_NAMESPACE, layout.txs)
-    if layout.pfb_shares:
+    pfb_shares_actual = 0
+    if layout.pfb_shares_reserved:
         wrapped = [
             blob_mod.marshal_index_wrapper(
                 e.tx,
@@ -138,8 +166,12 @@ def _export(layout: _Layout, k: int) -> Square:
             )
             for i, e in enumerate(layout.pfbs)
         ]
-        shares += shares_mod.split_txs(ns_mod.PAY_FOR_BLOB_NAMESPACE, wrapped)
-    assert len(shares) == layout.tx_shares + layout.pfb_shares
+        pfb = shares_mod.split_txs(ns_mod.PAY_FOR_BLOB_NAMESPACE, wrapped)
+        pfb_shares_actual = len(pfb)
+        # real index varints ≤ the reserved worst case; the gap up to the
+        # first blob becomes primary-reserved padding below
+        assert pfb_shares_actual <= layout.pfb_shares_reserved
+        shares += pfb
 
     cursor = len(shares)
     prev_ns: ns_mod.Namespace | None = None
@@ -164,7 +196,8 @@ def _export(layout: _Layout, k: int) -> Square:
         pfbs=layout.pfbs,
         blob_start_indexes=layout.starts,
         tx_shares_len=layout.tx_shares,
-        pfb_shares_len=layout.pfb_shares,
+        pfb_shares_len=pfb_shares_actual,
+        pfb_shares_reserved=layout.pfb_shares_reserved,
     )
 
 
@@ -175,7 +208,7 @@ def construct(
     subtree_root_threshold: int,
 ) -> Square:
     """All txs must fit in max_square_size or ValueError (ProcessProposal)."""
-    layout = _Layout(txs, pfbs, subtree_root_threshold)
+    layout = _Layout(txs, pfbs, subtree_root_threshold, max_square_size)
     k = max(layout.square_size(), 1)
     if k > max_square_size:
         raise ValueError(
@@ -215,7 +248,9 @@ def build(
     pfb_seq_len = 0
     blob_shares_worst = 0
     for e in pfbs:
-        wrapped = blob_mod.index_wrapper_size(len(e.tx), len(e.blobs))
+        wrapped = blob_mod.index_wrapper_size_worst_case(
+            len(e.tx), len(e.blobs), max_square_size
+        )
         cand_pfb_len = pfb_seq_len + len(uvarint(wrapped)) + wrapped
         cand_blob_worst = blob_shares_worst
         for b in e.blobs:
@@ -229,7 +264,7 @@ def build(
             kept_pfbs.append(e)
             pfb_seq_len = cand_pfb_len
             blob_shares_worst = cand_blob_worst
-    layout = _Layout(kept_txs, kept_pfbs, subtree_root_threshold)
+    layout = _Layout(kept_txs, kept_pfbs, subtree_root_threshold, max_square_size)
     k = max(layout.square_size(), 1)
     assert k <= max_square_size, "worst-case accounting must over-approximate"
     return _export(layout, k)

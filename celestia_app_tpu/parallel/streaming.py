@@ -29,6 +29,8 @@ def stream_blocks(layout_fn, n_blocks: int, k: int, *, pipeline=None):
     """
     import jax
 
+    if n_blocks <= 0:
+        return []
     run = pipeline if pipeline is not None else eds_mod.jitted_pipeline(k)
     roots: list[bytes] = []
     pending = None

@@ -1,11 +1,15 @@
-"""gRPC tx service: the reference's cosmos.tx.v1beta1.Service on :9090.
+"""gRPC services: the reference's :9090 surface — tx service + the query
+services the client bootstrap depends on.
 
 pkg/user/tx_client.go broadcasts over gRPC (BroadcastMode_SYNC,
 tx_client.go:320-330) and estimates gas via Simulate; GetTx backs
-ConfirmTx polling. This server exposes exactly those methods with the
-real service/method names and the real cosmos wire messages
-(BroadcastTxRequest/TxResponse/SimulateRequest/... — hand-rolled codecs
-in wire/txpb.py, cross-checked against the protobuf runtime), so a
+ConfirmTx polling. SetupTxClient additionally bootstraps over five query
+RPCs (tx_client.go:147-198): tendermint GetLatestBlock (chain-id +
+app version), auth Account (number/sequence), node Config + params/minfee
+(min gas price); bank Balance and celestia.blob.v1 Params round out the
+module query surface clients use. This server exposes all of them with the
+real service/method names and the real cosmos wire messages (hand-rolled
+codecs in wire/txpb.py, cross-checked against the protobuf runtime), so a
 generated cosmos client stub can point at it unchanged. Handlers run
 under the same single-writer lock as the HTTP service.
 
@@ -21,9 +25,16 @@ from concurrent import futures
 
 import grpc
 
-from celestia_app_tpu.wire import txpb
+from celestia_app_tpu.wire import bech32, txpb
 
 SERVICE = "cosmos.tx.v1beta1.Service"
+TM_SERVICE = "cosmos.base.tendermint.v1beta1.Service"
+NODE_SERVICE = "cosmos.base.node.v1beta1.Service"
+AUTH_QUERY = "cosmos.auth.v1beta1.Query"
+BANK_QUERY = "cosmos.bank.v1beta1.Query"
+PARAMS_QUERY = "cosmos.params.v1beta1.Query"
+BLOB_QUERY = "celestia.blob.v1.Query"
+MINFEE_QUERY = "celestia.minfee.v1.Query"
 
 
 class CosmosTxService:
@@ -86,35 +97,153 @@ class CosmosTxService:
         return txpb.get_tx_response_pb(resp)
 
 
+class QueryServices:
+    """The bootstrap query surface (one instance serves all five services).
+    Reads go through the app's keepers under the shared lock, mirroring the
+    HTTP QueryRouter's accessors (chain/query.py)."""
+
+    def __init__(self, node, lock: threading.Lock):
+        self.node = node
+        self.lock = lock
+
+    def _ctx(self):
+        from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+
+        app = self.node.app
+        return Context(app.store, InfiniteGasMeter(), app.height, 0.0,
+                       app.chain_id, app.app_version)
+
+    # -- cosmos.base.tendermint.v1beta1.Service -------------------------
+
+    def get_latest_block(self, request: bytes, context) -> bytes:
+        with self.lock:
+            app = self.node.app
+            return txpb.get_latest_block_response_pb(
+                app.chain_id, app.height, app.app_version
+            )
+
+    # -- cosmos.base.node.v1beta1.Service -------------------------------
+
+    def config(self, request: bytes, context) -> bytes:
+        from celestia_app_tpu import appconsts
+
+        price = getattr(self.node.app, "min_gas_price",
+                        appconsts.DEFAULT_MIN_GAS_PRICE)
+        return txpb.node_config_response_pb(
+            f"{price:.18f}{appconsts.BOND_DENOM}"
+        )
+
+    # -- cosmos.auth.v1beta1.Query --------------------------------------
+
+    def account(self, request: bytes, context) -> bytes:
+        addr_str = txpb.parse_query_account_request(request)
+        try:
+            addr = bech32.decode(addr_str, bech32.HRP_ACCOUNT)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        with self.lock:
+            acc = self.node.app.auth.account(self._ctx(), addr)
+        if acc is None:
+            # the reference returns NotFound for unknown accounts and
+            # SetupTxClient skips them (tx_client.go:176-180)
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"account {addr_str} not found")
+        pub = bytes.fromhex(acc["pubkey"]) if acc.get("pubkey") else None
+        base = txpb.base_account_pb(addr_str, pub, acc["number"], acc["sequence"])
+        return txpb.query_account_response_pb(base)
+
+    # -- cosmos.bank.v1beta1.Query --------------------------------------
+
+    def balance(self, request: bytes, context) -> bytes:
+        from celestia_app_tpu import appconsts
+
+        addr_str, denom = txpb.parse_query_balance_request(request)
+        try:
+            addr = bech32.decode(addr_str, bech32.HRP_ACCOUNT)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        with self.lock:
+            amount = self.node.app.bank.balance(self._ctx(), addr)
+        return txpb.query_balance_response_pb(
+            denom or appconsts.BOND_DENOM, amount
+        )
+
+    # -- cosmos.params.v1beta1.Query ------------------------------------
+
+    def subspace_params(self, request: bytes, context) -> bytes:
+        import json
+
+        subspace, key = txpb.parse_query_subspace_params_request(request)
+        if subspace == "minfee" and key == "NetworkMinGasPrice":
+            if self.node.app.app_version < 2:
+                # v1 has no minfee subspace; the reference surfaces exactly
+                # this error string, which QueryMinimumGasPrice matches on
+                # to fall back to the local price (tx_client.go:580)
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              "unknown subspace: minfee")
+            with self.lock:
+                price = self.node.app.minfee.network_min_gas_price(self._ctx())
+            return txpb.query_subspace_params_response_pb(
+                subspace, key, json.dumps(f"{price:.18f}")
+            )
+        context.abort(grpc.StatusCode.NOT_FOUND,
+                      f"unknown subspace: {subspace}")
+
+    # -- celestia.blob.v1.Query -----------------------------------------
+
+    def blob_params(self, request: bytes, context) -> bytes:
+        with self.lock:
+            p = self.node.app.blob.params(self._ctx())
+        return txpb.blob_params_response_pb(
+            p["gas_per_blob_byte"], p["gov_max_square_size"]
+        )
+
+    # -- celestia.minfee.v1.Query ---------------------------------------
+
+    def network_min_gas_price(self, request: bytes, context) -> bytes:
+        if self.node.app.app_version < 2:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          "minfee is a v2+ module")
+        with self.lock:
+            price = self.node.app.minfee.network_min_gas_price(self._ctx())
+        return txpb.minfee_response_pb(price)
+
+
 def _identity(x: bytes) -> bytes:
     return x
+
+
+def _handler(fn):
+    return grpc.unary_unary_rpc_method_handler(
+        fn, request_deserializer=_identity, response_serializer=_identity
+    )
 
 
 class GrpcTxServer:
     def __init__(self, node, host: str = "127.0.0.1", port: int = 9090,
                  lock: threading.Lock | None = None):
         self.service = CosmosTxService(node, lock)
-        handlers = {
-            "BroadcastTx": grpc.unary_unary_rpc_method_handler(
-                self.service.broadcast_tx,
-                request_deserializer=_identity,
-                response_serializer=_identity,
-            ),
-            "Simulate": grpc.unary_unary_rpc_method_handler(
-                self.service.simulate,
-                request_deserializer=_identity,
-                response_serializer=_identity,
-            ),
-            "GetTx": grpc.unary_unary_rpc_method_handler(
-                self.service.get_tx,
-                request_deserializer=_identity,
-                response_serializer=_identity,
-            ),
+        self.queries = QueryServices(node, self.service.lock)
+        q = self.queries
+        services = {
+            SERVICE: {
+                "BroadcastTx": _handler(self.service.broadcast_tx),
+                "Simulate": _handler(self.service.simulate),
+                "GetTx": _handler(self.service.get_tx),
+            },
+            TM_SERVICE: {"GetLatestBlock": _handler(q.get_latest_block)},
+            NODE_SERVICE: {"Config": _handler(q.config)},
+            AUTH_QUERY: {"Account": _handler(q.account)},
+            BANK_QUERY: {"Balance": _handler(q.balance)},
+            PARAMS_QUERY: {"Params": _handler(q.subspace_params)},
+            BLOB_QUERY: {"Params": _handler(q.blob_params)},
+            MINFEE_QUERY: {"NetworkMinGasPrice": _handler(q.network_min_gas_price)},
         }
         self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
-        self.server.add_generic_rpc_handlers(
-            (grpc.method_handlers_generic_handler(SERVICE, handlers),)
-        )
+        self.server.add_generic_rpc_handlers(tuple(
+            grpc.method_handlers_generic_handler(name, handlers)
+            for name, handlers in services.items()
+        ))
         self.port = self.server.add_insecure_port(f"{host}:{port}")
         if self.port == 0:
             # add_insecure_port returns 0 on bind FAILURE (port taken);

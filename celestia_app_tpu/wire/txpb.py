@@ -71,10 +71,14 @@ def _addr_str(addr20: bytes) -> str:
 def _addr_bytes(s: str) -> bytes:
     if not s:
         return b""
-    # accept either HRP: celestia-app treats valoper/account bech32 as the
-    # same 20 underlying bytes for its own operator keys
+    # accept exactly the two chain HRPs (valoper/account share the same 20
+    # underlying bytes for operator keys); any foreign prefix — however
+    # valid its checksum — is rejected at decode, as the reference's
+    # sdk.AccAddressFromBech32 rejects non-celestia address strings
     pos = s.rfind("1")
     hrp = s[:pos] if pos > 0 else bech32.HRP_ACCOUNT
+    if hrp not in (bech32.HRP_ACCOUNT, bech32.HRP_VALOPER):
+        raise ValueError(f"unsupported bech32 prefix {hrp!r}")
     return bech32.decode(s, hrp)
 
 
@@ -667,3 +671,190 @@ def parse_index_wrapper(raw: bytes) -> tuple[bytes, list[int]]:
     if f.get_string(3) != INDEX_WRAPPER_TYPE_ID:
         raise ValueError("not a protobuf IndexWrapper (bad type_id)")
     return f.get_bytes(1), f.repeated_uint(2)
+
+
+# ---------------------------------------------------------------------------
+# gRPC query services for the client bootstrap surface: the reference's
+# SetupTxClient populates chain-id / account number / sequence / min gas
+# price over exactly these five RPCs (pkg/user/tx_client.go:147-198,
+# account.go:59-80, tx_client.go:561-610) before a single tx is signed.
+# Hand-rolled wire messages, same style as the tx service above.
+# ---------------------------------------------------------------------------
+
+BASE_ACCOUNT_TYPE_URL = "/cosmos.auth.v1beta1.BaseAccount"
+DEC_SCALE = 10**18  # cosmos sdk.Dec wire form: value*10^18 as an integer str
+
+
+def dec_pb_str(value: float) -> str:
+    return str(int(round(value * DEC_SCALE)))
+
+
+def parse_dec_str(s: str) -> float:
+    return int(s) / DEC_SCALE if s else 0.0
+
+
+# -- cosmos.auth.v1beta1.Query/Account --------------------------------------
+
+
+def query_account_request_pb(address: str) -> bytes:
+    return field_string(1, address)
+
+
+def parse_query_account_request(raw: bytes) -> str:
+    return Fields(raw).get_string(1)
+
+
+def base_account_pb(
+    address: str, pubkey33: bytes | None, account_number: int, sequence: int
+) -> bytes:
+    out = field_string(1, address)
+    if pubkey33:
+        out += field_message(
+            2, any_pb("/cosmos.crypto.secp256k1.PubKey", field_bytes(1, pubkey33))
+        )
+    out += field_varint(3, account_number) + field_varint(4, sequence)
+    return out
+
+
+def query_account_response_pb(base_account: bytes) -> bytes:
+    return field_message(
+        1, any_pb(BASE_ACCOUNT_TYPE_URL, base_account), emit_default=True
+    )
+
+
+def parse_query_account_response(raw: bytes) -> dict:
+    """-> {address, account_number, sequence, pubkey?} (the fields
+    QueryAccount unpacks from the Any, account.go:72-79)."""
+    url, value = parse_any(Fields(raw).get_bytes(1))
+    if url != BASE_ACCOUNT_TYPE_URL:
+        raise ValueError(f"unexpected account type {url!r}")
+    f = Fields(value)
+    out = {
+        "address": f.get_string(1),
+        "account_number": f.get_int(3),
+        "sequence": f.get_int(4),
+    }
+    any_raw = f.get_bytes(2)
+    if any_raw:
+        _, pk_value = parse_any(any_raw)
+        out["pubkey"] = Fields(pk_value).get_bytes(1)
+    return out
+
+
+# -- cosmos.bank.v1beta1.Query/Balance --------------------------------------
+
+
+def query_balance_request_pb(address: str, denom: str) -> bytes:
+    return field_string(1, address) + field_string(2, denom)
+
+
+def parse_query_balance_request(raw: bytes) -> tuple[str, str]:
+    f = Fields(raw)
+    return f.get_string(1), f.get_string(2)
+
+
+def query_balance_response_pb(denom: str, amount: int) -> bytes:
+    return field_message(1, coin_pb(denom, amount), emit_default=True)
+
+
+def parse_query_balance_response(raw: bytes) -> tuple[str, int]:
+    return parse_coin(Fields(raw).get_bytes(1))
+
+
+# -- cosmos.base.tendermint.v1beta1.Service/GetLatestBlock -------------------
+# SetupTxClient reads SdkBlock.Header.{ChainID, Version.App}
+# (tx_client.go:154-162); Height rides along for status-style callers.
+
+
+def get_latest_block_response_pb(
+    chain_id: str, height: int, app_version: int
+) -> bytes:
+    header = (
+        field_message(1, field_varint(2, app_version))  # Consensus.app
+        + field_string(2, chain_id)
+        + field_varint(3, height)
+    )
+    sdk_block = field_message(1, header)
+    return field_message(3, sdk_block, emit_default=True)
+
+
+def parse_get_latest_block_response(raw: bytes) -> dict:
+    header = Fields(Fields(Fields(raw).get_bytes(3)).get_bytes(1))
+    version = Fields(header.get_bytes(1))
+    return {
+        "chain_id": header.get_string(2),
+        "height": header.get_int(3),
+        "app_version": version.get_int(2),
+    }
+
+
+# -- cosmos.base.node.v1beta1.Service/Config ---------------------------------
+# local (operator-set) min gas price as a DecCoins string, e.g. "0.002utia"
+# (tx_client.go:564-573 parses it with ParseDecCoins)
+
+
+def node_config_response_pb(minimum_gas_price: str) -> bytes:
+    return field_string(1, minimum_gas_price)
+
+
+def parse_node_config_response(raw: bytes) -> str:
+    return Fields(raw).get_string(1)
+
+
+# -- cosmos.params.v1beta1.Query/Params (subspace queries) -------------------
+# QueryNetworkMinGasPrice falls back through this generic params route with
+# subspace "minfee" (tx_client.go:593-610); the param VALUE is the JSON
+# encoding of the param (a quoted decimal string for the min gas price).
+
+
+def query_subspace_params_request_pb(subspace: str, key: str) -> bytes:
+    return field_string(1, subspace) + field_string(2, key)
+
+
+def parse_query_subspace_params_request(raw: bytes) -> tuple[str, str]:
+    f = Fields(raw)
+    return f.get_string(1), f.get_string(2)
+
+
+def query_subspace_params_response_pb(subspace: str, key: str, value: str) -> bytes:
+    change = (
+        field_string(1, subspace) + field_string(2, key) + field_string(3, value)
+    )
+    return field_message(1, change, emit_default=True)
+
+
+def parse_query_subspace_params_response(raw: bytes) -> dict:
+    f = Fields(Fields(raw).get_bytes(1))
+    return {
+        "subspace": f.get_string(1),
+        "key": f.get_string(2),
+        "value": f.get_string(3),
+    }
+
+
+# -- celestia.blob.v1.Query/Params -------------------------------------------
+
+
+def blob_params_response_pb(gas_per_blob_byte: int, gov_max_square_size: int) -> bytes:
+    params = field_varint(1, gas_per_blob_byte) + field_varint(2, gov_max_square_size)
+    return field_message(1, params, emit_default=True)
+
+
+def parse_blob_params_response(raw: bytes) -> dict:
+    f = Fields(Fields(raw).get_bytes(1))
+    return {
+        "gas_per_blob_byte": f.get_int(1),
+        "gov_max_square_size": f.get_int(2),
+    }
+
+
+# -- celestia.minfee.v1.Query/NetworkMinGasPrice -----------------------------
+# response field 1 is a cosmos.Dec (proto/celestia/minfee/v1/query.proto:23)
+
+
+def minfee_response_pb(network_min_gas_price: float) -> bytes:
+    return field_string(1, dec_pb_str(network_min_gas_price))
+
+
+def parse_minfee_response(raw: bytes) -> float:
+    return parse_dec_str(Fields(raw).get_string(1))

@@ -24,7 +24,11 @@ def _genesis(privs):
             for p in privs
         ],
         "validators": [
-            {"operator": p.public_key().address().hex(), "power": 10}
+            {
+                "operator": p.public_key().address().hex(),
+                "power": 10,
+                "pubkey": p.public_key().compressed.hex(),
+            }
             for p in privs
         ],
     }
@@ -85,6 +89,36 @@ def test_commit_certificate_verifies_and_rejects_forgery(tmp_path):
         cert.height, cert.block_hash, (cert.votes[0],) * 3
     )
     assert not one.verify(CHAIN, validators, 30, powers)
+
+
+def test_forged_presence_vote_cannot_suppress_absence(tmp_path):
+    """ADVICE r3: a certificate padded with a junk-signature vote for an
+    offline validator must still mark that validator absent — presence
+    requires a VERIFIED precommit, exactly like cert.verify's counting."""
+    net, signer, privs = _network(tmp_path, with_disk=False)
+    proposer = net.proposer_for(1)
+    block = proposer.propose(t=1_700_000_010.0)
+    bh = block.header.hash()
+    # two honest votes + one forged "presence" vote for the third validator
+    honest = [n.vote_on(block) for n in net.nodes[:2]]
+    offline = net.nodes[2]
+    forged = consensus.Vote(
+        block.header.height, bh, offline.address, b"\x00" * 64
+    )
+    cert = consensus.CommitCertificate(
+        block.header.height, bh, tuple(honest) + (forged,)
+    )
+    node = net.nodes[0]
+    node.apply(block, cert)
+    # the absent set is consumed by BeginBlock liveness accounting, so the
+    # durable observable is the slashing missed-counter
+    from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+
+    ctx = Context(node.app.store, InfiniteGasMeter(), node.app.height, 0,
+                  node.app.chain_id, node.app.app_version)
+    assert node.app.slashing.info(ctx, offline.address)["missed"] == 1
+    assert node.app.slashing.info(ctx, net.nodes[0].address)["missed"] == 0
+    assert node.app.slashing.info(ctx, net.nodes[1].address)["missed"] == 0
 
 
 def test_bad_proposal_fails_to_reach_quorum(tmp_path):

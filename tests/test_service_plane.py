@@ -649,3 +649,61 @@ def test_grpc_service_rejects_bad_inputs(tmp_path):
         assert exc.value.code() == grpc_mod.StatusCode.INVALID_ARGUMENT
     finally:
         server.stop()
+
+
+def test_grpc_bootstrap_and_pfb_submit(tmp_path):
+    """VERDICT r3 #3 done-criterion: a TxClient bootstraps chain-id,
+    account number/sequence, and min gas price over gRPC ALONE
+    (SetupTxClient, pkg/user/tx_client.go:147-198) and submits a PFB
+    end-to-end on the same channel."""
+    import threading
+
+    import numpy as np
+
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.client.tx_client import setup_tx_client_grpc
+    from celestia_app_tpu.da.blob import Blob
+    from celestia_app_tpu.da.namespace import Namespace
+    from celestia_app_tpu.service.grpc_server import GrpcTxServer
+    from celestia_app_tpu.wire import bech32
+
+    app, signer, privs = _persistent_app(tmp_path)
+    node = Node(app)
+    node.produce_block(t=1_700_000_500.0)  # height 1 for GetLatestBlock
+    server = GrpcTxServer(node, port=0)
+    try:
+        # an extra key with no account in state must be skipped, as the
+        # reference skips keyring records absent from state
+        ghost = PrivateKey.from_seed(b"\xAA" * 4)
+        client = setup_tx_client_grpc(
+            f"127.0.0.1:{server.port}", [privs[0], privs[1], ghost]
+        )
+        # chain-id and accounts came from the wire, not local config
+        assert client.signer.chain_id == CHAIN
+        assert len(client.signer.accounts) == 2
+        a0 = privs[0].public_key().address()
+        acc = client.signer.accounts[a0]
+        assert (acc.number, acc.sequence) == (0, 0)
+        assert ghost.public_key().address() not in client.signer.accounts
+        # min gas price came from node Config / minfee params
+        assert client.default_gas_price and client.default_gas_price > 0
+        # bank balance is queryable over the same channel
+        assert client.node.query_balance(bech32.encode(a0)) == 10**12
+        assert client.node.blob_params()["gov_max_square_size"] > 0
+
+        # submit a PFB: broadcast over gRPC, commit mid-confirm, confirm
+        rng = np.random.default_rng(5)
+        blobs = [Blob(Namespace.v0(b"grpcb"),
+                      rng.integers(0, 256, 700, dtype=np.uint8).tobytes())]
+        timer = threading.Timer(
+            0.4, lambda: node.produce_block(t=1_700_000_600.0)
+        )
+        timer.start()
+        try:
+            conf = client.submit_pay_for_blob(a0, blobs)
+        finally:
+            timer.cancel()
+        assert conf["found"] is True and conf["height"] == app.height
+        assert client.signer.accounts[a0].sequence == 1
+    finally:
+        server.stop()

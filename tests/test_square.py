@@ -79,6 +79,50 @@ def test_wrapped_pfb_roundtrip():
     assert iw.share_indexes == (sq.blob_start_indexes[(0, 0)],)
 
 
+def test_in_square_wrapper_is_reference_protobuf():
+    """VERDICT r3 #2 done-criterion: the PAY_FOR_BLOB_NAMESPACE shares carry
+    protobuf IndexWrappers (type_id "INDX") decodable with the byte-compat
+    codec (wire/txpb.py, cross-checked against the google.protobuf runtime
+    in tests/test_wire.py) — the bytes go-square writes in-square
+    (app/encoding/index_wrapper_decoder.go:10)."""
+    from celestia_app_tpu.wire import txpb
+
+    rng = np.random.default_rng(7)
+    pfbs = [
+        PfbEntry(b"pfb-x" * 20, (_blob(rng, 3, 900), _blob(rng, 6, 150))),
+        PfbEntry(b"pfb-y", (_blob(rng, 5, 5000),)),
+    ]
+    sq = square_mod.build([b"normal-tx"], pfbs, 64, THRESHOLD)
+    pfb_shares = sq.shares[sq.tx_shares_len : sq.tx_shares_len + sq.pfb_shares_len]
+    wrapped = shares_mod.parse_compact_shares(pfb_shares)
+    assert len(wrapped) == 2
+    for w, entry, i in zip(wrapped, sq.pfbs, range(2)):
+        tx, idxs = txpb.parse_index_wrapper(w)  # raises unless protobuf INDX
+        assert tx == entry.tx
+        assert idxs == [
+            sq.blob_start_indexes[(i, j)] for j in range(len(entry.blobs))
+        ]
+
+
+def test_reserved_padding_fills_pessimistic_gap():
+    """The compact PFB sequence is reserved at worst-case index sizing; the
+    actually-written wrappers are shorter, and the gap up to the first blob
+    is primary-reserved padding (ADR-020 pessimistic append, shares.md
+    'Primary Reserved Padding Share')."""
+    rng = np.random.default_rng(8)
+    # 28 single-blob PFBs at max square 128: reserved indexes are 3-byte
+    # varints (16384), actual ones 1-2 bytes, so the reserve crosses a
+    # share boundary the actual bytes don't
+    pfbs = [PfbEntry(b"p%02d" % i, (_blob(rng, 10 + i, 600),)) for i in range(28)]
+    sq = square_mod.build([], pfbs, 128, THRESHOLD)
+    assert sq.pfb_shares_len < sq.pfb_shares_reserved
+    first_blob = min(sq.blob_start_indexes.values())
+    gap = sq.shares[sq.tx_shares_len + sq.pfb_shares_len : first_blob]
+    assert gap, "expected a nonzero reserved-padding gap"
+    for s in gap:
+        assert s.namespace == ns_mod.PRIMARY_RESERVED_PADDING_NAMESPACE
+
+
 def test_construct_equals_build():
     """The proposer's square and every validator's reconstruction must agree
     byte for byte (the PrepareProposal/ProcessProposal consistency core)."""

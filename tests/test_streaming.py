@@ -17,6 +17,11 @@ def test_stream_roots_match_serial():
     assert streamed == serial
 
 
+def test_stream_zero_blocks_returns_empty():
+    # ADVICE r3: n_blocks=0 must not raise on the final drain
+    assert streaming.stream_blocks(lambda i: None, 0, 8) == []
+
+
 def test_bench_stream_reports_overlap():
     out = streaming.bench_stream(k=8, n_blocks=4)
     assert out["value"] > 0

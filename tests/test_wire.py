@@ -134,6 +134,18 @@ def test_bech32_bip173_vectors():
     assert bech32.decode(val, bech32.HRP_VALOPER) == ADDR
 
 
+def test_foreign_hrp_address_rejected_at_decode():
+    """ADVICE r3: a checksum-valid bech32 string with a NON-celestia prefix
+    (e.g. cosmos1...) must be rejected by the msg codecs, as the reference's
+    sdk.AccAddressFromBech32 rejects foreign-HRP strings."""
+    cosmos_addr = bech32.encode(ADDR, "cosmos")
+    with pytest.raises(ValueError, match="prefix"):
+        txpb._addr_bytes(cosmos_addr)
+    # both chain HRPs still decode to the same 20 bytes
+    assert txpb._addr_bytes(ADDR_STR) == ADDR
+    assert txpb._addr_bytes(bech32.encode(ADDR, bech32.HRP_VALOPER)) == ADDR
+
+
 def test_varint_roundtrip():
     for v in (0, 1, 127, 128, 300, 2**32 - 1, 2**63, 2**64 - 1):
         raw = encode_varint(v)
