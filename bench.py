@@ -463,6 +463,9 @@ def main() -> None:
     if "--proofs" in sys.argv:
         measure_proofs()
         return
+    if "--stream-mesh" in sys.argv:
+        measure_stream_mesh()
+        return
     if "--stream" in sys.argv:
         measure_stream()
         return
@@ -496,6 +499,16 @@ def measure_stream() -> None:
     from celestia_app_tpu.parallel import streaming
 
     print(json.dumps(streaming.bench_stream()))
+
+
+def measure_stream_mesh() -> None:
+    """BASELINE config 5: 256×256 streaming on an 8-device mesh — the
+    sharded pipeline (two all-to-alls inside) streamed with host overlap;
+    prints blocks/s. Virtual CPU devices demonstrate the same program when
+    no multi-chip hardware is attached."""
+    from celestia_app_tpu.parallel import streaming
+
+    print(json.dumps(streaming.bench_stream_mesh()))
 
 
 if __name__ == "__main__":

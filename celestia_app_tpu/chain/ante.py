@@ -1,19 +1,38 @@
 """The ante handler chain: every tx admission/execution gate, in order.
 
-Reference parity: app/ante/ante.go:15-82's 17-decorator chain, reduced to the
-decorators with observable effect in this framework (panic wrapping lives in
-the app; IBC decorators arrive with the IBC subsystem):
+Reference parity: the decorator list maps 1:1 to app/ante/ante.go:15-82's
+chain, in the reference's order. Decorators with no analog in this
+framework are documented no-ops, not silently dropped:
 
-  1. validate basic (sig present, fee sane)
-  2. msg-version gatekeeper — circuit breaker by app version
-     (app/ante/msg_gatekeeper.go)
-  3. consume tx-size gas (10 gas/byte)
-  4. fee checker: gas price >= max(network min, local min) then deduct
-     (app/ante/fee_checker.go; network floor from x/minfee)
-  5. signature verification (pubkey binding, account number, sequence)
-  6. increment sequence
-  7. blob decorators: MinGasPFBDecorator + BlobShareDecorator
-     (x/blob/ante/ante.go:15-52, blob_share_decorator.go:28-63)
+   1. HandlePanicDecorator        — lives in the app shell (ProcessProposal
+                                    catches all panics; deliver wraps)
+   2. MsgVersioningGateKeeper     — step (2) below
+   3. SetUpContextDecorator       — Context/GasMeter creation by the caller
+   4. ExtensionOptionsDecorator   — NO-OP: the tx codecs cannot express
+                                    extension options, so none can arrive
+   5. ValidateBasicDecorator      — step (1)
+   6. TxTimeoutHeightDecorator    — step (1), timeout_height
+   7. ValidateMemoDecorator       — step (1b), max 256 memo chars
+   8. ConsumeGasForTxSizeDecorator— step (3), 10 gas/byte
+   9. DeductFeeDecorator          — step (4): gas-price floor (network min
+                                    from x/minfee at v2+) + feegrant-aware
+                                    deduction (app/ante/fee_checker.go)
+  10. SetPubKeyDecorator          — step (5), set_pubkey on first use
+  11. ValidateSigCountDecorator   — step (5a), sig count <= 7 (sdk default;
+                                    this framework's txs carry exactly one)
+  12. SigGasConsumeDecorator      — step (5b), 1000 gas per secp256k1 sig
+  13. SigVerificationDecorator    — step (5c), sign-doc binding + sequence
+  14. MinGasPFBDecorator          — step (7) (x/blob/ante/ante.go:15-52)
+  15. MaxTotalBlobSizeDecorator   — step (7a), v1 + CheckTx only
+                                    (x/blob/ante/max_total_blob_size_ante.go)
+  16. BlobShareDecorator          — step (7b), v2+ (blob_share_decorator.go)
+  17. GovProposalDecorator        — step (8), proposal must carry >= 1
+                                    change (app/ante/gov.go)
+  18. IncrementSequenceDecorator  — step (6)
+  19. RedundantRelayDecorator     — step (9), CheckTx only: a relay tx whose
+                                    every packet msg is already processed is
+                                    rejected before it wastes block space
+                                    (ibc-go core/ante)
 """
 
 from __future__ import annotations
@@ -49,6 +68,11 @@ from celestia_app_tpu.da import shares as shares_mod
 
 class AnteError(Exception):
     pass
+
+
+MAX_MEMO_CHARACTERS = 256  # sdk auth params default (ValidateMemoDecorator)
+TX_SIG_LIMIT = 7  # sdk auth params default (ValidateSigCountDecorator)
+SIG_VERIFY_COST_SECP256K1 = 1000  # DefaultSigVerificationGasConsumer
 
 
 # Msg acceptance by app version (app/module configurator GetAcceptedMessages:
@@ -114,6 +138,7 @@ class AnteHandler:
     minfee: modules.MinFeeKeeper
     min_gas_price: float = appconsts.DEFAULT_MIN_GAS_PRICE
     feegrant: object | None = None  # FeeGrantKeeper when enabled
+    ibc: object | None = None  # IBCStack for the redundant-relay decorator
 
     def __post_init__(self):
         # node-local floor, parsed once (it is fixed for the handler's life)
@@ -135,6 +160,11 @@ class AnteHandler:
             raise AnteError(f"wrong chain id {body.chain_id!r}")
         if body.timeout_height and ctx.height > body.timeout_height:
             raise AnteError("tx timed out")
+        # 1b. memo length (ValidateMemoDecorator, sdk MaxMemoCharacters)
+        if len(body.memo) > MAX_MEMO_CHARACTERS:
+            raise AnteError(
+                f"memo length {len(body.memo)} exceeds {MAX_MEMO_CHARACTERS}"
+            )
 
         # 2. version gatekeeper (circuit breaker). Walks authz-nested
         # messages too — the reference's MsgVersioningGateKeeper inspects
@@ -208,7 +238,19 @@ class AnteHandler:
             except ValueError as e:
                 raise AnteError(f"cannot pay fee: {e}") from None
 
-        # 5. signature verification
+        # 5a. sig count bound (ValidateSigCountDecorator): tx codecs carry
+        # exactly one signature, kept under the sdk limit of 7
+        sig_count = 1
+        if sig_count > TX_SIG_LIMIT:
+            raise AnteError("too many signatures")
+        # 5b. signature-verification gas (SigGasConsumeDecorator): charged
+        # in simulate mode too — the sdk simulates with a stand-in sig so
+        # gas estimates cover the real verification cost
+        ctx.gas_meter.consume(
+            sig_count * SIG_VERIFY_COST_SECP256K1, "sig verification"
+        )
+
+        # 5c. signature verification
         if not simulate:
             if PublicKey(tx.pubkey).address() != signer:
                 raise AnteError("pubkey does not match signer address")
@@ -230,13 +272,72 @@ class AnteHandler:
                 raise AnteError("signature verification failed")
             self.auth.set_pubkey(ctx, signer, tx.pubkey)
 
-            # 6. sequence increment
-            self.auth.increment_sequence(ctx, signer)
-
         # 7. blob decorators
         for m in body.msgs:
             if isinstance(m, MsgPayForBlobs):
                 self._check_pfb(ctx, m, body)
+
+        # 8. gov proposal decorator (app/ante/gov.go): a MsgSubmitProposal
+        # with zero proposal messages is dead weight — reject at admission
+        import json as _json
+
+        for m in body.msgs:
+            if isinstance(m, MsgSubmitProposal):
+                try:
+                    changes = _json.loads(m.changes_json)
+                except ValueError:
+                    # the reference's decorator only counts messages; payload
+                    # VALIDITY is delivery's concern (a malformed proposal
+                    # fails its own tx there, never the chain)
+                    continue
+                if isinstance(changes, list) and not changes:
+                    raise AnteError(
+                        "must include at least one message in proposal"
+                    )
+
+        if not simulate:
+            # 6/18. sequence increment (IncrementSequenceDecorator sits
+            # near the end of the reference chain, after all validity gates)
+            self.auth.increment_sequence(ctx, signer)
+
+        # 9. redundant-relay decorator (ibc-go core/ante, CheckTx only): a
+        # tx made ENTIRELY of already-processed relay msgs burns mempool
+        # and block space with no effect — drop it at admission. Deliver
+        # keeps the keeper-level no-op semantics (racing relayers are
+        # normal; only the mempool gate rejects).
+        if ctx.is_check_tx and self.ibc is not None:
+            self._check_redundant_relay(ctx, body)
+
+    def _check_redundant_relay(self, ctx: Context, body) -> None:
+        relay_msgs = [
+            m for m in body.msgs
+            if isinstance(m, (MsgRecvPacket, MsgAcknowledgePacket,
+                              MsgTimeoutPacket))
+        ]
+        if not relay_msgs or len(relay_msgs) != len(body.msgs):
+            return  # mixed or non-relay txs pass through (ibc-go semantics)
+        import json as _json
+
+        channels = self.ibc.channels
+        for m in relay_msgs:
+            try:
+                packet = _json.loads(m.packet_json)
+                if isinstance(m, MsgRecvPacket):
+                    if channels.get_ack(ctx, packet) is None:
+                        return  # at least one msg still does work
+                else:
+                    # ack/timeout settle OUR commitment; absence == settled
+                    key = channels.COMMIT + (
+                        f"{packet['source_port']}/{packet['source_channel']}/"
+                        f"{packet['sequence']}".encode()
+                    )
+                    if ctx.store.get(key) is not None:
+                        return
+            except (ValueError, KeyError, TypeError, AttributeError):
+                # malformed packet: not redundant — the relay handler will
+                # fail THIS tx with a real error message downstream
+                return
+        raise AnteError("redundant relay: every packet msg already processed")
 
     def _signer(self, body) -> bytes:
         addrs = {msg_signer(m) for m in body.msgs}
@@ -253,11 +354,30 @@ class AnteHandler:
             raise AnteError(
                 f"gas limit {body.gas_limit} below blob gas requirement {needed}"
             )
-        # BlobShareDecorator: blobs must fit the governed square
         max_sq = min(
             params["gov_max_square_size"],
             appconsts.square_size_upper_bound(ctx.app_version),
         )
+        if ctx.app_version == 1:
+            # MaxTotalBlobSizeDecorator (v1 + CheckTx only, max_total_blob_
+            # size_ante.go:26-33): total blob BYTES must fit the bytes
+            # available to sparse shares in the max square, less the one
+            # share the PFB tx itself occupies
+            if ctx.is_check_tx:
+                blob_shares = max_sq * max_sq - 1
+                available = (
+                    appconsts.FIRST_SPARSE_SHARE_CONTENT_SIZE
+                    + (blob_shares - 1)
+                    * appconsts.CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+                )
+                total = sum(msg.blob_sizes)
+                if total > available:
+                    raise AnteError(
+                        f"total blob size {total} exceeds max {available}"
+                    )
+            return
+        # BlobShareDecorator (v2+, blob_share_decorator.go:28-63): blobs
+        # must fit the governed square, counted in shares
         total_shares = sum(
             shares_mod.sparse_shares_needed(s) for s in msg.blob_sizes
         )

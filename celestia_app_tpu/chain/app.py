@@ -264,7 +264,7 @@ class App:
         self.module_manager = mm
         self.ante = ante_mod.AnteHandler(
             self.auth, self.bank, self.blob, self.minfee, min_gas_price,
-            feegrant=self.feegrant,
+            feegrant=self.feegrant, ibc=self.ibc,
         )
         # committed-state snapshots for load_height rollback (app/app.go:592);
         # when a ChainDB is attached the window lives on disk instead

@@ -46,14 +46,18 @@ class Vote:
     block_hash: bytes | None  # None = nil vote (proposal rejected)
     validator: bytes  # 20-byte operator address
     signature: bytes
+    phase: str = "precommit"  # "prevote" | "precommit" (Tendermint steps)
 
     @staticmethod
-    def sign_bytes(chain_id: str, height: int, block_hash: bytes | None) -> bytes:
+    def sign_bytes(
+        chain_id: str, height: int, block_hash: bytes | None,
+        phase: str = "precommit",
+    ) -> bytes:
         doc = {
             "chain_id": chain_id,
             "height": height,
             "block_hash": block_hash.hex() if block_hash else None,
-            "type": "precommit",
+            "type": phase,
         }
         return json.dumps(doc, sort_keys=True).encode()
 
@@ -87,6 +91,110 @@ class CommitCertificate:
         return signed * 3 > total_power * 2
 
 
+# ---------------------------------------------------------------------------
+# JSON codecs for consensus types: one definition shared by the WAL record,
+# the socket wire (service/validator_server.py), and state-sync manifests —
+# divergent encodings would let a replayed WAL disagree with live peers.
+# ---------------------------------------------------------------------------
+
+
+def header_to_json(h: Header) -> dict:
+    return {
+        "chain_id": h.chain_id,
+        "height": h.height,
+        "time_unix": h.time_unix,
+        "data_hash": h.data_hash.hex(),
+        "square_size": h.square_size,
+        "app_hash": h.app_hash.hex(),
+        "proposer": h.proposer.hex(),
+        "app_version": h.app_version,
+        "last_block_hash": h.last_block_hash.hex(),
+    }
+
+
+def header_from_json(d: dict) -> Header:
+    return Header(
+        chain_id=d["chain_id"],
+        height=d["height"],
+        time_unix=d["time_unix"],
+        data_hash=bytes.fromhex(d["data_hash"]),
+        square_size=d["square_size"],
+        app_hash=bytes.fromhex(d["app_hash"]),
+        proposer=bytes.fromhex(d["proposer"]),
+        app_version=d["app_version"],
+        last_block_hash=bytes.fromhex(d["last_block_hash"]),
+    )
+
+
+def block_to_json(b: Block) -> dict:
+    import base64
+
+    return {
+        "header": header_to_json(b.header),
+        "txs": [base64.b64encode(tx).decode() for tx in b.txs],
+    }
+
+
+def block_from_json(d: dict) -> Block:
+    import base64
+
+    return Block(
+        header=header_from_json(d["header"]),
+        txs=[base64.b64decode(t) for t in d["txs"]],
+    )
+
+
+def vote_to_json(v: Vote) -> dict:
+    return {
+        "height": v.height,
+        "block_hash": v.block_hash.hex() if v.block_hash else None,
+        "validator": v.validator.hex(),
+        "signature": v.signature.hex(),
+        "phase": v.phase,
+    }
+
+
+def vote_from_json(d: dict) -> Vote:
+    return Vote(
+        d["height"],
+        bytes.fromhex(d["block_hash"]) if d["block_hash"] else None,
+        bytes.fromhex(d["validator"]),
+        bytes.fromhex(d["signature"]),
+        d.get("phase", "precommit"),
+    )
+
+
+def cert_to_json(c: CommitCertificate) -> dict:
+    return {
+        "height": c.height,
+        "block_hash": c.block_hash.hex(),
+        "votes": [vote_to_json(v) for v in c.votes],
+    }
+
+
+def cert_from_json(d: dict) -> CommitCertificate:
+    return CommitCertificate(
+        d["height"],
+        bytes.fromhex(d["block_hash"]),
+        tuple(vote_from_json(v) for v in d["votes"]),
+    )
+
+
+def evidence_to_json(ev: "DuplicateVoteEvidence") -> dict:
+    return {
+        "height": ev.height,
+        "votes": [vote_to_json(v) for v in (ev.vote_a, ev.vote_b)],
+    }
+
+
+def evidence_from_json(d: dict) -> "DuplicateVoteEvidence":
+    # pre-round-4 WAL evidence votes carried height only on the OUTER dict
+    a, b = (
+        vote_from_json({"height": d["height"], **v}) for v in d["votes"]
+    )
+    return DuplicateVoteEvidence(d["height"], a, b)
+
+
 class ValidatorNode:
     """One validator: an App + key + mempool + WAL."""
 
@@ -102,6 +210,9 @@ class ValidatorNode:
         if self.wal_dir:
             os.makedirs(self.wal_dir, exist_ok=True)
         self.certificates: dict[int, CommitCertificate] = {}
+        # lock-on-polka state (Tendermint lockedValue/lockedRound)
+        self.locked_block: Block | None = None
+        self.locked_round: int = -1
         # consensus pubkeys ride the genesis doc (Tendermint genesis
         # validators carry pub_key the same way) so a rebooted node can
         # verify WAL'd certificate votes without any peer alive
@@ -121,18 +232,79 @@ class ValidatorNode:
         return False
 
     # -- consensus steps -------------------------------------------------
+    # Two-phase Tendermint vote flow with lock-on-polka: prevote after
+    # ProcessProposal, lock when >2/3 prevote one hash (a "polka"),
+    # precommit only the locked/polka block. A validator locked at an
+    # earlier round prevotes nil on any different block, so two conflicting
+    # certificates at one height would need >1/3 byzantine power — the
+    # safety argument LocalNetwork's tests pin.
 
     def propose(self, t: float):
+        # a locked proposer must re-propose its locked block (Tendermint
+        # validValue/lockedValue rule), not build a fresh one
+        if self.locked_block is not None:
+            return self.locked_block
         prop = self.app.prepare_proposal(self.mempool, proposer=self.address, t=t)
         return prop.block
 
+    def _signed(self, height: int, bh: bytes | None, phase: str) -> Vote:
+        sig = self.priv.sign(
+            Vote.sign_bytes(self.app.chain_id, height, bh, phase)
+        )
+        return Vote(height, bh, self.address, sig, phase)
+
+    def prevote_on(self, block: Block) -> Vote:
+        """Prevote step: nil unless the proposal validates AND does not
+        conflict with an existing lock."""
+        h = block.header.height
+        bh = block.header.hash()
+        if self.locked_block is not None:
+            if self.locked_block.header.hash() == bh:
+                return self._signed(h, bh, "prevote")  # already validated
+            return self._signed(h, None, "prevote")  # locked elsewhere: nil
+        ok = self.app.process_proposal(block)
+        return self._signed(h, bh if ok else None, "prevote")
+
+    def on_polka(self, block: Block, round_: int) -> None:
+        """>2/3 prevoted this block: lock on it (lock-on-polka)."""
+        self.locked_block = block
+        self.locked_round = round_
+
+    def precommit_on(self, block: Block | None) -> Vote:
+        """Precommit the polka block, or nil when no polka was observed."""
+        if block is None:
+            height = self.app.height + 1
+            return self._signed(height, None, "precommit")
+        bh = block.header.hash()
+        return self._signed(block.header.height, bh, "precommit")
+
+    def clear_lock(self) -> None:
+        self.locked_block = None
+        self.locked_round = -1
+
     def vote_on(self, block: Block) -> Vote:
+        """One-shot validate+precommit (single-phase fixtures and tests);
+        the network path uses prevote_on/precommit_on."""
         ok = self.app.process_proposal(block)
         bh = block.header.hash() if ok else None
-        sig = self.priv.sign(
-            Vote.sign_bytes(self.app.chain_id, block.header.height, bh)
+        return self._signed(block.header.height, bh, "precommit")
+
+    def verify_certificate(self, cert: CommitCertificate) -> bool:
+        """Check a certificate against THIS node's own trust roots — the
+        genesis-known pubkeys and the staking-state powers — before
+        applying a block a remote orchestrator hands over (the socket
+        commit path must not trust the coordinator)."""
+        if not self.validator_pubkeys:
+            return False
+        ctx = Context(
+            self.app.store, InfiniteGasMeter(), self.app.height, 0,
+            self.app.chain_id, self.app.app_version,
         )
-        return Vote(block.header.height, bh, self.address, sig)
+        powers = dict(self.app.staking.validators(ctx))
+        return cert.verify(
+            self.app.chain_id, self.validator_pubkeys,
+            sum(powers.values()), powers,
+        )
 
     def _wal_path(self, height: int) -> str:
         return os.path.join(self.wal_dir, f"{height:020d}.json")
@@ -146,45 +318,12 @@ class ValidatorNode:
         the replayed app hash diverges from live peers."""
         if self.wal_dir is None:
             return
-        import base64
 
         doc = {
-            "evidence": [
-                {
-                    "height": ev.height,
-                    "votes": [
-                        {
-                            "block_hash": v.block_hash.hex(),
-                            "validator": v.validator.hex(),
-                            "signature": v.signature.hex(),
-                        }
-                        for v in (ev.vote_a, ev.vote_b)
-                    ],
-                }
-                for ev in evidence
-            ],
+            "evidence": [evidence_to_json(ev) for ev in evidence],
             "height": block.header.height,
-            "header": {
-                "chain_id": block.header.chain_id,
-                "height": block.header.height,
-                "time_unix": block.header.time_unix,
-                "data_hash": block.header.data_hash.hex(),
-                "square_size": block.header.square_size,
-                "app_hash": block.header.app_hash.hex(),
-                "proposer": block.header.proposer.hex(),
-                "app_version": block.header.app_version,
-                "last_block_hash": block.header.last_block_hash.hex(),
-            },
-            "txs": [base64.b64encode(tx).decode() for tx in block.txs],
-            "votes": [
-                {
-                    "height": v.height,
-                    "block_hash": v.block_hash.hex() if v.block_hash else None,
-                    "validator": v.validator.hex(),
-                    "signature": v.signature.hex(),
-                }
-                for v in cert.votes
-            ],
+            **block_to_json(block),
+            "votes": [vote_to_json(v) for v in cert.votes],
         }
         tmp = self._wal_path(block.header.height) + ".tmp"
         with open(tmp, "w") as f:
@@ -266,7 +405,6 @@ class ValidatorNode:
         (Tendermint replay). Returns how many blocks were replayed."""
         if self.wal_dir is None:
             return 0
-        import base64
 
         replayed = 0
         for name in sorted(os.listdir(self.wal_dir)):
@@ -277,45 +415,11 @@ class ValidatorNode:
                 continue
             with open(os.path.join(self.wal_dir, name)) as f:
                 doc = json.load(f)
-            hd = doc["header"]
-            block = Block(
-                header=Header(
-                    chain_id=hd["chain_id"],
-                    height=hd["height"],
-                    time_unix=hd["time_unix"],
-                    data_hash=bytes.fromhex(hd["data_hash"]),
-                    square_size=hd["square_size"],
-                    app_hash=bytes.fromhex(hd["app_hash"]),
-                    proposer=bytes.fromhex(hd["proposer"]),
-                    app_version=hd["app_version"],
-                    last_block_hash=bytes.fromhex(hd["last_block_hash"]),
-                ),
-                txs=[base64.b64decode(t) for t in doc["txs"]],
-            )
-            votes = tuple(
-                Vote(
-                    v["height"],
-                    bytes.fromhex(v["block_hash"]) if v["block_hash"] else None,
-                    bytes.fromhex(v["validator"]),
-                    bytes.fromhex(v["signature"]),
-                )
-                for v in doc["votes"]
-            )
+            block = block_from_json(doc)
+            votes = tuple(vote_from_json(v) for v in doc["votes"])
             cert = CommitCertificate(height, block.header.hash(), votes)
             evidence = tuple(
-                DuplicateVoteEvidence(
-                    e["height"],
-                    *[
-                        Vote(
-                            e["height"],
-                            bytes.fromhex(v["block_hash"]),
-                            bytes.fromhex(v["validator"]),
-                            bytes.fromhex(v["signature"]),
-                        )
-                        for v in e["votes"]
-                    ],
-                )
-                for e in doc.get("evidence", [])
+                evidence_from_json(e) for e in doc.get("evidence", [])
             )
             self._apply_evidence(evidence)
             # reconstruct the LastCommitInfo absences from the WAL's cert so
@@ -410,13 +514,18 @@ class DuplicateVoteEvidence:
             return False  # both votes must be AT the evidence height
         if a.block_hash == b.block_hash:
             return False  # same block: not equivocation
+        if a.phase != b.phase:
+            # prevote(A)+precommit(B) across rounds is a legal Tendermint
+            # history (unlock via a later polka); only duplicate votes in
+            # the SAME step are slashable
+            return False
         pub = PublicKey(pubkey)
         if pub.address() != a.validator:
             return False
         return pub.verify(
-            a.signature, Vote.sign_bytes(chain_id, a.height, a.block_hash)
+            a.signature, Vote.sign_bytes(chain_id, a.height, a.block_hash, a.phase)
         ) and pub.verify(
-            b.signature, Vote.sign_bytes(chain_id, b.height, b.block_hash)
+            b.signature, Vote.sign_bytes(chain_id, b.height, b.block_hash, b.phase)
         )
 
 
@@ -426,14 +535,23 @@ def detect_equivocation(
 ) -> list[DuplicateVoteEvidence]:
     """Scan one height's votes (across rounds) for validators that signed
     two different block hashes; returns verified evidence only."""
-    seen: dict[tuple[bytes, int], Vote] = {}  # (validator, height) -> vote
+    # PRECOMMITS only. Votes carry no round number, and prevoting different
+    # blocks in different ROUNDS is legal Tendermint behavior (a failed
+    # round rotates to a fresh proposal every honest validator prevotes) —
+    # pooling prevotes would convict honest validators. Precommits are
+    # polka-gated: without >1/3 byzantine power, one validator can never
+    # honestly precommit two blocks at one height, so a duplicate precommit
+    # IS the classic slashable double-sign.
+    seen: dict[tuple[bytes, int, str], Vote] = {}
     out: list[DuplicateVoteEvidence] = []
     accused: set[bytes] = set()
     for votes in votes_by_round:
         for v in votes:
             if v.block_hash is None or v.validator in accused:
                 continue
-            key = (v.validator, v.height)
+            if v.phase != "precommit":
+                continue
+            key = (v.validator, v.height, v.phase)
             prior = seen.get(key)
             if prior is None:
                 seen[key] = v
@@ -476,33 +594,87 @@ class LocalNetwork:
                       app.chain_id, app.app_version)
         return dict(app.staking.validators(ctx))
 
-    def broadcast_tx(self, raw: bytes) -> bool:
-        """Gossip: every node's mempool sees the tx (first node's CheckTx
-        verdict is authoritative for the caller)."""
-        results = [n.add_tx(raw) for n in self.nodes]
-        return results[0]
+    def broadcast_tx(self, raw: bytes, via: int = 0) -> bool:
+        """Gossip: every node runs CheckTx on the tx independently (the
+        Tendermint model — mempools can disagree). The caller's verdict is
+        the verdict of the node it submitted through (`via`), exactly as a
+        client sees only its own node's CheckTx; `broadcast_tx_all` exposes
+        the full per-node picture for tests and the devnet monitor."""
+        return self.broadcast_tx_all(raw)[via]
+
+    def broadcast_tx_all(self, raw: bytes) -> list[bool]:
+        return [n.add_tx(raw) for n in self.nodes]
 
     def proposer_for(self, height: int, round_: int = 0) -> ValidatorNode:
         return self.nodes[(height + round_) % len(self.nodes)]
 
-    def produce_height(self, t: float) -> tuple[Block | None, CommitCertificate | None]:
-        """One consensus round. Returns (block, certificate) on commit, or
-        (None, None) when the proposal failed to reach >2/3 — the round
-        counter then advances, so the NEXT call rotates past a faulty
-        proposer instead of retrying it forever (Tendermint round schedule)."""
+    def produce_height(
+        self, t: float, vote_filter=None,
+    ) -> tuple[Block | None, CommitCertificate | None]:
+        """One consensus round, two vote phases (Tendermint):
+
+        propose → prevote → [polka? lock] → precommit → [>2/3? commit].
+
+        A "polka" (>2/3 prevote power on one hash) locks every validator
+        that observed it onto the block; locked validators prevote nil on
+        any OTHER block in later rounds and a locked proposer re-proposes
+        its lock — so a split round is SAFE (no second certificate can
+        form at the height), not merely rotated. Round timeouts are
+        schedule-driven here: a round that fails any quorum advances
+        `_round`, rotating the proposer exactly as Tendermint's timeout
+        cascade does, and locks persist across rounds.
+
+        `vote_filter(phase, votes) -> votes` (tests only) models partitions
+        and message loss by dropping votes in flight."""
         height = self.nodes[0].app.height + 1
         proposer = self.proposer_for(height, self._round)
-        block = proposer.propose(t)
-        votes = tuple(n.vote_on(block) for n in self.nodes)
-        self._vote_pool.extend(v for v in votes if v.block_hash is not None)
-        self._prune_vote_pool(height)
+        try:
+            block = proposer.propose(t)
+        except Exception:
+            # proposer crash = propose-timeout: nil round, rotate
+            self._round += 1
+            return None, None
         bh = block.header.hash()
         powers = self._powers(self.nodes[0].app)
         total = sum(powers.values())
-        cert = CommitCertificate(height, bh, votes)
         validators = {
             n.address: n.priv.public_key().compressed for n in self.nodes
         }
+
+        # -- prevote phase ----------------------------------------------
+        own_prevotes = [n.prevote_on(block) for n in self.nodes]
+        prevotes = list(own_prevotes)
+        if vote_filter is not None:
+            prevotes = list(vote_filter("prevote", prevotes))
+        # prevotes do NOT enter the evidence pool: without a round number a
+        # legal round-0-A/round-1-B prevote pair is indistinguishable from
+        # equivocation (see detect_equivocation)
+        prevote_power = sum(
+            powers.get(v.validator, 0)
+            for v in prevotes
+            if v.block_hash == bh and v.height == height
+        )
+        polka = prevote_power * 3 > total * 2
+
+        # -- precommit phase --------------------------------------------
+        # a polka locks only validators whose OWN prevote accepted the
+        # block — one that judged it invalid precommits nil regardless of
+        # what >2/3 of the others claim (Tendermint validity gate)
+        precommits = []
+        for n, pv in zip(self.nodes, own_prevotes):
+            if polka and pv.block_hash == bh:
+                n.on_polka(block, self._round)
+                precommits.append(n.precommit_on(block))
+            else:
+                precommits.append(n.precommit_on(None))
+        if vote_filter is not None:
+            precommits = list(vote_filter("precommit", precommits))
+        self._vote_pool.extend(
+            v for v in precommits if v.block_hash is not None
+        )
+        self._prune_vote_pool(height)
+
+        cert = CommitCertificate(height, bh, tuple(precommits))
         if not cert.verify(self.chain_id, validators, total, powers):
             self._round += 1
             return None, None
@@ -522,6 +694,8 @@ class LocalNetwork:
             raise AssertionError(
                 f"state divergence after height {height}: {sorted(h.hex() for h in hashes)}"
             )
+        for n in self.nodes:
+            n.clear_lock()
         return block, cert
 
     def _prune_vote_pool(self, current_height: int) -> None:
@@ -539,7 +713,8 @@ class LocalNetwork:
             raise ValueError("vote from unknown validator or nil vote")
         if not PublicKey(pub).verify(
             vote.signature,
-            Vote.sign_bytes(self.chain_id, vote.height, vote.block_hash),
+            Vote.sign_bytes(self.chain_id, vote.height, vote.block_hash,
+                            vote.phase),
         ):
             raise ValueError("vote signature verification failed")
         self._vote_pool.append(vote)

@@ -78,24 +78,52 @@ class IBCError(ValueError):
 class ClientKeeper:
     """Light-client analog: tracked counterparty state roots by height.
 
-    `update_client` is the header-submission boundary (a real tendermint
-    light client verifies commits/validator sets there; the single-process
-    node trusts the update call — the critical property preserved is that
-    PACKETS cannot be forged: every recv must prove membership against a
-    root recorded BEFORE the packet is relayed)."""
+    Two client modes at the `update_client` header-submission boundary
+    (ibc-go 02-client UpdateClient → tendermint light client semantics):
+
+    - **Verifying** (created with a trusted validator set): every update
+      must carry the counterparty's Header plus a CommitCertificate whose
+      >2/3-of-power signatures over that header's hash check out against
+      the trusted set (chain/consensus.CommitCertificate.verify). The
+      recorded root is taken FROM the verified header (its app_hash), so a
+      malicious relayer cannot forge roots — packets are then
+      trust-minimized end-to-end with the membership/absence proofs below.
+    - **Trusting** (created bare — test fixtures): roots are recorded on
+      say-so, preserving only the ordering property that every recv must
+      prove membership against a root recorded BEFORE the relay."""
 
     CONS = b"ibc/client/"
 
-    def create_client(self, ctx: Context, client_id: str) -> None:
+    def create_client(
+        self, ctx: Context, client_id: str, *,
+        chain_id: str | None = None,
+        validators: dict[bytes, bytes] | None = None,
+        powers: dict[bytes, int] | None = None,
+    ) -> None:
+        """`validators` maps 20-byte operator address -> 33-byte pubkey
+        (the trusted set a real client is initialized with); passing it
+        makes the client VERIFYING."""
         meta_key = self.CONS + client_id.encode() + b"/meta"
         if _get(ctx, meta_key) is not None:
             # re-creation would reset latest_height and let update_client
             # overwrite recorded roots — the monotonicity guard's whole point
             raise IBCError(f"client {client_id!r} already exists")
-        _put(ctx, meta_key, {"latest_height": 0})
+        meta: dict = {"latest_height": 0}
+        if validators:
+            if chain_id is None or powers is None:
+                raise IBCError(
+                    "verifying client needs chain_id + validator powers"
+                )
+            meta["chain_id"] = chain_id
+            meta["validators"] = {
+                op.hex(): pk.hex() for op, pk in validators.items()
+            }
+            meta["powers"] = {op.hex(): int(p) for op, p in powers.items()}
+        _put(ctx, meta_key, meta)
 
     def update_client(
-        self, ctx: Context, client_id: str, height: int, root: bytes
+        self, ctx: Context, client_id: str, height: int,
+        root: bytes | None = None, *, header=None, cert=None,
     ) -> None:
         meta_key = self.CONS + client_id.encode() + b"/meta"
         meta = _get(ctx, meta_key)
@@ -105,10 +133,38 @@ class ClientKeeper:
             raise IBCError(
                 f"non-monotonic client update: {height} <= {meta['latest_height']}"
             )
+        if meta.get("validators"):
+            root = self._verify_header(meta, height, header, cert)
+        elif root is None:
+            raise IBCError("trusting client update needs a root")
         _put(ctx, self.CONS + f"{client_id}/{height}".encode(),
              {"root": root.hex()})
         meta["latest_height"] = height
         _put(ctx, meta_key, meta)
+
+    @staticmethod
+    def _verify_header(meta: dict, height: int, header, cert) -> bytes:
+        """Tendermint-client checks: certificate height/hash bind the
+        submitted header, and >2/3 of the TRUSTED power signed it. Returns
+        the root to record — the header's own app_hash (the state root the
+        counterparty committed, which packet proofs verify against)."""
+        if header is None or cert is None:
+            raise IBCError("verifying client requires header + certificate")
+        if header.height != height or cert.height != height:
+            raise IBCError(
+                f"header/cert height mismatch: {header.height}/{cert.height} != {height}"
+            )
+        if cert.block_hash != header.hash():
+            raise IBCError("certificate does not cover this header")
+        validators = {
+            bytes.fromhex(k): bytes.fromhex(v)
+            for k, v in meta["validators"].items()
+        }
+        powers = {bytes.fromhex(k): v for k, v in meta["powers"].items()}
+        if not cert.verify(meta["chain_id"], validators,
+                           sum(powers.values()), powers):
+            raise IBCError("header certificate verification failed")
+        return header.app_hash
 
     def consensus_root(
         self, ctx: Context, client_id: str, height: int

@@ -1,0 +1,234 @@
+"""Socket-side consensus orchestration: the round schedule over HTTP peers.
+
+Reference parity: in celestia-core, the consensus reactor's round state
+machine drives proposals and votes across TCP to validator processes
+(SURVEY §5.8). Here the same two-phase schedule (propose → prevote →
+polka/lock → precommit → commit) that LocalNetwork runs in-process is
+driven over per-validator HTTP services (service/validator_server.py) —
+every proposal, vote, certificate, and snapshot chunk crosses a real
+socket, each validator process signs and verifies locally, and the
+orchestrator is an untrusted scheduler (nodes refuse certs that fail
+their own verification).
+
+Failure model: a dead peer (connection refused / timeout) is simply absent
+from the round — its vote is missing, its state falls behind. If >2/3 of
+power remains, heights keep committing; the returned peer catches up via
+WAL replay on restart plus `/consensus/sync` (verified state-sync) and
+rejoins the schedule.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+from celestia_app_tpu.chain import consensus as c
+
+
+class PeerDown(Exception):
+    pass
+
+
+class RemoteValidator:
+    """HTTP handle to one validator process (the reactor's peer)."""
+
+    def __init__(self, url: str, timeout: float = 60.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def _call(self, method: str, path: str, payload: dict | None = None) -> dict:
+        try:
+            if method == "GET":
+                req = urllib.request.Request(self.url + path)
+            else:
+                req = urllib.request.Request(
+                    self.url + path,
+                    data=json.dumps(payload or {}).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            body = e.read().decode(errors="replace")
+            raise ValueError(f"{path} -> {e.code}: {body[:300]}") from None
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise PeerDown(f"{self.url}{path}: {e}") from None
+
+    def status(self) -> dict:
+        return self._call("GET", "/consensus/status")
+
+    def broadcast_tx(self, raw: bytes) -> dict:
+        import base64
+
+        return self._call("POST", "/broadcast_tx",
+                          {"tx": base64.b64encode(raw).decode()})
+
+    def propose(self, t: float) -> dict:
+        return self._call("POST", "/consensus/propose", {"time": t})["block"]
+
+    def prevote(self, block_json: dict) -> c.Vote:
+        out = self._call("POST", "/consensus/prevote", {"block": block_json})
+        return c.vote_from_json(out["vote"])
+
+    def precommit(self, block_json: dict | None, polka: bool,
+                  prevotes: list[dict], round_: int) -> c.Vote:
+        out = self._call("POST", "/consensus/precommit", {
+            "block": block_json, "polka": polka,
+            "prevotes": prevotes, "round": round_,
+        })
+        return c.vote_from_json(out["vote"])
+
+    def commit(self, block_json: dict, cert: c.CommitCertificate,
+               evidence=()) -> dict:
+        return self._call("POST", "/consensus/commit", {
+            "block": block_json,
+            "cert": c.cert_to_json(cert),
+            "evidence": [c.evidence_to_json(e) for e in evidence],
+        })
+
+    def sync_from(self, peer_url: str) -> dict:
+        return self._call("POST", "/consensus/sync", {"peer": peer_url})
+
+
+class SocketNetwork:
+    """The round scheduler over RemoteValidator peers.
+
+    Validator identity (address, pubkey, power) comes from the genesis doc
+    — the same source every validator process trusts — so the orchestrator
+    can pre-verify certificates before fan-out, but final authority stays
+    with each node's own `verify_certificate`."""
+
+    def __init__(self, peers: list[RemoteValidator], genesis: dict,
+                 chain_id: str):
+        self.chain_id = chain_id
+        self.genesis = genesis
+        self.pubkeys = {
+            bytes.fromhex(v["operator"]): bytes.fromhex(v["pubkey"])
+            for v in genesis.get("validators", [])
+            if "pubkey" in v
+        }
+        self.powers = {
+            bytes.fromhex(v["operator"]): int(v["power"])
+            for v in genesis.get("validators", [])
+        }
+        # deterministic proposer rotation: peers sorted by their validator
+        # address, exactly as LocalNetwork sorts its nodes — every process
+        # self-reports the address it signs with at handshake time
+        self.peers = sorted(peers, key=lambda p: p.status()["address"])
+        self._round = 0
+        self._vote_pool: list[c.Vote] = []
+
+    EVIDENCE_MAX_AGE = 10
+
+    # -- helpers ---------------------------------------------------------
+
+    def _alive_status(self) -> list[tuple[RemoteValidator, dict]]:
+        out = []
+        for p in self.peers:
+            try:
+                out.append((p, p.status()))
+            except PeerDown:
+                continue
+        return out
+
+    def broadcast_tx(self, raw: bytes, via: int = 0) -> bool:
+        """Fan the tx to every ALIVE peer's mempool; the caller's verdict is
+        its submission node's CheckTx (the Tendermint client view)."""
+        verdicts = {}
+        for i, p in enumerate(self.peers):
+            try:
+                verdicts[i] = p.broadcast_tx(raw)["code"] == 0
+            except PeerDown:
+                verdicts[i] = False
+        return verdicts.get(via, False)
+
+    def produce_height(self, t: float):
+        """One socket-crossing consensus round. Returns (height, app_hash)
+        on commit or (None, None) on a failed round (proposer dead, no
+        polka, or <2/3 precommit power)."""
+        alive = self._alive_status()
+        if not alive:
+            self._round += 1
+            return None, None
+        height = max(st["height"] for _, st in alive) + 1
+        participants = [
+            (p, st) for p, st in alive if st["height"] == height - 1
+        ]
+        total = sum(self.powers.values())
+
+        proposer_idx = (height + self._round) % len(self.peers)
+        proposer = self.peers[proposer_idx]
+        try:
+            block_json = proposer.propose(t)
+        except (PeerDown, ValueError):
+            self._round += 1
+            return None, None
+        block = c.block_from_json(block_json)
+        bh = block.header.hash()
+
+        # prevote phase (over sockets)
+        prevotes: list[c.Vote] = []
+        for p, _st in participants:
+            try:
+                prevotes.append(p.prevote(block_json))
+            except (PeerDown, ValueError):
+                continue
+        # prevotes stay out of the evidence pool (cross-round prevotes for
+        # different blocks are legal — detect_equivocation's contract)
+        prevote_power = sum(
+            self.powers.get(v.validator, 0)
+            for v in prevotes
+            if v.block_hash == bh and v.height == height
+            and v.phase == "prevote"
+        )
+        polka = prevote_power * 3 > total * 2
+        prevote_jsons = [c.vote_to_json(v) for v in prevotes]
+
+        # precommit phase: each node re-verifies the polka locally
+        precommits: list[c.Vote] = []
+        for p, _st in participants:
+            try:
+                precommits.append(
+                    p.precommit(block_json if polka else None, polka,
+                                prevote_jsons, self._round)
+                )
+            except (PeerDown, ValueError):
+                continue
+        self._vote_pool.extend(
+            v for v in precommits if v.block_hash is not None
+        )
+        self._prune_vote_pool(height)
+
+        cert = c.CommitCertificate(height, bh, tuple(precommits))
+        if not cert.verify(self.chain_id, self.pubkeys, total, self.powers):
+            self._round += 1
+            return None, None
+        self._round = 0
+
+        evidence = tuple(c.detect_equivocation(
+            self.chain_id, [self._vote_pool], self.pubkeys
+        ))
+        if evidence:
+            punished = {ev.vote_a.validator for ev in evidence}
+            self._vote_pool = [
+                v for v in self._vote_pool if v.validator not in punished
+            ]
+
+        hashes = {}
+        for p, _st in participants:
+            try:
+                out = p.commit(block_json, cert, evidence)
+                hashes[out["app_hash"]] = out["height"]
+            except (PeerDown, ValueError):
+                continue
+        if len(hashes) != 1:
+            raise AssertionError(
+                f"state divergence at height {height}: {sorted(hashes)}"
+            )
+        return height, next(iter(hashes))
+
+    def _prune_vote_pool(self, current_height: int) -> None:
+        floor = current_height - self.EVIDENCE_MAX_AGE
+        self._vote_pool = [v for v in self._vote_pool if v.height > floor]

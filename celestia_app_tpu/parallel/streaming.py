@@ -54,6 +54,78 @@ def _synthetic_layout(k: int, seed: int) -> np.ndarray:
     return ods
 
 
+def stream_blocks_mesh(layout_fn, n_batches: int, mesh, k: int, *,
+                       pipeline=None):
+    """Mesh-sharded streaming (BASELINE cfg 5): each unit is a BATCH of
+    data-axis-many squares through the sharded pipeline
+    (parallel/sharded_eds.py) — rows split over ``seq``, blocks over
+    ``data`` — with the host laying out batch i+1 while the mesh extends
+    and commits batch i. Returns the flat list of 32-byte data roots."""
+    import jax
+
+    from celestia_app_tpu.parallel import sharded_eds
+
+    if n_batches <= 0:
+        return []
+    run = (pipeline if pipeline is not None
+           else sharded_eds.jitted_sharded_pipeline(mesh, k))
+    roots: list[bytes] = []
+    pending = None
+    for i in range(n_batches):
+        batch = layout_fn(i)  # host: lay out batch i
+        out = run(batch)  # mesh: async dispatch
+        if pending is not None:
+            roots.extend(bytes(r) for r in np.asarray(pending[3]))
+        pending = out
+    roots.extend(bytes(r) for r in np.asarray(pending[3]))
+    return roots
+
+
+def bench_stream_mesh(k: int | None = None, n_batches: int = 3,
+                      n_devices: int = 8) -> dict:
+    """Streamed blocks/s on an n-device mesh (BASELINE cfg 5's shape:
+    256×256 streaming on 8 devices). On the TPU backend this is the real
+    target; on CPU the virtual mesh demonstrates the same program."""
+    import jax
+
+    from celestia_app_tpu.parallel import mesh as mesh_mod
+
+    devices = jax.devices()
+    n_devices = min(n_devices, len(devices))
+    backend = devices[0].platform
+    if k is None:
+        k = 256 if backend == "tpu" else 32
+    mesh = mesh_mod.make_mesh(n_devices, k=k, devices=devices[:n_devices])
+    batch = mesh.shape[mesh_mod.DATA_AXIS]
+
+    from celestia_app_tpu.parallel import sharded_eds
+
+    run = sharded_eds.jitted_sharded_pipeline(mesh, k)
+
+    def layout(i: int):
+        return np.stack(
+            [_synthetic_layout(k, i * batch + j) for j in range(batch)]
+        )
+
+    warm = layout(0)
+    jax.block_until_ready(run(warm)[3])
+    t0 = time.perf_counter()
+    roots = stream_blocks_mesh(layout, n_batches, mesh, k, pipeline=run)
+    dt = time.perf_counter() - t0
+    n_blocks = n_batches * batch
+    assert len(roots) == n_blocks and len(roots[0]) == 32
+    return {
+        "metric": f"stream_mesh_blocks_per_sec_k{k}",
+        "value": round(n_blocks / dt, 3),
+        "unit": "blocks/s",
+        "backend": backend,
+        "devices": n_devices,
+        "mesh": dict(mesh.shape),
+        "blocks": n_blocks,
+        "elapsed_s": round(dt, 2),
+    }
+
+
 def bench_stream(k: int | None = None, n_blocks: int = 6) -> dict:
     """Measure streamed blocks/s vs the serial cost. ONE JSON-able dict."""
     import jax

@@ -1,0 +1,212 @@
+"""Per-validator HTTP consensus service: the socket-crossing vote plane.
+
+Reference parity: celestia-core's p2p reactors gossip proposals, votes, and
+txs between validator PROCESSES over TCP (SURVEY §5.8). This server gives
+one ValidatorNode (chain/consensus.py) that same out-of-process surface:
+every proposal, prevote, precommit, commit, and state-sync chunk crosses a
+real socket as JSON — nothing consensus-critical stays in-process. The
+devnet's `--processes` mode runs one OS process per validator around this
+server (cli.py cmd_validator_serve), with `chain/remote_consensus.py`
+driving the round schedule from outside.
+
+Trust model: the node signs votes LOCALLY and verifies every inbound
+certificate against its own genesis pubkeys + staking powers
+(`ValidatorNode.verify_certificate`) before applying — the orchestrator is
+a scheduler, not a trusted party (a forged /consensus/commit is refused).
+
+Routes (all JSON):
+  GET  /consensus/status            {name, height, app_hash, chain_id, mempool}
+  POST /broadcast_tx {tx: b64}      CheckTx + mempool admission
+  POST /consensus/propose {time}    -> {block}    (PrepareProposal or lock)
+  POST /consensus/prevote {block}   -> {vote}     (ProcessProposal inside)
+  POST /consensus/precommit {block?, polka, round} -> {vote}  (lock if polka)
+  POST /consensus/commit {block, cert, evidence} -> {app_hash}
+  POST /consensus/clear_round {}    round failed: keep locks, drop nothing
+  GET  /consensus/snapshot          {manifest, chunks: [b64]} (state sync)
+  POST /consensus/sync {peer}       pull + verify a peer's snapshot
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from celestia_app_tpu.chain import consensus as c
+
+
+class ValidatorService:
+    def __init__(self, vnode: "c.ValidatorNode", host: str = "127.0.0.1",
+                 port: int = 0):
+        self.vnode = vnode
+        self.lock = threading.Lock()
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _send(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    if self.path == "/consensus/status":
+                        with service.lock:
+                            self._send(200, service._status())
+                    elif self.path == "/consensus/snapshot":
+                        with service.lock:
+                            manifest, chunks = service.vnode.snapshot_chunks()
+                        self._send(200, {
+                            "manifest": manifest,
+                            "chunks": [
+                                base64.b64encode(ch).decode() for ch in chunks
+                            ],
+                        })
+                    else:
+                        self._send(404, {"error": f"no route {self.path}"})
+                except Exception as e:
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    route = {
+                        "/broadcast_tx": service._broadcast_tx,
+                        "/consensus/propose": service._propose,
+                        "/consensus/prevote": service._prevote,
+                        "/consensus/precommit": service._precommit,
+                        "/consensus/commit": service._commit,
+                        "/consensus/clear_round": lambda p: {},
+                        "/consensus/sync": service._sync,
+                    }.get(self.path)
+                    if route is None:
+                        self._send(404, {"error": f"no route {self.path}"})
+                        return
+                    with service.lock:
+                        self._send(200, route(payload))
+                except ValueError as e:
+                    self._send(400, {"error": str(e)})
+                except Exception as e:
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+
+    # -- handlers (under self.lock) --------------------------------------
+
+    def _status(self) -> dict:
+        v = self.vnode
+        return {
+            "name": v.name,
+            "address": v.address.hex(),
+            "chain_id": v.app.chain_id,
+            "height": v.app.height,
+            "app_hash": v.app.last_app_hash.hex(),
+            "mempool": len(v.mempool),
+            "locked": v.locked_block.header.hash().hex()
+            if v.locked_block is not None else None,
+        }
+
+    def _broadcast_tx(self, p: dict) -> dict:
+        raw = base64.b64decode(p["tx"])
+        res = self.vnode.app.check_tx(raw)
+        if res.code == 0:
+            self.vnode.mempool.append(raw)
+        return {"code": res.code, "log": res.log,
+                "gas_wanted": res.gas_wanted, "gas_used": res.gas_used}
+
+    def _propose(self, p: dict) -> dict:
+        block = self.vnode.propose(t=float(p["time"]))
+        return {"block": c.block_to_json(block)}
+
+    def _prevote(self, p: dict) -> dict:
+        block = c.block_from_json(p["block"])
+        return {"vote": c.vote_to_json(self.vnode.prevote_on(block))}
+
+    def _precommit(self, p: dict) -> dict:
+        """polka=true: the orchestrator relays the >2/3 prevote set as the
+        polka justification; the node re-counts it AGAINST ITS OWN trust
+        roots before locking — a lying coordinator cannot force a lock."""
+        if not p.get("polka"):
+            return {"vote": c.vote_to_json(self.vnode.precommit_on(None))}
+        block = c.block_from_json(p["block"])
+        prevotes = [c.vote_from_json(v) for v in p.get("prevotes", [])]
+        if not self._polka_checks_out(block, prevotes):
+            return {"vote": c.vote_to_json(self.vnode.precommit_on(None))}
+        self.vnode.on_polka(block, int(p.get("round", 0)))
+        return {"vote": c.vote_to_json(self.vnode.precommit_on(block))}
+
+    def _polka_checks_out(self, block, prevotes) -> bool:
+        from celestia_app_tpu.chain.crypto import PublicKey
+        from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+
+        v = self.vnode
+        bh = block.header.hash()
+        ctx = Context(v.app.store, InfiniteGasMeter(), v.app.height, 0,
+                      v.app.chain_id, v.app.app_version)
+        powers = dict(v.app.staking.validators(ctx))
+        signed = 0
+        seen: set[bytes] = set()
+        doc = c.Vote.sign_bytes(v.app.chain_id, block.header.height, bh,
+                                "prevote")
+        for pv in prevotes:
+            if (pv.block_hash != bh or pv.phase != "prevote"
+                    or pv.validator in seen):
+                continue
+            pub = v.validator_pubkeys.get(pv.validator)
+            if pub is None or not PublicKey(pub).verify(pv.signature, doc):
+                continue
+            seen.add(pv.validator)
+            signed += powers.get(pv.validator, 0)
+        return signed * 3 > sum(powers.values()) * 2
+
+    def _commit(self, p: dict) -> dict:
+        block = c.block_from_json(p["block"])
+        cert = c.cert_from_json(p["cert"])
+        evidence = tuple(
+            c.evidence_from_json(e) for e in p.get("evidence", [])
+        )
+        if cert.block_hash != block.header.hash():
+            raise ValueError("certificate does not cover this block")
+        if not self.vnode.verify_certificate(cert):
+            raise ValueError("commit certificate failed local verification")
+        app_hash = self.vnode.apply(block, cert, evidence)
+        self.vnode.clear_lock()
+        return {"app_hash": app_hash.hex(), "height": self.vnode.app.height}
+
+    def _sync(self, p: dict) -> dict:
+        """State-sync catch-up over the wire: pull a peer's snapshot and
+        adopt it after chunk-hash + app-hash verification."""
+        import urllib.request
+
+        with urllib.request.urlopen(
+            p["peer"].rstrip("/") + "/consensus/snapshot", timeout=30
+        ) as r:
+            doc = json.loads(r.read())
+        chunks = [base64.b64decode(ch) for ch in doc["chunks"]]
+        before = self.vnode.app.height
+        c.state_sync_bootstrap(self.vnode, doc["manifest"], chunks)
+        return {"height": self.vnode.app.height, "from_height": before,
+                "app_hash": self.vnode.app.last_app_hash.hex()}
+
+    # -- lifecycle -------------------------------------------------------
+
+    def serve_background(self) -> threading.Thread:
+        th = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        th.start()
+        return th
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

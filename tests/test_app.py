@@ -378,3 +378,37 @@ def test_app_module_manager_drives_upgrade_migration():
     assert app.minfee.network_min_gas_price_atto(ctx) > 0
     assert "blobstream" not in app.module_manager.active(2)
     assert "minfee" in app.module_manager.active(2)
+
+
+def test_ante_memo_and_empty_proposal_rejected():
+    """ValidateMemoDecorator (max 256 chars) + GovProposalDecorator (a
+    proposal must carry at least one change) — app/ante/ante.go order."""
+    from celestia_app_tpu.chain.tx import MsgSubmitProposal
+
+    app, signer, privs = make_app()
+    addr = privs[0].public_key().address()
+    tx = signer.create_tx(addr, [MsgSend(addr, b"\x09" * 20, 1)],
+                          fee=10**6, gas_limit=10**5, memo="m" * 257)
+    res = app.check_tx(tx.encode())
+    assert res.code != 0 and "memo" in res.log
+
+    empty = MsgSubmitProposal(proposer=addr, changes_json=b"[]",
+                              initial_deposit=10**6)
+    tx2 = signer.create_tx(addr, [empty], fee=10**6, gas_limit=10**6)
+    res2 = app.check_tx(tx2.encode())
+    assert res2.code != 0 and "proposal" in res2.log
+
+
+def test_v1_max_total_blob_size_checktx_gate():
+    """MaxTotalBlobSizeDecorator (v1 + CheckTx only): a PFB whose total
+    blob BYTES cannot fit the max square is refused at admission."""
+    app, signer, privs = make_app()
+    assert app.app_version == 1
+    addr = privs[0].public_key().address()
+    # a real BlobTx whose single blob exceeds the 64x64 square's available
+    # sparse-share bytes (the decorator reads the PFB's blob_sizes)
+    big = Blob(Namespace.v0(b"big"), b"\x5a" * (64 * 64 * 482 + 1))
+    raw = signer.create_pay_for_blobs(addr, [big], fee=10**9, gas_limit=10**9)
+    res = app.check_tx(raw)
+    assert res.code != 0
+    assert "total blob size" in res.log

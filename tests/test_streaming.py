@@ -1,6 +1,7 @@
 """Streaming PrepareProposal overlap (BASELINE cfg 4/5, VERDICT r2 #5)."""
 
 import numpy as np
+import pytest
 
 from celestia_app_tpu.da import eds as eds_mod
 from celestia_app_tpu.parallel import streaming
@@ -28,3 +29,46 @@ def test_bench_stream_reports_overlap():
     assert out["streamed_ms"] <= out["serial_ms"] * 1.25  # overlap not slower
     assert set(out) >= {"metric", "value", "unit", "host_layout_ms",
                         "device_ms", "serial_ms", "streamed_ms"}
+
+
+def test_bench_stream_mesh_small():
+    """Mesh streaming mode (BASELINE cfg 5 shape) at a CI-affordable size:
+    the sharded pipeline streams batches with host/device overlap and
+    reports blocks/s."""
+    out = streaming.bench_stream_mesh(k=8, n_batches=2)
+    assert out["value"] > 0
+    assert out["blocks"] >= 2
+    assert out["metric"].startswith("stream_mesh_blocks_per_sec")
+
+
+@pytest.mark.slow
+def test_stream_mesh_k256_gf16_blocks_per_sec():
+    """VERDICT r3 #5: 256x256 streaming (BASELINE cfg 5) on the virtual
+    8-device mesh. k=256 means codeword length 512 — the GF(2^16) Leopard
+    codec — through the full sharded extend+commit, streamed. Prints the
+    measured blocks/s; the root is cross-checked against the single-device
+    pipeline for the first block."""
+    import jax
+
+    from celestia_app_tpu.parallel import mesh as mesh_mod
+    from celestia_app_tpu.parallel import sharded_eds
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        import pytest as _pytest
+
+        _pytest.skip("needs the 8-device CPU mesh")
+    k = 256
+    out = streaming.bench_stream_mesh(k=k, n_batches=2)
+    print(f"\nstream_mesh k=256: {out}")
+    assert out["value"] > 0 and out["blocks"] >= 2
+
+    # bit-equality of the mesh path at k=256 vs the single-device pipeline
+    mesh = mesh_mod.make_mesh(8, k=k, devices=devices[:8])
+    batch = mesh.shape[mesh_mod.DATA_AXIS]
+    ods = np.stack([streaming._synthetic_layout(k, j) for j in range(batch)])
+    run = sharded_eds.jitted_sharded_pipeline(mesh, k)
+    root_mesh = bytes(np.asarray(run(ods)[3][0]))
+    single = eds_mod.jitted_pipeline(k)
+    root_single = bytes(np.asarray(single(ods[0])[3]))
+    assert root_mesh == root_single
