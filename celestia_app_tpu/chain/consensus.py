@@ -206,6 +206,7 @@ class ValidatorNode:
         self.app = App(chain_id=chain_id, engine="host", data_dir=data_dir)
         self.app.init_chain(genesis)
         self.mempool: list[bytes] = []
+        self._tx_meta: dict[bytes, tuple[float, bytes | None]] = {}
         self.wal_dir = os.path.join(data_dir, "wal") if data_dir else None
         if self.wal_dir:
             os.makedirs(self.wal_dir, exist_ok=True)
@@ -224,12 +225,39 @@ class ValidatorNode:
 
     # -- mempool (gossiped) ---------------------------------------------
 
-    def add_tx(self, raw: bytes) -> bool:
+    def add_tx(self, raw: bytes):
+        """CheckTx + admission; returns the TxResult so transports
+        (in-process bus, HTTP validator service) share ONE admission path."""
         res = self.app.check_tx(raw)
         if res.code == 0:
             self.mempool.append(raw)
-            return True
-        return False
+            self._note_tx_meta(raw)
+        return res
+
+    def _note_tx_meta(self, raw: bytes) -> None:
+        """Cache (fee/gas, signer pubkey) for priority reaping (the
+        reference's mempool v1 orders by gas price —
+        default_overrides.go:265-274)."""
+        from celestia_app_tpu.chain.tx import decode_tx
+        from celestia_app_tpu.da import blob as blob_mod
+
+        try:
+            btx = blob_mod.try_unmarshal_blob_tx(raw)
+            tx = decode_tx(btx.tx if btx is not None else raw)
+            self._tx_meta[raw] = (tx.body.fee / tx.body.gas_limit, tx.pubkey)
+        except (ValueError, ZeroDivisionError):
+            self._tx_meta[raw] = (0.0, None)
+
+    def reap_mempool(self) -> list[bytes]:
+        """Priority order: gas price desc, per-sender arrival order kept —
+        the order FilterTxs receives candidates in (mempool v1 semantics;
+        see node.priority_order for the nonce-safety rationale)."""
+        from celestia_app_tpu.chain.node import priority_order
+
+        return priority_order([
+            (raw, *self._tx_meta.get(raw, (0.0, None)))
+            for raw in self.mempool
+        ])
 
     # -- consensus steps -------------------------------------------------
     # Two-phase Tendermint vote flow with lock-on-polka: prevote after
@@ -244,7 +272,9 @@ class ValidatorNode:
         # validValue/lockedValue rule), not build a fresh one
         if self.locked_block is not None:
             return self.locked_block
-        prop = self.app.prepare_proposal(self.mempool, proposer=self.address, t=t)
+        prop = self.app.prepare_proposal(
+            self.reap_mempool(), proposer=self.address, t=t
+        )
         return prop.block
 
     def _signed(self, height: int, bh: bytes | None, phase: str) -> Vote:
@@ -398,6 +428,8 @@ class ValidatorNode:
         self.certificates[block.header.height] = cert
         committed = {tx for tx in block.txs}
         self.mempool = [tx for tx in self.mempool if tx not in committed]
+        for tx in committed:
+            self._tx_meta.pop(tx, None)
         return app_hash
 
     def replay_wal(self) -> int:
@@ -603,7 +635,7 @@ class LocalNetwork:
         return self.broadcast_tx_all(raw)[via]
 
     def broadcast_tx_all(self, raw: bytes) -> list[bool]:
-        return [n.add_tx(raw) for n in self.nodes]
+        return [n.add_tx(raw).code == 0 for n in self.nodes]
 
     def proposer_for(self, height: int, round_: int = 0) -> ValidatorNode:
         return self.nodes[(height + round_) % len(self.nodes)]

@@ -23,6 +23,30 @@ class MempoolTx:
     raw: bytes
     gas_price: float
     height_added: int
+    sender: bytes | None = None  # signer pubkey; keys per-sender FIFO
+
+
+def priority_order(items: list[tuple[bytes, float, bytes | None]]) -> list[bytes]:
+    """Gas-price-descending reap that preserves PER-SENDER arrival order.
+
+    `items` = [(raw, gas_price, sender)] in arrival order. A plain
+    (-price, arrival) sort would let a sender's later high-fee tx jump its
+    own earlier low-fee one — the later tx then fails the ante sequence
+    check in the proposal filter and is pointlessly delayed a height. Here
+    the sorted positions are kept, but each position is filled with the
+    owning sender's OLDEST pending tx, so priority decides which sender
+    goes first while nonces stay in submission order."""
+    from collections import deque
+
+    def key(i: int):
+        sender = items[i][2]
+        return sender if sender is not None else (b"raw", items[i][0])
+
+    queues: dict = {}
+    for i, (raw, _price, _sender) in enumerate(items):
+        queues.setdefault(key(i), deque()).append(raw)
+    order = sorted(range(len(items)), key=lambda i: (-items[i][1], i))
+    return [queues[key(i)].popleft() for i in order]
 
 
 class Node:
@@ -48,21 +72,21 @@ class Node:
                     raw=raw,
                     gas_price=tx.body.fee / tx.body.gas_limit,
                     height_added=self.app.height,
+                    sender=tx.pubkey,
                 )
             )
         return res
 
     def _reap(self) -> list[bytes]:
-        """Priority order: gas price desc, arrival order as tiebreak."""
+        """Priority order: gas price desc, per-sender arrival order kept."""
         self.mempool = [
             m
             for m in self.mempool
             if self.app.height - m.height_added < self.mempool_ttl
         ]
-        ordered = sorted(
-            enumerate(self.mempool), key=lambda im: (-im[1].gas_price, im[0])
+        return priority_order(
+            [(m.raw, m.gas_price, m.sender) for m in self.mempool]
         )
-        return [m.raw for _, m in ordered]
 
     # -- consensus loop ------------------------------------------------
 

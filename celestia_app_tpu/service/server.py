@@ -51,6 +51,19 @@ class NodeService:
                     if self.path == "/status":
                         with service.lock:
                             self._send(200, service.router.query("status", {}))
+                    elif self.path == "/metrics":
+                        # Prometheus text exposition (the reference's
+                        # metrics provider endpoint, SURVEY §5.1)
+                        from celestia_app_tpu.utils import telemetry
+
+                        body = telemetry.prometheus().encode()
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type", "text/plain; version=0.0.4"
+                        )
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
                     elif self.path.startswith("/trace/"):
                         # columnar trace tables (pkg/trace pull, §5.1):
                         # /trace/<table>?since=<index>&limit=<n> — reads the

@@ -21,7 +21,6 @@ Routes (all JSON):
   POST /consensus/prevote {block}   -> {vote}     (ProcessProposal inside)
   POST /consensus/precommit {block?, polka, round} -> {vote}  (lock if polka)
   POST /consensus/commit {block, cert, evidence} -> {app_hash}
-  POST /consensus/clear_round {}    round failed: keep locks, drop nothing
   GET  /consensus/snapshot          {manifest, chunks: [b64]} (state sync)
   POST /consensus/sync {peer}       pull + verify a peer's snapshot
 """
@@ -84,7 +83,6 @@ class ValidatorService:
                         "/consensus/prevote": service._prevote,
                         "/consensus/precommit": service._precommit,
                         "/consensus/commit": service._commit,
-                        "/consensus/clear_round": lambda p: {},
                         "/consensus/sync": service._sync,
                     }.get(self.path)
                     if route is None:
@@ -117,9 +115,7 @@ class ValidatorService:
 
     def _broadcast_tx(self, p: dict) -> dict:
         raw = base64.b64decode(p["tx"])
-        res = self.vnode.app.check_tx(raw)
-        if res.code == 0:
-            self.vnode.mempool.append(raw)
+        res = self.vnode.add_tx(raw)  # the ONE admission path
         return {"code": res.code, "log": res.log,
                 "gas_wanted": res.gas_wanted, "gas_used": res.gas_used}
 

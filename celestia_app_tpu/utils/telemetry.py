@@ -49,6 +49,34 @@ class Registry:
         self.counters.clear()
         self.timers.clear()
 
+    def prometheus(self, prefix: str = "celestia") -> str:
+        """Prometheus text exposition of the registry (the reference wires
+        node.DefaultMetricsProvider + a prometheus endpoint —
+        test/util/testnode/full_node.go:44, SURVEY §5.1). Counters become
+        `<prefix>_<name>_total`; timers become `_seconds_{count,sum,max}`."""
+
+        def _san(name: str) -> str:
+            return "".join(
+                ch if ch.isalnum() or ch == "_" else "_" for ch in name
+            )
+
+        # snapshot copies: another thread may insert a first-time metric
+        # mid-scrape (the docstring's promise that readers see a copy)
+        counters = dict(self.counters)
+        timers = {k: dict(v) for k, v in dict(self.timers).items()}
+        lines: list[str] = []
+        for name, v in sorted(counters.items()):
+            m = f"{prefix}_{_san(name)}_total"
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {v}")
+        for name, t in sorted(timers.items()):
+            base = f"{prefix}_{_san(name)}_seconds"
+            lines.append(f"# TYPE {base} summary")
+            lines.append(f"{base}_count {t['count']}")
+            lines.append(f"{base}_sum {t['total_s']:.9f}")
+            lines.append(f"{base}_max {t['max_s']:.9f}")
+        return "\n".join(lines) + "\n"
+
 
 class TraceTables:
     """Columnar event tracing — the celestia-core ``pkg/trace`` analog
@@ -92,6 +120,7 @@ _traces = TraceTables()
 incr = _global.incr
 measure_since = _global.measure_since
 snapshot = _global.snapshot
+prometheus = _global.prometheus
 reset = _global.reset
 trace = _traces.write
 read_trace = _traces.read

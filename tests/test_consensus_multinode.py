@@ -475,3 +475,43 @@ def test_cross_round_prevotes_are_not_equivocation(tmp_path):
             assert not info["tombstoned"], "honest validator tombstoned"
         # full voting power intact (no equivocation slash)
         assert n.app.staking.validator_power(ctx, n.address) == 10
+
+
+def test_mempool_priority_order_in_proposal(tmp_path):
+    """Mempool v1 semantics: the proposer reaps by gas price (desc), so a
+    high-fee tx lands ahead of an earlier low-fee one in the block."""
+    net, signer, privs = _network(tmp_path, with_disk=False)
+    a0 = privs[0].public_key().address()
+    a1 = privs[1].public_key().address()
+    a2 = privs[2].public_key().address()
+    # a1 submits FIRST with a low gas price, a2 second with a high one
+    cheap = signer.create_tx(a1, [MsgSend(a1, a0, 1)], fee=1000,
+                             gas_limit=100_000)
+    rich = signer.create_tx(a2, [MsgSend(a2, a0, 2)], fee=50_000,
+                            gas_limit=100_000)
+    assert net.broadcast_tx(cheap.encode())
+    assert net.broadcast_tx(rich.encode())
+    blk, cert = net.produce_height(t=1_700_000_010.0)
+    assert blk is not None and len(blk.txs) == 2
+    assert blk.txs[0] == rich.encode()
+    assert blk.txs[1] == cheap.encode()
+
+
+def test_same_sender_nonce_order_survives_priority(tmp_path):
+    """Code-review regression: a sender's later HIGH-fee tx must not jump
+    its own earlier low-fee tx in the reap — both commit in one block, in
+    sequence order (priority decides which SENDER goes first; nonces stay
+    in submission order)."""
+    net, signer, privs = _network(tmp_path, with_disk=False)
+    a1 = privs[1].public_key().address()
+    a0 = privs[0].public_key().address()
+    low = signer.create_tx(a1, [MsgSend(a1, a0, 1)], fee=1000,
+                           gas_limit=100_000)
+    signer.accounts[a1].sequence += 1
+    high = signer.create_tx(a1, [MsgSend(a1, a0, 2)], fee=90_000,
+                            gas_limit=100_000)
+    assert net.broadcast_tx(low.encode())
+    assert net.broadcast_tx(high.encode())
+    blk, cert = net.produce_height(t=1_700_000_010.0)
+    assert blk is not None
+    assert list(blk.txs) == [low.encode(), high.encode()]

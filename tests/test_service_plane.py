@@ -707,3 +707,30 @@ def test_grpc_bootstrap_and_pfb_submit(tmp_path):
         assert client.signer.accounts[a0].sequence == 1
     finally:
         server.stop()
+
+
+def test_prometheus_metrics_endpoint(tmp_path):
+    """§5.1: /metrics serves the Prometheus text exposition of the node's
+    counters and prepare/process/commit timing summaries."""
+    from celestia_app_tpu.service.server import NodeService
+    from celestia_app_tpu.utils import telemetry
+
+    app, signer, privs = _persistent_app(tmp_path)
+    node = _run_blocks(app, signer, privs)
+    svc = NodeService(node, port=0)
+    svc.serve_background()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}/metrics"
+        ) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            body = r.read().decode()
+        assert "# TYPE" in body
+        assert "celestia_prepare_proposal_seconds_count" in body
+        assert "celestia_prepare_proposal_seconds_sum" in body
+        # counters render as prometheus counters
+        snap = telemetry.snapshot()
+        if snap["counters"]:
+            assert "_total " in body
+    finally:
+        svc.shutdown()

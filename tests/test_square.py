@@ -218,3 +218,48 @@ def test_build_layout_speed_large_mempool():
     assert dt < 1.0, f"7.9MB layout took {dt:.2f}s"
     assert sq.size == 128
     assert len(sq.pfbs) >= len(pfbs) - 5  # nearly everything admitted
+
+
+def test_builder_reserve_invariants_fuzz():
+    """Property fuzz over the pessimistic-reserve builder (round-4 layout):
+    for random tx/blob workloads across square caps,
+      - build() == construct() share-for-share (Prepare/Process core),
+      - actual PFB shares never exceed the reserve,
+      - blobs start at/after the reserved region with NI-default alignment,
+      - the square never exceeds the cap build() admitted against."""
+    rng = np.random.default_rng(2024)
+    for trial in range(40):
+        max_sq = int(rng.choice([8, 16, 32, 64, 128]))
+        txs = [
+            rng.integers(0, 256, int(rng.integers(10, 400)),
+                         dtype=np.uint8).tobytes()
+            for _ in range(int(rng.integers(0, 6)))
+        ]
+        pfbs = []
+        for i in range(int(rng.integers(0, 10))):
+            n_blobs = int(rng.integers(1, 4))
+            blobs = tuple(
+                _blob(rng, int(rng.integers(1, 200)),
+                      int(rng.integers(1, 40_000)))
+                for _ in range(n_blobs)
+            )
+            tx_len = int(rng.integers(5, 600))
+            pfbs.append(PfbEntry(bytes(tx_len), blobs))
+        built = square_mod.build(txs, pfbs, max_sq, THRESHOLD)
+        assert built.size <= max_sq, (trial, built.size, max_sq)
+        assert built.pfb_shares_len <= built.pfb_shares_reserved
+        if built.blob_start_indexes:
+            first = min(built.blob_start_indexes.values())
+            assert first >= built.tx_shares_len + built.pfb_shares_len
+            for (i, j), start in built.blob_start_indexes.items():
+                width = subtree_width(
+                    built.pfbs[i].blobs[j].share_count(), THRESHOLD
+                )
+                assert start % width == 0
+        constructed = square_mod.construct(
+            built.txs, built.pfbs, max_sq, THRESHOLD
+        )
+        assert built.size == constructed.size, trial
+        assert [s.raw for s in built.shares] == [
+            s.raw for s in constructed.shares
+        ], trial
