@@ -207,6 +207,7 @@ class ValidatorNode:
         self.app.init_chain(genesis)
         self.mempool: list[bytes] = []
         self._tx_meta: dict[bytes, tuple[float, bytes | None]] = {}
+        self.committed: dict[bytes, tuple[int, object]] = {}
         self.wal_dir = os.path.join(data_dir, "wal") if data_dir else None
         if self.wal_dir:
             os.makedirs(self.wal_dir, exist_ok=True)
@@ -423,14 +424,28 @@ class ValidatorNode:
         # absences — both paths must compute the absent set against the
         # same post-evidence validator set or replayed nodes diverge
         self._mark_absent_from_votes(cert)
-        self.app.finalize_block(block)
+        results = self.app.finalize_block(block)
         app_hash = self.app.commit(block)
         self.certificates[block.header.height] = cert
+        self._record_committed(block, results)
         committed = {tx for tx in block.txs}
         self.mempool = [tx for tx in self.mempool if tx not in committed]
         for tx in committed:
             self._tx_meta.pop(tx, None)
         return app_hash
+
+    def _record_committed(self, block: Block, results) -> None:
+        """Tx-hash -> (height, result) index backing the gRPC GetTx /
+        ConfirmTx surface a validator process serves — the ONE recorder
+        shared with Node (height-windowed; node.record_committed)."""
+        from celestia_app_tpu.chain.node import record_committed
+
+        record_committed(self.committed, block, results)
+
+    # GrpcTxServer speaks to anything exposing broadcast_tx/app/committed;
+    # a validator process IS that node (one binary per validator)
+    def broadcast_tx(self, raw: bytes):
+        return self.add_tx(raw)
 
     def replay_wal(self) -> int:
         """Crash recovery: apply WAL entries above the committed height
@@ -458,9 +473,10 @@ class ValidatorNode:
             # the replayed liveness accounting matches the live run (same
             # evidence-then-absences order as apply())
             self._mark_absent_from_votes(cert)
-            self.app.finalize_block(block)
+            results = self.app.finalize_block(block)
             self.app.commit(block)
             self.certificates[height] = cert
+            self._record_committed(block, results)
             replayed += 1
         return replayed
 

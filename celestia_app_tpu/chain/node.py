@@ -26,6 +26,29 @@ class MempoolTx:
     sender: bytes | None = None  # signer pubkey; keys per-sender FIFO
 
 
+# heights of committed-tx lookups retained for GetTx/ConfirmTx; the
+# reference's default lookback for confirmation polling is far shorter
+COMMITTED_INDEX_WINDOW = 1000
+
+
+def record_committed(index: dict, block: "Block", results) -> None:
+    """THE committed-tx index recorder (tx-hash -> (height, result)), shared
+    by Node and ValidatorNode so the gRPC GetTx/ConfirmTx contract stays
+    single-sourced. Prunes entries older than COMMITTED_INDEX_WINDOW
+    heights (amortized) so a long-lived validator process does not grow
+    its index with the whole chain history."""
+    import hashlib
+
+    h = block.header.height
+    for raw, res in zip(block.txs, results):
+        index[hashlib.sha256(raw).digest()] = (h, res)
+    if h % 50 == 0:
+        floor = h - COMMITTED_INDEX_WINDOW
+        if floor > 0:
+            for key in [k for k, (hh, _r) in index.items() if hh <= floor]:
+                del index[key]
+
+
 def priority_order(items: list[tuple[bytes, float, bytes | None]]) -> list[bytes]:
     """Gas-price-descending reap that preserves PER-SENDER arrival order.
 
@@ -101,13 +124,7 @@ class Node:
 
         included = set(prop.block.txs)
         self.mempool = [m for m in self.mempool if m.raw not in included]
-        import hashlib
-
-        for raw, res in zip(prop.block.txs, results):
-            self.committed[hashlib.sha256(raw).digest()] = (
-                prop.block.header.height,
-                res,
-            )
+        record_committed(self.committed, prop.block, results)
         return prop.block, results
 
     def confirm_tx(self, raw: bytes):

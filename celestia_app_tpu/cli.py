@@ -257,11 +257,21 @@ def cmd_validator_serve(args) -> int:
         pass  # fresh home: stay at the genesis state init_chain built
     replayed = vnode.replay_wal()
     svc = ValidatorService(vnode, port=args.port)
+    endpoint = {"host": "127.0.0.1", "port": svc.port}
+    grpc_server = None
+    if args.grpc is not None:
+        # the full client surface on the SAME process (one binary per
+        # validator, as the reference serves gRPC:9090 from the node):
+        # tx broadcast/simulate/GetTx + the SetupTxClient bootstrap queries
+        from celestia_app_tpu.service.grpc_server import GrpcTxServer
+
+        grpc_server = GrpcTxServer(vnode, port=args.grpc, lock=svc.lock)
+        endpoint["grpc_port"] = grpc_server.port
     # atomic publish: the spawner polls for this file and must never read
     # a half-written JSON body
     ep_tmp = os.path.join(args.home, "endpoint.json.tmp")
     with open(ep_tmp, "w") as f:
-        json.dump({"host": "127.0.0.1", "port": svc.port}, f)
+        json.dump(endpoint, f)
     os.replace(ep_tmp, os.path.join(args.home, "endpoint.json"))
     print(
         f"{vnode.name}: serving on 127.0.0.1:{svc.port} at height "
@@ -272,6 +282,9 @@ def cmd_validator_serve(args) -> int:
         svc.serve_forever()
     except KeyboardInterrupt:
         pass
+    finally:
+        if grpc_server is not None:
+            grpc_server.stop()
     return 0
 
 
@@ -683,6 +696,9 @@ def main(argv=None) -> int:
                    help="validator home (genesis.json + key.json inside)")
     p.add_argument("--chain-id", required=True)
     p.add_argument("--port", type=int, default=0)
+    p.add_argument("--grpc", type=int, default=None,
+                   help="also serve the cosmos gRPC surface on this port "
+                        "(0 = ephemeral)")
     p.set_defaults(fn=cmd_validator_serve)
 
     p = sub.add_parser("addr-conversion")

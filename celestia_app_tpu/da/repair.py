@@ -1,0 +1,139 @@
+"""2D EDS repair: reconstruct a damaged extended square from any
+sufficient subset of shares, verifying every axis against its committed
+NMT root.
+
+Reference parity: rsmt2d's `ExtendedDataSquare.Repair` (the API light
+nodes and full nodes use to rebuild a block from sampled/gossiped shares;
+rsmt2d repair.go `solveCrossword`). The algorithm is the same crossword
+fixpoint: any row or column with ≥ k of its 2k shares present is decoded
+with the Leopard erasure decoder (ops/rs.repair_axis — the FWHT
+error-locator path), its recomputed NMT root is compared to the DAH's
+committed root, and the recovered shares unlock further axes; iterate to
+fixpoint.
+
+Byzantine detection: when the input shares are AUTHENTIC (each proven
+against the DAH before being fed here — the caller's job, as in DAS), a
+root mismatch on a repaired or fully-present axis means the block
+producer committed a NON-CODEWORD. That axis is exactly what a
+bad-encoding fraud proof indicts: the raised `BadEncodingError` carries
+(axis, index) ready for `da/fraud.generate_befp` (specs fraud_proofs.md;
+rsmt2d ErrByzantineData semantics).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.da import namespace as ns_mod
+from celestia_app_tpu.ops import rs
+from celestia_app_tpu.utils import nmt_host
+
+NS = appconsts.NAMESPACE_SIZE
+SHARE = appconsts.SHARE_SIZE
+
+
+class BadEncodingError(Exception):
+    """A verified-share axis failed its committed root: the producer
+    committed a non-codeword (rsmt2d ErrByzantineData). Carries what
+    generate_befp needs to build the fraud proof."""
+
+    def __init__(self, axis: str, index: int):
+        self.axis = axis
+        self.index = index
+        super().__init__(
+            f"{axis} {index} does not match its committed root: "
+            "the square is not a valid codeword (bad encoding)"
+        )
+
+
+def _axis_root(slab: np.ndarray, axis: str, index: int, k: int) -> bytes:
+    """Committed-root recomputation for one full axis of 2k shares.
+    Leaf namespace rule: Q0 keeps the share's own prefix, parity quadrants
+    use the parity namespace (pkg/wrapper/nmt_wrapper.go:93-114)."""
+    tree = nmt_host.NmtTree()
+    for j in range(2 * k):
+        r, c = (index, j) if axis == "row" else (j, index)
+        share = slab[j].tobytes()
+        ns = share[:NS] if (r < k and c < k) else ns_mod.PARITY_NS_RAW
+        tree.leaves.append((ns, share))
+    return nmt_host.serialize(tree.root())
+
+
+def repair_eds(
+    symbols: np.ndarray,
+    present: np.ndarray,
+    row_roots: list[bytes],
+    col_roots: list[bytes],
+) -> np.ndarray:
+    """Rebuild the full (2k, 2k, 512) EDS from the shares marked present.
+
+    `symbols` may hold arbitrary bytes at missing positions; `present` is
+    the (2k, 2k) bool mask of authentic shares. Raises ValueError when the
+    erasure pattern is unsolvable, BadEncodingError when a completed axis
+    contradicts its committed root. Returns the repaired square; on
+    success every row/column root has been verified."""
+    symbols = np.array(symbols, dtype=np.uint8, copy=True)
+    present = np.array(present, dtype=bool, copy=True)
+    two_k = symbols.shape[0]
+    k = two_k // 2
+    if symbols.shape != (two_k, two_k, SHARE):
+        raise ValueError(f"bad square shape {symbols.shape}")
+    if present.shape != (two_k, two_k):
+        raise ValueError(f"bad mask shape {present.shape}")
+    if len(row_roots) != two_k or len(col_roots) != two_k:
+        raise ValueError("need 2k row roots and 2k col roots")
+
+    verified_rows = [False] * two_k
+    verified_cols = [False] * two_k
+
+    def _finish_row(r: int) -> None:
+        if _axis_root(symbols[r], "row", r, k) != row_roots[r]:
+            raise BadEncodingError("row", r)
+        verified_rows[r] = True
+
+    def _finish_col(c: int) -> None:
+        if _axis_root(symbols[:, c, :], "col", c, k) != col_roots[c]:
+            raise BadEncodingError("col", c)
+        verified_cols[c] = True
+
+    while True:
+        progress = False
+        for r in range(two_k):
+            if verified_rows[r]:
+                continue
+            n = int(present[r].sum())
+            if n == two_k:
+                _finish_row(r)
+                progress = True
+            elif n >= k:
+                rec = rs.repair_axis(
+                    symbols[r], list(np.flatnonzero(present[r]))
+                )
+                symbols[r] = rec.reshape(two_k, SHARE)
+                _finish_row(r)
+                present[r] = True
+                progress = True
+        for c in range(two_k):
+            if verified_cols[c]:
+                continue
+            n = int(present[:, c].sum())
+            if n == two_k:
+                _finish_col(c)
+                progress = True
+            elif n >= k:
+                rec = rs.repair_axis(
+                    symbols[:, c, :], list(np.flatnonzero(present[:, c]))
+                )
+                symbols[:, c, :] = rec.reshape(two_k, SHARE)
+                _finish_col(c)
+                present[:, c] = True
+                progress = True
+        if all(verified_rows) and all(verified_cols):
+            return symbols
+        if not progress:
+            missing = int((~present).sum())
+            raise ValueError(
+                f"unsolvable erasure pattern: {missing} shares still "
+                "missing and no row or column has k known shares"
+            )

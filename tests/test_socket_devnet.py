@@ -259,3 +259,87 @@ def test_concurrent_broadcast_during_rounds(tmp_path):
                     pr.kill()
                 except Exception:
                     pass
+
+
+def test_grpc_surface_on_validator_process(tmp_path):
+    """One binary per validator: a validator PROCESS serves the cosmos gRPC
+    surface next to its consensus service (the reference's node:9090).
+    A TxClient bootstraps over gRPC against the process, submits a PFB into
+    its mempool, and confirms once that validator's proposal turn commits
+    the tx through socket consensus."""
+    import threading
+
+    import numpy as np
+
+    from celestia_app_tpu.client.tx_client import setup_tx_client_grpc
+    from celestia_app_tpu.da.blob import Blob
+    from celestia_app_tpu.da.namespace import Namespace
+
+    n = 3
+    privs = [PrivateKey.from_seed(f"sock-{i}".encode()) for i in range(n)]
+    genesis = _genesis(privs)
+    homes = [str(tmp_path / f"val{i}") for i in range(n)]
+    procs = []
+    for i in range(n):
+        home = homes[i]
+        os.makedirs(home, exist_ok=True)
+        with open(os.path.join(home, "genesis.json"), "w") as f:
+            json.dump(genesis, f)
+        with open(os.path.join(home, "key.json"), "w") as f:
+            json.dump({"seed_hex": f"sock-{i}".encode().hex(),
+                       "name": f"val{i}"}, f)
+        ep = os.path.join(home, "endpoint.json")
+        if os.path.exists(ep):
+            os.unlink(ep)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "celestia_app_tpu", "validator-serve",
+             "--home", home, "--chain-id", CHAIN, "--grpc", "0"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        ))
+    try:
+        peers = [_peer(h) for h in homes]
+        net = SocketNetwork(peers, genesis, CHAIN)
+        with open(os.path.join(homes[0], "endpoint.json")) as f:
+            grpc_port = json.load(f)["grpc_port"]
+
+        client = setup_tx_client_grpc(
+            f"127.0.0.1:{grpc_port}", [privs[0]]
+        )
+        assert client.signer.chain_id == CHAIN
+        a0 = privs[0].public_key().address()
+
+        stop = threading.Event()
+
+        def drive():
+            t = 1_700_000_010.0
+            for _ in range(12):
+                if stop.is_set():
+                    return
+                t += 1
+                net.produce_height(t=t)
+                time.sleep(0.2)
+
+        driver = threading.Thread(target=drive, daemon=True)
+        driver.start()
+        try:
+            rng = np.random.default_rng(3)
+            blobs = [Blob(Namespace.v0(b"procg"),
+                          rng.integers(0, 256, 600, dtype=np.uint8).tobytes())]
+            conf = client.submit_pay_for_blob(a0, blobs)
+        finally:
+            stop.set()
+            driver.join(timeout=30)
+        assert conf["found"] is True and conf["code"] == 0
+        heights = {p.status()["height"] for p in net.peers}
+        hashes = {p.status()["app_hash"] for p in net.peers}
+        assert len(hashes) == 1 and max(heights) >= conf["height"]
+    finally:
+        for pr in procs:
+            try:
+                pr.terminate()
+                pr.wait(timeout=5)
+            except Exception:
+                try:
+                    pr.kill()
+                except Exception:
+                    pass
