@@ -228,7 +228,14 @@ class ValidatorNode:
 
     def add_tx(self, raw: bytes):
         """CheckTx + admission; returns the TxResult so transports
-        (in-process bus, HTTP validator service) share ONE admission path."""
+        (in-process bus, HTTP validator service, gRPC) share ONE admission
+        path, including the mempool byte cap Node enforces
+        (default_overrides.go:271-273)."""
+        from celestia_app_tpu import appconsts
+        from celestia_app_tpu.chain.block import TxResult
+
+        if len(raw) > appconsts.MEMPOOL_MAX_TX_BYTES:
+            return TxResult(1, "tx exceeds mempool max bytes", 0, 0, [])
         res = self.app.check_tx(raw)
         if res.code == 0:
             self.mempool.append(raw)

@@ -48,10 +48,15 @@ class BadEncodingProof:
     shares: tuple[ShareWithProof, ...]  # exactly k members
 
 
-def _leaf_ns(row: int, col: int, share: bytes, k: int) -> bytes:
-    """pkg/wrapper leaf namespace rule: Q0 keeps the share's own prefix,
-    every parity quadrant uses PARITY."""
+def leaf_ns(row: int, col: int, share: bytes, k: int) -> bytes:
+    """THE pkg/wrapper leaf namespace rule (nmt_wrapper.go:93-114): Q0
+    keeps the share's own prefix, every parity quadrant uses PARITY.
+    Shared by fraud proving and 2D repair (da/repair.py) so both always
+    verify against the same leaf construction."""
     return share[:NS] if (row < k and col < k) else ns_mod.PARITY_NS_RAW
+
+
+_leaf_ns = leaf_ns  # backwards-compat alias for in-tree callers
 
 
 def _axis_tree(eds: ExtendedDataSquare, axis: str, index: int) -> nmt_host.NmtTree:

@@ -25,7 +25,6 @@ from __future__ import annotations
 import numpy as np
 
 from celestia_app_tpu import appconsts
-from celestia_app_tpu.da import namespace as ns_mod
 from celestia_app_tpu.ops import rs
 from celestia_app_tpu.utils import nmt_host
 
@@ -48,15 +47,17 @@ class BadEncodingError(Exception):
 
 
 def _axis_root(slab: np.ndarray, axis: str, index: int, k: int) -> bytes:
-    """Committed-root recomputation for one full axis of 2k shares.
-    Leaf namespace rule: Q0 keeps the share's own prefix, parity quadrants
-    use the parity namespace (pkg/wrapper/nmt_wrapper.go:93-114)."""
+    """Committed-root recomputation for one full axis of 2k shares, using
+    the ONE leaf-namespace rule shared with the fraud prover
+    (da/fraud.leaf_ns) — repair and BEFP verification must agree on leaf
+    construction or the BadEncodingError handoff breaks."""
+    from celestia_app_tpu.da.fraud import leaf_ns
+
     tree = nmt_host.NmtTree()
     for j in range(2 * k):
         r, c = (index, j) if axis == "row" else (j, index)
         share = slab[j].tobytes()
-        ns = share[:NS] if (r < k and c < k) else ns_mod.PARITY_NS_RAW
-        tree.leaves.append((ns, share))
+        tree.leaves.append((leaf_ns(r, c, share, k), share))
     return nmt_host.serialize(tree.root())
 
 

@@ -267,24 +267,29 @@ def matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return out
 
 
-@functools.lru_cache(maxsize=None)
-def bit_matrix(k: int) -> np.ndarray:
-    """(8k, 8k) 0/1 int8 GF(2) expansion of encode_matrix(k).
+def to_bit_matrix(m: np.ndarray) -> np.ndarray:
+    """(r, c) GF(2^8) label matrix -> (8r, 8c) 0/1 int8 GF(2) expansion.
 
-    y = c ·gf x is GF(2)-linear in x's label bits: with bits packed LSB-first
-    within each byte, B[8j+i, 8l+b] = bit i of mul(E[j,l], 1<<b), and
-    parity_bits = (B @ data_bits) mod 2. This is the constant the device RS
-    kernel folds into its MXU matmul (ops/rs.py) — the whole Leopard encode
-    collapses into one int8 matrix once the code is seen as GF(2)-linear.
-    """
-    e = encode_matrix(k).astype(np.int32)
+    y = M ·gf x is GF(2)-linear in x's label bits: with bits packed
+    LSB-first within each byte, B[8j+i, 8l+b] = bit i of mul(M[j,l], 1<<b),
+    so y_bits = (B @ x_bits) mod 2 for ANY label matrix (encode, decode,
+    or their products)."""
+    m = m.astype(np.int32)
     log, exp = _tables()
     powers = (1 << np.arange(8)).astype(np.int32)  # labels 2^b
-    # prod[j, l, b] = E[j,l] ·gf 2^b in label space
-    prod = exp[(log[e][:, :, None] + log[powers][None, None, :]) % MODULUS]
-    prod = np.where(e[:, :, None] == 0, 0, prod)
+    prod = exp[(log[m][:, :, None] + log[powers][None, None, :]) % MODULUS]
+    prod = np.where(m[:, :, None] == 0, 0, prod)
     bits = (prod[:, None, :, :] >> np.arange(8)[None, :, None, None]) & 1
-    return bits.reshape(8 * k, 8 * k).astype(np.int8)
+    return bits.reshape(8 * m.shape[0], 8 * m.shape[1]).astype(np.int8)
+
+
+@functools.lru_cache(maxsize=None)
+def bit_matrix(k: int) -> np.ndarray:
+    """(8k, 8k) GF(2) expansion of encode_matrix(k) — the constant the
+    device RS kernel folds into its MXU matmul (ops/rs.py): the whole
+    Leopard encode collapses into one int8 matrix once the code is seen as
+    GF(2)-linear."""
+    return to_bit_matrix(encode_matrix(k))
 
 
 def _gf_invert(a: np.ndarray) -> np.ndarray:
@@ -496,20 +501,24 @@ def matmul16(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return out
 
 
-@functools.lru_cache(maxsize=None)
-def bit_matrix16(k: int) -> np.ndarray:
-    """(16k, 16k) 0/1 int8 GF(2) expansion of encode_matrix16(k).
-
-    B[16j+i, 16l+b] = bit i of mul16(E16[j,l], 1<<b); with shares unpacked
-    as little-endian uint16 symbols this drops into the same MXU bit-matmul
-    as the 8-bit code (ops/rs.py picks the matrix by k)."""
-    e = encode_matrix16(k).astype(np.int64)
+def to_bit_matrix16(m: np.ndarray) -> np.ndarray:
+    """(r, c) GF(2^16) label matrix -> (16r, 16c) GF(2) expansion
+    (to_bit_matrix's 16-bit twin)."""
+    m = m.astype(np.int64)
     log, exp = _tables16()
     powers = (1 << np.arange(16)).astype(np.int64)
-    prod = exp[(log[e][:, :, None] + log[powers][None, None, :]) % MODULUS16]
-    prod = np.where(e[:, :, None] == 0, 0, prod)
+    prod = exp[(log[m][:, :, None] + log[powers][None, None, :]) % MODULUS16]
+    prod = np.where(m[:, :, None] == 0, 0, prod)
     bits = (prod[:, None, :, :] >> np.arange(16)[None, :, None, None]) & 1
-    return bits.reshape(16 * k, 16 * k).astype(np.int8)
+    return bits.reshape(16 * m.shape[0], 16 * m.shape[1]).astype(np.int8)
+
+
+@functools.lru_cache(maxsize=None)
+def bit_matrix16(k: int) -> np.ndarray:
+    """(16k, 16k) GF(2) expansion of encode_matrix16(k); with shares
+    unpacked as little-endian uint16 symbols this drops into the same MXU
+    bit-matmul as the 8-bit code (ops/rs.py picks the matrix by k)."""
+    return to_bit_matrix16(encode_matrix16(k))
 
 
 def _gf_invert16(a: np.ndarray) -> np.ndarray:

@@ -239,6 +239,60 @@ def extend_square_np(ods: np.ndarray) -> np.ndarray:
     return np.concatenate([top, bottom], axis=0)
 
 
+@functools.lru_cache(maxsize=256)  # pattern-keyed; entries are (2k, k) LABELS
+def _repair_label_matrix(k: int, use: tuple[int, ...]) -> np.ndarray:
+    """Label-space matrix mapping the k chosen present symbols to the FULL
+    2k codeword: G ·gf D with D the decode matrix for the pattern and G
+    the generator — decode and re-encode fused. Cached in LABEL space
+    ((2k, k) bytes/uint16s); the ~bits²-times-larger GF(2) expansion is
+    built per jitted closure, not hoarded per pattern."""
+    if leopard.uses_gf16(k):
+        return leopard.matmul16(
+            leopard.generator_matrix16(k), leopard.decode_matrix16(k, use)
+        )
+    return leopard.matmul(
+        leopard.generator_matrix(k), leopard.decode_matrix(k, use)
+    )
+
+
+@functools.lru_cache(maxsize=16)  # each closure pins a device bit matrix
+def repair_axes_fn(k: int, present: tuple[int, ...]):
+    """Jitted BATCHED erasure repair for one shared pattern: the
+    TPU-native path for the common DA-repair shape, where whole COLUMNS of
+    the square are missing and every row therefore has the same erasure
+    pattern. Repairing n axes collapses into one MXU bit-matmul over the
+    batch — (bits·2k, bits·k) @ (n, bits·k, S) — instead of rsmt2d's
+    per-axis heap decodes.
+
+    Returns run((n, 2k, SHARE) uint8, garbage at missing) -> (n, 2k, SHARE)
+    full codewords. NOTE the output is the full RE-ENCODE from the first k
+    sorted present positions: for a consistent codeword it equals
+    repair_axis's output bit-for-bit (tests/test_repair.py), but any EXTRA
+    present shares are overwritten rather than passed through — a caller
+    doing byzantine DETECTION must compare output vs input at present
+    positions (or use the per-axis repair_axis, which preserves them)."""
+    two_k = 2 * k
+    if len(present) < k:
+        raise ValueError(f"need at least {k} of {two_k} symbols")
+    use = tuple(sorted(present)[:k])
+    labels = _repair_label_matrix(k, use)
+    if leopard.uses_gf16(k):
+        bitmat = jnp.asarray(leopard.to_bit_matrix16(labels))
+    else:
+        bitmat = jnp.asarray(leopard.to_bit_matrix(labels))
+    if leopard.uses_gf16(k):
+        to_bits, from_bits = bytes_to_bits16, bits_to_bytes16
+    else:
+        to_bits, from_bits = bytes_to_bits, bits_to_bytes
+
+    @jax.jit
+    def run(symbols_batch: jax.Array) -> jax.Array:
+        x = symbols_batch[:, list(use), :]
+        return from_bits(_gf_mix(bitmat, to_bits(x))).astype(jnp.uint8)
+
+    return run
+
+
 def repair_axis(symbols: np.ndarray, present: list[int]) -> np.ndarray:
     """Recover all 2k symbols of one row/column from any k known ones.
 

@@ -515,3 +515,16 @@ def test_same_sender_nonce_order_survives_priority(tmp_path):
     blk, cert = net.produce_height(t=1_700_000_010.0)
     assert blk is not None
     assert list(blk.txs) == [low.encode(), high.encode()]
+
+
+def test_validator_mempool_rejects_oversize_tx(tmp_path):
+    """Code-review regression: the validator admission path enforces the
+    same mempool byte cap as Node (a gRPC-submitted giant tx must not
+    reach a proposal)."""
+    from celestia_app_tpu import appconsts
+
+    net, signer, privs = _network(tmp_path, with_disk=False)
+    giant = b"\x00" * (appconsts.MEMPOOL_MAX_TX_BYTES + 1)
+    res = net.nodes[0].add_tx(giant)
+    assert res.code != 0 and "max bytes" in res.log
+    assert net.nodes[0].mempool == []
