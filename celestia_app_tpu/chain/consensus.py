@@ -231,11 +231,11 @@ class ValidatorNode:
         (in-process bus, HTTP validator service, gRPC) share ONE admission
         path, including the mempool byte cap Node enforces
         (default_overrides.go:271-273)."""
-        from celestia_app_tpu import appconsts
-        from celestia_app_tpu.chain.block import TxResult
+        from celestia_app_tpu.chain.node import check_mempool_size
 
-        if len(raw) > appconsts.MEMPOOL_MAX_TX_BYTES:
-            return TxResult(1, "tx exceeds mempool max bytes", 0, 0, [])
+        oversize = check_mempool_size(raw)
+        if oversize is not None:
+            return oversize
         res = self.app.check_tx(raw)
         if res.code == 0:
             self.mempool.append(raw)

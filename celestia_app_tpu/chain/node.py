@@ -31,6 +31,15 @@ class MempoolTx:
 COMMITTED_INDEX_WINDOW = 1000
 
 
+def check_mempool_size(raw: bytes) -> "TxResult | None":
+    """THE mempool byte-cap gate (MaxTxBytes, default_overrides.go:271-273),
+    shared by Node and ValidatorNode admission so they can never disagree
+    on which txs fit. None = within the cap."""
+    if len(raw) > appconsts.MEMPOOL_MAX_TX_BYTES:
+        return TxResult(1, "tx exceeds mempool max bytes", 0, 0, [])
+    return None
+
+
 def record_committed(index: dict, block: "Block", results) -> None:
     """THE committed-tx index recorder (tx-hash -> (height, result)), shared
     by Node and ValidatorNode so the gRPC GetTx/ConfirmTx contract stays
@@ -84,8 +93,9 @@ class Node:
 
     def broadcast_tx(self, raw: bytes) -> TxResult:
         """BroadcastMode_SYNC: run CheckTx, admit to the mempool on success."""
-        if len(raw) > appconsts.MEMPOOL_MAX_TX_BYTES:
-            return TxResult(1, "tx exceeds mempool max bytes", 0, 0, [])
+        oversize = check_mempool_size(raw)
+        if oversize is not None:
+            return oversize
         res = self.app.check_tx(raw)
         if res.code == 0:
             btx = blob_mod.try_unmarshal_blob_tx(raw)  # single parse

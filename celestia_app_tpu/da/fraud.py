@@ -56,9 +56,6 @@ def leaf_ns(row: int, col: int, share: bytes, k: int) -> bytes:
     return share[:NS] if (row < k and col < k) else ns_mod.PARITY_NS_RAW
 
 
-_leaf_ns = leaf_ns  # backwards-compat alias for in-tree callers
-
-
 def _axis_tree(eds: ExtendedDataSquare, axis: str, index: int) -> nmt_host.NmtTree:
     """Axis NMT of a possibly-CORRUPT square: leaves appended without the
     namespace-order check (the malicious producer's tree — reference
@@ -69,7 +66,7 @@ def _axis_tree(eds: ExtendedDataSquare, axis: str, index: int) -> nmt_host.NmtTr
     for j in range(eds.width):
         r, c = (index, j) if axis == "row" else (j, index)
         share = eds.squares[r, c].tobytes()
-        tree.leaves.append((_leaf_ns(r, c, share, k), share))
+        tree.leaves.append((leaf_ns(r, c, share, k), share))
     return tree
 
 
@@ -131,7 +128,7 @@ def verify_befp(dah: DataAvailabilityHeader, befp: BadEncodingProof) -> bool:
                 return False
             seen.add(j)
             r, c = (befp.index, j) if befp.axis == "row" else (j, befp.index)
-            ns = _leaf_ns(r, c, swp.share, k)
+            ns = leaf_ns(r, c, swp.share, k)
             # the share must be committed at leaf `index` of orthogonal axis j
             if not swp.proof.verify(ortho_roots[j], [(ns, swp.share)]):
                 return False
@@ -149,7 +146,7 @@ def verify_befp(dah: DataAvailabilityHeader, befp: BadEncodingProof) -> bool:
         for j in range(width):
             r, c = (befp.index, j) if befp.axis == "row" else (j, befp.index)
             share = recovered[j].tobytes()
-            tree.leaves.append((_leaf_ns(r, c, share, k), share))
+            tree.leaves.append((leaf_ns(r, c, share, k), share))
         expected = nmt_host.serialize(tree.root())
         committed = (
             dah.row_roots[befp.index]

@@ -100,6 +100,30 @@ def repair_eds(
 
     while True:
         progress = False
+        # batched fast path: rows sharing one erasure pattern (whole
+        # columns missing — the dominant DA-repair shape) are decoded in a
+        # single device bit-matmul (ops/rs.repair_axes_fn). The per-axis
+        # root check below still gates every repaired row, so the batched
+        # re-encode cannot mask a byzantine axis.
+        patterns: dict[tuple[int, ...], list[int]] = {}
+        for r in range(two_k):
+            if verified_rows[r]:
+                continue
+            n = int(present[r].sum())
+            if k <= n < two_k:
+                patterns.setdefault(
+                    tuple(np.flatnonzero(present[r]).tolist()), []
+                ).append(r)
+        for pattern, rows in patterns.items():
+            if len(rows) < 2:
+                continue
+            run = rs.repair_axes_fn(k, pattern)
+            out = np.asarray(run(symbols[rows]))
+            for i, r in enumerate(rows):
+                symbols[r] = out[i]
+                _finish_row(r)
+                present[r] = True
+                progress = True
         for r in range(two_k):
             if verified_rows[r]:
                 continue

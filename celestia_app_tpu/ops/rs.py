@@ -276,13 +276,13 @@ def repair_axes_fn(k: int, present: tuple[int, ...]):
         raise ValueError(f"need at least {k} of {two_k} symbols")
     use = tuple(sorted(present)[:k])
     labels = _repair_label_matrix(k, use)
+    # one branch assigns the matched (matrix, packers) triple — the bit
+    # matrix and the bit packers must always come from the same field
     if leopard.uses_gf16(k):
         bitmat = jnp.asarray(leopard.to_bit_matrix16(labels))
-    else:
-        bitmat = jnp.asarray(leopard.to_bit_matrix(labels))
-    if leopard.uses_gf16(k):
         to_bits, from_bits = bytes_to_bits16, bits_to_bytes16
     else:
+        bitmat = jnp.asarray(leopard.to_bit_matrix(labels))
         to_bits, from_bits = bytes_to_bits, bits_to_bytes
 
     @jax.jit

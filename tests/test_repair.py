@@ -170,3 +170,31 @@ print("GF16-BATCH-REPAIR-OK")
                        capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stderr[-3000:]
     assert "GF16-BATCH-REPAIR-OK" in r.stdout
+
+
+def test_repair_eds_batched_path_with_byzantine_row():
+    """The in-repair batched fast path (several rows sharing one missing-
+    columns pattern) must still flag a byzantine axis: the re-encoded row
+    contradicts the committed root even though the batch repaired it."""
+    k = 4
+    ods = _square(k, seed=13)
+    honest = rs.extend_square_np(ods)
+    corrupt = honest.copy()
+    corrupt[2, 2 * k - 2] ^= 0x55  # row 2: inconsistent codeword
+    from tests.test_fraud import _dah_of
+
+    d_bad = _dah_of(corrupt)
+    present = np.ones((2 * k, 2 * k), dtype=bool)
+    present[:, k:] = False  # parity COLUMNS missing: all rows share pattern
+    damaged = np.where(present[..., None], corrupt, 0).astype(np.uint8)
+    with pytest.raises(repair.BadEncodingError) as exc:
+        repair.repair_eds(damaged, present,
+                          list(d_bad.row_roots), list(d_bad.col_roots))
+    assert (exc.value.axis, exc.value.index) == ("row", 2)
+
+    # and the honest square through the same shape repairs cleanly
+    d_ok, eds_ok = _committed(ods)
+    damaged_ok = np.where(present[..., None], eds_ok, 0).astype(np.uint8)
+    out = repair.repair_eds(damaged_ok, present,
+                            list(d_ok.row_roots), list(d_ok.col_roots))
+    np.testing.assert_array_equal(out, eds_ok)
