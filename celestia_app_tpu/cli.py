@@ -607,6 +607,10 @@ def cmd_txsim(args) -> int:
     node = Node(
         app, mempool_ttl=cfg.get("mempool_ttl_blocks", _consts.MEMPOOL_TX_TTL_BLOCKS)
     )
+    from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+
+    ctx = Context(app.store, InfiniteGasMeter(), app.height, 0,
+                  app.chain_id, app.app_version)
     signer = Signer(app.chain_id)
     accounts = []
     for i in range(args.accounts):
@@ -614,22 +618,23 @@ def cmd_txsim(args) -> int:
         # prints the matching address for genesis funding
         pk = PrivateKey.from_seed(str(i).encode())
         addr = pk.public_key().address()
-        from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
-
-        ctx = Context(app.store, InfiniteGasMeter(), app.height, 0,
-                      app.chain_id, app.app_version)
         acc = app.auth.account(ctx, addr)
         number = acc["number"] if acc else i
         sequence = acc["sequence"] if acc else 0
         signer.add_account(pk, number, sequence)
         accounts.append(addr)
+    validators = None
+    if args.stake_sequences:
+        validators = [op for op, _p in app.staking.validators(ctx)]
     rep = txsim.run(
         node, signer, accounts,
         rounds=args.rounds,
         blob_sequences=args.blob_sequences,
         send_sequences=args.send_sequences,
+        stake_sequences=args.stake_sequences,
         blob_sizes=tuple(int(x) for x in args.blob_sizes.split("-")),
         blobs_per_pfb=tuple(int(x) for x in args.blobs_per_pfb.split("-")),
+        validators=validators,
     )
     print(json.dumps(rep.as_dict(), indent=2))
     return 0
@@ -740,6 +745,7 @@ def main(argv=None) -> int:
     p.add_argument("--accounts", type=int, default=3)
     p.add_argument("--blob-sequences", type=int, default=2)
     p.add_argument("--send-sequences", type=int, default=1)
+    p.add_argument("--stake-sequences", type=int, default=0)
     p.add_argument("--blob-sizes", default="100-2000")
     p.add_argument("--blobs-per-pfb", default="1-3")
     p.set_defaults(fn=cmd_txsim)

@@ -22,12 +22,21 @@ from celestia_app_tpu.da.blob import Blob
 from celestia_app_tpu.da import commitment as commitment_mod
 
 _SEQ_RE = re.compile(r"expected (\d+), got (\d+)")
+_GAS_PRICE_RE = re.compile(r"insufficient gas price: [0-9.]+ < min ([0-9.]+)")
 
 
 def parse_expected_sequence(err: str) -> int | None:
     """app/errors/nonce_mismatch.go:13-30 equivalent."""
     m = _SEQ_RE.search(err)
     return int(m.group(1)) if m else None
+
+
+def parse_required_min_gas_price(err: str) -> float | None:
+    """app/errors/insufficient_gas_price.go analog: the gas-price floor the
+    node demands, parsed from the ante rejection (chain/ante.py step 4) so
+    the client can re-price and resubmit instead of failing the user."""
+    m = _GAS_PRICE_RE.search(err)
+    return float(m.group(1)) if m else None
 
 
 @dataclasses.dataclass
@@ -415,16 +424,34 @@ class TxClient:
             )
         return 100_000
 
+    def _recover_broadcast_failure(self, addr: bytes, res, gas: int,
+                                   fee: int) -> int | None:
+        """Shared resubmission logic (tx_client.go:330-360 + app/errors):
+        a sequence mismatch resyncs the signer; an insufficient-gas-price
+        rejection re-prices against the node's parsed floor. Returns the
+        new fee to retry with, or None when the failure is terminal."""
+        expected = parse_expected_sequence(res.log)
+        if expected is not None:
+            self.signer.accounts[addr].sequence = expected
+            return fee
+        floor = parse_required_min_gas_price(res.log)
+        if floor is not None:
+            return max(fee + 1, int(gas * floor) + 1)
+        return None
+
     def submit_pay_for_blob(self, addr: bytes, blobs: list[Blob]):
         """Estimate gas (simulate, falling back to the linear model), sign,
-        broadcast, confirm; resubmit once on a sequence mismatch
-        (tx_client.go:357 + nonce parsing). Blob commitments — the dominant
-        client-side hashing cost — are computed exactly once."""
+        broadcast, confirm; resubmit once on a sequence mismatch or an
+        insufficient gas price (tx_client.go:357 + app/errors parsing).
+        Blob commitments — the dominant client-side hashing cost — are
+        computed exactly once."""
         pfb_msg = self.signer.build_pfb_msg(addr, blobs)
         gas = self.estimate_gas(addr, [], blobs, pfb_msg=pfb_msg)
         fee = max(1, int(gas * self._gas_price()) + 1)
 
-        for _attempt in range(2):
+        # 3 attempts: the two recoverable classes (stale sequence, price
+        # below floor) can BOTH occur on one tx, each burning one attempt
+        for _attempt in range(3):
             raw = self.signer.create_pay_for_blobs(
                 addr, blobs, fee=fee, gas_limit=gas, msg=pfb_msg
             )
@@ -438,16 +465,16 @@ class TxClient:
                 if isinstance(self.node, (HttpNodeClient, GrpcNodeClient)):
                     return self.node.confirm_tx(raw, attempts=10, interval=1.0)
                 return self.node.confirm_tx(raw)
-            expected = parse_expected_sequence(res.log)
-            if expected is None:
+            new_fee = self._recover_broadcast_failure(addr, res, gas, fee)
+            if new_fee is None:
                 raise RuntimeError(f"broadcast failed: {res.log}")
-            self.signer.accounts[addr].sequence = expected
-        raise RuntimeError("sequence resubmission failed")
+            fee = new_fee
+        raise RuntimeError("resubmission failed")
 
     def submit_send(self, addr: bytes, to: bytes, amount: int):
         gas = 100_000
         fee = max(1, int(gas * self._gas_price()) + 1)
-        for _attempt in range(2):
+        for _attempt in range(3):  # see submit_pay_for_blob's budget note
             tx = self.signer.create_tx(
                 addr, [MsgSend(addr, to, amount)], fee=fee, gas_limit=gas
             )
@@ -455,8 +482,8 @@ class TxClient:
             if res.code == 0:
                 self.signer.accounts[addr].sequence += 1
                 return self.node.confirm_tx(tx.encode())
-            expected = parse_expected_sequence(res.log)
-            if expected is None:
+            new_fee = self._recover_broadcast_failure(addr, res, gas, fee)
+            if new_fee is None:
                 raise RuntimeError(f"broadcast failed: {res.log}")
-            self.signer.accounts[addr].sequence = expected
-        raise RuntimeError("sequence resubmission failed")
+            fee = new_fee
+        raise RuntimeError("resubmission failed")

@@ -1,12 +1,15 @@
 """txsim: transaction load generator (reference test/txsim/run.go analog).
 
-Drives a node with a configurable mix of sequences — send sequences and
-blob sequences with size/count distributions (test/txsim/blob.go's ranges)
-— either in-process (Node object) or over the HTTP service. Reports
-per-type submission counts, acceptance, and blocks produced.
+Drives a node with a configurable mix of sequences — send sequences, blob
+sequences with size/count distributions (test/txsim/blob.go's ranges), and
+stake sequences alternating delegate/undelegate against the validator set
+(test/txsim/stake.go) — either in-process (Node object) or over the HTTP
+service. Reports per-type submission counts, acceptance, and blocks
+produced.
 
 Usage (CLI): python -m celestia_app_tpu txsim --blob-sequences 2 \
-    --send-sequences 2 --blob-sizes 100-2000 --blobs-per-pfb 1-3 --rounds 5
+    --send-sequences 2 --stake-sequences 1 --blob-sizes 100-2000 \
+    --blobs-per-pfb 1-3 --rounds 5
 """
 
 from __future__ import annotations
@@ -27,6 +30,8 @@ class TxSimReport:
     pfbs_accepted: int = 0
     sends_submitted: int = 0
     sends_accepted: int = 0
+    stakes_submitted: int = 0
+    stakes_accepted: int = 0
     bytes_submitted: int = 0
 
     def as_dict(self) -> dict:
@@ -40,8 +45,10 @@ def run(
     rounds: int = 5,
     blob_sequences: int = 2,
     send_sequences: int = 1,
+    stake_sequences: int = 0,
     blob_sizes: tuple[int, int] = (100, 2000),
     blobs_per_pfb: tuple[int, int] = (1, 3),
+    validators: list[bytes] | None = None,
     seed: int = 0,
     block_time: float | None = None,
 ) -> TxSimReport:
@@ -51,16 +58,23 @@ def run(
     Each sequence OWNS one account (run.go:52: sequences get dedicated
     accounts) — normal txs order before blob txs inside a block, so a
     same-account blob+send mix would break sequence continuity by design.
-    Needs len(accounts) >= blob_sequences + send_sequences."""
-    from celestia_app_tpu.chain.tx import MsgSend
+    Needs len(accounts) >= blob_sequences + send_sequences +
+    stake_sequences; stake sequences additionally need `validators`
+    (operator addresses to delegate to — test/txsim/stake.go)."""
+    from celestia_app_tpu.chain.tx import MsgDelegate, MsgSend, MsgUndelegate
 
-    if len(accounts) < blob_sequences + send_sequences:
+    n_seq = blob_sequences + send_sequences + stake_sequences
+    if len(accounts) < n_seq:
         raise ValueError(
-            f"need {blob_sequences + send_sequences} accounts (one per "
-            f"sequence), got {len(accounts)}"
+            f"need {n_seq} accounts (one per sequence), got {len(accounts)}"
         )
+    if stake_sequences and not validators:
+        raise ValueError("stake sequences need validator operator addresses")
     rng = np.random.default_rng(seed)
     rep = TxSimReport()
+    # per (stake sequence, validator) running total of what WE delegated,
+    # so undelegates always target a validator with enough of our stake
+    staked: dict[tuple[int, bytes], int] = {}
     t = block_time if block_time is not None else 1_800_000_000.0
     for rnd in range(rounds):
         for seq in range(blob_sequences):
@@ -92,6 +106,32 @@ def run(
             if node.broadcast_tx(tx.encode()).code == 0:
                 rep.sends_accepted += 1
                 signer.accounts[a].sequence += 1
+        for seq in range(stake_sequences):
+            # stake.go's loop: delegate on even rounds; on odd rounds
+            # undelegate PART OF WHAT THIS SEQUENCE DELEGATED (tracked per
+            # validator — undelegating stake we never placed would just
+            # bounce off the staking keeper)
+            a = accounts[blob_sequences + send_sequences + seq]
+            funded = [
+                (s, v) for (s, v), amt in staked.items()
+                if s == seq and amt > 0
+            ]
+            if rnd % 2 == 0 or not funded:
+                val = validators[(rnd + seq) % len(validators)]
+                amount = int(rng.integers(1_000, 100_000))
+                msg = MsgDelegate(a, val, amount)
+                delta = amount
+            else:
+                _s, val = funded[int(rng.integers(0, len(funded)))]
+                amount = max(1, staked[(seq, val)] // 2)
+                msg = MsgUndelegate(a, val, amount)
+                delta = -amount
+            tx = signer.create_tx(a, [msg], fee=4000, gas_limit=300_000)
+            rep.stakes_submitted += 1
+            if node.broadcast_tx(tx.encode()).code == 0:
+                rep.stakes_accepted += 1
+                signer.accounts[a].sequence += 1
+                staked[(seq, val)] = staked.get((seq, val), 0) + delta
         t += 6.0
         node.produce_block(t=t)
         rep.blocks += 1

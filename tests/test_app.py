@@ -412,3 +412,31 @@ def test_v1_max_total_blob_size_checktx_gate():
     res = app.check_tx(raw)
     assert res.code != 0
     assert "total blob size" in res.log
+
+
+def test_client_reprices_on_insufficient_gas_price():
+    """app/errors/insufficient_gas_price.go analog: a client priced below
+    the node's floor parses the required floor from the rejection,
+    re-prices, and the resubmission commits."""
+    from celestia_app_tpu.client.tx_client import (
+        parse_required_min_gas_price,
+    )
+
+    app, signer, privs = make_app()
+    node = Node(app)
+    # client believes gas is nearly free; the node's floor says otherwise
+    client = TxClient(node, signer, gas_multiplier=1.1)
+    client.default_gas_price = 1e-12
+    a = privs[0].public_key().address()
+    b = privs[1].public_key().address()
+    height, res = client.submit_send(a, b, 77)
+    assert res.code == 0
+    from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+
+    ctx = Context(app.store, InfiniteGasMeter(), app.height, 0, CHAIN, 1)
+    assert app.bank.balance(ctx, b) == 10**12 + 77
+
+    # the parser itself, against the ante's exact message shape
+    msg = "insufficient gas price: 0.000000010 < min 0.002000000"
+    assert parse_required_min_gas_price(msg) == 0.002
+    assert parse_required_min_gas_price("some other error") is None

@@ -214,6 +214,34 @@ def test_txsim_full_acceptance(tmp_path):
     assert rep.blocks == 3
 
 
+def test_txsim_stake_sequences(tmp_path):
+    """Stake sequences (test/txsim/stake.go): alternating delegate /
+    undelegate against the validator set, every tx accepted and the
+    delegation visible in state."""
+    from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+    from celestia_app_tpu.tools import txsim
+
+    app, signer, privs = _persistent_app(tmp_path)
+    node = Node(app)
+    accounts = [p.public_key().address() for p in privs]
+    ctx = Context(app.store, InfiniteGasMeter(), app.height, 0,
+                  CHAIN, app.app_version)
+    validators = [op for op, _p in app.staking.validators(ctx)]
+    rep = txsim.run(node, signer, accounts, rounds=4, blob_sequences=1,
+                    send_sequences=1, stake_sequences=1,
+                    validators=validators)
+    assert rep.stakes_accepted == rep.stakes_submitted == 4
+    assert rep.pfbs_accepted == 4 and rep.sends_accepted == 4
+    # the staker holds live delegations after the run
+    staker = accounts[2]
+    ctx2 = Context(app.store, InfiniteGasMeter(), app.height, 0,
+                   CHAIN, app.app_version)
+    total = sum(
+        app.staking.delegation(ctx2, v, staker) for v in validators
+    )
+    assert total > 0
+
+
 def test_export_genesis_reproduces_state(tmp_path):
     from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
     from celestia_app_tpu.chain.staking import POWER_REDUCTION
