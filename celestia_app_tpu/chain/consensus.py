@@ -449,10 +449,19 @@ class ValidatorNode:
 
         record_committed(self.committed, block, results)
 
-    # GrpcTxServer speaks to anything exposing broadcast_tx/app/committed;
-    # a validator process IS that node (one binary per validator)
+    # GrpcTxServer and NodeService speak to anything exposing
+    # broadcast_tx/app/committed; a validator process IS that node
+    # (one binary per validator)
     def broadcast_tx(self, raw: bytes):
         return self.add_tx(raw)
+
+    def produce_block(self, t: float | None = None):
+        """Blocked on purpose: a validator's blocks come from consensus
+        (the socket round schedule), never from a local convenience route
+        — NodeService's /produce_block surfaces this as an error."""
+        raise ValueError(
+            "validator blocks are produced by consensus, not on demand"
+        )
 
     def replay_wal(self) -> int:
         """Crash recovery: apply WAL entries above the committed height

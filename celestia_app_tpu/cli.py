@@ -258,6 +258,16 @@ def cmd_validator_serve(args) -> int:
     replayed = vnode.replay_wal()
     svc = ValidatorService(vnode, port=args.port)
     endpoint = {"host": "127.0.0.1", "port": svc.port}
+    http_service = None
+    if args.http is not None:
+        # the node query surface (status/block/abci_query incl. proof
+        # routes, /trace, /metrics) from the same process
+        from celestia_app_tpu.service.server import NodeService
+
+        http_service = NodeService(vnode, port=args.http)
+        http_service.lock = svc.lock  # one writer lock for the process
+        http_service.serve_background()
+        endpoint["http_port"] = http_service.port
     grpc_server = None
     if args.grpc is not None:
         # the full client surface on the SAME process (one binary per
@@ -285,6 +295,8 @@ def cmd_validator_serve(args) -> int:
     finally:
         if grpc_server is not None:
             grpc_server.stop()
+        if http_service is not None:
+            http_service.shutdown()
     return 0
 
 
@@ -704,6 +716,9 @@ def main(argv=None) -> int:
     p.add_argument("--grpc", type=int, default=None,
                    help="also serve the cosmos gRPC surface on this port "
                         "(0 = ephemeral)")
+    p.add_argument("--http", type=int, default=None,
+                   help="also serve the node HTTP query surface (status/"
+                        "block/abci_query/trace/metrics; 0 = ephemeral)")
     p.set_defaults(fn=cmd_validator_serve)
 
     p = sub.add_parser("addr-conversion")

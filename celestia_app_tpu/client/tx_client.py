@@ -436,6 +436,9 @@ class TxClient:
             return fee
         floor = parse_required_min_gas_price(res.log)
         if floor is not None:
+            # remember the node's floor: only the FIRST underpriced tx pays
+            # the extra rejected round-trip
+            self.default_gas_price = max(self._gas_price(), floor)
             return max(fee + 1, int(gas * floor) + 1)
         return None
 
@@ -469,7 +472,7 @@ class TxClient:
             if new_fee is None:
                 raise RuntimeError(f"broadcast failed: {res.log}")
             fee = new_fee
-        raise RuntimeError("resubmission failed")
+        raise RuntimeError(f"resubmission failed; last rejection: {res.log}")
 
     def submit_send(self, addr: bytes, to: bytes, amount: int):
         gas = 100_000
@@ -486,4 +489,4 @@ class TxClient:
             if new_fee is None:
                 raise RuntimeError(f"broadcast failed: {res.log}")
             fee = new_fee
-        raise RuntimeError("resubmission failed")
+        raise RuntimeError(f"resubmission failed; last rejection: {res.log}")

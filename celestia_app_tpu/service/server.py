@@ -146,7 +146,10 @@ class NodeService:
                         self._send(200, out)
                     else:
                         self._send(404, {"error": f"no route {self.path}"})
-                except QueryError as e:
+                except (QueryError, ValueError) as e:
+                    # ValueError = client-side problem (bad payload, or a
+                    # policy refusal like a validator's /produce_block):
+                    # a 4xx, not a 5xx that trips server-health monitoring
                     self._send(400, {"error": str(e)})
                 except Exception as e:
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})

@@ -293,7 +293,8 @@ def test_grpc_surface_on_validator_process(tmp_path):
             os.unlink(ep)
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "celestia_app_tpu", "validator-serve",
-             "--home", home, "--chain-id", CHAIN, "--grpc", "0"],
+             "--home", home, "--chain-id", CHAIN, "--grpc", "0",
+             "--http", "0"],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         ))
     try:
@@ -333,6 +334,33 @@ def test_grpc_surface_on_validator_process(tmp_path):
         heights = {p.status()["height"] for p in net.peers}
         hashes = {p.status()["app_hash"] for p in net.peers}
         assert len(hashes) == 1 and max(heights) >= conf["height"]
+
+        # the same process serves the node HTTP query surface (--http):
+        # status, stored blocks, prometheus metrics; on-demand block
+        # production is refused (blocks come from consensus)
+        import urllib.error
+        import urllib.request
+
+        with open(os.path.join(homes[0], "endpoint.json")) as f:
+            http_port = json.load(f)["http_port"]
+        base = f"http://127.0.0.1:{http_port}"
+        with urllib.request.urlopen(base + "/status") as r:
+            st = json.loads(r.read())
+        assert st["chain_id"] == CHAIN and st["height"] >= conf["height"]
+        with urllib.request.urlopen(base + f"/block/{conf['height']}") as r:
+            blk_doc = json.loads(r.read())
+        assert blk_doc["height"] == conf["height"] and blk_doc["txs"]
+        with urllib.request.urlopen(base + "/metrics") as r:
+            assert b"# TYPE" in r.read()
+        req = urllib.request.Request(
+            base + "/produce_block", data=b"{}",
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            urllib.request.urlopen(req)
+            raise AssertionError("/produce_block must be refused")
+        except urllib.error.HTTPError as e:
+            assert b"consensus" in e.read()
     finally:
         for pr in procs:
             try:
