@@ -458,8 +458,11 @@ class ValidatorNode:
     def produce_block(self, t: float | None = None):
         """Blocked on purpose: a validator's blocks come from consensus
         (the socket round schedule), never from a local convenience route
-        — NodeService's /produce_block surfaces this as an error."""
-        raise ValueError(
+        — NodeService's /produce_block surfaces this as a 400 policy
+        refusal (QueryError), not a server error."""
+        from celestia_app_tpu.chain.query import QueryError
+
+        raise QueryError(
             "validator blocks are produced by consensus, not on demand"
         )
 

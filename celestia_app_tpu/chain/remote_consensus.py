@@ -37,7 +37,8 @@ class RemoteValidator:
         self.url = url.rstrip("/")
         self.timeout = timeout
 
-    def _call(self, method: str, path: str, payload: dict | None = None) -> dict:
+    def _call(self, method: str, path: str, payload: dict | None = None,
+              timeout: float | None = None) -> dict:
         try:
             if method == "GET":
                 req = urllib.request.Request(self.url + path)
@@ -48,7 +49,9 @@ class RemoteValidator:
                     headers={"Content-Type": "application/json"},
                     method="POST",
                 )
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            with urllib.request.urlopen(
+                req, timeout=timeout if timeout is not None else self.timeout
+            ) as r:
                 return json.loads(r.read())
         except urllib.error.HTTPError as e:
             body = e.read().decode(errors="replace")
@@ -56,8 +59,8 @@ class RemoteValidator:
         except (urllib.error.URLError, OSError, TimeoutError) as e:
             raise PeerDown(f"{self.url}{path}: {e}") from None
 
-    def status(self) -> dict:
-        return self._call("GET", "/consensus/status")
+    def status(self, timeout: float | None = None) -> dict:
+        return self._call("GET", "/consensus/status", timeout=timeout)
 
     def broadcast_tx(self, raw: bytes) -> dict:
         import base64
@@ -65,28 +68,32 @@ class RemoteValidator:
         return self._call("POST", "/broadcast_tx",
                           {"tx": base64.b64encode(raw).decode()})
 
-    def propose(self, t: float) -> dict:
-        return self._call("POST", "/consensus/propose", {"time": t})["block"]
+    def propose(self, t: float, timeout: float | None = None) -> dict:
+        return self._call("POST", "/consensus/propose", {"time": t},
+                          timeout=timeout)["block"]
 
-    def prevote(self, block_json: dict) -> c.Vote:
-        out = self._call("POST", "/consensus/prevote", {"block": block_json})
+    def prevote(self, block_json: dict,
+                timeout: float | None = None) -> c.Vote:
+        out = self._call("POST", "/consensus/prevote",
+                         {"block": block_json}, timeout=timeout)
         return c.vote_from_json(out["vote"])
 
     def precommit(self, block_json: dict | None, polka: bool,
-                  prevotes: list[dict], round_: int) -> c.Vote:
+                  prevotes: list[dict], round_: int,
+                  timeout: float | None = None) -> c.Vote:
         out = self._call("POST", "/consensus/precommit", {
             "block": block_json, "polka": polka,
             "prevotes": prevotes, "round": round_,
-        })
+        }, timeout=timeout)
         return c.vote_from_json(out["vote"])
 
     def commit(self, block_json: dict, cert: c.CommitCertificate,
-               evidence=()) -> dict:
+               evidence=(), timeout: float | None = None) -> dict:
         return self._call("POST", "/consensus/commit", {
             "block": block_json,
             "cert": c.cert_to_json(cert),
             "evidence": [c.evidence_to_json(e) for e in evidence],
-        })
+        }, timeout=timeout)
 
     def sync_from(self, peer_url: str) -> dict:
         return self._call("POST", "/consensus/sync", {"peer": peer_url})
@@ -121,6 +128,21 @@ class SocketNetwork:
         self._vote_pool: list[c.Vote] = []
 
     EVIDENCE_MAX_AGE = 10
+    # Wall-clock phase timeouts, escalating per failed round — Tendermint's
+    # timeout cascade shape (TimeoutPropose + delta/round,
+    # consensus_consts.go), with windows sized LARGER than the reference's
+    # 10s/500ms because a first propose/prevote may pay a cold jit compile
+    # of the extend pipeline (tens of seconds on TPU, minutes on a virtual
+    # CPU mesh). A peer that cannot answer inside the window counts as
+    # absent for the phase, and the round rotates if quorum is lost.
+    TIMEOUT_PROPOSE_S = 120.0
+    TIMEOUT_VOTE_S = 120.0
+    TIMEOUT_COMMIT_S = 120.0
+    TIMEOUT_STATUS_S = 10.0  # liveness checks must fail fast
+    TIMEOUT_DELTA_S = 15.0  # added per failed round
+
+    def _phase_timeout(self, base: float) -> float:
+        return base + self._round * self.TIMEOUT_DELTA_S
 
     # -- helpers ---------------------------------------------------------
 
@@ -128,7 +150,7 @@ class SocketNetwork:
         out = []
         for p in self.peers:
             try:
-                out.append((p, p.status()))
+                out.append((p, p.status(timeout=self.TIMEOUT_STATUS_S)))
             except PeerDown:
                 continue
         return out
@@ -161,7 +183,9 @@ class SocketNetwork:
         proposer_idx = (height + self._round) % len(self.peers)
         proposer = self.peers[proposer_idx]
         try:
-            block_json = proposer.propose(t)
+            block_json = proposer.propose(
+                t, timeout=self._phase_timeout(self.TIMEOUT_PROPOSE_S)
+            )
         except (PeerDown, ValueError):
             self._round += 1
             return None, None
@@ -170,9 +194,10 @@ class SocketNetwork:
 
         # prevote phase (over sockets)
         prevotes: list[c.Vote] = []
+        vote_timeout = self._phase_timeout(self.TIMEOUT_VOTE_S)
         for p, _st in participants:
             try:
-                prevotes.append(p.prevote(block_json))
+                prevotes.append(p.prevote(block_json, timeout=vote_timeout))
             except (PeerDown, ValueError):
                 continue
         # prevotes stay out of the evidence pool (cross-round prevotes for
@@ -192,7 +217,8 @@ class SocketNetwork:
             try:
                 precommits.append(
                     p.precommit(block_json if polka else None, polka,
-                                prevote_jsons, self._round)
+                                prevote_jsons, self._round,
+                                timeout=vote_timeout)
                 )
             except (PeerDown, ValueError):
                 continue
@@ -217,9 +243,11 @@ class SocketNetwork:
             ]
 
         hashes = {}
+        commit_timeout = self._phase_timeout(self.TIMEOUT_COMMIT_S)
         for p, _st in participants:
             try:
-                out = p.commit(block_json, cert, evidence)
+                out = p.commit(block_json, cert, evidence,
+                               timeout=commit_timeout)
                 hashes[out["app_hash"]] = out["height"]
             except (PeerDown, ValueError):
                 continue

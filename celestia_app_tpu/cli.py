@@ -330,13 +330,14 @@ def _devnet_processes(args, privs, genesis) -> int:
                 os.unlink(ep)
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "celestia_app_tpu", "validator-serve",
-                 "--home", home, "--chain-id", args.chain_id],
+                 "--home", home, "--chain-id", args.chain_id,
+                 "--grpc", "0", "--http", "0"],
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             ))
             homes.append(home)
 
         peers = []
-        for home in homes:
+        for i, home in enumerate(homes):
             ep = os.path.join(home, "endpoint.json")
             for _ in range(200):  # first process start imports jax: slow
                 if os.path.exists(ep):
@@ -348,6 +349,12 @@ def _devnet_processes(args, privs, genesis) -> int:
                 doc = json.load(f)
             peers.append(
                 RemoteValidator(f"http://{doc['host']}:{doc['port']}")
+            )
+            print(
+                f"val{i}: consensus http://{doc['host']}:{doc['port']}, "
+                f"grpc :{doc.get('grpc_port')}, "
+                f"http :{doc.get('http_port')}",
+                file=sys.stderr,
             )
         net = SocketNetwork(peers, genesis, args.chain_id)
 

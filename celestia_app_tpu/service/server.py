@@ -100,6 +100,10 @@ class NodeService:
                         })
                     else:
                         self._send(404, {"error": f"no route {self.path}"})
+                except (QueryError, ValueError) as e:
+                    # GET-side ValueErrors are path/query parse failures
+                    # (non-integer height, bad since=): client errors
+                    self._send(400, {"error": str(e)})
                 except Exception as e:
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
@@ -146,10 +150,12 @@ class NodeService:
                         self._send(200, out)
                     else:
                         self._send(404, {"error": f"no route {self.path}"})
-                except (QueryError, ValueError) as e:
-                    # ValueError = client-side problem (bad payload, or a
-                    # policy refusal like a validator's /produce_block):
-                    # a 4xx, not a 5xx that trips server-health monitoring
+                except QueryError as e:
+                    # client-side problem or policy refusal (e.g. a
+                    # validator's /produce_block): 4xx, not a 5xx that
+                    # trips server-health monitoring. Internal errors that
+                    # surface as bare ValueError stay 500 on purpose — a
+                    # failing node must look unhealthy.
                     self._send(400, {"error": str(e)})
                 except Exception as e:
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
