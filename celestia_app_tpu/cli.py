@@ -139,6 +139,11 @@ def cmd_start(args) -> int:
         + f", block time {args.block_time}s",
         file=sys.stderr,
     )
+    snap_interval = cfg.get(
+        "snapshot_interval_blocks", appconsts.SNAPSHOT_INTERVAL_BLOCKS
+    )
+    snap_keep = cfg.get("snapshot_keep_recent", appconsts.SNAPSHOT_KEEP_RECENT)
+    snap_root = os.path.join(args.home, "snapshots")
     produced = 0
     try:
         while args.blocks is None or produced < args.blocks:
@@ -152,6 +157,25 @@ def cmd_start(args) -> int:
                 f"data root {blk.header.data_hash.hex()[:16]}",
                 file=sys.stderr,
             )
+            if snap_interval and blk.header.height % snap_interval == 0:
+                # interval state-sync snapshots with keep-recent pruning
+                # (default_overrides.go:294-297: interval 1500, keep 2).
+                # Only the in-memory state CAPTURE needs the service lock;
+                # chunk/manifest disk writes happen outside it so queries
+                # and tx submission never stall on snapshot I/O.
+                from celestia_app_tpu.chain import consensus as _cons
+
+                with svc.lock:
+                    m, chunks = _cons.snapshot_app_chunks(app)
+                _write_snapshot_files(
+                    m, chunks, os.path.join(snap_root, str(blk.header.height))
+                )
+                _prune_snapshots(snap_root, snap_keep)
+                print(
+                    f"snapshot at height {m['height']} "
+                    f"({m['n_chunks']} chunks)",
+                    file=sys.stderr,
+                )
     except KeyboardInterrupt:
         pass
     finally:
@@ -510,6 +534,44 @@ def cmd_devnet(args) -> int:
     return 0
 
 
+def _write_snapshot_files(manifest: dict, chunks: list, out_dir: str) -> None:
+    """Persist already-captured snapshot chunks + manifest (manifest last,
+    so a half-written snapshot is never restorable)."""
+    os.makedirs(out_dir, exist_ok=True)
+    for i, c in enumerate(chunks):
+        with open(os.path.join(out_dir, f"chunk_{i:06d}.json"), "wb") as f:
+            f.write(c)
+    with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+
+
+def _write_snapshot(app, out_dir: str) -> dict:
+    """Capture + write the committed state as verified chunks; THE snapshot
+    writer shared by `snapshot create` and the start loop's interval
+    snapshots (which captures under the service lock but writes outside)."""
+    from celestia_app_tpu.chain import consensus
+
+    manifest, chunks = consensus.snapshot_app_chunks(app)
+    _write_snapshot_files(manifest, chunks, out_dir)
+    return manifest
+
+
+def _prune_snapshots(root: str, keep: int) -> None:
+    """Keep only the newest `keep` height-named snapshot dirs
+    (default_overrides.go:294-297 keep-recent; 0 = keep everything, the
+    sdk's snapshot-keep-recent semantics)."""
+    import shutil
+
+    if keep <= 0 or not os.path.isdir(root):
+        return
+    heights = sorted(
+        (int(name) for name in os.listdir(root) if name.isdigit()),
+        reverse=True,
+    )
+    for h in heights[keep:]:
+        shutil.rmtree(os.path.join(root, str(h)), ignore_errors=True)
+
+
 def cmd_snapshot(args) -> int:
     """State-sync snapshots (cmd/root.go snapshot commands +
     default_overrides.go:294-297 semantics): `create` writes the committed
@@ -520,13 +582,7 @@ def cmd_snapshot(args) -> int:
 
     if args.action == "create":
         app, _ = _make_app(args.home)
-        manifest, chunks = consensus.snapshot_app_chunks(app)
-        os.makedirs(args.out, exist_ok=True)
-        for i, c in enumerate(chunks):
-            with open(os.path.join(args.out, f"chunk_{i:06d}.json"), "wb") as f:
-                f.write(c)
-        with open(os.path.join(args.out, "manifest.json"), "w") as f:
-            json.dump(manifest, f, indent=2)
+        manifest = _write_snapshot(app, args.out)
         print(json.dumps({
             "height": manifest["height"],
             "chunks": manifest["n_chunks"],

@@ -762,3 +762,38 @@ def test_prometheus_metrics_endpoint(tmp_path):
             assert "_total " in body
     finally:
         svc.shutdown()
+
+
+def test_start_interval_snapshots_with_pruning(tmp_path):
+    """The node loop writes state-sync snapshots every N blocks and prunes
+    to keep-recent (default_overrides.go:294-297: interval 1500, keep 2 —
+    shrunk via config for the test), and a fresh home restores from the
+    newest one."""
+    from celestia_app_tpu import cli
+
+    home = str(tmp_path / "snapnode")
+    assert cli.main(["init", "--home", home]) == 0
+    cfg_path = os.path.join(home, "config.json")
+    cfg = json.load(open(cfg_path))
+    cfg["snapshot_interval_blocks"] = 2
+    cfg["snapshot_keep_recent"] = 1
+    json.dump(cfg, open(cfg_path, "w"))
+
+    assert cli.main(["start", "--home", home, "--blocks", "5",
+                     "--block-time", "0.01", "--listen", "0"]) == 0
+    snaps = sorted(os.listdir(os.path.join(home, "snapshots")))
+    assert snaps == ["4"], snaps  # heights 2 and 4 written, 2 pruned
+
+    # a fresh home bootstraps from the interval snapshot
+    dst = str(tmp_path / "joiner")
+    assert cli.main(["init", "--home", dst]) == 0
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(["snapshot", "restore", "--home", dst, "--out",
+                       os.path.join(home, "snapshots", "4")])
+    assert rc == 0
+    out = json.loads(buf.getvalue())
+    assert out["restored_height"] == 4
