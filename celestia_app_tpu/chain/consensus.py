@@ -508,10 +508,25 @@ class ValidatorNode:
 SNAPSHOT_CHUNK_KEYS = 64
 
 
-def snapshot_app_chunks(app: App) -> tuple[dict, list[bytes]]:
-    """(manifest, chunks): the committed store split into deterministic
-    key-ranged chunks (state-sync serving, default_overrides.go:294)."""
-    items = sorted(app.store.snapshot().items())
+def capture_app_snapshot(app: App) -> dict:
+    """The part that must run under the node's writer lock: copy the
+    committed store + chain identity at one instant. Cheap (dict copy);
+    the expensive chunk encoding happens in encode_app_snapshot, safely
+    outside the lock."""
+    return {
+        "items": dict(app.store.snapshot()),
+        "height": app.height,
+        "app_hash": app.last_app_hash.hex(),
+        "app_version": app.app_version,
+        "chain_id": app.chain_id,
+        "genesis_time": app.genesis_time,
+        "last_block_hash": app.last_block_hash.hex(),
+    }
+
+
+def encode_app_snapshot(capture: dict) -> tuple[dict, list[bytes]]:
+    """Pure: deterministic key-ranged chunks + manifest from a capture."""
+    items = sorted(capture["items"].items())
     chunks: list[bytes] = []
     for i in range(0, max(len(items), 1), SNAPSHOT_CHUNK_KEYS):
         part = items[i : i + SNAPSHOT_CHUNK_KEYS]
@@ -521,16 +536,19 @@ def snapshot_app_chunks(app: App) -> tuple[dict, list[bytes]]:
             ).encode()
         )
     manifest = {
-        "height": app.height,
-        "app_hash": app.last_app_hash.hex(),
-        "app_version": app.app_version,
-        "chain_id": app.chain_id,
-        "genesis_time": app.genesis_time,
-        "last_block_hash": app.last_block_hash.hex(),
+        **{k: v for k, v in capture.items() if k != "items"},
         "n_chunks": len(chunks),
         "chunk_hashes": [hashlib.sha256(c).hexdigest() for c in chunks],
     }
     return manifest, chunks
+
+
+def snapshot_app_chunks(app: App) -> tuple[dict, list[bytes]]:
+    """(manifest, chunks): the committed store split into deterministic
+    key-ranged chunks (state-sync serving, default_overrides.go:294).
+    One-shot convenience; lock-conscious callers split into
+    capture_app_snapshot (under lock) + encode_app_snapshot (outside)."""
+    return encode_app_snapshot(capture_app_snapshot(app))
 
 
 def state_sync_bootstrap(node_or_app, manifest: dict, chunks: list[bytes]) -> None:

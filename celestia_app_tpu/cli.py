@@ -160,22 +160,29 @@ def cmd_start(args) -> int:
             if snap_interval and blk.header.height % snap_interval == 0:
                 # interval state-sync snapshots with keep-recent pruning
                 # (default_overrides.go:294-297: interval 1500, keep 2).
-                # Only the in-memory state CAPTURE needs the service lock;
-                # chunk/manifest disk writes happen outside it so queries
-                # and tx submission never stall on snapshot I/O.
+                # Only the state CAPTURE holds the service lock; chunk
+                # encoding and disk writes run outside it. Snapshots are
+                # auxiliary: any failure is logged, never fatal to block
+                # production.
                 from celestia_app_tpu.chain import consensus as _cons
 
-                with svc.lock:
-                    m, chunks = _cons.snapshot_app_chunks(app)
-                _write_snapshot_files(
-                    m, chunks, os.path.join(snap_root, str(blk.header.height))
-                )
-                _prune_snapshots(snap_root, snap_keep)
-                print(
-                    f"snapshot at height {m['height']} "
-                    f"({m['n_chunks']} chunks)",
-                    file=sys.stderr,
-                )
+                try:
+                    with svc.lock:
+                        cap = _cons.capture_app_snapshot(app)
+                    m, chunks = _cons.encode_app_snapshot(cap)
+                    _write_snapshot_files(
+                        m, chunks,
+                        os.path.join(snap_root, str(blk.header.height)),
+                    )
+                    _prune_snapshots(snap_root, snap_keep)
+                    print(
+                        f"snapshot at height {m['height']} "
+                        f"({m['n_chunks']} chunks)",
+                        file=sys.stderr,
+                    )
+                except Exception as e:
+                    print(f"snapshot at height {blk.header.height} "
+                          f"failed: {e}", file=sys.stderr)
     except KeyboardInterrupt:
         pass
     finally:
@@ -256,6 +263,30 @@ def cmd_tx(args) -> int:
     return 0 if res.code == 0 else 1
 
 
+def _ensure_home_config(home: str, chain_id: str) -> None:
+    """Make a validator home a first-class CLI --home: with config.json in
+    place (and data under <home>/data), `snapshot create`, `query`,
+    `export`, `blockscan` etc. all work against a stopped validator."""
+    from celestia_app_tpu import appconsts
+
+    cfg_path = os.path.join(home, "config.json")
+    if os.path.exists(cfg_path):
+        return
+    with open(cfg_path, "w") as f:
+        json.dump(
+            {
+                "chain_id": chain_id,
+                "app_version": 1,
+                "engine": "host",
+                "min_gas_price": appconsts.DEFAULT_MIN_GAS_PRICE,
+                "invariant_check_period": 0,
+                "v2_upgrade_height": None,
+                "mempool_ttl_blocks": appconsts.MEMPOOL_TX_TTL_BLOCKS,
+            },
+            f, indent=2,
+        )
+
+
 def cmd_validator_serve(args) -> int:
     """One validator as its own OS process (the reference's one-binary-per-
     validator deployment): loads key + genesis from --home, resumes durable
@@ -270,10 +301,29 @@ def cmd_validator_serve(args) -> int:
         genesis = json.load(f)
     with open(os.path.join(args.home, "key.json")) as f:
         key_doc = json.load(f)
+    _ensure_home_config(args.home, args.chain_id)
     priv = PrivateKey.from_seed(bytes.fromhex(key_doc["seed_hex"]))
+    # layout: validator state lives under <home>/data (so the home doubles
+    # as a CLI --home). A home written by the pre-round-4 layout kept state
+    # directly under <home>; silently ignoring it would restart the
+    # validator from genesis AND re-sign old heights — refuse loudly.
+    data_dir = os.path.join(args.home, "data")
+    legacy = [
+        p for p in ("state", "wal", "LATEST")
+        if os.path.exists(os.path.join(args.home, p))
+    ]
+    if legacy and not os.path.isdir(data_dir):
+        print(
+            f"ERROR: {args.home} holds pre-round-4 validator state "
+            f"({', '.join(legacy)}) at the home root; move it under "
+            f"{data_dir}/ before starting, or this validator would "
+            "silently reset to genesis and double-sign.",
+            file=sys.stderr,
+        )
+        return 1
     vnode = consensus.ValidatorNode(
         key_doc.get("name", "val"), priv, genesis, args.chain_id,
-        data_dir=args.home,
+        data_dir=data_dir,
     )
     try:
         vnode.app.load()  # resume at the durable committed height
@@ -466,13 +516,17 @@ def cmd_devnet(args) -> int:
     os.makedirs(args.home, exist_ok=True)
     if args.processes:
         return _devnet_processes(args, privs, genesis)
-    nodes = [
-        consensus.ValidatorNode(
+    nodes = []
+    for i in range(n):
+        home = os.path.join(args.home, f"val{i}")
+        os.makedirs(home, exist_ok=True)
+        with open(os.path.join(home, "genesis.json"), "w") as f:
+            json.dump(genesis, f)
+        _ensure_home_config(home, args.chain_id)
+        nodes.append(consensus.ValidatorNode(
             f"val{i}", privs[i], genesis, args.chain_id,
-            data_dir=os.path.join(args.home, f"val{i}"),
-        )
-        for i in range(n)
-    ]
+            data_dir=os.path.join(home, "data"),
+        ))
     net = consensus.LocalNetwork(nodes)
     services = []
     for vn in net.nodes:
@@ -546,9 +600,9 @@ def _write_snapshot_files(manifest: dict, chunks: list, out_dir: str) -> None:
 
 
 def _write_snapshot(app, out_dir: str) -> dict:
-    """Capture + write the committed state as verified chunks; THE snapshot
-    writer shared by `snapshot create` and the start loop's interval
-    snapshots (which captures under the service lock but writes outside)."""
+    """One-shot capture + write for `snapshot create` (no concurrent
+    mutator). The start loop splits capture/encode around its service
+    lock and calls _write_snapshot_files directly."""
     from celestia_app_tpu.chain import consensus
 
     manifest, chunks = consensus.snapshot_app_chunks(app)
@@ -557,18 +611,25 @@ def _write_snapshot(app, out_dir: str) -> dict:
 
 
 def _prune_snapshots(root: str, keep: int) -> None:
-    """Keep only the newest `keep` height-named snapshot dirs
+    """Keep only the newest `keep` RESTORABLE snapshot dirs
     (default_overrides.go:294-297 keep-recent; 0 = keep everything, the
-    sdk's snapshot-keep-recent semantics)."""
+    sdk's snapshot-keep-recent semantics). A half-written dir (no
+    manifest.json — a crash mid-write) is deleted outright and never
+    counts toward the kept set, so it can't displace the last restorable
+    snapshot."""
     import shutil
 
     if keep <= 0 or not os.path.isdir(root):
         return
-    heights = sorted(
-        (int(name) for name in os.listdir(root) if name.isdigit()),
-        reverse=True,
-    )
-    for h in heights[keep:]:
+    complete = []
+    for name in os.listdir(root):
+        if not name.isdigit():
+            continue
+        if os.path.exists(os.path.join(root, name, "manifest.json")):
+            complete.append(int(name))
+        else:
+            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+    for h in sorted(complete, reverse=True)[keep:]:
         shutil.rmtree(os.path.join(root, str(h)), ignore_errors=True)
 
 
