@@ -514,7 +514,7 @@ def capture_app_snapshot(app: App) -> dict:
     the expensive chunk encoding happens in encode_app_snapshot, safely
     outside the lock."""
     return {
-        "items": dict(app.store.snapshot()),
+        "items": app.store.snapshot(),  # already a fresh copy (state.py)
         "height": app.height,
         "app_hash": app.last_app_hash.hex(),
         "app_version": app.app_version,

@@ -98,6 +98,8 @@ class QueryRouter:
             return self._tx_inclusion(data)
         if path == "custom/shareInclusionProof":
             return self._share_inclusion(data)
+        if path == "custom/namespaceData":
+            return self._namespace_data(data)
         if path == "bank/balance":
             addr = bytes.fromhex(data["address"])
             return {"balance": self.app.bank.balance(self._ctx(), addr)}
@@ -191,6 +193,23 @@ class QueryRouter:
         block, square, prover, root = self._prover(height)
         pf = prover.prove_shares(start, end, namespace)
         return {"proof": _share_proof_json(pf), "data_root": root.hex()}
+
+    def _namespace_data(self, data: dict) -> dict:
+        """GetSharesByNamespace-style route: every share of a namespace in
+        a block with a presence-and-completeness proof, or an absence
+        witness (da/namespace_data.py)."""
+        from celestia_app_tpu.da import namespace_data as nsd
+
+        height = int(data["height"])
+        namespace = bytes.fromhex(data["namespace"])
+        block, square, prover, root = self._prover(height)
+        nd = nsd.get_namespace_data(prover, namespace)
+        return {
+            "present": bool(nd.shares),
+            "shares": [base64.b64encode(s).decode() for s in nd.shares],
+            "proof": _share_proof_json(nd.proof) if nd.proof else None,
+            "data_root": root.hex(),
+        }
 
 
 def _share_proof_json(pf) -> dict:

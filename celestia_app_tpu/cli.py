@@ -95,14 +95,23 @@ def cmd_init(args) -> int:
     }
     with open(os.path.join(args.home, "genesis.json"), "w") as f:
         json.dump(genesis, f, indent=2)
-    with open(os.path.join(args.home, "config.json"), "w") as f:
+    _write_config(args.home, args.chain_id, engine=args.engine)
+    print(f"initialized {args.home} (chain-id {args.chain_id})")
+    return 0
+
+
+def _write_config(home: str, chain_id: str, engine: str = "auto") -> None:
+    """THE node-local config writer (SURVEY §5.6 layer 4 — the reference's
+    app.toml/config.toml knobs), shared by `init` and validator/devnet
+    home setup so the key set can never drift between them."""
+    from celestia_app_tpu import appconsts
+
+    with open(os.path.join(home, "config.json"), "w") as f:
         json.dump(
             {
-                # node-local config layer (SURVEY §5.6 layer 4 — the
-                # reference's app.toml/config.toml knobs)
-                "chain_id": args.chain_id,
+                "chain_id": chain_id,
                 "app_version": 1,
-                "engine": args.engine,
+                "engine": engine,
                 "min_gas_price": appconsts.DEFAULT_MIN_GAS_PRICE,
                 "invariant_check_period": 0,
                 "v2_upgrade_height": None,
@@ -110,8 +119,6 @@ def cmd_init(args) -> int:
             },
             f, indent=2,
         )
-    print(f"initialized {args.home} (chain-id {args.chain_id})")
-    return 0
 
 
 def cmd_start(args) -> int:
@@ -266,25 +273,30 @@ def cmd_tx(args) -> int:
 def _ensure_home_config(home: str, chain_id: str) -> None:
     """Make a validator home a first-class CLI --home: with config.json in
     place (and data under <home>/data), `snapshot create`, `query`,
-    `export`, `blockscan` etc. all work against a stopped validator."""
-    from celestia_app_tpu import appconsts
+    `export`, `blockscan` etc. all work against a stopped validator.
+    Validators run engine=host (ValidatorNode's App does)."""
+    if not os.path.exists(os.path.join(home, "config.json")):
+        _write_config(home, chain_id, engine="host")
 
-    cfg_path = os.path.join(home, "config.json")
-    if os.path.exists(cfg_path):
-        return
-    with open(cfg_path, "w") as f:
-        json.dump(
-            {
-                "chain_id": chain_id,
-                "app_version": 1,
-                "engine": "host",
-                "min_gas_price": appconsts.DEFAULT_MIN_GAS_PRICE,
-                "invariant_check_period": 0,
-                "v2_upgrade_height": None,
-                "mempool_ttl_blocks": appconsts.MEMPOOL_TX_TTL_BLOCKS,
-            },
-            f, indent=2,
+
+def _check_legacy_validator_home(home: str) -> str | None:
+    """Pre-round-4 layout detection: validator state at the HOME ROOT
+    instead of <home>/data. Returns an error message, or None when clean.
+    Silently adopting such a home would reset the validator to genesis AND
+    re-sign heights it already signed."""
+    data_dir = os.path.join(home, "data")
+    legacy = [
+        p for p in ("state", "wal", "LATEST")
+        if os.path.exists(os.path.join(home, p))
+    ]
+    if legacy and not os.path.isdir(data_dir):
+        return (
+            f"{home} holds pre-round-4 validator state "
+            f"({', '.join(legacy)}) at the home root; move it under "
+            f"{data_dir}/ before starting, or this validator would "
+            "silently reset to genesis and double-sign."
         )
+    return None
 
 
 def cmd_validator_serve(args) -> int:
@@ -304,26 +316,14 @@ def cmd_validator_serve(args) -> int:
     _ensure_home_config(args.home, args.chain_id)
     priv = PrivateKey.from_seed(bytes.fromhex(key_doc["seed_hex"]))
     # layout: validator state lives under <home>/data (so the home doubles
-    # as a CLI --home). A home written by the pre-round-4 layout kept state
-    # directly under <home>; silently ignoring it would restart the
-    # validator from genesis AND re-sign old heights — refuse loudly.
-    data_dir = os.path.join(args.home, "data")
-    legacy = [
-        p for p in ("state", "wal", "LATEST")
-        if os.path.exists(os.path.join(args.home, p))
-    ]
-    if legacy and not os.path.isdir(data_dir):
-        print(
-            f"ERROR: {args.home} holds pre-round-4 validator state "
-            f"({', '.join(legacy)}) at the home root; move it under "
-            f"{data_dir}/ before starting, or this validator would "
-            "silently reset to genesis and double-sign.",
-            file=sys.stderr,
-        )
+    # as a CLI --home); a pre-round-4 home is refused loudly
+    err = _check_legacy_validator_home(args.home)
+    if err is not None:
+        print(f"ERROR: {err}", file=sys.stderr)
         return 1
     vnode = consensus.ValidatorNode(
         key_doc.get("name", "val"), priv, genesis, args.chain_id,
-        data_dir=data_dir,
+        data_dir=os.path.join(args.home, "data"),
     )
     try:
         vnode.app.load()  # resume at the durable committed height
@@ -394,6 +394,12 @@ def _devnet_processes(args, privs, genesis) -> int:
         for i in range(n):
             home = os.path.join(args.home, f"val{i}")
             os.makedirs(home, exist_ok=True)
+            # fail fast and VISIBLY here: the spawned validator's stderr is
+            # devnulled, so its own refusal would surface only as a 50s
+            # "never came up" timeout
+            err = _check_legacy_validator_home(home)
+            if err is not None:
+                raise RuntimeError(err)
             with open(os.path.join(home, "genesis.json"), "w") as f:
                 json.dump(genesis, f)
             with open(os.path.join(home, "key.json"), "w") as f:
@@ -520,6 +526,10 @@ def cmd_devnet(args) -> int:
     for i in range(n):
         home = os.path.join(args.home, f"val{i}")
         os.makedirs(home, exist_ok=True)
+        err = _check_legacy_validator_home(home)
+        if err is not None:
+            print(f"ERROR: {err}", file=sys.stderr)
+            return 1
         with open(os.path.join(home, "genesis.json"), "w") as f:
             json.dump(genesis, f)
         _ensure_home_config(home, args.chain_id)

@@ -1,0 +1,196 @@
+"""Namespace data retrieval with presence/completeness/absence proofs.
+
+The light-node side of the DA story (celestia-node's GetSharesByNamespace /
+nmt ProveNamespace+VerifyNamespace): given a block's DAH, return EVERY
+share of a namespace with a proof that the set is complete — or a proof
+that the namespace is absent from the block.
+
+Built on the framework's existing pieces: the square is namespace-sorted
+(data_square_layout.md), so a namespace's shares form one contiguous
+row-major range; NMT proof nodes are serialized as min_ns‖max_ns‖hash
+(90 bytes), so a verifier can read each out-of-range subtree's namespace
+window straight off the proof; and the DAH's row roots carry [min,max]
+windows for whole rows.
+
+Verification logic (nmt VerifyNamespace semantics):
+- presence: the ShareProof chains to the data root, every returned share
+  carries the target namespace, every OTHER row's root window excludes it,
+  and every out-of-range proof node inside the touched rows excludes it —
+  so no share of the namespace can exist outside the returned set.
+- absence, no covering row: every row root window excludes the target.
+- absence, straddling row (min < target < max with no exact match): a
+  one-leaf proof of the SUCCESSOR share (the first leaf with ns > target);
+  the left-side proof nodes' max < target proves nothing with the target
+  sits before it, the successor's own ns > target proves nothing at it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.da.dah import DataAvailabilityHeader
+from celestia_app_tpu.da.proof import ShareProof
+
+NS = appconsts.NAMESPACE_SIZE
+
+
+@dataclasses.dataclass
+class NamespaceData:
+    """shares + presence proof, or an absence witness."""
+
+    namespace: bytes
+    shares: list[bytes]  # [] when absent
+    proof: ShareProof | None  # presence proof, or successor proof (absence)
+
+
+def _root_window(root90: bytes) -> tuple[bytes, bytes]:
+    return root90[:NS], root90[NS : 2 * NS]
+
+
+def _out_of_range_subtrees(total: int, start: int, end: int):
+    """Maximal out-of-range subtrees of the perfect `total`-leaf tree for
+    range [start, end), in the walk order the prover emits proof nodes
+    (matches BlockProver._range_proof / NmtRangeProof.verify)."""
+    out: list[tuple[int, int]] = []
+
+    def walk(lo: int, hi: int) -> None:
+        if hi <= start or lo >= end:
+            out.append((lo, hi))
+            return
+        if hi - lo == 1:
+            return
+        mid = lo + (hi - lo) // 2
+        walk(lo, mid)
+        walk(mid, hi)
+
+    walk(0, total)
+    return out
+
+
+def get_namespace_data(prover, namespace: bytes) -> NamespaceData:
+    """All shares of `namespace` in the prover's block, with proof.
+
+    `prover` is a da/proof_device.BlockProver (cached row trees: proof
+    assembly is pure index arithmetic)."""
+    if len(namespace) != NS:
+        raise ValueError(f"namespace must be {NS} bytes")
+    k = prover.k
+    ods = prover.eds.squares
+    hits = [
+        r * k + c
+        for r in range(k)
+        for c in range(k)
+        if ods[r, c, :NS].tobytes() == namespace
+    ]
+    if hits:
+        start, end = hits[0], hits[-1] + 1
+        if hits != list(range(start, end)):
+            raise AssertionError(
+                "namespace shares are not contiguous: square is not sorted"
+            )
+        pf = prover.prove_shares(start, end, namespace)
+        return NamespaceData(
+            namespace=namespace,
+            shares=[bytes(s) for s in pf.data],
+            proof=pf,
+        )
+    # absence: find a Q0 row whose root window straddles the namespace
+    for r in range(k):
+        lo, hi = _root_window(prover.dah.row_roots[r])
+        if lo <= namespace <= hi:
+            # successor leaf: first column with a larger namespace (must
+            # exist: hi >= namespace and no exact match)
+            succ = next(
+                c for c in range(k)
+                if ods[r, c, :NS].tobytes() > namespace
+            )
+            pf = prover.prove_shares(
+                r * k + succ, r * k + succ + 1,
+                ods[r, succ, :NS].tobytes(),
+            )
+            return NamespaceData(namespace=namespace, shares=[], proof=pf)
+    return NamespaceData(namespace=namespace, shares=[], proof=None)
+
+
+def verify_namespace_data(
+    dah: DataAvailabilityHeader, namespace: bytes, nd: NamespaceData
+) -> bool:
+    """True iff `nd` proves its claim (presence-and-complete, or absent)
+    against the trusted DAH."""
+    if nd.namespace != namespace or len(namespace) != NS:
+        return False
+    data_root = dah.hash()
+    k = len(dah.row_roots) // 2
+
+    def rows_exclude(rows) -> bool:
+        for r in rows:
+            lo, hi = _root_window(dah.row_roots[r])
+            if lo <= namespace <= hi:
+                return False
+        return True
+
+    def rows_bound(pf) -> bool:
+        """The proof's row roots must BE the DAH's roots for the claimed
+        row range — otherwise start_row/end_row are attacker-chosen labels
+        and the completeness checks below skip the wrong rows."""
+        want = [
+            dah.row_roots[r]
+            for r in range(pf.row_proof.start_row, pf.row_proof.end_row + 1)
+        ]
+        return list(pf.row_proof.row_roots) == want
+
+    if nd.shares:
+        pf = nd.proof
+        if pf is None or pf.data != nd.shares:
+            return False
+        if not pf.verify(data_root) or not rows_bound(pf):
+            return False
+        if any(s[:NS] != namespace for s in nd.shares):
+            return False
+        start_row, end_row = pf.row_proof.start_row, pf.row_proof.end_row
+        # completeness outside the touched rows
+        if not rows_exclude(
+            r for r in range(2 * k) if not start_row <= r <= end_row
+        ):
+            return False
+        # completeness inside the touched rows: every out-of-range proof
+        # node's namespace window must exclude the target
+        for nproof in pf.share_proofs:
+            for node in nproof.nodes:
+                lo, hi = _root_window(node)
+                if lo <= namespace <= hi:
+                    return False
+        return True
+
+    if nd.proof is None:
+        # absent with no covering row anywhere
+        return rows_exclude(range(2 * k))
+
+    # absent via successor proof in a straddling row
+    pf = nd.proof
+    if len(pf.data) != 1 or not pf.verify(data_root) or not rows_bound(pf):
+        return False
+    succ = pf.data[0]
+    if not succ[:NS] > namespace:
+        return False
+    row = pf.row_proof.start_row
+    if row != pf.row_proof.end_row or row >= k:
+        return False
+    # every other row must exclude the namespace outright
+    if not rows_exclude(r for r in range(2 * k) if r != row):
+        return False
+    # left of the successor: every out-of-range subtree's max < target
+    nproof = pf.share_proofs[0]
+    subtrees = _out_of_range_subtrees(nproof.total, nproof.start, nproof.end)
+    if len(subtrees) != len(nproof.nodes):
+        return False
+    for (lo_pos, hi_pos), node in zip(subtrees, nproof.nodes):
+        lo, hi = _root_window(node)
+        if hi_pos <= nproof.start:  # entirely left of the successor
+            if hi >= namespace:
+                return False
+        else:  # right side: must start after the target
+            if lo <= namespace:
+                return False
+    return True

@@ -40,7 +40,13 @@ class RowProof:
             return False
         if len(self.row_roots) != self.end_row - self.start_row + 1:
             return False
-        for root, proof in zip(self.row_roots, self.proofs):
+        for i, (root, proof) in enumerate(zip(self.row_roots, self.proofs)):
+            # the leaf index must BE the claimed row: row r is leaf r of
+            # the 4k-leaf rowRoots‖colRoots tree. Without this binding a
+            # prover could label row 2's proof as row 3 and smuggle a
+            # duplicated row past range-based completeness checks.
+            if proof.index != self.start_row + i:
+                return False
             if not proof.verify(data_root, root):
                 return False
         return True

@@ -1,0 +1,198 @@
+"""Namespace data retrieval (celestia-node GetSharesByNamespace / nmt
+VerifyNamespace semantics): presence with completeness, and absence —
+including the straddling-row successor proof — all verifiable against the
+DAH alone."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.da import dah as dah_mod
+from celestia_app_tpu.da import namespace_data as nsd
+from celestia_app_tpu.da import proof_device
+from celestia_app_tpu.da import square as square_mod
+from celestia_app_tpu.da.blob import Blob
+from celestia_app_tpu.da.namespace import Namespace
+from celestia_app_tpu.da.square import PfbEntry
+
+
+def _block(rng, blobs):
+    sq = square_mod.build([b"some-tx"], [PfbEntry(b"pfb", tuple(blobs))],
+                          64, 64)
+    ods = dah_mod.shares_to_ods(sq.share_bytes())
+    d, eds_obj, root = dah_mod.new_dah_from_ods(ods)
+    return sq, d, proof_device.BlockProver(eds_obj, d), root
+
+
+def _mk_blobs(rng):
+    return [
+        Blob(Namespace.v0(b"aaaaa"),
+             rng.integers(0, 256, 3000, dtype=np.uint8).tobytes()),
+        Blob(Namespace.v0(b"mmmmm"),
+             rng.integers(0, 256, 900, dtype=np.uint8).tobytes()),
+        Blob(Namespace.v0(b"zzzzz"),
+             rng.integers(0, 256, 500, dtype=np.uint8).tobytes()),
+    ]
+
+
+def test_namespace_presence_complete():
+    rng = np.random.default_rng(1)
+    blobs = _mk_blobs(rng)
+    sq, d, prover, root = _block(rng, blobs)
+    target = blobs[0].namespace.raw  # multi-share blob, may span rows
+    nd = nsd.get_namespace_data(prover, target)
+    assert nd.shares and nd.proof is not None
+    assert nsd.verify_namespace_data(d, target, nd)
+    # the returned shares reassemble exactly the blob
+    from celestia_app_tpu.da import shares as shares_mod
+    from celestia_app_tpu.da.shares import Share
+
+    got = shares_mod.parse_sparse_shares([Share(s) for s in nd.shares])
+    assert got == blobs[0].data
+
+
+def test_namespace_presence_rejects_truncation():
+    """Dropping a share from the response must fail verification — the
+    completeness half of VerifyNamespace."""
+    rng = np.random.default_rng(2)
+    blobs = _mk_blobs(rng)
+    sq, d, prover, root = _block(rng, blobs)
+    target = blobs[0].namespace.raw
+    nd = nsd.get_namespace_data(prover, target)
+    assert len(nd.shares) > 1
+    # forged "complete" response: prove a SUBrange and claim it is all
+    start = min(sq.blob_start_indexes.values())
+    forged_pf = prover.prove_shares(start, start + len(nd.shares) - 1, target)
+    forged = nsd.NamespaceData(
+        namespace=target,
+        shares=[bytes(s) for s in forged_pf.data],
+        proof=forged_pf,
+    )
+    assert not nsd.verify_namespace_data(d, target, forged)
+    # and a claimed-absent response while shares exist also fails
+    assert not nsd.verify_namespace_data(
+        d, target, nsd.NamespaceData(target, [], None)
+    )
+
+
+def test_namespace_absent_no_covering_row():
+    rng = np.random.default_rng(3)
+    blobs = _mk_blobs(rng)
+    sq, d, prover, root = _block(rng, blobs)
+    # BELOW every namespace in the square (TX_NAMESPACE is the row minimum):
+    # no row window can cover it, so absence needs no proof at all
+    target = bytes(29)
+    nd = nsd.get_namespace_data(prover, target)
+    assert nd.shares == [] and nd.proof is None
+    assert nsd.verify_namespace_data(d, target, nd)
+
+    # ABOVE the blobs but below tail padding: rows holding tail-padding
+    # shares straddle it, so absence carries a successor proof (the tail
+    # padding share) — and still verifies
+    target_hi = Namespace.v0(b"\x7f\x7f\x7f\x7f\x7f").raw
+    nd_hi = nsd.get_namespace_data(prover, target_hi)
+    assert nd_hi.shares == [] and nd_hi.proof is not None
+    assert nsd.verify_namespace_data(d, target_hi, nd_hi)
+
+
+def test_namespace_absent_straddling_row():
+    """A namespace BETWEEN two blobs that share a row: absence needs the
+    successor-leaf proof, and it verifies; claiming absence for a present
+    namespace with that machinery fails."""
+    rng = np.random.default_rng(4)
+    blobs = _mk_blobs(rng)
+    sq, d, prover, root = _block(rng, blobs)
+    target = Namespace.v0(b"qqqqq").raw  # between mmmmm and zzzzz
+    nd = nsd.get_namespace_data(prover, target)
+    assert nd.shares == [] and nd.proof is not None  # successor proof
+    assert nsd.verify_namespace_data(d, target, nd)
+
+    # the successor machinery cannot fake absence of a PRESENT namespace
+    present = blobs[1].namespace.raw
+    fake = nsd.NamespaceData(namespace=present, shares=[], proof=nd.proof)
+    assert not nsd.verify_namespace_data(d, present, fake)
+
+
+def test_namespace_query_route(tmp_path):
+    """The custom/namespaceData ABCI route serves it out-of-process."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_app import make_app
+
+    import base64
+
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.chain.query import QueryRouter
+    from celestia_app_tpu.client.tx_client import TxClient
+
+    rng = np.random.default_rng(5)
+    app, signer, privs = make_app()
+    app.db = __import__(
+        "celestia_app_tpu.chain.storage", fromlist=["ChainDB"]
+    ).ChainDB(str(tmp_path / "db"))
+    node = Node(app)
+    client = TxClient(node, signer)
+    addr = privs[0].public_key().address()
+    blob = Blob(Namespace.v0(b"route"),
+                rng.integers(0, 256, 700, dtype=np.uint8).tobytes())
+    client.submit_pay_for_blob(addr, [blob])
+
+    router = QueryRouter(app)
+    out = router.query("custom/namespaceData", {
+        "height": 1, "namespace": blob.namespace.raw.hex(),
+    })
+    assert out["present"] is True
+    from celestia_app_tpu.chain.query import share_proof_from_json
+    from celestia_app_tpu.da import shares as shares_mod
+    from celestia_app_tpu.da.shares import Share
+
+    shares = [base64.b64decode(s) for s in out["shares"]]
+    assert shares_mod.parse_sparse_shares(
+        [Share(s) for s in shares]
+    ) == blob.data
+    pf = share_proof_from_json(out["proof"])
+    assert pf.verify(bytes.fromhex(out["data_root"]))
+
+    missing = router.query("custom/namespaceData", {
+        "height": 1, "namespace": Namespace.v0(b"nope!").raw.hex(),
+    })
+    assert missing["present"] is False
+
+
+def test_duplicated_row_forgery_rejected():
+    """Code-review regression: a forged presence response that duplicates
+    one row's proof under two row labels (hiding the real second row's
+    shares) must fail — row labels are bound to the DAH's roots AND the
+    Merkle proofs' own leaf indices."""
+    rng = np.random.default_rng(6)
+    blobs = _mk_blobs(rng)
+    sq, d, prover, root = _block(rng, blobs)
+    target = blobs[0].namespace.raw
+    nd = nsd.get_namespace_data(prover, target)
+    pf = nd.proof
+    if pf.row_proof.start_row == pf.row_proof.end_row:
+        pytest.skip("blob fit one row under this layout; forgery needs 2")
+    from celestia_app_tpu.da.proof import RowProof, ShareProof
+
+    first_count = pf.share_proofs[0].end - pf.share_proofs[0].start
+    forged = ShareProof(
+        data=pf.data[:first_count] * 2,
+        share_proofs=[pf.share_proofs[0], pf.share_proofs[0]],
+        namespace=target,
+        row_proof=RowProof(
+            row_roots=[pf.row_proof.row_roots[0]] * 2,
+            proofs=[pf.row_proof.proofs[0]] * 2,
+            start_row=pf.row_proof.start_row,
+            end_row=pf.row_proof.start_row + 1,
+        ),
+        start_share=pf.start_share,
+        end_share=pf.start_share + 2 * first_count,
+    )
+    fake = nsd.NamespaceData(
+        namespace=target,
+        shares=list(forged.data),
+        proof=forged,
+    )
+    assert not nsd.verify_namespace_data(d, target, fake)
