@@ -511,10 +511,19 @@ class App:
             proposer=proposer,
             app_version=self.app_version,
             last_block_hash=self.last_block_hash,
+            validators_hash=self._validators_hash(),
         )
         block = Block(header=header, txs=tuple(square.txs + kept_blob_raws))
         telemetry.measure_since("prepare_proposal", _t0)
         return ProposalResult(block=block, square=square, dah=d)
+
+    def _validators_hash(self) -> bytes:
+        """Commitment to the current (operator, power) set — the header's
+        ValidatorsHash analog light clients verify certificates against."""
+        from celestia_app_tpu.chain.block import validators_hash_of
+
+        ctx = self._ctx(self.store.branch(), InfiniteGasMeter(), check=False)
+        return validators_hash_of(self.staking.validators(ctx))
 
     # ------------------------------------------------------------------
     # ProcessProposal (every validator)
@@ -543,6 +552,11 @@ class App:
             raise ValueError("app version mismatch")
         if h.app_hash != self.last_app_hash:
             raise ValueError("app hash mismatch")
+        if h.validators_hash != self._validators_hash():
+            # the proposer must commit to the SAME valset every honest
+            # validator derives from state — a forged commitment would let
+            # light clients be pointed at a fake set
+            raise ValueError("validators hash mismatch")
 
         ctx = self._ctx(
             self.store.branch(), InfiniteGasMeter(), check=False,

@@ -19,6 +19,12 @@ class Header:
     proposer: bytes
     app_version: int
     last_block_hash: bytes = b"\x00" * 32
+    # commitment to the CURRENT validator set's (operator, power) pairs
+    # (Tendermint header ValidatorsHash analog): what light clients verify
+    # certificates against. Pubkeys need not be in state — operator
+    # addresses ARE pubkey hashes, so a candidate set is checkable against
+    # this commitment plus the address derivation (chain/light.py).
+    validators_hash: bytes = b"\x00" * 32
 
     def encode(self) -> bytes:
         cid = self.chain_id.encode()
@@ -38,10 +44,23 @@ class Header:
         out += uvarint(len(self.proposer)) + self.proposer
         out += uvarint(self.app_version)
         out += self.last_block_hash
+        out += self.validators_hash
         return bytes(out)
 
     def hash(self) -> bytes:
         return hashlib.sha256(self.encode()).digest()
+
+
+def validators_hash_of(validators: list[tuple[bytes, int]]) -> bytes:
+    """Canonical commitment to a validator set: sha256 over the sorted
+    (operator, power) pairs. Proposers compute it from staking state;
+    every ProcessProposal recomputes and compares; light clients check
+    candidate sets against it."""
+    out = bytearray()
+    for op, power in sorted(validators):
+        out += uvarint(len(op)) + op
+        out += uvarint(power)
+    return hashlib.sha256(bytes(out)).digest()
 
 
 @dataclasses.dataclass(frozen=True)

@@ -68,15 +68,20 @@ class CommitCertificate:
     block_hash: bytes
     votes: tuple[Vote, ...]
 
-    def verify(self, chain_id: str, validators: dict[bytes, bytes],
-               total_power: int, powers: dict[bytes, int]) -> bool:
-        """Check ≥2/3 of `total_power` signed this block hash. `validators`
-        maps operator address -> 33-byte pubkey."""
+    def signed_power(self, chain_id: str, validators: dict[bytes, bytes],
+                     powers: dict[bytes, int]) -> int:
+        """THE vote-counting core: total power of distinct validators whose
+        precommit signature over THIS (height, block_hash) verifies against
+        `validators` (operator address -> 33-byte pubkey; the pubkey must
+        derive the address). Shared by certificate verification, the light
+        client's 2/3 and 1/3-overlap checks (chain/light.py), and the IBC
+        verifying client — one hardening fix reaches every consumer."""
         signed = 0
         seen: set[bytes] = set()
         doc = Vote.sign_bytes(chain_id, self.height, self.block_hash)
         for v in self.votes:
-            if v.validator in seen or v.block_hash != self.block_hash:
+            if (v.validator in seen or v.block_hash != self.block_hash
+                    or v.height != self.height or v.phase != "precommit"):
                 continue
             pub = validators.get(v.validator)
             if pub is None or PublicKey(pub).address() != v.validator:
@@ -85,10 +90,16 @@ class CommitCertificate:
                 continue
             seen.add(v.validator)
             signed += powers.get(v.validator, 0)
-        # STRICTLY more than 2/3 (Tendermint): at exactly 2/3, two
-        # conflicting certificates could overlap in only 1/3 of power —
-        # all of it byzantine — losing the accountability guarantee
-        return signed * 3 > total_power * 2
+        return signed
+
+    def verify(self, chain_id: str, validators: dict[bytes, bytes],
+               total_power: int, powers: dict[bytes, int]) -> bool:
+        """Check ≥2/3 of `total_power` signed this block hash.
+
+        STRICTLY more than 2/3 (Tendermint): at exactly 2/3, two
+        conflicting certificates could overlap in only 1/3 of power —
+        all of it byzantine — losing the accountability guarantee."""
+        return self.signed_power(chain_id, validators, powers) * 3 > total_power * 2
 
 
 # ---------------------------------------------------------------------------
@@ -109,6 +120,7 @@ def header_to_json(h: Header) -> dict:
         "proposer": h.proposer.hex(),
         "app_version": h.app_version,
         "last_block_hash": h.last_block_hash.hex(),
+        "validators_hash": h.validators_hash.hex(),
     }
 
 
@@ -123,6 +135,11 @@ def header_from_json(d: dict) -> Header:
         proposer=bytes.fromhex(d["proposer"]),
         app_version=d["app_version"],
         last_block_hash=bytes.fromhex(d["last_block_hash"]),
+        # STRICT: a header doc without the validators_hash commitment is
+        # from a pre-commitment encoding whose block hash no longer matches
+        # this code — failing loudly here beats silently re-hashing it to a
+        # value none of its stored votes cover
+        validators_hash=bytes.fromhex(d["validators_hash"]),
     )
 
 

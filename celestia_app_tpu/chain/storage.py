@@ -219,42 +219,23 @@ class ChainDB:
         return os.path.join(self.dir, "blocks", f"{height:020d}.json.gz")
 
     def save_block(self, block: Block) -> None:
-        h = block.header
-        doc = {
-            "header": {
-                "chain_id": h.chain_id,
-                "height": h.height,
-                "time_unix": h.time_unix,
-                "data_hash": h.data_hash.hex(),
-                "square_size": h.square_size,
-                "app_hash": h.app_hash.hex(),
-                "proposer": h.proposer.hex(),
-                "app_version": h.app_version,
-                "last_block_hash": h.last_block_hash.hex(),
-            },
-            "txs": [base64.b64encode(t).decode() for t in block.txs],
-        }
+        # THE header codec (chain/consensus.py) — the block store, the WAL,
+        # and the socket wire must agree on every field, or a stored block
+        # re-hashes differently than the chain committed
+        from celestia_app_tpu.chain.consensus import block_to_json
+
+        doc = block_to_json(block)
         blob = gzip.compress(
             json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
         )
-        _atomic_write(self._block_path(h.height), blob)
+        _atomic_write(self._block_path(block.header.height), blob)
 
     def load_block(self, height: int) -> Block:
+        from celestia_app_tpu.chain.consensus import block_from_json
+
         with gzip.open(self._block_path(height), "rb") as f:
             doc = json.loads(f.read())
-        hd = doc["header"]
-        header = Header(
-            chain_id=hd["chain_id"],
-            height=hd["height"],
-            time_unix=hd["time_unix"],
-            data_hash=bytes.fromhex(hd["data_hash"]),
-            square_size=hd["square_size"],
-            app_hash=bytes.fromhex(hd["app_hash"]),
-            proposer=bytes.fromhex(hd["proposer"]),
-            app_version=hd["app_version"],
-            last_block_hash=bytes.fromhex(hd["last_block_hash"]),
-        )
-        return Block(header=header, txs=[base64.b64decode(t) for t in doc["txs"]])
+        return block_from_json(doc)
 
     def block_heights(self) -> list[int]:
         out = []

@@ -89,16 +89,10 @@ def out_of_order_prepare(app, raw_txs: list[bytes], t: float) -> Block:
     shares = swap_first_two_blobs(sq)
     ods = dah_mod.shares_to_ods(shares)
     _, root = blind_dah(ods)
-    h = block.header
-    forged = Header(
-        chain_id=h.chain_id,
-        height=h.height,
-        time_unix=h.time_unix,
-        data_hash=root,
-        square_size=h.square_size,
-        app_hash=h.app_hash,
-        proposer=h.proposer,
-        app_version=h.app_version,
-        last_block_hash=h.last_block_hash,
-    )
+    import dataclasses
+
+    # replace ONLY the data root: every other header field (including any
+    # added later, like validators_hash) stays honest, so ProcessProposal's
+    # rejection exercises the data-root check and nothing else
+    forged = dataclasses.replace(block.header, data_hash=root)
     return Block(header=forged, txs=block.txs)

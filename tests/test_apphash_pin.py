@@ -19,15 +19,15 @@ from test_app import make_app
 PINS = {
     # App-hash pins date from round 3 (fixed-point state, protobuf wire,
     # bucketed app-hash tree) and did NOT move in round 4 — the state plane
-    # is stable. data_root_h2/block_hash_h3 regenerated once in round 4 for
-    # the in-square protobuf IndexWrapper switch (VERDICT r3 #2): PFB
-    # squares now carry go-square's wrapped-tx bytes, so PFB-block data
-    # roots moved while app hashes stayed fixed.
+    # is stable. Round-4 regenerations, each a single conscious step:
+    # data_root_h2 for the in-square protobuf IndexWrapper switch (VERDICT
+    # r3 #2), and block_hash_h3 for that plus the header's new
+    # validators_hash commitment (light-client support).
     "app_hash_h1_send": "14a2ea9fbee34a25817e5a8bc15747952f5212f645de7e7825f0bf31a6aa214c",
     "app_hash_h2_pfb": "dc565dd8813a1ecb66e7b607c99e6f9a09c7f671e0d2602e552dbb61eedbfcc8",
     "data_root_h2": "865ee5ce8ff37dc2aabb4245833a0d1a57e49f4c1e0aa2dd7c726ade926c8c8a",
     "app_hash_h3_empty": "74a649decdc14c3eaf1f190d6e6355a9cc59ce697ab22943c94834ae6650d146",
-    "block_hash_h3": "9a64780f74aa03d7bf1907e2a089f0502defd783816a4ec2a491b808a7026c85",
+    "block_hash_h3": "8110877074f1649f9f983c33c4b547482672d0753862f663d4b977ffcaad6cb9",
 }
 
 

@@ -1,0 +1,162 @@
+"""Light client (celestia-core `light` analog): header-chain following by
+certificate verification alone, with valset transitions under the
+Tendermint 1/3-overlap skipping-trust rule."""
+
+import dataclasses
+
+import pytest
+
+from celestia_app_tpu.chain import consensus, light
+from celestia_app_tpu.chain.block import Header, validators_hash_of
+from celestia_app_tpu.chain.crypto import PrivateKey
+from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+from celestia_app_tpu.chain.tx import MsgDelegate, MsgSend
+from celestia_app_tpu.client.tx_client import Signer
+
+import sys
+
+sys.path.insert(0, "tests")
+from test_consensus_multinode import CHAIN, _genesis, _network  # noqa: E402
+
+
+def _trusted_from(net):
+    return light.TrustedState(
+        height=net.nodes[0].app.height,
+        header_hash=net.nodes[0].app.last_block_hash,
+        validators={
+            n.address: n.priv.public_key().compressed for n in net.nodes
+        },
+        powers={
+            n.address: p
+            for n, p in zip(net.nodes, [10] * len(net.nodes))
+        },
+    )
+
+
+def test_light_client_follows_headers(tmp_path):
+    net, signer, privs = _network(tmp_path, with_disk=False)
+    lc = light.LightClient(CHAIN, _trusted_from(net))
+
+    a0 = privs[0].public_key().address()
+    tx = signer.create_tx(a0, [MsgSend(a0, privs[1].public_key().address(), 5)],
+                          fee=2000, gas_limit=100_000)
+    net.broadcast_tx(tx.encode())
+    blk1, cert1 = net.produce_height(t=1_700_000_010.0)
+    st = lc.update(blk1.header, cert1)
+    assert st.height == 1 and st.header_hash == blk1.header.hash()
+
+    blk2, cert2 = net.produce_height(t=1_700_000_020.0)
+    st = lc.update(blk2.header, cert2)
+    assert st.height == 2
+
+    # stale/duplicate header refuses
+    with pytest.raises(light.LightClientError, match="non-monotonic"):
+        lc.update(blk1.header, cert1)
+
+
+def test_light_client_rejects_forgeries(tmp_path):
+    net, signer, privs = _network(tmp_path, with_disk=False)
+    lc = light.LightClient(CHAIN, _trusted_from(net))
+    blk, cert = net.produce_height(t=1_700_000_010.0)
+
+    # tampered header: cert no longer covers it
+    bad = dataclasses.replace(blk.header, app_hash=b"\xAB" * 32)
+    with pytest.raises(light.LightClientError, match="cover"):
+        lc.update(bad, cert)
+
+    # below 2/3: keep one vote of three
+    thin = consensus.CommitCertificate(
+        cert.height, cert.block_hash, cert.votes[:1]
+    )
+    with pytest.raises(light.LightClientError, match="2/3"):
+        lc.update(blk.header, thin)
+
+    # the genuine pair still advances trust afterwards
+    lc.update(blk.header, cert)
+    assert lc.trusted.height == 1
+
+
+def test_light_client_valset_change_with_overlap(tmp_path):
+    """A delegation changes a validator's power -> the header commits to a
+    NEW set; the light client demands the candidate set match the
+    commitment, 2/3 of the new set, and 1/3 overlap with the trusted set
+    (all three validators keep signing, so overlap holds)."""
+    net, signer, privs = _network(tmp_path, with_disk=False)
+    lc = light.LightClient(CHAIN, _trusted_from(net))
+
+    a0 = privs[0].public_key().address()
+    v1 = privs[1].public_key().address()
+    from celestia_app_tpu.chain.staking import POWER_REDUCTION
+
+    tx = signer.create_tx(
+        a0, [MsgDelegate(a0, v1, 5 * POWER_REDUCTION)],
+        fee=4000, gas_limit=300_000,
+    )
+    assert net.broadcast_tx(tx.encode())
+    blk1, cert1 = net.produce_height(t=1_700_000_010.0)
+    lc.update(blk1.header, cert1)  # height 1: set unchanged at propose time
+
+    # height 2's header commits to the post-delegation powers
+    blk2, cert2 = net.produce_height(t=1_700_000_020.0)
+    ctx = Context(net.nodes[0].app.store, InfiniteGasMeter(),
+                  net.nodes[0].app.height, 0, CHAIN, 1)
+    new_powers = dict(net.nodes[0].app.staking.validators(ctx))
+    assert new_powers[v1] == 15  # 10 + 5 delegated
+    new_vals = {
+        n.address: n.priv.public_key().compressed for n in net.nodes
+    }
+    # without the new set, the update must refuse
+    with pytest.raises(light.LightClientError, match="changed"):
+        lc.update(blk2.header, cert2)
+    st = lc.update(blk2.header, cert2, new_validators=new_vals,
+                   new_powers=new_powers)
+    assert st.powers[v1] == 15
+
+    # a LYING candidate set (inflated power) fails the hash binding
+    lied = dict(new_powers)
+    lied[v1] = 1000
+    lc2 = light.LightClient(CHAIN, _trusted_from(net))
+    with pytest.raises(light.LightClientError):
+        lc2.update(blk2.header, cert2, new_validators=new_vals,
+                   new_powers=lied)
+
+
+def test_light_client_no_overlap_rejected():
+    """A certificate from a completely DISJOINT valset — even a
+    self-consistent one — cannot move trust (long-range fork defense)."""
+    old_privs = [PrivateKey.from_seed(bytes([50 + i])) for i in range(3)]
+    new_privs = [PrivateKey.from_seed(bytes([80 + i])) for i in range(3)]
+    trusted = light.TrustedState(
+        height=0,
+        header_hash=b"\x00" * 32,
+        validators={
+            p.public_key().address(): p.public_key().compressed
+            for p in old_privs
+        },
+        powers={p.public_key().address(): 10 for p in old_privs},
+    )
+    lc = light.LightClient("chain-x", trusted)
+
+    new_powers = {p.public_key().address(): 10 for p in new_privs}
+    header = Header(
+        chain_id="chain-x", height=1, time_unix=1.0,
+        data_hash=b"\x01" * 32, square_size=1, app_hash=b"\x02" * 32,
+        proposer=new_privs[0].public_key().address(), app_version=1,
+        validators_hash=validators_hash_of(list(new_powers.items())),
+    )
+    bh = header.hash()
+    votes = tuple(
+        consensus.Vote(
+            1, bh, p.public_key().address(),
+            p.sign(consensus.Vote.sign_bytes("chain-x", 1, bh)),
+        )
+        for p in new_privs
+    )
+    cert = consensus.CommitCertificate(1, bh, votes)
+    new_vals = {
+        p.public_key().address(): p.public_key().compressed
+        for p in new_privs
+    }
+    with pytest.raises(light.LightClientError, match="overlap"):
+        lc.update(header, cert, new_validators=new_vals,
+                  new_powers=new_powers)
