@@ -124,7 +124,17 @@ class ClientKeeper:
     def update_client(
         self, ctx: Context, client_id: str, height: int,
         root: bytes | None = None, *, header=None, cert=None,
+        new_validators: dict[bytes, bytes] | None = None,
+        new_powers: dict[bytes, int] | None = None,
     ) -> None:
+        """Verifying clients run the FULL light-client update
+        (chain/light.py): >2/3 of the trusted power for a same-valset
+        header; for a changed valset the relayer supplies the candidate
+        set, which must match the header's validators_hash commitment,
+        carry >2/3 of its own power, and overlap the trusted set by >1/3 —
+        the adopted set is then persisted, so the client tracks the
+        counterparty's validator set over time (ibc-go 02-client update +
+        tendermint light semantics)."""
         meta_key = self.CONS + client_id.encode() + b"/meta"
         meta = _get(ctx, meta_key)
         if meta is None:
@@ -134,7 +144,9 @@ class ClientKeeper:
                 f"non-monotonic client update: {height} <= {meta['latest_height']}"
             )
         if meta.get("validators"):
-            root = self._verify_header(meta, height, header, cert)
+            root = self._verify_header(
+                meta, height, header, cert, new_validators, new_powers
+            )
         elif root is None:
             raise IBCError("trusting client update needs a root")
         _put(ctx, self.CONS + f"{client_id}/{height}".encode(),
@@ -143,27 +155,44 @@ class ClientKeeper:
         _put(ctx, meta_key, meta)
 
     @staticmethod
-    def _verify_header(meta: dict, height: int, header, cert) -> bytes:
-        """Tendermint-client checks: certificate height/hash bind the
-        submitted header, and >2/3 of the TRUSTED power signed it. Returns
-        the root to record — the header's own app_hash (the state root the
-        counterparty committed, which packet proofs verify against)."""
+    def _verify_header(meta: dict, height: int, header, cert,
+                       new_validators=None, new_powers=None) -> bytes:
+        """Light-client update against the client's persisted trusted
+        state; on success the (possibly new) valset is written back into
+        `meta`. Returns the root to record — the header's own app_hash
+        (the state root the counterparty committed, which packet proofs
+        verify against)."""
+        from celestia_app_tpu.chain import light
+
         if header is None or cert is None:
             raise IBCError("verifying client requires header + certificate")
-        if header.height != height or cert.height != height:
+        if header.height != height:
             raise IBCError(
-                f"header/cert height mismatch: {header.height}/{cert.height} != {height}"
+                f"header height mismatch: {header.height} != {height}"
             )
-        if cert.block_hash != header.hash():
-            raise IBCError("certificate does not cover this header")
-        validators = {
-            bytes.fromhex(k): bytes.fromhex(v)
-            for k, v in meta["validators"].items()
+        trusted = light.TrustedState(
+            height=meta["latest_height"],
+            header_hash=b"",
+            validators={
+                bytes.fromhex(k): bytes.fromhex(v)
+                for k, v in meta["validators"].items()
+            },
+            powers={bytes.fromhex(k): v for k, v in meta["powers"].items()},
+        )
+        # check_set=False: this set was validated when adopted and has been
+        # in OUR store since — re-deriving every pubkey address per relay
+        # would be pure overhead on the packet hot path
+        lc = light.LightClient(meta["chain_id"], trusted, check_set=False)
+        try:
+            st = lc.update(header, cert, new_validators=new_validators,
+                           new_powers=new_powers)
+        except light.LightClientError as e:
+            raise IBCError(f"header certificate verification failed: {e}") from None
+        # persist the adopted set: the client follows valset changes
+        meta["validators"] = {
+            op.hex(): pk.hex() for op, pk in st.validators.items()
         }
-        powers = {bytes.fromhex(k): v for k, v in meta["powers"].items()}
-        if not cert.verify(meta["chain_id"], validators,
-                           sum(powers.values()), powers):
-            raise IBCError("header certificate verification failed")
+        meta["powers"] = {op.hex(): int(p) for op, p in st.powers.items()}
         return header.app_hash
 
     def consensus_root(

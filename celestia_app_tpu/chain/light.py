@@ -45,10 +45,16 @@ class TrustedState:
 class LightClient:
     """Follows headers by certificate verification only."""
 
-    def __init__(self, chain_id: str, trusted: TrustedState):
+    def __init__(self, chain_id: str, trusted: TrustedState,
+                 check_set: bool = True):
+        """`check_set=False` skips re-validating the trusted set's
+        pubkey/address derivations — for callers restoring a set THEY
+        validated and persisted (the IBC client keeper's per-update
+        reconstruction), not for fresh external input."""
         self.chain_id = chain_id
         self.trusted = trusted
-        self._check_set(trusted.validators, trusted.powers)
+        if check_set:
+            self._check_set(trusted.validators, trusted.powers)
 
     @staticmethod
     def _check_set(validators: dict[bytes, bytes],
@@ -85,6 +91,15 @@ class LightClient:
             )
         if cert.height != header.height or cert.block_hash != header.hash():
             raise LightClientError("certificate does not cover this header")
+        # sequential hash-linkage: an adjacent header must chain to the
+        # trusted one (skipping updates have no such check — the overlap
+        # rule carries trust across the gap)
+        if (header.height == self.trusted.height + 1
+                and self.trusted.header_hash
+                and header.last_block_hash != self.trusted.header_hash):
+            raise LightClientError(
+                "adjacent header does not chain to the trusted header"
+            )
 
         if new_validators is None:
             # same-valset path: the header must still commit to the

@@ -28,12 +28,11 @@ from it (state-sync-style restore is just copying these files).
 
 from __future__ import annotations
 
-import base64
 import gzip
 import json
 import os
 
-from celestia_app_tpu.chain.block import Block, Header
+from celestia_app_tpu.chain.block import Block
 
 PRUNE_KEEP = 100  # same rollback window the in-memory history kept
 FULL_INTERVAL = 64  # full snapshot cadence (state-sync interval analog)

@@ -88,6 +88,18 @@ class BlockProver:
             start=p_start, end=p_end, total=total, nodes=nodes
         )
 
+    def prove_cell(self, row: int, col: int) -> tuple[bytes, "nmt_host.NmtRangeProof"]:
+        """One EXTENDED-square cell (any quadrant) with its NMT proof under
+        the row root — the unit a DAS sampler requests (da/sampling.py).
+        Pure index arithmetic over the cached row trees."""
+        width = 2 * self.k
+        if not (0 <= row < width and 0 <= col < width):
+            raise ValueError(f"cell ({row}, {col}) outside the {width}x{width} square")
+        return (
+            self.eds.squares[row, col].tobytes(),
+            self._range_proof(row, col, col + 1),
+        )
+
     def prove_shares(
         self, start_share: int, end_share: int, namespace: bytes
     ) -> ShareProof:

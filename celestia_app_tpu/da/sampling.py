@@ -1,0 +1,101 @@
+"""Data availability sampling: the light-node availability check.
+
+The point of the whole 2D construction (arXiv:1809.09044, SURVEY §1): a
+light node holding only the DAH samples s random cells of the EXTENDED
+square and demands each share with an NMT proof under its row root. To
+make even one original share unrecoverable, a withholding producer must
+hide more than (k+1)² of the (2k)² extended cells — over a quarter of the
+square — so every honest sample independently catches withholding with
+probability > 1/4, and s samples miss with probability < (3/4)^s.
+
+Server side: `BlockProver.prove_cell` answers sample requests from the
+cached row trees. Client side: `sample_block` draws coordinates, verifies
+every returned (share, proof) against the trusted DAH, and reports the
+confidence; any failed or refused sample marks the block unavailable —
+the signal that triggers rejection (and, with repair + fraud proofs,
+da/repair.py's BadEncodingError path)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.da.dah import DataAvailabilityHeader
+
+NS = appconsts.NAMESPACE_SIZE
+
+
+@dataclasses.dataclass
+class SampleReport:
+    samples: int
+    verified: int
+    failed: list[tuple[int, int]]  # coordinates that failed/refused
+    confidence: float  # P(withholding would have been caught)
+
+    @property
+    def available(self) -> bool:
+        return not self.failed
+
+
+def withholding_catch_confidence(s: int) -> float:
+    """1 - (3/4)^s: the standard DAS bound (a withholding producer must
+    hide > 1/4 of extended cells to lose any original share)."""
+    return 1.0 - 0.75**s
+
+
+def leaf_namespace(row: int, col: int, share: bytes, k: int) -> bytes:
+    from celestia_app_tpu.da.fraud import leaf_ns
+
+    return leaf_ns(row, col, share, k)
+
+
+def verify_sample(
+    dah: DataAvailabilityHeader, row: int, col: int,
+    share: bytes, proof,
+) -> bool:
+    """One sampled cell against the trusted DAH: the proof must cover
+    exactly this column under the claimed row's committed root, with the
+    pkg/wrapper leaf namespace rule applied."""
+    k = len(dah.row_roots) // 2
+    if not (0 <= row < 2 * k and 0 <= col < 2 * k):
+        return False
+    if len(share) != appconsts.SHARE_SIZE:
+        return False
+    if not (proof.start == col and proof.end == col + 1):
+        return False
+    ns = leaf_namespace(row, col, share, k)
+    return proof.verify(dah.row_roots[row], [(ns, share)])
+
+
+def sample_block(
+    dah: DataAvailabilityHeader,
+    fetch_cell,
+    n_samples: int,
+    rng,
+) -> SampleReport:
+    """Draw `n_samples` uniform cells and verify each. `fetch_cell(row,
+    col) -> (share, proof)` is the network boundary (a BlockProver
+    in-process, or any transport); raising/returning junk marks the cell
+    failed. `rng` must be the LIGHT NODE's own entropy — predictable
+    coordinates let a withholder serve exactly the sampled cells."""
+    width = len(dah.row_roots)
+    verified = 0
+    failed: list[tuple[int, int]] = []
+    for _ in range(n_samples):
+        row = int(rng.integers(0, width))
+        col = int(rng.integers(0, width))
+        try:
+            share, proof = fetch_cell(row, col)
+            ok = verify_sample(dah, row, col, share, proof)
+        except Exception:
+            ok = False
+        if ok:
+            verified += 1
+        else:
+            failed.append((row, col))
+    return SampleReport(
+        samples=n_samples,
+        verified=verified,
+        failed=failed,
+        confidence=withholding_catch_confidence(n_samples),
+    )

@@ -821,3 +821,90 @@ def test_redundant_relay_rejected_at_checktx():
     tx3 = signer.create_tx(relayer, [fresh], fee=2000, gas_limit=500_000)
     res3 = chain_b.check_tx(tx3.encode())
     assert res3.code == 0 or "redundant" not in res3.log
+
+
+def test_verifying_client_follows_valset_change(tmp_path):
+    """The IBC verifying client tracks the counterparty's validator set:
+    after a delegation shifts power, updates must supply the new set
+    (bound to the header's commitment + 1/3 overlap), and subsequent
+    same-set updates verify against the ADOPTED set."""
+    from celestia_app_tpu.chain import consensus
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.chain.ibc import IBCError
+    from celestia_app_tpu.chain.state import Context as Ctx
+    from celestia_app_tpu.chain.state import InfiniteGasMeter
+    from celestia_app_tpu.chain.tx import MsgDelegate
+    from celestia_app_tpu.chain.staking import POWER_REDUCTION
+    from celestia_app_tpu.client.tx_client import Signer
+
+    privs = [PrivateKey.from_seed(bytes([60 + i])) for i in range(3)]
+    genesis = {
+        "time_unix": 1_700_000_000.0,
+        "accounts": [
+            {"address": p.public_key().address().hex(), "balance": 10**12}
+            for p in privs
+        ],
+        "validators": [
+            {
+                "operator": p.public_key().address().hex(),
+                "power": 10,
+                "pubkey": p.public_key().compressed.hex(),
+            }
+            for p in privs
+        ],
+    }
+    nodes = [
+        consensus.ValidatorNode(f"a{i}", privs[i], genesis, "chain-a")
+        for i in range(3)
+    ]
+    net = consensus.LocalNetwork(nodes)
+    signer = Signer("chain-a")
+    for i, p in enumerate(privs):
+        signer.add_account(p, number=i)
+    a0 = privs[0].public_key().address()
+    v1 = privs[1].public_key().address()
+
+    chain_b, _s, _p = make_app()
+    ctx_b = _ctx(chain_b)
+    valset = {p.public_key().address(): p.public_key().compressed for p in privs}
+    chain_b.ibc.clients.create_client(
+        ctx_b, "client-a", chain_id="chain-a", validators=valset,
+        powers={p.public_key().address(): 10 for p in privs},
+    )
+
+    # height 1: delegation tx (set unchanged at propose time)
+    tx = signer.create_tx(a0, [MsgDelegate(a0, v1, 7 * POWER_REDUCTION)],
+                          fee=4000, gas_limit=300_000)
+    assert net.broadcast_tx(tx.encode())
+    blk1, cert1 = net.produce_height(t=1_700_000_010.0)
+    chain_b.ibc.clients.update_client(
+        ctx_b, "client-a", 1, header=blk1.header, cert=cert1
+    )
+
+    # height 2: the header commits to the post-delegation set — the update
+    # must refuse without the candidate set, then adopt it
+    blk2, cert2 = net.produce_height(t=1_700_000_020.0)
+    with pytest.raises(IBCError, match="changed"):
+        chain_b.ibc.clients.update_client(
+            ctx_b, "client-a", 2, header=blk2.header, cert=cert2
+        )
+    ctx_a = Ctx(net.nodes[0].app.store, InfiniteGasMeter(),
+                net.nodes[0].app.height, 0, "chain-a", 1)
+    new_powers = dict(net.nodes[0].app.staking.validators(ctx_a))
+    assert new_powers[v1] == 17
+    chain_b.ibc.clients.update_client(
+        ctx_b, "client-a", 2, header=blk2.header, cert=cert2,
+        new_validators=valset, new_powers=new_powers,
+    )
+    assert chain_b.ibc.clients.consensus_root(
+        ctx_b, "client-a", 2
+    ) == blk2.header.app_hash
+
+    # height 3: same set again — verified against the ADOPTED powers
+    blk3, cert3 = net.produce_height(t=1_700_000_030.0)
+    chain_b.ibc.clients.update_client(
+        ctx_b, "client-a", 3, header=blk3.header, cert=cert3
+    )
+    assert chain_b.ibc.clients.consensus_root(
+        ctx_b, "client-a", 3
+    ) == blk3.header.app_hash

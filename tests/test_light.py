@@ -160,3 +160,37 @@ def test_light_client_no_overlap_rejected():
     with pytest.raises(light.LightClientError, match="overlap"):
         lc.update(header, cert, new_validators=new_vals,
                   new_powers=new_powers)
+
+
+def test_light_client_sequential_hash_linkage(tmp_path):
+    """Code-review follow-up: an adjacent (height+1) header must chain to
+    the trusted header via last_block_hash — a certificate over an
+    unlinked fork header is refused even with valid signatures."""
+    net, signer, privs = _network(tmp_path, with_disk=False)
+    blk1, cert1 = net.produce_height(t=1_700_000_010.0)
+    lc = light.LightClient(CHAIN, light.TrustedState(
+        height=1,
+        header_hash=blk1.header.hash(),
+        validators={
+            n.address: n.priv.public_key().compressed for n in net.nodes
+        },
+        powers={n.address: 10 for n in net.nodes},
+    ))
+    blk2, cert2 = net.produce_height(t=1_700_000_020.0)
+    # a forged "height 2" not chaining to blk1, but properly certified by
+    # the (byzantine-majority) validators
+    forged = dataclasses.replace(blk2.header, last_block_hash=b"\x13" * 32)
+    fh = forged.hash()
+    forged_votes = tuple(
+        consensus.Vote(
+            2, fh, n.address,
+            n.priv.sign(consensus.Vote.sign_bytes(CHAIN, 2, fh)),
+        )
+        for n in net.nodes
+    )
+    forged_cert = consensus.CommitCertificate(2, fh, forged_votes)
+    with pytest.raises(light.LightClientError, match="chain"):
+        lc.update(forged, forged_cert)
+    # the genuine header still advances
+    st = lc.update(blk2.header, cert2)
+    assert st.height == 2

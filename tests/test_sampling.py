@@ -1,0 +1,77 @@
+"""Data availability sampling: honest blocks verify; withheld or tampered
+cells are caught; tampered proofs never verify."""
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.da import dah as dah_mod
+from celestia_app_tpu.da import proof_device
+from celestia_app_tpu.da import sampling
+
+
+def _block(k=4, seed=0):
+    rng = np.random.default_rng(seed)
+    ods = rng.integers(0, 256, size=(k, k, 512), dtype=np.uint8)
+    ods[..., :29] = 0
+    ods[..., 28] = 9
+    d, eds_obj, root = dah_mod.new_dah_from_ods(ods)
+    return d, proof_device.BlockProver(eds_obj, d)
+
+
+def test_honest_block_samples_verify():
+    d, prover = _block()
+    rng = np.random.default_rng(42)
+    rep = sampling.sample_block(d, prover.prove_cell, 20, rng)
+    assert rep.available and rep.verified == 20
+    assert rep.confidence == pytest.approx(1 - 0.75**20)
+
+
+def test_withholding_is_caught():
+    """A server refusing a quadrant: samples landing there fail and the
+    block is reported unavailable."""
+    d, prover = _block(seed=1)
+    k = 2 * (len(d.row_roots) // 2) // 2  # original k
+
+    def withholding(row, col):
+        if row >= k and col >= k:  # hide Q3
+            raise IOError("not serving that cell")
+        return prover.prove_cell(row, col)
+
+    rng = np.random.default_rng(7)
+    rep = sampling.sample_block(d, withholding, 40, rng)
+    assert not rep.available
+    assert all(r >= k and c >= k for r, c in rep.failed)
+
+
+def test_tampered_share_fails_verification():
+    d, prover = _block(seed=2)
+
+    def tampering(row, col):
+        share, proof = prover.prove_cell(row, col)
+        bad = bytearray(share)
+        bad[100] ^= 0xFF
+        return bytes(bad), proof
+
+    rng = np.random.default_rng(9)
+    rep = sampling.sample_block(d, tampering, 10, rng)
+    assert rep.verified == 0 and len(rep.failed) == 10
+
+
+def test_proof_for_wrong_cell_rejected():
+    """A malicious server answering with a DIFFERENT (valid) cell's proof
+    must fail: the proof position is bound to the requested column."""
+    d, prover = _block(seed=3)
+    share, proof = prover.prove_cell(1, 1)
+    assert sampling.verify_sample(d, 1, 1, share, proof)
+    # same proof presented for another coordinate
+    assert not sampling.verify_sample(d, 1, 2, share, proof)
+    assert not sampling.verify_sample(d, 2, 1, share, proof)
+
+
+def test_parity_cells_sample_with_parity_namespace():
+    """Q1/Q2/Q3 cells verify under the parity namespace leaf rule."""
+    d, prover = _block(seed=4)
+    k = len(d.row_roots) // 2
+    for row, col in [(0, k), (k, 0), (2 * k - 1, 2 * k - 1)]:
+        share, proof = prover.prove_cell(row, col)
+        assert sampling.verify_sample(d, row, col, share, proof)
