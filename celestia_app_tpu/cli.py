@@ -678,6 +678,34 @@ def cmd_snapshot(args) -> int:
     return 0
 
 
+def cmd_das(args) -> int:
+    """Data availability sampling against a stored block (da/sampling.py):
+    the light-node check, run from the CLI — random extended-square cells
+    verified against the block's DAH."""
+    import numpy as np
+
+    from celestia_app_tpu.chain.query import QueryRouter
+    from celestia_app_tpu.da import sampling
+
+    app, _cfg = _make_app(args.home)
+    router = QueryRouter(app)
+    height = args.height if args.height is not None else app.height
+    _block, _square, prover, root = router._prover(height)
+    rng = np.random.default_rng(args.seed)
+    rep = sampling.sample_block(prover.dah, prover.prove_cell,
+                                args.samples, rng)
+    print(json.dumps({
+        "height": height,
+        "data_root": root.hex(),
+        "samples": rep.samples,
+        "verified": rep.verified,
+        "failed": rep.failed,
+        "available": rep.available,
+        "confidence": round(rep.confidence, 6),
+    }, indent=2))
+    return 0 if rep.available else 1
+
+
 def cmd_keys(args) -> int:
     from celestia_app_tpu.chain.crypto import PrivateKey
     from celestia_app_tpu.wire import bech32
@@ -864,6 +892,14 @@ def main(argv=None) -> int:
     p.add_argument("--home", required=True)
     p.add_argument("--out", required=True, help="snapshot directory")
     p.set_defaults(fn=cmd_snapshot)
+
+    p = sub.add_parser("das", help="sample a stored block's availability")
+    p.add_argument("--home", required=True)
+    p.add_argument("--height", type=int, default=None)
+    p.add_argument("--samples", type=int, default=16)
+    p.add_argument("--seed", type=int, default=None,
+                   help="sampling entropy (default: OS randomness)")
+    p.set_defaults(fn=cmd_das)
 
     p = sub.add_parser("keys")
     p.add_argument("action", choices=["derive"])

@@ -75,3 +75,25 @@ def test_parity_cells_sample_with_parity_namespace():
     for row, col in [(0, k), (k, 0), (2 * k - 1, 2 * k - 1)]:
         share, proof = prover.prove_cell(row, col)
         assert sampling.verify_sample(d, row, col, share, proof)
+
+
+def test_das_cli_against_stored_block(tmp_path):
+    """`das` CLI: sample a devnet-committed block's availability from a
+    validator home."""
+    import io
+    import json
+    from contextlib import redirect_stdout
+
+    from celestia_app_tpu import cli
+
+    home = str(tmp_path / "dn")
+    rc = cli.main(["devnet", "--home", home, "--chain-id", "das-test",
+                   "--validators", "2", "--blocks", "1", "--load"])
+    assert rc == 0
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(["das", "--home", f"{home}/val0", "--height", "1",
+                       "--samples", "8", "--seed", "1"])
+    assert rc == 0
+    out = json.loads(buf.getvalue())
+    assert out["available"] is True and out["verified"] == 8
