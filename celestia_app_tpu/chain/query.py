@@ -100,6 +100,10 @@ class QueryRouter:
             return self._share_inclusion(data)
         if path == "custom/namespaceData":
             return self._namespace_data(data)
+        if path == "custom/dah":
+            return self._dah(data)
+        if path == "custom/sampleCell":
+            return self._sample_cell(data)
         if path == "bank/balance":
             addr = bytes.fromhex(data["address"])
             return {"balance": self.app.bank.balance(self._ctx(), addr)}
@@ -193,6 +197,40 @@ class QueryRouter:
         block, square, prover, root = self._prover(height)
         pf = prover.prove_shares(start, end, namespace)
         return {"proof": _share_proof_json(pf), "data_root": root.hex()}
+
+    def prover_for(self, height: int):
+        """Public accessor for the per-height device prover: (prover,
+        data_root). The CLI's das command and tests use this instead of
+        the private cache-entry tuple."""
+        _block, _square, prover, root = self._prover(height)
+        return prover, root
+
+    def _dah(self, data: dict) -> dict:
+        """A block's full DAH (row+col roots) — what a light node needs to
+        verify samples; it binds to the header via dah.hash()==data_hash."""
+        height = int(data["height"])
+        block, square, prover, root = self._prover(height)
+        return {
+            "row_roots": [r.hex() for r in prover.dah.row_roots],
+            "col_roots": [r.hex() for r in prover.dah.col_roots],
+            "data_root": root.hex(),
+        }
+
+    def _sample_cell(self, data: dict) -> dict:
+        """One extended-square cell + NMT proof (the DAS serving side)."""
+        height = int(data["height"])
+        row, col = int(data["row"]), int(data["col"])
+        block, square, prover, root = self._prover(height)
+        share, proof = prover.prove_cell(row, col)
+        return {
+            "share": base64.b64encode(share).decode(),
+            "proof": {
+                "start": proof.start,
+                "end": proof.end,
+                "total": proof.total,
+                "nodes": [base64.b64encode(n).decode() for n in proof.nodes],
+            },
+        }
 
     def _namespace_data(self, data: dict) -> dict:
         """GetSharesByNamespace-style route: every share of a namespace in

@@ -679,24 +679,93 @@ def cmd_snapshot(args) -> int:
 
 
 def cmd_das(args) -> int:
-    """Data availability sampling against a stored block (da/sampling.py):
-    the light-node check, run from the CLI — random extended-square cells
-    verified against the block's DAH."""
+    """Data availability sampling (da/sampling.py), two modes:
+
+    --url: the REAL light-node check against a remote, untrusted node —
+    fetch the block header and DAH over HTTP, verify dah.hash() binds to
+    the header's data root, then sample random cells via
+    custom/sampleCell; a withholding or tampering server fails samples.
+
+    --home: local self-audit of a stored block — the square is rebuilt and
+    revalidated against the stored header (disk corruption surfaces as
+    unavailable, not a traceback)."""
     import numpy as np
 
-    from celestia_app_tpu.chain.query import QueryRouter
     from celestia_app_tpu.da import sampling
 
-    app, _cfg = _make_app(args.home)
-    router = QueryRouter(app)
-    height = args.height if args.height is not None else app.height
-    _block, _square, prover, root = router._prover(height)
+    if args.samples < 1:
+        print("error: --samples must be >= 1", file=sys.stderr)
+        return 2
+    if not args.url and not args.home:
+        print("error: das needs --home or --url", file=sys.stderr)
+        return 2
     rng = np.random.default_rng(args.seed)
-    rep = sampling.sample_block(prover.dah, prover.prove_cell,
-                                args.samples, rng)
+
+    if args.url:
+        base = args.url.rstrip("/")
+        import base64 as b64
+        import urllib.request
+
+        def _post(path, payload):
+            req = urllib.request.Request(
+                base + "/abci_query",
+                data=json.dumps({"path": path, "data": payload}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read())
+
+        with urllib.request.urlopen(base + "/status", timeout=30) as r:
+            status = json.loads(r.read())
+        height = args.height if args.height is not None else status["height"]
+        with urllib.request.urlopen(base + f"/block/{height}", timeout=30) as r:
+            blk = json.loads(r.read())
+        dah_doc = _post("custom/dah", {"height": height})
+        from celestia_app_tpu.da.dah import DataAvailabilityHeader
+        from celestia_app_tpu.utils import nmt_host
+
+        dah = DataAvailabilityHeader(
+            row_roots=tuple(bytes.fromhex(x) for x in dah_doc["row_roots"]),
+            col_roots=tuple(bytes.fromhex(x) for x in dah_doc["col_roots"]),
+        )
+        if dah.hash().hex() != blk["data_hash"]:
+            print(json.dumps({
+                "height": height, "available": False,
+                "error": "served DAH does not bind to the header's data root",
+            }, indent=2))
+            return 1
+        root_hex = blk["data_hash"]
+
+        def fetch_cell(row, col):
+            out = _post("custom/sampleCell",
+                        {"height": height, "row": row, "col": col})
+            proof = nmt_host.NmtRangeProof(
+                start=out["proof"]["start"],
+                end=out["proof"]["end"],
+                total=out["proof"]["total"],
+                nodes=[b64.b64decode(n) for n in out["proof"]["nodes"]],
+            )
+            return b64.b64decode(out["share"]), proof
+    else:
+        from celestia_app_tpu.chain.query import QueryError, QueryRouter
+
+        app, _cfg = _make_app(args.home)
+        router = QueryRouter(app)
+        height = args.height if args.height is not None else app.height
+        try:
+            prover, root = router.prover_for(height)
+        except (QueryError, FileNotFoundError, KeyError, ValueError) as e:
+            # corrupted/missing stored block = unavailable, not a crash
+            print(json.dumps({
+                "height": height, "available": False, "error": str(e),
+            }, indent=2))
+            return 1
+        dah, fetch_cell, root_hex = prover.dah, prover.prove_cell, root.hex()
+
+    rep = sampling.sample_block(dah, fetch_cell, args.samples, rng)
     print(json.dumps({
         "height": height,
-        "data_root": root.hex(),
+        "data_root": root_hex,
         "samples": rep.samples,
         "verified": rep.verified,
         "failed": rep.failed,
@@ -893,8 +962,9 @@ def main(argv=None) -> int:
     p.add_argument("--out", required=True, help="snapshot directory")
     p.set_defaults(fn=cmd_snapshot)
 
-    p = sub.add_parser("das", help="sample a stored block's availability")
-    p.add_argument("--home", required=True)
+    p = sub.add_parser("das", help="sample a block's data availability")
+    p.add_argument("--home", help="local self-audit of a stored block")
+    p.add_argument("--url", help="light-node mode against a remote node")
     p.add_argument("--height", type=int, default=None)
     p.add_argument("--samples", type=int, default=16)
     p.add_argument("--seed", type=int, default=None,

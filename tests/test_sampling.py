@@ -78,8 +78,8 @@ def test_parity_cells_sample_with_parity_namespace():
 
 
 def test_das_cli_against_stored_block(tmp_path):
-    """`das` CLI: sample a devnet-committed block's availability from a
-    validator home."""
+    """`das` CLI: local self-audit of a devnet block, then REAL light-node
+    mode over HTTP against the node's sample-serving routes."""
     import io
     import json
     from contextlib import redirect_stdout
@@ -97,3 +97,24 @@ def test_das_cli_against_stored_block(tmp_path):
     assert rc == 0
     out = json.loads(buf.getvalue())
     assert out["available"] is True and out["verified"] == 8
+
+    # light-node mode: serve val0 over HTTP, sample across the wire
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.service.server import NodeService
+
+    app, _cfg = cli._make_app(f"{home}/val0")
+    svc = NodeService(Node(app), port=0)
+    svc.serve_background()
+    try:
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = cli.main(["das", "--url", f"http://127.0.0.1:{svc.port}",
+                           "--height", "1", "--samples", "6", "--seed", "2"])
+        assert rc == 0
+        out = json.loads(buf.getvalue())
+        assert out["available"] is True and out["verified"] == 6
+    finally:
+        svc.shutdown()
+
+    # zero samples is an error, not vacuous success
+    assert cli.main(["das", "--home", f"{home}/val0", "--samples", "0"]) == 2
