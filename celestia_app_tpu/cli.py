@@ -681,10 +681,14 @@ def cmd_snapshot(args) -> int:
 def cmd_das(args) -> int:
     """Data availability sampling (da/sampling.py), two modes:
 
-    --url: the REAL light-node check against a remote, untrusted node —
-    fetch the block header and DAH over HTTP, verify dah.hash() binds to
-    the header's data root, then sample random cells via
-    custom/sampleCell; a withholding or tampering server fails samples.
+    --url: light-node check against a remote node. The DAH is fetched over
+    HTTP, validated, and bound to a data root. With --trusted-root (a data
+    root from a TRUSTED source — a light client following commit
+    certificates, chain/light.py) the server cannot fabricate a block:
+    withholding, tampering, and a wrong DAH all fail. Without it the root
+    comes from the server's own header (trust-on-first-use; the report
+    carries "header_trusted": false) and only withholding/inconsistency
+    within the served block is detectable.
 
     --home: local self-audit of a stored block — the square is rebuilt and
     revalidated against the stored header (disk corruption surfaces as
@@ -696,49 +700,73 @@ def cmd_das(args) -> int:
     if args.samples < 1:
         print("error: --samples must be >= 1", file=sys.stderr)
         return 2
-    if not args.url and not args.home:
-        print("error: das needs --home or --url", file=sys.stderr)
+    if bool(args.url) == bool(args.home):
+        print("error: das needs exactly one of --home or --url",
+              file=sys.stderr)
         return 2
     rng = np.random.default_rng(args.seed)
+    header_trusted = True
+
+    def _unavailable(height, msg):
+        print(json.dumps({
+            "height": height, "available": False, "error": msg,
+        }, indent=2))
+        return 1
 
     if args.url:
-        base = args.url.rstrip("/")
         import base64 as b64
-        import urllib.request
+        import urllib.error
 
-        def _post(path, payload):
-            req = urllib.request.Request(
-                base + "/abci_query",
-                data=json.dumps({"path": path, "data": payload}).encode(),
-                headers={"Content-Type": "application/json"},
-            )
-            with urllib.request.urlopen(req, timeout=30) as r:
-                return json.loads(r.read())
-
-        with urllib.request.urlopen(base + "/status", timeout=30) as r:
-            status = json.loads(r.read())
-        height = args.height if args.height is not None else status["height"]
-        with urllib.request.urlopen(base + f"/block/{height}", timeout=30) as r:
-            blk = json.loads(r.read())
-        dah_doc = _post("custom/dah", {"height": height})
+        from celestia_app_tpu.client.tx_client import HttpNodeClient
         from celestia_app_tpu.da.dah import DataAvailabilityHeader
         from celestia_app_tpu.utils import nmt_host
 
-        dah = DataAvailabilityHeader(
-            row_roots=tuple(bytes.fromhex(x) for x in dah_doc["row_roots"]),
-            col_roots=tuple(bytes.fromhex(x) for x in dah_doc["col_roots"]),
-        )
-        if dah.hash().hex() != blk["data_hash"]:
-            print(json.dumps({
-                "height": height, "available": False,
-                "error": "served DAH does not bind to the header's data root",
-            }, indent=2))
-            return 1
-        root_hex = blk["data_hash"]
+        remote = HttpNodeClient(args.url)
+        height = args.height
+        try:
+            if height is None:
+                height = remote.status()["height"]
+            dah_doc = remote._post(
+                "/abci_query", {"path": "custom/dah",
+                                "data": {"height": height}}
+            )
+            dah = DataAvailabilityHeader(
+                row_roots=tuple(
+                    bytes.fromhex(x) for x in dah_doc["row_roots"]
+                ),
+                col_roots=tuple(
+                    bytes.fromhex(x) for x in dah_doc["col_roots"]
+                ),
+            )
+            # structural validation of UNTRUSTED input before anything
+            # touches it (bounds, root shapes — dah.validate_basic)
+            dah.validate_basic()
+        except (urllib.error.URLError, OSError, ValueError, KeyError) as e:
+            return _unavailable(height, f"fetching DAH failed: {e}")
+        if args.trusted_root:
+            root_hex = args.trusted_root.lower()
+        else:
+            header_trusted = False  # bound only to the server's own header
+            try:
+                import urllib.request
+
+                with urllib.request.urlopen(
+                    remote.base_url + f"/block/{height}", timeout=30
+                ) as r:
+                    root_hex = json.loads(r.read())["data_hash"]
+            except (urllib.error.URLError, OSError, ValueError, KeyError) as e:
+                return _unavailable(height, f"fetching header failed: {e}")
+        if dah.hash().hex() != root_hex:
+            return _unavailable(
+                height, "served DAH does not bind to the data root"
+            )
 
         def fetch_cell(row, col):
-            out = _post("custom/sampleCell",
-                        {"height": height, "row": row, "col": col})
+            out = remote._post(
+                "/abci_query",
+                {"path": "custom/sampleCell",
+                 "data": {"height": height, "row": row, "col": col}},
+            )
             proof = nmt_host.NmtRangeProof(
                 start=out["proof"]["start"],
                 end=out["proof"]["end"],
@@ -766,6 +794,7 @@ def cmd_das(args) -> int:
     print(json.dumps({
         "height": height,
         "data_root": root_hex,
+        "header_trusted": header_trusted,
         "samples": rep.samples,
         "verified": rep.verified,
         "failed": rep.failed,
@@ -967,6 +996,10 @@ def main(argv=None) -> int:
     p.add_argument("--url", help="light-node mode against a remote node")
     p.add_argument("--height", type=int, default=None)
     p.add_argument("--samples", type=int, default=16)
+    p.add_argument("--trusted-root",
+                   help="hex data root from a TRUSTED source (e.g. a light "
+                        "client following certificates); binds the served "
+                        "DAH so the server cannot fabricate the block")
     p.add_argument("--seed", type=int, default=None,
                    help="sampling entropy (default: OS randomness)")
     p.set_defaults(fn=cmd_das)
