@@ -504,6 +504,11 @@ def main() -> None:
     if "--stream-mesh" in sys.argv:
         measure_stream_mesh()
         return
+    if "--stream-batched" in sys.argv:
+        from celestia_app_tpu.parallel import streaming
+
+        print(json.dumps(streaming.bench_stream_batched()))
+        return
     if "--stream" in sys.argv:
         measure_stream()
         return

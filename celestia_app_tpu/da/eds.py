@@ -68,6 +68,17 @@ def jitted_pipeline(k: int):
     return jax.jit(pipeline_fn(k))
 
 
+@functools.lru_cache(maxsize=None)
+def jitted_pipeline_batched(k: int):
+    """Compiled (B, k, k, 512) -> batched (eds, row_roots, col_roots,
+    data_roots): one dispatch covers B blocks, amortizing launch overhead
+    and keeping the MXU fed when single squares underfill it (the
+    one-chip analog of the sharded pipeline's `data` axis; BASELINE cfg 5
+    throughput). vmap of the single-square program — bit-identical per
+    block (tests/test_streaming.py)."""
+    return jax.jit(jax.vmap(pipeline_fn(k)))
+
+
 def roots_only_fn(k: int):
     """Variant that keeps the EDS on device and returns only roots (less HBM
     traffic back to host for the PrepareProposal fast path)."""

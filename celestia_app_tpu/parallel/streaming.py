@@ -54,6 +54,24 @@ def _synthetic_layout(k: int, seed: int) -> np.ndarray:
     return ods
 
 
+def _stream_batches(layout_fn, n_batches: int, run) -> list[bytes]:
+    """THE one-deep batch-overlap loop shared by the mesh and the
+    single-chip batched modes: host lays out batch i+1 while the device
+    works on batch i; returns the flat list of 32-byte data roots."""
+    if n_batches <= 0:
+        return []
+    roots: list[bytes] = []
+    pending = None
+    for i in range(n_batches):
+        batch = layout_fn(i)  # host: lay out batch i
+        out = run(batch)  # device/mesh: async dispatch
+        if pending is not None:
+            roots.extend(bytes(r) for r in np.asarray(pending[3]))
+        pending = out
+    roots.extend(bytes(r) for r in np.asarray(pending[3]))
+    return roots
+
+
 def stream_blocks_mesh(layout_fn, n_batches: int, mesh, k: int, *,
                        pipeline=None):
     """Mesh-sharded streaming (BASELINE cfg 5): each unit is a BATCH of
@@ -61,24 +79,11 @@ def stream_blocks_mesh(layout_fn, n_batches: int, mesh, k: int, *,
     (parallel/sharded_eds.py) — rows split over ``seq``, blocks over
     ``data`` — with the host laying out batch i+1 while the mesh extends
     and commits batch i. Returns the flat list of 32-byte data roots."""
-    import jax
-
     from celestia_app_tpu.parallel import sharded_eds
 
-    if n_batches <= 0:
-        return []
     run = (pipeline if pipeline is not None
            else sharded_eds.jitted_sharded_pipeline(mesh, k))
-    roots: list[bytes] = []
-    pending = None
-    for i in range(n_batches):
-        batch = layout_fn(i)  # host: lay out batch i
-        out = run(batch)  # mesh: async dispatch
-        if pending is not None:
-            roots.extend(bytes(r) for r in np.asarray(pending[3]))
-        pending = out
-    roots.extend(bytes(r) for r in np.asarray(pending[3]))
-    return roots
+    return _stream_batches(layout_fn, n_batches, run)
 
 
 def bench_stream_mesh(k: int | None = None, n_batches: int = 3,
@@ -121,6 +126,44 @@ def bench_stream_mesh(k: int | None = None, n_batches: int = 3,
         "backend": backend,
         "devices": n_devices,
         "mesh": dict(mesh.shape),
+        "blocks": n_blocks,
+        "elapsed_s": round(dt, 2),
+    }
+
+
+def bench_stream_batched(k: int | None = None, batch: int = 4,
+                         n_batches: int = 3) -> dict:
+    """Single-chip BATCHED streaming: one dispatch per batch of B squares
+    (da/eds.jitted_pipeline_batched) with host layout overlapped — the
+    one-device throughput mode (amortized launches, fuller MXU) the
+    sharded mesh generalizes across chips."""
+    import jax
+
+    backend = jax.devices()[0].platform
+    if k is None:
+        k = 128 if backend == "tpu" else 16
+    jitted = eds_mod.jitted_pipeline_batched(k)
+
+    def run(batch_arr):
+        return jitted(jax.device_put(batch_arr))
+
+    def layout(i: int):
+        return np.stack(
+            [_synthetic_layout(k, i * batch + j) for j in range(batch)]
+        )
+
+    jax.block_until_ready(run(layout(0))[3])  # warm the compile
+    t0 = time.perf_counter()
+    roots = _stream_batches(layout, n_batches, run)
+    dt = time.perf_counter() - t0
+    n_blocks = batch * n_batches
+    assert len(roots) == n_blocks and len(roots[0]) == 32
+    return {
+        "metric": f"stream_batched_blocks_per_sec_k{k}",
+        "value": round(n_blocks / dt, 3),
+        "unit": "blocks/s",
+        "backend": backend,
+        "batch": batch,
         "blocks": n_blocks,
         "elapsed_s": round(dt, 2),
     }

@@ -76,3 +76,31 @@ def test_stream_mesh_k256_gf16_blocks_per_sec():
     single = eds_mod.jitted_pipeline(k)
     root_single = bytes(np.asarray(single(ods[0])[3]))
     assert root_mesh == root_single
+
+
+def test_batched_pipeline_bit_identical_per_block():
+    """jitted_pipeline_batched: one dispatch over B squares equals the
+    single-square pipeline block-for-block (roots and EDS)."""
+    import jax
+
+    k = 8
+    layouts = np.stack([streaming._synthetic_layout(k, i) for i in range(3)])
+    batched = eds_mod.jitted_pipeline_batched(k)
+    eds_b, row_b, col_b, roots_b = jax.tree.map(
+        np.asarray, batched(jax.device_put(layouts))
+    )
+    single = eds_mod.jitted_pipeline(k)
+    for i in range(3):
+        eds1, row1, col1, root1 = jax.tree.map(
+            np.asarray, single(jax.device_put(layouts[i]))
+        )
+        np.testing.assert_array_equal(eds_b[i], eds1)
+        np.testing.assert_array_equal(row_b[i], row1)
+        np.testing.assert_array_equal(col_b[i], col1)
+        np.testing.assert_array_equal(roots_b[i], root1)
+
+
+def test_bench_stream_batched_reports():
+    out = streaming.bench_stream_batched(k=8, batch=2, n_batches=2)
+    assert out["value"] > 0 and out["blocks"] == 4
+    assert out["metric"].startswith("stream_batched_blocks_per_sec")
