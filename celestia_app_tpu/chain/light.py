@@ -53,8 +53,25 @@ class LightClient:
         reconstruction), not for fresh external input."""
         self.chain_id = chain_id
         self.trusted = trusted
+        # data roots condemned by verified bad-encoding fraud proofs:
+        # headers carrying them are refused even with a valid certificate
+        # (>2/3 of validators signing a non-codeword IS the fraud-proof
+        # threat model — specs fraud_proofs.md)
+        self.condemned_roots: set[bytes] = set()
         if check_set:
             self._check_set(trusted.validators, trusted.powers)
+
+    def submit_fraud_proof(self, dah, befp) -> bool:
+        """A gossiped bad-encoding fraud proof (da/fraud.BadEncodingProof)
+        against a block's DAH: if it VERIFIES — the committed roots carry a
+        non-codeword — the data root is condemned and any header carrying
+        it will be refused. Returns whether the proof checked out."""
+        from celestia_app_tpu.da import fraud
+
+        if not fraud.verify_befp(dah, befp):
+            return False
+        self.condemned_roots.add(dah.hash())
+        return True
 
     @staticmethod
     def _check_set(validators: dict[bytes, bytes],
@@ -91,6 +108,10 @@ class LightClient:
             )
         if cert.height != header.height or cert.block_hash != header.hash():
             raise LightClientError("certificate does not cover this header")
+        if header.data_hash in self.condemned_roots:
+            raise LightClientError(
+                "header carries a data root condemned by a fraud proof"
+            )
         # sequential hash-linkage: an adjacent header must chain to the
         # trusted one (skipping updates have no such check — the overlap
         # rule carries trust across the gap)

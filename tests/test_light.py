@@ -194,3 +194,57 @@ def test_light_client_sequential_hash_linkage(tmp_path):
     # the genuine header still advances
     st = lc.update(blk2.header, cert2)
     assert st.height == 2
+
+
+def test_light_client_refuses_fraud_condemned_header(tmp_path):
+    """A verified bad-encoding fraud proof condemns the data root: even a
+    properly certified header carrying it is refused (the light-node halt
+    the BEFP machinery exists for); junk proofs change nothing."""
+    import numpy as np
+
+    from celestia_app_tpu.da import dah as dah_mod
+    from celestia_app_tpu.da import fraud
+    from celestia_app_tpu.ops import rs
+
+    net, signer, privs = _network(tmp_path, with_disk=False)
+    lc = light.LightClient(CHAIN, _trusted_from(net))
+
+    # a producer commits a NON-codeword square (blind trees)
+    k = 4
+    rng = np.random.default_rng(0)
+    ods = rng.integers(0, 256, size=(k, k, 512), dtype=np.uint8)
+    ods[..., :29] = 0
+    ods[..., 28] = 9
+    corrupt = rs.extend_square_np(ods)
+    corrupt[1, 2 * k - 1] ^= 0xFF
+    from tests.test_fraud import _dah_of
+
+    d_bad = _dah_of(corrupt)
+    befp = fraud.generate_befp(
+        dah_mod.ExtendedDataSquare(corrupt), "row", 1
+    )
+    # a junk proof against an honest DAH is refused and condemns nothing
+    d_ok, _eds, _root = dah_mod.new_dah_from_ods(ods)
+    assert lc.submit_fraud_proof(d_ok, befp) is False
+    assert lc.condemned_roots == set()
+    # the genuine proof verifies and condemns the bad root
+    assert lc.submit_fraud_proof(d_bad, befp) is True
+
+    # >2/3 of validators certify a header carrying the condemned root:
+    # the light client still refuses it
+    blk, cert = net.produce_height(t=1_700_000_010.0)
+    forged = dataclasses.replace(blk.header, data_hash=d_bad.hash())
+    fh = forged.hash()
+    votes = tuple(
+        consensus.Vote(
+            1, fh, n.address,
+            n.priv.sign(consensus.Vote.sign_bytes(CHAIN, 1, fh)),
+        )
+        for n in net.nodes
+    )
+    bad_cert = consensus.CommitCertificate(1, fh, votes)
+    with pytest.raises(light.LightClientError, match="condemned"):
+        lc.update(forged, bad_cert)
+    # the honest header still advances
+    st = lc.update(blk.header, cert)
+    assert st.height == 1
