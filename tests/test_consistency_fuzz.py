@@ -152,3 +152,14 @@ def test_prepare_process_consistency(gov_max, seed):
                 signer.accounts[addr].sequence = acc["sequence"]
 
     assert app.height == 3
+
+
+@pytest.mark.parametrize("seed", range(4, 16))
+def test_prepare_process_consistency_wide(seed):
+    """Wide sweep of the single most important reimplementation invariant
+    (app/test/fuzz_abci_test.go:27): 12 more seeds x 3 rounds each across
+    random gov caps, on top of the default run's 4 seeds. ~Hundreds of
+    randomized blocks through the pessimistic-reserve builder, the full
+    ante chain, and the device data-root pipeline."""
+    gov_max = [None, 4, 8, 16][seed % 4]
+    test_prepare_process_consistency(gov_max, seed)
