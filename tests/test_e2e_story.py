@@ -182,3 +182,31 @@ def test_full_story(tmp_path):
                     pr.kill()
                 except Exception:
                     pass
+
+
+@pytest.mark.slow
+def test_big_block_acceptance():
+    """The reference e2e pass criterion (test/e2e/benchmark/throughput.go:
+    105,124-125): a block carrying >= 1 MB of blob data commits. Eight
+    200 KB blobs — the e2e manifests' blob shape — fill a gov-max 64x64
+    square (~1.6 MB) through CheckTx, Prepare, Process, and commit."""
+    from test_app import make_app
+
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.client.tx_client import TxClient
+
+    app, signer, privs = make_app()
+    node = Node(app)
+    client = TxClient(node, signer)
+    addr = privs[0].public_key().address()
+    rng = np.random.default_rng(0)
+    blobs = [
+        Blob(Namespace.v0(bytes([i + 1]) * 5),
+             rng.integers(0, 256, 200_000, dtype=np.uint8).tobytes())
+        for i in range(8)
+    ]
+    height, res = client.submit_pay_for_blob(addr, blobs)
+    assert res.code == 0, res.log
+    blk = node.blocks[-1]
+    assert sum(len(tx) for tx in blk.txs) >= 1_000_000
+    assert blk.header.square_size == 64  # the gov-max square, full
