@@ -280,6 +280,13 @@ class App:
     # pipeline selection
     # ------------------------------------------------------------------
 
+    def close(self) -> None:
+        """Release durable-storage handles (the native engine holds a
+        writer flock; an App replaced in-process — reborn-validator tests,
+        rollback tooling — must release it before a successor opens)."""
+        if self.db is not None:
+            self.db.close()
+
     def _pipeline(self, ods):
         """ODS -> (row_roots, col_roots, data_root); device when possible."""
         if self.engine in ("device", "auto"):

@@ -14,16 +14,26 @@ keys, not total state size. Every block (header + txs) is kept so proofs
 for past heights can be re-derived (pkg/proof/querier.go re-extends the
 square from block data).
 
-Layout under ``data_dir``:
+Two storage engines sit under ONE ChainDB (the commit/load/prune logic is
+engine-independent; engines only move bytes):
 
-    state/<height:020d>.json.gz   full store + identity at height
-    delta/<height:020d>.json.gz   changed/deleted keys + identity at height
-    blocks/<height:020d>.json.gz  block: header fields + base64 txs
-    LATEST                        latest committed height (atomic rename)
+- **native** (default where the toolchain exists): native/chaindb.cc via
+  ctypes — a segmented append-only record store with CRC framing, fsync
+  batching, torn-tail recovery, rollback/prune tombstones, dead-segment GC
+  and writer flocks. This is the tm-db analog and the engine a real
+  validator runs on.
+- **files**: one gzip-JSON artifact per height under state/ delta/ blocks/
+  plus a LATEST pointer, each atomically renamed and fsynced. Zero native
+  dependencies; also the round-3 on-disk layout, which it still reads.
 
-Atomicity: temp-file + os.replace per artifact, LATEST written last — a
-crash mid-commit leaves the previous height intact and the node resumes
-from it (state-sync-style restore is just copying these files).
+Selection: ``CELESTIA_CHAINDB`` env = ``native`` / ``files`` / ``auto``
+(default). Auto keeps whatever engine a home already uses (seg-*.log ⇒
+native; LATEST/state ⇒ files) and picks native for fresh homes when the
+.so is buildable.
+
+Crash-safety contract (both engines): the commit artifact is durable
+BEFORE the latest-pointer that references it — a crash between the two
+resumes from the previous height; a torn tail is dropped on reopen.
 """
 
 from __future__ import annotations
@@ -36,6 +46,9 @@ from celestia_app_tpu.chain.block import Block
 
 PRUNE_KEEP = 100  # same rollback window the in-memory history kept
 FULL_INTERVAL = 64  # full snapshot cadence (state-sync interval analog)
+
+# record streams (shared by both engines; the file engine maps them to dirs)
+STATE, DELTA, BLOCK, LATEST = 0, 1, 2, 3
 
 
 def _atomic_write(path: str, data: bytes) -> None:
@@ -55,30 +68,202 @@ def _atomic_write(path: str, data: bytes) -> None:
         os.close(dfd)
 
 
-class ChainDB:
+class FileBackend:
+    """gzip-JSON-per-height files; every op is individually durable."""
+
+    DIRS = {STATE: "state", DELTA: "delta", BLOCK: "blocks"}
+
     def __init__(self, data_dir: str):
         self.dir = data_dir
-        os.makedirs(os.path.join(data_dir, "state"), exist_ok=True)
-        os.makedirs(os.path.join(data_dir, "delta"), exist_ok=True)
-        os.makedirs(os.path.join(data_dir, "blocks"), exist_ok=True)
+        for sub in self.DIRS.values():
+            os.makedirs(os.path.join(data_dir, sub), exist_ok=True)
 
-    # -- commits ---------------------------------------------------------
+    def _path(self, stream: int, height: int) -> str:
+        return os.path.join(
+            self.dir, self.DIRS[stream], f"{height:020d}.json.gz"
+        )
 
-    def _state_path(self, height: int) -> str:
-        return os.path.join(self.dir, "state", f"{height:020d}.json.gz")
+    def put(self, stream: int, height: int, blob: bytes) -> None:
+        _atomic_write(self._path(stream, height), blob)
 
-    def _delta_path(self, height: int) -> str:
-        return os.path.join(self.dir, "delta", f"{height:020d}.json.gz")
+    def get(self, stream: int, height: int) -> bytes | None:
+        try:
+            with open(self._path(stream, height), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
 
-    def _heights_in(self, sub: str) -> list[int]:
+    def heights(self, stream: int) -> list[int]:
         out = []
-        for name in os.listdir(os.path.join(self.dir, sub)):
+        for name in os.listdir(os.path.join(self.dir, self.DIRS[stream])):
             if name.endswith(".json.gz"):
                 try:
                     out.append(int(name.split(".")[0]))
                 except ValueError:
                     pass
         return sorted(out)
+
+    def latest(self) -> int | None:
+        try:
+            with open(os.path.join(self.dir, "LATEST"), "rb") as f:
+                return int(f.read().decode())
+        except FileNotFoundError:
+            return None
+
+    def set_latest(self, height: int) -> None:
+        _atomic_write(os.path.join(self.dir, "LATEST"), str(height).encode())
+
+    def delete_at(self, stream: int, height: int) -> None:
+        try:
+            os.unlink(self._path(stream, height))
+        except FileNotFoundError:
+            pass
+
+    def delete_above(self, height: int) -> None:
+        for stream in self.DIRS:
+            for h in self.heights(stream):
+                if h > height:
+                    self.delete_at(stream, h)
+        # keep the pointer consistent with the surviving artifacts (the
+        # native engine's tomb_above does this implicitly): a crash after
+        # rollback but before the next commit must resume at `height`, not
+        # point at deltas that no longer exist
+        latest = self.latest()
+        if latest is not None and latest > height:
+            self.set_latest(height)
+
+    def sync(self) -> None:  # every put/set_latest already fsynced
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class NativeBackend:
+    """native/chaindb.cc via ctypes (utils/native_chaindb.py)."""
+
+    def __init__(self, data_dir: str, *, read_only: bool = False):
+        from celestia_app_tpu.utils import native_chaindb
+
+        self.dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self.log = native_chaindb.NativeLog(data_dir, read_only=read_only)
+
+    def put(self, stream: int, height: int, blob: bytes) -> None:
+        self.log.put(stream, height, blob)
+
+    def get(self, stream: int, height: int) -> bytes | None:
+        return self.log.get(stream, height)
+
+    def heights(self, stream: int) -> list[int]:
+        return self.log.heights(stream)
+
+    def latest(self) -> int | None:
+        # LATEST is a stream of empty records; rollback tombstones shrink it
+        # in the same append-only log as everything else
+        return self.log.latest(LATEST)
+
+    def set_latest(self, height: int) -> None:
+        # barrier FIRST: the artifact this pointer references must be
+        # durable before the pointer (the file engine gets this ordering
+        # from its per-op fsyncs). No trailing sync: losing the pointer
+        # itself just resumes from the previous height, which the
+        # crash-safety contract permits — save_commit's final sync() is
+        # the one that makes the whole commit durable.
+        prev = self.log.latest(LATEST)
+        self.log.sync()
+        self.log.put(LATEST, height, b"")
+        # retire the superseded pointer record or the LATEST stream (and
+        # open-time replay) grows one dead entry per commit forever; tomb
+        # AFTER the new put so a crash between the two cannot regress the
+        # pointer below `prev`
+        if prev is not None and prev != height:
+            self.log.tomb_at(LATEST, prev)
+
+    def delete_at(self, stream: int, height: int) -> None:
+        self.log.tomb_at(stream, height)
+
+    def delete_above(self, height: int) -> None:
+        self.log.tomb_above(height)
+        self.log.sync()
+
+    def sync(self) -> None:
+        self.log.sync()
+
+    def close(self) -> None:
+        self.log.close()
+
+
+def _detect_backend(data_dir: str, *, read_only: bool = False):
+    """CELESTIA_CHAINDB = native / files / auto (default: keep what the home
+    already uses; native for fresh homes when the toolchain exists)."""
+    choice = os.environ.get("CELESTIA_CHAINDB", "auto")
+    if choice == "files":
+        return FileBackend(data_dir)
+    if choice == "native":
+        return NativeBackend(data_dir, read_only=read_only)
+    has_native = bool(
+        [n for n in _listdir(data_dir) if n.startswith("seg-")]
+    )
+    has_files = os.path.exists(os.path.join(data_dir, "LATEST")) or (
+        os.path.isdir(os.path.join(data_dir, "state"))
+    )
+    if has_native and not has_files:
+        return NativeBackend(data_dir, read_only=read_only)
+    if has_files:
+        return FileBackend(data_dir)
+    from celestia_app_tpu.utils import native_chaindb
+
+    if native_chaindb.available():
+        return NativeBackend(data_dir, read_only=read_only)
+    return FileBackend(data_dir)
+
+
+def _listdir(path: str) -> list[str]:
+    try:
+        return os.listdir(path)
+    except FileNotFoundError:
+        return []
+
+
+def wipe_commits(data_dir: str) -> None:
+    """Destroy ALL committed state + blocks (both engines' artifacts),
+    keeping everything else in the home (WAL, keys, config). This is the
+    disk-level wipe the crash-recovery tests and ops runbooks mean by
+    "lost its data dir but kept the WAL" — after it, a node rebuilds from
+    genesis + WAL replay (or state-sync)."""
+    import shutil
+
+    for sub in FileBackend.DIRS.values():
+        shutil.rmtree(os.path.join(data_dir, sub), ignore_errors=True)
+    for name in _listdir(data_dir):
+        if name == "LATEST" or name == "LOCK" or name.startswith("seg-"):
+            try:
+                os.unlink(os.path.join(data_dir, name))
+            except FileNotFoundError:
+                pass
+
+
+class ChainDB:
+    def __init__(self, data_dir: str, *, backend=None, read_only: bool = False):
+        self.dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self.backend = backend or _detect_backend(data_dir, read_only=read_only)
+
+    def close(self) -> None:
+        self.backend.close()
+
+    # -- commits ---------------------------------------------------------
+
+    @staticmethod
+    def _encode(doc: dict) -> bytes:
+        return gzip.compress(
+            json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+        )
+
+    @staticmethod
+    def _decode(blob: bytes) -> dict:
+        return json.loads(gzip.decompress(blob))
 
     def save_commit(
         self,
@@ -100,7 +285,7 @@ class ChainDB:
             # the old fork's (reconstructing a state that existed on neither)
             self.delete_above(height)
             force_full = True
-        fulls = self._heights_in("state")
+        fulls = self.backend.heights(STATE)
         write_full = (
             force_full
             or not fulls
@@ -113,10 +298,7 @@ class ChainDB:
                 "meta": meta,
                 "store": {k.hex(): v.hex() for k, v in store.snapshot().items()},
             }
-            blob = gzip.compress(
-                json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
-            )
-            _atomic_write(self._state_path(height), blob)
+            self.backend.put(STATE, height, self._encode(doc))
         else:
             doc = {
                 "height": height,
@@ -126,23 +308,13 @@ class ChainDB:
                     for k, v in changes.items()
                 },
             }
-            blob = gzip.compress(
-                json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
-            )
-            _atomic_write(self._delta_path(height), blob)
-        _atomic_write(os.path.join(self.dir, "LATEST"), str(height).encode())
+            self.backend.put(DELTA, height, self._encode(doc))
+        self.backend.set_latest(height)
         self._prune(height)
+        self.backend.sync()
 
     def latest_height(self) -> int | None:
-        try:
-            with open(os.path.join(self.dir, "LATEST"), "rb") as f:
-                return int(f.read().decode())
-        except FileNotFoundError:
-            return None
-
-    def _read_doc(self, path: str) -> dict:
-        with gzip.open(path, "rb") as f:
-            return json.loads(f.read())
+        return self.backend.latest()
 
     def load_commit(self, height: int | None = None):
         """-> (height, store_data, meta); latest when height is None.
@@ -154,16 +326,16 @@ class ChainDB:
             height = self.latest_height()
             if height is None:
                 raise FileNotFoundError("no committed state on disk")
-        fulls = [h for h in self._heights_in("state") if h <= height]
+        fulls = [h for h in self.backend.heights(STATE) if h <= height]
         if not fulls:
             raise FileNotFoundError(f"no snapshot at or below height {height}")
         base = max(fulls)
-        doc = self._read_doc(self._state_path(base))
+        doc = self._decode(self.backend.get(STATE, base))
         store = {
             bytes.fromhex(k): bytes.fromhex(v) for k, v in doc["store"].items()
         }
         meta = doc["meta"]
-        deltas = [h for h in self._heights_in("delta") if base < h <= height]
+        deltas = [h for h in self.backend.heights(DELTA) if base < h <= height]
         expected = list(range(base + 1, height + 1))
         if deltas != expected:
             raise FileNotFoundError(
@@ -171,7 +343,7 @@ class ChainDB:
                 f"need {base + 1}..{height}"
             )
         for h in deltas:
-            d = self._read_doc(self._delta_path(h))
+            d = self._decode(self.backend.get(DELTA, h))
             for k_hex, v_hex in d["changes"].items():
                 k = bytes.fromhex(k_hex)
                 if v_hex is None:
@@ -184,38 +356,25 @@ class ChainDB:
     def delete_above(self, height: int) -> None:
         """Remove commits and blocks above `height` (rollback discards the
         abandoned fork, like the reference's rollback deleting versions)."""
-        for sub in ("state", "delta", "blocks"):
-            d = os.path.join(self.dir, sub)
-            for name in os.listdir(d):
-                if not name.endswith(".json.gz"):
-                    continue
-                try:
-                    h = int(name.split(".")[0])
-                except ValueError:
-                    continue
-                if h > height:
-                    os.unlink(os.path.join(d, name))
+        self.backend.delete_above(height)
 
     def _prune(self, latest: int) -> None:
         """Prune outside the rollback window, keeping every height in
         [latest-PRUNE_KEEP, latest] reconstructible: the newest full
         snapshot at or below the window floor anchors the delta chain."""
         floor = latest - PRUNE_KEEP
-        fulls = self._heights_in("state")
+        fulls = self.backend.heights(STATE)
         anchors = [h for h in fulls if h <= floor]
         anchor = max(anchors) if anchors else None
         for h in fulls:
             if h != anchor and h <= floor:
-                os.unlink(self._state_path(h))
+                self.backend.delete_at(STATE, h)
         if anchor is not None:
-            for h in self._heights_in("delta"):
+            for h in self.backend.heights(DELTA):
                 if h <= anchor:
-                    os.unlink(self._delta_path(h))
+                    self.backend.delete_at(DELTA, h)
 
     # -- blocks ----------------------------------------------------------
-
-    def _block_path(self, height: int) -> str:
-        return os.path.join(self.dir, "blocks", f"{height:020d}.json.gz")
 
     def save_block(self, block: Block) -> None:
         # THE header codec (chain/consensus.py) — the block store, the WAL,
@@ -224,24 +383,16 @@ class ChainDB:
         from celestia_app_tpu.chain.consensus import block_to_json
 
         doc = block_to_json(block)
-        blob = gzip.compress(
-            json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
-        )
-        _atomic_write(self._block_path(block.header.height), blob)
+        self.backend.put(BLOCK, block.header.height, self._encode(doc))
+        self.backend.sync()
 
     def load_block(self, height: int) -> Block:
         from celestia_app_tpu.chain.consensus import block_from_json
 
-        with gzip.open(self._block_path(height), "rb") as f:
-            doc = json.loads(f.read())
-        return block_from_json(doc)
+        blob = self.backend.get(BLOCK, height)
+        if blob is None:
+            raise FileNotFoundError(f"no block at height {height}")
+        return block_from_json(self._decode(blob))
 
     def block_heights(self) -> list[int]:
-        out = []
-        for name in os.listdir(os.path.join(self.dir, "blocks")):
-            if name.endswith(".json.gz"):
-                try:
-                    out.append(int(name.split(".")[0]))
-                except ValueError:
-                    pass
-        return sorted(out)
+        return self.backend.heights(BLOCK)

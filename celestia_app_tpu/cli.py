@@ -34,6 +34,15 @@ import sys
 import time
 
 
+# Apps opened by _make_app during one cli.main() call; main() closes the
+# ones ITS dispatch opened on the way out. A real CLI process exits anyway,
+# but in-process callers (tests, tools embedding cli.main) would otherwise
+# leak the storage engine's writer flock until GC and wedge the next
+# command on the home. Weakrefs: direct _make_app callers (outside main)
+# own their app's lifecycle — the registry must not pin those forever.
+_OPEN_APPS: list = []  # list[weakref.ref[App]]
+
+
 def _make_app(home: str):
     from celestia_app_tpu import appconsts
     from celestia_app_tpu.chain.app import App
@@ -50,6 +59,9 @@ def _make_app(home: str):
         invariant_check_period=cfg.get("invariant_check_period", 0),
         v2_upgrade_height=cfg.get("v2_upgrade_height"),
     )
+    import weakref
+
+    _OPEN_APPS.append(weakref.ref(app))
     latest = app.db.latest_height()
     if latest is None:
         with open(os.path.join(home, "genesis.json")) as f:
@@ -582,6 +594,8 @@ def cmd_devnet(args) -> int:
     finally:
         for svc in services:
             svc.shutdown()
+        for vn in net.nodes:
+            vn.app.close()  # release writer flocks for follow-up commands
     final_hashes = {vn.app.last_app_hash for vn in net.nodes}
     if len(final_hashes) != 1:
         print(
@@ -1039,7 +1053,17 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_txsim)
 
     args = ap.parse_args(argv)
-    return args.fn(args)
+    mark = len(_OPEN_APPS)  # only close what THIS invocation opens — tests
+    try:                    # may hold apps from direct _make_app calls
+        return args.fn(args)
+    finally:
+        while len(_OPEN_APPS) > mark:
+            app = _OPEN_APPS.pop()()
+            if app is not None:
+                try:
+                    app.close()
+                except Exception:
+                    pass
 
 
 if __name__ == "__main__":

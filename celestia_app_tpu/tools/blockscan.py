@@ -7,7 +7,9 @@ from celestia_app_tpu.da.blob import is_blob_tx, unmarshal_blob_tx
 
 
 def scan(data_dir: str, from_height: int | None = None, to_height: int | None = None):
-    db = ChainDB(data_dir)
+    # read_only: scanning a LIVE validator home must neither take the
+    # writer flock nor truncate a tail the writer is mid-appending
+    db = ChainDB(data_dir, read_only=True)
     for h in db.block_heights():
         if from_height is not None and h < from_height:
             continue

@@ -7,7 +7,7 @@ from celestia_app_tpu.chain.storage import ChainDB
 
 
 def report(data_dir: str, last_n: int | None = None) -> dict:
-    db = ChainDB(data_dir)
+    db = ChainDB(data_dir, read_only=True)  # safe against a live home
     heights = db.block_heights()
     if last_n:
         heights = heights[-last_n - 1 :]

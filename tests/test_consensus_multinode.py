@@ -7,7 +7,7 @@ import json
 import numpy as np
 import pytest
 
-from celestia_app_tpu.chain import consensus
+from celestia_app_tpu.chain import consensus, storage
 from celestia_app_tpu.chain.crypto import PrivateKey
 from celestia_app_tpu.chain.node import Node  # noqa: F401 (fixture parity)
 from celestia_app_tpu.chain.tx import MsgSend
@@ -150,15 +150,9 @@ def test_wal_replay_recovers_a_crashed_node(tmp_path):
     # simulate the crash: rebuild node 2 from its data dir as of height 0
     # (its durable commit for height 1 is wiped; the WAL survives)
     victim = net.nodes[2]
-    import os
-    import shutil
-
     data_dir = victim.app.db.dir
-    for sub in ("state", "delta", "blocks"):
-        shutil.rmtree(os.path.join(data_dir, sub))
-    latest = os.path.join(data_dir, "LATEST")
-    if os.path.exists(latest):
-        os.unlink(latest)
+    victim.app.close()  # a dead process would have dropped its flock
+    storage.wipe_commits(data_dir)
 
     reborn = consensus.ValidatorNode(
         "val2-reborn", victim.priv, _genesis(privs), CHAIN, data_dir=data_dir
@@ -284,13 +278,9 @@ def test_double_sign_evidence_tombstones_the_equivocator(tmp_path):
 
     # WAL replay reproduces the slash: rebuild node 2 from WAL only
     victim = net.nodes[2]
-    import os
-    import shutil
-
     data_dir = victim.app.db.dir
-    for sub in ("state", "delta", "blocks"):
-        shutil.rmtree(os.path.join(data_dir, sub))
-    os.unlink(os.path.join(data_dir, "LATEST"))
+    victim.app.close()  # a dead process would have dropped its flock
+    storage.wipe_commits(data_dir)
     reborn = consensus.ValidatorNode(
         "val2-reborn", victim.priv, _genesis(privs), CHAIN, data_dir=data_dir
     )

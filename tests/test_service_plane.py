@@ -63,6 +63,7 @@ def test_restart_resumes_at_committed_height(tmp_path):
     _run_blocks(app, signer, privs)
     h, ah, bh = app.height, app.last_app_hash, app.last_block_hash
     assert h == 2
+    app.close()  # "process exit": releases the storage engine's flock
 
     # a brand-new process: fresh App over the same data dir
     app2 = App(chain_id="x", engine="host", data_dir=str(tmp_path / "data"))

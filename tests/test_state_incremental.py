@@ -102,8 +102,8 @@ def test_delta_persistence_roundtrip(tmp_path):
         metas[h] = {"height": h}
         db.save_commit(h, store, metas[h])
     # only height 1 is a full snapshot; 2..11 are deltas
-    assert db._heights_in("state") == [1]
-    assert db._heights_in("delta") == list(range(2, 12))
+    assert db.backend.heights(storage.STATE) == [1]
+    assert db.backend.heights(storage.DELTA) == list(range(2, 12))
     # reconstruct several heights
     for h in (1, 4, 5, 11):
         got_h, data, meta = db.load_commit(h)
@@ -127,7 +127,7 @@ def test_delta_persistence_full_interval_and_prune(tmp_path):
     for h in range(1, n + 1):
         store.set(b"h%d" % h, b"x")
         db.save_commit(h, store, {"h": h})
-    fulls = db._heights_in("state")
+    fulls = db.backend.heights(storage.STATE)
     assert any(h % storage.FULL_INTERVAL == 0 for h in fulls)
     # every height in the rollback window reconstructs
     latest = n
