@@ -342,6 +342,35 @@ def _run_child() -> None:
     else:
         cpu_ms, _, _ = measure_baseline()
 
+    if os.environ.get("CELESTIA_BENCH_MINIMAL"):
+        # Shortest possible path to a silicon number for a relay window
+        # that may close in minutes: default schedule, jnp SHA (ONE
+        # pipeline compile, no Pallas attempt / cross-check), few reps.
+        # The richer modes below re-measure properly once a window holds.
+        import jax
+
+        from celestia_app_tpu.da import eds as eds_mod
+
+        os.environ["CELESTIA_SHA256_IMPL"] = "jnp"
+        eds_mod.jitted_pipeline.cache_clear()
+        ods = jax.device_put(_bench_ods(K))
+        pipeline = eds_mod.jitted_pipeline(K)
+        device_ms = _time_fn(pipeline, ods, reps=5)
+        _check_baseline_root(bytes(np.asarray(pipeline(ods)[3])))
+        out = {
+            "metric": "extend_commit_128_ms",
+            "value": round(device_ms, 3),
+            "unit": "ms",
+            "vs_baseline": round(cpu_ms / device_ms, 2),
+            "sha_impl": "jnp",
+            "rs_schedule": "batched/int8 (minimal mode)",
+            "backend": jax.devices()[0].platform,
+        }
+        if _ROOT_MISMATCH:
+            out["baseline_root_match"] = False
+        print(json.dumps(out))
+        return
+
     if os.environ.get("CELESTIA_BENCH_SKIP_CAL"):
         # parent is low on budget: trust env/defaults rather than probing
         rs_schedule = (f"{os.environ.get('CELESTIA_RS_LAYOUT', 'batched')}/"

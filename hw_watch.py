@@ -1,0 +1,169 @@
+"""Autonomous TPU-relay watcher (round 4).
+
+The axon relay is intermittently alive (it answered a probe at the start of
+this session, then hung again; round 3 it hung for 8+ hours straight). This
+watcher converts any future alive window into hardware numbers without a
+human in the loop:
+
+  probe (90 s) -> on success:
+    1. lean measurement   (bench.py --child, calibration skipped)
+    2. schedule grid      (bench.py --stages)
+    3. calibrated attempt (bench.py --child, full calibration)
+  every result line is appended to HW_RESULTS_r4.jsonl; full child output to
+  hw_watch.log. The first non-null headline value is also written to
+  BENCH_HW_r4.json for the judge.
+
+Run detached:  nohup python hw_watch.py >> hw_watch.log 2>&1 &
+Stop:          kill $(cat hw_watch.pid)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RESULTS = os.path.join(HERE, "HW_RESULTS_r4.jsonl")
+HEADLINE = os.path.join(HERE, "BENCH_HW_r4.json")
+PROBE_TIMEOUT_S = 90
+LEAN_TIMEOUT_S = 560
+STAGES_TIMEOUT_S = 600
+CAL_TIMEOUT_S = 600
+IDLE_SLEEP_S = 120
+
+
+def log(msg: str) -> None:
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def probe() -> bool:
+    code = (
+        "import jax, numpy as np\n"
+        "x = jax.device_put(np.ones((8, 8), np.float32))\n"
+        "assert float(x.sum()) == 64.0\n"
+        "print('PROBE_OK', jax.devices()[0].platform)\n"
+    )
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=PROBE_TIMEOUT_S, cwd=HERE)
+    except subprocess.TimeoutExpired:
+        return False
+    return r.returncode == 0 and "PROBE_OK" in r.stdout
+
+
+def parse_last_json(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def record(tag: str, obj) -> None:
+    with open(RESULTS, "a") as f:
+        f.write(json.dumps({"ts": time.time(), "tag": tag, "result": obj}) + "\n")
+
+
+def run_child(tag: str, timeout_s: float, skip_cal: bool,
+              minimal: bool = False) -> bool:
+    """One bench.py --child run; returns True if a non-null value landed."""
+    env = dict(os.environ)
+    env["CELESTIA_BENCH_CHILD_TIMEOUT"] = str(int(timeout_s - 20))
+    if minimal:
+        env["CELESTIA_BENCH_MINIMAL"] = "1"
+    else:
+        env.pop("CELESTIA_BENCH_MINIMAL", None)
+    if skip_cal:
+        env["CELESTIA_BENCH_SKIP_CAL"] = "1"
+    else:
+        env.pop("CELESTIA_BENCH_SKIP_CAL", None)
+    log(f"{tag}: starting (timeout {timeout_s:.0f}s)")
+    try:
+        r = subprocess.run([sys.executable, os.path.join(HERE, "bench.py"),
+                            "--child"], capture_output=True, text=True,
+                           timeout=timeout_s, env=env, cwd=HERE)
+    except subprocess.TimeoutExpired as e:
+        log(f"{tag}: TIMEOUT; stderr tail: "
+            + "|".join((e.stderr or b"").decode("utf-8", "replace").strip().splitlines()[-5:]
+                       if isinstance(e.stderr, bytes) else
+                       (e.stderr or "").strip().splitlines()[-5:]))
+        record(tag, {"error": f"timeout {timeout_s:.0f}s"})
+        return False
+    log(f"{tag}: rc={r.returncode}; stderr tail: "
+        + "|".join((r.stderr or "").strip().splitlines()[-8:]))
+    parsed = parse_last_json(r.stdout)
+    record(tag, parsed if parsed is not None
+           else {"error": f"rc={r.returncode}, no JSON",
+                 "stderr": (r.stderr or "")[-500:]})
+    if parsed and parsed.get("value") is not None:
+        # richer modes supersede: minimal < lean < calibrated
+        rank = {"minimal": 0, "lean": 1, "calibrated": 2}[tag]
+        prev_rank = -1
+        if os.path.exists(HEADLINE):
+            with open(HEADLINE) as f:
+                prev_rank = json.load(f).get("_rank", -1)
+        if rank > prev_rank:
+            parsed["_rank"] = rank
+            with open(HEADLINE, "w") as f:
+                json.dump(parsed, f, indent=2)
+                f.write("\n")
+        log(f"{tag}: LANDED {parsed}")
+        return True
+    return False
+
+
+def run_stages() -> None:
+    log("stages: starting")
+    try:
+        r = subprocess.run([sys.executable, os.path.join(HERE, "bench.py"),
+                            "--stages"], capture_output=True, text=True,
+                           timeout=STAGES_TIMEOUT_S, cwd=HERE)
+    except subprocess.TimeoutExpired:
+        log("stages: TIMEOUT")
+        record("stages", {"error": "timeout"})
+        return
+    tail = (r.stderr or "").strip().splitlines()
+    grid = [ln for ln in tail if "stages:" in ln or "rs probe" in ln]
+    log("stages: " + " | ".join(grid[-10:]))
+    record("stages", {"rc": r.returncode, "grid": grid})
+
+
+def main() -> None:
+    with open(os.path.join(HERE, "hw_watch.pid"), "w") as f:
+        f.write(str(os.getpid()))
+    log(f"watcher up, pid {os.getpid()}")
+    landed_min = landed_lean = landed_cal = stages_done = False
+    while True:
+        if not probe():
+            log("probe: relay down")
+            time.sleep(IDLE_SLEEP_S)
+            continue
+        log("probe: RELAY ALIVE")
+        if not landed_min:
+            # fastest path to ANY silicon number (one compile, 5 reps) —
+            # round-4 windows have closed within minutes
+            landed_min = run_child("minimal", 300, skip_cal=True,
+                                   minimal=True)
+            continue  # re-probe between long steps: windows are short
+        if not landed_lean:
+            landed_lean = run_child("lean", LEAN_TIMEOUT_S, skip_cal=True)
+            continue
+        if not stages_done:
+            run_stages()
+            stages_done = True
+            continue
+        if not landed_cal:
+            landed_cal = run_child("calibrated", CAL_TIMEOUT_S, skip_cal=False)
+            continue
+        log("all targets landed; monitoring only")
+        time.sleep(600)
+
+
+if __name__ == "__main__":
+    main()
