@@ -32,10 +32,14 @@ S_TILE = 256  # share-byte tile per grid cell (VMEM budget)
 
 def _extend_pass_kernel(k: int):
     def kernel(b_ref, x_ref, o_ref):
-        x = x_ref[0]  # (k, S_TILE) u8 — one row of the square, one tile
-        shifts = jnp.arange(8, dtype=jnp.uint8)
-        bits = ((x[:, None, :] >> shifts[None, :, None]) & 1).astype(
-            jnp.bfloat16
+        x = x_ref[0].astype(jnp.int32)  # (k, S_TILE) — one row, one tile
+        shifts = jnp.arange(8, dtype=jnp.int32)
+        # Mosaic has no u8->bf16 cast; widen to i32 for the shift, then go
+        # through f32 (both casts lower on the TPU toolchain)
+        bits = (
+            ((x[:, None, :] >> shifts[None, :, None]) & 1)
+            .astype(jnp.float32)
+            .astype(jnp.bfloat16)
         )  # (k, 8, S_TILE)
         bits = bits.reshape(8 * k, S_TILE)
         acc = jnp.dot(
