@@ -131,5 +131,26 @@ def nmt_roots(leaf_ns: jax.Array, leaf_data: jax.Array) -> jax.Array:
 
     L must be a power of two (axis lengths of the extended square always are).
     """
-    mins, maxs, vs = nmt_levels(leaf_ns, leaf_data)[-1]
-    return jnp.concatenate([mins[:, 0], maxs[:, 0], vs[:, 0]], axis=1)
+    return roots_from_leaf_nodes(*leaf_nodes(leaf_ns, leaf_data))
+
+
+def roots_from_leaf_nodes(
+    mins: jax.Array, maxs: jax.Array, vs: jax.Array
+) -> jax.Array:
+    """Inner-node reduction only: precomputed (T, L, .) leaf nodes ->
+    (T, 90) roots.
+
+    Exists so callers with shared leaves can hash them ONCE: in an EDS the
+    leaf at (r, c) has the identical preimage (0x00 || ns || share) in row
+    tree r and column tree c, and leaves dominate the hash work (542-byte
+    preimages = 9 compression blocks vs 3 for the 181-byte inner nodes) —
+    see da/eds.pipeline_fn, which transposes one leaf-node grid to serve
+    both orientations.
+    """
+    l = vs.shape[1]
+    assert l & (l - 1) == 0 and l >= 1, f"leaf count {l} not a power of two"
+    level = (mins, maxs, vs)
+    while level[0].shape[1] > 1:
+        level = reduce_level(*level)
+    l_min, l_max, l_v = level
+    return jnp.concatenate([l_min[:, 0], l_max[:, 0], l_v[:, 0]], axis=1)

@@ -113,7 +113,7 @@ def bench_stream_mesh(k: int | None = None, n_batches: int = 3,
         )
 
     warm = layout(0)
-    jax.block_until_ready(run(warm)[3])
+    np.asarray(run(warm)[3])  # fetch: block_until_ready lies on the relay
     t0 = time.perf_counter()
     roots = stream_blocks_mesh(layout, n_batches, mesh, k, pipeline=run)
     dt = time.perf_counter() - t0
@@ -152,7 +152,7 @@ def bench_stream_batched(k: int | None = None, batch: int = 4,
             [_synthetic_layout(k, i * batch + j) for j in range(batch)]
         )
 
-    jax.block_until_ready(run(layout(0))[3])  # warm the compile
+    np.asarray(run(layout(0))[3])  # warm the compile (fetch: see bench.py)
     t0 = time.perf_counter()
     roots = _stream_batches(layout, n_batches, run)
     dt = time.perf_counter() - t0
@@ -180,9 +180,10 @@ def bench_stream(k: int | None = None, n_blocks: int = 6) -> dict:
         k = 256 if backend == "tpu" else 32
 
     run = eds_mod.jitted_pipeline(k)
-    # warm the compile out of the measurement
+    # warm the compile out of the measurement (root FETCH, not
+    # block_until_ready — the latter is a no-op on the axon relay)
     warm = _synthetic_layout(k, 0)
-    jax.block_until_ready(run(jax.device_put(warm))[3])
+    np.asarray(run(jax.device_put(warm))[3])
 
     # serial attribution: host layout cost, device cost
     t0 = time.perf_counter()
@@ -190,7 +191,7 @@ def bench_stream(k: int | None = None, n_blocks: int = 6) -> dict:
     host_ms = (time.perf_counter() - t0) * 1000 / n_blocks
     t0 = time.perf_counter()
     for ods in layouts:
-        jax.block_until_ready(run(jax.device_put(ods))[3])
+        np.asarray(run(jax.device_put(ods))[3])
     device_ms = (time.perf_counter() - t0) * 1000 / n_blocks
 
     # streamed: layout of block i+1 overlaps device work on block i
