@@ -112,6 +112,256 @@ def cmd_init(args) -> int:
     return 0
 
 
+def _load_genesis(home: str) -> dict:
+    with open(os.path.join(home, "genesis.json")) as f:
+        return json.load(f)
+
+
+def _store_genesis(home: str, genesis: dict) -> None:
+    with open(os.path.join(home, "genesis.json"), "w") as f:
+        json.dump(genesis, f, indent=2)
+
+
+def _gentx_sign_doc(doc: dict) -> bytes:
+    """Canonical bytes covered by a gentx signature (everything but the
+    signature field, sorted-key JSON — the same canonicalization the vote
+    and header sign-docs use)."""
+    unsigned = {k: v for k, v in doc.items() if k != "signature"}
+    return json.dumps(unsigned, sort_keys=True, separators=(",", ":")).encode()
+
+
+def cmd_genesis_add_account(args) -> int:
+    """genutil AddGenesisAccountCmd analog (ref cmd/root.go:130): append a
+    funded account to an un-started chain's genesis."""
+    genesis = _load_genesis(args.home)
+    addr = args.address.lower()
+    try:
+        if len(bytes.fromhex(addr)) != 20:
+            print(f"address {addr!r} is not 20 bytes", file=sys.stderr)
+            return 1
+    except ValueError:
+        print(f"address {addr!r} is not hex", file=sys.stderr)
+        return 1
+    if int(args.balance) < 0:
+        print("balance must be non-negative", file=sys.stderr)
+        return 1
+    if any(a["address"].lower() == addr for a in genesis.get("accounts", [])):
+        print(f"account {addr} already in genesis", file=sys.stderr)
+        return 1
+    genesis.setdefault("accounts", []).append(
+        {"address": addr, "balance": int(args.balance)}
+    )
+    _store_genesis(args.home, genesis)
+    print(f"added {addr} with balance {args.balance}")
+    return 0
+
+
+def cmd_genesis_gentx(args) -> int:
+    """genutil GenTxCmd analog (ref cmd/root.go:132): emit a signed
+    validator-candidacy document into <home>/gentx/ for collect-gentxs to
+    verify and merge. The reference wraps a MsgCreateValidator in a tx;
+    the same roles here are (operator, power, pubkey) + signature."""
+    from celestia_app_tpu.chain.crypto import PrivateKey
+
+    priv = PrivateKey.from_seed(args.seed.encode())
+    pub = priv.public_key()
+    doc = {
+        "moniker": args.moniker,
+        "operator": pub.address().hex(),
+        "power": int(args.power),
+        "pubkey": pub.compressed.hex(),
+    }
+    doc["signature"] = priv.sign(_gentx_sign_doc(doc)).hex()
+    gdir = os.path.join(args.home, "gentx")
+    os.makedirs(gdir, exist_ok=True)
+    path = os.path.join(gdir, f"gentx-{doc['operator'][:16]}.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(f"wrote {path}")
+    return 0
+
+
+def cmd_genesis_collect(args) -> int:
+    """genutil CollectGenTxsCmd analog (ref cmd/root.go:128): verify every
+    gentx in <home>/gentx/ (signature against its own pubkey, operator ==
+    address(pubkey), operator funded in genesis) and merge them into the
+    genesis validator set."""
+    import glob as glob_mod
+
+    from celestia_app_tpu.chain.crypto import PublicKey
+
+    genesis = _load_genesis(args.home)
+    funded = {a["address"].lower() for a in genesis.get("accounts", [])}
+    validators = {
+        v["operator"].lower(): v for v in genesis.get("validators", [])
+    }
+    n_merged = 0
+    merged_ops: set[str] = set()
+    for path in sorted(glob_mod.glob(os.path.join(args.home, "gentx", "*.json"))):
+        # a gentx file is UNTRUSTED input: any malformed field gets the
+        # same "<path>: reason" treatment as a failed signature, never a
+        # traceback
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            pub = PublicKey(bytes.fromhex(doc["pubkey"]))
+            operator = str(doc["operator"]).lower()
+            signature = bytes.fromhex(doc["signature"])
+            power = int(doc["power"])
+        except (json.JSONDecodeError, KeyError, ValueError, TypeError) as e:
+            print(f"{path}: malformed gentx ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            return 1
+        if operator != pub.address().hex():
+            print(f"{path}: operator does not match pubkey", file=sys.stderr)
+            return 1
+        if not pub.verify(signature, _gentx_sign_doc(doc)):
+            print(f"{path}: bad signature", file=sys.stderr)
+            return 1
+        if operator not in funded:
+            print(f"{path}: operator {operator} has no genesis "
+                  "account (add-account first)", file=sys.stderr)
+            return 1
+        if power <= 0:
+            print(f"{path}: non-positive power", file=sys.stderr)
+            return 1
+        if operator in merged_ops:
+            print(f"{path}: duplicate gentx for operator {operator} "
+                  "(delete the stale file)", file=sys.stderr)
+            return 1
+        merged_ops.add(operator)
+        validators[operator] = {
+            "operator": operator,
+            "power": power,
+            "pubkey": doc["pubkey"],
+        }
+        n_merged += 1
+    genesis["validators"] = list(validators.values())
+    _store_genesis(args.home, genesis)
+    print(f"collected {n_merged} gentx(s); validator set size "
+          f"{len(genesis['validators'])}")
+    return 0
+
+
+def cmd_genesis_validate(args) -> int:
+    """genutil ValidateGenesisCmd analog (ref cmd/root.go:133): structural
+    checks mirroring what init_chain assumes, so a bad hand-edited genesis
+    fails HERE with a message instead of inside the node."""
+    from celestia_app_tpu.chain.crypto import PublicKey
+
+    genesis = _load_genesis(args.home)
+    errors: list[str] = []
+    seen: set[str] = set()
+    for i, a in enumerate(genesis.get("accounts", [])):
+        addr = str(a.get("address", "")).lower()
+        try:
+            if len(bytes.fromhex(addr)) != 20:
+                errors.append(f"accounts[{i}]: address not 20 bytes")
+        except ValueError:
+            errors.append(f"accounts[{i}]: address not hex")
+        if addr in seen:
+            errors.append(f"accounts[{i}]: duplicate address {addr}")
+        seen.add(addr)
+        try:
+            if int(a.get("balance", -1)) < 0:
+                errors.append(f"accounts[{i}]: negative balance")
+        except (ValueError, TypeError):
+            errors.append(f"accounts[{i}]: balance not an integer")
+    vals = genesis.get("validators", [])
+    if not vals:
+        errors.append("no validators")
+    for i, v in enumerate(vals):
+        try:
+            if int(v.get("power", 0)) <= 0:
+                errors.append(f"validators[{i}]: non-positive power")
+        except (ValueError, TypeError):
+            errors.append(f"validators[{i}]: power not an integer")
+        try:
+            op = bytes.fromhex(str(v.get("operator", "")))
+            if len(op) != 20:
+                errors.append(f"validators[{i}]: operator not 20 bytes")
+        except ValueError:
+            errors.append(f"validators[{i}]: operator not hex")
+            op = None
+        pubhex = v.get("pubkey")
+        if pubhex and op is not None:
+            try:
+                if PublicKey(bytes.fromhex(pubhex)).address() != op:
+                    errors.append(
+                        f"validators[{i}]: pubkey does not match operator"
+                    )
+            except Exception:
+                errors.append(f"validators[{i}]: malformed pubkey")
+    for khex, vhex in genesis.get("raw_modules", {}).items():
+        try:
+            bytes.fromhex(khex), bytes.fromhex(vhex)
+        except ValueError:
+            errors.append(f"raw_modules[{khex[:16]}...]: not hex")
+            break
+    for e in errors:
+        print(f"invalid genesis: {e}", file=sys.stderr)
+    if not errors:
+        print("genesis.json is valid")
+    return 1 if errors else 0
+
+
+# Known-network genesis pins (ref cmd/download_genesis.go:19-24 — the
+# command's real value is the hash check, which works offline too).
+_GENESIS_SHA256 = {
+    "celestia": "9727aac9bbfb021ce7fc695a92f901986421283a891b89e0af97bc9fad187793",
+    "mocha-4": "0846b99099271b240b638a94e17a6301423b5e4047f6558df543d6e91db7e575",
+    "arabica-10": "fad0a187669f7a2c11bb07f9dc27140d66d2448b7193e186312713856f28e3e1",
+    "arabica-11": "77605cee57ce545b1be22402110d4baacac837bdc7fc3f5c74020abf9a08810f",
+}
+
+
+def cmd_download_genesis(args) -> int:
+    """cmd/download_genesis.go analog: fetch (or locally verify) a known
+    network's genesis and check it against the pinned SHA-256."""
+    import hashlib
+    import urllib.error
+    import urllib.request
+
+    chain_id = args.chain_id
+    if chain_id not in _GENESIS_SHA256:
+        print(f"unknown chain-id: {chain_id}. Must be one of: "
+              + ", ".join(sorted(_GENESIS_SHA256)), file=sys.stderr)
+        return 1
+    out = os.path.join(args.home, "genesis.json")
+    downloaded = False
+    if not os.path.exists(out):
+        url = ("https://raw.githubusercontent.com/celestiaorg/networks/"
+               f"master/{chain_id}/genesis.json")
+        try:
+            os.makedirs(args.home, exist_ok=True)
+            with urllib.request.urlopen(url, timeout=10) as r:
+                data = r.read()
+            with open(out, "wb") as f:
+                f.write(data)
+            downloaded = True
+        except (urllib.error.URLError, OSError) as e:
+            print(f"download failed ({e}); if you already have the file, "
+                  f"place it at {out} and re-run to verify its hash",
+                  file=sys.stderr)
+            return 1
+    with open(out, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()
+    want = _GENESIS_SHA256[chain_id]
+    if digest != want:
+        if downloaded:
+            # never leave a just-fetched bad file wedging future runs
+            os.remove(out)
+            print(f"sha256 MISMATCH for {chain_id}: got {digest}, want "
+                  f"{want}; removed the downloaded file — re-run to retry",
+                  file=sys.stderr)
+        else:
+            print(f"sha256 MISMATCH for {chain_id}: got {digest}, want "
+                  f"{want}; delete {out} to re-download", file=sys.stderr)
+        return 1
+    print(f"{out}: sha256 verified for {chain_id}")
+    return 0
+
+
 def _write_config(home: str, chain_id: str, engine: str = "auto") -> None:
     """THE node-local config writer (SURVEY §5.6 layer 4 — the reference's
     app.toml/config.toml knobs), shared by `init` and validator/devnet
@@ -131,6 +381,48 @@ def _write_config(home: str, chain_id: str, engine: str = "auto") -> None:
             },
             f, indent=2,
         )
+
+
+def cmd_config(args) -> int:
+    """config.Cmd analog (ref cmd/root.go:135): read or set node-local
+    config keys in <home>/config.json. `get` with no key prints the whole
+    effective config; `set` parses the value as JSON when possible (so
+    numbers/null/bools round-trip) and refuses unknown keys — the writer
+    above owns the key set."""
+    path = os.path.join(args.home, "config.json")
+    try:
+        with open(path) as f:
+            cfg = json.load(f)
+    except FileNotFoundError:
+        print(f"no config.json in {args.home} — run `init` first",
+              file=sys.stderr)
+        return 1
+    if args.action == "get":
+        if args.key is None:
+            print(json.dumps(cfg, indent=2))
+            return 0
+        if args.key not in cfg:
+            print(f"unknown config key {args.key!r}; known: "
+                  + ", ".join(sorted(cfg)), file=sys.stderr)
+            return 1
+        print(json.dumps(cfg[args.key]))
+        return 0
+    if args.key is None or args.value is None:
+        print("config set needs KEY and VALUE", file=sys.stderr)
+        return 1
+    if args.key not in cfg:
+        print(f"unknown config key {args.key!r}; known: "
+              + ", ".join(sorted(cfg)), file=sys.stderr)
+        return 1
+    try:
+        value = json.loads(args.value)
+    except json.JSONDecodeError:
+        value = args.value  # bare string
+    cfg[args.key] = value
+    with open(path, "w") as f:
+        json.dump(cfg, f, indent=2)
+    print(f"{args.key} = {json.dumps(value)}")
+    return 0
 
 
 def cmd_start(args) -> int:
@@ -999,6 +1291,40 @@ def main(argv=None) -> int:
     p.add_argument("address", help="bech32 celestia1.../hex address")
     p.set_defaults(fn=cmd_addr_conversion)
 
+    p = sub.add_parser("genesis", help="genesis file toolkit (genutil analog)")
+    gsub = p.add_subparsers(dest="gcmd", required=True)
+    g = gsub.add_parser("add-account")
+    g.add_argument("--home", required=True)
+    g.add_argument("--address", required=True, help="20-byte hex address")
+    g.add_argument("--balance", required=True, type=int)
+    g.set_defaults(fn=cmd_genesis_add_account)
+    g = gsub.add_parser("gentx")
+    g.add_argument("--home", required=True)
+    g.add_argument("--seed", required=True, help="key seed (as `keys`)")
+    g.add_argument("--moniker", default="validator")
+    g.add_argument("--power", required=True, type=int)
+    g.set_defaults(fn=cmd_genesis_gentx)
+    g = gsub.add_parser("collect-gentxs")
+    g.add_argument("--home", required=True)
+    g.set_defaults(fn=cmd_genesis_collect)
+    g = gsub.add_parser("validate")
+    g.add_argument("--home", required=True)
+    g.set_defaults(fn=cmd_genesis_validate)
+
+    p = sub.add_parser("config", help="get/set node-local config keys")
+    p.add_argument("action", choices=["get", "set"])
+    p.add_argument("key", nargs="?")
+    p.add_argument("value", nargs="?")
+    p.add_argument("--home", required=True)
+    p.set_defaults(fn=cmd_config)
+
+    p = sub.add_parser("download-genesis",
+                       help="fetch/verify a known network's genesis "
+                            "against its pinned sha256")
+    p.add_argument("chain_id", nargs="?", default="celestia")
+    p.add_argument("--home", required=True)
+    p.set_defaults(fn=cmd_download_genesis)
+
     p = sub.add_parser("snapshot")
     p.add_argument("action", choices=["create", "restore"])
     p.add_argument("--home", required=True)
@@ -1056,6 +1382,14 @@ def main(argv=None) -> int:
     mark = len(_OPEN_APPS)  # only close what THIS invocation opens — tests
     try:                    # may hold apps from direct _make_app calls
         return args.fn(args)
+    except BrokenPipeError:
+        # stdout piped into a pager/head that exited: normal CLI etiquette
+        # is a silent success, not a traceback
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        return 0
     finally:
         while len(_OPEN_APPS) > mark:
             app = _OPEN_APPS.pop()()

@@ -68,13 +68,33 @@ def _leaf_ns_local(
     return jnp.where(in_q0[None, :, :, None], sq_local[..., :NS], parity)
 
 
-def _roots_local(sq_local: jax.Array, k: int, major_start: jax.Array) -> jax.Array:
-    """(B_l, M_l, 2k, SHARE) local axis slabs -> (B_l, M_l, 90) NMT roots."""
+def _leaf_nodes_local(
+    sq_local: jax.Array, k: int, major_start: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(B_l, M_l, 2k, SHARE) local axis slabs -> leaf (min, max, v) arrays,
+    each (B_l, M_l, 2k, .)."""
     b_l, m_l = sq_local.shape[0], sq_local.shape[1]
     leaf_ns = _leaf_ns_local(sq_local, k, major_start)
-    roots = nmt.nmt_roots(
+    mins, maxs, vs = nmt.leaf_nodes(
         leaf_ns.reshape(b_l * m_l, 2 * k, NS),
         sq_local.reshape(b_l * m_l, 2 * k, SHARE),
+    )
+    return (
+        mins.reshape(b_l, m_l, 2 * k, NS),
+        maxs.reshape(b_l, m_l, 2 * k, NS),
+        vs.reshape(b_l, m_l, 2 * k, 32),
+    )
+
+
+def _roots_from_leaves_local(
+    mins: jax.Array, maxs: jax.Array, vs: jax.Array
+) -> jax.Array:
+    """(B_l, M_l, 2k, .) leaf nodes -> (B_l, M_l, 90) NMT roots."""
+    b_l, m_l, two_k = vs.shape[0], vs.shape[1], vs.shape[2]
+    roots = nmt.roots_from_leaf_nodes(
+        mins.reshape(b_l * m_l, two_k, NS),
+        maxs.reshape(b_l * m_l, two_k, NS),
+        vs.reshape(b_l * m_l, two_k, 32),
     )
     return roots.reshape(b_l, m_l, 90)
 
@@ -110,20 +130,33 @@ def _local_pipeline(k: int, n_seq: int):
         eds_cols = jnp.concatenate([col_major, par_major], axis=2)
         # (B_l, 2k/n, 2k, S): full columns, column-major
 
-        # 4. Column NMT roots for owned columns.
+        # 4. Column-tree leaf nodes + roots for owned columns. Leaf (r, c)
+        #    has the identical preimage (0x00 || ns || share) in row tree r
+        #    and column tree c (da/eds.pipeline_fn does the same dedup on
+        #    one chip), so hash each leaf ONCE here and ship the 32-byte
+        #    digests to the row owners — 1/16 the bytes of re-hashing the
+        #    512-byte shares and none of the 9-block SHA work.
         col_start = seq_idx * (2 * k // n_seq)
-        col_roots_local = _roots_local(eds_cols, k, col_start)
+        col_mins, col_maxs, col_vs = _leaf_nodes_local(eds_cols, k, col_start)
+        col_roots_local = _roots_from_leaves_local(col_mins, col_maxs, col_vs)
 
         # 5. Transpose back to row-sharding for the row trees: split the 2k
-        #    rows (axis 2) across devices, gather all columns on axis 1.
+        #    rows (axis 2) across devices, gather all columns on axis 1 —
+        #    shares for the EDS output, digests for the row-tree leaves.
         rows_back = lax.all_to_all(
             eds_cols, SEQ_AXIS, split_axis=2, concat_axis=1, tiled=True
         )  # (B_l, 2k cols in global order, 2k/n owned rows, S)
         eds_rows = jnp.swapaxes(rows_back, 1, 2)  # (B_l, 2k/n, 2k, S)
+        vs_back = lax.all_to_all(
+            col_vs, SEQ_AXIS, split_axis=2, concat_axis=1, tiled=True
+        )  # (B_l, 2k cols, 2k/n owned rows, 32)
+        row_vs = jnp.swapaxes(vs_back, 1, 2)  # (B_l, 2k/n, 2k, 32)
 
-        # 6. Row NMT roots for owned rows.
+        # 6. Row NMT roots for owned rows: namespaces recomputed locally
+        #    from the row slab (cheap), digests reused from step 4.
         row_start = seq_idx * (2 * k // n_seq)
-        row_roots_local = _roots_local(eds_rows, k, row_start)
+        row_ns = _leaf_ns_local(eds_rows, k, row_start)
+        row_roots_local = _roots_from_leaves_local(row_ns, row_ns, row_vs)
 
         return eds_rows, row_roots_local, col_roots_local
 
