@@ -534,10 +534,11 @@ def cmd_tx(args) -> int:
     if args.action == "send" and (args.to is None or args.amount is None):
         print("tx send requires --to and --amount", file=sys.stderr)
         return 2
-    if args.action == "pay-for-blob" and (
+    if args.action == "pay-for-blob" and args.input_file is None and (
         args.namespace is None or args.data is None
     ):
-        print("tx pay-for-blob requires --namespace and --data", file=sys.stderr)
+        print("tx pay-for-blob requires --namespace and --data "
+              "(or --input-file blobs.json)", file=sys.stderr)
         return 2
 
     app, _cfg = _make_app(args.home)
@@ -556,13 +557,50 @@ def cmd_tx(args) -> int:
             addr, bytes.fromhex(args.to), int(args.amount)
         )
     else:  # pay-for-blob
-        ns = Namespace.v0(bytes.fromhex(args.namespace))
-        if args.data.startswith("@"):
-            with open(args.data[1:], "rb") as f:
-                payload = f.read()
+        if args.input_file is not None:
+            if args.namespace is not None or args.data is not None:
+                print("--input-file conflicts with --namespace/--data; "
+                      "pass one or the other", file=sys.stderr)
+                return 2
+            # multi-blob file input, the reference's --input-file JSON
+            # schema (x/blob/client/cli/payforblob.go:60-76):
+            # {"Blobs": [{"namespaceID": "0x..10 bytes..", "blob": "0x.."}]}
+            # The file is user input: every malformed shape gets a usage
+            # error naming the entry, never a traceback.
+            try:
+                with open(args.input_file) as f:
+                    doc = json.load(f)
+                entries = (doc.get("Blobs") or doc.get("blobs")
+                           if isinstance(doc, dict) else None)
+                if not entries:
+                    print(f"{args.input_file}: no Blobs array",
+                          file=sys.stderr)
+                    return 2
+                blobs = []
+                for i, e in enumerate(entries):
+                    if not isinstance(e, dict) or "namespaceID" not in e \
+                            or "blob" not in e:
+                        print(f"{args.input_file}: Blobs[{i}] needs "
+                              "namespaceID and blob", file=sys.stderr)
+                        return 2
+                    ns_hex = str(e["namespaceID"]).removeprefix("0x")
+                    blob_hex = str(e["blob"]).removeprefix("0x")
+                    blobs.append(
+                        Blob(Namespace.v0(bytes.fromhex(ns_hex)),
+                             bytes.fromhex(blob_hex))
+                    )
+            except (OSError, json.JSONDecodeError, ValueError) as e:
+                print(f"{args.input_file}: {e}", file=sys.stderr)
+                return 2
         else:
-            payload = bytes.fromhex(args.data)
-        height, res = client.submit_pay_for_blob(addr, [Blob(ns, payload)])
+            ns = Namespace.v0(bytes.fromhex(args.namespace))
+            if args.data.startswith("@"):
+                with open(args.data[1:], "rb") as f:
+                    payload = f.read()
+            else:
+                payload = bytes.fromhex(args.data)
+            blobs = [Blob(ns, payload)]
+        height, res = client.submit_pay_for_blob(addr, blobs)
     # commits already hit disk inside produce_block (durable save_commit)
     print(json.dumps({
         "height": height,
@@ -1259,6 +1297,10 @@ def main(argv=None) -> int:
     p.add_argument("--amount", help="utia amount (send)")
     p.add_argument("--namespace", help="10-hex-char v0 namespace id (pfb)")
     p.add_argument("--data", help="blob hex, or @file for raw bytes (pfb)")
+    p.add_argument("--input-file",
+                   help="multi-blob JSON file (reference --input-file "
+                        "schema: {\"Blobs\": [{\"namespaceID\": \"0x..\", "
+                        "\"blob\": \"0x..\"}]})")
     p.set_defaults(fn=cmd_tx)
 
     p = sub.add_parser("devnet")

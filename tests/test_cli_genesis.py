@@ -134,3 +134,23 @@ def test_config_get_set_roundtrip(tmp_path):
     assert cli.main(["config", "get", "min_gas_price", "--home", home]) == 0
     assert cli.main(["config", "set", "no_such_key", "1", "--home", home]) == 1
     assert cli.main(["config", "get", "no_such_key", "--home", home]) == 1
+
+
+def test_pay_for_blob_input_file_multi_blob(tmp_path):
+    """The reference's --input-file JSON schema submits several blobs in
+    ONE PFB (x/blob/client/cli/payforblob.go:60-76)."""
+    home = str(tmp_path / "home")
+    _init(home)
+    path = os.path.join(home, "blobs.json")
+    with open(path, "w") as f:
+        json.dump({"Blobs": [
+            {"namespaceID": "0x" + "01" * 10, "blob": "0x48656c6c6f"},
+            {"namespaceID": "0x" + "02" * 10, "blob": "0xdeadbeef"},
+        ]}, f)
+    assert cli.main(["tx", "pay-for-blob", "--home", home,
+                     "--from-seed", "0", "--input-file", path]) == 0
+    # empty Blobs array is a usage error, not a crash
+    with open(path, "w") as f:
+        json.dump({"Blobs": []}, f)
+    assert cli.main(["tx", "pay-for-blob", "--home", home,
+                     "--from-seed", "0", "--input-file", path]) == 2
