@@ -498,6 +498,26 @@ def _emit(errors: list[str], note: str) -> None:
         "unit": "ms",
         "error": ("; ".join(errors + [note]))[-2000:],
     }
+    # A dead relay should not erase history: attach the last measurement
+    # the watcher/bench landed on real hardware (clearly labeled with its
+    # own provenance — `value` above stays null because THIS run measured
+    # nothing).
+    hw_file = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_HW_r4.json")
+    try:
+        with open(hw_file) as f:
+            hw = json.load(f)
+        if isinstance(hw, dict) and hw.get("value") is not None:
+            line["last_hw_result"] = {
+                k: hw[k] for k in
+                ("metric", "value", "unit", "vs_baseline", "rs_schedule",
+                 "backend") if k in hw
+            }
+            line["last_hw_result"]["source"] = "BENCH_HW_r4.json"
+    except Exception:
+        # nothing may stop the provisional line from printing — this
+        # history attachment is strictly best-effort
+        pass
     print(json.dumps(line), flush=True)
 
 

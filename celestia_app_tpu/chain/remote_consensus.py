@@ -122,8 +122,21 @@ class SocketNetwork:
         }
         # deterministic proposer rotation: peers sorted by their validator
         # address, exactly as LocalNetwork sorts its nodes — every process
-        # self-reports the address it signs with at handshake time
-        self.peers = sorted(peers, key=lambda p: p.status()["address"])
+        # self-reports the address it signs with at handshake time. A peer
+        # that is DOWN at construction keeps the documented failure-model
+        # semantics (absent, can rejoin) instead of killing the scheduler:
+        # it sorts after the live ones, keyed by URL (deterministic for
+        # this orchestrator instance; rotation is orchestrator-local).
+        def sort_key(p: RemoteValidator) -> tuple[int, str]:
+            try:
+                st = p.status(timeout=self.TIMEOUT_STATUS_S)
+                return (0, st["address"])
+            except (PeerDown, ValueError, KeyError):
+                # unreachable, erroring, or malformed — same absent
+                # semantics produce_height applies per phase
+                return (1, p.url)
+
+        self.peers = sorted(peers, key=sort_key)
         self._round = 0
         self._vote_pool: list[c.Vote] = []
 

@@ -371,3 +371,30 @@ def test_grpc_surface_on_validator_process(tmp_path):
                     pr.kill()
                 except Exception:
                     pass
+
+
+def test_scheduler_constructs_with_a_dead_peer(tmp_path):
+    """The documented failure model ('a dead peer is simply absent') must
+    hold at CONSTRUCTION too: one unreachable URL in the peer list sorts
+    last instead of raising, and the live majority still commits."""
+    n = 3
+    privs = [PrivateKey.from_seed(f"sock-{i}".encode()) for i in range(n)]
+    genesis = _genesis(privs)
+    homes = [str(tmp_path / f"val{i}") for i in range(n)]
+    procs = [_spawn(homes[i], i, genesis) for i in range(n)]
+    try:
+        peers = [_peer(h) for h in homes]
+        # a peer nothing listens on: must not kill the scheduler
+        peers.append(RemoteValidator("http://127.0.0.1:9", timeout=2.0))
+        net = SocketNetwork(peers, genesis, CHAIN)
+        assert net.peers[-1].url == "http://127.0.0.1:9"
+        height, app_hash = net.produce_height(t=1_700_000_050.0)
+        # the first round may rotate if the dead peer drew proposer duty
+        if height is None:
+            height, app_hash = net.produce_height(t=1_700_000_051.0)
+        assert height == 1 and app_hash
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=20)
