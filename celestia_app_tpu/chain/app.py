@@ -280,12 +280,39 @@ class App:
     # pipeline selection
     # ------------------------------------------------------------------
 
+    def enable_store_trace(self, path: str) -> None:
+        """Commit-multistore tracer analog (ref app/app.go:194
+        SetCommitMultiStoreTracer + cmd/root.go:243 --trace): every
+        committed store write/delete appends a JSON line
+        {op, key, len, height} to `path`. Line-buffered so a crash loses
+        at most the current line."""
+        import json as json_mod
+
+        self._trace_f = open(path, "a", buffering=1)
+
+        def tracer(op: str, key: bytes, vlen: int) -> None:
+            executing = getattr(self, "_executing_height", None)
+            self._trace_f.write(json_mod.dumps({
+                "op": op, "key": key.hex(), "len": vlen,
+                "height": executing if executing is not None else self.height,
+            }) + "\n")
+
+        self.store.tracer = tracer
+
     def close(self) -> None:
         """Release durable-storage handles (the native engine holds a
         writer flock; an App replaced in-process — reborn-validator tests,
         rollback tooling — must release it before a successor opens)."""
         if self.db is not None:
             self.db.close()
+        f = getattr(self, "_trace_f", None)
+        if f is not None:
+            self.store.tracer = None
+            self._trace_f = None
+            try:
+                f.close()
+            except OSError:
+                pass
 
     def _pipeline(self, ods):
         """ODS -> (row_roots, col_roots, data_root); device when possible."""
@@ -653,7 +680,14 @@ class App:
         if self.invariant_check_period and h.height % self.invariant_check_period == 0:
             self.crisis.assert_invariants(ctx)
 
-        ctx.store.write()
+        # the branch flush below is where the store tracer fires;
+        # self.height still holds the PREVIOUS height until commit(), so
+        # tell the tracer which block these writes belong to
+        self._executing_height = h.height
+        try:
+            ctx.store.write()
+        finally:
+            self._executing_height = None
         return results
 
     def _deliver_tx(self, block_ctx: Context, raw: bytes) -> TxResult:

@@ -108,6 +108,10 @@ class KVStore:
         self._dirty: set[int] = set()
         self._tree_valid = False
         self._changes: dict[bytes, bytes | None] = {}
+        # optional operation tracer (the commit-multistore tracer analog,
+        # ref app/app.go:194 SetCommitMultiStoreTracer): called as
+        # tracer(op, key, value_len) for every committed write/delete
+        self.tracer = None
 
     def _bucket_of(self, key: bytes) -> int:
         b = self._key_bucket.get(key)
@@ -135,6 +139,8 @@ class KVStore:
         if self._bucket_keys is not None:
             self._bucket_keys.setdefault(b, set()).add(key)
         self._dirty.add(b)
+        if self.tracer is not None:
+            self.tracer("write", key, len(value))
 
     def delete(self, key: bytes) -> None:
         if self._data.pop(key, None) is not None:
@@ -145,6 +151,8 @@ class KVStore:
                 if ks is not None:
                     ks.discard(key)
             self._dirty.add(b)
+            if self.tracer is not None:
+                self.tracer("delete", key, 0)
 
     def iterate_prefix(self, prefix: bytes):
         for k in sorted(self._data):

@@ -432,6 +432,11 @@ def cmd_start(args) -> int:
     app, cfg = _make_app(args.home)
     from celestia_app_tpu import appconsts
 
+    if args.trace:
+        trace_path = os.path.join(args.home, "data", "store_trace.jsonl")
+        os.makedirs(os.path.dirname(trace_path), exist_ok=True)
+        app.enable_store_trace(trace_path)
+        print(f"store trace -> {trace_path}", file=sys.stderr)
     node = Node(
         app,
         mempool_ttl=cfg.get("mempool_ttl_blocks", appconsts.MEMPOOL_TX_TTL_BLOCKS),
@@ -1276,6 +1281,10 @@ def main(argv=None) -> int:
                         "(9090 in the reference; 0 = ephemeral)")
     p.add_argument("--block-time", type=float, default=6.0)
     p.add_argument("--blocks", type=int, default=None)
+    p.add_argument("--trace", action="store_true",
+                   help="append every committed store write/delete to "
+                        "data/store_trace.jsonl (SetCommitMultiStoreTracer "
+                        "analog)")
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("status")

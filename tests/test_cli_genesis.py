@@ -154,3 +154,24 @@ def test_pay_for_blob_input_file_multi_blob(tmp_path):
         json.dump({"Blobs": []}, f)
     assert cli.main(["tx", "pay-for-blob", "--home", home,
                      "--from-seed", "0", "--input-file", path]) == 2
+
+
+def test_store_trace_records_commits(tmp_path):
+    """`start --trace` appends {op, key, len, height} JSON lines for every
+    committed store write (SetCommitMultiStoreTracer analog,
+    ref app/app.go:194 + cmd/root.go:243)."""
+    home = str(tmp_path / "home")
+    _init(home)
+    assert cli.main(["start", "--home", home, "--blocks", "2",
+                     "--block-time", "0.05", "--listen", "0",
+                     "--trace"]) == 0
+    path = os.path.join(home, "data", "store_trace.jsonl")
+    with open(path) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    assert lines, "no trace lines written"
+    assert {ln["op"] for ln in lines} <= {"write", "delete"}
+    assert all(set(ln) == {"op", "key", "len", "height"} for ln in lines)
+    # every line carries the height of the block whose flush wrote it:
+    # exactly blocks 1 and 2 (no off-by-one attribution to N-1)
+    heights = {ln["height"] for ln in lines}
+    assert heights == {1, 2}, heights
