@@ -212,6 +212,95 @@ def evidence_from_json(d: dict) -> "DuplicateVoteEvidence":
     return DuplicateVoteEvidence(d["height"], a, b)
 
 
+@dataclasses.dataclass(frozen=True)
+class Proposal:
+    """Signed proposal envelope for the autonomous (gossip) consensus mode.
+
+    Tendermint proposals carry the block plus the consensus-critical
+    commit-info the whole network must apply IDENTICALLY: the commit
+    certificate for height-1 (LastCommitInfo — what liveness accounting
+    reads) and the equivocation evidence for this height. In the
+    orchestrated socket mode one coordinator picks those for everyone; in
+    autonomous mode every node assembles its own certificate from gossip,
+    so cert contents differ per node — the proposer's choice, committed to
+    by this envelope's signature, is what keeps app hashes equal.
+
+    The signature covers (chain_id, height, round, block hash, and a
+    digest of last_cert+evidence), so a relaying peer cannot swap the
+    commit-info under a real proposal.
+    """
+
+    height: int
+    round: int
+    block: Block
+    proposer: bytes  # 20-byte operator address
+    signature: bytes
+    last_cert: CommitCertificate | None  # None only at height 1
+    evidence: tuple["DuplicateVoteEvidence", ...] = ()
+
+    @staticmethod
+    def commit_info_digest(
+        last_cert: CommitCertificate | None,
+        evidence: tuple["DuplicateVoteEvidence", ...],
+    ) -> bytes:
+        doc = {
+            "last_cert": cert_to_json(last_cert) if last_cert else None,
+            "evidence": [evidence_to_json(e) for e in evidence],
+        }
+        return hashlib.sha256(
+            json.dumps(doc, sort_keys=True).encode()
+        ).digest()
+
+    @staticmethod
+    def sign_bytes(
+        chain_id: str, height: int, round_: int, block_hash: bytes,
+        info_digest: bytes,
+    ) -> bytes:
+        doc = {
+            "chain_id": chain_id,
+            "height": height,
+            "round": round_,
+            "block_hash": block_hash.hex(),
+            "commit_info": info_digest.hex(),
+            "type": "proposal",
+        }
+        return json.dumps(doc, sort_keys=True).encode()
+
+    def verify(self, chain_id: str, pubkey: bytes) -> bool:
+        doc = Proposal.sign_bytes(
+            chain_id, self.height, self.round, self.block.header.hash(),
+            Proposal.commit_info_digest(self.last_cert, self.evidence),
+        )
+        pk = PublicKey(pubkey)
+        return pk.address() == self.proposer and pk.verify(
+            self.signature, doc
+        )
+
+
+def proposal_to_json(p: Proposal) -> dict:
+    return {
+        "height": p.height,
+        "round": p.round,
+        "block": block_to_json(p.block),
+        "proposer": p.proposer.hex(),
+        "signature": p.signature.hex(),
+        "last_cert": cert_to_json(p.last_cert) if p.last_cert else None,
+        "evidence": [evidence_to_json(e) for e in p.evidence],
+    }
+
+
+def proposal_from_json(d: dict) -> Proposal:
+    return Proposal(
+        height=d["height"],
+        round=d["round"],
+        block=block_from_json(d["block"]),
+        proposer=bytes.fromhex(d["proposer"]),
+        signature=bytes.fromhex(d["signature"]),
+        last_cert=cert_from_json(d["last_cert"]) if d["last_cert"] else None,
+        evidence=tuple(evidence_from_json(e) for e in d["evidence"]),
+    )
+
+
 class ValidatorNode:
     """One validator: an App + key + mempool + WAL."""
 
@@ -367,10 +456,17 @@ class ValidatorNode:
     def write_wal(
         self, block: Block, cert: CommitCertificate,
         evidence: tuple["DuplicateVoteEvidence", ...] = (),
+        present: set[bytes] | None = None,
+        record_present: bool = False,
     ) -> None:
         """Append-before-apply: the crash-recovery record. Evidence applied
         with the block is PART of the record — replay must re-apply it or
-        the replayed app hash diverges from live peers."""
+        the replayed app hash diverges from live peers. When
+        `record_present` is set (autonomous mode), the presence set that
+        liveness accounting actually used is recorded explicitly, because
+        it came from the PROPOSAL's last-commit certificate, not from the
+        locally-assembled `cert` this record stores — replay from the cert
+        alone would diverge."""
         if self.wal_dir is None:
             return
 
@@ -380,6 +476,11 @@ class ValidatorNode:
             **block_to_json(block),
             "votes": [vote_to_json(v) for v in cert.votes],
         }
+        if record_present:
+            doc["present"] = (
+                None if present is None
+                else sorted(a.hex() for a in present)
+            )
         tmp = self._wal_path(block.header.height) + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f)
@@ -387,17 +488,27 @@ class ValidatorNode:
             os.fsync(f.fileno())
         os.replace(tmp, self._wal_path(block.header.height))
 
-    def _mark_absent_from_votes(self, cert: CommitCertificate) -> None:
-        """LastCommitInfo reconstruction shared by the live commit path and
-        WAL replay: a validator counts as present only with a precommit FOR
-        the committed block at the certificate's height — a vote for a
-        different block / stale height / junk signature is an absence, so
-        misbehaving validators cannot suppress their own liveness window.
-        Each vote's signature is checked against the genesis-known validator
-        pubkeys (mirroring cert.verify), so a cert padded with forged
-        presence-votes for offline validators cannot suppress their
-        downtime accounting; a validator with no genesis pubkey (legacy
-        fixture genesis) falls back to unverified matching."""
+    def _present_set_from_cert(
+        self, cert: CommitCertificate | None
+    ) -> set[bytes] | None:
+        """LastCommitInfo presence reconstruction: a validator counts as
+        present only with a precommit FOR the committed block at the
+        certificate's height — a vote for a different block / stale height
+        / junk signature is an absence, so misbehaving validators cannot
+        suppress their own liveness window. Each vote's signature is
+        checked against the genesis-known validator pubkeys (mirroring
+        cert.verify), so a cert padded with forged presence-votes for
+        offline validators cannot suppress their downtime accounting; a
+        validator with no genesis pubkey (legacy fixture genesis) falls
+        back to unverified matching. None cert (height 1 in autonomous
+        mode: no last commit exists) -> None, meaning everyone present.
+
+        State-independent on purpose (reads only the cert + genesis
+        pubkeys): the presence set can be computed before evidence is
+        applied and recorded in the WAL, while the absent set it induces
+        is derived from the POST-evidence validator set (_set_absent)."""
+        if cert is None:
+            return None
         doc = Vote.sign_bytes(self.app.chain_id, cert.height, cert.block_hash)
         voted = set()
         for v in cert.votes:
@@ -407,14 +518,23 @@ class ValidatorNode:
             if pub is not None and not PublicKey(pub).verify(v.signature, doc):
                 continue
             voted.add(v.validator)
+        return voted
+
+    def _set_absent(self, present: set[bytes] | None) -> None:
         ctx = Context(
             self.app.store, InfiniteGasMeter(), self.app.height, 0,
             self.app.chain_id, self.app.app_version,
         )
+        if present is None:
+            self.app.absent_validators = set()
+            return
         self.app.absent_validators = {
             op for op, _p in self.app.staking.validators(ctx)
-            if op not in voted
+            if op not in present
         }
+
+    def _mark_absent_from_votes(self, cert: CommitCertificate) -> None:
+        self._set_absent(self._present_set_from_cert(cert))
 
     def _apply_evidence(
         self, evidence: tuple["DuplicateVoteEvidence", ...]
@@ -428,26 +548,40 @@ class ValidatorNode:
                 ctx, ev.vote_a.validator, infraction_height=ev.height
             )
 
+    # sentinel: "derive the presence set from the commit certificate itself"
+    # (the orchestrated modes, where one coordinator hands every node the
+    # same cert). Autonomous mode passes the proposal's last_cert instead.
+    _ABSENT_FROM_CERT = object()
+
     def apply(
         self, block: Block, cert: CommitCertificate,
         evidence: tuple["DuplicateVoteEvidence", ...] = (),
+        absent_cert=_ABSENT_FROM_CERT,
     ) -> bytes:
         """Finalize + commit a certified block (evidence first — the
         x/evidence BeginBlock position); returns the app hash. Evidence is
         in the WAL record, so crash replay re-applies it identically.
 
         LastCommitInfo analog: validators whose precommit is absent from
-        the certificate are marked absent, consumed by THIS block's
-        BeginBlock liveness accounting (one height earlier than Tendermint
-        wires LastCommitInfo, which carries height H's commit into H+1 —
-        deterministic either way since every node applies the same
-        certificate in the same order)."""
-        self.write_wal(block, cert, evidence)
+        the presence source are marked absent, consumed by THIS block's
+        BeginBlock liveness accounting. The presence source is the commit
+        certificate itself in the orchestrated modes (every node receives
+        the identical cert), or — in autonomous mode, where each node
+        assembles its OWN cert from gossip — the height-1 certificate the
+        PROPOSER embedded in the signed proposal (`absent_cert`), which is
+        the one choice all nodes share (Tendermint's LastCommitInfo-in-
+        block wiring). The WAL records the presence set whenever it did
+        not come from `cert`."""
+        from_proposal = absent_cert is not ValidatorNode._ABSENT_FROM_CERT
+        src = cert if not from_proposal else absent_cert
+        present = self._present_set_from_cert(src)
+        self.write_wal(block, cert, evidence, present=present,
+                       record_present=from_proposal)
         self._apply_evidence(evidence)
         # ordering invariant shared with replay_wal: evidence FIRST, then
         # absences — both paths must compute the absent set against the
         # same post-evidence validator set or replayed nodes diverge
-        self._mark_absent_from_votes(cert)
+        self._set_absent(present)
         results = self.app.finalize_block(block)
         app_hash = self.app.commit(block)
         self.certificates[block.header.height] = cert
@@ -505,10 +639,18 @@ class ValidatorNode:
                 evidence_from_json(e) for e in doc.get("evidence", [])
             )
             self._apply_evidence(evidence)
-            # reconstruct the LastCommitInfo absences from the WAL's cert so
-            # the replayed liveness accounting matches the live run (same
-            # evidence-then-absences order as apply())
-            self._mark_absent_from_votes(cert)
+            # reconstruct the LastCommitInfo absences exactly as the live
+            # run applied them (same evidence-then-absences order as
+            # apply()): from the WAL's explicit presence record when one
+            # was written (autonomous mode), else from the stored cert
+            if "present" in doc:
+                present = (
+                    None if doc["present"] is None
+                    else {bytes.fromhex(a) for a in doc["present"]}
+                )
+                self._set_absent(present)
+            else:
+                self._mark_absent_from_votes(cert)
             results = self.app.finalize_block(block)
             self.app.commit(block)
             self.certificates[height] = cert

@@ -1,0 +1,705 @@
+"""Autonomous consensus reactor: each validator drives its OWN rounds.
+
+Reference parity: celestia-core's consensus reactor (SURVEY §5.8) — every
+validator process runs the Tendermint round state machine itself and
+exchanges proposals/votes with peers over the network; there is no
+coordinator. This module is that state machine for this framework's
+validator processes: a background thread per process stepping
+
+    propose -> prevote -> (polka? lock) -> precommit -> commit
+
+with wall-clock phase timeouts escalating per failed round, deterministic
+proposer rotation, peer-to-peer flooding of proposals and votes over the
+validator HTTP services (service/validator_server.py /gossip/* routes),
+and commit-certificate assembly from each node's own received votes.
+
+Determinism of commit info (the one subtlety vs the orchestrated
+SocketNetwork): every node assembles a DIFFERENT >2/3 certificate from
+gossip, but liveness accounting and evidence must be identical across the
+network or app hashes diverge. Tendermint solves this by putting
+LastCommitInfo and evidence IN the block; here the signed Proposal
+envelope (chain/consensus.py Proposal) carries the height-1 certificate
+and the evidence list, and apply() consumes THOSE for absence accounting
+(absent_cert=) while storing the locally-assembled cert for the height.
+
+Trust model: all inbound gossip is verified locally — proposal signatures
+against the expected proposer for (height, round), vote signatures
+against genesis pubkeys, certificates against the node's own staking
+powers — a byzantine peer can at most waste inbox space. One honest
+caveat, documented: vote signatures commit to (height, hash, phase) but
+NOT the round (the orchestrated mode's wire format, kept compatible), so
+a relayer can replay an old-round vote into a newer round. That cannot
+forge a certificate (certs are round-blind by design) or a polka for a
+hash the validator never prevoted; it only weakens per-round vote
+attribution.
+
+Catch-up: a node that misses the commit gossip for its next height asks
+peers for their recent commit record (GET /gossip/commit_at) and, if the
+gap exceeds the recent-commit window, falls back to verified state sync
+(/consensus/snapshot), exactly like a rebooted node.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from celestia_app_tpu.chain import consensus as c
+from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+
+
+@dataclasses.dataclass
+class ReactorConfig:
+    """Phase timeouts (seconds). Defaults suit the host-engine devnet;
+    the reference's mainnet shape is TimeoutPropose 10 s / TimeoutCommit
+    11 s (consensus_consts.go), scaled here because a first proposal may
+    pay a cold jit compile on device engines."""
+
+    timeout_propose: float = 30.0
+    timeout_prevote: float = 20.0
+    timeout_precommit: float = 20.0
+    timeout_delta: float = 5.0  # added per failed round
+    block_interval: float = 0.05  # pause between committed heights
+    poll: float = 0.02  # inbox poll granularity
+    gossip_timeout: float = 5.0  # per-peer HTTP send timeout
+    recent_commits: int = 8  # commit records served to laggards
+    sync_grace: float = 5.0  # how long "peer ahead" persists before sync
+
+
+class ConsensusReactor:
+    """The per-validator round state machine (one thread per process)."""
+
+    def __init__(self, vnode, peer_urls: list[str], service_lock,
+                 config: ReactorConfig | None = None):
+        self.vnode = vnode
+        self.peers = [u.rstrip("/") for u in peer_urls]
+        self.service_lock = service_lock
+        self.cfg = config or ReactorConfig()
+        # rotation order: genesis validator operator addresses, sorted —
+        # every process computes the identical schedule with no exchange
+        self.rotation = sorted(self.vnode.validator_pubkeys.keys())
+        if not self.rotation:
+            raise ValueError(
+                "autonomous consensus needs genesis validator pubkeys"
+            )
+        self.round = 0
+        self.step = "idle"
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # inbox (guarded by _msg_lock; handlers must never block on the
+        # service lock, or a slow propose would starve vote intake)
+        self._msg_lock = threading.Lock()
+        self._proposals: dict[tuple[int, int], c.Proposal] = {}
+        self._votes: dict[tuple[int, int, str], dict[bytes, c.Vote]] = {}
+        self._pending_commits: list[dict] = []
+        self._vote_pool: list[c.Vote] = []  # precommits, for evidence
+        self._recent: dict[int, dict] = {}  # height -> gossiped commit doc
+        self._ahead: tuple[int, str, float] | None = None  # (h, peer, t)
+        self.height_view = self.vnode.app.height + 1  # for status only
+        self.app_hashes: dict[int, str] = {}  # height -> hex (divergence checks)
+        self._seen_txs: dict[bytes, None] = {}  # ordered set for dedup
+        self._senders: dict[str, object] = {}  # peer url -> send queue
+        self._pending_txs: list[bytes] = []  # gossiped txs awaiting CheckTx
+        # powers snapshot from just BEFORE our latest commit: the set that
+        # signed that height's certificate (validators for height H come
+        # from state after H-1). Verifying a height-1 cert against POST-
+        # apply powers would mis-count when that block slashed a signer.
+        self._last_powers: tuple[int, dict[bytes, int]] | None = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        self._start_senders()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+
+    # -- outbound gossip -------------------------------------------------
+
+    def _post(self, url: str, path: str, payload: dict) -> None:
+        req = urllib.request.Request(
+            url + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.cfg.gossip_timeout):
+            pass
+
+    def _gossip(self, path: str, payload: dict) -> None:
+        """Fire-and-forget flood to every peer (fully-connected devnet
+        topology). One daemon sender per peer drains a queue, so a dead
+        peer costs ONE blocked thread regardless of message rate, and
+        messages to a live peer stay ordered."""
+        for u in self.peers:
+            try:
+                self._senders[u].put_nowait((path, payload))
+            except Exception:
+                pass  # queue full (peer long dead): drop — gossip is
+                # best-effort; the pull-probe recovers anything that matters
+
+    def _start_senders(self) -> None:
+        """One sender queue+thread per peer, created once at start (the
+        peer list is static for a reactor's lifetime)."""
+        import queue
+
+        for url in self.peers:
+            q = queue.Queue(maxsize=256)
+            self._senders[url] = q
+
+            def drain(u: str = url, qq=q) -> None:
+                while not self._stop.is_set():
+                    try:
+                        item = qq.get(timeout=1.0)
+                    except Exception:
+                        continue
+                    try:
+                        self._post(u, *item)
+                    except (urllib.error.URLError, OSError, ValueError):
+                        pass
+
+            threading.Thread(target=drain, daemon=True).start()
+
+    # -- inbound gossip (HTTP handler threads; _msg_lock only) -----------
+
+    def on_proposal(self, doc: dict) -> None:
+        prop = c.proposal_from_json(doc)
+        expected = self.rotation[
+            (prop.height + prop.round) % len(self.rotation)
+        ]
+        if prop.proposer != expected:
+            return
+        pub = self.vnode.validator_pubkeys.get(prop.proposer)
+        if pub is None or not prop.verify(self.vnode.app.chain_id, pub):
+            return
+        with self._msg_lock:
+            self._proposals.setdefault((prop.height, prop.round), prop)
+        self._note_height(prop.height)
+
+    def on_vote(self, doc: dict) -> None:
+        round_ = int(doc.get("round", 0))
+        vote = c.vote_from_json(doc["vote"])
+        pub = self.vnode.validator_pubkeys.get(vote.validator)
+        if pub is None:
+            return
+        signed = c.Vote.sign_bytes(
+            self.vnode.app.chain_id, vote.height, vote.block_hash, vote.phase
+        )
+        from celestia_app_tpu.chain.crypto import PublicKey
+
+        if not PublicKey(pub).verify(vote.signature, signed):
+            return
+        with self._msg_lock:
+            pool = self._votes.setdefault(
+                (vote.height, round_, vote.phase), {}
+            )
+            fresh = vote.validator not in pool
+            pool.setdefault(vote.validator, vote)
+            if (fresh and vote.phase == "precommit"
+                    and vote.block_hash is not None):
+                self._vote_pool.append(vote)
+        self._note_height(vote.height)
+
+    def on_commit(self, doc: dict, peer: str = "") -> None:
+        """A peer announces a committed height: queue for the loop (the
+        handler must not grab the service lock — apply can take seconds)."""
+        with self._msg_lock:
+            self._pending_commits.append(doc)
+        try:
+            self._note_height(int(doc["cert"]["height"]), peer)
+        except (KeyError, TypeError, ValueError):
+            pass
+
+    def commit_at(self, height: int) -> dict | None:
+        with self._msg_lock:
+            return self._recent.get(height)
+
+    # -- mempool gossip (the reference's mempool reactor) ----------------
+
+    def _tx_first_seen(self, raw: bytes) -> bool:
+        import hashlib
+
+        key = hashlib.sha256(raw).digest()
+        with self._msg_lock:
+            if key in self._seen_txs:
+                return False
+            self._seen_txs[key] = None
+            if len(self._seen_txs) > 8192:  # bounded dedup window
+                for k in list(self._seen_txs)[:4096]:
+                    del self._seen_txs[k]
+        return True
+
+    def gossip_tx(self, raw: bytes) -> None:
+        """Flood a locally-admitted tx to peers (mempool reactor out)."""
+        import base64
+
+        if self._tx_first_seen(raw):
+            self._gossip("/gossip/tx",
+                         {"tx": base64.b64encode(raw).decode()})
+
+    def on_tx(self, doc: dict) -> None:
+        """A peer floods a tx: queue it for the reactor loop (like every
+        gossip intake, this handler must not touch the writer lock — a
+        tx flood during a slow apply() would otherwise pile up blocked
+        handler threads). The loop admits through CheckTx and re-floods
+        once on success (dedup makes the flood terminate on any
+        topology)."""
+        import base64
+
+        raw = base64.b64decode(doc["tx"])
+        if not self._tx_first_seen(raw):
+            return
+        with self._msg_lock:
+            self._pending_txs.append(raw)
+
+    def _admit_pending_txs(self) -> None:
+        import base64
+
+        with self._msg_lock:
+            pending, self._pending_txs = self._pending_txs, []
+        for raw in pending:
+            with self.service_lock:
+                res = self.vnode.add_tx(raw)
+            if res.code == 0:
+                self._gossip("/gossip/tx",
+                             {"tx": base64.b64encode(raw).decode()})
+
+    def _note_height(self, height: int, peer: str = "") -> None:
+        """Track evidence that the network is ahead of us. The first-seen
+        timestamp is PRESERVED while we stay behind — resetting it on
+        every height advance would starve the sync_grace gate exactly
+        when peers commit faster than the grace window (the case where
+        catch-up matters most)."""
+        if height > self.vnode.app.height + 1:
+            with self._msg_lock:
+                if self._ahead is None:
+                    self._ahead = (height, peer, time.monotonic())
+                elif self._ahead[0] < height:
+                    self._ahead = (height, peer or self._ahead[1],
+                                   self._ahead[2])
+
+    # -- helpers ---------------------------------------------------------
+
+    def _powers(self) -> dict[bytes, int]:
+        app = self.vnode.app
+        ctx = Context(app.store, InfiniteGasMeter(), app.height, 0,
+                      app.chain_id, app.app_version)
+        return dict(app.staking.validators(ctx))
+
+    def proposer_for(self, height: int, round_: int) -> bytes:
+        return self.rotation[(height + round_) % len(self.rotation)]
+
+    def _timeout(self, base: float) -> float:
+        return base + self.round * self.cfg.timeout_delta
+
+    def _wait(self, deadline: float, check):
+        """Poll `check` (under _msg_lock) until non-None or deadline."""
+        while not self._stop.is_set():
+            with self._msg_lock:
+                got = check()
+            if got is not None:
+                return got
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(self.cfg.poll)
+        return None
+
+    def _prune(self, floor_height: int) -> None:
+        with self._msg_lock:
+            self._proposals = {
+                k: v for k, v in self._proposals.items()
+                if k[0] >= floor_height
+            }
+            self._votes = {
+                k: v for k, v in self._votes.items() if k[0] >= floor_height
+            }
+            self._vote_pool = [
+                v for v in self._vote_pool
+                if v.height > floor_height - 10
+            ]
+            for h in [h for h in self._recent
+                      if h < floor_height - self.cfg.recent_commits]:
+                del self._recent[h]
+
+    # -- proposal validity ----------------------------------------------
+
+    def _proposal_acceptable(self, prop: c.Proposal, height: int) -> bool:
+        """Stateful checks beyond the signature (which on_proposal did):
+        the block chains from OUR committed tip, the embedded last-commit
+        certificate is real for height-1 (the absences every node will
+        apply are derived from it, so a proposer cannot smuggle a
+        thin/padded cert past the network), and every evidence item
+        actually proves a double-sign — apply() slashes whoever the
+        evidence names, so unverified evidence would let a byzantine
+        proposer tombstone honest validators."""
+        app = self.vnode.app
+        if prop.height != height or prop.block.header.height != height:
+            return False
+        if prop.block.header.last_block_hash != app.last_block_hash:
+            return False
+        if len(prop.evidence) > len(self.rotation):
+            return False  # at most one double-sign per validator
+        accused: set[bytes] = set()
+        for ev in prop.evidence:
+            pub = self.vnode.validator_pubkeys.get(ev.vote_a.validator)
+            if pub is None or not ev.verify(app.chain_id, pub):
+                return False
+            if not 0 < ev.height <= height:
+                return False
+            if ev.vote_a.validator in accused:
+                return False  # duplicates would double-count nothing, but
+            accused.add(ev.vote_a.validator)  # reject sloppy proposals
+        if height == 1:
+            return prop.last_cert is None
+        lc = prop.last_cert
+        if lc is None or lc.height != height - 1:
+            return False
+        if lc.block_hash != app.last_block_hash:
+            return False
+        # verify against the powers that were in force when height-1 was
+        # certified (snapshotted just before we applied it): the current
+        # set may already reflect a slash that block itself carried. A
+        # node without the snapshot (WAL replay / state sync) falls back
+        # to current powers — best effort, same as its cert verification.
+        if (self._last_powers is not None
+                and self._last_powers[0] == height - 1):
+            powers = self._last_powers[1]
+        else:
+            powers = self._powers()
+        return lc.verify(app.chain_id, self.vnode.validator_pubkeys,
+                         sum(powers.values()), powers)
+
+    # -- the state machine ----------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                committed = self._step_height()
+            except Exception as e:  # keep the reactor alive; log loudly
+                print(f"[reactor {self.vnode.name}] round error: "
+                      f"{type(e).__name__}: {e}", flush=True)
+                committed = False
+                time.sleep(0.2)
+            if committed:
+                self.round = 0
+                time.sleep(self.cfg.block_interval)
+
+    def _apply_pending_commit(self) -> bool:
+        """Adopt a gossiped commit for our next height, if one is queued.
+        Verification order: cert against our own trust roots, proposal
+        signature + last-cert, then ProcessProposal — a certified block
+        that fails local validity means >1/3 byzantine power or a bug;
+        refuse and say so rather than follow the herd."""
+        with self._msg_lock:
+            pending, self._pending_commits = self._pending_commits, []
+        applied = False
+        for doc in pending:
+            try:
+                prop = c.proposal_from_json(doc["proposal"])
+                cert = c.cert_from_json(doc["cert"])
+            except (KeyError, ValueError, TypeError):
+                continue
+            with self.service_lock:
+                app = self.vnode.app
+                height = app.height + 1
+                if cert.height != height:
+                    continue
+                if cert.block_hash != prop.block.header.hash():
+                    continue
+                pub = self.vnode.validator_pubkeys.get(prop.proposer)
+                if pub is None or not prop.verify(app.chain_id, pub):
+                    continue
+                if not self._proposal_acceptable(prop, height):
+                    continue
+                if not self.vnode.verify_certificate(cert):
+                    continue
+                if not app.process_proposal(prop.block):
+                    print(f"[reactor {self.vnode.name}] REFUSING certified "
+                          f"block at height {height}: local validation "
+                          "failed (>1/3 byzantine or bug)", flush=True)
+                    continue
+                self._last_powers = (height, self._powers())
+                h = self.vnode.apply(prop.block, cert,
+                                     evidence=prop.evidence,
+                                     absent_cert=prop.last_cert)
+                self.vnode.clear_lock()
+                self.app_hashes[height] = h.hex()
+                self._remember_commit(doc, height)
+                applied = True
+        return applied
+
+    def _remember_commit(self, doc: dict, height: int) -> None:
+        punished = {
+            bytes.fromhex(v["validator"])
+            for e in doc.get("proposal", {}).get("evidence", [])
+            for v in e.get("votes", [])
+        }
+        with self._msg_lock:
+            self._recent[height] = doc
+            self._ahead = None
+            if punished:
+                # x/evidence tombstones are idempotent, but re-proposing
+                # settled evidence forever would bloat every proposal
+                self._vote_pool = [
+                    v for v in self._vote_pool if v.validator not in punished
+                ]
+
+    def _maybe_catch_up(self) -> bool:
+        """If peers are persistently ahead, pull their commit records (or
+        a full verified snapshot when the gap is too wide)."""
+        with self._msg_lock:
+            ahead = self._ahead
+        if ahead is None:
+            return False
+        target, peer, since = ahead
+        if time.monotonic() - since < self.cfg.sync_grace:
+            return False
+        progressed = False
+        # 1) replay peers' recent commit records height by height
+        for _ in range(self.cfg.recent_commits * 2):
+            with self.service_lock:
+                need = self.vnode.app.height + 1
+            if need > target:
+                break
+            doc = self._fetch_commit_record(need, prefer=peer)
+            if doc is None:
+                break
+            self.on_commit(doc)
+            if self._apply_pending_commit():
+                progressed = True
+            else:
+                break
+        with self.service_lock:
+            still_behind = self.vnode.app.height + 1 < target
+        if not still_behind:
+            with self._msg_lock:
+                if self._ahead is not None and self._ahead[0] <= target:
+                    self._ahead = None  # caught up; stop re-checking
+        if still_behind and not progressed:
+            # 2) verified state sync from whoever served the gossip
+            urls = [peer] if peer else list(self.peers)
+            for u in urls:
+                if self._state_sync_from(u):
+                    progressed = True
+                    break
+        if progressed:
+            with self._msg_lock:
+                self._ahead = None
+        return progressed
+
+    def _probe_peer_heights(self) -> None:
+        """GET /consensus/status from each peer; note the max height seen
+        (feeds the same catch-up path inbound gossip does)."""
+        for u in self.peers:
+            try:
+                with urllib.request.urlopen(
+                    u + "/consensus/status",
+                    timeout=self.cfg.gossip_timeout,
+                ) as r:
+                    st = json.loads(r.read())
+                self._note_height(int(st["height"]) + 1, u)
+            except (urllib.error.URLError, OSError, ValueError, KeyError):
+                continue
+
+    def _fetch_commit_record(self, height: int,
+                             prefer: str = "") -> dict | None:
+        urls = ([prefer] if prefer else []) + [
+            u for u in self.peers if u != prefer
+        ]
+        for u in urls:
+            try:
+                with urllib.request.urlopen(
+                    f"{u}/gossip/commit_at?height={height}",
+                    timeout=self.cfg.gossip_timeout,
+                ) as r:
+                    doc = json.loads(r.read())
+                if doc:
+                    return doc
+            except (urllib.error.URLError, OSError, ValueError):
+                continue
+        return None
+
+    def _state_sync_from(self, url: str) -> bool:
+        import base64
+
+        try:
+            with urllib.request.urlopen(
+                url + "/consensus/snapshot", timeout=30
+            ) as r:
+                doc = json.loads(r.read())
+            chunks = [base64.b64decode(ch) for ch in doc["chunks"]]
+            with self.service_lock:
+                c.state_sync_bootstrap(self.vnode, doc["manifest"], chunks)
+            return True
+        except (urllib.error.URLError, OSError, ValueError, KeyError):
+            return False
+
+    def _step_height(self) -> bool:
+        """One (height, round) attempt; True iff a block was committed."""
+        self._admit_pending_txs()
+        if self._apply_pending_commit():
+            return True
+        if self._maybe_catch_up():
+            return True
+        with self.service_lock:
+            height = self.vnode.app.height + 1
+            my_last_cert = self.vnode.certificates.get(height - 1)
+        self.height_view = height
+        r = self.round
+
+        # ---- propose ----
+        self.step = "propose"
+        i_am_proposer = self.proposer_for(height, r) == self.vnode.address
+        # a proposer that lacks the height-1 cert (it state-synced into
+        # this height) cannot author valid commit info; it stays silent
+        # and the round rotates past it
+        if i_am_proposer and (height == 1 or my_last_cert is not None):
+            with self._msg_lock:
+                pool = [list(self._vote_pool)]
+            with self.service_lock:
+                evidence = tuple(c.detect_equivocation(
+                    self.vnode.app.chain_id, pool,
+                    self.vnode.validator_pubkeys,
+                ))
+                block = self.vnode.propose(t=time.time())
+            digest = c.Proposal.commit_info_digest(my_last_cert, evidence)
+            sig = self.vnode.priv.sign(c.Proposal.sign_bytes(
+                self.vnode.app.chain_id, height, r, block.header.hash(),
+                digest,
+            ))
+            prop = c.Proposal(height, r, block, self.vnode.address, sig,
+                              my_last_cert, evidence)
+            with self._msg_lock:
+                self._proposals.setdefault((height, r), prop)
+            self._gossip("/gossip/proposal", c.proposal_to_json(prop))
+
+        deadline = time.monotonic() + self._timeout(self.cfg.timeout_propose)
+        prop = self._wait(
+            deadline, lambda: self._proposals.get((height, r))
+        )
+
+        # ---- prevote ----
+        self.step = "prevote"
+        accept = False
+        if prop is not None:
+            with self.service_lock:
+                accept = self._proposal_acceptable(prop, height)
+        if accept:
+            with self.service_lock:
+                pv = self.vnode.prevote_on(prop.block)  # ProcessProposal
+        else:
+            with self.service_lock:
+                pv = self.vnode._signed(height, None, "prevote")
+        self.on_vote({"round": r, "vote": c.vote_to_json(pv)})
+        self._gossip("/gossip/vote",
+                     {"round": r, "vote": c.vote_to_json(pv)})
+
+        with self.service_lock:
+            powers = self._powers()
+        total = sum(powers.values())
+
+        def polka_check():
+            pool = self._votes.get((height, r, "prevote"), {})
+            by_hash: dict[bytes, int] = {}
+            nil_power = 0
+            for v in pool.values():
+                p = powers.get(v.validator, 0)
+                if v.block_hash is None:
+                    nil_power += p
+                else:
+                    by_hash[v.block_hash] = by_hash.get(v.block_hash, 0) + p
+            for bh, power in by_hash.items():
+                if power * 3 > total * 2:
+                    return bh
+            if nil_power * 3 > total * 2:
+                return b"nil"  # sentinel: round is dead, move on
+            return None
+
+        deadline = time.monotonic() + self._timeout(self.cfg.timeout_prevote)
+        polka = self._wait(deadline, polka_check)
+        polka_hash = polka if isinstance(polka, bytes) and polka != b"nil" \
+            else None
+
+        # ---- precommit ----
+        self.step = "precommit"
+        if (polka_hash is not None and prop is not None
+                and prop.block.header.hash() == polka_hash):
+            with self.service_lock:
+                self.vnode.on_polka(prop.block, r)
+                pc = self.vnode.precommit_on(prop.block)
+        else:
+            with self.service_lock:
+                pc = self.vnode.precommit_on(None)
+        self.on_vote({"round": r, "vote": c.vote_to_json(pc)})
+        self._gossip("/gossip/vote",
+                     {"round": r, "vote": c.vote_to_json(pc)})
+
+        def quorum_check():
+            pool = self._votes.get((height, r, "precommit"), {})
+            votes = [
+                v for v in pool.values() if v.block_hash == polka_hash
+            ]
+            power = sum(powers.get(v.validator, 0) for v in votes)
+            if power * 3 > total * 2:
+                return tuple(votes)
+            return None
+
+        if polka_hash is None:
+            # a nil polka (or none at all) already proved this round dead:
+            # no certificate we could act on can form, so don't dead-wait
+            # the precommit window — any commit others reached arrives by
+            # gossip and is adopted at the top of the next attempt
+            cert_votes = None
+        else:
+            deadline = time.monotonic() + self._timeout(
+                self.cfg.timeout_precommit
+            )
+            cert_votes = self._wait(deadline, quorum_check)
+
+        # a certificate is only actionable if WE hold the matching
+        # proposal: an equivocating proposer could have sent us block A
+        # while the majority polka'd block B — applying A under a cert
+        # for B would fork this node's state. Without the block, let the
+        # commit arrive by gossip instead.
+        if (cert_votes is not None
+                and (prop is None
+                     or prop.block.header.hash() != polka_hash)):
+            cert_votes = None
+
+        if cert_votes is None:
+            # commit may still arrive by gossip (others saw the quorum)
+            if self._apply_pending_commit():
+                return True
+            # pull-based peer probe: a failed round can mean the network
+            # moved on without us (our inbound gossip is not arriving —
+            # e.g. we rejoined on a new address). Ask peers where they are
+            # so _maybe_catch_up can pull the gap.
+            self._probe_peer_heights()
+            self.round = r + 1
+            self.step = "round-failed"
+            self._prune(self.vnode.app.height + 1)
+            return False
+
+        # ---- commit ----
+        self.step = "commit"
+        cert = c.CommitCertificate(height, polka_hash, cert_votes)
+        doc = {"proposal": c.proposal_to_json(prop),
+               "cert": c.cert_to_json(cert)}
+        with self.service_lock:
+            if self.vnode.app.height >= height:
+                return True  # a gossiped commit beat us to it
+            self._last_powers = (height, self._powers())
+            ah = self.vnode.apply(prop.block, cert, evidence=prop.evidence,
+                                  absent_cert=prop.last_cert)
+            self.vnode.clear_lock()
+            self.app_hashes[height] = ah.hex()
+        self._remember_commit(doc, height)
+        self._gossip("/gossip/commit", doc)
+        self._prune(height + 1)
+        return True

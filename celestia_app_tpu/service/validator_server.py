@@ -23,6 +23,15 @@ Routes (all JSON):
   POST /consensus/commit {block, cert, evidence} -> {app_hash}
   GET  /consensus/snapshot          {manifest, chunks: [b64]} (state sync)
   POST /consensus/sync {peer}       pull + verify a peer's snapshot
+
+Autonomous (gossip) mode adds the peer-to-peer plane consumed by
+chain/reactor.py — these routes deliberately BYPASS the big writer lock
+(they only record into the reactor's inbox; a slow propose must not
+starve vote intake):
+  POST /gossip/proposal {proposal}  signed Proposal from a peer
+  POST /gossip/vote {round, vote}   prevote/precommit from a peer
+  POST /gossip/commit {proposal, cert}   a peer's committed height
+  GET  /gossip/commit_at?height=H   recent commit record (laggard catch-up)
 """
 
 from __future__ import annotations
@@ -40,6 +49,7 @@ class ValidatorService:
                  port: int = 0):
         self.vnode = vnode
         self.lock = threading.Lock()
+        self.reactor = None  # set by attach_reactor (autonomous mode)
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -59,6 +69,15 @@ class ValidatorService:
                     if self.path == "/consensus/status":
                         with service.lock:
                             self._send(200, service._status())
+                    elif self.path.startswith("/gossip/commit_at"):
+                        from urllib.parse import parse_qs, urlparse
+
+                        if service.reactor is None:
+                            self._send(404, {"error": "not autonomous"})
+                            return
+                        q = parse_qs(urlparse(self.path).query)
+                        h = int(q.get("height", ["0"])[0])
+                        self._send(200, service.reactor.commit_at(h) or {})
                     elif self.path == "/consensus/snapshot":
                         with service.lock:
                             manifest, chunks = service.vnode.snapshot_chunks()
@@ -77,6 +96,31 @@ class ValidatorService:
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(n) or b"{}")
+                    # gossip intake records into the reactor inbox WITHOUT
+                    # the writer lock — vote delivery must not wait behind
+                    # a propose/apply in progress
+                    gossip = {
+                        "/gossip/proposal": "on_proposal",
+                        "/gossip/vote": "on_vote",
+                        "/gossip/commit": "on_commit",
+                        "/gossip/tx": "on_tx",
+                    }.get(self.path)
+                    if gossip is not None:
+                        if service.reactor is None:
+                            self._send(404, {"error": "not autonomous"})
+                            return
+                        try:
+                            getattr(service.reactor, gossip)(payload)
+                        except (KeyError, TypeError, ValueError) as e:
+                            # malformed peer input is the peer's problem,
+                            # not a server error
+                            self._send(400, {
+                                "error": f"malformed gossip: "
+                                         f"{type(e).__name__}"
+                            })
+                            return
+                        self._send(200, {"ok": True})
+                        return
                     route = {
                         "/broadcast_tx": service._broadcast_tx,
                         "/consensus/propose": service._propose,
@@ -102,7 +146,7 @@ class ValidatorService:
 
     def _status(self) -> dict:
         v = self.vnode
-        return {
+        out = {
             "name": v.name,
             "address": v.address.hex(),
             "chain_id": v.app.chain_id,
@@ -112,10 +156,32 @@ class ValidatorService:
             "locked": v.locked_block.header.hash().hex()
             if v.locked_block is not None else None,
         }
+        if self.reactor is not None:
+            out["reactor"] = {
+                "round": self.reactor.round,
+                "step": self.reactor.step,
+                "height_view": self.reactor.height_view,
+            }
+        return out
+
+    def attach_reactor(self, peer_urls: list[str], config=None):
+        """Switch this validator to autonomous mode: start the consensus
+        reactor thread gossiping with `peer_urls` (chain/reactor.py)."""
+        from celestia_app_tpu.chain.reactor import ConsensusReactor
+
+        self.reactor = ConsensusReactor(
+            self.vnode, peer_urls, self.lock, config
+        )
+        self.reactor.start()
+        return self.reactor
 
     def _broadcast_tx(self, p: dict) -> dict:
         raw = base64.b64decode(p["tx"])
         res = self.vnode.add_tx(raw)  # the ONE admission path
+        if res.code == 0 and self.reactor is not None:
+            # autonomous mode: flood to peers (the mempool reactor) so any
+            # upcoming proposer can include the tx
+            self.reactor.gossip_tx(raw)
         return {"code": res.code, "log": res.log,
                 "gas_wanted": res.gas_wanted, "gas_used": res.gas_used}
 
@@ -204,5 +270,7 @@ class ValidatorService:
         self.httpd.serve_forever()
 
     def shutdown(self) -> None:
+        if self.reactor is not None:
+            self.reactor.stop()
         self.httpd.shutdown()
         self.httpd.server_close()

@@ -1,0 +1,199 @@
+"""Autonomous consensus: validators drive their OWN rounds over sockets.
+
+No coordinator anywhere — each ValidatorService gets a ConsensusReactor
+(chain/reactor.py) that proposes, prevotes, precommits, assembles its own
+commit certificates from gossip, and commits independently; proposals,
+votes, and commit records cross real localhost HTTP sockets. Mirrors the
+reference's consensus reactor topology (celestia-core p2p, SURVEY §5.8)
+where the orchestrated SocketNetwork (test_socket_devnet.py) mirrors only
+its message flow.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from celestia_app_tpu.chain import consensus as c
+from celestia_app_tpu.chain.crypto import PrivateKey
+from celestia_app_tpu.chain.reactor import ReactorConfig
+from celestia_app_tpu.chain.tx import MsgSend
+from celestia_app_tpu.client.tx_client import Signer
+from celestia_app_tpu.service.validator_server import ValidatorService
+
+CHAIN = "celestia-autonomous-test"
+
+FAST = dict(
+    timeout_propose=8.0,
+    timeout_prevote=4.0,
+    timeout_precommit=4.0,
+    timeout_delta=1.0,
+    block_interval=0.01,
+    poll=0.005,
+    gossip_timeout=2.0,
+    sync_grace=0.5,
+)
+
+
+def _genesis(privs):
+    return {
+        "time_unix": 1_700_000_000.0,
+        "accounts": [
+            {"address": p.public_key().address().hex(), "balance": 10**12}
+            for p in privs
+        ],
+        "validators": [
+            {
+                "operator": p.public_key().address().hex(),
+                "power": 10,
+                "pubkey": p.public_key().compressed.hex(),
+            }
+            for p in privs
+        ],
+    }
+
+
+class Net:
+    """N in-process validator services wired as a fully-connected gossip
+    mesh over real localhost HTTP."""
+
+    def __init__(self, n: int, seed: str):
+        self.privs = [
+            PrivateKey.from_seed(f"{seed}-{i}".encode()) for i in range(n)
+        ]
+        genesis = _genesis(self.privs)
+        self.nodes = [
+            c.ValidatorNode(f"val{i}", p, genesis, CHAIN)
+            for i, p in enumerate(self.privs)
+        ]
+        self.services = [ValidatorService(v) for v in self.nodes]
+        for s in self.services:
+            s.serve_background()
+        self.urls = [f"http://127.0.0.1:{s.port}" for s in self.services]
+
+    def start_reactor(self, i: int, **overrides) -> None:
+        peers = [u for j, u in enumerate(self.urls) if j != i]
+        self.services[i].attach_reactor(
+            peers, ReactorConfig(**{**FAST, **overrides})
+        )
+
+    def start_all(self) -> None:
+        for i in range(len(self.services)):
+            self.start_reactor(i)
+
+    def stop(self) -> None:
+        for s in self.services:
+            try:
+                s.shutdown()
+            except Exception:
+                pass
+
+    def heights(self) -> list[int]:
+        return [v.app.height for v in self.nodes]
+
+    def wait_heights(self, target: int, nodes=None, timeout: float = 90.0):
+        nodes = nodes if nodes is not None else list(range(len(self.nodes)))
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(self.nodes[i].app.height >= target for i in nodes):
+                return
+            time.sleep(0.05)
+        raise AssertionError(
+            f"timeout waiting for height {target}: {self.heights()}"
+        )
+
+    def assert_no_divergence(self, nodes=None) -> int:
+        """Every height committed by 2+ of the given nodes has ONE hash."""
+        nodes = nodes if nodes is not None else list(range(len(self.nodes)))
+        reactors = [self.services[i].reactor for i in nodes]
+        common = 0
+        all_heights = set()
+        for r in reactors:
+            all_heights |= set(r.app_hashes)
+        for h in sorted(all_heights):
+            seen = {r.app_hashes[h] for r in reactors if h in r.app_hashes}
+            assert len(seen) <= 1, f"divergence at height {h}: {seen}"
+            if sum(h in r.app_hashes for r in reactors) >= 2:
+                common += 1
+        assert common > 0, "no height was committed by two nodes"
+        return common
+
+
+@pytest.fixture
+def net4():
+    net = Net(4, "auto")
+    yield net
+    net.stop()
+
+
+def test_autonomous_heights_commit_identically(net4):
+    """Four reactors, no coordinator: blocks commit, app hashes agree at
+    every shared height, and a tx lands in state everywhere."""
+    net4.start_all()
+    net4.wait_heights(2)
+
+    # a tx submitted to ONE node's HTTP route floods to every mempool
+    # (the mempool-reactor path) and is committed network-wide no matter
+    # whose proposer slot comes next
+    import base64
+    import json as json_mod
+    import urllib.request
+
+    signer = Signer(CHAIN)
+    signer.add_account(net4.privs[0], number=0)
+    a0 = net4.privs[0].public_key().address()
+    a1 = net4.privs[1].public_key().address()
+    tx = signer.create_tx(a0, [MsgSend(a0, a1, 777)],
+                          fee=2000, gas_limit=100_000)
+    req = urllib.request.Request(
+        net4.urls[0] + "/broadcast_tx",
+        data=json_mod.dumps(
+            {"tx": base64.b64encode(tx.encode()).decode()}
+        ).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert json_mod.loads(r.read())["code"] == 0
+
+    base = net4.nodes[0].app.height
+    net4.wait_heights(base + 2)
+    net4.assert_no_divergence()
+
+    # the send executed: receiver balance grew on EVERY node
+    for v in net4.nodes:
+        from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+
+        ctx = Context(v.app.store, InfiniteGasMeter(), v.app.height, 0,
+                      CHAIN, v.app.app_version)
+        assert v.app.bank.balance(ctx, a1) > 10**12
+
+
+@pytest.mark.slow
+def test_dead_proposer_rotates_round(net4):
+    """Kill one validator (reactor + server): the remaining 3/4 power is
+    >2/3, so heights keep committing after its proposer slots time out."""
+    net4.start_all()
+    net4.wait_heights(1)
+    victim = 2
+    net4.services[victim].shutdown()
+    alive = [i for i in range(4) if i != victim]
+    base = max(net4.nodes[i].app.height for i in alive)
+    # +3 heights guarantees at least one slot where the dead node was the
+    # proposer (rotation is round-robin over 4)
+    net4.wait_heights(base + 3, nodes=alive, timeout=120.0)
+    net4.assert_no_divergence(nodes=alive)
+
+
+def test_late_starter_catches_up(net4):
+    """A validator whose reactor starts late (server up, reactor down —
+    the 'slept through consensus' shape) adopts the committed heights from
+    peers' commit records and rejoins."""
+    for i in range(3):
+        net4.start_reactor(i)
+    net4.wait_heights(2, nodes=[0, 1, 2])
+    assert net4.nodes[3].app.height == 0
+    net4.start_reactor(3)
+    target = net4.nodes[0].app.height + 1
+    net4.wait_heights(target, timeout=120.0)
+    net4.assert_no_divergence()

@@ -1,0 +1,172 @@
+"""Process-level autonomous consensus: kill/restart with NO coordinator.
+
+Each validator is its own OS process (`validator-serve --autonomous`)
+running the consensus reactor from chain/reactor.py; this test kills one
+mid-run (the remaining 3/4 power keeps committing through its proposer
+slots) and restarts it (WAL replay + commit-record catch-up over the
+wire). The orchestrated twin is tests/test_socket_devnet.py; here nobody
+drives the schedule.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+CHAIN = "celestia-autodev-test"
+
+FAST_REACTOR = {
+    "timeout_propose": 6.0,
+    "timeout_prevote": 3.0,
+    "timeout_precommit": 3.0,
+    "timeout_delta": 1.0,
+    "block_interval": 0.05,
+    "poll": 0.01,
+    "gossip_timeout": 2.0,
+    "sync_grace": 0.5,
+}
+
+
+def _genesis(seeds):
+    from celestia_app_tpu.chain.crypto import PrivateKey
+
+    privs = [PrivateKey.from_seed(s.encode()) for s in seeds]
+    return {
+        "time_unix": 1_700_000_000.0,
+        "accounts": [
+            {"address": p.public_key().address().hex(), "balance": 10**12}
+            for p in privs
+        ],
+        "validators": [
+            {
+                "operator": p.public_key().address().hex(),
+                "power": 10,
+                "pubkey": p.public_key().compressed.hex(),
+            }
+            for p in privs
+        ],
+    }
+
+
+def _spawn(home: str, seed: str, genesis: dict,
+           port: int = 0) -> subprocess.Popen:
+    os.makedirs(home, exist_ok=True)
+    with open(os.path.join(home, "genesis.json"), "w") as f:
+        json.dump(genesis, f)
+    with open(os.path.join(home, "key.json"), "w") as f:
+        json.dump({"seed_hex": seed.encode().hex(),
+                   "name": os.path.basename(home)}, f)
+    with open(os.path.join(home, "reactor.json"), "w") as f:
+        json.dump(FAST_REACTOR, f)
+    ep = os.path.join(home, "endpoint.json")
+    if os.path.exists(ep):
+        os.unlink(ep)
+    return subprocess.Popen(
+        [sys.executable, "-m", "celestia_app_tpu", "validator-serve",
+         "--home", home, "--chain-id", CHAIN, "--autonomous",
+         "--port", str(port)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def _endpoint(home: str, timeout: float = 120.0) -> str:
+    ep = os.path.join(home, "endpoint.json")
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(ep):
+            with open(ep) as f:
+                doc = json.load(f)
+            return f"http://{doc['host']}:{doc['port']}"
+        time.sleep(0.25)
+    raise AssertionError(f"{home} never published an endpoint")
+
+
+def _status(url: str) -> dict | None:
+    try:
+        with urllib.request.urlopen(url + "/consensus/status",
+                                    timeout=5) as r:
+            return json.loads(r.read())
+    except OSError:
+        return None
+
+
+def _wait_height(urls, target, timeout=120.0, need=None):
+    need = need if need is not None else len(urls)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        sts = [_status(u) for u in urls]
+        ok = [s for s in sts if s and s["height"] >= target]
+        if len(ok) >= need:
+            return
+        time.sleep(0.3)
+    raise AssertionError(
+        f"timeout to height {target}: "
+        f"{[(s or {}).get('height') for s in (_status(u) for u in urls)]}"
+    )
+
+
+@pytest.mark.slow
+def test_autonomous_kill_restart(tmp_path):
+    seeds = [f"autodev-{i}" for i in range(4)]
+    genesis = _genesis(seeds)
+    homes = [str(tmp_path / f"val{i}") for i in range(4)]
+    procs = [_spawn(h, s, genesis) for h, s in zip(homes, seeds)]
+    try:
+        urls = [_endpoint(h) for h in homes]
+        for h in homes:
+            tmp = os.path.join(h, "peers.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(urls, f)
+            os.replace(tmp, os.path.join(h, "peers.json"))
+
+        # generous first wait: four fresh interpreters cold-import jax
+        # concurrently before their reactors arm
+        _wait_height(urls, 2, timeout=240.0)
+
+        # kill one validator outright; 3/4 power keeps committing through
+        # the dead node's proposer slots (round rotation)
+        procs[1].kill()
+        procs[1].wait(timeout=10)
+        alive = [urls[i] for i in (0, 2, 3)]
+        sts = [_status(u) for u in alive]
+        base = max(s["height"] for s in sts if s)
+        _wait_height(alive, base + 3, timeout=180.0)
+
+        # restart from the same home ON THE SAME PORT (the configured
+        # listen address, as a real deployment would): WAL replay to its
+        # committed height, then commit-record catch-up from peers — and
+        # it resumes voting
+        old_port = int(urls[1].rsplit(":", 1)[1])
+        procs[1] = _spawn(homes[1], seeds[1], genesis, port=old_port)
+        assert _endpoint(homes[1]) == urls[1]
+        cur = max((_status(u) or {}).get("height", 0) for u in alive)
+        _wait_height(urls, cur + 1, timeout=180.0)
+
+        # no divergence: all holders of the last common height's commit
+        # record agree on the block hash
+        lo = min(s["height"] for s in (_status(u) for u in urls) if s)
+        hashes = set()
+        for u in urls:
+            try:
+                with urllib.request.urlopen(
+                    f"{u}/gossip/commit_at?height={lo}", timeout=5
+                ) as r:
+                    doc = json.loads(r.read())
+                if doc:
+                    hashes.add(doc["cert"]["block_hash"])
+            except OSError:
+                pass
+        assert len(hashes) <= 1, f"divergence at {lo}: {hashes}"
+    finally:
+        for p in procs:
+            try:
+                p.terminate()
+                p.wait(timeout=5)
+            except Exception:
+                p.kill()
