@@ -773,7 +773,20 @@ class App:
                 ctx, msg.src_validator, msg.dst_validator, msg.delegator, msg.amount
             )
         elif isinstance(msg, MsgCreateValidator):
-            self.staking.create_validator(ctx, msg.operator, msg.self_stake)
+            if msg.pubkey:
+                # the consensus pubkey must derive the operator address, or
+                # anyone could register a key they don't hold for an
+                # address they do (votes would verify against the wrong
+                # identity)
+                from celestia_app_tpu.chain.crypto import PublicKey
+
+                if PublicKey(msg.pubkey).address() != msg.operator:
+                    raise ValueError(
+                        "consensus pubkey does not derive operator address"
+                    )
+            self.staking.create_validator(
+                ctx, msg.operator, msg.self_stake, pubkey=msg.pubkey
+            )
         elif isinstance(msg, MsgSubmitProposal):
             import json as json_mod
 

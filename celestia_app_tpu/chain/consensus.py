@@ -433,12 +433,28 @@ class ValidatorNode:
         bh = block.header.hash() if ok else None
         return self._signed(block.header.height, bh, "precommit")
 
+    def known_pubkeys(self) -> dict[bytes, bytes]:
+        """operator -> consensus pubkey from BOTH trust roots: the genesis
+        doc and on-chain registrations (MsgCreateValidator.pubkey via
+        staking.consensus_pubkeys) — the full set whose votes this node
+        can verify. Runtime-created validators exist only in the latter;
+        a genesis entry wins any conflict (it is the older commitment,
+        and create_validator refuses existing operators anyway)."""
+        ctx = Context(
+            self.app.store, InfiniteGasMeter(), self.app.height, 0,
+            self.app.chain_id, self.app.app_version,
+        )
+        out = dict(self.app.staking.consensus_pubkeys(ctx))
+        out.update(self.validator_pubkeys)
+        return out
+
     def verify_certificate(self, cert: CommitCertificate) -> bool:
         """Check a certificate against THIS node's own trust roots — the
-        genesis-known pubkeys and the staking-state powers — before
-        applying a block a remote orchestrator hands over (the socket
-        commit path must not trust the coordinator)."""
-        if not self.validator_pubkeys:
+        genesis + on-chain-registered pubkeys and the staking-state powers
+        — before applying a block a remote orchestrator hands over (the
+        socket commit path must not trust the coordinator)."""
+        pubkeys = self.known_pubkeys()
+        if not pubkeys:
             return False
         ctx = Context(
             self.app.store, InfiniteGasMeter(), self.app.height, 0,
@@ -446,7 +462,7 @@ class ValidatorNode:
         )
         powers = dict(self.app.staking.validators(ctx))
         return cert.verify(
-            self.app.chain_id, self.validator_pubkeys,
+            self.app.chain_id, pubkeys,
             sum(powers.values()), powers,
         )
 
@@ -503,18 +519,23 @@ class ValidatorNode:
         back to unverified matching. None cert (height 1 in autonomous
         mode: no last commit exists) -> None, meaning everyone present.
 
-        State-independent on purpose (reads only the cert + genesis
-        pubkeys): the presence set can be computed before evidence is
-        applied and recorded in the WAL, while the absent set it induces
-        is derived from the POST-evidence validator set (_set_absent)."""
+        Computed BEFORE evidence is applied (and recorded in the WAL when
+        the source cert differs from the stored one), while the absent
+        set it induces is derived from the POST-evidence validator set
+        (_set_absent). Verification keys are known_pubkeys() — genesis
+        plus on-chain registrations — so runtime-created validators'
+        presence votes are signature-checked too, not fallback-matched;
+        both live apply and WAL replay read the same pre-block state, so
+        the computation stays deterministic across them."""
         if cert is None:
             return None
+        known = self.known_pubkeys()
         doc = Vote.sign_bytes(self.app.chain_id, cert.height, cert.block_hash)
         voted = set()
         for v in cert.votes:
             if v.block_hash != cert.block_hash or v.height != cert.height:
                 continue
-            pub = self.validator_pubkeys.get(v.validator)
+            pub = known.get(v.validator)
             if pub is not None and not PublicKey(pub).verify(v.signature, doc):
                 continue
             voted.add(v.validator)

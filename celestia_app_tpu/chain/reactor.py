@@ -79,9 +79,14 @@ class ConsensusReactor:
         self.peers = [u.rstrip("/") for u in peer_urls]
         self.service_lock = service_lock
         self.cfg = config or ReactorConfig()
-        # rotation order: genesis validator operator addresses, sorted —
-        # every process computes the identical schedule with no exchange
+        # rotation order: operator addresses of the CURRENT staked set
+        # (sorted), refreshed from state at every commit — a runtime
+        # MsgCreateValidator(pubkey=...) joins the schedule the height
+        # after it commits, Tendermint's valset-update flow. Genesis
+        # pubkeys seed the set; every process derives the identical
+        # schedule from its own state, no exchange needed.
         self.rotation = sorted(self.vnode.validator_pubkeys.keys())
+        self._pubkey_cache = dict(self.vnode.validator_pubkeys)
         if not self.rotation:
             raise ValueError(
                 "autonomous consensus needs genesis validator pubkeys"
@@ -113,6 +118,8 @@ class ConsensusReactor:
     # -- lifecycle -------------------------------------------------------
 
     def start(self) -> None:
+        with self.service_lock:
+            self._refresh_valset()  # a resumed node's set may differ from genesis
         self._start_senders()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -175,7 +182,7 @@ class ConsensusReactor:
         ]
         if prop.proposer != expected:
             return
-        pub = self.vnode.validator_pubkeys.get(prop.proposer)
+        pub = self._pubkey_cache.get(prop.proposer)
         if pub is None or not prop.verify(self.vnode.app.chain_id, pub):
             return
         with self._msg_lock:
@@ -185,7 +192,7 @@ class ConsensusReactor:
     def on_vote(self, doc: dict) -> None:
         round_ = int(doc.get("round", 0))
         vote = c.vote_from_json(doc["vote"])
-        pub = self.vnode.validator_pubkeys.get(vote.validator)
+        pub = self._pubkey_cache.get(vote.validator)
         if pub is None:
             return
         signed = c.Vote.sign_bytes(
@@ -292,6 +299,22 @@ class ConsensusReactor:
                       app.chain_id, app.app_version)
         return dict(app.staking.validators(ctx))
 
+    def _refresh_valset(self) -> None:
+        """Recompute rotation + vote-verification keys from state (call
+        under service_lock). The set changes only at commits, so gossip
+        handlers read the cached copies lock-free; they are an intake
+        filter at worst one height stale — the authoritative checks
+        (_proposal_acceptable, certificate verification) always read
+        live state under the lock."""
+        pubkeys = self.vnode.known_pubkeys()
+        powers = self._powers()
+        rotation = sorted(
+            op for op in powers if op in pubkeys
+        )
+        if rotation:
+            self.rotation = rotation
+        self._pubkey_cache = pubkeys
+
     def proposer_for(self, height: int, round_: int) -> bytes:
         return self.rotation[(height + round_) % len(self.rotation)]
 
@@ -341,13 +364,21 @@ class ConsensusReactor:
         app = self.vnode.app
         if prop.height != height or prop.block.header.height != height:
             return False
+        # the envelope must come from the rotation proposer for its
+        # (height, round): without this, any registered validator could
+        # re-wrap a legitimately certified block in its OWN envelope with
+        # different last_cert/evidence via commit gossip, and nodes
+        # applying different envelopes would diverge on absence/slash sets
+        if prop.proposer != self.proposer_for(prop.height, prop.round):
+            return False
         if prop.block.header.last_block_hash != app.last_block_hash:
             return False
         if len(prop.evidence) > len(self.rotation):
             return False  # at most one double-sign per validator
+        known = self.vnode.known_pubkeys()
         accused: set[bytes] = set()
         for ev in prop.evidence:
-            pub = self.vnode.validator_pubkeys.get(ev.vote_a.validator)
+            pub = known.get(ev.vote_a.validator)
             if pub is None or not ev.verify(app.chain_id, pub):
                 return False
             if not 0 < ev.height <= height:
@@ -372,7 +403,7 @@ class ConsensusReactor:
             powers = self._last_powers[1]
         else:
             powers = self._powers()
-        return lc.verify(app.chain_id, self.vnode.validator_pubkeys,
+        return lc.verify(app.chain_id, known,
                          sum(powers.values()), powers)
 
     # -- the state machine ----------------------------------------------
@@ -412,7 +443,7 @@ class ConsensusReactor:
                     continue
                 if cert.block_hash != prop.block.header.hash():
                     continue
-                pub = self.vnode.validator_pubkeys.get(prop.proposer)
+                pub = self.vnode.known_pubkeys().get(prop.proposer)
                 if pub is None or not prop.verify(app.chain_id, pub):
                     continue
                 if not self._proposal_acceptable(prop, height):
@@ -429,6 +460,7 @@ class ConsensusReactor:
                                      evidence=prop.evidence,
                                      absent_cert=prop.last_cert)
                 self.vnode.clear_lock()
+                self._refresh_valset()
                 self.app_hashes[height] = h.hex()
                 self._remember_commit(doc, height)
                 applied = True
@@ -536,6 +568,7 @@ class ConsensusReactor:
             chunks = [base64.b64decode(ch) for ch in doc["chunks"]]
             with self.service_lock:
                 c.state_sync_bootstrap(self.vnode, doc["manifest"], chunks)
+                self._refresh_valset()  # the synced state may carry new validators
             return True
         except (urllib.error.URLError, OSError, ValueError, KeyError):
             return False
@@ -565,7 +598,7 @@ class ConsensusReactor:
             with self.service_lock:
                 evidence = tuple(c.detect_equivocation(
                     self.vnode.app.chain_id, pool,
-                    self.vnode.validator_pubkeys,
+                    self.vnode.known_pubkeys(),
                 ))
                 block = self.vnode.propose(t=time.time())
             digest = c.Proposal.commit_info_digest(my_last_cert, evidence)
@@ -628,13 +661,25 @@ class ConsensusReactor:
 
         # ---- precommit ----
         self.step = "precommit"
-        if (polka_hash is not None and prop is not None
-                and prop.block.header.hash() == polka_hash):
-            with self.service_lock:
+        with self.service_lock:
+            locked = self.vnode.locked_block
+            lock_ok = (locked is None
+                       or locked.header.hash() == polka_hash)
+            # While locked on a different block, precommit NIL even on a
+            # fresh polka: our votes carry no round number, so a second
+            # non-nil precommit for a different hash at this height would
+            # be indistinguishable from a double-sign — peers would
+            # generate VALID slashing evidence against an honest node.
+            # Safety over liveness for this one validator: it abstains
+            # until the network commits (adopted via gossip, which clears
+            # the lock) — Tendermint's unlock-on-higher-polka needs
+            # round-scoped votes this wire format deliberately lacks.
+            if (polka_hash is not None and prop is not None
+                    and prop.block.header.hash() == polka_hash
+                    and lock_ok):
                 self.vnode.on_polka(prop.block, r)
                 pc = self.vnode.precommit_on(prop.block)
-        else:
-            with self.service_lock:
+            else:
                 pc = self.vnode.precommit_on(None)
         self.on_vote({"round": r, "vote": c.vote_to_json(pc)})
         self._gossip("/gossip/vote",
@@ -698,6 +743,7 @@ class ConsensusReactor:
             ah = self.vnode.apply(prop.block, cert, evidence=prop.evidence,
                                   absent_cert=prop.last_cert)
             self.vnode.clear_lock()
+            self._refresh_valset()
             self.app_hashes[height] = ah.hex()
         self._remember_commit(doc, height)
         self._gossip("/gossip/commit", doc)

@@ -117,18 +117,24 @@ class StakingKeeper:
         _put(ctx, self.VAL + operator, v)
 
     def create_validator(
-        self, ctx: Context, operator: bytes, self_stake: int
+        self, ctx: Context, operator: bytes, self_stake: int,
+        pubkey: bytes = b"",
     ) -> None:
-        """MsgCreateValidator: operator self-delegates `self_stake` utia."""
+        """MsgCreateValidator: operator self-delegates `self_stake` utia.
+
+        `pubkey` (optional, 33-byte compressed consensus key — the
+        reference MsgCreateValidator's Pubkey field) is recorded in the
+        validator record so consensus can verify this validator's votes
+        and include it in proposer rotation without it being in genesis
+        (ValidatorNode.known_pubkeys / chain/reactor.py)."""
         if self.validator(ctx, operator) is not None:
             raise ValueError("validator already exists")
         if self_stake <= 0:
             raise ValueError("self stake must be positive")
-        self._set_val(
-            ctx,
-            operator,
-            {"tokens": 0, "shares": 0, "jailed": False, "bonded": True},
-        )
+        rec = {"tokens": 0, "shares": 0, "jailed": False, "bonded": True}
+        if pubkey:
+            rec["pubkey"] = pubkey.hex()
+        self._set_val(ctx, operator, rec)
         for h in self.hooks:
             fn = getattr(h, "after_validator_created", None)
             if fn is not None:
@@ -180,6 +186,20 @@ class StakingKeeper:
             p = self.validator_power(ctx, op)
             if p > 0:
                 out.append((op, p))
+        return out
+
+    def consensus_pubkeys(self, ctx: Context) -> dict[bytes, bytes]:
+        """operator -> consensus pubkey for every validator that registered
+        one on-chain (MsgCreateValidator.pubkey). Genesis validators'
+        pubkeys ride the genesis doc instead; consumers merge both
+        (ValidatorNode.known_pubkeys)."""
+        import json as json_mod
+
+        out: dict[bytes, bytes] = {}
+        for k, raw in ctx.store.iterate_prefix(self.VAL):
+            v = json_mod.loads(raw)  # iterate yields the stored value —
+            if v.get("pubkey"):      # no second keyed lookup per record
+                out[k[len(self.VAL):]] = bytes.fromhex(v["pubkey"])
         return out
 
     # -- delegations ----------------------------------------------------

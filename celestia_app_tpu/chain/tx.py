@@ -240,19 +240,32 @@ class MsgBeginRedelegate:
 
 @dataclasses.dataclass(frozen=True)
 class MsgCreateValidator:
-    """x/staking MsgCreateValidator (operator key = account key here)."""
+    """x/staking MsgCreateValidator (operator key = account key here).
+
+    `pubkey` is the optional consensus public key (33-byte compressed),
+    the reference's Pubkey field on MsgCreateValidator — registering it
+    on-chain is what lets a runtime-created validator's votes verify and
+    its address join the proposer rotation (chain/reactor.py). Encoded
+    only when present, so pre-existing tx bytes (and their app-hash pins)
+    are unchanged."""
 
     TYPE = "staking/MsgCreateValidator"
     operator: bytes
     self_stake: int
+    pubkey: bytes = b""
 
     def encode(self) -> bytes:
-        return _b(self.operator) + uvarint(self.self_stake)
+        out = _b(self.operator) + uvarint(self.self_stake)
+        if self.pubkey:
+            out += _b(self.pubkey)
+        return out
 
     @classmethod
     def decode(cls, raw: bytes) -> "MsgCreateValidator":
         r = _Reader(raw)
-        return cls(r.b(), r.u())
+        op, stake = r.b(), r.u()
+        pubkey = b"" if r.done() else r.b()
+        return cls(op, stake, pubkey)
 
 
 @dataclasses.dataclass(frozen=True)

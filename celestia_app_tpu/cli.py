@@ -539,6 +539,10 @@ def cmd_tx(args) -> int:
     if args.action == "send" and (args.to is None or args.amount is None):
         print("tx send requires --to and --amount", file=sys.stderr)
         return 2
+    if args.action == "create-validator" and args.amount is None:
+        print("tx create-validator requires --amount (self-stake, utia)",
+              file=sys.stderr)
+        return 2
     if args.action == "pay-for-blob" and args.input_file is None and (
         args.namespace is None or args.data is None
     ):
@@ -560,6 +564,13 @@ def cmd_tx(args) -> int:
     if args.action == "send":
         height, res = client.submit_send(
             addr, bytes.fromhex(args.to), int(args.amount)
+        )
+    elif args.action == "create-validator":
+        # stake in with the signer's own consensus pubkey registered
+        # on-chain, so a running autonomous network adopts this address
+        # into rotation (chain/reactor.py valset-update flow)
+        height, res = client.submit_create_validator(
+            addr, int(args.amount), priv.public_key().compressed
         )
     else:  # pay-for-blob
         if args.input_file is not None:
@@ -1498,7 +1509,8 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_query)
 
     p = sub.add_parser("tx")
-    p.add_argument("action", choices=["send", "pay-for-blob"])
+    p.add_argument("action",
+                   choices=["send", "pay-for-blob", "create-validator"])
     p.add_argument("--home", required=True)
     p.add_argument("--from-seed", required=True,
                    help="key seed (matches `keys derive`)")

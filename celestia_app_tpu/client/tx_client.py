@@ -442,31 +442,24 @@ class TxClient:
             return max(fee + 1, int(gas * floor) + 1)
         return None
 
-    def submit_pay_for_blob(self, addr: bytes, blobs: list[Blob]):
-        """Estimate gas (simulate, falling back to the linear model), sign,
-        broadcast, confirm; resubmit once on a sequence mismatch or an
-        insufficient gas price (tx_client.go:357 + app/errors parsing).
-        Blob commitments — the dominant client-side hashing cost — are
-        computed exactly once."""
-        pfb_msg = self.signer.build_pfb_msg(addr, blobs)
-        gas = self.estimate_gas(addr, [], blobs, pfb_msg=pfb_msg)
-        fee = max(1, int(gas * self._gas_price()) + 1)
-
-        # 3 attempts: the two recoverable classes (stale sequence, price
-        # below floor) can BOTH occur on one tx, each burning one attempt
+    def _broadcast_with_retry(self, addr: bytes, make_raw, gas: int,
+                              fee: int):
+        """THE submit loop every submit_* goes through. 3 attempts: the two
+        recoverable rejection classes (stale sequence, price below floor —
+        tx_client.go:357 + app/errors parsing) can BOTH occur on one tx,
+        each burning one attempt. `make_raw(fee)` re-signs with the
+        current fee/sequence. On acceptance, bumps the cached sequence and
+        confirms — the in-process Node drives blocks to commit and returns
+        (height, TxResult); remote transports POLL the server's block
+        production and return the tx-by-hash dict (check ['found'])."""
         for _attempt in range(3):
-            raw = self.signer.create_pay_for_blobs(
-                addr, blobs, fee=fee, gas_limit=gas, msg=pfb_msg
-            )
+            raw = make_raw(fee)
             res = self.node.broadcast_tx(raw)
             if res.code == 0:
                 self.signer.accounts[addr].sequence += 1
-                # in-process Node drives blocks to commit and returns
-                # (height, TxResult); the remote transport POLLS the
-                # server's block production and returns the tx-by-hash
-                # dict — check ['found'] before treating it as committed
                 if isinstance(self.node, (HttpNodeClient, GrpcNodeClient)):
-                    return self.node.confirm_tx(raw, attempts=10, interval=1.0)
+                    return self.node.confirm_tx(raw, attempts=10,
+                                                interval=1.0)
                 return self.node.confirm_tx(raw)
             new_fee = self._recover_broadcast_failure(addr, res, gas, fee)
             if new_fee is None:
@@ -474,19 +467,46 @@ class TxClient:
             fee = new_fee
         raise RuntimeError(f"resubmission failed; last rejection: {res.log}")
 
+    def submit_pay_for_blob(self, addr: bytes, blobs: list[Blob]):
+        """Estimate gas (simulate, falling back to the linear model), sign,
+        broadcast, confirm. Blob commitments — the dominant client-side
+        hashing cost — are computed exactly once."""
+        pfb_msg = self.signer.build_pfb_msg(addr, blobs)
+        gas = self.estimate_gas(addr, [], blobs, pfb_msg=pfb_msg)
+        fee = max(1, int(gas * self._gas_price()) + 1)
+        return self._broadcast_with_retry(
+            addr,
+            lambda f: self.signer.create_pay_for_blobs(
+                addr, blobs, fee=f, gas_limit=gas, msg=pfb_msg
+            ),
+            gas, fee,
+        )
+
+    def submit_create_validator(self, addr: bytes, self_stake: int,
+                                pubkey: bytes = b""):
+        """MsgCreateValidator with the consensus pubkey registered on-chain
+        (the reference tx staking create-validator; pubkey is what lets
+        the new validator's votes verify — chain/reactor.py)."""
+        from celestia_app_tpu.chain.tx import MsgCreateValidator
+
+        gas = 200_000
+        fee = max(1, int(gas * self._gas_price()) + 1)
+        return self._broadcast_with_retry(
+            addr,
+            lambda f: self.signer.create_tx(
+                addr, [MsgCreateValidator(addr, self_stake, pubkey)],
+                fee=f, gas_limit=gas,
+            ).encode(),
+            gas, fee,
+        )
+
     def submit_send(self, addr: bytes, to: bytes, amount: int):
         gas = 100_000
         fee = max(1, int(gas * self._gas_price()) + 1)
-        for _attempt in range(3):  # see submit_pay_for_blob's budget note
-            tx = self.signer.create_tx(
-                addr, [MsgSend(addr, to, amount)], fee=fee, gas_limit=gas
-            )
-            res = self.node.broadcast_tx(tx.encode())
-            if res.code == 0:
-                self.signer.accounts[addr].sequence += 1
-                return self.node.confirm_tx(tx.encode())
-            new_fee = self._recover_broadcast_failure(addr, res, gas, fee)
-            if new_fee is None:
-                raise RuntimeError(f"broadcast failed: {res.log}")
-            fee = new_fee
-        raise RuntimeError(f"resubmission failed; last rejection: {res.log}")
+        return self._broadcast_with_retry(
+            addr,
+            lambda f: self.signer.create_tx(
+                addr, [MsgSend(addr, to, amount)], fee=f, gas_limit=gas
+            ).encode(),
+            gas, fee,
+        )

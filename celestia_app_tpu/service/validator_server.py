@@ -215,6 +215,7 @@ class ValidatorService:
         ctx = Context(v.app.store, InfiniteGasMeter(), v.app.height, 0,
                       v.app.chain_id, v.app.app_version)
         powers = dict(v.app.staking.validators(ctx))
+        known = v.known_pubkeys()
         signed = 0
         seen: set[bytes] = set()
         doc = c.Vote.sign_bytes(v.app.chain_id, block.header.height, bh,
@@ -223,7 +224,7 @@ class ValidatorService:
             if (pv.block_hash != bh or pv.phase != "prevote"
                     or pv.validator in seen):
                 continue
-            pub = v.validator_pubkeys.get(pv.validator)
+            pub = known.get(pv.validator)
             if pub is None or not PublicKey(pub).verify(pv.signature, doc):
                 continue
             seen.add(pv.validator)

@@ -177,19 +177,37 @@ def _dec_redelegate(raw: bytes) -> itx.MsgBeginRedelegate:
     )
 
 
+_SECP256K1_PUBKEY_URL = "/cosmos.crypto.secp256k1.PubKey"
+
+
 def _enc_create_validator(m: itx.MsgCreateValidator) -> bytes:
     # subset of cosmos.staking.v1beta1.MsgCreateValidator: the internal model
-    # has no description/commission/pubkey split — operator key == account key
-    return (
-        field_string(5, bech32.encode(m.operator, bech32.HRP_VALOPER))
-        + field_message(7, coin_pb(BOND_DENOM, m.self_stake))
-    )
+    # has no description/commission split — operator key == account key.
+    # Field 6 is the consensus pubkey as google.protobuf.Any wrapping
+    # cosmos.crypto.secp256k1.PubKey{key=1}, the reference's Pubkey field
+    # (what lets a runtime validator's votes verify — chain/reactor.py).
+    out = field_string(5, bech32.encode(m.operator, bech32.HRP_VALOPER))
+    if m.pubkey:  # ascending field order, as the canonical runtime emits
+        any_pb = (
+            field_string(1, _SECP256K1_PUBKEY_URL)
+            + field_message(2, field_bytes(1, m.pubkey))
+        )
+        out += field_message(6, any_pb)
+    out += field_message(7, coin_pb(BOND_DENOM, m.self_stake))
+    return out
 
 
 def _dec_create_validator(raw: bytes) -> itx.MsgCreateValidator:
     f = Fields(raw)
     _, stake = parse_coin(f.get_bytes(7)) if f.has(7) else (BOND_DENOM, 0)
-    return itx.MsgCreateValidator(_addr_bytes(f.get_string(5)), stake)
+    pubkey = b""
+    if f.has(6):
+        any_f = Fields(f.get_bytes(6))
+        if any_f.get_string(1) == _SECP256K1_PUBKEY_URL:
+            pubkey = Fields(any_f.get_bytes(2)).get_bytes(1)
+    return itx.MsgCreateValidator(
+        _addr_bytes(f.get_string(5)), stake, pubkey
+    )
 
 
 _VOTE_OPTIONS = {"yes": 1, "abstain": 2, "no": 3, "veto": 4}

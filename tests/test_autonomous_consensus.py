@@ -169,6 +169,121 @@ def test_autonomous_heights_commit_identically(net4):
         assert v.app.bank.balance(ctx, a1) > 10**12
 
 
+def test_validator_joins_at_runtime():
+    """Dynamic validator set: an account stakes in via MsgCreateValidator
+    (with its consensus pubkey), the running network adopts it into the
+    proposer rotation at the next commit, and the new validator's node —
+    started afterwards — catches up and PROPOSES blocks. Tendermint's
+    valset-update flow, no restart anywhere."""
+    import urllib.request
+    import base64
+    import json as json_mod
+
+    from celestia_app_tpu.chain.staking import POWER_REDUCTION
+    from celestia_app_tpu.chain.tx import MsgCreateValidator
+    from celestia_app_tpu.service.validator_server import ValidatorService
+    from celestia_app_tpu.chain.reactor import ReactorConfig
+
+    privs = [PrivateKey.from_seed(f"join-{i}".encode()) for i in range(5)]
+    genesis = {
+        "time_unix": 1_700_000_000.0,
+        "accounts": [
+            {"address": p.public_key().address().hex(), "balance": 10**12}
+            for p in privs
+        ],
+        "validators": [  # only the first four start as validators
+            {
+                "operator": p.public_key().address().hex(),
+                "power": 10,
+                "pubkey": p.public_key().compressed.hex(),
+            }
+            for p in privs[:4]
+        ],
+    }
+    nodes = [
+        c.ValidatorNode(f"val{i}", p, genesis, CHAIN)
+        for i, p in enumerate(privs)
+    ]
+    services = [ValidatorService(v) for v in nodes]
+    for s in services:
+        s.serve_background()
+    urls = [f"http://127.0.0.1:{s.port}" for s in services]
+    try:
+        for i in range(4):
+            services[i].attach_reactor(
+                [u for j, u in enumerate(urls) if j != i],
+                ReactorConfig(**FAST),
+            )
+        deadline = time.monotonic() + 60
+        while (time.monotonic() < deadline
+               and min(n.app.height for n in nodes[:4]) < 2):
+            time.sleep(0.05)
+        assert min(n.app.height for n in nodes[:4]) >= 2
+
+        # account 4 stakes in, registering its consensus pubkey on-chain
+        signer = Signer(CHAIN)
+        signer.add_account(privs[4], number=4)
+        a4 = privs[4].public_key().address()
+        tx = signer.create_tx(
+            a4,
+            [MsgCreateValidator(a4, 10 * POWER_REDUCTION,
+                                privs[4].public_key().compressed)],
+            fee=2000, gas_limit=200_000,
+        )
+        req = urllib.request.Request(
+            urls[0] + "/broadcast_tx",
+            data=json_mod.dumps(
+                {"tx": base64.b64encode(tx.encode()).decode()}
+            ).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json_mod.loads(r.read())["code"] == 0
+        base = nodes[0].app.height
+        deadline = time.monotonic() + 60
+        while (time.monotonic() < deadline
+               and min(n.app.height for n in nodes[:4]) < base + 2):
+            time.sleep(0.05)
+
+        # the staked-in validator's own node comes up, catches up from
+        # peers, and must eventually PROPOSE a committed block
+        services[4].attach_reactor(
+            [u for j, u in enumerate(urls) if j != 4],
+            ReactorConfig(**FAST),
+        )
+        deadline = time.monotonic() + 120
+        proposed = False
+        while time.monotonic() < deadline and not proposed:
+            for s in services:
+                if s.reactor is None:
+                    continue
+                with s.reactor._msg_lock:
+                    docs = list(s.reactor._recent.values())
+                for doc in docs:
+                    if doc["proposal"]["proposer"] == a4.hex():
+                        proposed = True
+            time.sleep(0.2)
+        assert proposed, (
+            f"runtime validator never proposed; heights "
+            f"{[n.app.height for n in nodes]}"
+        )
+
+        # and no divergence anywhere
+        hs: dict[int, set] = {}
+        for s in services:
+            if s.reactor is None:
+                continue
+            for h, v in s.reactor.app_hashes.items():
+                hs.setdefault(h, set()).add(v)
+        assert all(len(v) == 1 for v in hs.values()), hs
+    finally:
+        for s in services:
+            try:
+                s.shutdown()
+            except Exception:
+                pass
+
+
 @pytest.mark.slow
 def test_dead_proposer_rotates_round(net4):
     """Kill one validator (reactor + server): the remaining 3/4 power is
