@@ -335,10 +335,18 @@ class App:
             except Exception:
                 if self.engine == "device":
                     raise
-        from celestia_app_tpu.utils import refimpl
+        # host path: the BLAS+hashlib pipeline (utils/fast_host), bit-equal
+        # to the device path and the refimpl oracle (tests/test_fast_host)
+        # but ~100x faster than the oracle — a validator process on the
+        # host engine must keep big-blob blocks inside the propose window
+        from celestia_app_tpu.utils import fast_host
 
-        _, rows, cols, root = refimpl.pipeline_host(ods)
-        return rows, cols, root
+        _, rows, cols, root = fast_host.pipeline_fast(ods)
+        return (
+            [bytes(r) for r in rows],
+            [bytes(c) for c in cols],
+            bytes(root),
+        )
 
     def _data_root(self, square: square_mod.Square) -> tuple[dah_mod.DataAvailabilityHeader, bytes]:
         ods = dah_mod.shares_to_ods(square.share_bytes())
@@ -615,7 +623,8 @@ class App:
             elif seen_blob_scan:
                 # cheap reject before paying the device commitment batch
                 raise ValueError("normal tx after blob tx (ordering violation)")
-        all_commitments = batch_commitments(all_blobs, threshold)
+        all_commitments = batch_commitments(all_blobs, threshold,
+                                            engine=self.engine)
         cursor = 0
         for i, raw in enumerate(block.txs):
             if i in parsed:

@@ -19,13 +19,24 @@ class BlobTxError(Exception):
     pass
 
 
-def batch_commitments(blobs: list, subtree_root_threshold: int) -> list[bytes]:
+def batch_commitments(blobs: list, subtree_root_threshold: int,
+                      engine: str = "auto") -> list[bytes]:
     """Commitments for many blobs at once: device-batched when the workload
-    is big enough to amortize a dispatch (BASELINE config 3), host otherwise."""
-    if len(blobs) >= 4:
-        from celestia_app_tpu.da import commitment_device
+    is big enough to amortize a dispatch (BASELINE config 3), host
+    otherwise. `engine` is the owning App's compute engine — a host-engine
+    validator must NEVER touch the jax backend here: with the accelerator
+    relay down, backend init does not fail, it HANGS, wedging consensus
+    the first time a block carries >= 4 blobs."""
+    if engine in ("device", "auto") and len(blobs) >= 4:
+        try:
+            from celestia_app_tpu.da import commitment_device
 
-        return commitment_device.commitments_device(blobs, subtree_root_threshold)
+            return commitment_device.commitments_device(
+                blobs, subtree_root_threshold
+            )
+        except Exception:
+            if engine == "device":
+                raise
     return commitment_mod.create_commitments(blobs, subtree_root_threshold)
 
 

@@ -448,12 +448,16 @@ class ValidatorNode:
         out.update(self.validator_pubkeys)
         return out
 
-    def verify_certificate(self, cert: CommitCertificate) -> bool:
+    def verify_certificate(self, cert: CommitCertificate,
+                           pubkeys: dict[bytes, bytes] | None = None) -> bool:
         """Check a certificate against THIS node's own trust roots — the
         genesis + on-chain-registered pubkeys and the staking-state powers
         — before applying a block a remote orchestrator hands over (the
-        socket commit path must not trust the coordinator)."""
-        pubkeys = self.known_pubkeys()
+        socket commit path must not trust the coordinator). `pubkeys`
+        optionally supplies a precomputed known_pubkeys() map so hot
+        callers avoid repeated staking-store scans."""
+        if pubkeys is None:
+            pubkeys = self.known_pubkeys()
         if not pubkeys:
             return False
         ctx = Context(

@@ -75,10 +75,42 @@ class QueryRouter:
             return self._prover_cache[height]
         block, square = self._rebuild_square(height)
         ods = dah_mod.shares_to_ods(square.share_bytes())
-        d, eds_obj, root = dah_mod.new_dah_from_ods(ods)
+        if getattr(self.app, "engine", "auto") == "host":
+            # host-engine validators must not touch the jax backend even
+            # for queries (a down accelerator relay HANGS backend init,
+            # wedging the HTTP handler mid-service-lock); the host NMT
+            # levels are bit-identical (tests/test_fast_host.py)
+            import numpy as np
+
+            from celestia_app_tpu.utils import fast_host, merkle_host
+
+            eds_np = fast_host.extend_square_fast(ods)
+            k = eds_np.shape[0] // 2
+            # row levels hashed ONCE: the prover consumes all of them and
+            # the row roots are just the last level
+            levels = fast_host.nmt_levels_fast(
+                fast_host._axis_leaf_ns(eds_np, k), eds_np
+            )
+            lm, lx, lv = levels[-1]
+            rows = np.concatenate([lm[:, 0], lx[:, 0], lv[:, 0]], axis=1)
+            eds_t = np.swapaxes(eds_np, 0, 1)
+            cols = fast_host.nmt_roots_fast(
+                fast_host._axis_leaf_ns(eds_t, k), eds_t
+            )
+            root = merkle_host.hash_from_leaves(
+                [bytes(r) for r in rows] + [bytes(c) for c in cols]
+            )
+            d = dah_mod.DataAvailabilityHeader(
+                tuple(bytes(r) for r in rows),
+                tuple(bytes(c) for c in cols),
+            )
+            eds_obj = dah_mod.ExtendedDataSquare(eds_np)
+        else:
+            d, eds_obj, root = dah_mod.new_dah_from_ods(ods)
+            levels = None
         if root != block.header.data_hash:
             raise QueryError("recomputed data root mismatches stored header")
-        prover = proof_device.BlockProver(eds_obj, d)
+        prover = proof_device.BlockProver(eds_obj, d, levels=levels)
         entry = (block, square, prover, root)
         self._prover_cache.clear()  # keep at most one height resident
         self._prover_cache[height] = entry

@@ -50,6 +50,7 @@ import urllib.request
 
 from celestia_app_tpu.chain import consensus as c
 from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+from celestia_app_tpu.utils import telemetry
 
 
 @dataclasses.dataclass
@@ -68,6 +69,10 @@ class ReactorConfig:
     gossip_timeout: float = 5.0  # per-peer HTTP send timeout
     recent_commits: int = 8  # commit records served to laggards
     sync_grace: float = 5.0  # how long "peer ahead" persists before sync
+    # artificial per-message send latency (seconds): software-level network
+    # condition injection, the role BitTwister plays in the reference's e2e
+    # benchmarks (test/e2e/benchmark/benchmark.go:110-117 injects 70 ms)
+    gossip_delay: float = 0.0
 
 
 class ConsensusReactor:
@@ -166,6 +171,8 @@ class ConsensusReactor:
                         item = qq.get(timeout=1.0)
                     except Exception:
                         continue
+                    if self.cfg.gossip_delay > 0:  # injected latency
+                        time.sleep(self.cfg.gossip_delay)
                     try:
                         self._post(u, *item)
                     except (urllib.error.URLError, OSError, ValueError):
@@ -187,6 +194,7 @@ class ConsensusReactor:
             return
         with self._msg_lock:
             self._proposals.setdefault((prop.height, prop.round), prop)
+        telemetry.incr("reactor.gossip.proposals")
         self._note_height(prop.height)
 
     def on_vote(self, doc: dict) -> None:
@@ -211,6 +219,7 @@ class ConsensusReactor:
             if (fresh and vote.phase == "precommit"
                     and vote.block_hash is not None):
                 self._vote_pool.append(vote)
+        telemetry.incr("reactor.gossip.votes")
         self._note_height(vote.height)
 
     def on_commit(self, doc: dict, peer: str = "") -> None:
@@ -321,6 +330,36 @@ class ConsensusReactor:
     def _timeout(self, base: float) -> float:
         return base + self.round * self.cfg.timeout_delta
 
+    def _trace_block(self, block, round_: int) -> None:
+        """BlockSummary trace row (pkg/trace's per-block table): the
+        figures the reference's e2e benchmark harness scrapes to compute
+        throughput (CheckResults pulls PullBlockSummaryTraces)."""
+        try:
+            self.vnode.app.traces.write(
+                "block_summary",
+                height=block.header.height,
+                round=round_,
+                txs=len(block.txs),
+                block_bytes=sum(len(t) for t in block.txs),
+                square_size=block.header.square_size,
+                time_unix=block.header.time_unix,
+            )
+        except Exception:
+            pass
+
+    def _trace_round(self, height: int, round_: int, step: str,
+                     t0: float) -> None:
+        """RoundState trace row (the celestia-core pkg/trace columnar
+        table, SURVEY §5.1) into this validator's own trace plane —
+        served at /trace/round_state by the node HTTP service."""
+        try:
+            self.vnode.app.traces.write(
+                "round_state", height=height, round=round_, step=step,
+                elapsed_ms=round((time.monotonic() - t0) * 1e3, 3),
+            )
+        except Exception:
+            pass  # observability must never kill consensus
+
     def _wait(self, deadline: float, check):
         """Poll `check` (under _msg_lock) until non-None or deadline."""
         while not self._stop.is_set():
@@ -352,7 +391,8 @@ class ConsensusReactor:
 
     # -- proposal validity ----------------------------------------------
 
-    def _proposal_acceptable(self, prop: c.Proposal, height: int) -> bool:
+    def _proposal_acceptable(self, prop: c.Proposal, height: int,
+                             known: dict[bytes, bytes] | None = None) -> bool:
         """Stateful checks beyond the signature (which on_proposal did):
         the block chains from OUR committed tip, the embedded last-commit
         certificate is real for height-1 (the absences every node will
@@ -375,7 +415,8 @@ class ConsensusReactor:
             return False
         if len(prop.evidence) > len(self.rotation):
             return False  # at most one double-sign per validator
-        known = self.vnode.known_pubkeys()
+        if known is None:
+            known = self.vnode.known_pubkeys()
         accused: set[bytes] = set()
         for ev in prop.evidence:
             pub = known.get(ev.vote_a.validator)
@@ -443,12 +484,13 @@ class ConsensusReactor:
                     continue
                 if cert.block_hash != prop.block.header.hash():
                     continue
-                pub = self.vnode.known_pubkeys().get(prop.proposer)
+                known = self.vnode.known_pubkeys()  # ONE staking scan
+                pub = known.get(prop.proposer)
                 if pub is None or not prop.verify(app.chain_id, pub):
                     continue
-                if not self._proposal_acceptable(prop, height):
+                if not self._proposal_acceptable(prop, height, known=known):
                     continue
-                if not self.vnode.verify_certificate(cert):
+                if not self.vnode.verify_certificate(cert, pubkeys=known):
                     continue
                 if not app.process_proposal(prop.block):
                     print(f"[reactor {self.vnode.name}] REFUSING certified "
@@ -462,6 +504,8 @@ class ConsensusReactor:
                 self.vnode.clear_lock()
                 self._refresh_valset()
                 self.app_hashes[height] = h.hex()
+                self._trace_block(prop.block, prop.round)
+                telemetry.incr("reactor.commits_adopted")
                 self._remember_commit(doc, height)
                 applied = True
         return applied
@@ -585,6 +629,7 @@ class ConsensusReactor:
             my_last_cert = self.vnode.certificates.get(height - 1)
         self.height_view = height
         r = self.round
+        _t_round = time.monotonic()
 
         # ---- propose ----
         self.step = "propose"
@@ -616,6 +661,7 @@ class ConsensusReactor:
         prop = self._wait(
             deadline, lambda: self._proposals.get((height, r))
         )
+        self._trace_round(height, r, "propose", _t_round)
 
         # ---- prevote ----
         self.step = "prevote"
@@ -658,6 +704,7 @@ class ConsensusReactor:
         polka = self._wait(deadline, polka_check)
         polka_hash = polka if isinstance(polka, bytes) and polka != b"nil" \
             else None
+        self._trace_round(height, r, "prevote", _t_round)
 
         # ---- precommit ----
         self.step = "precommit"
@@ -728,6 +775,8 @@ class ConsensusReactor:
             self._probe_peer_heights()
             self.round = r + 1
             self.step = "round-failed"
+            self._trace_round(height, r, "round-failed", _t_round)
+            telemetry.incr("reactor.round_failures")
             self._prune(self.vnode.app.height + 1)
             return False
 
@@ -745,6 +794,9 @@ class ConsensusReactor:
             self.vnode.clear_lock()
             self._refresh_valset()
             self.app_hashes[height] = ah.hex()
+        self._trace_round(height, r, "commit", _t_round)
+        self._trace_block(prop.block, r)
+        telemetry.incr("reactor.commits")
         self._remember_commit(doc, height)
         self._gossip("/gossip/commit", doc)
         self._prune(height + 1)

@@ -657,6 +657,15 @@ def _check_legacy_validator_home(home: str) -> str | None:
     return None
 
 
+def cmd_e2e_bench(args) -> int:
+    """Throughput benchmark on the autonomous process devnet — see
+    tools/e2e_bench.py (the test/e2e/benchmark/throughput.go analog)."""
+    from celestia_app_tpu.tools import e2e_bench
+
+    return e2e_bench.run(args, _spawn_validator_processes,
+                         _terminate_processes)
+
+
 def cmd_validator_serve(args) -> int:
     """One validator as its own OS process (the reference's one-binary-per-
     validator deployment): loads key + genesis from --home, resumes durable
@@ -780,9 +789,9 @@ def _spawn_validator_processes(args, genesis, extra_flags=(),
         for i in range(args.validators):
             home = os.path.join(args.home, f"val{i}")
             os.makedirs(home, exist_ok=True)
-            # fail fast and VISIBLY here: the spawned validator's stderr
-            # is devnulled, so its own refusal would surface only as a
-            # 50s "never came up" timeout
+            # fail fast here too: the spawned validator's own refusal
+            # would otherwise surface only as a 50s "never came up"
+            # timeout (its output goes to <home>/validator.log)
             err = _check_legacy_validator_home(home)
             if err is not None:
                 raise RuntimeError(err)
@@ -798,12 +807,17 @@ def _spawn_validator_processes(args, genesis, extra_flags=(),
                 sp = os.path.join(home, stale)
                 if os.path.exists(sp):
                     os.unlink(sp)
+            # per-validator log file (the reference's --log-to-file): a
+            # devnulled validator would hide reactor errors exactly when
+            # a devnet misbehaves
+            log_f = open(os.path.join(home, "validator.log"), "a")
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "celestia_app_tpu",
                  "validator-serve", "--home", home,
                  "--chain-id", args.chain_id, *extra_flags],
-                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                stdout=log_f, stderr=subprocess.STDOUT,
             ))
+            log_f.close()  # the child holds its own fd now
             homes.append(home)
 
         for i, home in enumerate(homes):
@@ -1539,6 +1553,31 @@ def main(argv=None) -> int:
                         "runs its own consensus reactor and gossips "
                         "proposals/votes/txs peer-to-peer")
     p.set_defaults(fn=cmd_devnet)
+
+    p = sub.add_parser(
+        "e2e-bench",
+        help="throughput benchmark over the autonomous process devnet "
+             "(the reference test/e2e/benchmark analog: PFB flood, "
+             "injected gossip latency, BlockSummary scrape, >=90%%-of-"
+             "target pass criterion)")
+    p.add_argument("--home", required=True)
+    p.add_argument("--chain-id", default="celestia-e2e-bench")
+    p.add_argument("--validators", type=int, default=2)
+    p.add_argument("--blocks", type=int, default=8)
+    p.add_argument("--block-time", type=float, default=1.0)
+    p.add_argument("--blob-kb", type=int, default=200,
+                   help="per-blob size (reference floods 200 KB blobs)")
+    p.add_argument("--blobs-per-tx", type=int, default=2)
+    p.add_argument("--txs-per-block", type=int, default=8,
+                   help="load pacing: PFBs submitted per committed height "
+                        "(txsim's per-sequence-per-block pacing)")
+    p.add_argument("--latency-ms", type=float, default=70.0,
+                   help="injected per-message gossip latency "
+                        "(BitTwister's 70 ms in the reference manifests)")
+    p.add_argument("--target-mb", type=float, default=1.0,
+                   help="pass if some block >= 90%% of this "
+                        "(TwoNodeSimple criterion: 1 MB)")
+    p.set_defaults(fn=cmd_e2e_bench)
 
     p = sub.add_parser("validator-serve",
                        help="one validator process: HTTP consensus service")

@@ -50,12 +50,16 @@ def _jitted_row_levels(k: int):
 class BlockProver:
     """Per-block proof factory: one device pass, then index-only proofs."""
 
-    def __init__(self, eds: ExtendedDataSquare, dah: DataAvailabilityHeader):
+    def __init__(self, eds: ExtendedDataSquare, dah: DataAvailabilityHeader,
+                 levels=None):
         self.eds = eds
         self.dah = dah
         self.k = eds.width // 2
-        levels = _jitted_row_levels(self.k)(jnp.asarray(eds.squares))
-        # [(mins, maxs, vs)] with node counts 2k, k, ..., 1 per row tree
+        if levels is None:
+            levels = _jitted_row_levels(self.k)(jnp.asarray(eds.squares))
+        # [(mins, maxs, vs)] with node counts 2k, k, ..., 1 per row tree;
+        # `levels` may be precomputed on the host (utils/fast_host
+        # nmt_levels_fast) by engines that must not touch jax
         self.levels = [
             (np.asarray(m), np.asarray(x), np.asarray(v)) for m, x, v in levels
         ]

@@ -1,0 +1,246 @@
+"""e2e throughput benchmark over the autonomous multi-process devnet.
+
+The reference's e2e benchmark harness (test/e2e/benchmark/throughput.go)
+provisions validator pods, injects network latency via BitTwister (70 ms,
+5 MB/s per peer), floods PFB load from txsim, then scrapes per-node
+BlockSummary traces and passes if some block reaches >= 90% of
+MaxBlockBytes (TwoNodeSimple: >= 1 MB). This is that harness for THIS
+framework, with OS processes for pods and the reactor's gossip_delay for
+the latency injection:
+
+  python -m celestia_app_tpu e2e-bench --home DIR \
+      --validators 2 --blocks 8 --blob-kb 200 --blobs-per-tx 2 \
+      --latency-ms 70 --target-mb 1.0
+
+Spawns `validator-serve --autonomous` processes, floods multi-blob PFBs
+at every validator's /broadcast_tx from a load thread, waits for the
+target height, then pulls /trace/block_summary (the rows the reactor
+writes at every commit) and reports blocks/s + block-byte statistics
+with the reference's >= 90%-of-target pass criterion.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import random
+import sys
+import threading
+import time
+import urllib.request
+
+
+def _post(url: str, path: str, payload: dict, timeout: float = 30.0) -> dict:
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(url: str, path: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+class BlobLoad(threading.Thread):
+    """txsim-lite: continuously submit multi-blob PFBs round-robin across
+    validators, tracking per-account sequence and re-syncing on the
+    sequence-mismatch rejection (the reference's txsim blob sequence)."""
+
+    def __init__(self, urls: list[str], privs, chain_id: str,
+                 blob_kb: int, blobs_per_tx: int, txs_per_block: int = 8,
+                 seed: int = 7):
+        super().__init__(daemon=True)
+        from celestia_app_tpu.client.tx_client import Signer
+
+        self.urls = urls
+        self.chain_id = chain_id
+        self.blob_kb = blob_kb
+        self.blobs_per_tx = blobs_per_tx
+        # paced like the reference's txsim (one tx per sequence per
+        # block): an unpaced flood starves the consensus threads of the
+        # writer lock (every CheckTx recomputes blob commitments) and
+        # bloats the mempool past what one square can hold
+        self.txs_per_block = txs_per_block
+        self.rng = random.Random(seed)
+        self.signers = []
+        for i, p in enumerate(privs):
+            s = Signer(chain_id)
+            s.add_account(p, number=i)
+            self.signers.append((p.public_key().address(), s))
+        self.submitted = 0
+        self.rejected = 0
+        self.stop_flag = threading.Event()
+
+    def _height(self) -> int:
+        for u in self.urls:
+            try:
+                return _get(u, "/consensus/status", timeout=5)["height"]
+            except OSError:
+                continue
+        return 0
+
+    def run(self) -> None:
+        from celestia_app_tpu.chain.modules import estimate_pfb_gas
+        from celestia_app_tpu.client.tx_client import parse_expected_sequence
+        from celestia_app_tpu.da.blob import Blob
+        from celestia_app_tpu.da.namespace import Namespace
+
+        i = 0
+        height = self._height()
+        sent_this_height = 0
+        while not self.stop_flag.is_set():
+            if sent_this_height >= self.txs_per_block:
+                h = self._height()
+                if h == height:
+                    time.sleep(0.2)
+                    continue
+                height, sent_this_height = h, 0
+            addr, signer = self.signers[i % len(self.signers)]
+            url = self.urls[i % len(self.urls)]
+            i += 1
+            blobs = [
+                Blob(
+                    Namespace.v0(self.rng.randbytes(10)),
+                    self.rng.randbytes(self.blob_kb * 1024),
+                )
+                for _ in range(self.blobs_per_tx)
+            ]
+            gas = int(estimate_pfb_gas([len(b.data) for b in blobs]) * 1.2)
+            fee = max(1, int(gas * 0.002) + 1)
+            raw = signer.create_pay_for_blobs(
+                addr, blobs, fee=fee, gas_limit=gas
+            )
+            try:
+                res = _post(url, "/broadcast_tx",
+                            {"tx": base64.b64encode(raw).decode()})
+            except OSError:
+                time.sleep(0.2)
+                continue
+            if res.get("code") == 0:
+                signer.accounts[addr].sequence += 1
+                self.submitted += 1
+                sent_this_height += 1
+            else:
+                self.rejected += 1
+                exp = parse_expected_sequence(res.get("log", ""))
+                if exp is not None:
+                    signer.accounts[addr].sequence = exp
+                else:
+                    time.sleep(0.1)  # mempool full / floor: back off
+
+
+def run(args, spawn_processes, terminate_processes) -> int:
+    """The benchmark driver; `spawn_processes`/`terminate_processes` come
+    from the CLI's shared devnet scaffolding."""
+    from celestia_app_tpu.chain.crypto import PrivateKey
+
+    n = args.validators
+    privs = [
+        PrivateKey.from_seed(f"devnet-{i}".encode()) for i in range(n)
+    ]
+    genesis = {
+        "time_unix": time.time(),
+        "accounts": [
+            {"address": p.public_key().address().hex(), "balance": 10**14}
+            for p in privs
+        ],
+        "validators": [
+            {
+                "operator": p.public_key().address().hex(),
+                "power": 10,
+                "pubkey": p.public_key().compressed.hex(),
+            }
+            for p in privs
+        ],
+    }
+    os.makedirs(args.home, exist_ok=True)
+    procs, homes, urls = spawn_processes(
+        args, genesis,
+        extra_flags=("--autonomous", "--http", "0"),
+        reactor_cfg={
+            "timeout_propose": 60.0,  # a 2 MB square build + extend can
+            "timeout_prevote": 30.0,  # take a while on a loaded host
+            "timeout_precommit": 30.0,
+            "timeout_delta": 5.0,
+            "block_interval": args.block_time,
+            "gossip_delay": args.latency_ms / 1000.0,
+        },
+    )
+    load = None
+    try:
+        # reactors arm on sight of the address book
+        for home in homes:
+            tmp = os.path.join(home, "peers.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(urls, f)
+            os.replace(tmp, os.path.join(home, "peers.json"))
+
+        load = BlobLoad(urls, privs, args.chain_id,
+                        args.blob_kb, args.blobs_per_tx,
+                        txs_per_block=args.txs_per_block)
+        load.start()
+
+        deadline = time.monotonic() + max(300.0, 60.0 * args.blocks)
+        while time.monotonic() < deadline:
+            heights = []
+            for u in urls:
+                try:
+                    heights.append(_get(u, "/consensus/status")["height"])
+                except OSError:
+                    pass
+            if heights and min(heights) >= args.blocks:
+                break
+            if heights:
+                print(f"heights: {heights}, submitted {load.submitted}, "
+                      f"rejected {load.rejected}", file=sys.stderr)
+            time.sleep(max(0.5, args.block_time))
+        else:
+            print("ERROR: benchmark never reached the target height",
+                  file=sys.stderr)
+            return 1
+        load.stop_flag.set()
+
+        # scrape BlockSummary traces from validator 0's node HTTP service
+        with open(os.path.join(homes[0], "endpoint.json")) as f:
+            ep = json.load(f)
+        http = f"http://{ep['host']}:{ep['http_port']}"
+        rows = _get(http, "/trace/block_summary?limit=100000")
+        rows = rows.get("rows", rows) if isinstance(rows, dict) else rows
+        if not rows:
+            print("ERROR: no block_summary traces", file=sys.stderr)
+            return 1
+        by_height = {}
+        for r in rows:
+            by_height[r["height"]] = r
+        blocks = sorted(by_height.values(), key=lambda r: r["height"])
+        bytes_list = [r["block_bytes"] for r in blocks]
+        times = [r["time_unix"] for r in blocks]
+        span = max(times) - min(times)
+        bps = (len(blocks) - 1) / span if span > 0 and len(blocks) > 1 \
+            else None
+        target = int(args.target_mb * 1024 * 1024)
+        max_bytes = max(bytes_list)
+        out = {
+            "validators": n,
+            "latency_ms": args.latency_ms,
+            "blocks": len(blocks),
+            "blocks_per_sec": round(bps, 3) if bps else None,
+            "max_block_bytes": max_bytes,
+            "avg_block_bytes": sum(bytes_list) // len(bytes_list),
+            "txs_total": sum(r["txs"] for r in blocks),
+            "pfb_submitted": load.submitted,
+            "target_bytes": target,
+            # the reference pass criterion: SOME block >= 90% of target
+            # (test/e2e/benchmark/throughput.go:124-125)
+            "pass": max_bytes >= int(0.9 * target),
+        }
+        print(json.dumps(out))
+        return 0 if out["pass"] else 1
+    finally:
+        if load is not None:
+            load.stop_flag.set()
+        terminate_processes(procs)

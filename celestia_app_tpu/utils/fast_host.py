@@ -92,9 +92,12 @@ def _ns_lt(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return lt
 
 
-def nmt_roots_fast(leaf_ns: np.ndarray, leaf_data: np.ndarray) -> np.ndarray:
-    """Batched NMT roots (T, L, 29)+(T, L, D) -> (T, 90); nmt semantics as in
-    ops/nmt.py (IgnoreMaxNamespace=true, parity propagation)."""
+def nmt_levels_fast(
+    leaf_ns: np.ndarray, leaf_data: np.ndarray
+) -> list[tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """All NMT tree levels, leaves first: the host twin of ops/nmt.py
+    nmt_levels (same (mins, maxs, vs) shape per level), feeding proof
+    generation on validators whose engine never touches jax."""
     t, l, d = leaf_data.shape
     pre = np.concatenate(
         [
@@ -107,6 +110,7 @@ def nmt_roots_fast(leaf_ns: np.ndarray, leaf_data: np.ndarray) -> np.ndarray:
     vs = _sha_many(pre).reshape(t, l, 32)
     mins = leaf_ns.copy()
     maxs = leaf_ns.copy()
+    levels = [(mins, maxs, vs)]
     while vs.shape[1] > 1:
         lm, rm = mins[:, 0::2], mins[:, 1::2]
         lx, rx = maxs[:, 0::2], maxs[:, 1::2]
@@ -127,6 +131,14 @@ def nmt_roots_fast(leaf_ns: np.ndarray, leaf_data: np.ndarray) -> np.ndarray:
         r_par = np.all(rm == PARITY, axis=-1)[..., None]
         mx = np.where(_ns_lt(lx, rx)[..., None], rx, lx)
         maxs = np.where(l_par, PARITY, np.where(r_par, lx, mx))
+        levels.append((mins, maxs, vs))
+    return levels
+
+
+def nmt_roots_fast(leaf_ns: np.ndarray, leaf_data: np.ndarray) -> np.ndarray:
+    """Batched NMT roots (T, L, 29)+(T, L, D) -> (T, 90); nmt semantics as in
+    ops/nmt.py (IgnoreMaxNamespace=true, parity propagation)."""
+    mins, maxs, vs = nmt_levels_fast(leaf_ns, leaf_data)[-1]
     return np.concatenate([mins[:, 0], maxs[:, 0], vs[:, 0]], axis=1)
 
 

@@ -203,8 +203,15 @@ def _dec_create_validator(raw: bytes) -> itx.MsgCreateValidator:
     pubkey = b""
     if f.has(6):
         any_f = Fields(f.get_bytes(6))
-        if any_f.get_string(1) == _SECP256K1_PUBKEY_URL:
-            pubkey = Fields(any_f.get_bytes(2)).get_bytes(1)
+        url = any_f.get_string(1)
+        if url != _SECP256K1_PUBKEY_URL:
+            # reject loudly: silently dropping the key would create a
+            # validator that counts in power totals but can never vote
+            raise ValueError(
+                f"unsupported consensus pubkey type {url!r} "
+                f"(only {_SECP256K1_PUBKEY_URL})"
+            )
+        pubkey = Fields(any_f.get_bytes(2)).get_bytes(1)
     return itx.MsgCreateValidator(
         _addr_bytes(f.get_string(5)), stake, pubkey
     )

@@ -1,0 +1,33 @@
+"""e2e throughput benchmark acceptance (test/e2e/benchmark analog).
+
+Runs the real CLI: spawns autonomous validator processes, floods paced
+multi-blob PFBs, injects gossip latency, scrapes BlockSummary traces,
+and applies the reference pass criterion (some block >= 90% of target —
+throughput.go:124-125). Scaled down for CI; the full manifest shape is
+`e2e-bench --validators 2 --blocks 8 --blob-kb 200 --latency-ms 70
+--target-mb 1.0`.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_e2e_bench_passes(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "celestia_app_tpu", "e2e-bench",
+         "--home", str(tmp_path), "--validators", "2", "--blocks", "3",
+         "--blob-kb", "50", "--blobs-per-tx", "2", "--txs-per-block", "2",
+         "--latency-ms", "10", "--target-mb", "0.1",
+         "--block-time", "0.3", "--chain-id", "e2e-bench-test"],
+        capture_output=True, text=True, timeout=420,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["pass"] is True
+    assert doc["blocks"] >= 3
+    assert doc["max_block_bytes"] >= 0.9 * doc["target_bytes"]
+    assert doc["blocks_per_sec"] is None or doc["blocks_per_sec"] > 0
