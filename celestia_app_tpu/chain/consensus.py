@@ -329,6 +329,7 @@ class ValidatorNode:
             for v in genesis.get("validators", [])
             if "pubkey" in v
         }
+        self._load_sign_state()
 
     # -- mempool (gossiped) ---------------------------------------------
 
@@ -391,7 +392,64 @@ class ValidatorNode:
         )
         return prop.block
 
+    def _sign_state_path(self) -> str | None:
+        if self.wal_dir is None:
+            return None
+        return os.path.join(os.path.dirname(self.wal_dir),
+                            "priv_validator_state.json")
+
+    def _load_sign_state(self) -> None:
+        """Tendermint's priv_validator_state.json: the last non-nil vote
+        hash signed per (height, phase), persisted BEFORE each signature
+        so a crashed-and-restarted validator can never be tricked (or
+        race itself) into signing a second, different non-nil vote at a
+        height it already voted — the self-inflicted double-sign that
+        round-blind vote signatures would make slashable."""
+        self._signed_hashes: dict[tuple[int, str], str] = {}
+        path = self._sign_state_path()
+        if path is None or not os.path.exists(path):
+            return
+        with open(path) as f:
+            doc = json.load(f)
+        self._signed_hashes = {
+            (int(h), p): v
+            for k, v in doc.get("signed", {}).items()
+            for h, p in [k.split(":", 1)]
+        }
+
+    def _persist_sign_state(self) -> None:
+        path = self._sign_state_path()
+        if path is None:
+            return
+        doc = {"signed": {
+            f"{h}:{p}": v for (h, p), v in self._signed_hashes.items()
+        }}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
     def _signed(self, height: int, bh: bytes | None, phase: str) -> Vote:
+        """Sign a vote — through the double-sign guard for PRECOMMITS: a
+        second non-nil precommit at a height we already precommitted must
+        carry the SAME hash, else we sign nil instead (safe: nil votes
+        can never form evidence or a certificate). Prevotes are exempt —
+        prevoting different blocks in successive rounds is legal
+        Tendermint behavior and required for liveness after a failed
+        round (detect_equivocation pools precommits only). Entries are
+        pruned once the chain moves past them."""
+        if bh is not None and phase == "precommit":
+            prior = self._signed_hashes.get((height, phase))
+            if prior is not None and prior != bh.hex():
+                bh = None  # refuse the double-sign; vote nil
+            else:
+                self._signed_hashes[(height, phase)] = bh.hex()
+                floor = self.app.height - 2
+                for k in [k for k in self._signed_hashes if k[0] < floor]:
+                    del self._signed_hashes[k]
+                self._persist_sign_state()
         sig = self.priv.sign(
             Vote.sign_bytes(self.app.chain_id, height, bh, phase)
         )

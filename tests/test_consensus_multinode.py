@@ -417,7 +417,19 @@ def test_same_phase_equivocation_still_slashed(tmp_path):
     h = 5
     bh_a, bh_b = b"\x01" * 32, b"\x02" * 32
     pre_a = node._signed(h, bh_a, "precommit")
-    pre_b = node._signed(h, bh_b, "precommit")
+    # an honest node's _signed refuses the second precommit (the
+    # priv_validator_state double-sign guard turns it nil), so the
+    # byzantine second vote is forged directly with the raw key — which
+    # is exactly what a real equivocator would do
+    guarded = node._signed(h, bh_b, "precommit")
+    assert guarded.block_hash is None  # the guard held
+    pre_b = c.Vote(
+        h, bh_b, node.address,
+        node.priv.sign(
+            c.Vote.sign_bytes(CHAIN, h, bh_b, "precommit")
+        ),
+        phase="precommit",
+    )
     pv_a = node._signed(h, bh_a, "prevote")
     validators = {node.address: node.priv.public_key().compressed}
 
@@ -518,3 +530,33 @@ def test_validator_mempool_rejects_oversize_tx(tmp_path):
     res = net.nodes[0].add_tx(giant)
     assert res.code != 0 and "max bytes" in res.log
     assert net.nodes[0].mempool == []
+
+
+def test_sign_state_survives_restart(tmp_path):
+    """priv_validator_state parity: the double-sign guard is durable. A
+    validator that precommitted block A at height h, crashed, and
+    restarted from the same home must refuse to precommit a DIFFERENT
+    block at h (it signs nil) — while re-signing A stays allowed."""
+    privs = [PrivateKey.from_seed(b"\x51")]
+    genesis = _genesis(privs)
+    home = str(tmp_path / "v0")
+    node = consensus.ValidatorNode("v0", privs[0], genesis, CHAIN,
+                                   data_dir=home)
+    bh_a, bh_b = b"\xaa" * 32, b"\xbb" * 32
+    v1 = node._signed(7, bh_a, "precommit")
+    assert v1.block_hash == bh_a
+
+    # crash + restart: a fresh process over the same home (release the
+    # storage flock as a dead process would)
+    node.app.close()
+    node2 = consensus.ValidatorNode("v0", privs[0], genesis, CHAIN,
+                                    data_dir=home)
+    refused = node2._signed(7, bh_b, "precommit")
+    assert refused.block_hash is None  # guard held across the restart
+    again = node2._signed(7, bh_a, "precommit")
+    assert again.block_hash == bh_a  # same hash: legal re-sign
+
+    # prevotes stay exempt (cross-round re-prevoting is legal liveness)
+    pv1 = node2._signed(8, bh_a, "prevote")
+    pv2 = node2._signed(8, bh_b, "prevote")
+    assert pv1.block_hash == bh_a and pv2.block_hash == bh_b
