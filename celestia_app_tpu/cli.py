@@ -657,6 +657,37 @@ def _check_legacy_validator_home(home: str) -> str | None:
     return None
 
 
+def cmd_multihost_worker(args) -> int:
+    """One host of the multi-host mesh (spawned by multihost-dryrun; env
+    is prepared by the spawner BEFORE this interpreter starts)."""
+    from celestia_app_tpu.parallel import multihost
+
+    out = multihost.worker_main(
+        args.process_id, args.num_processes, args.coordinator,
+        args.k, args.batch, args.devices_per_host,
+    )
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_multihost_dryrun(args) -> int:
+    """N OS processes x M virtual devices = one global mesh running the
+    sharded block pipeline, every host feeding only its own shards; roots
+    must agree across hosts AND match the single-host oracle."""
+    from celestia_app_tpu.parallel import multihost
+
+    if args.processes < 1 or args.devices_per_host < 1:
+        print("--processes and --devices-per-host must be >= 1",
+              file=sys.stderr)
+        return 2
+    out = multihost.spawn_dryrun(
+        k=args.k, batch=args.batch, num_processes=args.processes,
+        devices_per_host=args.devices_per_host,
+    )
+    print(json.dumps(out))
+    return 0 if out["all_hosts_match_oracle"] else 1
+
+
 def cmd_e2e_bench(args) -> int:
     """Throughput benchmark on the autonomous process devnet — see
     tools/e2e_bench.py (the test/e2e/benchmark/throughput.go analog)."""
@@ -1553,6 +1584,27 @@ def main(argv=None) -> int:
                         "runs its own consensus reactor and gossips "
                         "proposals/votes/txs peer-to-peer")
     p.set_defaults(fn=cmd_devnet)
+
+    p = sub.add_parser(
+        "multihost-dryrun",
+        help="prove the cross-host SPMD path: N processes x M virtual CPU "
+             "devices as ONE global mesh (jax.distributed + Gloo, the DCN "
+             "stand-in), sharded pipeline, every host checking the mesh's "
+             "root against the independently recomputed CPU oracle")
+    p.add_argument("--processes", type=int, default=2)
+    p.add_argument("--devices-per-host", type=int, default=4)
+    p.add_argument("--k", type=int, default=16)
+    p.add_argument("--batch", type=int, default=2)
+    p.set_defaults(fn=cmd_multihost_dryrun)
+
+    p = sub.add_parser("multihost-worker")  # internal (spawned)
+    p.add_argument("--process-id", type=int, required=True)
+    p.add_argument("--num-processes", type=int, required=True)
+    p.add_argument("--coordinator", required=True)
+    p.add_argument("--k", type=int, required=True)
+    p.add_argument("--batch", type=int, required=True)
+    p.add_argument("--devices-per-host", type=int, required=True)
+    p.set_defaults(fn=cmd_multihost_worker)
 
     p = sub.add_parser(
         "e2e-bench",
