@@ -330,22 +330,9 @@ class ConsensusReactor:
     def _timeout(self, base: float) -> float:
         return base + self.round * self.cfg.timeout_delta
 
-    def _trace_block(self, block, round_: int) -> None:
-        """BlockSummary trace row (pkg/trace's per-block table): the
-        figures the reference's e2e benchmark harness scrapes to compute
-        throughput (CheckResults pulls PullBlockSummaryTraces)."""
-        try:
-            self.vnode.app.traces.write(
-                "block_summary",
-                height=block.header.height,
-                round=round_,
-                txs=len(block.txs),
-                block_bytes=sum(len(t) for t in block.txs),
-                square_size=block.header.square_size,
-                time_unix=block.header.time_unix,
-            )
-        except Exception:
-            pass
+    # NOTE: the per-block BlockSummary row is written by App.commit itself
+    # (chain/app.py — one schema for every consensus mode); the reactor
+    # only adds the RoundState rows below.
 
     def _trace_round(self, height: int, round_: int, step: str,
                      t0: float) -> None:
@@ -504,7 +491,6 @@ class ConsensusReactor:
                 self.vnode.clear_lock()
                 self._refresh_valset()
                 self.app_hashes[height] = h.hex()
-                self._trace_block(prop.block, prop.round)
                 telemetry.incr("reactor.commits_adopted")
                 self._remember_commit(doc, height)
                 applied = True
@@ -795,7 +781,6 @@ class ConsensusReactor:
             self._refresh_valset()
             self.app_hashes[height] = ah.hex()
         self._trace_round(height, r, "commit", _t_round)
-        self._trace_block(prop.block, r)
         telemetry.incr("reactor.commits")
         self._remember_commit(doc, height)
         self._gossip("/gossip/commit", doc)

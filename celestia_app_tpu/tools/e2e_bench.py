@@ -231,7 +231,7 @@ def run(args, spawn_processes, terminate_processes) -> int:
             "blocks_per_sec": round(bps, 3) if bps else None,
             "max_block_bytes": max_bytes,
             "avg_block_bytes": sum(bytes_list) // len(bytes_list),
-            "txs_total": sum(r["txs"] for r in blocks),
+            "txs_total": sum(r["n_txs"] for r in blocks),
             "pfb_submitted": load.submitted,
             "target_bytes": target,
             # the reference pass criterion: SOME block >= 90% of target

@@ -1,0 +1,192 @@
+"""Concurrency stress: the `make test-race` analog (SURVEY §5.2).
+
+Python has no race detector, so the equivalent confidence comes from
+hammering a live autonomous network's every concurrent surface at once —
+tx broadcasts (valid, duplicate, and garbage), status polls, gossip-route
+junk, commit-record reads — from many threads while the reactors commit
+heights, then asserting liveness (heights advanced), safety (no app-hash
+divergence), and service health (every route still answers).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from celestia_app_tpu.chain import consensus as c
+from celestia_app_tpu.chain.crypto import PrivateKey
+from celestia_app_tpu.chain.reactor import ReactorConfig
+from celestia_app_tpu.chain.tx import MsgSend
+from celestia_app_tpu.client.tx_client import Signer
+from celestia_app_tpu.service.validator_server import ValidatorService
+
+CHAIN = "celestia-stress-test"
+
+
+def _post(url: str, path: str, payload: dict, timeout: float = 10.0):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(url: str, path: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+@pytest.mark.slow
+def test_concurrent_hammering_cannot_wedge_or_diverge():
+    privs = [PrivateKey.from_seed(f"stress-{i}".encode()) for i in range(4)]
+    genesis = {
+        "time_unix": 1_700_000_000.0,
+        "accounts": [
+            {"address": p.public_key().address().hex(), "balance": 10**12}
+            for p in privs
+        ],
+        "validators": [
+            {
+                "operator": p.public_key().address().hex(),
+                "power": 10,
+                "pubkey": p.public_key().compressed.hex(),
+            }
+            for p in privs
+        ],
+    }
+    nodes = [c.ValidatorNode(f"v{i}", p, genesis, CHAIN)
+             for i, p in enumerate(privs)]
+    services = [ValidatorService(v) for v in nodes]
+    for s in services:
+        s.serve_background()
+    urls = [f"http://127.0.0.1:{s.port}" for s in services]
+    cfg = ReactorConfig(
+        timeout_propose=10.0, timeout_prevote=5.0, timeout_precommit=5.0,
+        timeout_delta=1.0, block_interval=0.01, poll=0.005,
+        gossip_timeout=2.0, sync_grace=0.5,
+    )
+    for i, s in enumerate(services):
+        s.attach_reactor([u for j, u in enumerate(urls) if j != i], cfg)
+
+    stop = threading.Event()
+    errors: list[str] = []
+
+    def guard(fn):
+        def run():
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — stress harness
+                errors.append(f"{fn.__name__}: {type(e).__name__}: {e}")
+        return run
+
+    signers = []
+    for i, p in enumerate(privs):
+        s = Signer(CHAIN)
+        s.add_account(p, number=i)
+        signers.append(s)
+    send_lock = threading.Lock()
+
+    @guard
+    def valid_tx_hammer():
+        rng = random.Random(1)
+        while not stop.is_set():
+            i = rng.randrange(4)
+            with send_lock:  # one tx stream per account, sequenced
+                signer = signers[i]
+                a = privs[i].public_key().address()
+                b = privs[(i + 1) % 4].public_key().address()
+                tx = signer.create_tx(a, [MsgSend(a, b, 1)],
+                                      fee=2000, gas_limit=100_000)
+                raw = tx.encode()
+            try:
+                res = _post(rng.choice(urls), "/broadcast_tx",
+                            {"tx": base64.b64encode(raw).decode()})
+                if res.get("code") == 0:
+                    with send_lock:
+                        signers[i].accounts[a].sequence += 1
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.02)
+
+    @guard
+    def garbage_hammer():
+        rng = random.Random(2)
+        paths = ["/broadcast_tx", "/gossip/vote", "/gossip/proposal",
+                 "/gossip/tx", "/gossip/commit"]
+        while not stop.is_set():
+            payload = rng.choice([
+                {}, {"tx": "!!!not-base64!!!"}, {"nonsense": rng.random()},
+                {"vote": {"height": -1}}, {"round": "NaN"},
+            ])
+            try:
+                _post(rng.choice(urls), rng.choice(paths), payload,
+                      timeout=5.0)
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.01)
+
+    @guard
+    def reader_hammer():
+        rng = random.Random(3)
+        while not stop.is_set():
+            u = rng.choice(urls)
+            try:
+                st = _get(u, "/consensus/status", timeout=5.0)
+                _get(u, f"/gossip/commit_at?height={st['height']}",
+                     timeout=5.0)
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.01)
+
+    threads = [threading.Thread(target=t, daemon=True)
+               for t in [valid_tx_hammer, garbage_hammer, garbage_hammer,
+                         reader_hammer, reader_hammer]]
+    try:
+        base = max(n.app.height for n in nodes)
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 60
+        while (time.monotonic() < deadline
+               and min(n.app.height for n in nodes) < base + 6):
+            time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+        assert not errors, errors[:3]
+        # liveness under fire
+        assert min(n.app.height for n in nodes) >= base + 6, (
+            [n.app.height for n in nodes]
+        )
+        # safety: every height committed by 2+ nodes has ONE app hash
+        hs: dict[int, set] = {}
+        for s in services:
+            for h, v in s.reactor.app_hashes.items():
+                hs.setdefault(h, set()).add(v)
+        assert all(len(v) == 1 for v in hs.values()), {
+            h: v for h, v in hs.items() if len(v) > 1
+        }
+        # service health: every node still answers every read surface
+        for u in urls:
+            assert "height" in _get(u, "/consensus/status")
+        # at least one valid tx actually committed under the noise
+        assert any(
+            r["n_txs"] > 0
+            for s in services
+            for r in s.vnode.app.traces.read("block_summary", limit=10000)
+        )
+    finally:
+        stop.set()
+        for s in services:
+            try:
+                s.shutdown()
+            except Exception:
+                pass
