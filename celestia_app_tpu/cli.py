@@ -657,6 +657,85 @@ def _check_legacy_validator_home(home: str) -> str | None:
     return None
 
 
+def cmd_verify(args) -> int:
+    """Blobstream verification CLI (x/blobstream/client verify analog,
+    ref client/verify.go:27-38): prove that shares at a height are
+    covered by an on-chain data-commitment attestation — share proof to
+    the block's data root, then the data-root tuple proof to the
+    attestation's commitment root, the exact value an EVM Blobstream
+    contract stores per nonce. The reference queries a live Ethereum
+    contract; with no external chain here, the root is recomputed from
+    the home's own attested height range, which is the same statement an
+    orchestrator would have relayed."""
+    from celestia_app_tpu.chain import blobstream as bs
+    from celestia_app_tpu.chain.query import QueryRouter
+    from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+
+    app, _cfg = _make_app(args.home)
+    if app.height < args.height:
+        print(f"home is at height {app.height}; {args.height} not committed",
+              file=sys.stderr)
+        return 1
+    ctx = Context(app.store, InfiniteGasMeter(), app.height, 0,
+                  app.chain_id, app.app_version)
+
+    # find the data-commitment attestation whose range covers the height
+    latest = app.blobstream.latest_attestation_nonce(ctx)
+    if latest is None:
+        print("no blobstream attestations in state (v1 only; the module "
+              "is disabled from app version 2)", file=sys.stderr)
+        return 1
+    dc = None
+    for nonce in range(latest, 0, -1):
+        att = app.blobstream.attestation_by_nonce(ctx, nonce)
+        if (isinstance(att, bs.DataCommitment)
+                and att.begin_block <= args.height < att.end_block):
+            dc = att
+            break
+    if dc is None:
+        print(f"height {args.height} is not covered by any data "
+              "commitment yet (window boundary not reached)",
+              file=sys.stderr)
+        return 1
+
+    # share proof -> data root (the same prover the query routes use)
+    qr = QueryRouter(app)
+    prover, data_root = qr.prover_for(args.height)
+    ns = bytes.fromhex(args.namespace) if args.namespace else \
+        prover.eds.squares[0, 0, :29].tobytes()
+    proof = prover.prove_shares(args.start, args.end, ns)
+    if not proof.verify(data_root):
+        print("FAILED: share proof does not verify against the data root",
+              file=sys.stderr)
+        return 1
+
+    # data root -> attestation tuple root (what the EVM contract stores)
+    data_roots = {}
+    for h in range(dc.begin_block, dc.end_block):
+        if h < 1 or h > app.height:
+            continue
+        data_roots[h] = app.db.load_block(h).header.data_hash
+    tuple_root = bs.data_commitment_root(dc, data_roots)
+    tproof = bs.data_root_tuple_proof(dc, data_roots, args.height)
+    if not bs.verify_data_root_inclusion(
+        args.height, data_root, tuple_root, tproof
+    ):
+        print("FAILED: data root not included in the attestation's "
+              "tuple root", file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "verified": True,
+        "height": args.height,
+        "shares": [args.start, args.end],
+        "namespace": ns.hex(),
+        "data_root": data_root.hex(),
+        "attestation_nonce": dc.nonce,
+        "attestation_range": [dc.begin_block, dc.end_block],
+        "data_commitment_root": tuple_root.hex(),
+    }, indent=2))
+    return 0
+
+
 def cmd_multihost_worker(args) -> int:
     """One host of the multi-host mesh (spawned by multihost-dryrun; env
     is prepared by the spawner BEFORE this interpreter starts)."""
@@ -1584,6 +1663,20 @@ def main(argv=None) -> int:
                         "runs its own consensus reactor and gossips "
                         "proposals/votes/txs peer-to-peer")
     p.set_defaults(fn=cmd_devnet)
+
+    p = sub.add_parser(
+        "verify",
+        help="blobstream verify (x/blobstream client verify analog): "
+             "prove shares at a height up to the covering data-commitment "
+             "attestation's tuple root")
+    p.add_argument("--home", required=True)
+    p.add_argument("--height", type=int, required=True)
+    p.add_argument("--start", type=int, default=0,
+                   help="ODS share start index (row-major)")
+    p.add_argument("--end", type=int, default=1, help="exclusive end index")
+    p.add_argument("--namespace",
+                   help="29-byte namespace hex (default: share 0's)")
+    p.set_defaults(fn=cmd_verify)
 
     p = sub.add_parser(
         "multihost-dryrun",
