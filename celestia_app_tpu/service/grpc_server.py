@@ -35,6 +35,8 @@ BANK_QUERY = "cosmos.bank.v1beta1.Query"
 PARAMS_QUERY = "cosmos.params.v1beta1.Query"
 BLOB_QUERY = "celestia.blob.v1.Query"
 MINFEE_QUERY = "celestia.minfee.v1.Query"
+STAKING_QUERY = "cosmos.staking.v1beta1.Query"
+GOV_QUERY = "cosmos.gov.v1beta1.Query"
 
 
 class CosmosTxService:
@@ -198,6 +200,45 @@ class QueryServices:
             p["gas_per_blob_byte"], p["gov_max_square_size"]
         )
 
+    # -- cosmos.staking.v1beta1.Query -----------------------------------
+
+    def staking_validator(self, request: bytes, context) -> bytes:
+        addr_str = txpb.parse_query_validator_request(request)
+        try:
+            op = bech32.decode(addr_str, bech32.HRP_VALOPER)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        with self.lock:
+            v = self.node.app.staking.validator(self._ctx(), op)
+            if v is None:
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              f"validator {addr_str} not found")
+            return txpb.query_validator_response_pb(txpb.validator_pb(
+                op, v["jailed"], v["bonded"], v["tokens"]
+            ))
+
+    def staking_validators(self, request: bytes, context) -> bytes:
+        with self.lock:
+            ctx = self._ctx()
+            out = []
+            for op, _power in self.node.app.staking.validators(ctx):
+                v = self.node.app.staking.validator(ctx, op)
+                out.append(txpb.validator_pb(
+                    op, v["jailed"], v["bonded"], v["tokens"]
+                ))
+        return txpb.query_validators_response_pb(out)
+
+    # -- cosmos.gov.v1beta1.Query ---------------------------------------
+
+    def gov_proposal(self, request: bytes, context) -> bytes:
+        pid = txpb.parse_query_proposal_request(request)
+        with self.lock:
+            p = self.node.app.gov.proposal(self._ctx(), pid)
+        if p is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"proposal {pid} doesn't exist")
+        return txpb.query_proposal_response_pb(p["id"], p["status"])
+
     # -- celestia.minfee.v1.Query ---------------------------------------
 
     def network_min_gas_price(self, request: bytes, context) -> bytes:
@@ -238,6 +279,11 @@ class GrpcTxServer:
             PARAMS_QUERY: {"Params": _handler(q.subspace_params)},
             BLOB_QUERY: {"Params": _handler(q.blob_params)},
             MINFEE_QUERY: {"NetworkMinGasPrice": _handler(q.network_min_gas_price)},
+            STAKING_QUERY: {
+                "Validator": _handler(q.staking_validator),
+                "Validators": _handler(q.staking_validators),
+            },
+            GOV_QUERY: {"Proposal": _handler(q.gov_proposal)},
         }
         self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
         self.server.add_generic_rpc_handlers(tuple(

@@ -883,3 +883,84 @@ def minfee_response_pb(network_min_gas_price: float) -> bytes:
 
 def parse_minfee_response(raw: bytes) -> float:
     return parse_dec_str(Fields(raw).get_string(1))
+
+
+# -- cosmos.staking.v1beta1.Query (Validator / Validators) -------------------
+# Subset of the reference's Validator message the client surfaces actually
+# read: operator_address(1), jailed(3), status(4; 3 = BOND_STATUS_BONDED),
+# tokens(5, integer string).
+
+
+def parse_query_validator_request(raw: bytes) -> str:
+    return Fields(raw).get_string(1)
+
+
+def validator_pb(operator: bytes, jailed: bool, bonded: bool,
+                 tokens: int) -> bytes:
+    return (
+        field_string(1, bech32.encode(operator, bech32.HRP_VALOPER))
+        + field_varint(3, 1 if jailed else 0)
+        + field_varint(4, 3 if bonded else 1, emit_default=True)
+        + field_string(5, str(tokens))
+    )
+
+
+def query_validator_response_pb(validator: bytes) -> bytes:
+    return field_message(1, validator, emit_default=True)
+
+
+def parse_validator(raw: bytes) -> dict:
+    f = Fields(raw)
+    return {
+        "operator_address": f.get_string(1),
+        "jailed": bool(f.get_int(3)),
+        "bonded": f.get_int(4) == 3,
+        "tokens": int(f.get_string(5) or "0"),
+    }
+
+
+def parse_query_validator_response(raw: bytes) -> dict:
+    return parse_validator(Fields(raw).get_bytes(1))
+
+
+def query_validators_response_pb(validators: list[bytes]) -> bytes:
+    return b"".join(field_message(1, v, emit_default=True)
+                    for v in validators)
+
+
+def parse_query_validators_response(raw: bytes) -> list[dict]:
+    return [parse_validator(v) for v in Fields(raw).repeated_bytes(1)]
+
+
+# -- cosmos.gov.v1beta1.Query (Proposal) -------------------------------------
+# Subset: proposal_id(1), status(3) with the SDK ProposalStatus codes
+# (1 deposit, 2 voting, 3 passed, 4 rejected, 5 failed), mapped from the
+# keeper's status strings (chain/gov.py).
+
+_GOV_STATUS_CODES = {
+    "deposit_period": 1,
+    "voting_period": 2,
+    "passed": 3,
+    "rejected_deposit": 4,  # both rejection flavors share the SDK code;
+    "rejected": 4,          # "rejected" (later entry) names code 4 on decode
+    "failed": 5,
+}
+_GOV_STATUS_NAMES = {v: k for k, v in _GOV_STATUS_CODES.items()}
+
+
+def parse_query_proposal_request(raw: bytes) -> int:
+    return Fields(raw).get_int(1)
+
+
+def query_proposal_response_pb(pid: int, status: str) -> bytes:
+    body = (
+        field_varint(1, pid, emit_default=True)
+        + field_varint(3, _GOV_STATUS_CODES.get(status, 0),
+                       emit_default=True)
+    )
+    return field_message(1, body, emit_default=True)
+
+
+def parse_query_proposal_response(raw: bytes) -> tuple[int, str]:
+    f = Fields(Fields(raw).get_bytes(1))
+    return f.get_int(1), _GOV_STATUS_NAMES.get(f.get_int(3), "unknown")

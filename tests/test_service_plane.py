@@ -798,3 +798,82 @@ def test_start_interval_snapshots_with_pruning(tmp_path):
     assert rc == 0
     out = json.loads(buf.getvalue())
     assert out["restored_height"] == 4
+
+
+def test_grpc_staking_and_gov_queries(tmp_path):
+    """cosmos.staking.v1beta1.Query Validator/Validators and
+    cosmos.gov.v1beta1.Query Proposal over gRPC — the module query
+    surface beyond the SetupTxClient bootstrap four (app/app.go:393-425
+    serves every module's querier)."""
+    import grpc as grpc_mod
+
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.chain.tx import MsgSubmitProposal
+    from celestia_app_tpu.service.grpc_server import GrpcTxServer
+    from celestia_app_tpu.wire import bech32 as b32
+    from celestia_app_tpu.wire import txpb
+    from celestia_app_tpu.wire.proto import field_string, field_varint
+
+    app, signer, privs = _persistent_app(tmp_path)
+    node = Node(app)
+    # one live proposal so gov has state to serve
+    a0 = privs[0].public_key().address()
+    import json as json_mod
+
+    tx = signer.create_tx(
+        a0,
+        [MsgSubmitProposal(
+            proposer=a0,
+            changes_json=json_mod.dumps(
+                [{"param": "blob/gas_per_blob_byte", "value": 9}]
+            ).encode(),
+            initial_deposit=10_000_000,
+            title="t")],
+        fee=2000, gas_limit=400_000,
+    )
+    assert node.broadcast_tx(tx.encode()).code == 0
+    node.produce_block(t=1_700_000_100.0)
+
+    server = GrpcTxServer(node, port=0)
+    try:
+        chan = grpc_mod.insecure_channel(f"127.0.0.1:{server.port}")
+        ident = lambda x: x  # noqa: E731
+
+        val = chan.unary_unary(
+            "/cosmos.staking.v1beta1.Query/Validator",
+            request_serializer=ident, response_deserializer=ident)
+        vals = chan.unary_unary(
+            "/cosmos.staking.v1beta1.Query/Validators",
+            request_serializer=ident, response_deserializer=ident)
+        prop = chan.unary_unary(
+            "/cosmos.gov.v1beta1.Query/Proposal",
+            request_serializer=ident, response_deserializer=ident)
+
+        op_str = b32.encode(a0, b32.HRP_VALOPER)
+        got = txpb.parse_query_validator_response(
+            val(field_string(1, op_str)))
+        assert got["operator_address"] == op_str
+        assert got["bonded"] is True and got["jailed"] is False
+        assert got["tokens"] == 10 * 1_000_000
+
+        all_vals = txpb.parse_query_validators_response(vals(b""))
+        assert len(all_vals) == 3
+        assert {v["operator_address"] for v in all_vals} == {
+            b32.encode(p.public_key().address(), b32.HRP_VALOPER)
+            for p in privs
+        }
+
+        pid, status = txpb.parse_query_proposal_response(
+            prop(field_varint(1, 1, emit_default=True)))
+        assert pid == 1 and status in ("deposit_period", "voting_period")
+
+        # unknown ids/addresses are NOT_FOUND, not crashes
+        with pytest.raises(grpc_mod.RpcError) as exc:
+            prop(field_varint(1, 99, emit_default=True))
+        assert exc.value.code() == grpc_mod.StatusCode.NOT_FOUND
+        with pytest.raises(grpc_mod.RpcError) as exc:
+            val(field_string(
+                1, b32.encode(b"\x01" * 20, b32.HRP_VALOPER)))
+        assert exc.value.code() == grpc_mod.StatusCode.NOT_FOUND
+    finally:
+        server.stop()
