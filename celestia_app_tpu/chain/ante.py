@@ -60,6 +60,7 @@ from celestia_app_tpu.chain.tx import (
     MsgRecvPacket,
     MsgAcknowledgePacket,
     MsgTimeoutPacket,
+    MsgUpdateClient,
     Tx,
 )
 from celestia_app_tpu.chain.crypto import PublicKey
@@ -95,6 +96,7 @@ MSG_VERSIONS: dict[str, tuple[int, int]] = {
     MsgRecvPacket.TYPE: (1, 99),
     MsgAcknowledgePacket.TYPE: (1, 99),
     MsgTimeoutPacket.TYPE: (1, 99),
+    MsgUpdateClient.TYPE: (1, 99),
 }
 
 
@@ -125,7 +127,8 @@ def msg_signer(m) -> bytes | None:
         return m.sender
     if isinstance(m, MsgExec):
         return m.grantee
-    if isinstance(m, (MsgRecvPacket, MsgAcknowledgePacket, MsgTimeoutPacket)):
+    if isinstance(m, (MsgRecvPacket, MsgAcknowledgePacket, MsgTimeoutPacket,
+                      MsgUpdateClient)):
         return m.relayer
     return None
 

@@ -66,6 +66,7 @@ from celestia_app_tpu.chain.tx import (
     MsgRecvPacket,
     MsgAcknowledgePacket,
     MsgTimeoutPacket,
+    MsgUpdateClient,
     decode_tx,
 )
 from celestia_app_tpu.da import blob as blob_mod
@@ -719,10 +720,11 @@ class App:
             tx_ctx.store.write()
             return TxResult(0, "", tx.body.gas_limit, gas.consumed, tx_ctx.events)
         except (ante_mod.AnteError, OutOfGas, ValueError, KeyError,
-                TypeError, IndexError) as e:
+                TypeError, IndexError, AttributeError) as e:
             # baseapp's runTx panic recovery: ANY malformed msg payload
-            # (e.g. relay JSON missing fields -> KeyError) becomes a failed
-            # tx, never a deterministic crash of every validator.
+            # (e.g. relay JSON missing fields -> KeyError, or JSON of the
+            # wrong shape -> AttributeError on .get/.items) becomes a
+            # failed tx, never a deterministic crash of every validator.
             # failed txs keep their fee + sequence bump (cosmos semantics):
             # re-run just the ante effects on a fresh branch
             fee_ctx = block_ctx.branch()
@@ -815,6 +817,49 @@ class App:
                 ctx, msg.source_channel, msg.sender, msg.receiver,
                 msg.denom, msg.amount,
             )
+        elif isinstance(msg, MsgUpdateClient):
+            # client-root recording as a consensus tx: replicated client
+            # state is what makes the proof-gated relay txs below evaluate
+            # identically on every validator
+            import json as json_mod
+
+            from celestia_app_tpu.chain import consensus as consensus_mod
+
+            header = cert = new_validators = new_powers = None
+            if msg.header_json:
+                header = consensus_mod.header_from_json(
+                    json_mod.loads(msg.header_json)
+                )
+            if msg.cert_json:
+                cert = consensus_mod.cert_from_json(
+                    json_mod.loads(msg.cert_json)
+                )
+            if msg.valset_json:
+                vs = json_mod.loads(msg.valset_json)
+                if not isinstance(vs, dict):
+                    raise ValueError("valset_json must be an object")
+                ops = vs.get("operators", {})
+                pows = vs.get("powers", {})
+                if not isinstance(ops, dict) or not isinstance(pows, dict):
+                    raise ValueError(
+                        "valset operators/powers must be objects"
+                    )
+                new_validators = {
+                    bytes.fromhex(k): bytes.fromhex(v)
+                    for k, v in ops.items()
+                }
+                new_powers = {
+                    bytes.fromhex(k): int(v) for k, v in pows.items()
+                }
+            self.ibc.clients.update_client(
+                # empty root decodes as b"" — normalize to None so the
+                # keeper's "trusting update needs a root" guard applies
+                ctx, msg.client_id, msg.height, msg.root or None,
+                header=header, cert=cert,
+                new_validators=new_validators, new_powers=new_powers,
+            )
+            ctx.emit_event("ibc.update_client", client_id=msg.client_id,
+                           height=msg.height)
         elif isinstance(msg, MsgRecvPacket):
             # consensus-routed relay (ibc-go MsgRecvPacket): packet
             # application is part of the block, so every validator applies

@@ -121,6 +121,13 @@ class ClientKeeper:
             meta["powers"] = {op.hex(): int(p) for op, p in powers.items()}
         _put(ctx, meta_key, meta)
 
+    def latest_height(self, ctx: Context, client_id: str) -> int | None:
+        """The client's highest recorded counterparty height (None if the
+        client does not exist) — what a relayer checks before paying an
+        update_client it does not need."""
+        meta = _get(ctx, self.CONS + client_id.encode() + b"/meta")
+        return None if meta is None else meta["latest_height"]
+
     def update_client(
         self, ctx: Context, client_id: str, height: int,
         root: bytes | None = None, *, header=None, cert=None,
@@ -438,6 +445,13 @@ class TransferKeeper:
         self.channels.commit_packet(ctx, packet)
         ctx.emit_event(
             "ibc.transfer", channel=source_channel, denom=denom, amount=amount
+        )
+        # the full packet rides the event, as ibc-go's send_packet event
+        # attributes do — ON-chain only the commitment hash exists, so
+        # events are what relayers (tools/relayer.py, hermes in the
+        # reference ecosystem) reconstruct packets from
+        ctx.emit_event(
+            "send_packet", packet_json=canonical_json(packet).decode()
         )
         return packet
 
@@ -779,4 +793,9 @@ class IBCStack:
             per_packet.store.write()
             ctx.events.extend(per_packet.events)
         self.channels.write_ack(ctx, packet, ack)
+        ctx.emit_event(  # relayers read this to settle the ack (ibc-go's
+            "write_acknowledgement",  # write_acknowledgement event)
+            packet_json=canonical_json(packet).decode(),
+            ack_json=canonical_json(ack).decode(),
+        )
         return ack

@@ -349,6 +349,41 @@ class MsgTransfer:
 
 
 @dataclasses.dataclass(frozen=True)
+class MsgUpdateClient:
+    """ibc-go client MsgUpdateClient as a CONSENSUS transaction: the
+    recorded counterparty root is part of the replicated state, so every
+    validator holds identical client state and the proof-gated relay txs
+    (MsgRecvPacket/ack/timeout) evaluate identically network-wide — a
+    node-local keeper update would fork validators the first time a
+    proof checks out on one and not another. For verifying clients the
+    header/certificate/valset JSON payloads ride along (chain/light.py
+    semantics); say-so clients take the bare root."""
+
+    TYPE = "ibc/MsgUpdateClient"
+    relayer: bytes
+    client_id: str
+    height: int
+    root: bytes  # 32-byte counterparty app hash
+    header_json: bytes = b""  # consensus.header_to_json (verifying)
+    cert_json: bytes = b""  # consensus.cert_to_json (verifying)
+    valset_json: bytes = b""  # {"operators": {hex: pubkey hex}, "powers"}
+
+    def encode(self) -> bytes:
+        return (
+            _b(self.relayer) + _b(self.client_id.encode())
+            + uvarint(self.height) + _b(self.root)
+            + _b(self.header_json) + _b(self.cert_json)
+            + _b(self.valset_json)
+        )
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "MsgUpdateClient":
+        r = _Reader(raw)
+        return cls(r.b(), r.b().decode(), r.u(), r.b(), r.b(), r.b(),
+                   r.b())
+
+
+@dataclasses.dataclass(frozen=True)
 class MsgRecvPacket:
     """ibc-go channel MsgRecvPacket: a relayer submits an inbound packet
     WITH its commitment proof as a transaction, so packet application is
@@ -458,6 +493,7 @@ MSG_TYPES = {
         MsgRegisterEVMAddress, MsgDelegate, MsgUndelegate, MsgBeginRedelegate,
         MsgCreateValidator, MsgSubmitProposal, MsgDeposit, MsgVote, MsgTransfer,
         MsgExec, MsgRecvPacket, MsgAcknowledgePacket, MsgTimeoutPacket,
+        MsgUpdateClient,
     )
 }
 

@@ -388,6 +388,26 @@ def _dec_transfer(raw: bytes) -> itx.MsgTransfer:
     )
 
 
+def _enc_update_client(m: itx.MsgUpdateClient) -> bytes:
+    return (
+        field_string(1, m.client_id)
+        + field_varint(2, m.height)
+        + field_bytes(3, m.root)
+        + field_bytes(4, m.header_json)
+        + field_bytes(5, m.cert_json)
+        + field_bytes(6, m.valset_json)
+        + field_string(7, _addr_str(m.relayer))
+    )
+
+
+def _dec_update_client(raw: bytes) -> itx.MsgUpdateClient:
+    f = Fields(raw)
+    return itx.MsgUpdateClient(
+        _addr_bytes(f.get_string(7)), f.get_string(1), f.get_int(2),
+        f.get_bytes(3), f.get_bytes(4), f.get_bytes(5), f.get_bytes(6),
+    )
+
+
 def _enc_recv_packet(m: itx.MsgRecvPacket) -> bytes:
     return (
         field_bytes(1, m.packet_json)
@@ -473,6 +493,8 @@ MSG_CODECS = {
         itx.MsgRecvPacket, _enc_recv_packet, _dec_recv_packet),
     "/celestia_tpu.ibc.MsgAcknowledgePacket": (
         itx.MsgAcknowledgePacket, _enc_ack_packet, _dec_ack_packet),
+    "/celestia_tpu.ibc.MsgUpdateClient": (
+        itx.MsgUpdateClient, _enc_update_client, _dec_update_client),
     "/celestia_tpu.ibc.MsgTimeoutPacket": (
         itx.MsgTimeoutPacket, _enc_timeout_packet, _dec_timeout_packet),
 }

@@ -1,0 +1,169 @@
+"""The IBC relayer daemon (tools/relayer.py — the hermes/rly role).
+
+Two framework chains, a transfer, and the relayer doing EVERYTHING over
+public surfaces: reading send_packet events, recording client roots via
+MsgUpdateClient CONSENSUS txs, delivering MsgRecvPacket with a membership
+proof, then settling the written acknowledgement back. The native-token
+path exercises celestia's whole policy stack end-to-end: chain B's token
+filter rejects the foreign denom (error ack) and chain A refunds the
+sender automatically — one relayer loop, zero manual steps.
+"""
+
+from __future__ import annotations
+
+import json
+
+from celestia_app_tpu.chain.node import Node
+from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+from celestia_app_tpu.chain.tx import MsgTransfer
+from celestia_app_tpu.tools.relayer import ChainHandle, Relayer
+
+from test_app import make_app
+
+T0 = 1_700_000_000.0
+
+
+def _ctx(app):
+    return Context(app.store, InfiniteGasMeter(), app.height, T0,
+                   app.chain_id, app.app_version)
+
+
+def _wire(tmp_path):
+    """Two chains with client-backed channels BOTH ways and a relayer
+    account + node per side."""
+    chain_a, signer_a, privs_a = make_app()
+    chain_b, signer_b, privs_b = make_app()
+    chain_a.ibc.clients.create_client(_ctx(chain_a), "client-b")
+    chain_a.ibc.channels.open_channel(
+        _ctx(chain_a), "transfer", "channel-0", "transfer", "channel-1",
+        client_id="client-b",
+    )
+    chain_b.ibc.clients.create_client(_ctx(chain_b), "client-a")
+    chain_b.ibc.channels.open_channel(
+        _ctx(chain_b), "transfer", "channel-1", "transfer", "channel-0",
+        client_id="client-a",
+    )
+    a = ChainHandle(Node(chain_a), signer_a,
+                    privs_a[2].public_key().address(), "client-b")
+    b = ChainHandle(Node(chain_b), signer_b,
+                    privs_b[2].public_key().address(), "client-a")
+    return a, b, privs_a, privs_b
+
+
+def test_relayer_full_round_trip_with_tokenfilter_refund(tmp_path):
+    a, b, privs_a, privs_b = _wire(tmp_path)
+    sender = privs_a[0].public_key().address()
+
+    # the transfer is an ordinary consensus tx on A
+    tx = a.signer.create_tx(
+        sender,
+        [MsgTransfer(sender, "channel-0",
+                     privs_b[1].public_key().address().hex(), "utia",
+                     12_345)],
+        fee=2000, gas_limit=300_000,
+    )
+    assert a.node.broadcast_tx(tx.encode()).code == 0
+    a.signer.accounts[sender].sequence += 1
+    a.node.produce_block(t=T0 + 10)
+    bal_after_escrow = a.app.bank.balance(_ctx(a.app), sender)
+
+    relayer = Relayer(a, b)
+
+    # pass 1: client update + recv delivered to B
+    out1 = relayer.step()
+    assert out1["recv_a_to_b"] == 1
+    b.node.produce_block(t=T0 + 20)
+
+    # B's token filter refused the foreign denom: an ERROR ack is on B
+    packet = json.loads(
+        next(ev for _h, res in a.node.committed.values()
+             for ev in res.events if ev["type"] == "send_packet")
+        ["packet_json"]
+    )
+    ack = b.app.ibc.channels.get_ack(_ctx(b.app), packet)
+    assert ack is not None and "error" in ack
+
+    # pass 2: the ack settles on A -> refund (error ack unescrows)
+    out2 = relayer.step()
+    assert out2["acks_to_a"] == 1
+    a.node.produce_block(t=T0 + 30)
+    assert a.app.bank.balance(_ctx(a.app), sender) \
+        == bal_after_escrow + 12_345
+
+    # commitment consumed: nothing left to relay — steady state
+    out3 = relayer.step()
+    assert all(v == 0 for v in out3.values()), out3
+
+    # the client roots were recorded through CONSENSUS txs, not keeper
+    # side-writes: both chains saw an ibc.update_client event in a block
+    for h in (a, b):
+        evs = [ev for _hh, res in h.node.committed.values()
+               for ev in res.events if ev["type"] == "ibc.update_client"]
+        assert evs, f"no consensus client update on {h.client_id}"
+
+
+def test_relayer_is_idempotent_after_restart(tmp_path):
+    """A relayer that crashed mid-flow and restarted (fresh instance, no
+    local state) re-derives only the REMAINING work from chain state."""
+    a, b, privs_a, privs_b = _wire(tmp_path)
+    sender = privs_a[0].public_key().address()
+    tx = a.signer.create_tx(
+        sender,
+        [MsgTransfer(sender, "channel-0",
+                     privs_b[1].public_key().address().hex(), "utia", 999)],
+        fee=2000, gas_limit=300_000,
+    )
+    assert a.node.broadcast_tx(tx.encode()).code == 0
+    a.signer.accounts[sender].sequence += 1
+    a.node.produce_block(t=T0 + 10)
+
+    r1 = Relayer(a, b)
+    assert r1.step()["recv_a_to_b"] == 1
+    b.node.produce_block(t=T0 + 20)
+
+    # "crash": a brand-new relayer picks up at the ack-settlement stage
+    r2 = Relayer(a, b)
+    out = r2.step()
+    assert out["recv_a_to_b"] == 0  # not re-delivered
+    assert out["acks_to_a"] == 1
+    a.node.produce_block(t=T0 + 30)
+    assert all(v == 0 for v in Relayer(a, b).step().values())
+
+
+def test_malformed_update_client_fails_tx_never_the_chain(tmp_path):
+    """The consensus-halt class: wrong-shaped valset JSON or an empty
+    root in a MsgUpdateClient must fail THAT TX (code != 0) on every
+    validator identically — never escape block execution."""
+    from celestia_app_tpu.chain.tx import MsgUpdateClient
+
+    a, b, privs_a, _privs_b = _wire(tmp_path)
+    rel = a.relayer
+    t = T0 + 100
+    for i, bad in enumerate((b"[]", b"1", b'{"operators": []}',
+                             b'{"operators": {"zz": "yy"}}')):
+        msg = MsgUpdateClient(rel, "client-b", 50 + i, b"\x11" * 32,
+                              valset_json=bad)
+        tx = a.signer.create_tx(rel, [msg], fee=2000, gas_limit=200_000)
+        assert a.node.broadcast_tx(tx.encode()).code == 0
+        a.signer.accounts[rel].sequence += 1
+        t += 10
+        _blk, results = a.node.produce_block(t=t)
+        assert results[0].code != 0, f"payload {bad!r} was accepted"
+
+    # empty root on a trusting client: refused, client NOT bricked
+    msg = MsgUpdateClient(rel, "client-b", 60, b"")
+    tx = a.signer.create_tx(rel, [msg], fee=2000, gas_limit=200_000)
+    assert a.node.broadcast_tx(tx.encode()).code == 0
+    a.signer.accounts[rel].sequence += 1
+    _blk, results = a.node.produce_block(t=t + 10)
+    assert results[0].code != 0
+    assert a.app.ibc.clients.latest_height(_ctx(a.app), "client-b") in (
+        None, 0
+    )
+
+    # the chain is alive and a GOOD update still lands
+    msg = MsgUpdateClient(rel, "client-b", 61, b"\x22" * 32)
+    tx = a.signer.create_tx(rel, [msg], fee=2000, gas_limit=200_000)
+    assert a.node.broadcast_tx(tx.encode()).code == 0
+    _blk, results = a.node.produce_block(t=t + 20)
+    assert results[0].code == 0, results[0].log
