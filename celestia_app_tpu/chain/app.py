@@ -816,6 +816,7 @@ class App:
             self.ibc.transfer.send_transfer(
                 ctx, msg.source_channel, msg.sender, msg.receiver,
                 msg.denom, msg.amount,
+                timeout_height=msg.timeout_height,
             )
         elif isinstance(msg, MsgUpdateClient):
             # client-root recording as a consensus tx: replicated client

@@ -326,7 +326,12 @@ class MsgVote:
 
 @dataclasses.dataclass(frozen=True)
 class MsgTransfer:
-    """ibc-go transfer MsgTransfer (token filter guards the inbound side)."""
+    """ibc-go transfer MsgTransfer (token filter guards the inbound side).
+
+    `timeout_height` is the counterparty height after which the packet
+    may be timed out instead of received (ibc-go's TimeoutHeight; 0 =
+    no timeout). Encoded only when set, so pre-existing tx bytes are
+    unchanged."""
 
     TYPE = "ibc/MsgTransfer"
     sender: bytes
@@ -334,18 +339,26 @@ class MsgTransfer:
     receiver: str  # address encoding of the counterparty chain
     denom: str
     amount: int
+    timeout_height: int = 0
 
     def encode(self) -> bytes:
-        return (
+        out = (
             _b(self.sender) + _b(self.source_channel.encode())
             + _b(self.receiver.encode()) + _b(self.denom.encode())
             + uvarint(self.amount)
         )
+        if self.timeout_height:
+            out += uvarint(self.timeout_height)
+        return out
 
     @classmethod
     def decode(cls, raw: bytes) -> "MsgTransfer":
         r = _Reader(raw)
-        return cls(r.b(), r.b().decode(), r.b().decode(), r.b().decode(), r.u())
+        sender, chan, recv, denom, amount = (
+            r.b(), r.b().decode(), r.b().decode(), r.b().decode(), r.u()
+        )
+        timeout = 0 if r.done() else r.u()
+        return cls(sender, chan, recv, denom, amount, timeout)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -30,6 +30,7 @@ from celestia_app_tpu.chain.state import (
 from celestia_app_tpu.chain.tx import (
     MsgAcknowledgePacket,
     MsgRecvPacket,
+    MsgTimeoutPacket,
     MsgUpdateClient,
 )
 
@@ -100,13 +101,35 @@ class Relayer:
 
     def _pending_packets(self, src: ChainHandle,
                          dst: ChainHandle) -> list[dict]:
-        """Packets src committed that dst has not acknowledged yet."""
+        """Packets src committed that dst has not acknowledged yet —
+        excluding expired ones (hermes refuses to deliver past the
+        timeout; the timeout pass settles those instead)."""
         pending = []
         for ev in self._events(src, "send_packet"):
             packet = json.loads(ev["packet_json"])
+            timeout = int(packet.get("timeout_height") or 0)
+            if timeout and dst.app.height >= timeout:
+                continue
             if dst.app.ibc.channels.get_ack(dst.ctx(), packet) is None:
                 pending.append(packet)
         return pending
+
+    def _expired_packets(self, src: ChainHandle,
+                         dst: ChainHandle) -> list[dict]:
+        """src's packets whose timeout height has passed on dst with no
+        ack ever written — the set MsgTimeout settles (refund)."""
+        out = []
+        for ev in self._events(src, "send_packet"):
+            packet = json.loads(ev["packet_json"])
+            timeout = int(packet.get("timeout_height") or 0)
+            if timeout <= 0 or dst.app.height < timeout:
+                continue
+            if src.app.store.get(_commit_key(packet)) is None:
+                continue  # already settled (ack or prior timeout)
+            if dst.app.ibc.channels.get_ack(dst.ctx(), packet) is not None:
+                continue  # received in time: the ack pass settles it
+            out.append(packet)
+        return out
 
     def _unsettled_acks(self, src: ChainHandle,
                         dst: ChainHandle) -> list[tuple[dict, dict]]:
@@ -188,6 +211,25 @@ class Relayer:
             n += 1
         return n
 
+    def _relay_timeouts(self, src: ChainHandle, dst: ChainHandle) -> int:
+        """Refund src's expired packets: client view advanced past the
+        timeout height, plus an ABSENCE proof that dst never wrote the
+        ack (the receipt-absence gate in chain/ibc.timeout_packet)."""
+        n = 0
+        for packet in self._expired_packets(src, dst):
+            height = self._update_client(src, dst)
+            if height < int(packet["timeout_height"]):
+                continue  # client not past expiry yet; next pass
+            proof = dst.app.store.prove_absence(_ack_key(packet))
+            self._submit(src, MsgTimeoutPacket(
+                relayer=src.relayer,
+                packet_json=canonical_json(packet),
+                proof_json=canonical_json(proof),
+                proof_height=height,
+            ))
+            n += 1
+        return n
+
     def step(self) -> dict:
         """One relay pass in both directions. Delivery txs enter the
         mempools; the caller drives block production (or consensus does,
@@ -198,4 +240,6 @@ class Relayer:
             "recv_b_to_a": self._relay_packets(self.b, self.a),
             "acks_to_a": self._relay_acks(self.a, self.b),
             "acks_to_b": self._relay_acks(self.b, self.a),
+            "timeouts_to_a": self._relay_timeouts(self.a, self.b),
+            "timeouts_to_b": self._relay_timeouts(self.b, self.a),
         }

@@ -367,24 +367,30 @@ def _dec_exec(raw: bytes) -> itx.MsgExec:
 
 
 def _enc_transfer(m: itx.MsgTransfer) -> bytes:
-    return (
+    out = (
         field_string(1, "transfer")
         + field_string(2, m.source_channel)
         + field_message(3, coin_pb(m.denom, m.amount))
         + field_string(4, _addr_str(m.sender))
         + field_string(5, m.receiver)
     )
+    if m.timeout_height:
+        # ibc.core.client.v1.Height{revision_number=1, revision_height=2}
+        out += field_message(6, field_varint(2, m.timeout_height))
+    return out
 
 
 def _dec_transfer(raw: bytes) -> itx.MsgTransfer:
     f = Fields(raw)
     denom, amount = parse_coin(f.get_bytes(3)) if f.has(3) else (BOND_DENOM, 0)
+    timeout = Fields(f.get_bytes(6)).get_int(2) if f.has(6) else 0
     return itx.MsgTransfer(
         sender=_addr_bytes(f.get_string(4)),
         source_channel=f.get_string(2),
         receiver=f.get_string(5),
         denom=denom,
         amount=amount,
+        timeout_height=timeout,
     )
 
 

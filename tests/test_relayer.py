@@ -167,3 +167,54 @@ def test_malformed_update_client_fails_tx_never_the_chain(tmp_path):
     assert a.node.broadcast_tx(tx.encode()).code == 0
     _blk, results = a.node.produce_block(t=t + 20)
     assert results[0].code == 0, results[0].log
+
+
+def test_relayer_times_out_expired_packet_with_absence_proof(tmp_path):
+    """A packet whose timeout height passes on the counterparty WITHOUT
+    being received is settled by MsgTimeout: client update past expiry +
+    an ABSENCE proof of the never-written ack -> automatic refund. The
+    relayer refuses to deliver the expired packet (hermes semantics)."""
+    from celestia_app_tpu.chain.tx import MsgTransfer as MT
+
+    a, b, privs_a, _privs_b = _wire(tmp_path)
+    sender = privs_a[0].public_key().address()
+    bal0 = a.app.bank.balance(_ctx(a.app), sender)
+
+    # B is at height 0; timeout at B-height 2
+    tx = a.signer.create_tx(
+        sender,
+        [MT(sender, "channel-0", "00" * 20, "utia", 5_500,
+            timeout_height=2)],
+        fee=2000, gas_limit=300_000,
+    )
+    assert a.node.broadcast_tx(tx.encode()).code == 0
+    a.signer.accounts[sender].sequence += 1
+    a.node.produce_block(t=T0 + 10)
+    assert a.app.bank.balance(_ctx(a.app), sender) < bal0 - 2000  # escrowed
+
+    relayer = Relayer(a, b)
+    # B hasn't reached the timeout yet: the packet is still deliverable
+    assert relayer.step()["recv_a_to_b"] == 1
+    # ...but the delivery is LOST (dropped from B's mempool before any
+    # block includes it — the network-partition shape timeouts exist for)
+    b.node.mempool.clear()
+    for i in range(3):  # B passes the timeout height without receiving
+        b.node.produce_block(t=T0 + 20 + i)
+    r2 = Relayer(a, b)
+    out = r2.step()
+    assert out["recv_a_to_b"] == 0
+    assert out["timeouts_to_a"] == 1
+    a.node.produce_block(t=T0 + 40)
+    # refunded in full (minus fees paid)
+    assert a.app.ibc.channels.get_ack(_ctx(b.app), {
+        "destination_port": "transfer", "destination_channel": "channel-1",
+        "sequence": 1,
+    }) is None
+    esc_after = a.app.bank.balance(
+        _ctx(a.app),
+        __import__("celestia_app_tpu.chain.ibc",
+                   fromlist=["escrow_address"]).escrow_address(
+            "transfer", "channel-0"),
+    )
+    assert esc_after == 0  # escrow drained back to the sender
+    assert all(v == 0 for v in Relayer(a, b).step().values())
