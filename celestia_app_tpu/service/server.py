@@ -148,6 +148,66 @@ class NodeService:
                                 payload["path"], payload.get("data", {})
                             )
                         self._send(200, out)
+                    elif self.path == "/ibc/prove":
+                        # membership/absence proof of a raw store key: the
+                        # relayer's proof source (public data — any light
+                        # client could derive the same against the root)
+                        key = bytes.fromhex(payload["key"])
+                        try:
+                            with service.lock:
+                                if payload.get("absence"):
+                                    proof = (service.node.app.store
+                                             .prove_absence(key))
+                                else:
+                                    proof = service.node.app.store.prove(key)
+                        except KeyError:
+                            self._send(404, {"error": "no such key"})
+                            return
+                        self._send(200, {"proof": proof})
+                    elif self.path == "/ibc/ack":
+                        from celestia_app_tpu.chain.state import (
+                            Context, InfiniteGasMeter,
+                        )
+
+                        with service.lock:
+                            app = service.node.app
+                            ctx = Context(app.store, InfiniteGasMeter(),
+                                          app.height, 0, app.chain_id,
+                                          app.app_version)
+                            ack = app.ibc.channels.get_ack(
+                                ctx, payload["packet"]
+                            )
+                        self._send(200, {"ack": ack})
+                    elif self.path == "/ibc/client_height":
+                        from celestia_app_tpu.chain.state import (
+                            Context, InfiniteGasMeter,
+                        )
+
+                        with service.lock:
+                            app = service.node.app
+                            ctx = Context(app.store, InfiniteGasMeter(),
+                                          app.height, 0, app.chain_id,
+                                          app.app_version)
+                            h = app.ibc.clients.latest_height(
+                                ctx, payload["client_id"]
+                            )
+                        self._send(200, {"latest_height": h})
+                    elif self.path == "/ibc/events":
+                        # committed packet events, the relayer's work list
+                        # (bounded by the node's committed-index window)
+                        want = payload.get("type", "send_packet")
+                        with service.lock:
+                            rows = [
+                                {"height": h, **ev}
+                                for _tx, (h, res) in sorted(
+                                    service.node.committed.items(),
+                                    key=lambda kv: kv[1][0],
+                                )
+                                if res.code == 0
+                                for ev in res.events
+                                if ev.get("type") == want
+                            ]
+                        self._send(200, {"events": rows})
                     else:
                         self._send(404, {"error": f"no route {self.path}"})
                 except QueryError as e:

@@ -1,25 +1,37 @@
-"""IBC relayer: automatic packet + ack settlement between two chains.
+"""IBC relayer: automatic packet + ack + timeout settlement between two
+chains.
 
 The reference ecosystem delegates relaying to an external daemon
 (hermes/rly): watch chain A for send_packet events, update chain B's
 light client, submit MsgRecvPacket with a membership proof, then carry
-the written acknowledgement back to A the same way. This module is that
-daemon for two instances of THIS framework, speaking only public
-surfaces — committed tx events (ibc-go's event-sourcing reality: the
-chain stores only commitment hashes), `store.prove` for the membership
+the written acknowledgement (or an expiry + ack-ABSENCE proof) back to A
+the same way. This module is that daemon for two instances of THIS
+framework, speaking only public surfaces — committed tx events (ibc-go's
+event-sourcing reality: the chain stores only commitment hashes), store
 proofs, and ordinary signed transactions for delivery.
 
+Two chain transports share one relay engine:
+
+  ChainHandle      — in-process Node/ValidatorNode (tests, embedded use)
+  HttpChainHandle  — a LIVE node over its HTTP service (/ibc/* routes on
+                     service/server.py) — the hermes deployment shape:
+                     the relayer is its own process holding only its key.
+
 Idempotent by construction — no local database: a packet is pending-recv
-iff the destination has no ack recorded for it, and pending-ack-settle
-iff the source still holds its commitment (take_commitment deletes it on
-settlement). A crashed-and-restarted relayer re-derives exactly the
-remaining work from chain state.
+iff the destination has no ack recorded for it, pending-ack-settle iff
+the source still holds its commitment (take_commitment deletes it on
+settlement), and pending-timeout iff expired with no ack ever written. A
+crashed-and-restarted relayer re-derives exactly the remaining work from
+chain state.
 """
 
 from __future__ import annotations
 
+import base64
 import dataclasses
 import json
+import urllib.error
+import urllib.request
 
 from celestia_app_tpu.chain.ibc import ChannelKeeper
 from celestia_app_tpu.chain.state import (
@@ -33,28 +45,6 @@ from celestia_app_tpu.chain.tx import (
     MsgTimeoutPacket,
     MsgUpdateClient,
 )
-
-
-@dataclasses.dataclass
-class ChainHandle:
-    """One side of the relay: an in-process node + a funded relayer key.
-
-    `client_id` is the IBC client ON THIS CHAIN that tracks the
-    counterparty. `scan_heights` caps how far back events are re-read
-    each step (committed results are pruned node-side anyway)."""
-
-    node: object  # Node or ValidatorNode (broadcast_tx/produce-capable)
-    signer: object  # client.tx_client.Signer with the relayer account
-    relayer: bytes  # 20-byte relayer address
-    client_id: str
-
-    @property
-    def app(self):
-        return self.node.app
-
-    def ctx(self) -> Context:
-        return Context(self.app.store, InfiniteGasMeter(), self.app.height,
-                       0, self.app.chain_id, self.app.app_version)
 
 
 def _commit_key(packet: dict) -> bytes:
@@ -73,10 +63,144 @@ def _ack_key(packet: dict) -> bytes:
     )
 
 
-class Relayer:
-    """Bidirectional relayer over two ChainHandles."""
+@dataclasses.dataclass
+class ChainHandle:
+    """One side of the relay, in-process: a node + a funded relayer key.
+    `client_id` is the IBC client ON THIS CHAIN tracking the
+    counterparty."""
 
-    def __init__(self, a: ChainHandle, b: ChainHandle):
+    node: object  # Node or ValidatorNode (broadcast_tx-capable)
+    signer: object  # client.tx_client.Signer with the relayer account
+    relayer: bytes  # 20-byte relayer address
+    client_id: str
+
+    @property
+    def app(self):
+        return self.node.app
+
+    def ctx(self) -> Context:
+        return Context(self.app.store, InfiniteGasMeter(), self.app.height,
+                       0, self.app.chain_id, self.app.app_version)
+
+    # -- the transport surface the relay engine consumes -----------------
+
+    def height(self) -> int:
+        return self.app.height
+
+    def last_root(self) -> bytes:
+        return self.app.last_app_hash
+
+    def events(self, type_: str) -> list[dict]:
+        out = []
+        for _txhash, (_h, res) in sorted(
+            self.node.committed.items(), key=lambda kv: kv[1][0]
+        ):
+            if res.code != 0:
+                continue
+            out.extend(ev for ev in res.events if ev.get("type") == type_)
+        return out
+
+    def get_ack(self, packet: dict):
+        return self.app.ibc.channels.get_ack(self.ctx(), packet)
+
+    def has_commitment(self, packet: dict) -> bool:
+        return self.app.store.get(_commit_key(packet)) is not None
+
+    def prove(self, key: bytes) -> dict:
+        return self.app.store.prove(key)
+
+    def prove_absence(self, key: bytes) -> dict:
+        return self.app.store.prove_absence(key)
+
+    def client_latest_height(self):
+        return self.app.ibc.clients.latest_height(self.ctx(), self.client_id)
+
+    def submit(self, msg, gas: int = 500_000) -> None:
+        tx = self.signer.create_tx(self.relayer, [msg], fee=2000,
+                                   gas_limit=gas)
+        res = self.node.broadcast_tx(tx.encode())
+        if res.code != 0:
+            raise RuntimeError(f"relay tx rejected: {res.log}")
+        self.signer.accounts[self.relayer].sequence += 1
+
+
+@dataclasses.dataclass
+class HttpChainHandle:
+    """One side of the relay over a LIVE node's HTTP service — the hermes
+    deployment shape: the relayer process holds only its signing key and
+    the node URL; everything else comes from /ibc/* + /status +
+    /broadcast_tx (service/server.py)."""
+
+    url: str
+    signer: object
+    relayer: bytes
+    client_id: str
+    timeout: float = 15.0
+
+    def _get(self, path: str):
+        with urllib.request.urlopen(self.url.rstrip("/") + path,
+                                    timeout=self.timeout) as r:
+            return json.loads(r.read())
+
+    def _post(self, path: str, payload: dict):
+        req = urllib.request.Request(
+            self.url.rstrip("/") + path,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read())
+
+    def height(self) -> int:
+        return self._get("/status")["height"]
+
+    def last_root(self) -> bytes:
+        return bytes.fromhex(self._get("/status")["last_app_hash"])
+
+    def events(self, type_: str) -> list[dict]:
+        return self._post("/ibc/events", {"type": type_})["events"]
+
+    def get_ack(self, packet: dict):
+        return self._post("/ibc/ack", {"packet": packet})["ack"]
+
+    def has_commitment(self, packet: dict) -> bool:
+        # membership proof doubles as the existence check (404 = absent)
+        try:
+            self._post("/ibc/prove", {"key": _commit_key(packet).hex()})
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return False
+            raise
+
+    def prove(self, key: bytes) -> dict:
+        return self._post("/ibc/prove", {"key": key.hex()})["proof"]
+
+    def prove_absence(self, key: bytes) -> dict:
+        return self._post(
+            "/ibc/prove", {"key": key.hex(), "absence": True}
+        )["proof"]
+
+    def client_latest_height(self):
+        return self._post(
+            "/ibc/client_height", {"client_id": self.client_id}
+        )["latest_height"]
+
+    def submit(self, msg, gas: int = 500_000) -> None:
+        tx = self.signer.create_tx(self.relayer, [msg], fee=2000,
+                                   gas_limit=gas)
+        res = self._post("/broadcast_tx", {
+            "tx": base64.b64encode(tx.encode()).decode()
+        })
+        if res["code"] != 0:
+            raise RuntimeError(f"relay tx rejected: {res['log']}")
+        self.signer.accounts[self.relayer].sequence += 1
+
+
+class Relayer:
+    """Bidirectional relay engine over two handles (either transport)."""
+
+    def __init__(self, a, b):
         self.a = a
         self.b = b
         # heights already SUBMITTED (possibly uncommitted) per client: the
@@ -85,67 +209,53 @@ class Relayer:
         # the monotonicity check, burning the fee for nothing
         self._submitted_updates: dict[str, int] = {}
 
-    # -- event sourcing --------------------------------------------------
+    # -- work discovery (pure chain state; no local database) ------------
 
-    def _events(self, h: ChainHandle, type_: str) -> list[dict]:
-        out = []
-        for _txhash, (_height, res) in sorted(
-            h.node.committed.items(), key=lambda kv: kv[1][0]
-        ):
-            if res.code != 0:
-                continue
-            for ev in res.events:
-                if ev.get("type") == type_:
-                    out.append(ev)
-        return out
-
-    def _pending_packets(self, src: ChainHandle,
-                         dst: ChainHandle) -> list[dict]:
+    def _pending_packets(self, src, dst) -> list[dict]:
         """Packets src committed that dst has not acknowledged yet —
         excluding expired ones (hermes refuses to deliver past the
         timeout; the timeout pass settles those instead)."""
         pending = []
-        for ev in self._events(src, "send_packet"):
+        dst_height = dst.height()
+        for ev in src.events("send_packet"):
             packet = json.loads(ev["packet_json"])
             timeout = int(packet.get("timeout_height") or 0)
-            if timeout and dst.app.height >= timeout:
+            if timeout and dst_height >= timeout:
                 continue
-            if dst.app.ibc.channels.get_ack(dst.ctx(), packet) is None:
+            if dst.get_ack(packet) is None:
                 pending.append(packet)
         return pending
 
-    def _expired_packets(self, src: ChainHandle,
-                         dst: ChainHandle) -> list[dict]:
+    def _expired_packets(self, src, dst) -> list[dict]:
         """src's packets whose timeout height has passed on dst with no
         ack ever written — the set MsgTimeout settles (refund)."""
         out = []
-        for ev in self._events(src, "send_packet"):
+        dst_height = dst.height()
+        for ev in src.events("send_packet"):
             packet = json.loads(ev["packet_json"])
             timeout = int(packet.get("timeout_height") or 0)
-            if timeout <= 0 or dst.app.height < timeout:
+            if timeout <= 0 or dst_height < timeout:
                 continue
-            if src.app.store.get(_commit_key(packet)) is None:
+            if not src.has_commitment(packet):
                 continue  # already settled (ack or prior timeout)
-            if dst.app.ibc.channels.get_ack(dst.ctx(), packet) is not None:
+            if dst.get_ack(packet) is not None:
                 continue  # received in time: the ack pass settles it
             out.append(packet)
         return out
 
-    def _unsettled_acks(self, src: ChainHandle,
-                        dst: ChainHandle) -> list[tuple[dict, dict]]:
+    def _unsettled_acks(self, src, dst) -> list[tuple[dict, dict]]:
         """(packet, ack) pairs dst wrote whose commitment still sits on
         src (i.e. the ack has not settled back)."""
         out = []
-        for ev in self._events(dst, "write_acknowledgement"):
+        for ev in dst.events("write_acknowledgement"):
             packet = json.loads(ev["packet_json"])
-            if src.app.store.get(_commit_key(packet)) is not None:
+            if src.has_commitment(packet):
                 out.append((packet, json.loads(ev["ack_json"])))
         return out
 
     # -- client updates --------------------------------------------------
 
-    def _update_client(self, viewer: ChainHandle,
-                       viewed: ChainHandle) -> int:
+    def _update_client(self, viewer, viewed) -> int:
         """Record `viewed`'s latest committed root on `viewer`'s client —
         as a CONSENSUS TX (MsgUpdateClient), never a direct keeper write:
         on a replicated `viewer` chain, node-local client state would
@@ -154,16 +264,14 @@ class Relayer:
         updates here; a VERIFYING client additionally needs the header/
         cert/valset JSON payloads the msg carries (wire them from a
         light-client follower when the viewed chain runs one)."""
-        height = viewed.app.height
-        root = viewed.app.last_app_hash
-        known = viewer.app.ibc.clients.latest_height(
-            viewer.ctx(), viewer.client_id
-        )
+        height = viewed.height()
+        root = viewed.last_root()
+        known = viewer.client_latest_height()
         if known is not None and known >= height:
             return known  # already recorded — prove at that height
         if self._submitted_updates.get(viewer.client_id, -1) >= height:
             return height  # update already in this pass's mempool
-        self._submit(viewer, MsgUpdateClient(
+        viewer.submit(MsgUpdateClient(
             relayer=viewer.relayer,
             client_id=viewer.client_id,
             height=height,
@@ -174,19 +282,12 @@ class Relayer:
 
     # -- delivery --------------------------------------------------------
 
-    def _submit(self, h: ChainHandle, msg, gas: int = 500_000) -> None:
-        tx = h.signer.create_tx(h.relayer, [msg], fee=2000, gas_limit=gas)
-        res = h.node.broadcast_tx(tx.encode())
-        if res.code != 0:
-            raise RuntimeError(f"relay tx rejected: {res.log}")
-        h.signer.accounts[h.relayer].sequence += 1
-
-    def _relay_packets(self, src: ChainHandle, dst: ChainHandle) -> int:
+    def _relay_packets(self, src, dst) -> int:
         n = 0
         for packet in self._pending_packets(src, dst):
             height = self._update_client(dst, src)
-            proof = src.app.store.prove(_commit_key(packet))
-            self._submit(dst, MsgRecvPacket(
+            proof = src.prove(_commit_key(packet))
+            dst.submit(MsgRecvPacket(
                 relayer=dst.relayer,
                 packet_json=canonical_json(packet),
                 proof_json=canonical_json(proof),
@@ -195,13 +296,13 @@ class Relayer:
             n += 1
         return n
 
-    def _relay_acks(self, src: ChainHandle, dst: ChainHandle) -> int:
+    def _relay_acks(self, src, dst) -> int:
         """Settle on `src` the acks `dst` wrote for src's packets."""
         n = 0
         for packet, ack in self._unsettled_acks(src, dst):
             height = self._update_client(src, dst)
-            proof = dst.app.store.prove(_ack_key(packet))
-            self._submit(src, MsgAcknowledgePacket(
+            proof = dst.prove(_ack_key(packet))
+            src.submit(MsgAcknowledgePacket(
                 relayer=src.relayer,
                 packet_json=canonical_json(packet),
                 ack_json=canonical_json(ack),
@@ -211,7 +312,7 @@ class Relayer:
             n += 1
         return n
 
-    def _relay_timeouts(self, src: ChainHandle, dst: ChainHandle) -> int:
+    def _relay_timeouts(self, src, dst) -> int:
         """Refund src's expired packets: client view advanced past the
         timeout height, plus an ABSENCE proof that dst never wrote the
         ack (the receipt-absence gate in chain/ibc.timeout_packet)."""
@@ -220,8 +321,8 @@ class Relayer:
             height = self._update_client(src, dst)
             if height < int(packet["timeout_height"]):
                 continue  # client not past expiry yet; next pass
-            proof = dst.app.store.prove_absence(_ack_key(packet))
-            self._submit(src, MsgTimeoutPacket(
+            proof = dst.prove_absence(_ack_key(packet))
+            src.submit(MsgTimeoutPacket(
                 relayer=src.relayer,
                 packet_json=canonical_json(packet),
                 proof_json=canonical_json(proof),

@@ -218,3 +218,50 @@ def test_relayer_times_out_expired_packet_with_absence_proof(tmp_path):
     )
     assert esc_after == 0  # escrow drained back to the sender
     assert all(v == 0 for v in Relayer(a, b).step().values())
+
+
+def test_relayer_over_http_transport(tmp_path):
+    """The hermes deployment shape: the relayer is its own 'process'
+    holding only its keys and two node URLs — every read (events, acks,
+    client heights, proofs) and every delivery crosses a real HTTP
+    socket (/ibc/* routes on the node service)."""
+    from celestia_app_tpu.service.server import NodeService
+    from celestia_app_tpu.tools.relayer import HttpChainHandle
+
+    a, b, privs_a, privs_b = _wire(tmp_path)
+    svc_a = NodeService(a.node, port=0)
+    svc_b = NodeService(b.node, port=0)
+    svc_a.serve_background()
+    svc_b.serve_background()
+    try:
+        ha = HttpChainHandle(f"http://127.0.0.1:{svc_a.port}", a.signer,
+                             a.relayer, "client-b")
+        hb = HttpChainHandle(f"http://127.0.0.1:{svc_b.port}", b.signer,
+                             b.relayer, "client-a")
+
+        sender = privs_a[0].public_key().address()
+        tx = a.signer.create_tx(
+            sender,
+            [MsgTransfer(sender, "channel-0",
+                         privs_b[1].public_key().address().hex(), "utia",
+                         777)],
+            fee=2000, gas_limit=300_000,
+        )
+        assert a.node.broadcast_tx(tx.encode()).code == 0
+        a.signer.accounts[sender].sequence += 1
+        a.node.produce_block(t=T0 + 10)
+        bal_escrowed = a.app.bank.balance(_ctx(a.app), sender)
+
+        relayer = Relayer(ha, hb)
+        assert relayer.step()["recv_a_to_b"] == 1
+        b.node.produce_block(t=T0 + 20)
+        assert relayer.step()["acks_to_a"] == 1
+        a.node.produce_block(t=T0 + 30)
+
+        # tokenfilter error-ack -> refund, all through HTTP
+        assert a.app.bank.balance(_ctx(a.app), sender) \
+            == bal_escrowed + 777
+        assert all(v == 0 for v in Relayer(ha, hb).step().values())
+    finally:
+        svc_a.shutdown()
+        svc_b.shutdown()
