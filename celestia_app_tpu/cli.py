@@ -657,6 +657,56 @@ def _check_legacy_validator_home(home: str) -> str | None:
     return None
 
 
+def cmd_relayer(args) -> int:
+    """IBC relayer daemon over two live nodes' HTTP services (the hermes
+    role; tools/relayer.py). Loops step() until --passes completes or
+    forever; each pass prints its delivery counts."""
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.client.tx_client import Signer
+    from celestia_app_tpu.tools.relayer import HttpChainHandle, Relayer
+
+    def handle(url: str, seed: str, client_id: str) -> HttpChainHandle:
+        import urllib.request
+
+        priv = PrivateKey.from_seed(seed.encode())
+        addr = priv.public_key().address()
+        with urllib.request.urlopen(url.rstrip("/") + "/status",
+                                    timeout=10) as r:
+            chain_id = json.load(r)["chain_id"]
+        signer = Signer(chain_id)
+        # bootstrap the account number/sequence from the node
+        import urllib.request as _u
+
+        req = _u.Request(
+            url.rstrip("/") + "/abci_query",
+            data=json.dumps({"path": "auth/account",
+                             "data": {"address": addr.hex()}}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with _u.urlopen(req, timeout=10) as r:
+            acc = json.load(r).get("account") or {}
+        signer.add_account(priv, acc.get("number", 0),
+                           acc.get("sequence", 0))
+        return HttpChainHandle(url, signer, addr, client_id)
+
+    a = handle(args.url_a, args.seed_a, args.client_a)
+    b = handle(args.url_b, args.seed_b, args.client_b)
+    relayer = Relayer(a, b)
+    done = 0
+    while args.passes is None or done < args.passes:
+        try:
+            out = relayer.step()
+        except (OSError, RuntimeError) as e:
+            print(f"pass failed: {e}", file=sys.stderr)
+            out = None
+        if out is not None:
+            print(json.dumps(out), flush=True)
+        done += 1
+        if args.passes is None or done < args.passes:
+            time.sleep(args.interval)
+    return 0
+
+
 def cmd_verify(args) -> int:
     """Blobstream verification CLI (x/blobstream/client verify analog,
     ref client/verify.go:27-38): prove that shares at a height are
@@ -1663,6 +1713,25 @@ def main(argv=None) -> int:
                         "runs its own consensus reactor and gossips "
                         "proposals/votes/txs peer-to-peer")
     p.set_defaults(fn=cmd_devnet)
+
+    p = sub.add_parser(
+        "relayer",
+        help="IBC relayer daemon between two live nodes over HTTP "
+             "(hermes role): packets, acks, and timeouts, all "
+             "proof-gated consensus txs")
+    p.add_argument("--url-a", required=True, help="node A HTTP URL")
+    p.add_argument("--url-b", required=True, help="node B HTTP URL")
+    p.add_argument("--seed-a", required=True,
+                   help="relayer key seed on chain A (keys derive)")
+    p.add_argument("--seed-b", required=True)
+    p.add_argument("--client-a", default="client-b",
+                   help="client ON chain A tracking chain B")
+    p.add_argument("--client-b", default="client-a")
+    p.add_argument("--passes", type=int, default=None,
+                   help="relay passes to run (default: forever)")
+    p.add_argument("--interval", type=float, default=3.0,
+                   help="seconds between passes (ConfirmTx-style poll)")
+    p.set_defaults(fn=cmd_relayer)
 
     p = sub.add_parser(
         "verify",
