@@ -95,8 +95,20 @@ def _one_tx(rng, signer, addr) -> tuple[list[bytes], bool]:
             tx = signer.create_tx(addr, [msg], fee=10**5, gas_limit=10**6)
             return [tx.encode()], True
         if sub == 2:
-            # malformed relay msg: MUST fail the tx, never the block
-            msg = MsgRecvPacket(addr, b"{}", b"", 0)
+            # malformed relay/client msgs: MUST fail the tx, never the
+            # block (the consensus-halt class — all valid signatures over
+            # garbage payloads)
+            from celestia_app_tpu.chain.tx import MsgUpdateClient
+
+            bad = int(rng.integers(0, 3))
+            if bad == 0:
+                msg = MsgRecvPacket(addr, b"{}", b"", 0)
+            elif bad == 1:
+                msg = MsgUpdateClient(addr, "nope", 1, b"",
+                                      valset_json=b"[]")
+            else:
+                msg = MsgUpdateClient(addr, "x", 0, b"\x01" * 32,
+                                      header_json=b'{"broken": true}')
             tx = signer.create_tx(addr, [msg], fee=10**5, gas_limit=10**6)
             return [tx.encode()], True
         # oversize-gas send (fails in delivery, fee still charged)
