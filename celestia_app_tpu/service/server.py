@@ -192,6 +192,31 @@ class NodeService:
                                 ctx, payload["client_id"]
                             )
                         self._send(200, {"latest_height": h})
+                    elif self.path == "/ibc/header":
+                        # certified header + commit certificate at a
+                        # height (the verifying-client update payload);
+                        # 404 when this node is not consensus-backed or
+                        # the height is not yet certified
+                        from celestia_app_tpu.chain import (
+                            consensus as consensus_mod,
+                        )
+
+                        h = int(payload["height"])
+                        certs = getattr(service.node, "certificates", None)
+                        with service.lock:
+                            db = getattr(service.node.app, "db", None)
+                            if not certs or h not in certs or db is None:
+                                self._send(404, {"error": "not certified"})
+                                return
+                            block = db.load_block(h)
+                            self._send(200, {
+                                "header": consensus_mod.header_to_json(
+                                    block.header
+                                ),
+                                "cert": consensus_mod.cert_to_json(
+                                    certs[h]
+                                ),
+                            })
                     elif self.path == "/ibc/events":
                         # committed packet events, the relayer's work list
                         # (bounded by the node's committed-index window)

@@ -67,12 +67,17 @@ def _ack_key(packet: dict) -> bytes:
 class ChainHandle:
     """One side of the relay, in-process: a node + a funded relayer key.
     `client_id` is the IBC client ON THIS CHAIN tracking the
-    counterparty."""
+    counterparty; `verifying` marks that client as header-verified
+    (create_client with a trusted valset), which switches the relay
+    engine to the real light-client flow: prove at height H, update the
+    client with the CERTIFIED header for H+1 (whose app_hash IS the
+    state root after H), deliver with proof_height = H+1."""
 
     node: object  # Node or ValidatorNode (broadcast_tx-capable)
     signer: object  # client.tx_client.Signer with the relayer account
     relayer: bytes  # 20-byte relayer address
     client_id: str
+    verifying: bool = False
 
     @property
     def app(self):
@@ -123,6 +128,27 @@ class ChainHandle:
             raise RuntimeError(f"relay tx rejected: {res.log}")
         self.signer.accounts[self.relayer].sequence += 1
 
+    def update_payload(self, height: int):
+        """(header_json, cert_json) for a CERTIFIED block at `height` —
+        what a counterparty's VERIFYING client demands. Available when
+        this chain's node is consensus-backed (ValidatorNode: block
+        store + commit certificates); None until that block is certified
+        (the relayer retries next pass)."""
+        from celestia_app_tpu.chain import consensus as c
+
+        certs = getattr(self.node, "certificates", None)
+        db = getattr(self.app, "db", None)
+        if not certs or height not in certs or db is None:
+            return None
+        try:
+            block = db.load_block(height)
+        except (KeyError, ValueError, OSError):
+            return None
+        return (
+            json.dumps(c.header_to_json(block.header)).encode(),
+            json.dumps(c.cert_to_json(certs[height])).encode(),
+        )
+
 
 @dataclasses.dataclass
 class HttpChainHandle:
@@ -135,6 +161,7 @@ class HttpChainHandle:
     signer: object
     relayer: bytes
     client_id: str
+    verifying: bool = False
     timeout: float = 15.0
 
     def _get(self, path: str):
@@ -196,6 +223,20 @@ class HttpChainHandle:
             raise RuntimeError(f"relay tx rejected: {res['log']}")
         self.signer.accounts[self.relayer].sequence += 1
 
+    def update_payload(self, height: int):
+        try:
+            out = self._post("/ibc/header", {"height": height})
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+        if not out.get("header"):
+            return None
+        return (
+            json.dumps(out["header"]).encode(),
+            json.dumps(out["cert"]).encode(),
+        )
+
 
 class Relayer:
     """Bidirectional relay engine over two handles (either transport)."""
@@ -208,6 +249,12 @@ class Relayer:
         # duplicate same-height MsgUpdateClient deterministically fails
         # the monotonicity check, burning the fee for nothing
         self._submitted_updates: dict[str, int] = {}
+        # verifying-mode proof cache: a proof captured at tip H stays
+        # valid against its static root forever, but the header carrying
+        # that root (H+1) certifies one block LATER — without the cache
+        # every pass would re-prove at the new tip and chase it forever.
+        # Restart-safe: a fresh relayer re-captures and waits one block.
+        self._proof_cache: dict[bytes, tuple[dict, int]] = {}
 
     # -- work discovery (pure chain state; no local database) ------------
 
@@ -282,11 +329,62 @@ class Relayer:
 
     # -- delivery --------------------------------------------------------
 
+    def _verified_update(self, viewer, viewed, proof_state_height: int):
+        """The light-client flow for a VERIFYING viewer client: the state
+        root after height H only appears in header H+1's app_hash, so
+        prove against the tip (H) and update the client with the
+        certified header for H+1. Returns the proof height to use, or
+        None when H+1 is not certified yet (retry next pass — the proof
+        already captured stays valid against its static root)."""
+        target = proof_state_height + 1
+        known = viewer.client_latest_height()
+        if known is not None and known >= target:
+            return target  # header already recorded
+        payload = viewed.update_payload(target)
+        if payload is None:
+            return None
+        if self._submitted_updates.get(viewer.client_id, -1) >= target:
+            return target
+        header_json, cert_json = payload
+        viewer.submit(MsgUpdateClient(
+            relayer=viewer.relayer,
+            client_id=viewer.client_id,
+            height=target,
+            root=b"",  # the verified header supplies the root
+            header_json=header_json,
+            cert_json=cert_json,
+        ), gas=300_000)
+        self._submitted_updates[viewer.client_id] = target
+        return target
+
+    def _proof_for(self, chain, key: bytes, absence: bool = False):
+        """(proof, state_height) with verifying-mode caching (see
+        _proof_cache): the height is read BEFORE proving so the proof
+        binds to that height's root."""
+        cached = self._proof_cache.get(key)
+        if cached is not None:
+            return cached
+        state_height = chain.height()
+        proof = (chain.prove_absence(key) if absence
+                 else chain.prove(key))
+        self._proof_cache[key] = (proof, state_height)
+        return proof, state_height
+
     def _relay_packets(self, src, dst) -> int:
         n = 0
         for packet in self._pending_packets(src, dst):
-            height = self._update_client(dst, src)
-            proof = src.prove(_commit_key(packet))
+            key = _commit_key(packet)
+            if dst.verifying:
+                proof, state_height = self._proof_for(src, key)
+                height = self._verified_update(dst, src, state_height)
+                if height is None:
+                    continue  # src's next header not certified yet
+                self._proof_cache.pop(key, None)
+            else:
+                # trusting client: record the current root, prove against
+                # it in the same pass (no block in between)
+                height = self._update_client(dst, src)
+                proof = src.prove(key)
             dst.submit(MsgRecvPacket(
                 relayer=dst.relayer,
                 packet_json=canonical_json(packet),
@@ -300,8 +398,16 @@ class Relayer:
         """Settle on `src` the acks `dst` wrote for src's packets."""
         n = 0
         for packet, ack in self._unsettled_acks(src, dst):
-            height = self._update_client(src, dst)
-            proof = dst.prove(_ack_key(packet))
+            key = _ack_key(packet)
+            if src.verifying:
+                proof, state_height = self._proof_for(dst, key)
+                height = self._verified_update(src, dst, state_height)
+                if height is None:
+                    continue
+                self._proof_cache.pop(key, None)
+            else:
+                height = self._update_client(src, dst)
+                proof = dst.prove(key)
             src.submit(MsgAcknowledgePacket(
                 relayer=src.relayer,
                 packet_json=canonical_json(packet),
@@ -318,10 +424,19 @@ class Relayer:
         ack (the receipt-absence gate in chain/ibc.timeout_packet)."""
         n = 0
         for packet in self._expired_packets(src, dst):
-            height = self._update_client(src, dst)
+            key = _ack_key(packet)
+            if src.verifying:
+                proof, state_height = self._proof_for(dst, key,
+                                                      absence=True)
+                height = self._verified_update(src, dst, state_height)
+                if height is None:
+                    continue
+                self._proof_cache.pop(key, None)
+            else:
+                height = self._update_client(src, dst)
+                proof = dst.prove_absence(key)
             if height < int(packet["timeout_height"]):
                 continue  # client not past expiry yet; next pass
-            proof = dst.prove_absence(_ack_key(packet))
             src.submit(MsgTimeoutPacket(
                 relayer=src.relayer,
                 packet_json=canonical_json(packet),

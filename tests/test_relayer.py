@@ -16,6 +16,7 @@ import json
 from celestia_app_tpu.chain.node import Node
 from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
 from celestia_app_tpu.chain.tx import MsgTransfer
+from celestia_app_tpu.client.tx_client import Signer
 from celestia_app_tpu.tools.relayer import ChainHandle, Relayer
 
 from test_app import make_app
@@ -265,3 +266,132 @@ def test_relayer_over_http_transport(tmp_path):
     finally:
         svc_a.shutdown()
         svc_b.shutdown()
+
+
+def test_relayer_verifying_client_flow(tmp_path):
+    """The REAL light-client relay (hermes semantics): chain B's client
+    for A is VERIFYING — every root must arrive as a certified header.
+    The state root after height H only appears in header H+1, so the
+    relayer proves at H and updates the client with the >2/3-certified
+    header for H+1 before delivering. No say-so root ever touches B."""
+    from celestia_app_tpu.chain import consensus
+    from celestia_app_tpu.chain.crypto import PrivateKey
+
+    # chain A: a real 3-validator network with certified blocks + block
+    # store (the header source)
+    privs = [PrivateKey.from_seed(f"vrf-{i}".encode()) for i in range(3)]
+    genesis = {
+        "time_unix": T0,
+        "accounts": [
+            {"address": p.public_key().address().hex(), "balance": 10**12}
+            for p in privs
+        ],
+        "validators": [
+            {
+                "operator": p.public_key().address().hex(),
+                "power": 10,
+                "pubkey": p.public_key().compressed.hex(),
+            }
+            for p in privs
+        ],
+    }
+    nodes = [
+        consensus.ValidatorNode(f"a{i}", privs[i], genesis, "chain-a",
+                                data_dir=str(tmp_path / f"a{i}"))
+        for i in range(3)
+    ]
+    net = consensus.LocalNetwork(nodes)
+
+    class NetAdapter:
+        """ChainHandle transport over the validator network: txs fan to
+        every mempool; block store/certs come from node 0."""
+
+        def __init__(self, network):
+            self.net = network
+            self.app = network.nodes[0].app
+            self.certificates = network.nodes[0].certificates
+
+        @property
+        def committed(self):
+            return self.net.nodes[0].committed
+
+        def broadcast_tx(self, raw):
+            results = [n.add_tx(raw) for n in self.net.nodes]
+            return results[0]
+
+    # IBC wiring — identical keeper writes on EVERY validator pre-block
+    for n in nodes:
+        c_ctx = Context(n.app.store, InfiniteGasMeter(), n.app.height, T0,
+                        "chain-a", n.app.app_version)
+        n.app.ibc.clients.create_client(c_ctx, "client-b")
+        n.app.ibc.channels.open_channel(
+            c_ctx, "transfer", "channel-0", "transfer", "channel-1",
+            client_id="client-b",
+        )
+    chain_b, signer_b, privs_b = make_app()
+    bctx = _ctx(chain_b)
+    chain_b.ibc.clients.create_client(
+        bctx, "client-a", chain_id="chain-a",
+        validators={p.public_key().address(): p.public_key().compressed
+                    for p in privs},
+        powers={p.public_key().address(): 10 for p in privs},
+    )
+    chain_b.ibc.channels.open_channel(
+        bctx, "transfer", "channel-1", "transfer", "channel-0",
+        client_id="client-a",
+    )
+
+    signer_a = Signer("chain-a")
+    for i, p in enumerate(privs):
+        signer_a.add_account(p, number=i)
+    a = ChainHandle(NetAdapter(net), signer_a,
+                    privs[2].public_key().address(), "client-b")
+    b = ChainHandle(Node(chain_b), signer_b,
+                    privs_b[2].public_key().address(), "client-a",
+                    verifying=True)
+
+    # a transfer commits on A at height H
+    sender = privs[0].public_key().address()
+    tx = signer_a.create_tx(
+        sender,
+        [MsgTransfer(sender, "channel-0", "22" * 20, "utia", 4_242)],
+        fee=2000, gas_limit=300_000,
+    )
+    assert a.node.broadcast_tx(tx.encode()).code == 0
+    signer_a.accounts[sender].sequence += 1
+    blk, _cert = net.produce_height(t=T0 + 10)
+    assert blk is not None and len(blk.txs) == 1
+
+    relayer = Relayer(a, b)
+    # H+1 not certified yet: the verifying update cannot be built
+    assert relayer.step()["recv_a_to_b"] == 0
+    net.produce_height(t=T0 + 20)  # H+1 exists now, carrying root(H)
+    out = relayer.step()
+    assert out["recv_a_to_b"] == 1
+    b.node.produce_block(t=T0 + 30)
+
+    # B accepted the packet via a HEADER-verified root only
+    from celestia_app_tpu.chain.ibc import IBCError
+    import pytest as _pytest
+
+    with _pytest.raises(IBCError, match="header"):
+        # say-so updates stay impossible on B's client
+        chain_b.ibc.clients.update_client(
+            _ctx(chain_b), "client-a", 99, b"\x42" * 32
+        )
+
+    # the ack (tokenfilter error) settles back on A -> refund
+    bal_before = None
+    for n in nodes:
+        nctx = Context(n.app.store, InfiniteGasMeter(), n.app.height, T0,
+                       "chain-a", n.app.app_version)
+        bal = n.app.bank.balance(nctx, sender)
+        assert bal_before is None or bal == bal_before
+        bal_before = bal
+    assert relayer.step()["acks_to_a"] == 1
+    net.produce_height(t=T0 + 40)
+    for n in nodes:
+        nctx = Context(n.app.store, InfiniteGasMeter(), n.app.height, T0,
+                       "chain-a", n.app.app_version)
+        assert n.app.bank.balance(nctx, sender) == bal_before + 4_242
+    assert all(v == 0 for v in relayer.step().values())
