@@ -1782,9 +1782,11 @@ def main(argv=None) -> int:
     p.add_argument("--blob-kb", type=int, default=200,
                    help="per-blob size (reference floods 200 KB blobs)")
     p.add_argument("--blobs-per-tx", type=int, default=2)
-    p.add_argument("--txs-per-block", type=int, default=8,
+    p.add_argument("--txs-per-block", type=int, default=4,
                    help="load pacing: PFBs submitted per committed height "
-                        "(txsim's per-sequence-per-block pacing)")
+                        "(txsim's per-sequence-per-block pacing; the "
+                        "default 4 x 400 KB fills the 1.97 MB default "
+                        "square without flooding the mempool cap)")
     p.add_argument("--latency-ms", type=float, default=70.0,
                    help="injected per-message gossip latency "
                         "(BitTwister's 70 ms in the reference manifests)")
