@@ -47,15 +47,23 @@ class Vote:
     validator: bytes  # 20-byte operator address
     signature: bytes
     phase: str = "precommit"  # "prevote" | "precommit" (Tendermint steps)
+    round: int = 0
 
     @staticmethod
     def sign_bytes(
         chain_id: str, height: int, block_hash: bytes | None,
-        phase: str = "precommit",
+        phase: str = "precommit", round_: int = 0,
     ) -> bytes:
+        """The signed vote document. It commits to (chain_id, height,
+        ROUND, block hash, phase) — Tendermint's CanonicalVote fields
+        (celestia-core types/vote.go) — so a relayed old-round vote can
+        never be replayed into a newer round, per-round attribution is
+        exact, and same-round duplicate votes (either phase) are the
+        slashable double-sign."""
         doc = {
             "chain_id": chain_id,
             "height": height,
+            "round": round_,
             "block_hash": block_hash.hex() if block_hash else None,
             "type": phase,
         }
@@ -67,21 +75,28 @@ class CommitCertificate:
     height: int
     block_hash: bytes
     votes: tuple[Vote, ...]
+    # The commit round (Tendermint Commit.Round): every counted precommit
+    # must be FROM this round. Cross-round aggregation would void the
+    # textbook safety proof once unlock-on-higher-polka lets an honest
+    # validator legally precommit different hashes in different rounds.
+    round: int = 0
 
     def signed_power(self, chain_id: str, validators: dict[bytes, bytes],
                      powers: dict[bytes, int]) -> int:
         """THE vote-counting core: total power of distinct validators whose
-        precommit signature over THIS (height, block_hash) verifies against
-        `validators` (operator address -> 33-byte pubkey; the pubkey must
-        derive the address). Shared by certificate verification, the light
-        client's 2/3 and 1/3-overlap checks (chain/light.py), and the IBC
-        verifying client — one hardening fix reaches every consumer."""
+        precommit signature over THIS (height, round, block_hash) verifies
+        against `validators` (operator address -> 33-byte pubkey; the pubkey
+        must derive the address). Shared by certificate verification, the
+        light client's 2/3 and 1/3-overlap checks (chain/light.py), and the
+        IBC verifying client — one hardening fix reaches every consumer."""
         signed = 0
         seen: set[bytes] = set()
-        doc = Vote.sign_bytes(chain_id, self.height, self.block_hash)
+        doc = Vote.sign_bytes(chain_id, self.height, self.block_hash,
+                              round_=self.round)
         for v in self.votes:
             if (v.validator in seen or v.block_hash != self.block_hash
-                    or v.height != self.height or v.phase != "precommit"):
+                    or v.height != self.height or v.phase != "precommit"
+                    or v.round != self.round):
                 continue
             pub = validators.get(v.validator)
             if pub is None or PublicKey(pub).address() != v.validator:
@@ -168,6 +183,7 @@ def vote_to_json(v: Vote) -> dict:
         "validator": v.validator.hex(),
         "signature": v.signature.hex(),
         "phase": v.phase,
+        "round": v.round,
     }
 
 
@@ -178,6 +194,7 @@ def vote_from_json(d: dict) -> Vote:
         bytes.fromhex(d["validator"]),
         bytes.fromhex(d["signature"]),
         d.get("phase", "precommit"),
+        int(d.get("round", 0)),
     )
 
 
@@ -186,6 +203,7 @@ def cert_to_json(c: CommitCertificate) -> dict:
         "height": c.height,
         "block_hash": c.block_hash.hex(),
         "votes": [vote_to_json(v) for v in c.votes],
+        "round": c.round,
     }
 
 
@@ -194,6 +212,7 @@ def cert_from_json(d: dict) -> CommitCertificate:
         d["height"],
         bytes.fromhex(d["block_hash"]),
         tuple(vote_from_json(v) for v in d["votes"]),
+        int(d.get("round", 0)),
     )
 
 
@@ -400,30 +419,50 @@ class ValidatorNode:
 
     def _load_sign_state(self) -> None:
         """Tendermint's priv_validator_state.json: the last non-nil vote
-        hash signed per (height, phase), persisted BEFORE each signature
-        so a crashed-and-restarted validator can never be tricked (or
-        race itself) into signing a second, different non-nil vote at a
-        height it already voted — the self-inflicted double-sign that
-        round-blind vote signatures would make slashable."""
-        self._signed_hashes: dict[tuple[int, str], str] = {}
+        hash signed per (height, round, phase), persisted BEFORE each
+        signature so a crashed-and-restarted validator can never be
+        tricked (or race itself) into signing a second, different non-nil
+        vote at a (height, round) it already voted — now that votes sign
+        their round, a same-round duplicate in EITHER phase is the
+        slashable double-sign (celestia-core privval/file.go
+        checkVotesOnlyDifferByTimestamp analog)."""
+        self._signed_hashes: dict[tuple[int, int, str], str] = {}
+        # Tendermint's monotonic watermark: the highest (round, step)
+        # signed per height. A non-nil signature for an EARLIER slot is
+        # refused (nil instead) — a lying coordinator replaying an old
+        # round's genuine polka after we moved on (locks are in-memory;
+        # a restart loses them) could otherwise harvest conflicting
+        # cross-round precommits into two certificates at one height.
+        self._sign_watermark: dict[int, tuple[int, int]] = {}
         path = self._sign_state_path()
         if path is None or not os.path.exists(path):
             return
         with open(path) as f:
             doc = json.load(f)
-        self._signed_hashes = {
-            (int(h), p): v
-            for k, v in doc.get("signed", {}).items()
-            for h, p in [k.split(":", 1)]
-        }
+        for k, v in doc.get("signed", {}).items():
+            parts = k.split(":")
+            if len(parts) == 3:  # "height:round:phase"
+                self._signed_hashes[(int(parts[0]), int(parts[1]),
+                                     parts[2])] = v
+            elif len(parts) == 2:  # legacy round-blind "height:phase"
+                self._signed_hashes[(int(parts[0]), 0, parts[1])] = v
+        for h, rr in doc.get("watermark", {}).items():
+            self._sign_watermark[int(h)] = (int(rr[0]), int(rr[1]))
 
     def _persist_sign_state(self) -> None:
         path = self._sign_state_path()
         if path is None:
             return
-        doc = {"signed": {
-            f"{h}:{p}": v for (h, p), v in self._signed_hashes.items()
-        }}
+        doc = {
+            "signed": {
+                f"{h}:{r}:{p}": v
+                for (h, r, p), v in self._signed_hashes.items()
+            },
+            "watermark": {
+                str(h): list(rr)
+                for h, rr in self._sign_watermark.items()
+            },
+        }
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f)
@@ -431,65 +470,111 @@ class ValidatorNode:
             os.fsync(f.fileno())
         os.replace(tmp, path)
 
-    def _signed(self, height: int, bh: bytes | None, phase: str) -> Vote:
-        """Sign a vote — through the double-sign guard for PRECOMMITS: a
-        second non-nil precommit at a height we already precommitted must
-        carry the SAME hash, else we sign nil instead (safe: nil votes
-        can never form evidence or a certificate). Prevotes are exempt —
-        prevoting different blocks in successive rounds is legal
-        Tendermint behavior and required for liveness after a failed
-        round (detect_equivocation pools precommits only). Entries are
-        pruned once the chain moves past them."""
-        if bh is not None and phase == "precommit":
-            prior = self._signed_hashes.get((height, phase))
-            if prior is not None and prior != bh.hex():
-                bh = None  # refuse the double-sign; vote nil
-            else:
-                self._signed_hashes[(height, phase)] = bh.hex()
-                floor = self.app.height - 2
-                for k in [k for k in self._signed_hashes if k[0] < floor]:
-                    del self._signed_hashes[k]
-                self._persist_sign_state()
-        sig = self.priv.sign(
-            Vote.sign_bytes(self.app.chain_id, height, bh, phase)
-        )
-        return Vote(height, bh, self.address, sig, phase)
+    def _signed(self, height: int, bh: bytes | None, phase: str,
+                round_: int = 0) -> Vote:
+        """Sign a vote — through the durable double-sign guard (Tendermint
+        privval FilePV semantics), refusing with a signed NIL instead
+        (safe: nil votes can never form evidence or a certificate). Two
+        durable rules:
 
-    def prevote_on(self, block: Block) -> Vote:
+        1. same-slot: a second non-nil vote at a (height, round, phase)
+           we already signed must carry the SAME hash — a same-round
+           duplicate is the slashable double-sign;
+        2. monotonic: a non-nil vote for a slot EARLIER than the highest
+           (round, step) signed at this height is refused — without it a
+           lying coordinator (or a crash that dropped the in-memory
+           lock) could walk us back to an old round's genuine polka and
+           collect the conflicting old-round precommit that forges a
+           second certificate at the height.
+
+        Signing different hashes in LATER rounds stays legal (required
+        for liveness: re-prevoting a fresh proposal after a failed
+        round, re-precommitting after unlock-on-higher-polka). Entries
+        are pruned once the chain moves past them."""
+        slot = (round_, 0 if phase == "prevote" else 1)
+        wm = self._sign_watermark.get(height)
+        changed = False
+        if bh is not None:
+            if wm is not None and slot < wm:
+                bh = None  # slot regression: refuse
+            else:
+                key = (height, round_, phase)
+                prior = self._signed_hashes.get(key)
+                if prior is not None and prior != bh.hex():
+                    bh = None  # refuse the double-sign; vote nil
+                elif prior is None:
+                    self._signed_hashes[key] = bh.hex()
+                    changed = True
+        if wm is None or slot > wm:
+            # every signature advances the watermark — nil ones too
+            # (Tendermint persists every signed vote): a nil precommit at
+            # round r must block a later non-nil signature for round < r
+            self._sign_watermark[height] = slot
+            changed = True
+        if changed:  # persist REAL transitions only (idempotent re-signs
+            # of a recorded slot+hash skip the fsync on the hot path)
+            floor = self.app.height - 2
+            for k in [k for k in self._signed_hashes if k[0] < floor]:
+                del self._signed_hashes[k]
+            for h in [h for h in self._sign_watermark if h < floor]:
+                del self._sign_watermark[h]
+            self._persist_sign_state()
+        sig = self.priv.sign(
+            Vote.sign_bytes(self.app.chain_id, height, bh, phase, round_)
+        )
+        return Vote(height, bh, self.address, sig, phase, round_)
+
+    def prevote_on(self, block: Block, round_: int = 0) -> Vote:
         """Prevote step: nil unless the proposal validates AND does not
         conflict with an existing lock."""
         h = block.header.height
         bh = block.header.hash()
         if self.locked_block is not None:
             if self.locked_block.header.hash() == bh:
-                return self._signed(h, bh, "prevote")  # already validated
-            return self._signed(h, None, "prevote")  # locked elsewhere: nil
+                return self._signed(h, bh, "prevote", round_)  # validated
+            return self._signed(h, None, "prevote", round_)  # locked: nil
         ok = self.app.process_proposal(block)
-        return self._signed(h, bh if ok else None, "prevote")
+        return self._signed(h, bh if ok else None, "prevote", round_)
+
+    def lock_permits(self, block_hash: bytes, round_: int) -> bool:
+        """THE lock discipline, shared by the autonomous reactor and the
+        orchestrated server (one definition — divergent copies would give
+        the two modes different consensus safety rules): a locked
+        validator may precommit `block_hash` at `round_` iff it is
+        unlocked, the hash IS its lock, or the polka is from a LATER
+        round than its lock (Tendermint unlock-on-higher-polka, sound
+        now that votes sign their round)."""
+        return (self.locked_block is None
+                or self.locked_block.header.hash() == block_hash
+                or round_ > self.locked_round)
 
     def on_polka(self, block: Block, round_: int) -> None:
-        """>2/3 prevoted this block: lock on it (lock-on-polka)."""
+        """>2/3 prevoted this block: lock on it (lock-on-polka). A polka
+        at a LATER round than the current lock replaces it — Tendermint's
+        unlock-on-higher-polka, sound now that votes sign their round."""
+        if self.locked_block is not None and round_ < self.locked_round:
+            return  # never regress to an older lock
         self.locked_block = block
         self.locked_round = round_
 
-    def precommit_on(self, block: Block | None) -> Vote:
+    def precommit_on(self, block: Block | None, round_: int = 0) -> Vote:
         """Precommit the polka block, or nil when no polka was observed."""
         if block is None:
             height = self.app.height + 1
-            return self._signed(height, None, "precommit")
+            return self._signed(height, None, "precommit", round_)
         bh = block.header.hash()
-        return self._signed(block.header.height, bh, "precommit")
+        return self._signed(block.header.height, bh, "precommit", round_)
 
     def clear_lock(self) -> None:
         self.locked_block = None
         self.locked_round = -1
 
-    def vote_on(self, block: Block) -> Vote:
+    def vote_on(self, block: Block, round_: int = 0) -> Vote:
         """One-shot validate+precommit (single-phase fixtures and tests);
         the network path uses prevote_on/precommit_on."""
         ok = self.app.process_proposal(block)
         bh = block.header.hash() if ok else None
-        return self._signed(block.header.height, bh, "precommit")
+        return self._signed(block.header.height, bh, "precommit", round_)
 
     def known_pubkeys(self) -> dict[bytes, bytes]:
         """operator -> consensus pubkey from BOTH trust roots: the genesis
@@ -553,6 +638,10 @@ class ValidatorNode:
             "height": block.header.height,
             **block_to_json(block),
             "votes": [vote_to_json(v) for v in cert.votes],
+            # the commit round: replay must rebuild the certificate with
+            # it, or a round>0 cert's round-scoped votes count as zero
+            # power (and the presence set reads empty) after restart
+            "cert_round": cert.round,
         }
         if record_present:
             doc["present"] = (
@@ -592,10 +681,12 @@ class ValidatorNode:
         if cert is None:
             return None
         known = self.known_pubkeys()
-        doc = Vote.sign_bytes(self.app.chain_id, cert.height, cert.block_hash)
+        doc = Vote.sign_bytes(self.app.chain_id, cert.height,
+                              cert.block_hash, round_=cert.round)
         voted = set()
         for v in cert.votes:
-            if v.block_hash != cert.block_hash or v.height != cert.height:
+            if (v.block_hash != cert.block_hash or v.height != cert.height
+                    or v.round != cert.round):
                 continue
             pub = known.get(v.validator)
             if pub is not None and not PublicKey(pub).verify(v.signature, doc):
@@ -717,7 +808,8 @@ class ValidatorNode:
                 doc = json.load(f)
             block = block_from_json(doc)
             votes = tuple(vote_from_json(v) for v in doc["votes"])
-            cert = CommitCertificate(height, block.header.hash(), votes)
+            cert = CommitCertificate(height, block.header.hash(), votes,
+                                     int(doc.get("cert_round", 0)))
             evidence = tuple(
                 evidence_from_json(e) for e in doc.get("evidence", [])
             )
@@ -839,20 +931,38 @@ class DuplicateVoteEvidence:
             return False
         if a.height != self.height or b.height != self.height:
             return False  # both votes must be AT the evidence height
+        if a.block_hash is None or b.block_hash is None:
+            # nil votes are never equivocation — the sign guard's refusal
+            # path EMITS signed nils at slots where a non-nil already
+            # exists, so a (non-nil, nil) pair is an honest validator
+            # protecting itself, not a double-sign
+            return False
         if a.block_hash == b.block_hash:
             return False  # same block: not equivocation
         if a.phase != b.phase:
-            # prevote(A)+precommit(B) across rounds is a legal Tendermint
-            # history (unlock via a later polka); only duplicate votes in
-            # the SAME step are slashable
+            # prevote(A)+precommit(B) is a legal Tendermint history
+            # (unlock via a later polka); only duplicate votes in the
+            # SAME step are slashable
+            return False
+        if a.round != b.round:
+            # different rounds: legal protocol behavior in BOTH phases —
+            # re-prevoting a fresh proposal after a failed round, or
+            # re-precommitting after unlock-on-higher-polka. Without this
+            # check a byzantine proposer could package two honest
+            # cross-round votes as "evidence" and have the network slash
+            # an honest validator (round-4 advisor finding).
             return False
         pub = PublicKey(pubkey)
         if pub.address() != a.validator:
             return False
         return pub.verify(
-            a.signature, Vote.sign_bytes(chain_id, a.height, a.block_hash, a.phase)
+            a.signature,
+            Vote.sign_bytes(chain_id, a.height, a.block_hash, a.phase,
+                            a.round),
         ) and pub.verify(
-            b.signature, Vote.sign_bytes(chain_id, b.height, b.block_hash, b.phase)
+            b.signature,
+            Vote.sign_bytes(chain_id, b.height, b.block_hash, b.phase,
+                            b.round),
         )
 
 
@@ -860,25 +970,26 @@ def detect_equivocation(
     chain_id: str, votes_by_round: list[list[Vote]],
     validators: dict[bytes, bytes],
 ) -> list[DuplicateVoteEvidence]:
-    """Scan one height's votes (across rounds) for validators that signed
-    two different block hashes; returns verified evidence only."""
-    # PRECOMMITS only. Votes carry no round number, and prevoting different
-    # blocks in different ROUNDS is legal Tendermint behavior (a failed
-    # round rotates to a fresh proposal every honest validator prevotes) —
-    # pooling prevotes would convict honest validators. Precommits are
-    # polka-gated: without >1/3 byzantine power, one validator can never
-    # honestly precommit two blocks at one height, so a duplicate precommit
-    # IS the classic slashable double-sign.
-    seen: dict[tuple[bytes, int, str], Vote] = {}
+    """Scan one height's votes for validators that signed two different
+    block hashes at the same (round, phase); returns verified evidence
+    only."""
+    # SAME (round, phase) only. Votes sign their round, so an honest
+    # validator produces at most one non-nil vote per (height, round,
+    # phase) — prevoting different blocks in different ROUNDS (failed
+    # round rotates the proposal) and precommitting different blocks in
+    # different rounds (unlock-on-higher-polka) are both legal protocol
+    # histories. A same-round duplicate in EITHER phase is the classic
+    # slashable double-sign (celestia-core types/evidence.go).
+    seen: dict[tuple[bytes, int, int, str], Vote] = {}
     out: list[DuplicateVoteEvidence] = []
     accused: set[bytes] = set()
     for votes in votes_by_round:
         for v in votes:
             if v.block_hash is None or v.validator in accused:
                 continue
-            if v.phase != "precommit":
+            if v.phase not in ("prevote", "precommit"):
                 continue
-            key = (v.validator, v.height, v.phase)
+            key = (v.validator, v.height, v.round, v.phase)
             prior = seen.get(key)
             if prior is None:
                 seen[key] = v
@@ -969,13 +1080,17 @@ class LocalNetwork:
         }
 
         # -- prevote phase ----------------------------------------------
-        own_prevotes = [n.prevote_on(block) for n in self.nodes]
+        own_prevotes = [n.prevote_on(block, self._round) for n in self.nodes]
         prevotes = list(own_prevotes)
         if vote_filter is not None:
             prevotes = list(vote_filter("prevote", prevotes))
-        # prevotes do NOT enter the evidence pool: without a round number a
-        # legal round-0-A/round-1-B prevote pair is indistinguishable from
-        # equivocation (see detect_equivocation)
+        # prevotes enter the evidence pool too: votes sign their round, so
+        # detect_equivocation pairs only same-round duplicates — a legal
+        # round-0-A/round-1-B prevote history can no longer be mistaken
+        # for equivocation
+        self._vote_pool.extend(
+            v for v in prevotes if v.block_hash is not None
+        )
         prevote_power = sum(
             powers.get(v.validator, 0)
             for v in prevotes
@@ -991,9 +1106,9 @@ class LocalNetwork:
         for n, pv in zip(self.nodes, own_prevotes):
             if polka and pv.block_hash == bh:
                 n.on_polka(block, self._round)
-                precommits.append(n.precommit_on(block))
+                precommits.append(n.precommit_on(block, self._round))
             else:
-                precommits.append(n.precommit_on(None))
+                precommits.append(n.precommit_on(None, self._round))
         if vote_filter is not None:
             precommits = list(vote_filter("precommit", precommits))
         self._vote_pool.extend(
@@ -1001,7 +1116,7 @@ class LocalNetwork:
         )
         self._prune_vote_pool(height)
 
-        cert = CommitCertificate(height, bh, tuple(precommits))
+        cert = CommitCertificate(height, bh, tuple(precommits), self._round)
         if not cert.verify(self.chain_id, validators, total, powers):
             self._round += 1
             return None, None
@@ -1041,7 +1156,7 @@ class LocalNetwork:
         if not PublicKey(pub).verify(
             vote.signature,
             Vote.sign_bytes(self.chain_id, vote.height, vote.block_hash,
-                            vote.phase),
+                            vote.phase, vote.round),
         ):
             raise ValueError("vote signature verification failed")
         self._vote_pool.append(vote)

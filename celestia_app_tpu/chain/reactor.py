@@ -25,13 +25,13 @@ and the evidence list, and apply() consumes THOSE for absence accounting
 Trust model: all inbound gossip is verified locally — proposal signatures
 against the expected proposer for (height, round), vote signatures
 against genesis pubkeys, certificates against the node's own staking
-powers — a byzantine peer can at most waste inbox space. One honest
-caveat, documented: vote signatures commit to (height, hash, phase) but
-NOT the round (the orchestrated mode's wire format, kept compatible), so
-a relayer can replay an old-round vote into a newer round. That cannot
-forge a certificate (certs are round-blind by design) or a polka for a
-hash the validator never prevoted; it only weakens per-round vote
-attribution.
+powers — a byzantine peer can at most waste inbox space. Vote signatures
+commit to (chain_id, height, ROUND, hash, phase) — Tendermint's
+CanonicalVote fields (celestia-core types/vote.go) — so a relayed
+old-round vote cannot be replayed into a newer round, certificates are
+round-scoped (Commit.round), and per-round attribution is exact; the
+unlock-on-higher-polka rule this enables keeps a locked validator live
+when the network polkas a different block in a later round.
 
 Catch-up: a node that misses the commit gossip for its next height asks
 peers for their recent commit record (GET /gossip/commit_at) and, if the
@@ -198,13 +198,15 @@ class ConsensusReactor:
         self._note_height(prop.height)
 
     def on_vote(self, doc: dict) -> None:
-        round_ = int(doc.get("round", 0))
         vote = c.vote_from_json(doc["vote"])
         pub = self._pubkey_cache.get(vote.validator)
         if pub is None:
             return
+        # the vote's OWN signed round is authoritative — the envelope
+        # round is transport metadata a relayer could rewrite
         signed = c.Vote.sign_bytes(
-            self.vnode.app.chain_id, vote.height, vote.block_hash, vote.phase
+            self.vnode.app.chain_id, vote.height, vote.block_hash,
+            vote.phase, vote.round,
         )
         from celestia_app_tpu.chain.crypto import PublicKey
 
@@ -212,12 +214,13 @@ class ConsensusReactor:
             return
         with self._msg_lock:
             pool = self._votes.setdefault(
-                (vote.height, round_, vote.phase), {}
+                (vote.height, vote.round, vote.phase), {}
             )
             fresh = vote.validator not in pool
             pool.setdefault(vote.validator, vote)
-            if (fresh and vote.phase == "precommit"
-                    and vote.block_hash is not None):
+            if fresh and vote.block_hash is not None:
+                # both phases feed the evidence pool: same-round
+                # duplicates in either phase are slashable
                 self._vote_pool.append(vote)
         telemetry.incr("reactor.gossip.votes")
         self._note_height(vote.height)
@@ -657,10 +660,10 @@ class ConsensusReactor:
                 accept = self._proposal_acceptable(prop, height)
         if accept:
             with self.service_lock:
-                pv = self.vnode.prevote_on(prop.block)  # ProcessProposal
+                pv = self.vnode.prevote_on(prop.block, r)  # ProcessProposal
         else:
             with self.service_lock:
-                pv = self.vnode._signed(height, None, "prevote")
+                pv = self.vnode._signed(height, None, "prevote", r)
         self.on_vote({"round": r, "vote": c.vote_to_json(pv)})
         self._gossip("/gossip/vote",
                      {"round": r, "vote": c.vote_to_json(pv)})
@@ -695,25 +698,25 @@ class ConsensusReactor:
         # ---- precommit ----
         self.step = "precommit"
         with self.service_lock:
-            locked = self.vnode.locked_block
-            lock_ok = (locked is None
-                       or locked.header.hash() == polka_hash)
-            # While locked on a different block, precommit NIL even on a
-            # fresh polka: our votes carry no round number, so a second
-            # non-nil precommit for a different hash at this height would
-            # be indistinguishable from a double-sign — peers would
-            # generate VALID slashing evidence against an honest node.
-            # Safety over liveness for this one validator: it abstains
-            # until the network commits (adopted via gossip, which clears
-            # the lock) — Tendermint's unlock-on-higher-polka needs
-            # round-scoped votes this wire format deliberately lacks.
+            # Tendermint lock discipline with round-scoped votes
+            # (ValidatorNode.lock_permits — one definition shared with
+            # the orchestrated server): precommit the polka block when it
+            # matches our lock, we are unlocked, or the polka is at a
+            # LATER round than our lock (unlock-on-higher-polka — the
+            # cross-round precommit is legal and signed with this round,
+            # so it can never read as a double-sign). `accept` gates
+            # validity: the signed envelope carries the commit info
+            # apply() will consume, so a polka on a block whose envelope
+            # WE could not validate gets nil.
+            lock_ok = (polka_hash is not None
+                       and self.vnode.lock_permits(polka_hash, r))
             if (polka_hash is not None and prop is not None
                     and prop.block.header.hash() == polka_hash
-                    and lock_ok):
+                    and accept and lock_ok):
                 self.vnode.on_polka(prop.block, r)
-                pc = self.vnode.precommit_on(prop.block)
+                pc = self.vnode.precommit_on(prop.block, r)
             else:
-                pc = self.vnode.precommit_on(None)
+                pc = self.vnode.precommit_on(None, r)
         self.on_vote({"round": r, "vote": c.vote_to_json(pc)})
         self._gossip("/gossip/vote",
                      {"round": r, "vote": c.vote_to_json(pc)})
@@ -768,7 +771,7 @@ class ConsensusReactor:
 
         # ---- commit ----
         self.step = "commit"
-        cert = c.CommitCertificate(height, polka_hash, cert_votes)
+        cert = c.CommitCertificate(height, polka_hash, cert_votes, r)
         doc = {"proposal": c.proposal_to_json(prop),
                "cert": c.cert_to_json(cert)}
         with self.service_lock:

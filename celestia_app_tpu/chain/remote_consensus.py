@@ -72,10 +72,11 @@ class RemoteValidator:
         return self._call("POST", "/consensus/propose", {"time": t},
                           timeout=timeout)["block"]
 
-    def prevote(self, block_json: dict,
+    def prevote(self, block_json: dict, round_: int = 0,
                 timeout: float | None = None) -> c.Vote:
         out = self._call("POST", "/consensus/prevote",
-                         {"block": block_json}, timeout=timeout)
+                         {"block": block_json, "round": round_},
+                         timeout=timeout)
         return c.vote_from_json(out["vote"])
 
     def precommit(self, block_json: dict | None, polka: bool,
@@ -210,11 +211,16 @@ class SocketNetwork:
         vote_timeout = self._phase_timeout(self.TIMEOUT_VOTE_S)
         for p, _st in participants:
             try:
-                prevotes.append(p.prevote(block_json, timeout=vote_timeout))
+                prevotes.append(p.prevote(block_json, self._round,
+                                          timeout=vote_timeout))
             except (PeerDown, ValueError):
                 continue
-        # prevotes stay out of the evidence pool (cross-round prevotes for
-        # different blocks are legal — detect_equivocation's contract)
+        # prevotes enter the evidence pool too: round-signed votes make
+        # same-round prevote duplicates slashable while cross-round
+        # re-prevoting stays legal (detect_equivocation's contract)
+        self._vote_pool.extend(
+            v for v in prevotes if v.block_hash is not None
+        )
         prevote_power = sum(
             self.powers.get(v.validator, 0)
             for v in prevotes
@@ -240,7 +246,8 @@ class SocketNetwork:
         )
         self._prune_vote_pool(height)
 
-        cert = c.CommitCertificate(height, bh, tuple(precommits))
+        cert = c.CommitCertificate(height, bh, tuple(precommits),
+                                   self._round)
         if not cert.verify(self.chain_id, self.pubkeys, total, self.powers):
             self._round += 1
             return None, None

@@ -191,22 +191,34 @@ class ValidatorService:
 
     def _prevote(self, p: dict) -> dict:
         block = c.block_from_json(p["block"])
-        return {"vote": c.vote_to_json(self.vnode.prevote_on(block))}
+        round_ = int(p.get("round", 0))
+        return {"vote": c.vote_to_json(self.vnode.prevote_on(block, round_))}
 
     def _precommit(self, p: dict) -> dict:
         """polka=true: the orchestrator relays the >2/3 prevote set as the
         polka justification; the node re-counts it AGAINST ITS OWN trust
-        roots before locking — a lying coordinator cannot force a lock."""
+        roots before locking — a lying coordinator cannot force a lock.
+        The polka must be FROM the precommit's round (stale-round prevote
+        pooling is refused in _polka_checks_out), must not regress an
+        existing lock to an older round, and the sign guard's monotonic
+        watermark independently refuses old-round signatures — three
+        layers against coordinator-harvested conflicting precommits."""
+        round_ = int(p.get("round", 0))
         if not p.get("polka"):
-            return {"vote": c.vote_to_json(self.vnode.precommit_on(None))}
+            return {"vote": c.vote_to_json(
+                self.vnode.precommit_on(None, round_))}
         block = c.block_from_json(p["block"])
         prevotes = [c.vote_from_json(v) for v in p.get("prevotes", [])]
-        if not self._polka_checks_out(block, prevotes):
-            return {"vote": c.vote_to_json(self.vnode.precommit_on(None))}
-        self.vnode.on_polka(block, int(p.get("round", 0)))
-        return {"vote": c.vote_to_json(self.vnode.precommit_on(block))}
+        lock_ok = self.vnode.lock_permits(block.header.hash(), round_)
+        if not lock_ok or not self._polka_checks_out(block, prevotes,
+                                                     round_):
+            return {"vote": c.vote_to_json(
+                self.vnode.precommit_on(None, round_))}
+        self.vnode.on_polka(block, round_)
+        return {"vote": c.vote_to_json(
+            self.vnode.precommit_on(block, round_))}
 
-    def _polka_checks_out(self, block, prevotes) -> bool:
+    def _polka_checks_out(self, block, prevotes, round_: int) -> bool:
         from celestia_app_tpu.chain.crypto import PublicKey
         from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
 
@@ -218,11 +230,15 @@ class ValidatorService:
         known = v.known_pubkeys()
         signed = 0
         seen: set[bytes] = set()
-        doc = c.Vote.sign_bytes(v.app.chain_id, block.header.height, bh,
-                                "prevote")
+        # a polka is >2/3 prevote power in ONE round — the round we are
+        # being asked to precommit. Counting each prevote against its own
+        # claimed round would let a lying coordinator pool stale prevotes
+        # from failed rounds into a quorum no single round ever had.
+        doc = c.Vote.sign_bytes(v.app.chain_id, block.header.height,
+                                bh, "prevote", round_)
         for pv in prevotes:
             if (pv.block_hash != bh or pv.phase != "prevote"
-                    or pv.validator in seen):
+                    or pv.round != round_ or pv.validator in seen):
                 continue
             pub = known.get(pv.validator)
             if pub is None or not PublicKey(pub).verify(pv.signature, doc):

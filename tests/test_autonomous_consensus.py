@@ -321,3 +321,54 @@ def test_late_starter_catches_up(net4):
     target = net4.nodes[0].app.height + 1
     net4.wait_heights(target, timeout=120.0)
     net4.assert_no_divergence()
+
+
+def test_proposal_with_cross_round_prevote_evidence_rejected():
+    """Advisor A1 regression on the ACCEPTANCE path: a byzantine proposer
+    packaging two honest cross-round prevotes as DuplicateVoteEvidence
+    must fail _proposal_acceptable — nodes would otherwise slash and
+    tombstone an honest validator for legal failed-round re-prevoting.
+    Same-round forged duplicates (real equivocation) still pass."""
+    import threading
+
+    from celestia_app_tpu.chain.reactor import ConsensusReactor
+
+    privs = [PrivateKey.from_seed(f"a1-{i}".encode()) for i in range(2)]
+    genesis = _genesis(privs)
+    nodes = [
+        c.ValidatorNode(f"val{i}", p, genesis, CHAIN)
+        for i, p in enumerate(privs)
+    ]
+    reactor = ConsensusReactor(nodes[0], [], threading.Lock(),
+                               ReactorConfig(**FAST))
+    height, r = 1, 0
+    proposer = next(n for n in nodes
+                    if n.address == reactor.proposer_for(height, r))
+    victim = next(n for n in nodes if n is not proposer)
+    block = proposer.propose(t=1_700_000_010.0)
+
+    def proposal_with(evidence):
+        digest = c.Proposal.commit_info_digest(None, evidence)
+        sig = proposer.priv.sign(c.Proposal.sign_bytes(
+            CHAIN, height, r, block.header.hash(), digest))
+        return c.Proposal(height, r, block, proposer.address, sig,
+                          None, evidence)
+
+    # honest history: prevote A in failed round 0, prevote B in round 1
+    pv_r0 = victim._signed(1, b"\x0a" * 32, "prevote", round_=0)
+    pv_r1 = victim._signed(1, b"\x0b" * 32, "prevote", round_=1)
+    forged_ev = c.DuplicateVoteEvidence(1, pv_r0, pv_r1)
+    assert not reactor._proposal_acceptable(
+        proposal_with((forged_ev,)), height)
+
+    # real equivocation: same-round duplicate signed with the raw key
+    dup = c.Vote(
+        1, b"\x0b" * 32, victim.address,
+        victim.priv.sign(
+            c.Vote.sign_bytes(CHAIN, 1, b"\x0b" * 32, "prevote", 0)),
+        phase="prevote", round=0,
+    )
+    real_ev = c.DuplicateVoteEvidence(1, pv_r0, dup)
+    assert reactor._proposal_acceptable(proposal_with((real_ev,)), height)
+    # and the clean proposal is acceptable (the fixture itself is sound)
+    assert reactor._proposal_acceptable(proposal_with(()), height)

@@ -313,8 +313,8 @@ def test_absent_validator_accrues_missed_blocks(tmp_path):
     real_prevote_on = sleeper.prevote_on
     # offline validator: nil prevote → (no polka participation) → its
     # precommit is nil too, so it is absent from the certificate
-    sleeper.prevote_on = lambda block: sleeper._signed(
-        block.header.height, None, "prevote"
+    sleeper.prevote_on = lambda block, round_=0: sleeper._signed(
+        block.header.height, None, "prevote", round_
     )
     blk, cert = net.produce_height(t=1_700_000_010.0)
     assert blk is not None  # 30 of 40 power > 2/3
@@ -556,7 +556,164 @@ def test_sign_state_survives_restart(tmp_path):
     again = node2._signed(7, bh_a, "precommit")
     assert again.block_hash == bh_a  # same hash: legal re-sign
 
-    # prevotes stay exempt (cross-round re-prevoting is legal liveness)
+    # prevotes are guarded PER ROUND now that votes sign their round: a
+    # second different-hash prevote at the same (height, round) would be
+    # slashable equivocation, so the guard turns it nil — while
+    # re-prevoting a different block in the NEXT round (failed-round
+    # liveness) stays legal
     pv1 = node2._signed(8, bh_a, "prevote")
     pv2 = node2._signed(8, bh_b, "prevote")
-    assert pv1.block_hash == bh_a and pv2.block_hash == bh_b
+    assert pv1.block_hash == bh_a and pv2.block_hash is None
+    pv3 = node2._signed(8, bh_b, "prevote", round_=1)
+    assert pv3.block_hash == bh_b
+
+
+def test_round_signed_votes_kill_cross_round_replay(tmp_path):
+    """Votes sign their round (celestia-core CanonicalVote, VERDICT r4 #2):
+
+    1. a round-0 vote relabeled as round-1 fails signature verification —
+       the replay the old round-blind wire permitted is dead;
+    2. two honest PREVOTES for different blocks in different rounds are
+       NOT equivocation evidence (advisor A1: a byzantine proposer
+       packaging them must get nothing);
+    3. a same-round prevote duplicate IS slashable equivocation.
+    """
+    import dataclasses as dc
+
+    from celestia_app_tpu.chain.crypto import PublicKey
+
+    net, signer, privs = _network(tmp_path, with_disk=False)
+    node = net.nodes[0]
+    pub = node.priv.public_key().compressed
+    bh_a, bh_b = b"\x0a" * 32, b"\x0b" * 32
+
+    v_r0 = node._signed(3, bh_a, "prevote", round_=0)
+    assert v_r0.round == 0
+    replayed = dc.replace(v_r0, round=1)
+    assert PublicKey(pub).verify(
+        v_r0.signature,
+        consensus.Vote.sign_bytes(CHAIN, 3, bh_a, "prevote", 0))
+    assert not PublicKey(pub).verify(
+        replayed.signature,
+        consensus.Vote.sign_bytes(CHAIN, 3, bh_a, "prevote", 1))
+
+    # legal liveness history: prevote A in failed round 0, B in round 1
+    v_r1 = node._signed(3, bh_b, "prevote", round_=1)
+    assert v_r1.block_hash == bh_b  # per-round guard allows the new round
+    ev = consensus.DuplicateVoteEvidence(3, v_r0, v_r1)
+    assert not ev.verify(CHAIN, pub)
+    validators = {node.address: pub}
+    assert consensus.detect_equivocation(
+        CHAIN, [[v_r0, v_r1]], validators) == []
+
+    # byzantine same-round duplicate, forged with the raw key (an honest
+    # node's _signed guard refuses it)
+    dup = consensus.Vote(
+        3, bh_b, node.address,
+        node.priv.sign(
+            consensus.Vote.sign_bytes(CHAIN, 3, bh_b, "prevote", 0)),
+        phase="prevote", round=0,
+    )
+    ev2 = consensus.DuplicateVoteEvidence(3, v_r0, dup)
+    assert ev2.verify(CHAIN, pub)
+    out = consensus.detect_equivocation(CHAIN, [[v_r0, dup]], validators)
+    assert len(out) == 1 and out[0].vote_a.validator == node.address
+
+
+def test_certificates_are_round_scoped(tmp_path):
+    """Commit certificates carry their round (Tendermint Commit.Round):
+    precommits from a DIFFERENT round do not count toward the certificate
+    — cross-round aggregation would void the safety proof once
+    unlock-on-higher-polka lets honest validators precommit different
+    hashes in different rounds."""
+    net, signer, privs = _network(tmp_path, with_disk=False)
+    blk, cert = net.produce_height(t=1_700_000_010.0)
+    assert blk is not None and cert.round == 0
+    validators = {
+        n.address: n.priv.public_key().compressed for n in net.nodes
+    }
+    powers = {n.address: 10 for n in net.nodes}
+    assert cert.signed_power(CHAIN, validators, powers) == 30
+    # the same votes claimed under round 1 verify as zero power
+    relabeled = consensus.CommitCertificate(
+        cert.height, cert.block_hash, cert.votes, 1)
+    assert relabeled.signed_power(CHAIN, validators, powers) == 0
+
+
+def test_wal_replay_preserves_round_of_late_round_commit(tmp_path):
+    """Code-review regression: a block committed at round 1 must replay
+    from the WAL with its certificate ROUND intact — a round-0 rebuild
+    would count the round-scoped votes as zero power and read an empty
+    presence set (everyone absent), forking the replayed node's liveness
+    state and app hash from live peers."""
+    net, signer, privs = _network(tmp_path)
+    calls = {"first": True}
+
+    def drop_first_round(phase, votes):
+        if calls["first"] and phase == "prevote":
+            calls["first"] = False
+            return []  # round 0 dies: no polka anywhere
+        return votes
+
+    blk, cert = net.produce_height(t=1_700_000_010.0,
+                                   vote_filter=drop_first_round)
+    assert blk is None and cert is None
+    blk, cert = net.produce_height(t=1_700_000_020.0)
+    assert blk is not None and cert.round == 1
+    # absences from the round-1 cert feed THIS block's accounting; one
+    # more height makes the state depend on it end-to-end
+    blk2, cert2 = net.produce_height(t=1_700_000_030.0)
+    assert blk2 is not None
+    target_hash = net.nodes[0].app.last_app_hash
+
+    victim = net.nodes[2]
+    data_dir = victim.app.db.dir
+    victim.app.close()
+    storage.wipe_commits(data_dir)
+    reborn = consensus.ValidatorNode(
+        "val2-reborn", victim.priv, _genesis(privs), CHAIN,
+        data_dir=data_dir,
+    )
+    assert reborn.replay_wal() == 2
+    assert reborn.app.last_app_hash == target_hash
+    assert reborn.certificates[1].round == 1  # round survived the WAL
+    assert reborn.verify_certificate(reborn.certificates[1])
+
+
+def test_sign_watermark_blocks_old_round_walkback(tmp_path):
+    """Code-review regression (round-5): the sign guard is MONOTONIC in
+    (round, step) per height — after precommitting B at round 1, a
+    replayed round-0 polka for A must get a nil signature (even across a
+    restart, where the in-memory lock is gone), or a lying coordinator
+    could assemble certificates for both A and B at one height. And a
+    (non-nil, guard-emitted nil) pair must never verify as evidence."""
+    privs = [PrivateKey.from_seed(b"\x61")]
+    genesis = _genesis(privs)
+    home = str(tmp_path / "v0")
+    node = consensus.ValidatorNode("v0", privs[0], genesis, CHAIN,
+                                   data_dir=home)
+    bh_a, bh_b = b"\xaa" * 32, b"\xbb" * 32
+    # round 0: nil precommit (no polka seen); round 1: precommit B
+    nil0 = node._signed(5, None, "precommit", round_=0)
+    assert nil0.block_hash is None
+    pc1 = node._signed(5, bh_b, "precommit", round_=1)
+    assert pc1.block_hash == bh_b
+
+    # walk-back attempt at round 0: refused in-memory
+    walked = node._signed(5, bh_a, "precommit", round_=0)
+    assert walked.block_hash is None
+
+    # ...and refused after a crash/restart (the watermark is durable;
+    # the lock would be gone)
+    node.app.close()
+    node2 = consensus.ValidatorNode("v0", privs[0], genesis, CHAIN,
+                                    data_dir=home)
+    walked2 = node2._signed(5, bh_a, "precommit", round_=0)
+    assert walked2.block_hash is None
+    # re-signing the SAME slot+hash stays legal (idempotent re-gossip)
+    again = node2._signed(5, bh_b, "precommit", round_=1)
+    assert again.block_hash == bh_b
+
+    # the guard's nil fallback can never be packaged as evidence
+    ev = consensus.DuplicateVoteEvidence(5, pc1, walked)
+    assert not ev.verify(CHAIN, privs[0].public_key().compressed)
