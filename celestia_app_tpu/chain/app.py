@@ -858,6 +858,9 @@ class App:
                 ctx, msg.client_id, msg.height, msg.root or None,
                 header=header, cert=cert,
                 new_validators=new_validators, new_powers=new_powers,
+                # the tx signer: trusting clients only accept their
+                # pinned authorized relayer on this path (ibc.py)
+                tx_relayer=msg.relayer,
             )
             ctx.emit_event("ibc.update_client", client_id=msg.client_id,
                            height=msg.height)
@@ -951,8 +954,14 @@ class App:
 
     def commit(self, block: Block) -> bytes:
         t0 = time_mod.perf_counter()
-        self.height = block.header.height
+        # root BEFORE height: lockless readers pairing (height,
+        # last_app_hash) — ChainHandle.status_pair — can then never
+        # observe a height whose root is still the previous block's;
+        # the benign inverse (old height, new root) retries or, for the
+        # trusting relayer, records a binding its own fresh proofs verify
+        # against
         self.last_app_hash = self.store.app_hash()
+        self.height = block.header.height
         self.last_block_hash = block.header.hash()
         meta = self._commit_meta()
         if self.db is not None:

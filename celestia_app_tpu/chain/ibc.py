@@ -88,9 +88,16 @@ class ClientKeeper:
       recorded root is taken FROM the verified header (its app_hash), so a
       malicious relayer cannot forge roots — packets are then
       trust-minimized end-to-end with the membership/absence proofs below.
-    - **Trusting** (created bare — test fixtures): roots are recorded on
-      say-so, preserving only the ordering property that every recv must
-      prove membership against a root recorded BEFORE the relay."""
+    - **Trusting** (created bare — an explicitly-insecure fixture): roots
+      are recorded on say-so, preserving only the ordering property that
+      every recv must prove membership against a root recorded BEFORE the
+      relay. Because MsgUpdateClient is permissionless (ibc-go keeps it
+      permissionless only because every client verifies headers), a
+      trusting client is updatable VIA TX only by the single relayer
+      address pinned at creation (`insecure_relayer`) — otherwise any
+      funded account could record a fabricated root and deliver forged
+      packets against it, or brick the client with a huge bogus height
+      (round-4 advisor finding)."""
 
     CONS = b"ibc/client/"
 
@@ -99,10 +106,15 @@ class ClientKeeper:
         chain_id: str | None = None,
         validators: dict[bytes, bytes] | None = None,
         powers: dict[bytes, int] | None = None,
+        insecure_relayer: bytes | None = None,
     ) -> None:
         """`validators` maps 20-byte operator address -> 33-byte pubkey
         (the trusted set a real client is initialized with); passing it
-        makes the client VERIFYING."""
+        makes the client VERIFYING — the production mode. Without it the
+        client is trusting, and `insecure_relayer` (20-byte address)
+        names the ONLY account whose MsgUpdateClient txs it accepts; a
+        bare trusting client can be updated keeper-direct (in-process
+        fixtures) but never via tx."""
         meta_key = self.CONS + client_id.encode() + b"/meta"
         if _get(ctx, meta_key) is not None:
             # re-creation would reset latest_height and let update_client
@@ -119,6 +131,8 @@ class ClientKeeper:
                 op.hex(): pk.hex() for op, pk in validators.items()
             }
             meta["powers"] = {op.hex(): int(p) for op, p in powers.items()}
+        elif insecure_relayer is not None:
+            meta["authorized_relayer"] = insecure_relayer.hex()
         _put(ctx, meta_key, meta)
 
     def latest_height(self, ctx: Context, client_id: str) -> int | None:
@@ -133,6 +147,7 @@ class ClientKeeper:
         root: bytes | None = None, *, header=None, cert=None,
         new_validators: dict[bytes, bytes] | None = None,
         new_powers: dict[bytes, int] | None = None,
+        tx_relayer: bytes | None = None,
     ) -> None:
         """Verifying clients run the FULL light-client update
         (chain/light.py): >2/3 of the trusted power for a same-valset
@@ -141,7 +156,14 @@ class ClientKeeper:
         carry >2/3 of its own power, and overlap the trusted set by >1/3 —
         the adopted set is then persisted, so the client tracks the
         counterparty's validator set over time (ibc-go 02-client update +
-        tendermint light semantics)."""
+        tendermint light semantics).
+
+        `tx_relayer` is set by the MsgUpdateClient tx handler to the tx
+        signer: verifying updates stay permissionless (the header+cert do
+        the gating, exactly ibc-go's model), but a TRUSTING client accepts
+        a tx update only from its pinned authorized relayer — say-so roots
+        from arbitrary funded accounts are an escrow-theft / client-brick
+        primitive (round-4 advisor finding)."""
         meta_key = self.CONS + client_id.encode() + b"/meta"
         meta = _get(ctx, meta_key)
         if meta is None:
@@ -154,8 +176,18 @@ class ClientKeeper:
             root = self._verify_header(
                 meta, height, header, cert, new_validators, new_powers
             )
-        elif root is None:
-            raise IBCError("trusting client update needs a root")
+        else:
+            if tx_relayer is not None:
+                authorized = meta.get("authorized_relayer")
+                if authorized is None or tx_relayer.hex() != authorized:
+                    raise IBCError(
+                        "trusting client refuses tx updates except from "
+                        "its authorized relayer — create the client "
+                        "verifying (header-checked) for permissionless "
+                        "updates"
+                    )
+            if root is None:
+                raise IBCError("trusting client update needs a root")
         _put(ctx, self.CONS + f"{client_id}/{height}".encode(),
              {"root": root.hex()})
         meta["latest_height"] = height

@@ -665,7 +665,8 @@ def cmd_relayer(args) -> int:
     from celestia_app_tpu.client.tx_client import Signer
     from celestia_app_tpu.tools.relayer import HttpChainHandle, Relayer
 
-    def handle(url: str, seed: str, client_id: str) -> HttpChainHandle:
+    def handle(url: str, seed: str, client_id: str,
+               verifying: bool) -> HttpChainHandle:
         import urllib.request
 
         priv = PrivateKey.from_seed(seed.encode())
@@ -687,10 +688,12 @@ def cmd_relayer(args) -> int:
             acc = json.load(r).get("account") or {}
         signer.add_account(priv, acc.get("number", 0),
                            acc.get("sequence", 0))
-        return HttpChainHandle(url, signer, addr, client_id)
+        return HttpChainHandle(url, signer, addr, client_id,
+                               verifying=verifying)
 
-    a = handle(args.url_a, args.seed_a, args.client_a)
-    b = handle(args.url_b, args.seed_b, args.client_b)
+    verifying = not args.insecure
+    a = handle(args.url_a, args.seed_a, args.client_a, verifying)
+    b = handle(args.url_b, args.seed_b, args.client_b, verifying)
     relayer = Relayer(a, b)
     done = 0
     while args.passes is None or done < args.passes:
@@ -1731,6 +1734,11 @@ def main(argv=None) -> int:
                    help="relay passes to run (default: forever)")
     p.add_argument("--interval", type=float, default=3.0,
                    help="seconds between passes (ConfirmTx-style poll)")
+    p.add_argument("--insecure", action="store_true",
+                   help="relay on say-so roots instead of certified "
+                        "headers — requires clients created with an "
+                        "authorized relayer; test fixtures only "
+                        "(default: verifying light-client updates)")
     p.set_defaults(fn=cmd_relayer)
 
     p = sub.add_parser(

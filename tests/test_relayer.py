@@ -31,23 +31,31 @@ def _ctx(app):
 
 def _wire(tmp_path):
     """Two chains with client-backed channels BOTH ways and a relayer
-    account + node per side."""
+    account + node per side — the EXPLICITLY-INSECURE trusting fixture
+    (Node-based chains have no commit certificates to verify): clients
+    pin the relayer address authorized to record say-so roots, and the
+    handles opt out of verifying mode. The verifying default is
+    exercised by test_relayer_verifying_client_flow."""
     chain_a, signer_a, privs_a = make_app()
     chain_b, signer_b, privs_b = make_app()
-    chain_a.ibc.clients.create_client(_ctx(chain_a), "client-b")
+    rel_a = privs_a[2].public_key().address()
+    rel_b = privs_b[2].public_key().address()
+    chain_a.ibc.clients.create_client(_ctx(chain_a), "client-b",
+                                      insecure_relayer=rel_a)
     chain_a.ibc.channels.open_channel(
         _ctx(chain_a), "transfer", "channel-0", "transfer", "channel-1",
         client_id="client-b",
     )
-    chain_b.ibc.clients.create_client(_ctx(chain_b), "client-a")
+    chain_b.ibc.clients.create_client(_ctx(chain_b), "client-a",
+                                      insecure_relayer=rel_b)
     chain_b.ibc.channels.open_channel(
         _ctx(chain_b), "transfer", "channel-1", "transfer", "channel-0",
         client_id="client-a",
     )
-    a = ChainHandle(Node(chain_a), signer_a,
-                    privs_a[2].public_key().address(), "client-b")
-    b = ChainHandle(Node(chain_b), signer_b,
-                    privs_b[2].public_key().address(), "client-a")
+    a = ChainHandle(Node(chain_a), signer_a, rel_a, "client-b",
+                    verifying=False)
+    b = ChainHandle(Node(chain_b), signer_b, rel_b, "client-a",
+                    verifying=False)
     return a, b, privs_a, privs_b
 
 
@@ -236,9 +244,9 @@ def test_relayer_over_http_transport(tmp_path):
     svc_b.serve_background()
     try:
         ha = HttpChainHandle(f"http://127.0.0.1:{svc_a.port}", a.signer,
-                             a.relayer, "client-b")
+                             a.relayer, "client-b", verifying=False)
         hb = HttpChainHandle(f"http://127.0.0.1:{svc_b.port}", b.signer,
-                             b.relayer, "client-a")
+                             b.relayer, "client-a", verifying=False)
 
         sender = privs_a[0].public_key().address()
         tx = a.signer.create_tx(
@@ -323,7 +331,9 @@ def test_relayer_verifying_client_flow(tmp_path):
     for n in nodes:
         c_ctx = Context(n.app.store, InfiniteGasMeter(), n.app.height, T0,
                         "chain-a", n.app.app_version)
-        n.app.ibc.clients.create_client(c_ctx, "client-b")
+        n.app.ibc.clients.create_client(
+            c_ctx, "client-b",
+            insecure_relayer=privs[2].public_key().address())
         n.app.ibc.channels.open_channel(
             c_ctx, "transfer", "channel-0", "transfer", "channel-1",
             client_id="client-b",
@@ -344,11 +354,14 @@ def test_relayer_verifying_client_flow(tmp_path):
     signer_a = Signer("chain-a")
     for i, p in enumerate(privs):
         signer_a.add_account(p, number=i)
+    # A's own client for B stays a trusting fixture (B is a plain Node
+    # with no certificates to verify); B's client for A is the verifying
+    # DEFAULT under test
     a = ChainHandle(NetAdapter(net), signer_a,
-                    privs[2].public_key().address(), "client-b")
+                    privs[2].public_key().address(), "client-b",
+                    verifying=False)
     b = ChainHandle(Node(chain_b), signer_b,
-                    privs_b[2].public_key().address(), "client-a",
-                    verifying=True)
+                    privs_b[2].public_key().address(), "client-a")
 
     # a transfer commits on A at height H
     sender = privs[0].public_key().address()
@@ -395,3 +408,64 @@ def test_relayer_verifying_client_flow(tmp_path):
                        "chain-a", n.app.app_version)
         assert n.app.bank.balance(nctx, sender) == bal_before + 4_242
     assert all(v == 0 for v in relayer.step().values())
+
+
+def test_handle_submit_resyncs_sequence_on_nonce_mismatch(tmp_path):
+    """Advisor A3 regression: a relayer whose cached account sequence
+    desynced (e.g. a node restart flushed the mempool after the bump)
+    must re-sync from the nonce-mismatch rejection and retry — one
+    dropped tx must not wedge the daemon forever."""
+    from celestia_app_tpu.chain.tx import MsgUpdateClient
+
+    a, b, privs_a, _ = _wire(tmp_path)
+
+    # desync: pretend an earlier tx was accepted-then-dropped
+    a.signer.accounts[a.relayer].sequence += 3
+    a.submit(MsgUpdateClient(
+        relayer=a.relayer, client_id="client-b", height=1,
+        root=b"\x11" * 32,
+    ), gas=200_000)
+    # one tx in the mempool, signed with the CORRECT (re-synced) sequence
+    assert len(a.node.mempool) == 1
+    a.node.produce_block(t=T0 + 10)
+    committed = [res for _h, res in a.node.committed.values()]
+    assert any(r.code == 0 for r in committed)
+
+
+def test_unauthorized_sayso_update_client_rejected(tmp_path):
+    """Advisor A2 regression: MsgUpdateClient is permissionless, so a
+    TRUSTING client must refuse say-so roots from anyone but its pinned
+    authorized relayer — otherwise any funded account could record a
+    fabricated root (escrow theft via forged packet proofs) or brick the
+    client with height=2^60. The authorized relayer still works, and
+    keeper-direct updates (in-process fixtures) stay unaffected."""
+    from celestia_app_tpu.chain.tx import MsgUpdateClient
+
+    a, b, privs_a, _ = _wire(tmp_path)
+    attacker = privs_a[0].public_key().address()
+
+    # attack 1: fabricated root from a non-relayer account
+    msg = MsgUpdateClient(attacker, "client-b", 7, b"\x66" * 32)
+    tx = a.signer.create_tx(attacker, [msg], fee=2000, gas_limit=200_000)
+    assert a.node.broadcast_tx(tx.encode()).code == 0  # valid signature
+    a.signer.accounts[attacker].sequence += 1
+    _blk, results = a.node.produce_block(t=T0 + 10)
+    assert results[0].code != 0
+    assert "authorized relayer" in results[0].log
+    assert a.app.ibc.clients.latest_height(_ctx(a.app), "client-b") in (
+        None, 0)
+
+    # attack 2: client-brick via an absurd height — same rejection
+    msg = MsgUpdateClient(attacker, "client-b", 2**60, b"\x67" * 32)
+    tx = a.signer.create_tx(attacker, [msg], fee=2000, gas_limit=200_000)
+    assert a.node.broadcast_tx(tx.encode()).code == 0
+    a.signer.accounts[attacker].sequence += 1
+    _blk, results = a.node.produce_block(t=T0 + 20)
+    assert results[0].code != 0
+
+    # the pinned relayer's update still lands (the fixture keeps working)
+    a.submit(MsgUpdateClient(a.relayer, "client-b", 9, b"\x68" * 32),
+             gas=200_000)
+    _blk, results = a.node.produce_block(t=T0 + 30)
+    assert results[0].code == 0, results[0].log
+    assert a.app.ibc.clients.latest_height(_ctx(a.app), "client-b") == 9
