@@ -447,7 +447,8 @@ def cmd_start(args) -> int:
     if args.grpc is not None:
         from celestia_app_tpu.service.grpc_server import GrpcTxServer
 
-        grpc_srv = GrpcTxServer(node, port=args.grpc, lock=svc.lock)
+        grpc_srv = GrpcTxServer(node, port=args.grpc, lock=svc.lock,
+                                da_core=svc.da_core)
     print(
         f"node started: chain {app.chain_id} at height {app.height}, "
         f"http on 127.0.0.1:{svc.port}"
@@ -707,6 +708,58 @@ def cmd_relayer(args) -> int:
         done += 1
         if args.passes is None or done < args.passes:
             time.sleep(args.interval)
+    return 0
+
+
+def cmd_da_serve(args) -> int:
+    """Standalone DA-core service (SURVEY §7.1.7 sidecar shape): serves
+    /da/extend_commit and /da/prove_shares with NO chain attached — a
+    foreign node (see shim/go/tpuda) points its da.ExtendShares
+    replacement here and keeps everything else. --grpc also serves
+    celestia_tpu.da.v1.DAService on that port."""
+    from celestia_app_tpu.service.da_service import DACore, DAService
+
+    core = DACore(engine=args.engine)
+    svc = DAService(core, port=args.listen)
+    grpc_srv = None
+    if args.grpc is not None:
+        from concurrent import futures as _futures
+
+        import grpc as _g
+
+        from celestia_app_tpu.service.grpc_server import (
+            DA_SERVICE,
+            DAGrpcService,
+            _handler,
+        )
+
+        da = DAGrpcService(core)
+        server = _g.server(_futures.ThreadPoolExecutor(max_workers=4))
+        server.add_generic_rpc_handlers((
+            _g.method_handlers_generic_handler(DA_SERVICE, {
+                "ExtendAndCommit": _handler(da.extend_and_commit),
+                "ProveShares": _handler(da.prove_shares),
+            }),
+        ))
+        grpc_port = server.add_insecure_port(f"127.0.0.1:{args.grpc}")
+        if grpc_port == 0:
+            # add_insecure_port returns 0 on bind FAILURE (port taken);
+            # serving HTTP-only silently would strand the foreign caller
+            print(f"da-serve: cannot bind gRPC port {args.grpc}",
+                  file=sys.stderr)
+            return 1
+        server.start()
+        grpc_srv = server
+        print(f"da-serve: grpc on :{grpc_port}", flush=True)
+    print(f"da-serve: http on :{svc.port} (engine={core.engine})",
+          flush=True)
+    try:
+        svc.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if grpc_srv is not None:
+            grpc_srv.stop(grace=0.5)
     return 0
 
 
@@ -1740,6 +1793,16 @@ def main(argv=None) -> int:
                         "authorized relayer; test fixtures only "
                         "(default: verifying light-client updates)")
     p.set_defaults(fn=cmd_relayer)
+
+    p = sub.add_parser(
+        "da-serve",
+        help="standalone DA-core service for foreign nodes (§7.1.7 "
+             "shim): /da/extend_commit + /da/prove_shares, no chain "
+             "attached; --grpc adds celestia_tpu.da.v1.DAService")
+    p.add_argument("--listen", type=int, default=26659)
+    p.add_argument("--grpc", type=int, default=None)
+    p.add_argument("--engine", default="host", choices=("host", "device"))
+    p.set_defaults(fn=cmd_da_serve)
 
     p = sub.add_parser(
         "verify",

@@ -37,6 +37,105 @@ BLOB_QUERY = "celestia.blob.v1.Query"
 MINFEE_QUERY = "celestia.minfee.v1.Query"
 STAKING_QUERY = "cosmos.staking.v1beta1.Query"
 GOV_QUERY = "cosmos.gov.v1beta1.Query"
+DA_SERVICE = "celestia_tpu.da.v1.DAService"
+
+
+class DAGrpcService:
+    """gRPC transport for the stateless DA core (§7.1.7 shim surface) —
+    the same DACore the HTTP /da/* routes use, encoded per
+    proto/celestia_tpu/da/v1/da.proto with the hand-rolled codec
+    (wire/proto.py). No node state, no lock: callers are foreign
+    processes swapping da.ExtendShares for ExtendAndCommit."""
+
+    def __init__(self, da_core):
+        self.core = da_core
+
+    def extend_and_commit(self, request: bytes, context) -> bytes:
+        from celestia_app_tpu.service.da_service import DAError
+        from celestia_app_tpu.wire import proto as p
+
+        req = p.Fields(request)
+        # raw bytes straight through — no base64 detour on the hot path
+        # (an 8 MB 128x128 ODS per block is exactly what this service
+        # exists to accelerate)
+        payload = {"ods": req.get_bytes(1)}
+        k = req.get_int(2)
+        if k:
+            payload["square_size"] = k
+        try:
+            out = self.core.extend_and_commit(payload)
+        except DAError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return b"".join([
+            p.field_varint(1, out["square_size"]),
+            p.field_repeated_bytes(
+                2, [bytes.fromhex(r) for r in out["row_roots"]]),
+            p.field_repeated_bytes(
+                3, [bytes.fromhex(r) for r in out["col_roots"]]),
+            p.field_bytes(4, bytes.fromhex(out["data_root"])),
+        ])
+
+    def prove_shares(self, request: bytes, context) -> bytes:
+        import base64
+
+        from celestia_app_tpu.service.da_service import DAError
+        from celestia_app_tpu.wire import proto as p
+
+        req = p.Fields(request)
+        payload = {"start": req.get_int(3), "end": req.get_int(4)}
+        if req.has(1):
+            payload["data_root"] = req.get_bytes(1).hex()
+        if req.has(2):
+            payload["ods"] = req.get_bytes(2)
+        if req.has(5):
+            payload["namespace"] = req.get_bytes(5).hex()
+        try:
+            out = self.core.prove_shares(payload)
+        except DAError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        pf = out["proof"]
+
+        def nmt_range(sp: dict) -> bytes:
+            return b"".join([
+                p.field_varint(1, sp["start"]),
+                p.field_varint(2, sp["end"]),
+                p.field_varint(3, sp["total"]),
+                p.field_repeated_bytes(
+                    4, [base64.b64decode(n) for n in sp["nodes"]]),
+            ])
+
+        def merkle(mp: dict) -> bytes:
+            return b"".join([
+                p.field_varint(1, mp["index"]),
+                p.field_varint(2, mp["total"]),
+                p.field_bytes(3, base64.b64decode(mp["leaf_hash"])),
+                p.field_repeated_bytes(
+                    4, [base64.b64decode(a) for a in mp["aunts"]]),
+            ])
+
+        rp = pf["row_proof"]
+        row_proof = b"".join([
+            p.field_repeated_bytes(
+                1, [bytes.fromhex(r) for r in rp["row_roots"]]),
+            b"".join(p.field_message(2, merkle(mp))
+                     for mp in rp["proofs"]),
+            p.field_varint(3, rp["start_row"]),
+            p.field_varint(4, rp["end_row"]),
+        ])
+        share_proof = b"".join([
+            p.field_repeated_bytes(
+                1, [base64.b64decode(d) for d in pf["data"]]),
+            b"".join(p.field_message(2, nmt_range(sp))
+                     for sp in pf["share_proofs"]),
+            p.field_bytes(3, bytes.fromhex(pf["namespace"])),
+            p.field_message(4, row_proof),
+            p.field_varint(5, pf["start_share"]),
+            p.field_varint(6, pf["end_share"]),
+        ])
+        return b"".join([
+            p.field_message(1, share_proof),
+            p.field_bytes(2, bytes.fromhex(out["data_root"])),
+        ])
 
 
 class CosmosTxService:
@@ -262,11 +361,26 @@ def _handler(fn):
 
 class GrpcTxServer:
     def __init__(self, node, host: str = "127.0.0.1", port: int = 9090,
-                 lock: threading.Lock | None = None):
+                 lock: threading.Lock | None = None, da_core=None):
         self.service = CosmosTxService(node, lock)
         self.queries = QueryServices(node, self.service.lock)
+        # share the caller's DACore when both transports live in one
+        # process (cli start --grpc): an ExtendAndCommit over gRPC must
+        # be provable over HTTP by data_root from ONE square cache
+        if da_core is None:
+            from celestia_app_tpu.service.da_service import DACore
+
+            da_core = DACore(
+                engine="device" if getattr(node.app, "engine", "host")
+                == "device" else "host"
+            )
+        self.da = DAGrpcService(da_core)
         q = self.queries
         services = {
+            DA_SERVICE: {
+                "ExtendAndCommit": _handler(self.da.extend_and_commit),
+                "ProveShares": _handler(self.da.prove_shares),
+            },
             SERVICE: {
                 "BroadcastTx": _handler(self.service.broadcast_tx),
                 "Simulate": _handler(self.service.simulate),

@@ -15,6 +15,8 @@ Endpoints:
   POST /simulate_tx    {"tx": b64}     dry-run gas estimation (Simulate rpc)
   POST /produce_block  {"time": t?}    devnet convenience: one round
   POST /abci_query     {"path": ..., "data": {...}}
+  POST /da/extend_commit {"ods": b64}  stateless DA core: ODS -> DAH
+  POST /da/prove_shares  {...}         share-range proof (§7.1.7 shim)
 """
 
 from __future__ import annotations
@@ -32,6 +34,16 @@ class NodeService:
         self.node = node
         self.router = QueryRouter(node.app)
         self.lock = threading.Lock()  # node state is single-writer
+        # the stateless DA-core shim surface (§7.1.7): /da/extend_commit
+        # + /da/prove_shares for foreign callers. Host engine unless this
+        # node itself runs on device — a host-engine validator process
+        # must never import-and-dispatch jax (relay-down hang class).
+        from celestia_app_tpu.service.da_service import DACore
+
+        self.da_core = DACore(
+            engine="device" if getattr(node.app, "engine", "host")
+            == "device" else "host"
+        )
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -148,6 +160,18 @@ class NodeService:
                                 payload["path"], payload.get("data", {})
                             )
                         self._send(200, out)
+                    elif self.path.startswith("/da/"):
+                        # stateless DA core (no node state, no service
+                        # lock): foreign nodes extend/commit/prove here
+                        from celestia_app_tpu.service.da_service import (
+                            DAError,
+                        )
+
+                        try:
+                            self._send(200, service.da_core.handle(
+                                self.path, payload))
+                        except DAError as e:
+                            self._send(400, {"error": str(e)})
                     elif self.path == "/ibc/prove":
                         # membership/absence proof of a raw store key: the
                         # relayer's proof source (public data — any light

@@ -1,0 +1,746 @@
+// da_client: a NON-PYTHON node PRODUCING DA results through the shim RPC.
+//
+// verify_client.cc proved a foreign host can VERIFY this framework's
+// results; this client closes the other half of SURVEY §7.1.7 — the
+// boundary where a Go node swaps the body of `da.ExtendShares` +
+// `NewDataAvailabilityHeader` (reference pkg/da/
+// data_availability_header.go:44-75, called from app/extend_block.go:14-26)
+// for one RPC call. It:
+//
+//   1. builds a deterministic ODS (what a foreign square-builder emits),
+//   2. computes the expected DAH with its OWN GF(2^8) Leopard encoder +
+//      NMT + RFC-6962 Merkle implementation (portable scalar C++ — no
+//      shared code with the service),
+//   3. POSTs the ODS to /da/extend_commit (service/da_service.py; the
+//      same payload rides gRPC as celestia_tpu.da.v1.DAService),
+//   4. checks every returned row/col root and the data root are
+//      BYTE-IDENTICAL to the local recompute,
+//   5. requests a share-range proof from /da/prove_shares and verifies
+//      the full chain (shares -> NMT row roots -> data root) in C++,
+//      with a tampered-copy self-check against a vacuous verifier.
+//
+// Usage: ./da_client <host> <port> <k> [seed]     (k a power of two <= 32)
+// Exit 0 = the foreign-caller story holds end-to-end.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+static const int SHARE = 512;
+static const size_t NS = 29;
+
+// ---------------------------------------------------------------------------
+// portable SHA-256 (scalar; no ISA extensions — this client must build
+// anywhere a Go node runs)
+// ---------------------------------------------------------------------------
+
+namespace sha {
+static const uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+static inline uint32_t rotr(uint32_t x, int n) {
+  return (x >> n) | (x << (32 - n));
+}
+
+static void compress(uint32_t s[8], const uint8_t* p) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; i++)
+    w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+           (uint32_t(p[4 * i + 2]) << 8) | p[4 * i + 3];
+  for (int i = 16; i < 64; i++) {
+    uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = s[0], b = s[1], c = s[2], d = s[3], e = s[4], f = s[5],
+           g = s[6], h = s[7];
+  for (int i = 0; i < 64; i++) {
+    uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + S1 + ch + K[i] + w[i];
+    uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    uint32_t mj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = S0 + mj;
+    h = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  s[0] += a; s[1] += b; s[2] += c; s[3] += d;
+  s[4] += e; s[5] += f; s[6] += g; s[7] += h;
+}
+
+std::string digest(const std::string& msg) {
+  uint32_t s[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                   0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  std::string padded = msg;
+  uint64_t bitlen = uint64_t(msg.size()) * 8;
+  padded.push_back('\x80');
+  while (padded.size() % 64 != 56) padded.push_back('\0');
+  for (int i = 7; i >= 0; i--)
+    padded.push_back(char((bitlen >> (8 * i)) & 0xff));
+  for (size_t off = 0; off < padded.size(); off += 64)
+    compress(s, reinterpret_cast<const uint8_t*>(padded.data()) + off);
+  std::string out(32, '\0');
+  for (int i = 0; i < 8; i++)
+    for (int j = 0; j < 4; j++)
+      out[4 * i + j] = char((s[i] >> (8 * (3 - j))) & 0xff);
+  return out;
+}
+}  // namespace sha
+
+// ---------------------------------------------------------------------------
+// GF(2^8) Leopard LCH-FFT encoder (ops/leopard.py construction; scalar)
+// ---------------------------------------------------------------------------
+
+static const uint16_t kPoly = 0x11D;
+static const uint8_t kCantor[8] = {1, 214, 152, 146, 86, 200, 88, 230};
+static uint8_t LOGT[256], EXPT[256];
+static uint8_t MUL[256][256];
+static uint8_t SKEW[8][8];
+
+static uint8_t gf_mul(uint8_t a, uint8_t b) {
+  if (!a || !b) return 0;
+  int s = LOGT[a] + LOGT[b];
+  if (s >= 255) s -= 255;
+  return EXPT[s];
+}
+
+static void init_tables() {
+  int lfsr_log[256];
+  int state = 1;
+  for (int i = 0; i < 255; i++) {
+    lfsr_log[state] = i;
+    state <<= 1;
+    if (state & 0x100) state ^= kPoly;
+  }
+  lfsr_log[0] = 255;
+  int cantor[256];
+  cantor[0] = 0;
+  for (int b = 0; b < 8; b++)
+    for (int j = 0; j < (1 << b); j++)
+      cantor[j + (1 << b)] = cantor[j] ^ kCantor[b];
+  for (int i = 0; i < 256; i++) LOGT[i] = (uint8_t)lfsr_log[cantor[i]];
+  for (int i = 0; i < 256; i++) EXPT[LOGT[i]] = (uint8_t)i;
+  for (int a = 0; a < 256; a++)
+    for (int b = 0; b < 256; b++) MUL[a][b] = gf_mul((uint8_t)a, (uint8_t)b);
+  for (int d = 0; d < 8; d++) {
+    auto s_d_at = [&](int x) {
+      uint8_t acc = 1;
+      for (int a = 0; a < (1 << d); a++) acc = gf_mul(acc, (uint8_t)(x ^ a));
+      return acc;
+    };
+    uint8_t norm = s_d_at(1 << d);
+    uint8_t inv = EXPT[(255 - LOGT[norm]) % 255];
+    for (int b = d; b < 8; b++) SKEW[d][b] = gf_mul(s_d_at(1 << b), inv);
+  }
+}
+
+static uint8_t skew_at(int d, int gamma) {
+  uint8_t acc = 0;
+  for (int b = d; b < 8; b++)
+    if ((gamma >> b) & 1) acc ^= SKEW[d][b];
+  return acc;
+}
+
+static void mul_add(uint8_t* y, const uint8_t* x, uint8_t c, int len) {
+  if (c == 0) return;
+  for (int i = 0; i < len; i++) y[i] ^= MUL[c][x[i]];
+}
+
+static void leo_encode(uint8_t** work, int k, int len) {
+  for (int half = 1; half < k; half <<= 1) {
+    int d = __builtin_ctz(half);
+    for (int j = 0; j < k; j += 2 * half) {
+      uint8_t w = skew_at(d, k + j);
+      for (int p = 0; p < half; p++) {
+        uint8_t* xx = work[j + p];
+        uint8_t* yy = work[j + half + p];
+        for (int i = 0; i < len; i++) yy[i] ^= xx[i];
+        mul_add(xx, yy, w, len);
+      }
+    }
+  }
+  for (int half = k >> 1; half >= 1; half >>= 1) {
+    int d = __builtin_ctz(half);
+    for (int j = 0; j < k; j += 2 * half) {
+      uint8_t w = skew_at(d, j);
+      for (int p = 0; p < half; p++) {
+        uint8_t* xx = work[j + p];
+        uint8_t* yy = work[j + half + p];
+        mul_add(xx, yy, w, len);
+        for (int i = 0; i < len; i++) yy[i] ^= xx[i];
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// NMT axis roots + data root (mirrors utils/nmt_host.py / merkle_host.py)
+// ---------------------------------------------------------------------------
+
+struct NmtNode {
+  uint8_t mn[NS], mx[NS], v[32];
+};
+static uint8_t PARITY_NS[NS];
+
+static void sha256_buf(const uint8_t* p, size_t n, uint8_t out[32]) {
+  std::string d = sha::digest(std::string((const char*)p, n));
+  memcpy(out, d.data(), 32);
+}
+
+static void nmt_leaf(const uint8_t* ns, const uint8_t* share, NmtNode* out) {
+  uint8_t pre[1 + NS + SHARE];
+  pre[0] = 0;
+  memcpy(pre + 1, ns, NS);
+  memcpy(pre + 1 + NS, share, SHARE);
+  memcpy(out->mn, ns, NS);
+  memcpy(out->mx, ns, NS);
+  sha256_buf(pre, sizeof(pre), out->v);
+}
+
+static void nmt_inner(const NmtNode* lp, const NmtNode* rp, NmtNode* out) {
+  NmtNode lv = *lp, rv = *rp;
+  const NmtNode* l = &lv;
+  const NmtNode* r = &rv;
+  memcpy(out->mn, memcmp(l->mn, r->mn, NS) <= 0 ? l->mn : r->mn, NS);
+  if (!memcmp(l->mn, PARITY_NS, NS)) {
+    memcpy(out->mx, PARITY_NS, NS);
+  } else if (!memcmp(r->mn, PARITY_NS, NS)) {
+    memcpy(out->mx, l->mx, NS);  // IgnoreMaxNamespace
+  } else {
+    memcpy(out->mx, memcmp(l->mx, r->mx, NS) >= 0 ? l->mx : r->mx, NS);
+  }
+  uint8_t pre[1 + 2 * (2 * NS + 32)];
+  pre[0] = 1;
+  memcpy(pre + 1, l->mn, NS);
+  memcpy(pre + 1 + NS, l->mx, NS);
+  memcpy(pre + 1 + 2 * NS, l->v, 32);
+  memcpy(pre + 1 + 2 * NS + 32, r->mn, NS);
+  memcpy(pre + 1 + 3 * NS + 32, r->mx, NS);
+  memcpy(pre + 1 + 4 * NS + 32, r->v, 32);
+  sha256_buf(pre, sizeof(pre), out->v);
+}
+
+template <typename GetShare, typename InQ0>
+static void axis_root(int two_k, GetShare get, InQ0 in_q0, uint8_t out90[90]) {
+  std::vector<NmtNode> nodes(two_k);
+  for (int j = 0; j < two_k; j++) {
+    const uint8_t* share = get(j);
+    nmt_leaf(in_q0(j) ? share : PARITY_NS, share, &nodes[j]);
+  }
+  int n = two_k;
+  while (n > 1) {
+    for (int i = 0; i < n / 2; i++)
+      nmt_inner(&nodes[2 * i], &nodes[2 * i + 1], &nodes[i]);
+    n /= 2;
+  }
+  memcpy(out90, nodes[0].mn, NS);
+  memcpy(out90 + NS, nodes[0].mx, NS);
+  memcpy(out90 + 2 * NS, nodes[0].v, 32);
+}
+
+static void merkle_root(const uint8_t* leaves, int n, int leaf_len,
+                        uint8_t out[32]) {
+  std::vector<uint8_t> level(n * 32);
+  std::vector<uint8_t> pre(1 + leaf_len);
+  for (int i = 0; i < n; i++) {
+    pre[0] = 0;
+    memcpy(pre.data() + 1, leaves + (size_t)i * leaf_len, leaf_len);
+    sha256_buf(pre.data(), 1 + leaf_len, level.data() + (size_t)i * 32);
+  }
+  uint8_t ipre[65];
+  while (n > 1) {
+    for (int i = 0; i < n / 2; i++) {
+      ipre[0] = 1;
+      memcpy(ipre + 1, level.data() + (size_t)2 * i * 32, 32);
+      memcpy(ipre + 33, level.data() + (size_t)(2 * i + 1) * 32, 32);
+      sha256_buf(ipre, 65, level.data() + (size_t)i * 32);
+    }
+    n /= 2;
+  }
+  memcpy(out, level.data(), 32);
+}
+
+// ---------------------------------------------------------------------------
+// base64 / hex / JSON / HTTP (as in verify_client.cc)
+// ---------------------------------------------------------------------------
+
+static const char* B64TBL =
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+static std::string b64encode(const std::string& raw) {
+  std::string re;
+  re.reserve((raw.size() + 2) / 3 * 4);
+  for (size_t i = 0; i < raw.size(); i += 3) {
+    uint32_t v = (uint8_t)raw[i] << 16;
+    if (i + 1 < raw.size()) v |= (uint8_t)raw[i + 1] << 8;
+    if (i + 2 < raw.size()) v |= (uint8_t)raw[i + 2];
+    re.push_back(B64TBL[(v >> 18) & 63]);
+    re.push_back(B64TBL[(v >> 12) & 63]);
+    re.push_back(i + 1 < raw.size() ? B64TBL[(v >> 6) & 63] : '=');
+    re.push_back(i + 2 < raw.size() ? B64TBL[v & 63] : '=');
+  }
+  return re;
+}
+
+static std::string b64decode(const std::string& in) {
+  static int T[256];
+  static bool init = false;
+  if (!init) {
+    for (int i = 0; i < 256; i++) T[i] = -1;
+    for (int i = 0; i < 64; i++) T[(uint8_t)B64TBL[i]] = i;
+    init = true;
+  }
+  std::string out;
+  int val = 0, bits = -8;
+  for (unsigned char c : in) {
+    if (T[c] == -1) continue;
+    val = (val << 6) + T[c];
+    bits += 6;
+    if (bits >= 0) {
+      out.push_back(char((val >> bits) & 0xff));
+      bits -= 8;
+    }
+  }
+  return out;
+}
+
+static std::string hexdecode(const std::string& in) {
+  std::string out;
+  for (size_t i = 0; i + 1 < in.size(); i += 2)
+    out.push_back(char(std::stoi(in.substr(i, 2), nullptr, 16)));
+  return out;
+}
+
+static std::string hexencode(const std::string& raw) {
+  static const char* H = "0123456789abcdef";
+  std::string out;
+  for (unsigned char c : raw) {
+    out.push_back(H[c >> 4]);
+    out.push_back(H[c & 15]);
+  }
+  return out;
+}
+
+struct JValue {
+  enum Kind { OBJ, ARR, STR, NUM, BOOL, NUL } kind = NUL;
+  std::map<std::string, std::shared_ptr<JValue>> obj;
+  std::vector<std::shared_ptr<JValue>> arr;
+  std::string str;
+  long long num = 0;
+  bool boolean = false;
+};
+
+struct JParser {
+  const std::string& s;
+  size_t i = 0;
+  explicit JParser(const std::string& src) : s(src) {}
+  void ws() { while (i < s.size() && strchr(" \t\r\n", s[i])) i++; }
+  std::shared_ptr<JValue> parse() {
+    ws();
+    auto v = std::make_shared<JValue>();
+    if (i >= s.size()) return v;
+    char c = s[i];
+    if (c == '{') {
+      v->kind = JValue::OBJ;
+      i++;
+      ws();
+      if (s[i] == '}') { i++; return v; }
+      while (true) {
+        ws();
+        std::string key = parse_string();
+        ws();
+        i++;
+        v->obj[key] = parse();
+        ws();
+        if (s[i] == ',') { i++; continue; }
+        i++;
+        break;
+      }
+    } else if (c == '[') {
+      v->kind = JValue::ARR;
+      i++;
+      ws();
+      if (s[i] == ']') { i++; return v; }
+      while (true) {
+        v->arr.push_back(parse());
+        ws();
+        if (s[i] == ',') { i++; continue; }
+        i++;
+        break;
+      }
+    } else if (c == '"') {
+      v->kind = JValue::STR;
+      v->str = parse_string();
+    } else if (c == 't' || c == 'f') {
+      v->kind = JValue::BOOL;
+      v->boolean = (c == 't');
+      i += v->boolean ? 4 : 5;
+    } else if (c == 'n') {
+      i += 4;
+    } else {
+      v->kind = JValue::NUM;
+      size_t start = i;
+      if (s[i] == '-') i++;
+      while (i < s.size() && (isdigit(s[i]) || s[i] == '.' || s[i] == 'e' ||
+                              s[i] == 'E' || s[i] == '+' || s[i] == '-'))
+        i++;
+      v->num = atoll(s.substr(start, i - start).c_str());
+    }
+    return v;
+  }
+  std::string parse_string() {
+    std::string out;
+    i++;
+    while (i < s.size() && s[i] != '"') {
+      if (s[i] == '\\') {
+        i++;
+        char c = s[i++];
+        if (c == 'n') out.push_back('\n');
+        else if (c == 't') out.push_back('\t');
+        else out.push_back(c);
+      } else {
+        out.push_back(s[i++]);
+      }
+    }
+    i++;
+    return out;
+  }
+};
+
+static std::string http_post(const std::string& host, int port,
+                             const std::string& path,
+                             const std::string& body) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) { perror("socket"); exit(2); }
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+  if (connect(fd, (sockaddr*)&addr, sizeof addr) != 0) {
+    perror("connect");
+    exit(2);
+  }
+  char req[512];
+  snprintf(req, sizeof req,
+           "POST %s HTTP/1.1\r\nHost: %s\r\nContent-Type: application/json"
+           "\r\nContent-Length: %zu\r\nConnection: close\r\n\r\n",
+           path.c_str(), host.c_str(), body.size());
+  std::string full = std::string(req) + body;
+  size_t sent = 0;
+  while (sent < full.size()) {
+    ssize_t n = write(fd, full.data() + sent, full.size() - sent);
+    if (n <= 0) { perror("write"); exit(2); }
+    sent += (size_t)n;
+  }
+  std::string resp;
+  char buf[65536];
+  ssize_t n;
+  while ((n = read(fd, buf, sizeof buf)) > 0) resp.append(buf, (size_t)n);
+  close(fd);
+  size_t hdr = resp.find("\r\n\r\n");
+  return hdr == std::string::npos ? "" : resp.substr(hdr + 4);
+}
+
+// ---------------------------------------------------------------------------
+// NMT + row-proof verification (as verify_client.cc)
+// ---------------------------------------------------------------------------
+
+struct VNode {
+  std::string mn, mx, digest;
+};
+static const std::string PARITY_S(29, '\xff');
+
+static VNode v_leaf(const std::string& ns, const std::string& data) {
+  return {ns, ns, sha::digest(std::string("\x00", 1) + ns + data)};
+}
+
+static VNode v_inner(const VNode& l, const VNode& r) {
+  VNode n;
+  n.mn = std::min(l.mn, r.mn);
+  if (l.mn == PARITY_S) n.mx = PARITY_S;
+  else if (r.mn == PARITY_S) n.mx = l.mx;
+  else n.mx = std::max(l.mx, r.mx);
+  n.digest = sha::digest(std::string("\x01", 1) + l.mn + l.mx + l.digest +
+                         r.mn + r.mx + r.digest);
+  return n;
+}
+
+static size_t split_point(size_t n) {
+  size_t k = 1;
+  while (k * 2 < n) k *= 2;
+  return k;
+}
+
+struct NmtRange {
+  long long start, end, total;
+  std::vector<std::string> nodes;
+};
+
+static bool nmt_verify(
+    const NmtRange& pf, const std::string& root,
+    const std::vector<std::pair<std::string, std::string>>& leaves) {
+  if ((long long)leaves.size() != pf.end - pf.start || pf.total < pf.end)
+    return false;
+  size_t node_i = 0, leaf_i = 0;
+  bool ok = true;
+  std::function<VNode(long long, long long)> rebuild =
+      [&](long long start, long long end) -> VNode {
+    if (end <= pf.start || start >= pf.end) {
+      if (node_i >= pf.nodes.size()) { ok = false; return VNode(); }
+      const std::string& raw = pf.nodes[node_i++];
+      if (raw.size() != 2 * NS + 32) { ok = false; return VNode(); }
+      return {raw.substr(0, NS), raw.substr(NS, NS), raw.substr(2 * NS)};
+    }
+    if (end - start == 1) {
+      auto& lf = leaves[leaf_i++];
+      return v_leaf(lf.first, lf.second);
+    }
+    long long k = (long long)split_point((size_t)(end - start));
+    VNode l = rebuild(start, start + k);
+    VNode r = rebuild(start + k, end);
+    return v_inner(l, r);
+  };
+  VNode got = rebuild(0, pf.total);
+  if (!ok || node_i != pf.nodes.size()) return false;
+  return got.mn + got.mx + got.digest == root;
+}
+
+static std::string compute_from_aunts(long long index, long long total,
+                                      const std::string& lh,
+                                      const std::vector<std::string>& aunts,
+                                      size_t depth, bool& ok) {
+  if (total == 1) {
+    if (depth != aunts.size()) ok = false;
+    return lh;
+  }
+  if (depth >= aunts.size()) { ok = false; return lh; }
+  long long k = (long long)split_point((size_t)total);
+  const std::string& aunt = aunts[aunts.size() - 1 - depth];
+  if (index < k) {
+    std::string left = compute_from_aunts(index, k, lh, aunts, depth + 1, ok);
+    return sha::digest(std::string("\x01", 1) + left + aunt);
+  }
+  std::string right =
+      compute_from_aunts(index - k, total - k, lh, aunts, depth + 1, ok);
+  return sha::digest(std::string("\x01", 1) + aunt + right);
+}
+
+static bool verify_share_proof(const JValue& doc,
+                               const std::string& data_root) {
+  auto proof = doc.obj.at("proof");
+  std::vector<std::string> shares;
+  for (auto& d : proof->obj.at("data")->arr)
+    shares.push_back(b64decode(d->str));
+  auto rp = proof->obj.at("row_proof");
+  std::vector<std::string> row_roots;
+  for (auto& r : rp->obj.at("row_roots")->arr)
+    row_roots.push_back(hexdecode(r->str));
+  auto& rproofs = rp->obj.at("proofs")->arr;
+  if (row_roots.size() != rproofs.size()) return false;
+  for (size_t i = 0; i < row_roots.size(); i++) {
+    auto& p = *rproofs[i];
+    std::vector<std::string> aunts;
+    for (auto& a : p.obj.at("aunts")->arr) aunts.push_back(b64decode(a->str));
+    std::string lh = b64decode(p.obj.at("leaf_hash")->str);
+    if (lh != sha::digest(std::string("\x00", 1) + row_roots[i]))
+      return false;
+    bool ok = true;
+    std::string got = compute_from_aunts(
+        p.obj.at("index")->num, p.obj.at("total")->num, lh, aunts, 0, ok);
+    if (!ok || got != data_root) return false;
+  }
+  auto& sps = proof->obj.at("share_proofs")->arr;
+  if (sps.size() != row_roots.size()) return false;
+  size_t cursor = 0;
+  for (size_t i = 0; i < sps.size(); i++) {
+    auto& sp = *sps[i];
+    NmtRange r;
+    r.start = sp.obj.at("start")->num;
+    r.end = sp.obj.at("end")->num;
+    r.total = sp.obj.at("total")->num;
+    for (auto& nnode : sp.obj.at("nodes")->arr)
+      r.nodes.push_back(b64decode(nnode->str));
+    size_t count = (size_t)(r.end - r.start);
+    if (cursor + count > shares.size()) return false;
+    std::vector<std::pair<std::string, std::string>> leaves;
+    for (size_t j = 0; j < count; j++) {
+      const std::string& s = shares[cursor + j];
+      if (s.size() < NS) return false;
+      leaves.push_back({s.substr(0, NS), s});
+    }
+    if (!nmt_verify(r, row_roots[i], leaves)) return false;
+    cursor += count;
+  }
+  return cursor == shares.size();
+}
+
+// ---------------------------------------------------------------------------
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s <host> <port> <k> [seed]\n", argv[0]);
+    return 2;
+  }
+  std::string host = argv[1];
+  int port = atoi(argv[2]);
+  int k = atoi(argv[3]);
+  uint64_t seed = argc > 4 ? (uint64_t)atoll(argv[4]) : 42;
+  if (k < 1 || k > 32 || (k & (k - 1))) {
+    fprintf(stderr, "k must be a power of two in [1, 32]\n");
+    return 2;
+  }
+  init_tables();
+  memset(PARITY_NS, 0xFF, NS);
+  const int two_k = 2 * k;
+
+  // 1. deterministic ODS: ascending namespaces (row-major), xorshift body
+  std::vector<uint8_t> ods((size_t)k * k * SHARE);
+  uint64_t x = seed ? seed : 1;
+  for (int i = 0; i < k * k; i++) {
+    uint8_t* s = &ods[(size_t)i * SHARE];
+    memset(s, 0, NS);
+    s[18] = (uint8_t)(1 + (i * 200) / (k * k));  // non-decreasing namespaces
+    for (int j = (int)NS; j < SHARE; j++) {
+      x ^= x << 13; x ^= x >> 7; x ^= x << 17;
+      s[j] = (uint8_t)(x & 0xff);
+    }
+  }
+
+  // 2. local independent DAH: extend + axis roots + data root
+  std::vector<uint8_t> eds((size_t)two_k * two_k * SHARE);
+  for (int r = 0; r < k; r++)
+    memcpy(&eds[((size_t)r * two_k) * SHARE], &ods[(size_t)r * k * SHARE],
+           (size_t)k * SHARE);
+  std::vector<uint8_t*> work(k);
+  std::vector<uint8_t> buf((size_t)k * SHARE);
+  auto extend_row = [&](int r) {
+    for (int c = 0; c < k; c++) {
+      memcpy(&buf[(size_t)c * SHARE],
+             &eds[((size_t)r * two_k + c) * SHARE], SHARE);
+      work[c] = &buf[(size_t)c * SHARE];
+    }
+    leo_encode(work.data(), k, SHARE);
+    for (int c = 0; c < k; c++)
+      memcpy(&eds[((size_t)r * two_k + k + c) * SHARE], work[c], SHARE);
+  };
+  for (int r = 0; r < k; r++) extend_row(r);
+  for (int c = 0; c < k; c++) {  // Q2: column extend of Q0
+    for (int r = 0; r < k; r++) {
+      memcpy(&buf[(size_t)r * SHARE],
+             &eds[((size_t)r * two_k + c) * SHARE], SHARE);
+      work[r] = &buf[(size_t)r * SHARE];
+    }
+    leo_encode(work.data(), k, SHARE);
+    for (int r = 0; r < k; r++)
+      memcpy(&eds[((size_t)(k + r) * two_k + c) * SHARE], work[r], SHARE);
+  }
+  for (int r = k; r < two_k; r++) extend_row(r);  // Q3
+
+  std::vector<uint8_t> roots((size_t)2 * two_k * 90);
+  for (int r = 0; r < two_k; r++)
+    axis_root(
+        two_k, [&](int j) { return &eds[((size_t)r * two_k + j) * SHARE]; },
+        [&](int j) { return r < k && j < k; }, &roots[(size_t)r * 90]);
+  for (int c = 0; c < two_k; c++)
+    axis_root(
+        two_k, [&](int j) { return &eds[((size_t)j * two_k + c) * SHARE]; },
+        [&](int j) { return c < k && j < k; },
+        &roots[(size_t)(two_k + c) * 90]);
+  uint8_t local_root[32];
+  merkle_root(roots.data(), 2 * two_k, 90, local_root);
+
+  // 3. ExtendAndCommit over the wire
+  std::string ods_str((const char*)ods.data(), ods.size());
+  std::string body = "{\"ods\": \"" + b64encode(ods_str) +
+                     "\", \"square_size\": " + std::to_string(k) + "}";
+  std::string resp = http_post(host, port, "/da/extend_commit", body);
+  if (resp.empty()) {
+    fprintf(stderr, "empty HTTP response\n");
+    return 2;
+  }
+  JParser parser(resp);
+  auto doc = parser.parse();
+  if (doc->obj.count("error")) {
+    fprintf(stderr, "service error: %s\n", doc->obj["error"]->str.c_str());
+    return 2;
+  }
+
+  // 4. byte-identity of every root
+  auto& jrows = doc->obj.at("row_roots")->arr;
+  auto& jcols = doc->obj.at("col_roots")->arr;
+  if ((int)jrows.size() != two_k || (int)jcols.size() != two_k) {
+    printf("FAILED: expected %d roots per axis, got %zu/%zu\n", two_k,
+           jrows.size(), jcols.size());
+    return 1;
+  }
+  for (int i = 0; i < two_k; i++) {
+    if (hexdecode(jrows[i]->str) !=
+        std::string((const char*)&roots[(size_t)i * 90], 90)) {
+      printf("FAILED: row root %d differs from local recompute\n", i);
+      return 1;
+    }
+    if (hexdecode(jcols[i]->str) !=
+        std::string((const char*)&roots[(size_t)(two_k + i) * 90], 90)) {
+      printf("FAILED: col root %d differs from local recompute\n", i);
+      return 1;
+    }
+  }
+  std::string got_root = hexdecode(doc->obj.at("data_root")->str);
+  if (got_root != std::string((const char*)local_root, 32)) {
+    printf("FAILED: data root differs from local recompute\n");
+    return 1;
+  }
+
+  // 5. ProveShares against the (now byte-pinned) data root
+  int end = k * k < 4 ? k * k : 4;
+  std::string ns((const char*)&ods[0], NS);
+  std::string pbody = "{\"data_root\": \"" + doc->obj.at("data_root")->str +
+                      "\", \"start\": 0, \"end\": " + std::to_string(end) +
+                      ", \"namespace\": \"" + hexencode(ns) + "\"}";
+  std::string presp = http_post(host, port, "/da/prove_shares", pbody);
+  JParser pparser(presp);
+  auto pdoc = pparser.parse();
+  if (pdoc->obj.count("error")) {
+    fprintf(stderr, "prove error: %s\n", pdoc->obj["error"]->str.c_str());
+    return 2;
+  }
+  if (!verify_share_proof(*pdoc, got_root)) {
+    printf("FAILED: share proof did not verify\n");
+    return 1;
+  }
+  // tamper self-check (vacuous-verifier guard)
+  auto& first_share = pdoc->obj.at("proof")->obj.at("data")->arr[0]->str;
+  std::string raw = b64decode(first_share);
+  raw[NS] ^= 0x5a;
+  first_share = b64encode(raw);
+  if (verify_share_proof(*pdoc, got_root)) {
+    printf("FAILED: tampered proof verified (vacuous verifier)\n");
+    return 1;
+  }
+
+  printf("DA OK: k=%d DAH byte-identical (%d roots + data root %s...), "
+         "share proof [0,%d) verified in C++\n",
+         k, 2 * two_k, doc->obj.at("data_root")->str.substr(0, 16).c_str(),
+         end);
+  return 0;
+}

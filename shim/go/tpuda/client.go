@@ -1,0 +1,179 @@
+// Package tpuda is the Go-side shim for the celestia_app_tpu DA core
+// (SURVEY §7.1.7): a drop-in replacement for the erasure-extension +
+// DAH-construction path of celestia-app —
+//
+//	pkg/da/data_availability_header.go:65-75  (da.ExtendShares)
+//	pkg/da/data_availability_header.go:44-63  (NewDataAvailabilityHeader)
+//	app/extend_block.go:14-26                 (the one caller that matters)
+//
+// Instead of running rsmt2d + NMT hashing on the Go node's CPUs, the ODS
+// is shipped to a celestia_app_tpu DA service (TPU-backed `da-serve`
+// sidecar or a full node's /da/* routes) and the returned DAH is used
+// verbatim. Row/column roots and the data root are byte-identical to the
+// reference pipeline — native/da_client.cc and
+// tests/test_da_service.py pin that identity, and the service side is
+// additionally pinned against the reference DAH vectors
+// (tests/test_dah_golden.py).
+//
+// Zero dependencies beyond the standard library, so it compiles with any
+// stock Go toolchain. See README.md for the patch recipe and the
+// compile/test gate (no Go toolchain exists in the build image this
+// repository is developed in; `go vet && go test` must be run the first
+// time one is available).
+package tpuda
+
+import (
+	"bytes"
+	"encoding/base64"
+	"encoding/hex"
+	"encoding/json"
+	"fmt"
+	"net/http"
+	"time"
+)
+
+// ShareSize is the fixed celestia share size (appconsts.ShareSize).
+const ShareSize = 512
+
+// DataAvailabilityHeader mirrors the reference struct of the same name
+// (pkg/da/data_availability_header.go:32-40): 2k row roots, 2k column
+// roots (90-byte serialized NMT roots), and the 32-byte Merkle hash over
+// row_roots||column_roots.
+type DataAvailabilityHeader struct {
+	RowRoots    [][]byte `json:"row_roots"`
+	ColumnRoots [][]byte `json:"column_roots"`
+	hash        []byte
+}
+
+// Hash returns the data root the service computed. Unlike the reference
+// it is never recomputed locally — the service's answer IS the
+// commitment (verify end-to-end with native/da_client.cc if the service
+// is untrusted).
+func (dah *DataAvailabilityHeader) Hash() []byte { return dah.hash }
+
+// Equals matches the reference helper.
+func (dah *DataAvailabilityHeader) Equals(to *DataAvailabilityHeader) bool {
+	return bytes.Equal(dah.Hash(), to.Hash())
+}
+
+// Client talks to one DA service endpoint.
+type Client struct {
+	// BaseURL of the DA service, e.g. "http://127.0.0.1:26659"
+	// (`celestia-tpu da-serve`) or a full node's service port.
+	BaseURL string
+	HTTP    *http.Client
+}
+
+// New returns a client with a sane default timeout. Extension latency is
+// milliseconds on-device; the timeout covers cold-compile on first use.
+func New(baseURL string) *Client {
+	return &Client{
+		BaseURL: baseURL,
+		HTTP:    &http.Client{Timeout: 120 * time.Second},
+	}
+}
+
+type extendResponse struct {
+	SquareSize int      `json:"square_size"`
+	RowRoots   []string `json:"row_roots"`
+	ColRoots   []string `json:"col_roots"`
+	DataRoot   string   `json:"data_root"`
+	Error      string   `json:"error"`
+}
+
+// ExtendAndCommit is the drop-in for the da.ExtendShares →
+// NewDataAvailabilityHeader pair as used by app.ExtendBlock
+// (app/extend_block.go:14-26): ODS shares in (exactly what go-square's
+// shares.ToBytes(dataSquare) produces), DAH out. The service performs
+// the Reed-Solomon extension and every NMT/Merkle hash.
+func (c *Client) ExtendAndCommit(s [][]byte) (*DataAvailabilityHeader, error) {
+	if len(s) == 0 || (len(s)&(len(s)-1)) != 0 {
+		return nil, fmt.Errorf(
+			"number of shares is not a power of 2: got %d", len(s))
+	}
+	ods := make([]byte, 0, len(s)*ShareSize)
+	for i, share := range s {
+		if len(share) != ShareSize {
+			return nil, fmt.Errorf(
+				"share %d has %d bytes, want %d", i, len(share), ShareSize)
+		}
+		ods = append(ods, share...)
+	}
+	body, err := json.Marshal(map[string]any{
+		"ods": base64.StdEncoding.EncodeToString(ods),
+	})
+	if err != nil {
+		return nil, err
+	}
+	resp, err := c.HTTP.Post(c.BaseURL+"/da/extend_commit",
+		"application/json", bytes.NewReader(body))
+	if err != nil {
+		return nil, fmt.Errorf("da service unreachable: %w", err)
+	}
+	defer resp.Body.Close()
+	var out extendResponse
+	if err := json.NewDecoder(resp.Body).Decode(&out); err != nil {
+		return nil, err
+	}
+	if out.Error != "" {
+		return nil, fmt.Errorf("da service: %s", out.Error)
+	}
+	dah := &DataAvailabilityHeader{}
+	if dah.RowRoots, err = decodeHexList(out.RowRoots); err != nil {
+		return nil, err
+	}
+	if dah.ColumnRoots, err = decodeHexList(out.ColRoots); err != nil {
+		return nil, err
+	}
+	if dah.hash, err = hex.DecodeString(out.DataRoot); err != nil {
+		return nil, err
+	}
+	return dah, nil
+}
+
+// ProveShares fetches a share-range proof (pkg/proof ProveShares analog)
+// for ODS shares [start, end) of a square previously extended through
+// this service, identified by its data root. The returned JSON document
+// matches chain/query._share_proof_json and verifies with the
+// independent C++ verifier in native/da_client.cc.
+func (c *Client) ProveShares(dataRoot []byte, start, end int,
+	namespace []byte) (json.RawMessage, error) {
+	body, err := json.Marshal(map[string]any{
+		"data_root": hex.EncodeToString(dataRoot),
+		"start":     start,
+		"end":       end,
+		"namespace": hex.EncodeToString(namespace),
+	})
+	if err != nil {
+		return nil, err
+	}
+	resp, err := c.HTTP.Post(c.BaseURL+"/da/prove_shares",
+		"application/json", bytes.NewReader(body))
+	if err != nil {
+		return nil, fmt.Errorf("da service unreachable: %w", err)
+	}
+	defer resp.Body.Close()
+	var out struct {
+		Proof json.RawMessage `json:"proof"`
+		Error string          `json:"error"`
+	}
+	if err := json.NewDecoder(resp.Body).Decode(&out); err != nil {
+		return nil, err
+	}
+	if out.Error != "" {
+		return nil, fmt.Errorf("da service: %s", out.Error)
+	}
+	return out.Proof, nil
+}
+
+func decodeHexList(in []string) ([][]byte, error) {
+	out := make([][]byte, len(in))
+	for i, s := range in {
+		b, err := hex.DecodeString(s)
+		if err != nil {
+			return nil, err
+		}
+		out[i] = b
+	}
+	return out, nil
+}

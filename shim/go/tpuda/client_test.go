@@ -1,0 +1,89 @@
+package tpuda
+
+import (
+	"bytes"
+	"os"
+	"testing"
+)
+
+// TestExtendAndCommitAgainstLiveService drives a running DA service —
+// the Go half of the foreign-caller story. Point TPU_DA_URL at a
+// `celestia-tpu da-serve` (or node service) instance:
+//
+//	python -m celestia_app_tpu da-serve --listen 26659 &
+//	TPU_DA_URL=http://127.0.0.1:26659 go test ./...
+//
+// The byte-identity of the returned DAH against an independent local
+// recompute is pinned by native/da_client.cc (same service, same
+// payloads); this test pins the Go client's plumbing: shape, determinism,
+// error surfacing, and proof retrieval.
+func TestExtendAndCommitAgainstLiveService(t *testing.T) {
+	url := os.Getenv("TPU_DA_URL")
+	if url == "" {
+		t.Skip("TPU_DA_URL not set; start `celestia-tpu da-serve` and " +
+			"export TPU_DA_URL=http://127.0.0.1:26659")
+	}
+	c := New(url)
+
+	k := 4
+	shares := make([][]byte, k*k)
+	for i := range shares {
+		s := make([]byte, ShareSize)
+		s[18] = byte(1 + i/4) // ascending namespaces, row-major
+		for j := 29; j < ShareSize; j++ {
+			s[j] = byte((i*131 + j*31) % 251)
+		}
+		shares[i] = s
+	}
+
+	dah, err := c.ExtendAndCommit(shares)
+	if err != nil {
+		t.Fatalf("ExtendAndCommit: %v", err)
+	}
+	if len(dah.RowRoots) != 2*k || len(dah.ColumnRoots) != 2*k {
+		t.Fatalf("want %d roots per axis, got %d/%d", 2*k,
+			len(dah.RowRoots), len(dah.ColumnRoots))
+	}
+	for i, r := range dah.RowRoots {
+		if len(r) != 90 {
+			t.Fatalf("row root %d is %d bytes, want 90", i, len(r))
+		}
+	}
+	if len(dah.Hash()) != 32 {
+		t.Fatalf("data root is %d bytes, want 32", len(dah.Hash()))
+	}
+
+	// determinism: same ODS -> same DAH
+	again, err := c.ExtendAndCommit(shares)
+	if err != nil {
+		t.Fatalf("second ExtendAndCommit: %v", err)
+	}
+	if !dah.Equals(again) {
+		t.Fatal("same ODS produced different data roots")
+	}
+
+	// a changed square must change the commitment
+	shares[0] = bytes.Repeat([]byte{0}, ShareSize)
+	shares[0][29] = 0xFF
+	changed, err := c.ExtendAndCommit(shares)
+	if err != nil {
+		t.Fatalf("third ExtendAndCommit: %v", err)
+	}
+	if dah.Equals(changed) {
+		t.Fatal("tampered ODS produced the same data root")
+	}
+
+	// proof retrieval for the cached square
+	proof, err := c.ProveShares(again.Hash(), 0, 2, shares[1][:29])
+	if err != nil {
+		t.Fatalf("ProveShares: %v", err)
+	}
+	if len(proof) == 0 {
+		t.Fatal("empty proof document")
+	}
+
+	// malformed input surfaces the service's reason
+	if _, err := c.ExtendAndCommit([][]byte{make([]byte, 100)}); err == nil {
+		t.Fatal("undersized share accepted")
+	}
+}

@@ -1,0 +1,3 @@
+module github.com/celestia-tpu/shim/go/tpuda
+
+go 1.21
