@@ -236,6 +236,9 @@ def wipe_commits(data_dir: str) -> None:
 
     for sub in FileBackend.DIRS.values():
         shutil.rmtree(os.path.join(data_dir, sub), ignore_errors=True)
+    # the reactor's durable commit-record store describes the same wiped
+    # timeline — stale records must not be served to laggards
+    shutil.rmtree(os.path.join(data_dir, "commits"), ignore_errors=True)
     for name in _listdir(data_dir):
         if name == "LATEST" or name == "LOCK" or name.startswith("seg-"):
             try:
