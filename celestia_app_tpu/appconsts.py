@@ -11,6 +11,7 @@ Reference parity (celestia-app):
 from __future__ import annotations
 
 import dataclasses
+import os
 
 # ---------------------------------------------------------------------------
 # Layer 1: immutable share geometry (global_consts.go)
@@ -136,7 +137,12 @@ def gas_price_to_atto(price) -> int:
     if isinstance(price, int):
         return price * ATTO
     return int(Fraction(str(price)) * ATTO)
-DEFAULT_UPGRADE_HEIGHT_DELAY = 50_400  # ~7 days of 12s blocks (x/signal)
+# ~7 days of 12s blocks (x/signal). CONSENSUS-CRITICAL: every validator
+# in a network must agree on this value; the env override exists for
+# devnets/e2e tests (the reference's upgrade e2e shortens it the same
+# way via build-time config) and is read once at import.
+DEFAULT_UPGRADE_HEIGHT_DELAY = int(os.environ.get(
+    "CELESTIA_UPGRADE_HEIGHT_DELAY", 50_400))
 
 # x/blob gas model (x/blob/types/payforblob.go:20-42,158-179)
 PFB_GAS_FIXED_COST = 75_000

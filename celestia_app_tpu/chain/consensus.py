@@ -324,11 +324,13 @@ class ValidatorNode:
     """One validator: an App + key + mempool + WAL."""
 
     def __init__(self, name: str, priv: PrivateKey, genesis: dict,
-                 chain_id: str, data_dir: str | None = None):
+                 chain_id: str, data_dir: str | None = None,
+                 v2_upgrade_height: int | None = None):
         self.name = name
         self.priv = priv
         self.address = priv.public_key().address()
-        self.app = App(chain_id=chain_id, engine="host", data_dir=data_dir)
+        self.app = App(chain_id=chain_id, engine="host", data_dir=data_dir,
+                       v2_upgrade_height=v2_upgrade_height)
         self.app.init_chain(genesis)
         self.mempool: list[bytes] = []
         self._tx_meta: dict[bytes, tuple[float, bytes | None]] = {}

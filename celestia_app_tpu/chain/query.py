@@ -170,6 +170,18 @@ class QueryRouter:
             if att is None:
                 return {"attestation": None}
             return {"attestation": bs_mod._att_to_json(att)}
+        if path == "signal/tally":
+            # x/signal QueryVersionTally (x/signal/keeper.go): voting
+            # power signalled for a version + the total, plus any
+            # scheduled upgrade — what an operator watches pre-flip
+            version = int(data["version"])
+            ctx = self._ctx()
+            power, total = self.app.signal.tally(ctx, version)
+            return {
+                "power": power,
+                "total": total,
+                "pending": self.app.signal.pending_upgrade(ctx),
+            }
         if path == "blobstream/latest_nonce":
             return {
                 "nonce": self.app.blobstream.latest_attestation_nonce(self._ctx())

@@ -147,9 +147,14 @@ class ConsensusReactor:
         # never-seen proposer may be paying its cold jit compile, the
         # exact case the cold default exists for
         self._seen_proposers: set[bytes] = set()
-        # height of the catch-up episode whose snapshot-first attempt ran
-        # (one try per episode; replay continues regardless)
-        self._statesync_tried_for: int = -1
+        # snapshot-first attempted this catch-up episode? (reset when
+        # caught up; replay continues regardless of the attempt)
+        self._statesync_tried: bool = False
+        # proposers whose last expected proposal timed out while they
+        # were in _seen_proposers: they get ONE cold-window retry (a
+        # restarted validator repays its jit compile); a second timeout
+        # means dead, back to warm windows so rotation stays fast
+        self._cold_retry: set[bytes] = set()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -545,8 +550,11 @@ class ConsensusReactor:
                 self.app_hashes[height] = h.hex()
                 self._seen_proposers.add(prop.proposer)
                 telemetry.incr("reactor.commits_adopted")
-                self._remember_commit(doc, height)
-                applied = True
+            # persist OUTSIDE the writer lock (as the self-commit path
+            # does): a blocksync batch is one fsync per height, and the
+            # lock must not serialize HTTP handlers against disk flushes
+            self._remember_commit(doc, height)
+            applied = True
         return applied
 
     def _remember_commit(self, doc: dict, height: int) -> None:
@@ -673,12 +681,13 @@ class ConsensusReactor:
         progressed = False
         with self.service_lock:
             gap = target - (self.vnode.app.height + 1)
-        if (gap > self.cfg.statesync_gap
-                and self._statesync_tried_for != target):
-            # a huge gap snapshots first — but ONCE per episode, trying
-            # every peer: a dead snapshot endpoint must not tax every
-            # subsequent replay batch with its timeout
-            self._statesync_tried_for = target
+        if gap > self.cfg.statesync_gap and not self._statesync_tried:
+            # a huge gap snapshots first — but ONCE per catch-up episode
+            # (the flag resets when we catch up; keying on the moving
+            # target would re-fire per batch on a live chain): a dead
+            # snapshot endpoint must not tax every replay batch with its
+            # timeout
+            self._statesync_tried = True
             for u in self._peer_order(peer):
                 if self._state_sync_from(u):
                     progressed = True
@@ -691,31 +700,42 @@ class ConsensusReactor:
                 need = self.vnode.app.height + 1
             if need > target:
                 break
-            doc = self._fetch_commit_record(need, prefer=peer)
-            if doc is None:
+            if not self._replay_height(need, prefer=peer):
                 break
-            self.on_commit(doc)
-            if self._apply_pending_commit():
-                progressed = True
-            else:
-                break
+            progressed = True
         with self.service_lock:
             still_behind = self.vnode.app.height + 1 < target
         if not still_behind:
             with self._msg_lock:
                 if self._ahead is not None and self._ahead[0] <= target:
                     self._ahead = None  # caught up; stop re-checking
+            self._statesync_tried = False  # episode over
             return progressed
         if not progressed:
-            # no record served (peers pruned their windows past the gap):
-            # verified state sync is the only path left — try every peer
+            # no peer could serve an applicable record (windows pruned
+            # past the gap): verified state sync is the only path left
             for u in self._peer_order(peer):
                 if self._state_sync_from(u):
                     progressed = True
                     with self._msg_lock:
                         self._ahead = None
+                    self._statesync_tried = False
                     break
         return progressed
+
+    def _replay_height(self, need: int, prefer: str) -> bool:
+        """Blocksync one height: try EVERY peer's served record until one
+        passes the full verification in _apply_pending_commit — a single
+        peer serving a corrupt/tampered record must not defeat the sync
+        while honest peers hold a good one."""
+        for u in self._peer_order(prefer):
+            doc = self._fetch_record_from(u, need)
+            if doc is None:
+                continue
+            self.on_commit(doc)
+            if self._apply_pending_commit():
+                return True
+        return False
 
     def _peer_order(self, prefer: str) -> list[str]:
         return ([prefer] if prefer else []) + [
@@ -736,23 +756,16 @@ class ConsensusReactor:
             except (urllib.error.URLError, OSError, ValueError, KeyError):
                 continue
 
-    def _fetch_commit_record(self, height: int,
-                             prefer: str = "") -> dict | None:
-        urls = ([prefer] if prefer else []) + [
-            u for u in self.peers if u != prefer
-        ]
-        for u in urls:
-            try:
-                with urllib.request.urlopen(
-                    f"{u}/gossip/commit_at?height={height}",
-                    timeout=self.cfg.gossip_timeout,
-                ) as r:
-                    doc = json.loads(r.read())
-                if doc:
-                    return doc
-            except (urllib.error.URLError, OSError, ValueError):
-                continue
-        return None
+    def _fetch_record_from(self, url: str, height: int) -> dict | None:
+        try:
+            with urllib.request.urlopen(
+                f"{url}/gossip/commit_at?height={height}",
+                timeout=self.cfg.gossip_timeout,
+            ) as r:
+                doc = json.loads(r.read())
+            return doc or None
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
 
     def _state_sync_from(self, url: str) -> bool:
         import base64
@@ -810,17 +823,28 @@ class ConsensusReactor:
                 self._proposals.setdefault((height, r), prop)
             self._gossip("/gossip/proposal", c.proposal_to_json(prop))
 
-        # a proposer we have never seen a proposal from gets the cold
-        # window — its first proposal may be paying its own jit compile
-        proposer_is_new = (
-            self.proposer_for(height, r) not in self._seen_proposers
-        )
+        # cold propose window for (a) a proposer we have never seen a
+        # proposal from, or (b) one whose last expected proposal timed
+        # out (one retry: a RESTARTED validator repays its jit compile
+        # with peers still remembering it as seen) — either may be
+        # compiling; a second consecutive timeout reads as dead and
+        # rotation returns to warm windows
+        expected = self.proposer_for(height, r)
+        force_cold = (expected not in self._seen_proposers
+                      or expected in self._cold_retry)
         deadline = time.monotonic() + self._timeout(
-            "propose", force_cold=proposer_is_new
+            "propose", force_cold=force_cold
         )
         prop = self._wait(
             deadline, lambda: self._proposals.get((height, r))
         )
+        if prop is None and expected != self.vnode.address:
+            if expected in self._cold_retry:
+                self._cold_retry.discard(expected)  # dead: warm windows
+            elif expected in self._seen_proposers:
+                self._cold_retry.add(expected)  # maybe restarted: 1 retry
+        elif prop is not None:
+            self._cold_retry.discard(expected)
         self._trace_round(height, r, "propose", _t_round)
 
         # ---- prevote ----

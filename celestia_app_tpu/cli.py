@@ -904,9 +904,15 @@ def cmd_validator_serve(args) -> int:
     if err is not None:
         print(f"ERROR: {err}", file=sys.stderr)
         return 1
+    with open(os.path.join(args.home, "config.json")) as f:
+        home_cfg = json.load(f)
     vnode = consensus.ValidatorNode(
         key_doc.get("name", "val"), priv, genesis, args.chain_id,
         data_dir=os.path.join(args.home, "data"),
+        # the coordinated v1->v2 flip height (reference
+        # --v2-upgrade-height; consensus-critical, so it rides the home
+        # config every validator is provisioned with)
+        v2_upgrade_height=home_cfg.get("v2_upgrade_height"),
     )
     try:
         vnode.app.load()  # resume at the durable committed height

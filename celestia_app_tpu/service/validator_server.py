@@ -151,6 +151,7 @@ class ValidatorService:
             "address": v.address.hex(),
             "chain_id": v.app.chain_id,
             "height": v.app.height,
+            "app_version": v.app.app_version,
             "app_hash": v.app.last_app_hash.hex(),
             "mempool": len(v.mempool),
             "locked": v.locked_block.header.hash().hex()

@@ -31,3 +31,30 @@ def test_e2e_bench_passes(tmp_path):
     assert doc["blocks"] >= 3
     assert doc["max_block_bytes"] >= 0.9 * doc["target_bytes"]
     assert doc["blocks_per_sec"] is None or doc["blocks_per_sec"] > 0
+
+
+@pytest.mark.slow
+def test_e2e_bench_big_blocks_over_sockets(tmp_path):
+    """VERDICT r5 #5 done-criterion — the reference's ≥1 MB throughput
+    class (test/e2e/benchmark/throughput.go:105,124-125) over REAL
+    sockets: 3 autonomous OS-process validators, 70 ms injected gossip
+    latency, 200 KB blobs, target the full gov-max square (1.9 MB);
+    pass = some block reaches ≥90% of target. Single-blob PFBs pack the
+    square tighter than multi-blob ones (subtree-aligned padding), which
+    is how the flood reaches gov-max."""
+    out = subprocess.run(
+        [sys.executable, "-m", "celestia_app_tpu", "e2e-bench",
+         "--home", str(tmp_path), "--validators", "3", "--blocks", "5",
+         "--blob-kb", "200", "--blobs-per-tx", "1",
+         "--txs-per-block", "10", "--latency-ms", "70",
+         "--target-mb", "1.9", "--block-time", "1.0",
+         "--chain-id", "e2e-bench-big"],
+        capture_output=True, text=True, timeout=780,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["pass"] is True, doc
+    assert doc["validators"] == 3 and doc["latency_ms"] == 70.0
+    assert doc["max_block_bytes"] >= 0.9 * doc["target_bytes"]
+    assert doc["target_bytes"] >= 1.9 * 1024 * 1024
+    assert doc["blocks_per_sec"] and doc["blocks_per_sec"] > 0
