@@ -11,7 +11,6 @@ Reference parity (celestia-app):
 from __future__ import annotations
 
 import dataclasses
-import os
 
 # ---------------------------------------------------------------------------
 # Layer 1: immutable share geometry (global_consts.go)
@@ -138,11 +137,12 @@ def gas_price_to_atto(price) -> int:
         return price * ATTO
     return int(Fraction(str(price)) * ATTO)
 # ~7 days of 12s blocks (x/signal). CONSENSUS-CRITICAL: every validator
-# in a network must agree on this value; the env override exists for
-# devnets/e2e tests (the reference's upgrade e2e shortens it the same
-# way via build-time config) and is read once at import.
-DEFAULT_UPGRADE_HEIGHT_DELAY = int(os.environ.get(
-    "CELESTIA_UPGRADE_HEIGHT_DELAY", 50_400))
+# in a network must agree on this value. Devnets/e2e tests shorten it via
+# the provisioned home config (`upgrade_height_delay` in config.json,
+# plumbed App(upgrade_height_delay=...) -> SignalKeeper the same way
+# v2_upgrade_height rides) — NEVER a per-process env var, which two
+# validators could silently disagree on and fork at the flip.
+DEFAULT_UPGRADE_HEIGHT_DELAY = 50_400
 
 # x/blob gas model (x/blob/types/payforblob.go:20-42,158-179)
 PFB_GAS_FIXED_COST = 75_000

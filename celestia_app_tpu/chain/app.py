@@ -90,6 +90,7 @@ class App:
         engine: str = "auto",  # "device" | "host" | "auto"
         min_gas_price: float = appconsts.DEFAULT_MIN_GAS_PRICE,
         v2_upgrade_height: int | None = None,
+        upgrade_height_delay: int | None = None,
         data_dir: str | None = None,
         invariant_check_period: int = 0,  # crisis: 0 = only at genesis/on demand
     ):
@@ -117,7 +118,23 @@ class App:
         self.blob = modules.BlobKeeper()
         self.mint = modules.MintKeeper()
         self.staking = modules.StakingKeeper(self.bank)
-        self.signal = modules.SignalKeeper(self.staking)
+        if (upgrade_height_delay is not None and upgrade_height_delay
+                != appconsts.DEFAULT_UPGRADE_HEIGHT_DELAY):
+            # loud, per ADVICE r5: a delay override is consensus-critical
+            # — every validator in the network must carry the same one
+            import sys as _sys
+
+            print(
+                f"[{chain_id}] WARNING: upgrade_height_delay override "
+                f"active ({upgrade_height_delay} blocks, default "
+                f"{appconsts.DEFAULT_UPGRADE_HEIGHT_DELAY}); every "
+                "validator must be provisioned identically or the "
+                "network forks at the x/signal flip",
+                file=_sys.stderr, flush=True,
+            )
+        self.signal = modules.SignalKeeper(
+            self.staking, upgrade_height_delay=upgrade_height_delay
+        )
         self.minfee = modules.MinFeeKeeper()
         self.blobstream = blobstream_mod.BlobstreamKeeper(self.staking)
         self.staking.hooks.append(self.blobstream)

@@ -325,12 +325,14 @@ class ValidatorNode:
 
     def __init__(self, name: str, priv: PrivateKey, genesis: dict,
                  chain_id: str, data_dir: str | None = None,
-                 v2_upgrade_height: int | None = None):
+                 v2_upgrade_height: int | None = None,
+                 upgrade_height_delay: int | None = None):
         self.name = name
         self.priv = priv
         self.address = priv.public_key().address()
         self.app = App(chain_id=chain_id, engine="host", data_dir=data_dir,
-                       v2_upgrade_height=v2_upgrade_height)
+                       v2_upgrade_height=v2_upgrade_height,
+                       upgrade_height_delay=upgrade_height_delay)
         self.app.init_chain(genesis)
         self.mempool: list[bytes] = []
         self._tx_meta: dict[bytes, tuple[float, bytes | None]] = {}
@@ -492,21 +494,33 @@ class ValidatorNode:
         Signing different hashes in LATER rounds stays legal (required
         for liveness: re-prevoting a fresh proposal after a failed
         round, re-precommitting after unlock-on-higher-polka). Entries
-        are pruned once the chain moves past them."""
+        are pruned once the chain moves past them.
+
+        Nil signatures are recorded per slot too (the "" sentinel), so a
+        later NON-nil vote at a slot we already signed nil is refused —
+        Tendermint FilePV's same-HRS rule: two different votes at one
+        (height, round, step), nil vs block, are a conflict an external
+        privval judge would flag (ADVICE r5 #3). Re-signing nil at a
+        nil slot stays legal (nil is also the refusal output)."""
         slot = (round_, 0 if phase == "prevote" else 1)
         wm = self._sign_watermark.get(height)
         changed = False
+        key = (height, round_, phase)
         if bh is not None:
             if wm is not None and slot < wm:
                 bh = None  # slot regression: refuse
             else:
-                key = (height, round_, phase)
                 prior = self._signed_hashes.get(key)
                 if prior is not None and prior != bh.hex():
-                    bh = None  # refuse the double-sign; vote nil
+                    # covers both a DIFFERENT non-nil (the classic
+                    # double-sign) and a recorded nil ("" sentinel)
+                    bh = None  # refuse; vote nil
                 elif prior is None:
                     self._signed_hashes[key] = bh.hex()
                     changed = True
+        if bh is None and key not in self._signed_hashes:
+            self._signed_hashes[key] = ""  # nil signed at this slot
+            changed = True
         if wm is None or slot > wm:
             # every signature advances the watermark — nil ones too
             # (Tendermint persists every signed vote): a nil precommit at

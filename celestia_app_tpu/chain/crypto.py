@@ -1,34 +1,210 @@
 """Account keys, addresses, signing.
 
 Reference parity: cosmos-sdk secp256k1 account keys (the reference's account
-auth) — here via the `cryptography` library's SECP256K1 ECDSA with SHA-256,
-with deterministic low-level DER unwrapping to 64-byte (r || s) signatures.
-Addresses are the first 20 bytes of SHA-256(compressed pubkey) (the reference
-uses ripemd160(sha256(pk)); ripemd160 is unavailable in this OpenSSL build,
-and the address derivation is not consensus-relevant across frameworks).
+auth) — ECDSA with SHA-256 and deterministic RFC 6979 nonces, emitting
+64-byte low-S (r || s) signatures. Addresses are the first 20 bytes of
+SHA-256(compressed pubkey) (the reference uses ripemd160(sha256(pk));
+ripemd160 is unavailable in this OpenSSL build, and the address derivation
+is not consensus-relevant across frameworks).
+
+Two interchangeable backends, chosen at import:
+
+- the `cryptography` (OpenSSL) backend when the package is present;
+- a pure-Python secp256k1 fallback otherwise (Jacobian-coordinate point
+  arithmetic + faithful RFC 6979), so chain code runs in containers that
+  ship no OpenSSL bindings. Both backends produce BYTE-IDENTICAL
+  signatures (RFC 6979 is fully deterministic) — a WAL or tx signed under
+  one verifies and re-signs identically under the other, which keeps
+  app hashes and block data roots reproducible across environments
+  (pinned by tests/test_tx.py signature goldens).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import hashlib
+import hmac
 
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    Prehashed,
-    decode_dss_signature,
-    encode_dss_signature,
-)
+try:  # OpenSSL-backed fast path
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        Prehashed,
+        decode_dss_signature,
+    )
+
+    _HAVE_OPENSSL = True
+except ImportError:  # pure-Python fallback (see _Point below)
+    _HAVE_OPENSSL = False
 
 ADDRESS_LEN = 20
-_CURVE = ec.SECP256K1()
-# secp256k1 group order, for low-S normalization (signature malleability).
+# secp256k1 domain parameters (SEC 2): y^2 = x^3 + 7 over F_p.
+_P = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F
 _N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+_GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+_GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+if _HAVE_OPENSSL:
+    _CURVE = ec.SECP256K1()
 
 
 def _sha(b: bytes) -> bytes:
     return hashlib.sha256(b).digest()
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python secp256k1 (fallback backend)
+# ---------------------------------------------------------------------------
+# Jacobian coordinates: one modular inverse per scalar multiplication
+# instead of one per point add — the difference between usable and
+# unusable in pure Python. Only the operations this module needs (scalar
+# mult, add, compress/decompress) are implemented; no generality sought.
+
+
+def _inv(a: int, m: int) -> int:
+    return pow(a, -1, m)
+
+
+def _jac_double(p):
+    x, y, z = p
+    if not y:
+        return (0, 0, 0)
+    ysq = y * y % _P
+    s = 4 * x * ysq % _P
+    m = 3 * x * x % _P  # a = 0 for secp256k1
+    nx = (m * m - 2 * s) % _P
+    ny = (m * (s - nx) - 8 * ysq * ysq) % _P
+    nz = 2 * y * z % _P
+    return (nx, ny, nz)
+
+
+def _jac_add(p, q):
+    if not p[1]:
+        return q
+    if not q[1]:
+        return p
+    u1 = p[0] * q[2] * q[2] % _P
+    u2 = q[0] * p[2] * p[2] % _P
+    s1 = p[1] * q[2] ** 3 % _P
+    s2 = q[1] * p[2] ** 3 % _P
+    if u1 == u2:
+        if s1 != s2:
+            return (0, 0, 0)  # P + (-P)
+        return _jac_double(p)
+    h = (u2 - u1) % _P
+    r = (s2 - s1) % _P
+    h2 = h * h % _P
+    h3 = h * h2 % _P
+    u1h2 = u1 * h2 % _P
+    nx = (r * r - h3 - 2 * u1h2) % _P
+    ny = (r * (u1h2 - nx) - s1 * h3) % _P
+    nz = h * p[2] * q[2] % _P
+    return (nx, ny, nz)
+
+
+def _jac_mult(p, d: int):
+    r = (0, 0, 0)
+    a = p
+    while d:
+        if d & 1:
+            r = _jac_add(r, a)
+        a = _jac_double(a)
+        d >>= 1
+    return r
+
+
+def _to_affine(p):
+    if not p[1]:
+        return None  # point at infinity
+    zinv = _inv(p[2], _P)
+    z2 = zinv * zinv % _P
+    return (p[0] * z2 % _P, p[1] * z2 * zinv % _P)
+
+
+_G = (_GX, _GY, 1)
+
+
+def _decompress(compressed: bytes):
+    """(x, y) from a 33-byte SEC1 compressed point; None if invalid."""
+    if len(compressed) != 33 or compressed[0] not in (2, 3):
+        return None
+    x = int.from_bytes(compressed[1:], "big")
+    if x >= _P:
+        return None
+    y2 = (pow(x, 3, _P) + 7) % _P
+    y = pow(y2, (_P + 1) // 4, _P)  # p ≡ 3 (mod 4)
+    if y * y % _P != y2:
+        return None  # x is not on the curve
+    if (y & 1) != (compressed[0] & 1):
+        y = _P - y
+    return (x, y)
+
+
+def _compress(x: int, y: int) -> bytes:
+    return bytes([2 | (y & 1)]) + x.to_bytes(32, "big")
+
+
+def _rfc6979_k(x: int, h1: bytes) -> int:
+    """RFC 6979 §3.2 deterministic nonce (HMAC-SHA256, qlen = hlen = 256)
+    — bit-for-bit what the OpenSSL backend's deterministic_signing does,
+    so both backends emit identical signatures."""
+    xb = x.to_bytes(32, "big")
+    hb = (int.from_bytes(h1, "big") % _N).to_bytes(32, "big")
+    v = b"\x01" * 32
+    k = b"\x00" * 32
+    k = hmac.new(k, v + b"\x00" + xb + hb, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    k = hmac.new(k, v + b"\x01" + xb + hb, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    while True:
+        v = hmac.new(k, v, hashlib.sha256).digest()
+        kand = int.from_bytes(v, "big")
+        if 1 <= kand < _N:
+            return kand
+        k = hmac.new(k, v + b"\x00", hashlib.sha256).digest()
+        v = hmac.new(k, v, hashlib.sha256).digest()
+
+
+def _py_sign(x: int, message: bytes) -> bytes:
+    z = int.from_bytes(_sha(message), "big") % _N
+    h1 = _sha(message)
+    while True:
+        k = _rfc6979_k(x, h1)
+        pt = _to_affine(_jac_mult(_G, k))
+        r = pt[0] % _N
+        if not r:
+            h1 = _sha(h1)  # unreachable in practice; restart nonce stream
+            continue
+        s = _inv(k, _N) * (z + r * x) % _N
+        if not s:
+            h1 = _sha(h1)
+            continue
+        if s > _N // 2:
+            s = _N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+def _py_verify(compressed: bytes, signature: bytes, message: bytes) -> bool:
+    q = _decompress(compressed)
+    if q is None:
+        return False
+    r = int.from_bytes(signature[:32], "big")
+    s = int.from_bytes(signature[32:], "big")
+    if not (1 <= r < _N and 1 <= s < _N):
+        return False
+    z = int.from_bytes(_sha(message), "big") % _N
+    w = _inv(s, _N)
+    u1 = z * w % _N
+    u2 = r * w % _N
+    pt = _to_affine(_jac_add(_jac_mult(_G, u1),
+                             _jac_mult((q[0], q[1], 1), u2)))
+    if pt is None:
+        return False
+    return pt[0] % _N == r
+
+
+# ---------------------------------------------------------------------------
+# Public API (backend-independent)
+# ---------------------------------------------------------------------------
 
 
 @dataclasses.dataclass(frozen=True)
@@ -42,12 +218,22 @@ class PublicKey:
         """Verify a 64-byte (r || s) signature over sha256(message)."""
         if len(signature) != 64:
             return False
+        s = int.from_bytes(signature[32:], "big")
+        if s > _N // 2:
+            return False  # reject high-S: tx bytes must not be malleable
+        if not _HAVE_OPENSSL:
+            try:
+                return _py_verify(self.compressed, signature, message)
+            except Exception:
+                return False
         try:
-            pub = ec.EllipticCurvePublicKey.from_encoded_point(_CURVE, self.compressed)
+            pub = ec.EllipticCurvePublicKey.from_encoded_point(
+                _CURVE, self.compressed)
             r = int.from_bytes(signature[:32], "big")
-            s = int.from_bytes(signature[32:], "big")
-            if s > _N // 2:
-                return False  # reject high-S: tx bytes must not be malleable
+            from cryptography.hazmat.primitives.asymmetric.utils import (
+                encode_dss_signature,
+            )
+
             der = encode_dss_signature(r, s)
             pub.verify(der, _sha(message), ec.ECDSA(Prehashed(hashes.SHA256())))
             return True
@@ -71,10 +257,13 @@ class PrivateKey:
 
         return cls(secrets.randbelow(_N - 1) + 1)
 
-    def _key(self) -> ec.EllipticCurvePrivateKey:
+    def _key(self):
         return ec.derive_private_key(self.scalar, _CURVE)
 
     def public_key(self) -> PublicKey:
+        if not _HAVE_OPENSSL:
+            x, y = _to_affine(_jac_mult(_G, self.scalar))
+            return PublicKey(_compress(x, y))
         pub = self._key().public_key()
         compressed = pub.public_bytes(
             serialization.Encoding.X962, serialization.PublicFormat.CompressedPoint
@@ -87,9 +276,10 @@ class PrivateKey:
         Deterministic per RFC 6979 (HMAC-SHA256 nonce), like the reference's
         cosmos-sdk secp256k1 signer (btcec) — identical inputs produce
         identical tx bytes, which keeps block data roots reproducible and
-        signatures non-malleable. OpenSSL's randomized-nonce ECDSA is kept
-        for verification only.
+        signatures non-malleable.
         """
+        if not _HAVE_OPENSSL:
+            return _py_sign(self.scalar, message)
         der = self._key().sign(
             _sha(message),
             ec.ECDSA(Prehashed(hashes.SHA256()), deterministic_signing=True),

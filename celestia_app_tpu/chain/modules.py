@@ -238,8 +238,17 @@ class SignalKeeper:
     PREFIX = b"signal/sig/"
     UPGRADE = b"signal/pending_upgrade"
 
-    def __init__(self, staking: StakingKeeper):
+    def __init__(self, staking: StakingKeeper,
+                 upgrade_height_delay: int | None = None):
         self.staking = staking
+        # consensus-critical: every validator must be provisioned with the
+        # same value (home config / genesis), like v2_upgrade_height
+        self.upgrade_height_delay = (
+            appconsts.DEFAULT_UPGRADE_HEIGHT_DELAY
+            if upgrade_height_delay is None else int(upgrade_height_delay)
+        )
+        if self.upgrade_height_delay < 1:
+            raise ValueError("upgrade_height_delay must be >= 1")
 
     def signal_version(self, ctx: Context, validator: bytes, version: int) -> None:
         if self.staking.validator_power(ctx, validator) == 0:
@@ -261,7 +270,7 @@ class SignalKeeper:
 
     def try_upgrade(self, ctx: Context) -> bool:
         """keeper.go:96-116: >= 5/6 power on some version schedules it
-        DEFAULT_UPGRADE_HEIGHT_DELAY blocks out."""
+        upgrade_height_delay blocks out."""
         if _get(ctx, self.UPGRADE) is not None:
             raise ValueError("upgrade already pending")
         for version in range(ctx.app_version + 1, appconsts.LATEST_VERSION + 1):
@@ -272,7 +281,7 @@ class SignalKeeper:
                     self.UPGRADE,
                     {
                         "version": version,
-                        "height": ctx.height + appconsts.DEFAULT_UPGRADE_HEIGHT_DELAY,
+                        "height": ctx.height + self.upgrade_height_delay,
                     },
                 )
                 ctx.emit_event("signal.upgrade_scheduled", version=version)

@@ -31,6 +31,70 @@ class QueryError(Exception):
     pass
 
 
+def rebuild_square(app, height: int):
+    """Reconstruct the square from the stored block (querier.go:88-116:
+    proofs are derived from block data, not cached trees)."""
+    if app.db is None:
+        raise QueryError("no block store attached (need data_dir)")
+    block = app.db.load_block(height)
+    normal, pfbs = [], []
+    for raw in block.txs:
+        if is_blob_tx(raw):
+            btx = unmarshal_blob_tx(raw)
+            pfbs.append(PfbEntry(btx.tx, btx.blobs))
+        else:
+            normal.append(raw)
+    threshold = appconsts.subtree_root_threshold(block.header.app_version)
+    upper = appconsts.square_size_upper_bound(block.header.app_version)
+    square = square_mod.construct(normal, pfbs, upper, threshold)
+    return block, square
+
+
+def build_prover(app, height: int):
+    """(block, square, BlockProver, data_root) for a committed height —
+    engine-gated like every serving path, shared by the query router and
+    the DAS sample server (das/server.py)."""
+    block, square = rebuild_square(app, height)
+    ods = dah_mod.shares_to_ods(square.share_bytes())
+    if getattr(app, "engine", "auto") == "host":
+        # host-engine validators must not touch the jax backend even
+        # for queries (a down accelerator relay HANGS backend init,
+        # wedging the HTTP handler mid-service-lock); the host NMT
+        # levels are bit-identical (tests/test_fast_host.py)
+        import numpy as np
+
+        from celestia_app_tpu.utils import fast_host, merkle_host
+
+        eds_np = fast_host.extend_square_fast(ods)
+        k = eds_np.shape[0] // 2
+        # row levels hashed ONCE: the prover consumes all of them and
+        # the row roots are just the last level
+        levels = fast_host.nmt_levels_fast(
+            fast_host._axis_leaf_ns(eds_np, k), eds_np
+        )
+        lm, lx, lv = levels[-1]
+        rows = np.concatenate([lm[:, 0], lx[:, 0], lv[:, 0]], axis=1)
+        eds_t = np.swapaxes(eds_np, 0, 1)
+        cols = fast_host.nmt_roots_fast(
+            fast_host._axis_leaf_ns(eds_t, k), eds_t
+        )
+        root = merkle_host.hash_from_leaves(
+            [bytes(r) for r in rows] + [bytes(c) for c in cols]
+        )
+        d = dah_mod.DataAvailabilityHeader(
+            tuple(bytes(r) for r in rows),
+            tuple(bytes(c) for c in cols),
+        )
+        eds_obj = dah_mod.ExtendedDataSquare(eds_np)
+    else:
+        d, eds_obj, root = dah_mod.new_dah_from_ods(ods)
+        levels = None
+    if root != block.header.data_hash:
+        raise QueryError("recomputed data root mismatches stored header")
+    prover = proof_device.BlockProver(eds_obj, d, levels=levels)
+    return block, square, prover, root
+
+
 class QueryRouter:
     def __init__(self, app):
         self.app = app
@@ -46,24 +110,6 @@ class QueryRouter:
 
     # -- proof plumbing --------------------------------------------------
 
-    def _rebuild_square(self, height: int):
-        """Reconstruct the square from the stored block (querier.go:88-116:
-        proofs are derived from block data, not cached trees)."""
-        if self.app.db is None:
-            raise QueryError("no block store attached (need data_dir)")
-        block = self.app.db.load_block(height)
-        normal, pfbs = [], []
-        for raw in block.txs:
-            if is_blob_tx(raw):
-                btx = unmarshal_blob_tx(raw)
-                pfbs.append(PfbEntry(btx.tx, btx.blobs))
-            else:
-                normal.append(raw)
-        threshold = appconsts.subtree_root_threshold(block.header.app_version)
-        upper = appconsts.square_size_upper_bound(block.header.app_version)
-        square = square_mod.construct(normal, pfbs, upper, threshold)
-        return block, square
-
     def _prover(self, height: int):
         # rollback guard: any load()/load_height() bumps the app's state
         # generation; cached provers from before then may describe a
@@ -73,45 +119,7 @@ class QueryRouter:
             self._cache_generation = self.app.state_generation
         if height in self._prover_cache:
             return self._prover_cache[height]
-        block, square = self._rebuild_square(height)
-        ods = dah_mod.shares_to_ods(square.share_bytes())
-        if getattr(self.app, "engine", "auto") == "host":
-            # host-engine validators must not touch the jax backend even
-            # for queries (a down accelerator relay HANGS backend init,
-            # wedging the HTTP handler mid-service-lock); the host NMT
-            # levels are bit-identical (tests/test_fast_host.py)
-            import numpy as np
-
-            from celestia_app_tpu.utils import fast_host, merkle_host
-
-            eds_np = fast_host.extend_square_fast(ods)
-            k = eds_np.shape[0] // 2
-            # row levels hashed ONCE: the prover consumes all of them and
-            # the row roots are just the last level
-            levels = fast_host.nmt_levels_fast(
-                fast_host._axis_leaf_ns(eds_np, k), eds_np
-            )
-            lm, lx, lv = levels[-1]
-            rows = np.concatenate([lm[:, 0], lx[:, 0], lv[:, 0]], axis=1)
-            eds_t = np.swapaxes(eds_np, 0, 1)
-            cols = fast_host.nmt_roots_fast(
-                fast_host._axis_leaf_ns(eds_t, k), eds_t
-            )
-            root = merkle_host.hash_from_leaves(
-                [bytes(r) for r in rows] + [bytes(c) for c in cols]
-            )
-            d = dah_mod.DataAvailabilityHeader(
-                tuple(bytes(r) for r in rows),
-                tuple(bytes(c) for c in cols),
-            )
-            eds_obj = dah_mod.ExtendedDataSquare(eds_np)
-        else:
-            d, eds_obj, root = dah_mod.new_dah_from_ods(ods)
-            levels = None
-        if root != block.header.data_hash:
-            raise QueryError("recomputed data root mismatches stored header")
-        prover = proof_device.BlockProver(eds_obj, d, levels=levels)
-        entry = (block, square, prover, root)
+        entry = build_prover(self.app, height)
         self._prover_cache.clear()  # keep at most one height resident
         self._prover_cache[height] = entry
         return entry

@@ -58,6 +58,7 @@ def _make_app(home: str):
         min_gas_price=cfg.get("min_gas_price", appconsts.DEFAULT_MIN_GAS_PRICE),
         invariant_check_period=cfg.get("invariant_check_period", 0),
         v2_upgrade_height=cfg.get("v2_upgrade_height"),
+        upgrade_height_delay=cfg.get("upgrade_height_delay"),
     )
     import weakref
 
@@ -377,6 +378,7 @@ def _write_config(home: str, chain_id: str, engine: str = "auto") -> None:
                 "min_gas_price": appconsts.DEFAULT_MIN_GAS_PRICE,
                 "invariant_check_period": 0,
                 "v2_upgrade_height": None,
+                "upgrade_height_delay": None,
                 "mempool_ttl_blocks": appconsts.MEMPOOL_TX_TTL_BLOCKS,
             },
             f, indent=2,
@@ -763,6 +765,87 @@ def cmd_da_serve(args) -> int:
     return 0
 
 
+def cmd_das_serve(args) -> int:
+    """DAS sample-proof server over a full node's home (das/server.py):
+    answers light-node samplers (`das-follow`) with cells + NMT proofs
+    from the committed block store — the serving half of the DAS plane,
+    deployable next to (or instead of) the full node process."""
+    from celestia_app_tpu.das.server import SampleCore, SampleService
+
+    app, _cfg = _make_app(args.home)
+    core = SampleCore(app, cache_heights=args.cache_heights)
+    svc = SampleService(core, port=args.listen)
+    print(f"das-serve: http on :{svc.port} (height {app.height}, "
+          f"engine={getattr(app, 'engine', 'host')})", flush=True)
+    try:
+        svc.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_das_follow(args) -> int:
+    """DASer daemon (das/daser.py): follow a chain as a light node —
+    verify headers by commit certificate (chain/light.py), sample every
+    height, checkpoint progress under --home, and halt on a verified
+    bad-encoding fraud proof. Exit codes: 0 clean stop, 1 halted, 2 bad
+    invocation."""
+    import numpy as np
+
+    from celestia_app_tpu.chain.light import LightClient, TrustedState
+    from celestia_app_tpu.das.checkpoint import CheckpointStore
+    from celestia_app_tpu.das.daser import DASer, DASerConfig
+
+    if not args.peer:
+        print("error: das-follow needs at least one --peer", file=sys.stderr)
+        return 2
+    genesis_path = os.path.join(args.home, "genesis.json")
+    if not os.path.exists(genesis_path):
+        print(f"error: no genesis.json under {args.home} (trust root)",
+              file=sys.stderr)
+        return 2
+    with open(genesis_path) as f:
+        genesis = json.load(f)
+    validators, powers = {}, {}
+    for v in genesis.get("validators", []):
+        if "pubkey" not in v:
+            print("error: genesis validators need pubkeys for light "
+                  "verification", file=sys.stderr)
+            return 2
+        op = bytes.fromhex(v["operator"])
+        validators[op] = bytes.fromhex(v["pubkey"])
+        powers[op] = int(v["power"])
+    light = LightClient(args.chain_id, TrustedState(
+        height=0, header_hash=b"", validators=validators, powers=powers,
+    ))
+    store = CheckpointStore(os.path.join(args.home, "das",
+                                         "checkpoint.json"))
+    cfg = DASerConfig(
+        samples_per_header=args.samples,
+        workers=args.workers,
+        poll_interval=args.interval,
+    )
+    daser = DASer(list(args.peer), light, store, cfg=cfg,
+                  rng=np.random.default_rng(args.seed), name="das-follow")
+    if daser.halted:
+        print(json.dumps({"halted": daser.cp.halted}), flush=True)
+        return 1
+    try:
+        while not daser.halted:
+            out = daser.sync()
+            print(json.dumps(out), flush=True)
+            if out.get("halted"):
+                break  # a halt during header following returns a
+                # halted-only dict; fall through to the exit-1 line
+            if args.once and out.get("sample_from", 0) > out.get("head", -1) >= 1:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    print(json.dumps({"halted": daser.cp.halted}), flush=True)
+    return 1
+
+
 def cmd_verify(args) -> int:
     """Blobstream verification CLI (x/blobstream/client verify analog,
     ref client/verify.go:27-38): prove that shares at a height are
@@ -910,9 +993,11 @@ def cmd_validator_serve(args) -> int:
         key_doc.get("name", "val"), priv, genesis, args.chain_id,
         data_dir=os.path.join(args.home, "data"),
         # the coordinated v1->v2 flip height (reference
-        # --v2-upgrade-height; consensus-critical, so it rides the home
-        # config every validator is provisioned with)
+        # --v2-upgrade-height) and the x/signal scheduling delay: both
+        # consensus-critical, so both ride the home config every
+        # validator is provisioned with
         v2_upgrade_height=home_cfg.get("v2_upgrade_height"),
+        upgrade_height_delay=home_cfg.get("upgrade_height_delay"),
     )
     try:
         vnode.app.load()  # resume at the durable committed height
@@ -929,6 +1014,7 @@ def cmd_validator_serve(args) -> int:
 
         http_service = NodeService(vnode, port=args.http)
         http_service.lock = svc.lock  # one writer lock for the process
+        http_service.das_core.app_lock = svc.lock
         http_service.serve_background()
         endpoint["http_port"] = http_service.port
     grpc_server = None
@@ -1809,6 +1895,40 @@ def main(argv=None) -> int:
     p.add_argument("--grpc", type=int, default=None)
     p.add_argument("--engine", default="host", choices=("host", "device"))
     p.set_defaults(fn=cmd_da_serve)
+
+    p = sub.add_parser(
+        "das-serve",
+        help="DAS sample-proof server over a node home (das/server.py): "
+             "GET /das/sample + batched POST /das/samples from committed "
+             "blocks — the full-node half of the DAS plane")
+    p.add_argument("--home", required=True)
+    p.add_argument("--listen", type=int, default=26660)
+    p.add_argument("--cache-heights", type=int, default=4,
+                   help="LRU square-cache depth (per-height row trees)")
+    p.set_defaults(fn=cmd_das_serve)
+
+    p = sub.add_parser(
+        "das-follow",
+        help="DASer light-node daemon (das/daser.py): follow headers by "
+             "commit certificate, sample every height, checkpoint under "
+             "--home/das/, halt on a verified bad-encoding fraud proof")
+    p.add_argument("--home", required=True,
+                   help="holds genesis.json (the trust root) and the "
+                        "das/checkpoint.json progress record")
+    p.add_argument("--chain-id", default="celestia-tpu-1")
+    p.add_argument("--peer", action="append",
+                   help="sampling/header peer URL (repeatable)")
+    p.add_argument("--samples", type=int, default=16,
+                   help="cells sampled per header (confidence 1-(3/4)^s)")
+    p.add_argument("--workers", type=int, default=3,
+                   help="parallel catch-up workers")
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="seconds between sweeps")
+    p.add_argument("--seed", type=int, default=None,
+                   help="sampling rng seed (default: fresh entropy)")
+    p.add_argument("--once", action="store_true",
+                   help="exit 0 once caught up to the served head")
+    p.set_defaults(fn=cmd_das_follow)
 
     p = sub.add_parser(
         "verify",

@@ -88,12 +88,28 @@ def repair_eds(
     verified_rows = [False] * two_k
     verified_cols = [False] * two_k
 
-    def _finish_row(r: int) -> None:
+    def _is_codeword(slab: np.ndarray) -> bool:
+        """rsmt2d's re-encode check: a FULLY-PRESENT axis must itself be
+        a valid codeword (re-extend its systematic half, demand byte
+        identity). Axes completed by decoding are codewords by
+        construction, but a fully-present axis would otherwise sail
+        through on a root match alone — committed trees over a
+        non-codeword match their own leaves (rsmt2d ErrByzantineData
+        covers exactly this)."""
+        rec = rs.repair_axis(slab, list(range(k)))
+        return bool(np.array_equal(rec.reshape(two_k, SHARE),
+                                   np.asarray(slab)))
+
+    def _finish_row(r: int, check_rs: bool = False) -> None:
+        if check_rs and not _is_codeword(symbols[r]):
+            raise BadEncodingError("row", r)
         if _axis_root(symbols[r], "row", r, k) != row_roots[r]:
             raise BadEncodingError("row", r)
         verified_rows[r] = True
 
-    def _finish_col(c: int) -> None:
+    def _finish_col(c: int, check_rs: bool = False) -> None:
+        if check_rs and not _is_codeword(symbols[:, c, :]):
+            raise BadEncodingError("col", c)
         if _axis_root(symbols[:, c, :], "col", c, k) != col_roots[c]:
             raise BadEncodingError("col", c)
         verified_cols[c] = True
@@ -129,7 +145,7 @@ def repair_eds(
                 continue
             n = int(present[r].sum())
             if n == two_k:
-                _finish_row(r)
+                _finish_row(r, check_rs=True)
                 progress = True
             elif n >= k:
                 rec = rs.repair_axis(
@@ -144,7 +160,7 @@ def repair_eds(
                 continue
             n = int(present[:, c].sum())
             if n == two_k:
-                _finish_col(c)
+                _finish_col(c, check_rs=True)
                 progress = True
             elif n >= k:
                 rec = rs.repair_axis(

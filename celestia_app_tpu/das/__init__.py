@@ -1,0 +1,27 @@
+"""DAS plane: sample-proof serving (full nodes) + DASer daemon (light
+nodes) — the celestia-node DASer analog over this framework's DA core.
+
+Server plane: das/server.py (SampleCore + routes + standalone service).
+Client plane: das/daser.py (DASer) over das/checkpoint.py persistence.
+"""
+
+from celestia_app_tpu.das.checkpoint import Checkpoint, CheckpointStore
+from celestia_app_tpu.das.daser import DASer, DASerConfig, PeerSet
+from celestia_app_tpu.das.server import (
+    SampleCore,
+    SampleError,
+    SampleService,
+    route_das,
+)
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointStore",
+    "DASer",
+    "DASerConfig",
+    "PeerSet",
+    "SampleCore",
+    "SampleError",
+    "SampleService",
+    "route_das",
+]

@@ -1,0 +1,535 @@
+"""DASer: the light-node data-availability sampling daemon.
+
+The client half of the DAS plane (celestia-node `das/daser.go` analog).
+A DASer holds nothing but a genesis-rooted light client (chain/light.py)
+and a checkpoint file, yet ends every sweep with a quantified availability
+claim for each header it follows:
+
+- **header following**: commit certificates are fetched per height and
+  verified through the LightClient (>2/3 of the trusted set; condemned
+  data roots refused), so every data root the sampler trusts was certified
+  — the sampler never takes the serving node's word for what it committed.
+- **catch-up scheduling**: pending heights are split into jobs and worked
+  by a bounded pool of parallel workers (celestia-node's coordinator +
+  catch-up workers), so a node that was down for a thousand blocks
+  backfills at worker-pool parallelism while the head keeps advancing.
+- **sampling**: s cells per header, drawn from THIS node's own rng
+  (predictable coordinates let a withholder serve exactly what's asked),
+  fetched in one batched request, each share verified against the DAH
+  (da/sampling.verify_sample). Failures retry with exponential backoff
+  across every peer before anything escalates.
+- **escalation** (a failed sample after retries): fetch every obtainable
+  cell, verify each, and run the 2D repair fixpoint (da/repair.repair_eds)
+  over the authenticated shares. Repair completing means the block WAS
+  available (flaky peer); `BadEncodingError` means the producer committed
+  a non-codeword — the DASer then assembles a bad-encoding fraud proof
+  from orthogonal-axis cell proofs (served by das/server.py `axis=col`),
+  verifies it via the light client (which condemns the data root), writes
+  a HALTED checkpoint, and stops following the chain.
+- **checkpointing**: progress persists fsync-before-replace
+  (das/checkpoint.py); a restarted DASer re-verifies headers (cheap) but
+  never re-samples completed heights (the expensive part).
+
+Confidence math (da/sampling.py): each sample independently catches a
+square with > 1/4 of extended cells withheld with probability > 1/4, so
+s samples give 1-(3/4)^s; a fleet of m independent samplers compounds to
+1-(3/4)^(m*s). docs/DESIGN.md "The DAS plane" has the derivation.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import queue as queue_mod
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.chain import light as light_mod
+from celestia_app_tpu.da import fraud, repair, sampling
+from celestia_app_tpu.da.dah import DataAvailabilityHeader
+from celestia_app_tpu.das.checkpoint import Checkpoint, CheckpointStore
+from celestia_app_tpu.utils import nmt_host, telemetry
+
+
+class PeerError(OSError):
+    """Every peer failed (or refused) a request after all retries."""
+
+
+@dataclasses.dataclass
+class DASerConfig:
+    samples_per_header: int = 16  # s: confidence 1-(3/4)^s ≈ 0.99 at 16
+    workers: int = 3  # parallel catch-up workers (bounded in-flight)
+    job_size: int = 8  # heights per catch-up job
+    retries: int = 3  # per-request peer-rotation rounds
+    backoff: float = 0.05  # base backoff seconds (doubles per round)
+    request_timeout: float = 5.0
+    poll_interval: float = 0.25  # head-follow pause in run_background
+
+
+class PeerSet:
+    """Round-robin HTTP client over the sampler's peer URLs with
+    exponential backoff: each retry round tries EVERY peer once, so a
+    single withholding/flaky peer never decides availability while an
+    honest peer holds the data."""
+
+    def __init__(self, urls: list[str], timeout: float = 5.0,
+                 retries: int = 3, backoff: float = 0.05):
+        if not urls:
+            raise ValueError("PeerSet needs at least one peer URL")
+        self.urls = [u.rstrip("/") for u in urls]
+        self.timeout = timeout
+        self.retries = retries
+        self.backoff = backoff
+        self._i = 0
+        self._lock = threading.Lock()
+
+    def _order(self) -> list[str]:
+        with self._lock:
+            start = self._i
+            self._i = (self._i + 1) % len(self.urls)
+        return self.urls[start:] + self.urls[:start]
+
+    def _one(self, url: str, path: str, payload: dict | None):
+        if payload is None:
+            req = urllib.request.Request(url + path)
+        else:
+            req = urllib.request.Request(
+                url + path, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read())
+
+    def request(self, path: str, payload: dict | None = None):
+        """GET (payload None) or POST `path`, rotating peers with
+        exponential backoff between rounds; raises PeerError when every
+        peer failed every round. HTTP error bodies ({"error": ...}) are
+        treated as refusals and retried on the next peer."""
+        last = "no peers"
+        delay = self.backoff
+        for attempt in range(self.retries):
+            for url in self._order():
+                try:
+                    telemetry.incr("daser.requests")
+                    return self._one(url, path, payload)
+                except (urllib.error.URLError, OSError, ValueError) as e:
+                    telemetry.incr("daser.peer_errors")
+                    last = f"{url}{path}: {type(e).__name__}: {e}"
+            if attempt + 1 < self.retries:
+                telemetry.incr("daser.retry_rounds")
+                time.sleep(delay)
+                delay *= 2
+        raise PeerError(f"all peers failed: {last}")
+
+
+def http_header_source(peers: PeerSet):
+    """(height) -> (Header, CommitCertificate) via the node service's
+    /ibc/header route (the same certified-header payload the IBC
+    verifying client consumes). Returns None while the height is not yet
+    certified on any peer."""
+    from celestia_app_tpu.chain import consensus
+
+    def fetch(height: int):
+        try:
+            doc = peers.request("/ibc/header", {"height": height})
+        except PeerError:
+            return None
+        try:
+            return (consensus.header_from_json(doc["header"]),
+                    consensus.cert_from_json(doc["cert"]))
+        except (KeyError, ValueError, TypeError):
+            return None
+
+    return fetch
+
+
+class DASer:
+    """One light node's sampling daemon. Drive it with `sync()` (one full
+    sweep: follow head, catch up, checkpoint) or `run_background()`."""
+
+    def __init__(self, peers, light: light_mod.LightClient,
+                 store: CheckpointStore,
+                 cfg: DASerConfig | None = None,
+                 header_source=None, rng=None, name: str = "daser"):
+        self.cfg = cfg or DASerConfig()
+        self.peers = peers if isinstance(peers, PeerSet) else PeerSet(
+            peers, timeout=self.cfg.request_timeout,
+            retries=self.cfg.retries, backoff=self.cfg.backoff,
+        )
+        self.light = light
+        self.store = store
+        self.name = name
+        self.cp: Checkpoint = store.load()
+        self.header_source = header_source or http_header_source(self.peers)
+        # the light node's OWN entropy — a withholder that can predict
+        # coordinates serves exactly the sampled cells and nothing else
+        self.rng = rng if rng is not None else np.random.default_rng()
+        # height -> (data_root hex, ods square size), from VERIFIED headers
+        self._roots: dict[int, tuple[str, int]] = {}
+        self.reports: dict[int, dict] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- state -----------------------------------------------------------
+
+    @property
+    def halted(self) -> bool:
+        return self.cp.halted is not None
+
+    def _halt(self, height: int, reason: str, data_root: str) -> None:
+        with self._lock:
+            if self.cp.halted is None:
+                self.cp.halted = {
+                    "height": height, "reason": reason,
+                    "data_root": data_root,
+                }
+                self.store.save(self.cp)
+        telemetry.incr("daser.halts")
+
+    # -- header following (coordinator; sequential light-client trust) ---
+
+    def _advance_head(self) -> None:
+        try:
+            head = int(self.peers.request("/das/head")["height"])
+        except (PeerError, KeyError, ValueError, TypeError):
+            return
+        while self.light.trusted.height < head and not self._stop.is_set():
+            h = self.light.trusted.height + 1
+            got = self.header_source(h)
+            if got is None:
+                break  # not yet certified anywhere; try next sweep
+            header, cert = got
+            try:
+                self.light.update(header, cert)
+            except light_mod.LightClientError as e:
+                if "condemned" in str(e):
+                    self._halt(h, "condemned-root",
+                               header.data_hash.hex())
+                # valset changes need operator-supplied candidate sets;
+                # either way this sweep stops following here
+                break
+            self._roots[h] = (header.data_hash.hex(), header.square_size)
+            self.cp.network_head = max(self.cp.network_head, h)
+
+    # -- sampling workers ------------------------------------------------
+
+    def _fetch_dah(self, height: int, root_hex: str,
+                   square_size: int) -> DataAvailabilityHeader:
+        doc = self.peers.request(f"/das/header?height={height}")
+        dah = DataAvailabilityHeader(
+            row_roots=tuple(bytes.fromhex(x) for x in doc["row_roots"]),
+            col_roots=tuple(bytes.fromhex(x) for x in doc["col_roots"]),
+        )
+        dah.validate_basic()  # untrusted input: bounds/shapes first
+        if dah.hash().hex() != root_hex:
+            raise ValueError("served DAH does not bind to the certified root")
+        if len(dah.row_roots) != 2 * square_size:
+            raise ValueError("served DAH width contradicts the header")
+        return dah
+
+    @staticmethod
+    def _decode_sample(s: dict) -> tuple[bytes, nmt_host.NmtRangeProof]:
+        return (
+            base64.b64decode(s["share"]),
+            nmt_host.NmtRangeProof(
+                start=int(s["proof"]["start"]),
+                end=int(s["proof"]["end"]),
+                total=int(s["proof"]["total"]),
+                nodes=[base64.b64decode(n) for n in s["proof"]["nodes"]],
+            ),
+        )
+
+    def _fetch_cells(self, height: int, cells, axis: str = "row") -> list[dict]:
+        """Batched fetch; whole-request failures already rotate peers in
+        PeerSet. Returns the per-cell sample docs (error members kept)."""
+        out = self.peers.request(
+            "/das/samples",
+            {"height": height, "cells": [list(c) for c in cells],
+             "axis": axis},
+        )
+        return out["samples"]
+
+    def _verify_cells(self, dah: DataAvailabilityHeader,
+                      docs: list[dict]) -> tuple[dict, list]:
+        """Split served docs into {coord: (share, proof)} verified against
+        the DAH and the list of failed coords."""
+        good: dict[tuple[int, int], tuple] = {}
+        failed: list[tuple[int, int]] = []
+        for s in docs:
+            coord = (int(s["row"]), int(s["col"]))
+            if "error" in s:
+                failed.append(coord)
+                continue
+            try:
+                share, proof = self._decode_sample(s)
+                ok = sampling.verify_sample(dah, coord[0], coord[1],
+                                            share, proof)
+            except (KeyError, ValueError, TypeError):
+                ok = False
+            if ok:
+                good[coord] = (share, proof)
+            else:
+                failed.append(coord)
+        return good, failed
+
+    def _sample_height(self, height: int, root_hex: str,
+                       square_size: int, rng=None) -> dict:
+        """One height end-to-end; never raises. Returns the report dict
+        ({"status": "sampled"|"recovered"|"fraud"|"unavailable"|"error"}).
+        `rng` is the calling worker's own generator (numpy Generators are
+        not thread-safe; sharing one across workers would correlate the
+        draws the confidence bound assumes independent)."""
+        rng = rng if rng is not None else self.rng
+        t0 = time.perf_counter()
+        try:
+            dah = self._fetch_dah(height, root_hex, square_size)
+        except (PeerError, ValueError, KeyError) as e:
+            telemetry.incr("daser.header_fetch_failures")
+            return {"status": "error", "error": str(e)}
+        width = len(dah.row_roots)
+        s = self.cfg.samples_per_header
+        coords = [
+            (int(rng.integers(0, width)), int(rng.integers(0, width)))
+            for _ in range(s)
+        ]
+        try:
+            docs = self._fetch_cells(height, coords)
+        except PeerError as e:
+            return {"status": "error", "error": str(e)}
+        good, failed = self._verify_cells(dah, docs)
+        # per-cell retries: a refused/garbled cell may be served by the
+        # next peer in rotation (PeerSet advances its starting peer per
+        # request); deterministic refusals exhaust and escalate
+        delay = self.cfg.backoff
+        for _ in range(self.cfg.retries):
+            if not failed:
+                break
+            time.sleep(delay)
+            delay *= 2
+            try:
+                docs = self._fetch_cells(height, failed)
+            except PeerError:
+                continue
+            recovered, failed = self._verify_cells(dah, docs)
+            good.update(recovered)
+        telemetry.incr("daser.samples_verified", len(good))
+        report = {
+            "samples": s,
+            "verified": len(good),
+            "failed": sorted(set(failed)),
+            "confidence": sampling.withholding_catch_confidence(s),
+        }
+        if not failed:
+            telemetry.incr("daser.headers_sampled")
+            telemetry.measure_since("daser.sample_height", t0)
+            return {**report, "status": "sampled"}
+        telemetry.incr("daser.samples_failed", len(set(failed)))
+        out = {**report, **self._escalate(height, dah, root_hex)}
+        telemetry.measure_since("daser.sample_height", t0)
+        return out
+
+    # -- escalation: repair -> fraud proof -------------------------------
+
+    def _escalate(self, height: int, dah: DataAvailabilityHeader,
+                  root_hex: str) -> dict:
+        """A sample failed after retries: fetch everything obtainable,
+        reconstruct, and either clear the block (it WAS available),
+        condemn it with a verified BEFP, or record it unavailable."""
+        telemetry.incr("daser.escalations")
+        width = len(dah.row_roots)
+        # row-sized batches, not one square-sized request: a k=128 square
+        # is 64k cells (~100 MB of b64) — a single request would blow the
+        # peer timeout and misreport an available block as unavailable.
+        # A failed row batch just leaves its cells absent; the crossword
+        # tolerates holes up to the repair threshold.
+        docs: list[dict] = []
+        for r in range(width):
+            try:
+                docs += self._fetch_cells(
+                    height, [(r, c) for c in range(width)])
+            except PeerError:
+                continue
+        if not docs:
+            return {"status": "unavailable",
+                    "error": "no peer served any reconstruction cells"}
+        good, _failed = self._verify_cells(dah, docs)
+        symbols = np.zeros((width, width, appconsts.SHARE_SIZE),
+                           dtype=np.uint8)
+        present = np.zeros((width, width), dtype=bool)
+        for (r, c), (share, _proof) in good.items():
+            symbols[r, c] = np.frombuffer(share, dtype=np.uint8)
+            present[r, c] = True
+        try:
+            repair.repair_eds(symbols, present,
+                              list(dah.row_roots), list(dah.col_roots))
+        except repair.BadEncodingError as e:
+            befp = self._build_befp(height, dah, e.axis, e.index)
+            if befp is not None and self.light.submit_fraud_proof(dah, befp):
+                telemetry.incr("daser.befp_verified")
+                self._halt(height, "bad-encoding", root_hex)
+                return {"status": "fraud", "axis": e.axis,
+                        "index": e.index}
+            telemetry.incr("daser.befp_failed")
+            return {"status": "unavailable",
+                    "error": f"bad {e.axis} {e.index} but BEFP "
+                             "could not be assembled"}
+        except ValueError as e:
+            telemetry.incr("daser.unavailable")
+            return {"status": "unavailable", "error": str(e)}
+        # the crossword completed and every axis root checked out: the
+        # data IS recoverable, the failing samples were peer flakiness
+        telemetry.incr("daser.recovered")
+        return {"status": "recovered"}
+
+    def _build_befp(self, height: int, dah: DataAvailabilityHeader,
+                    axis: str, index: int):
+        """Assemble a BadEncodingProof for the condemned axis from served
+        orthogonal-axis cell proofs: for a bad ROW its cells are proven
+        under the COLUMN roots (and vice versa) — the exact ShareWithProof
+        members da/fraud.verify_befp checks, no full square needed."""
+        width = len(dah.row_roots)
+        k = width // 2
+        ortho = "col" if axis == "row" else "row"
+        cells = [(index, j) if axis == "row" else (j, index)
+                 for j in range(width)]
+        try:
+            docs = self._fetch_cells(height, cells, axis=ortho)
+        except PeerError:
+            return None
+        ortho_roots = dah.col_roots if axis == "row" else dah.row_roots
+        shares: list[fraud.ShareWithProof] = []
+        for s in docs:
+            if "error" in s or len(shares) >= k:
+                continue
+            r, c = int(s["row"]), int(s["col"])
+            j = c if axis == "row" else r
+            try:
+                share, proof = self._decode_sample(s)
+            except (KeyError, ValueError):
+                continue
+            ns = fraud.leaf_ns(r, c, share, k)
+            if (proof.start == index and proof.end == index + 1
+                    and proof.verify(ortho_roots[j], [(ns, share)])):
+                shares.append(fraud.ShareWithProof(
+                    position=j, share=share, proof=proof,
+                ))
+        if len(shares) < k:
+            return None
+        return fraud.BadEncodingProof(axis=axis, index=index,
+                                      shares=tuple(shares[:k]))
+
+    # -- the sweep -------------------------------------------------------
+
+    def _pending_heights(self) -> list[tuple[int, str, int]]:
+        pend = []
+        for h in range(self.cp.sample_from, self.cp.network_head + 1):
+            if h in self._roots:
+                pend.append((h, *self._roots[h]))
+        for h in sorted(self.cp.failed):
+            if h < self.cp.sample_from and h in self._roots:
+                pend.append((h, *self._roots[h]))  # retry earlier failures
+        return pend
+
+    def sync(self) -> dict:
+        """One full sweep: follow the head through the light client, then
+        catch up over every pending height with the bounded worker pool,
+        fold results into the checkpoint, and persist it. Returns a
+        summary {"head", "sample_from", "sampled", "failed", "halted"}."""
+        if self.halted:
+            return {"halted": self.cp.halted}
+        self._advance_head()
+        if self.halted:  # a condemned root surfaced during following
+            return {"halted": self.cp.halted}
+        pending = self._pending_heights()
+        results: dict[int, dict] = {}
+        if pending:
+            jobs: queue_mod.Queue = queue_mod.Queue()
+            for i in range(0, len(pending), self.cfg.job_size):
+                jobs.put(pending[i:i + self.cfg.job_size])
+
+            def worker(rng) -> None:
+                while not self._stop.is_set() and not self.halted:
+                    try:
+                        job = jobs.get_nowait()
+                    except queue_mod.Empty:
+                        return
+                    for h, root_hex, size in job:
+                        if self._stop.is_set() or self.halted:
+                            return
+                        rep = self._sample_height(h, root_hex, size,
+                                                  rng=rng)
+                        with self._lock:
+                            results[h] = rep
+                            self.reports[h] = rep
+
+            n_workers = min(self.cfg.workers, len(pending))
+            # one independent child generator per worker (spawn keys off
+            # the parent's seed sequence, so a seeded DASer stays
+            # deterministic while workers never share bit-generator state)
+            threads = [
+                threading.Thread(target=worker, args=(child,), daemon=True)
+                for child in self.rng.spawn(n_workers)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        self._fold(results)
+        return {
+            "head": self.cp.network_head,
+            "sample_from": self.cp.sample_from,
+            "sampled": sorted(h for h, r in results.items()
+                              if r["status"] in ("sampled", "recovered")),
+            "failed": sorted(self.cp.failed),
+            "halted": self.cp.halted,
+        }
+
+    def _fold(self, results: dict[int, dict]) -> None:
+        """Checkpoint bookkeeping: completed heights clear from the failed
+        map; incomplete ones record an attempt; the sample_from watermark
+        advances over every height that has a durable disposition."""
+        done_now = set()
+        for h, rep in results.items():
+            if rep["status"] in ("sampled", "recovered"):
+                self.cp.failed.pop(h, None)
+                done_now.add(h)
+            elif rep["status"] in ("unavailable", "error"):
+                self.cp.failed[h] = self.cp.failed.get(h, 0) + 1
+        while self.cp.sample_from <= self.cp.network_head and (
+                self.cp.sample_from in done_now
+                or self.cp.sample_from in self.cp.failed):
+            self.cp.sample_from += 1
+        # bound the verified-root map: everything durably sampled and not
+        # awaiting a failed-height retry can go (headers re-verify cheaply)
+        floor = min([self.cp.sample_from] + sorted(self.cp.failed)[:1])
+        for h in [h for h in self._roots if h < floor]:
+            del self._roots[h]
+        self.store.save(self.cp)
+
+    # -- daemon lifecycle ------------------------------------------------
+
+    def run_background(self) -> "DASer":
+        def loop() -> None:
+            while not self._stop.is_set() and not self.halted:
+                try:
+                    self.sync()
+                except Exception as e:  # keep the daemon alive, loudly
+                    print(f"[{self.name}] sweep error: "
+                          f"{type(e).__name__}: {e}", flush=True)
+                self._stop.wait(self.cfg.poll_interval)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)

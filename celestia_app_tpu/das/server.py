@@ -1,0 +1,423 @@
+"""DAS server plane: batched sample-proof serving from committed blocks.
+
+The serving half of the celestia-node DASer story (PAPER §1, SURVEY §1):
+millions of light clients hammer full nodes with cell-proof requests, so
+the full-node side must answer them from *cached row trees*, never by
+rehashing per request. Every height's trees come from the one batched
+device pass `da/proof_device.BlockProver` already runs (ops/nmt.nmt_levels
+— vmapped SHA-256 on device engines, the bit-identical fast_host SIMD
+levels on host engines); each served proof is then pure index arithmetic.
+Entries sit behind a bounded LRU keyed by height — the same discipline as
+the DA service's square cache (service/da_service.DACore).
+
+Routes (mounted on the node HTTP service and the standalone das-serve
+sidecar; wire format in docs/FORMATS.md §7):
+
+  GET  /das/head                        serving tip {"height": H}
+  GET  /das/header?height=H             DAH (row+col roots) + data root
+  GET  /das/sample?height&row&col[&axis]   one cell + NMT proof
+  POST /das/samples {height, cells, axis?} batched multi-cell variant
+  GET  /das/availability?height=H       per-height serving record
+
+`axis` selects which committed root the proof hangs under: "row" (the
+sampler default) or "col" — orthogonal-axis proofs are exactly the
+`ShareWithProof` members a bad-encoding fraud proof carries
+(da/fraud.py), so an escalating DASer can assemble a BEFP from served
+cells alone. Column trees are the row trees of the TRANSPOSED square
+(the pkg/wrapper leaf-namespace rule is transpose-invariant: parity iff
+outside Q0), so the col prover reuses the same batched device path with
+zero new hashing code.
+
+Fault injection: `withhold(height, cells)` makes the server refuse those
+cells — the adversarial fixture the DASer e2e uses to model a
+withholding producer (tests/test_das.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from celestia_app_tpu.da.dah import DataAvailabilityHeader, ExtendedDataSquare
+from celestia_app_tpu.utils import telemetry
+
+
+class SampleError(ValueError):
+    """Client-side problem (bad coordinates, unknown height, withheld
+    cell): transports map it to a 4xx, never a 500."""
+
+
+@dataclasses.dataclass
+class _Entry:
+    height: int
+    dah: DataAvailabilityHeader
+    root: bytes
+    prover: object  # BlockProver over the row trees
+    col_prover: Optional[object] = None  # lazy: BlockProver over cols
+
+
+def _b64(b: bytes) -> str:
+    import base64
+
+    return base64.b64encode(b).decode()
+
+
+class SampleCore:
+    """Per-height sample serving over an App's committed blocks.
+
+    Thread-safe: HTTP handler threads call `sample`/`sample_many`
+    concurrently; the entry cache and availability records are guarded.
+    Proof generation itself is lock-free index arithmetic on immutable
+    level arrays, so concurrent samplers never serialize on hashing."""
+
+    def __init__(self, app, cache_heights: int = 4,
+                 availability_keep: int = 256, app_lock=None):
+        self.app = app
+        # writer lock of the process hosting the app (NodeService shares
+        # its service lock): square REBUILDS take it so serving never
+        # races a commit mid-store; cached-entry serving stays lock-free
+        self.app_lock = app_lock
+        self._cache: collections.OrderedDict[int, _Entry] = \
+            collections.OrderedDict()
+        self._cache_heights = cache_heights
+        self._availability_keep = availability_keep
+        self._lock = threading.Lock()
+        # height -> serving record (exposed at /das/availability)
+        self._availability: dict[int, dict] = {}
+        self._withheld: dict[int, set[tuple[int, int]]] = {}
+        self._max_seeded = 0  # seeded entries can sit above app.height
+
+    # -- entries ---------------------------------------------------------
+
+    def _entry(self, height: int) -> _Entry:
+        with self._lock:
+            hit = self._cache.get(height)
+            if hit is not None:
+                self._cache.move_to_end(height)
+                return hit
+        import contextlib
+
+        from celestia_app_tpu.chain.query import QueryError, build_prover
+
+        t0 = time.perf_counter()
+        guard = self.app_lock if self.app_lock is not None \
+            else contextlib.nullcontext()
+        try:
+            with guard:
+                _block, _square, prover, root = build_prover(self.app, height)
+        except (QueryError, FileNotFoundError, KeyError, ValueError) as e:
+            raise SampleError(f"no servable square at height {height}: {e}") \
+                from None
+        telemetry.measure_since("das.square_build", t0)
+        entry = _Entry(height=height, dah=prover.dah, root=root,
+                       prover=prover)
+        self._remember(entry)
+        return entry
+
+    def seed_entry(self, height: int,
+                   eds: ExtendedDataSquare,
+                   dah: DataAvailabilityHeader) -> None:
+        """Serve a square already in memory (a block adopted via gossip /
+        blocksync whose EDS never hit the tx store, or a test fixture) —
+        bypasses the rebuild-from-txs path but NOT the proof path."""
+        prover = self._build_prover(eds, dah)
+        self._remember(_Entry(height=height, dah=dah, root=dah.hash(),
+                              prover=prover))
+        with self._lock:
+            self._max_seeded = max(self._max_seeded, height)
+
+    def _remember(self, entry: _Entry) -> None:
+        with self._lock:
+            self._cache[entry.height] = entry
+            self._cache.move_to_end(entry.height)
+            while len(self._cache) > self._cache_heights:
+                self._cache.popitem(last=False)
+
+    def _build_prover(self, eds: ExtendedDataSquare,
+                      dah: DataAvailabilityHeader):
+        """Engine-gated BlockProver construction — device engines run the
+        jitted nmt_levels pass, host engines the bit-identical SIMD
+        levels (a host-engine serving process must never dispatch jax;
+        chain/query.build_prover documents the relay-down hang class)."""
+        from celestia_app_tpu.da import proof_device
+
+        if getattr(self.app, "engine", "host") == "device":
+            return proof_device.BlockProver(eds, dah)
+        from celestia_app_tpu.utils import fast_host
+
+        k = eds.width // 2
+        levels = fast_host.nmt_levels_fast(
+            fast_host._axis_leaf_ns(eds.squares, k), eds.squares
+        )
+        return proof_device.BlockProver(eds, dah, levels=levels)
+
+    def _col_prover(self, entry: _Entry):
+        """Column-axis prover, built lazily on the first orthogonal-proof
+        request (only BEFP escalation needs it): the col trees of a
+        square ARE the row trees of its transpose — same leaf-namespace
+        rule (parity iff outside Q0 survives (r,c)->(c,r)), same batched
+        level pass, no per-cell hashing."""
+        with self._lock:
+            if entry.col_prover is not None:
+                return entry.col_prover
+        t0 = time.perf_counter()
+        eds_t = ExtendedDataSquare(
+            np.ascontiguousarray(np.swapaxes(entry.prover.eds.squares, 0, 1))
+        )
+        dah_t = DataAvailabilityHeader(
+            row_roots=entry.dah.col_roots, col_roots=entry.dah.row_roots
+        )
+        col_prover = self._build_prover(eds_t, dah_t)
+        telemetry.measure_since("das.col_tree_build", t0)
+        with self._lock:
+            if entry.col_prover is None:
+                entry.col_prover = col_prover
+            return entry.col_prover
+
+    # -- fault injection (tests / adversarial simulation) ----------------
+
+    def withhold(self, height: int, cells) -> None:
+        """Refuse to serve the given (row, col) cells of a height — the
+        withholding-producer fixture. Idempotent; cumulative per height."""
+        with self._lock:
+            self._withheld.setdefault(height, set()).update(
+                (int(r), int(c)) for r, c in cells
+            )
+
+    # -- serving ---------------------------------------------------------
+
+    def head(self) -> dict:
+        """The serving tip: the chain's committed height, or higher when
+        a seeded square (gossip/blocksync handoff) sits above it."""
+        return {"height": max(self.app.height, self._max_seeded)}
+
+    def header(self, height: int) -> dict:
+        entry = self._entry(height)
+        return {
+            "height": height,
+            "square_width": len(entry.dah.row_roots),
+            "row_roots": [r.hex() for r in entry.dah.row_roots],
+            "col_roots": [c.hex() for c in entry.dah.col_roots],
+            "data_root": entry.root.hex(),
+        }
+
+    def _one(self, entry: _Entry, row: int, col: int, axis: str) -> dict:
+        width = len(entry.dah.row_roots)
+        if not (0 <= row < width and 0 <= col < width):
+            raise SampleError(
+                f"cell ({row}, {col}) outside the {width}x{width} square"
+            )
+        held = self._withheld.get(entry.height)
+        if held and (row, col) in held:
+            self._note(entry, withheld=1)
+            raise SampleError(f"cell ({row}, {col}) not served")
+        if axis == "row":
+            share, proof = entry.prover.prove_cell(row, col)
+        else:
+            # transposed prover: cell (row, col) lives at (col, row) of
+            # the transpose; its proof hangs under col_roots[col] and
+            # covers leaf range [row, row+1)
+            share, proof = self._col_prover(entry).prove_cell(col, row)
+        return {
+            "row": row,
+            "col": col,
+            "share": _b64(share),
+            "proof": {
+                "start": proof.start,
+                "end": proof.end,
+                "total": proof.total,
+                "nodes": [_b64(n) for n in proof.nodes],
+            },
+        }
+
+    def sample(self, height: int, row: int, col: int,
+               axis: str = "row") -> dict:
+        out = self.sample_many(height, [(row, col)], axis=axis)
+        one = out["samples"][0]
+        if "error" in one:
+            raise SampleError(one["error"])
+        return {**out, "samples": [one]}
+
+    def sample_many(self, height: int, cells, axis: str = "row") -> dict:
+        """Batched multi-cell serving: one tree lookup, N index-arithmetic
+        proofs. Per-cell failures (withheld, out of range) come back as
+        {"row","col","error"} members so a partially-served batch still
+        helps a reconstructing DASer."""
+        if axis not in ("row", "col"):
+            raise SampleError(f"axis must be 'row' or 'col', not {axis!r}")
+        cells = [(int(r), int(c)) for r, c in cells]
+        if not cells:
+            raise SampleError("empty cell list")
+        entry = self._entry(height)
+        t0 = time.perf_counter()
+        samples = []
+        served = 0
+        for r, c in cells:
+            try:
+                samples.append(self._one(entry, r, c, axis))
+                served += 1
+            except SampleError as e:
+                samples.append({"row": r, "col": c, "error": str(e)})
+        telemetry.measure_since("das.sample_batch", t0)
+        telemetry.incr("das.samples_served", served)
+        telemetry.incr("das.sample_batches")
+        self._note(entry, served=served, batches=1,
+                   col_proofs=served if axis == "col" else 0)
+        return {
+            "height": height,
+            "data_root": entry.root.hex(),
+            "axis": axis,
+            "square_width": len(entry.dah.row_roots),
+            "samples": samples,
+        }
+
+    # -- availability records -------------------------------------------
+
+    def _note(self, entry: _Entry, served: int = 0, batches: int = 0,
+              withheld: int = 0, col_proofs: int = 0) -> None:
+        with self._lock:
+            rec = self._availability.setdefault(entry.height, {
+                "height": entry.height,
+                "data_root": entry.root.hex(),
+                "square_width": len(entry.dah.row_roots),
+                "samples_served": 0,
+                "batches": 0,
+                "withheld_refusals": 0,
+                "col_proofs_served": 0,
+            })
+            rec["samples_served"] += served
+            rec["batches"] += batches
+            rec["withheld_refusals"] += withheld
+            rec["col_proofs_served"] += col_proofs
+            while len(self._availability) > self._availability_keep:
+                self._availability.pop(min(self._availability))
+
+    def availability(self, height: int) -> dict:
+        with self._lock:
+            rec = self._availability.get(height)
+            if rec is not None:
+                return dict(rec)
+        # never-served height: the same record shape with null identity
+        # fields (FORMATS.md §7.1) so clients can read one schema
+        return {"height": height, "data_root": None, "square_width": None,
+                "samples_served": 0, "batches": 0,
+                "withheld_refusals": 0, "col_proofs_served": 0}
+
+
+# -- one router shared by every transport -----------------------------------
+
+
+def route_das(core: SampleCore, method: str, path: str,
+              query: dict, payload: dict | None = None) -> dict:
+    """Dispatch a /das/* request. `query` holds the GET params (strings);
+    POST bodies arrive in `payload`. Raises SampleError for every
+    malformed input (transports answer 4xx)."""
+
+    def _int(src: dict, key: str) -> int:
+        try:
+            v = src[key]
+            return int(v[0] if isinstance(v, list) else v)
+        except (KeyError, IndexError, TypeError, ValueError):
+            raise SampleError(f"missing/invalid integer field {key!r}") \
+                from None
+
+    def _axis(src: dict) -> str:
+        v = src.get("axis", "row")
+        return v[0] if isinstance(v, list) else v
+
+    if method == "GET":
+        if path == "/das/head":
+            return core.head()
+        if path == "/das/header":
+            return core.header(_int(query, "height"))
+        if path == "/das/sample":
+            return core.sample(_int(query, "height"), _int(query, "row"),
+                               _int(query, "col"), axis=_axis(query))
+        if path == "/das/availability":
+            return core.availability(_int(query, "height"))
+    elif method == "POST" and path == "/das/samples":
+        payload = payload or {}
+        cells = payload.get("cells")
+        if not isinstance(cells, list):
+            raise SampleError("samples needs a 'cells' list of [row, col]")
+        try:
+            pairs = [(int(r), int(c)) for r, c in cells]
+        except (TypeError, ValueError):
+            raise SampleError("each cell must be a [row, col] pair") \
+                from None
+        return core.sample_many(_int(payload, "height"), pairs,
+                                axis=_axis(payload))
+    raise SampleError(f"no DAS route {method} {path}")
+
+
+class SampleService:
+    """Standalone HTTP server for the DAS routes — the das-serve sidecar:
+    point it at a full node's home and it answers samplers with no chain
+    process attached (blocks come from the durable store)."""
+
+    def __init__(self, core: SampleCore, host: str = "127.0.0.1",
+                 port: int = 26660):
+        import json
+        from http.server import (
+            BaseHTTPRequestHandler,
+            ThreadingHTTPServer,
+        )
+        from urllib.parse import parse_qs, urlparse
+
+        service = self
+        self.core = core
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _route(self, method: str, payload: dict | None) -> None:
+                parsed = urlparse(self.path)
+                try:
+                    out = route_das(service.core, method, parsed.path,
+                                    parse_qs(parsed.query), payload)
+                    self._send(200, out)
+                except SampleError as e:
+                    self._send(404 if "not served" in str(e) else 400,
+                               {"error": str(e)})
+                except Exception as e:  # never kill the serving thread
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def do_GET(self):
+                self._route("GET", None)
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    self._send(400, {"error": "body must be JSON"})
+                    return
+                self._route("POST", payload)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+
+    def serve_background(self):
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        return self
+
+    def serve_forever(self):
+        self._httpd.serve_forever()
+
+    def shutdown(self):
+        self._httpd.shutdown()

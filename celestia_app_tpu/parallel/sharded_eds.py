@@ -176,8 +176,7 @@ def sharded_pipeline_fn(mesh: Mesh, k: int):
         raise ValueError(f"seq axis {n_seq} must divide square size {k}")
 
     local = _local_pipeline(k, n_seq)
-    shard = jax.shard_map(
-        local,
+    specs = dict(
         mesh=mesh,
         in_specs=P(DATA_AXIS, SEQ_AXIS, None, None),
         out_specs=(
@@ -185,11 +184,18 @@ def sharded_pipeline_fn(mesh: Mesh, k: int):
             P(DATA_AXIS, SEQ_AXIS, None),
             P(DATA_AXIS, SEQ_AXIS, None),
         ),
-        # The SHA-256 fori_loop carries mix replicated init state (H0) with
-        # device-varying data; skip VMA inference rather than thread pvary
-        # through every op (outputs are all explicitly sharded anyway).
-        check_vma=False,
     )
+    # The SHA-256 fori_loop carries mix replicated init state (H0) with
+    # device-varying data; skip VMA inference rather than thread pvary
+    # through every op (outputs are all explicitly sharded anyway).
+    # jax < 0.5 ships shard_map under jax.experimental with the older
+    # check_rep spelling of the same knob.
+    if hasattr(jax, "shard_map"):
+        shard = jax.shard_map(local, check_vma=False, **specs)
+    else:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        shard = _shard_map(local, check_rep=False, **specs)
 
     def run(ods_batch: jax.Array):
         eds, row_roots, col_roots = shard(ods_batch)

@@ -17,6 +17,8 @@ Endpoints:
   POST /abci_query     {"path": ..., "data": {...}}
   POST /da/extend_commit {"ods": b64}  stateless DA core: ODS -> DAH
   POST /da/prove_shares  {...}         share-range proof (§7.1.7 shim)
+  GET  /das/head | /das/header | /das/sample | /das/availability
+  POST /das/samples                    DAS sample serving (das/server.py)
 """
 
 from __future__ import annotations
@@ -44,6 +46,13 @@ class NodeService:
             engine="device" if getattr(node.app, "engine", "host")
             == "device" else "host"
         )
+        # the DAS sample-serving plane (das/server.py): committed blocks
+        # answered cell-by-cell with NMT proofs from cached row trees.
+        # Shares this service's writer lock for square rebuilds (callers
+        # that swap self.lock must swap das_core.app_lock with it).
+        from celestia_app_tpu.das.server import SampleCore
+
+        self.das_core = SampleCore(node.app, app_lock=self.lock)
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -99,6 +108,23 @@ class NodeService:
                             "rows": rows,
                             "tables": names,
                         })
+                    elif self.path.startswith("/das/"):
+                        from urllib.parse import parse_qs, urlparse
+
+                        from celestia_app_tpu.das.server import (
+                            SampleError,
+                            route_das,
+                        )
+
+                        parsed = urlparse(self.path)
+                        try:
+                            self._send(200, route_das(
+                                service.das_core, "GET", parsed.path,
+                                parse_qs(parsed.query),
+                            ))
+                        except SampleError as e:
+                            self._send(404 if "not served" in str(e)
+                                       else 400, {"error": str(e)})
                     elif self.path.startswith("/block/"):
                         height = int(self.path.split("/")[2])
                         blk = service.node.app.db.load_block(height)
@@ -172,6 +198,22 @@ class NodeService:
                                 self.path, payload))
                         except DAError as e:
                             self._send(400, {"error": str(e)})
+                    elif self.path.startswith("/das/"):
+                        from urllib.parse import urlparse
+
+                        from celestia_app_tpu.das.server import (
+                            SampleError,
+                            route_das,
+                        )
+
+                        try:
+                            self._send(200, route_das(
+                                service.das_core, "POST",
+                                urlparse(self.path).path, {}, payload,
+                            ))
+                        except SampleError as e:
+                            self._send(404 if "not served" in str(e)
+                                       else 400, {"error": str(e)})
                     elif self.path == "/ibc/prove":
                         # membership/absence proof of a raw store key: the
                         # relayer's proof source (public data — any light

@@ -717,3 +717,34 @@ def test_sign_watermark_blocks_old_round_walkback(tmp_path):
     # the guard's nil fallback can never be packaged as evidence
     ev = consensus.DuplicateVoteEvidence(5, pc1, walked)
     assert not ev.verify(CHAIN, privs[0].public_key().compressed)
+
+
+def test_same_slot_nil_then_nonnil_refused(tmp_path):
+    """FilePV same-HRS parity (ADVICE r5 #3): nil signatures are recorded
+    per (height, round, phase) slot, so a later NON-nil vote at a slot
+    already signed nil is refused — two different votes at one HRS, nil
+    vs block, are exactly what an external Tendermint-style privval judge
+    would flag. Nil re-signs stay legal (nil is also the refusal output),
+    and later rounds stay open for liveness."""
+    privs = [PrivateKey.from_seed(b"\x71")]
+    genesis = _genesis(privs)
+    home = str(tmp_path / "v0")
+    node = consensus.ValidatorNode("v0", privs[0], genesis, CHAIN,
+                                   data_dir=home)
+    bh = b"\xcc" * 32
+    nil = node._signed(4, None, "prevote", round_=0)
+    assert nil.block_hash is None
+    flip = node._signed(4, bh, "prevote", round_=0)
+    assert flip.block_hash is None  # same-slot nil->non-nil: refused
+    again = node._signed(4, None, "prevote", round_=0)
+    assert again.block_hash is None  # idempotent nil re-sign stays legal
+
+    # the nil record is durable: a crash/restart must not forget it
+    node.app.close()
+    node2 = consensus.ValidatorNode("v0", privs[0], genesis, CHAIN,
+                                    data_dir=home)
+    flip2 = node2._signed(4, bh, "prevote", round_=0)
+    assert flip2.block_hash is None
+    # a LATER round is a fresh slot (failed-round liveness)
+    later = node2._signed(4, bh, "prevote", round_=1)
+    assert later.block_hash == bh

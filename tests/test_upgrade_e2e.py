@@ -9,8 +9,10 @@ analog of the reference's major-upgrade e2e tests, both flavors:
 2. v2 -> v3: the x/signal rolling upgrade (x/signal/keeper.go:96-116):
    every validator signals v3 through ordinary consensus txs,
    MsgTryUpgrade tallies >= 5/6 of power and schedules the flip
-   UPGRADE_DELAY blocks out (shortened via CELESTIA_UPGRADE_HEIGHT_DELAY
-   for the devnet), and the network keeps committing straight through.
+   UPGRADE_DELAY blocks out (shortened via the provisioned home config's
+   upgrade_height_delay — consensus-critical, so it rides config.json
+   like v2_upgrade_height, never a per-process env var), and the network
+   keeps committing straight through.
 
 App hashes stay identical on every node through BOTH flips.
 """
@@ -78,16 +80,16 @@ def _spawn(home: str, i: int, genesis: dict) -> subprocess.Popen:
     with open(os.path.join(home, "reactor.json"), "w") as f:
         json.dump(FAST_REACTOR, f)
     with open(os.path.join(home, "config.json"), "w") as f:
+        # both flip knobs are consensus-critical and ride the home
+        # config every validator is provisioned with (identically)
         json.dump({"chain_id": CHAIN, "engine": "host",
-                   "v2_upgrade_height": V2_HEIGHT}, f)
-    env = dict(os.environ)
-    # consensus-critical; set IDENTICALLY for every process
-    env["CELESTIA_UPGRADE_HEIGHT_DELAY"] = str(UPGRADE_DELAY)
+                   "v2_upgrade_height": V2_HEIGHT,
+                   "upgrade_height_delay": UPGRADE_DELAY}, f)
     return subprocess.Popen(
         [sys.executable, "-m", "celestia_app_tpu", "validator-serve",
          "--home", home, "--chain-id", CHAIN, "--autonomous",
          "--http", "0"],
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
     )
 
 
@@ -178,8 +180,13 @@ def test_live_upgrades_v1_v2_then_signal_v3(tmp_path):
         h_now = max((_status(u) or {}).get("height", 0) for u in urls)
         _wait(lambda: all((_status(u) or {}).get("height", 0) >= h_now + 2
                           for u in urls), 180.0, "post-v2 commits")
-        assert _post(http[0], "/abci_query",
-                     {"path": "blobstream/latest_nonce"})["nonce"] is None
+        # the frozen post-v2 observable: the nonce at this point (None —
+        # the migration removed blobstream state) must never change again
+        # through the v3 flip; a still-wired v1 EndBlocker would re-attest
+        # at the very next block
+        frozen = _post(http[0], "/abci_query",
+                       {"path": "blobstream/latest_nonce"})["nonce"]
+        assert frozen is None
         floor = _post(http[0], "/abci_query", {"path": "minfee/params"})
         assert floor["network_min_gas_price"] > 0
 
