@@ -617,6 +617,9 @@ def main() -> None:
     if "--proofs" in sys.argv:
         measure_proofs()
         return
+    if "--mempool" in sys.argv:
+        measure_mempool()
+        return
     if "--stream-mesh" in sys.argv:
         measure_stream_mesh()
         return
@@ -649,6 +652,74 @@ def main() -> None:
               file=sys.stderr)
         return
     _run_parent()
+
+
+def measure_mempool(n_senders: int = 16, txs_per_sender: int = 32) -> None:
+    """Mempool plane microbench: CAT pool ingest (CheckTx + admission) and
+    priority reap, pure host path (no device work). Signing happens before
+    the clock starts — the measured path is what a node pays per inbound
+    /broadcast_tx and per proposal. Prints two JSON lines:
+
+      {"metric": "mempool_ingest_txs_per_sec", ...}
+      {"metric": "mempool_reap_ms", ...}
+    """
+    import random
+
+    from celestia_app_tpu.chain.app import App
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.chain.tx import MsgSend
+    from celestia_app_tpu.client.tx_client import Signer
+
+    chain = "mempool-bench"
+    privs = [PrivateKey.from_seed(b"mp-%d" % i) for i in range(n_senders)]
+    addrs = [p.public_key().address() for p in privs]
+    app = App(chain_id=chain, engine="host")
+    app.init_chain({
+        "time_unix": 1_700_000_000.0,
+        "accounts": [
+            {"address": a.hex(), "balance": 10**12} for a in addrs
+        ],
+        "validators": [
+            {"operator": addrs[0].hex(), "power": 10}
+        ],
+    })
+    signer = Signer(chain)
+    for i, p in enumerate(privs):
+        signer.add_account(p, number=i)
+    rng = random.Random(0)
+    raws: list[bytes] = []
+    for _seq in range(txs_per_sender):
+        for i, a in enumerate(addrs):
+            tx = signer.create_tx(
+                a, [MsgSend(a, addrs[(i + 1) % n_senders], 1)],
+                fee=rng.randint(1_000, 100_000), gas_limit=100_000,
+            )
+            signer.accounts[a].sequence += 1
+            raws.append(tx.encode())
+
+    node = Node(app)
+    t0 = time.perf_counter()
+    admitted = sum(1 for raw in raws if node.broadcast_tx(raw).code == 0)
+    ingest_s = time.perf_counter() - t0
+    reap_ms = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        reaped = node._reap()
+        reap_ms.append((time.perf_counter() - t0) * 1e3)
+    print(json.dumps({
+        "metric": "mempool_ingest_txs_per_sec",
+        "value": round(len(raws) / ingest_s, 1),
+        "unit": "tx/s",
+        "n_txs": len(raws),
+        "admitted": admitted,
+    }))
+    print(json.dumps({
+        "metric": "mempool_reap_ms",
+        "value": round(min(reap_ms), 3),
+        "unit": "ms",
+        "pool_count": len(reaped),
+    }))
 
 
 def measure_stream() -> None:

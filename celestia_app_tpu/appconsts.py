@@ -157,6 +157,13 @@ TIMEOUT_COMMIT_SECONDS = 11.0
 GOAL_BLOCK_TIME_SECONDS = 15.0
 MEMPOOL_TX_TTL_BLOCKS = 5
 MEMPOOL_MAX_TX_BYTES = 128**2 * CONTINUATION_SPARSE_SHARE_CONTENT_SIZE
+# CAT pool caps (celestia-core mempool config: Size / MaxTxsBytes): the
+# whole pool is bounded by count AND bytes, with lowest-gas-price eviction
+# once either cap is hit (mempool/cat/pool.go). Wall-clock TTL mirrors the
+# height TTL at the goal block time (TTLDuration ~ TTLNumBlocks blocks).
+MEMPOOL_MAX_TXS = 5000
+MEMPOOL_MAX_POOL_BYTES = 64 * MEMPOOL_MAX_TX_BYTES  # ~505 MB
+MEMPOOL_TX_TTL_SECONDS = MEMPOOL_TX_TTL_BLOCKS * GOAL_BLOCK_TIME_SECONDS
 SNAPSHOT_INTERVAL_BLOCKS = 1500
 SNAPSHOT_KEEP_RECENT = 2
 

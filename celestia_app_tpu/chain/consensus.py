@@ -334,8 +334,14 @@ class ValidatorNode:
                        v2_upgrade_height=v2_upgrade_height,
                        upgrade_height_delay=upgrade_height_delay)
         self.app.init_chain(genesis)
-        self.mempool: list[bytes] = []
-        self._tx_meta: dict[bytes, tuple[float, bytes | None]] = {}
+        # THE mempool: the shared CAT pool (celestia_app_tpu/mempool) —
+        # the pre-CAT validator list grew unboundedly (no cap, no TTL) and
+        # leaked per-tx metadata (_tx_meta) for any tx that never
+        # committed; pool entries now carry their own metadata, so
+        # lifetime follows membership by construction
+        from celestia_app_tpu.mempool.pool import CATPool
+
+        self.pool = CATPool()
         self.committed: dict[bytes, tuple[int, object]] = {}
         self.wal_dir = os.path.join(data_dir, "wal") if data_dir else None
         if self.wal_dir:
@@ -356,46 +362,39 @@ class ValidatorNode:
 
     # -- mempool (gossiped) ---------------------------------------------
 
+    @property
+    def mempool(self):
+        """List-of-raw-bytes view over the CAT pool (the pre-CAT shape
+        tests and status surfaces read)."""
+        from celestia_app_tpu.mempool.pool import RawTxView
+
+        return RawTxView(self.pool)
+
+    @mempool.setter
+    def mempool(self, items) -> None:
+        """Compat for fixtures that assign a replacement list; re-admitted
+        WITHOUT CheckTx (the caller vouches)."""
+        self.pool.clear()
+        for raw in items:
+            self.pool.add(raw, height=self.app.height)
+
     def add_tx(self, raw: bytes):
-        """CheckTx + admission; returns the TxResult so transports
+        """CheckTx + CAT admission; returns the TxResult so transports
         (in-process bus, HTTP validator service, gRPC) share ONE admission
-        path, including the mempool byte cap Node enforces
-        (default_overrides.go:271-273)."""
-        from celestia_app_tpu.chain.node import check_mempool_size
+        path — the pool's byte gate (default_overrides.go:271-273),
+        hash dedup (a duplicate submission returns the ORIGINAL result),
+        and cap eviction included."""
+        import time as time_mod
 
-        oversize = check_mempool_size(raw)
-        if oversize is not None:
-            return oversize
-        res = self.app.check_tx(raw)
-        if res.code == 0:
-            self.mempool.append(raw)
-            self._note_tx_meta(raw)
-        return res
-
-    def _note_tx_meta(self, raw: bytes) -> None:
-        """Cache (fee/gas, signer pubkey) for priority reaping (the
-        reference's mempool v1 orders by gas price —
-        default_overrides.go:265-274)."""
-        from celestia_app_tpu.chain.tx import decode_tx
-        from celestia_app_tpu.da import blob as blob_mod
-
-        try:
-            btx = blob_mod.try_unmarshal_blob_tx(raw)
-            tx = decode_tx(btx.tx if btx is not None else raw)
-            self._tx_meta[raw] = (tx.body.fee / tx.body.gas_limit, tx.pubkey)
-        except (ValueError, ZeroDivisionError):
-            self._tx_meta[raw] = (0.0, None)
+        return self.pool.add(raw, height=self.app.height,
+                             now=time_mod.time(),
+                             check_fn=self.app.check_tx)
 
     def reap_mempool(self) -> list[bytes]:
         """Priority order: gas price desc, per-sender arrival order kept —
         the order FilterTxs receives candidates in (mempool v1 semantics;
-        see node.priority_order for the nonce-safety rationale)."""
-        from celestia_app_tpu.chain.node import priority_order
-
-        return priority_order([
-            (raw, *self._tx_meta.get(raw, (0.0, None)))
-            for raw in self.mempool
-        ])
+        see mempool.pool.priority_order for the nonce-safety rationale)."""
+        return self.pool.reap(self.app.height)
 
     # -- consensus steps -------------------------------------------------
     # Two-phase Tendermint vote flow with lock-on-polka: prevote after
@@ -776,10 +775,12 @@ class ValidatorNode:
         app_hash = self.app.commit(block)
         self.certificates[block.header.height] = cert
         self._record_committed(block, results)
-        committed = {tx for tx in block.txs}
-        self.mempool = [tx for tx in self.mempool if tx not in committed]
-        for tx in committed:
-            self._tx_meta.pop(tx, None)
+        self.pool.remove_committed(block.txs)
+        # post-commit recheck (RecheckTx): survivors re-run CheckTx
+        # against the fresh check state so nonce-stale txs (their sender's
+        # sequence advanced in THIS block via a different tx) drop instead
+        # of wasting the next proposal slot
+        self.pool.recheck(self.app.check_tx)
         return app_hash
 
     def _record_committed(self, block: Block, results) -> None:

@@ -4,40 +4,33 @@ Reference parity: test/util/testnode (an in-process chain producing blocks
 against a real app via the local ABCI client, full_node.go:20-49) plus the
 mempool behavior celestia tunes in app/default_overrides.go:258-284 (priority
 mempool with per-tx TTL of 5 blocks, gas-price priority ordering).
+
+The mempool itself is the content-addressable CAT pool
+(celestia_app_tpu/mempool/pool.py) — hash-keyed dedup, byte+count caps with
+lowest-priority eviction, TTL by height and wall-clock, and post-commit
+recheck — shared with ValidatorNode and the autonomous reactor so all three
+consumers have ONE admission path and ONE eviction policy. `self.mempool`
+stays a list-shaped view for compatibility (tests, tools, status surfaces).
 """
 
 from __future__ import annotations
 
-import dataclasses
 import time as time_mod
 
 from celestia_app_tpu import appconsts
 from celestia_app_tpu.chain.app import App
 from celestia_app_tpu.chain.block import Block, TxResult
-from celestia_app_tpu.chain.tx import Tx, decode_tx
-from celestia_app_tpu.da import blob as blob_mod
-
-
-@dataclasses.dataclass
-class MempoolTx:
-    raw: bytes
-    gas_price: float
-    height_added: int
-    sender: bytes | None = None  # signer pubkey; keys per-sender FIFO
-
+from celestia_app_tpu.mempool.pool import (  # noqa: F401  (re-exports: the
+    CATPool,      # historical import surface for these lived here)
+    EntryView,
+    PoolTx as MempoolTx,
+    check_mempool_size,
+    priority_order,
+)
 
 # heights of committed-tx lookups retained for GetTx/ConfirmTx; the
 # reference's default lookback for confirmation polling is far shorter
 COMMITTED_INDEX_WINDOW = 1000
-
-
-def check_mempool_size(raw: bytes) -> "TxResult | None":
-    """THE mempool byte-cap gate (MaxTxBytes, default_overrides.go:271-273),
-    shared by Node and ValidatorNode admission so they can never disagree
-    on which txs fit. None = within the cap."""
-    if len(raw) > appconsts.MEMPOOL_MAX_TX_BYTES:
-        return TxResult(1, "tx exceeds mempool max bytes", 0, 0, [])
-    return None
 
 
 def record_committed(index: dict, block: "Block", results) -> None:
@@ -58,68 +51,51 @@ def record_committed(index: dict, block: "Block", results) -> None:
                 del index[key]
 
 
-def priority_order(items: list[tuple[bytes, float, bytes | None]]) -> list[bytes]:
-    """Gas-price-descending reap that preserves PER-SENDER arrival order.
-
-    `items` = [(raw, gas_price, sender)] in arrival order. A plain
-    (-price, arrival) sort would let a sender's later high-fee tx jump its
-    own earlier low-fee one — the later tx then fails the ante sequence
-    check in the proposal filter and is pointlessly delayed a height. Here
-    the sorted positions are kept, but each position is filled with the
-    owning sender's OLDEST pending tx, so priority decides which sender
-    goes first while nonces stay in submission order."""
-    from collections import deque
-
-    def key(i: int):
-        sender = items[i][2]
-        return sender if sender is not None else (b"raw", items[i][0])
-
-    queues: dict = {}
-    for i, (raw, _price, _sender) in enumerate(items):
-        queues.setdefault(key(i), deque()).append(raw)
-    order = sorted(range(len(items)), key=lambda i: (-items[i][1], i))
-    return [queues[key(i)].popleft() for i in order]
-
-
 class Node:
-    def __init__(self, app: App, mempool_ttl: int = appconsts.MEMPOOL_TX_TTL_BLOCKS):
+    def __init__(self, app: App,
+                 mempool_ttl: int = appconsts.MEMPOOL_TX_TTL_BLOCKS,
+                 mempool_max_txs: int = appconsts.MEMPOOL_MAX_TXS,
+                 mempool_max_bytes: int = appconsts.MEMPOOL_MAX_POOL_BYTES,
+                 mempool_ttl_seconds: float | None =
+                 appconsts.MEMPOOL_TX_TTL_SECONDS):
         self.app = app
-        self.mempool: list[MempoolTx] = []
+        self.pool = CATPool(
+            max_pool_bytes=mempool_max_bytes,
+            max_txs=mempool_max_txs,
+            ttl_blocks=mempool_ttl,
+            ttl_seconds=mempool_ttl_seconds,
+        )
         self.mempool_ttl = mempool_ttl
         self.committed: dict[bytes, tuple[int, TxResult]] = {}  # tx hash -> (height, result)
         self.blocks: list[Block] = []
 
     # -- mempool -------------------------------------------------------
 
+    @property
+    def mempool(self) -> EntryView:
+        """List-shaped view over the CAT pool (entries carry
+        .raw/.gas_price/.height_added/.sender, the old MempoolTx shape)."""
+        return EntryView(self.pool)
+
+    @mempool.setter
+    def mempool(self, items) -> None:
+        """Compat for tests/tools that assign a replacement list; entries
+        are re-admitted WITHOUT CheckTx (the caller already vouched)."""
+        self.pool.clear()
+        for it in items:
+            raw = it.raw if hasattr(it, "raw") else it
+            self.pool.add(raw, height=self.app.height)
+
     def broadcast_tx(self, raw: bytes) -> TxResult:
-        """BroadcastMode_SYNC: run CheckTx, admit to the mempool on success."""
-        oversize = check_mempool_size(raw)
-        if oversize is not None:
-            return oversize
-        res = self.app.check_tx(raw)
-        if res.code == 0:
-            btx = blob_mod.try_unmarshal_blob_tx(raw)  # single parse
-            tx = decode_tx(btx.tx if btx is not None else raw)
-            self.mempool.append(
-                MempoolTx(
-                    raw=raw,
-                    gas_price=tx.body.fee / tx.body.gas_limit,
-                    height_added=self.app.height,
-                    sender=tx.pubkey,
-                )
-            )
-        return res
+        """BroadcastMode_SYNC: CheckTx + CAT admission (size gate, hash
+        dedup returning the original result, cap eviction) — the ONE
+        admission path."""
+        return self.pool.add(raw, height=self.app.height,
+                             check_fn=self.app.check_tx)
 
     def _reap(self) -> list[bytes]:
         """Priority order: gas price desc, per-sender arrival order kept."""
-        self.mempool = [
-            m
-            for m in self.mempool
-            if self.app.height - m.height_added < self.mempool_ttl
-        ]
-        return priority_order(
-            [(m.raw, m.gas_price, m.sender) for m in self.mempool]
-        )
+        return self.pool.reap(self.app.height)
 
     # -- consensus loop ------------------------------------------------
 
@@ -132,8 +108,11 @@ class Node:
         self.app.commit(prop.block)
         self.blocks.append(prop.block)
 
-        included = set(prop.block.txs)
-        self.mempool = [m for m in self.mempool if m.raw not in included]
+        self.pool.remove_committed(prop.block.txs)
+        # post-commit recheck (RecheckTx): survivors re-run CheckTx against
+        # the fresh check state; nonce-stale/now-unfunded txs drop here
+        # instead of wasting the next proposal's slot
+        self.pool.recheck(self.app.check_tx)
         record_committed(self.committed, prop.block, results)
         return prop.block, results
 

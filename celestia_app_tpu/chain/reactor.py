@@ -102,11 +102,15 @@ class ConsensusReactor:
     """The per-validator round state machine (one thread per process)."""
 
     def __init__(self, vnode, peer_urls: list[str], service_lock,
-                 config: ReactorConfig | None = None):
+                 config: ReactorConfig | None = None,
+                 self_url: str = ""):
         self.vnode = vnode
         self.peers = [u.rstrip("/") for u in peer_urls]
         self.service_lock = service_lock
         self.cfg = config or ReactorConfig()
+        # peer-visible URL of THIS node: rides SeenTx announces so the
+        # receiver knows whom to WantTx-pull the content from
+        self.self_url = self_url.rstrip("/")
         # rotation order: operator addresses of the CURRENT staked set
         # (sorted), refreshed from state at every commit — a runtime
         # MsgCreateValidator(pubkey=...) joins the schedule the height
@@ -134,9 +138,16 @@ class ConsensusReactor:
         self._ahead: tuple[int, str, float] | None = None  # (h, peer, t)
         self.height_view = self.vnode.app.height + 1  # for status only
         self.app_hashes: dict[int, str] = {}  # height -> hex (divergence checks)
-        self._seen_txs: dict[bytes, None] = {}  # ordered set for dedup
         self._senders: dict[str, object] = {}  # peer url -> send queue
-        self._pending_txs: list[bytes] = []  # gossiped txs awaiting CheckTx
+        # the mempool reactor's want/have protocol state (SeenTx/WantTx/Tx
+        # — mempool/gossip.py); replaces the blind tx flood
+        from celestia_app_tpu.mempool.gossip import MempoolGossip
+
+        self.mempool_gossip = MempoolGossip(
+            self.vnode.pool, self.peers, self.self_url
+        )
+        self._pending_txs: list[tuple[bytes, str]] = []  # direct deliveries
+        self._pending_wants: list[tuple[bytes, str]] = []  # (hash, provider)
         # powers snapshot from just BEFORE our latest commit: the set that
         # signed that height's certificate (validators for height H come
         # from state after H-1). Verifying a height-1 cert against POST-
@@ -282,55 +293,123 @@ class ConsensusReactor:
             doc = self._load_commit_record(height)
         return doc
 
-    # -- mempool gossip (the reference's mempool reactor) ----------------
+    # -- mempool gossip: the CAT want/have reactor (mempool/gossip.py) ---
+    # SeenTx (32-byte hash announce) replaces the old full-tx flood; a
+    # peer that wants the content pulls it (WantTx -> Tx) from an
+    # announcer. Per-peer have-sets and redundant-want suppression keep
+    # tx payload bytes to ~one transfer per edge that needs it.
 
-    def _tx_first_seen(self, raw: bytes) -> bool:
-        import hashlib
-
-        key = hashlib.sha256(raw).digest()
+    def _announce_tx(self, h: bytes) -> None:
+        """SeenTx to every peer not known to have the tx (hash + our URL,
+        never the payload); marks the hash processed."""
         with self._msg_lock:
-            if key in self._seen_txs:
-                return False
-            self._seen_txs[key] = None
-            if len(self._seen_txs) > 8192:  # bounded dedup window
-                for k in list(self._seen_txs)[:4096]:
-                    del self._seen_txs[k]
-        return True
+            self.mempool_gossip.first_seen(h)  # idempotent mark
+            targets = self.mempool_gossip.announce_targets(h)
+        payload = {"hash": h.hex(), "from": self.self_url}
+        for u in targets:
+            try:
+                self._senders[u].put_nowait(("/gossip/seen_tx", payload))
+            except Exception:
+                pass  # best-effort, like all gossip
 
     def gossip_tx(self, raw: bytes) -> None:
-        """Flood a locally-admitted tx to peers (mempool reactor out)."""
-        import base64
+        """Announce a locally-admitted tx to peers (mempool reactor out);
+        dedup-gated so a duplicate /broadcast_tx does not re-announce."""
+        from celestia_app_tpu.mempool.pool import tx_hash
 
-        if self._tx_first_seen(raw):
-            self._gossip("/gossip/tx",
-                         {"tx": base64.b64encode(raw).decode()})
+        h = tx_hash(raw)
+        with self._msg_lock:
+            fresh = not self.mempool_gossip.seen(h)
+        if fresh:
+            self._announce_tx(h)
+
+    def on_seen_tx(self, doc: dict) -> None:
+        """A peer announces it HAS a tx: queue a pull if we want it (the
+        handler must not do network I/O or take the writer lock)."""
+        h = bytes.fromhex(doc["hash"])
+        if len(h) != 32:
+            raise ValueError("seen_tx hash must be 32 bytes")
+        provider = str(doc.get("from", "")).rstrip("/")
+        with self._msg_lock:
+            if self.mempool_gossip.on_seen(h, provider) and provider:
+                self._pending_wants.append((h, provider))
+        telemetry.incr("reactor.gossip.seen_tx")
+
+    def serve_want_tx(self, h: bytes, to_peer: str = "") -> bytes | None:
+        """Inbound WantTx pull: deliver the tx bytes from the pool."""
+        with self._msg_lock:
+            return self.mempool_gossip.serve_want(h, to_peer)
 
     def on_tx(self, doc: dict) -> None:
-        """A peer floods a tx: queue it for the reactor loop (like every
-        gossip intake, this handler must not touch the writer lock — a
-        tx flood during a slow apply() would otherwise pile up blocked
-        handler threads). The loop admits through CheckTx and re-floods
-        once on success (dedup makes the flood terminate on any
-        topology)."""
+        """Direct Tx push (legacy flood delivery, still accepted): queue
+        for the reactor loop — like every gossip intake, this handler must
+        not touch the writer lock (a delivery during a slow apply() would
+        pile up blocked handler threads)."""
         import base64
 
         raw = base64.b64decode(doc["tx"])
-        if not self._tx_first_seen(raw):
-            return
-        with self._msg_lock:
-            self._pending_txs.append(raw)
+        from celestia_app_tpu.mempool.pool import tx_hash
 
-    def _admit_pending_txs(self) -> None:
+        h = tx_hash(raw)
+        with self._msg_lock:
+            if not self.mempool_gossip.first_seen(h):
+                return
+            self.mempool_gossip.on_delivered(h, raw, "")
+            self._pending_txs.append((raw, ""))
+
+    def _pull_tx(self, h: bytes, provider: str) -> bytes | None:
+        """WantTx: pull tx content from an announcer; on failure fall
+        through that hash's remaining candidate providers."""
         import base64
 
+        url = provider
+        while url:
+            try:
+                with urllib.request.urlopen(
+                    f"{url}/gossip/want_tx?hash={h.hex()}",
+                    timeout=self.cfg.gossip_timeout,
+                ) as r:
+                    doc = json.loads(r.read())
+                tx_b64 = doc.get("tx")
+                if tx_b64:
+                    raw = base64.b64decode(tx_b64)
+                    with self._msg_lock:
+                        self.mempool_gossip.on_delivered(h, raw, url)
+                    return raw
+            except (urllib.error.URLError, OSError, ValueError):
+                pass
+            with self._msg_lock:
+                url = self.mempool_gossip.pull_failed(h)
+        return None
+
+    def _admit_pending_txs(self) -> None:
+        """The mempool-reactor loop half: drain queued WantTx pulls and
+        direct deliveries, admit through the ONE CAT admission path
+        (vnode.add_tx), and re-announce admitted txs to peers not known
+        to have them."""
         with self._msg_lock:
+            wants, self._pending_wants = self._pending_wants, []
             pending, self._pending_txs = self._pending_txs, []
-        for raw in pending:
+        for h, provider in wants:
+            raw = self._pull_tx(h, provider)
+            if raw is not None:
+                pending.append((raw, provider))
+        from celestia_app_tpu.mempool.pool import tx_hash
+
+        for raw, _src in pending:
             with self.service_lock:
                 res = self.vnode.add_tx(raw)
             if res.code == 0:
-                self._gossip("/gossip/tx",
-                             {"tx": base64.b64encode(raw).decode()})
+                # announce UNCONDITIONALLY (not via gossip_tx's dedup
+                # gate): a direct-push delivery already consumed
+                # first_seen in on_tx, but its admission still has to be
+                # announced to peers the pusher may not reach
+                self._announce_tx(tx_hash(raw))
+            else:
+                # mark processed so peers re-announcing a tx we refuse
+                # cannot make us re-pull it forever
+                with self._msg_lock:
+                    self.mempool_gossip.first_seen(tx_hash(raw))
 
     def _note_height(self, height: int, peer: str = "") -> None:
         """Track evidence that the network is ahead of us. The first-seen
@@ -558,12 +637,22 @@ class ConsensusReactor:
         return applied
 
     def _remember_commit(self, doc: dict, height: int) -> None:
+        import base64
+        import hashlib
+
         punished = {
             bytes.fromhex(v["validator"])
             for e in doc.get("proposal", {}).get("evidence", [])
             for v in e.get("votes", [])
         }
+        # committed txs left the pool in apply(); drop their want/have
+        # tracking too, so gossip state follows pool membership
+        committed_hashes = [
+            hashlib.sha256(base64.b64decode(t)).digest()
+            for t in doc.get("proposal", {}).get("block", {}).get("txs", [])
+        ]
         with self._msg_lock:
+            self.mempool_gossip.forget(committed_hashes)
             self._recent[height] = doc
             # clear the behind-marker only once this commit actually
             # reaches it — clearing unconditionally would abort a deep

@@ -73,6 +73,23 @@ def _make_app(home: str):
     return app, cfg
 
 
+def _mempool_kwargs(cfg: dict) -> dict:
+    """CAT pool knobs from <home>/config.json -> Node(...) kwargs (one
+    reader for every command that builds a Node)."""
+    from celestia_app_tpu import appconsts
+
+    return {
+        "mempool_ttl": cfg.get(
+            "mempool_ttl_blocks", appconsts.MEMPOOL_TX_TTL_BLOCKS),
+        "mempool_ttl_seconds": cfg.get(
+            "mempool_ttl_seconds", appconsts.MEMPOOL_TX_TTL_SECONDS),
+        "mempool_max_txs": cfg.get(
+            "mempool_max_txs", appconsts.MEMPOOL_MAX_TXS),
+        "mempool_max_bytes": cfg.get(
+            "mempool_max_pool_bytes", appconsts.MEMPOOL_MAX_POOL_BYTES),
+    }
+
+
 def cmd_init(args) -> int:
     from celestia_app_tpu import appconsts
 
@@ -380,6 +397,9 @@ def _write_config(home: str, chain_id: str, engine: str = "auto") -> None:
                 "v2_upgrade_height": None,
                 "upgrade_height_delay": None,
                 "mempool_ttl_blocks": appconsts.MEMPOOL_TX_TTL_BLOCKS,
+                "mempool_ttl_seconds": appconsts.MEMPOOL_TX_TTL_SECONDS,
+                "mempool_max_txs": appconsts.MEMPOOL_MAX_TXS,
+                "mempool_max_pool_bytes": appconsts.MEMPOOL_MAX_POOL_BYTES,
             },
             f, indent=2,
         )
@@ -439,10 +459,7 @@ def cmd_start(args) -> int:
         os.makedirs(os.path.dirname(trace_path), exist_ok=True)
         app.enable_store_trace(trace_path)
         print(f"store trace -> {trace_path}", file=sys.stderr)
-    node = Node(
-        app,
-        mempool_ttl=cfg.get("mempool_ttl_blocks", appconsts.MEMPOOL_TX_TTL_BLOCKS),
-    )
+    node = Node(app, **_mempool_kwargs(cfg))
     svc = NodeService(node, port=args.listen)
     svc.serve_background()
     grpc_srv = None
@@ -1758,9 +1775,7 @@ def cmd_txsim(args) -> int:
     from celestia_app_tpu import appconsts as _consts
 
     app, cfg = _make_app(args.home)
-    node = Node(
-        app, mempool_ttl=cfg.get("mempool_ttl_blocks", _consts.MEMPOOL_TX_TTL_BLOCKS)
-    )
+    node = Node(app, **_mempool_kwargs(cfg))
     from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
 
     ctx = Context(app.store, InfiniteGasMeter(), app.height, 0,

@@ -1,0 +1,32 @@
+"""Mempool plane: the content-addressable CAT pool + want/have gossip.
+
+- `pool.py`    — CATPool: hash-keyed store, priority reap, caps/TTL/recheck
+- `gossip.py`  — SeenTx/WantTx/Tx protocol state (reactor owns transport)
+- `metrics.py` — per-pool counters + process gauges into utils/telemetry
+"""
+
+from celestia_app_tpu.mempool.gossip import MempoolGossip
+from celestia_app_tpu.mempool.metrics import MempoolMetrics
+from celestia_app_tpu.mempool.pool import (
+    CATPool,
+    EntryView,
+    PoolTx,
+    RawTxView,
+    check_mempool_size,
+    parse_tx_meta,
+    priority_order,
+    tx_hash,
+)
+
+__all__ = [
+    "CATPool",
+    "EntryView",
+    "MempoolGossip",
+    "MempoolMetrics",
+    "PoolTx",
+    "RawTxView",
+    "check_mempool_size",
+    "parse_tx_meta",
+    "priority_order",
+    "tx_hash",
+]

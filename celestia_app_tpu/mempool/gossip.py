@@ -1,0 +1,145 @@
+"""SeenTx / WantTx / Tx — the CAT pool's want/have gossip protocol.
+
+Reference parity: celestia-core's cat reactor (mempool/cat/reactor.go):
+instead of flooding full tx bytes to every peer (O(peers × tx-bytes) per
+hop, the pre-CAT behavior of chain/reactor.py's mempool half), a node that
+admits a tx announces the 32-byte HASH (SeenTx) to peers that are not
+known to have it; a peer that wants the content pulls it from an announcer
+(WantTx), and the announcer delivers the bytes (Tx) exactly once per edge
+that asked. Per-peer have-sets suppress re-announcing to a peer that told
+us it has the tx, and redundant-want suppression keeps one outstanding
+pull per hash however many peers announce it.
+
+This class is TRANSPORT-AGNOSTIC protocol state: the consensus reactor
+(chain/reactor.py) owns the sockets and calls in; every decision that
+matters — whom to announce to, whether to pull, whom to pull from next
+after a failure — is made (and unit-testable) here. Wire formats are
+normative in docs/FORMATS.md §8.
+
+Byte accounting is per-instance (`stats`): tests and the devnet monitor
+compare tx-payload bytes moved under want/have against the flood
+equivalent, per node — the process-global telemetry registry would blur
+N in-process validators together.
+"""
+
+from __future__ import annotations
+
+from celestia_app_tpu.utils import telemetry
+
+
+class MempoolGossip:
+    """Want/have state for one node; see module docstring."""
+
+    MAX_TRACKED = 8192  # hashes tracked for dedup/have-sets (bounded)
+
+    def __init__(self, pool, peers: list[str], self_url: str):
+        self.pool = pool
+        self.peers = list(peers)
+        self.self_url = self_url
+        # hash -> set of peer urls known to HAVE the tx (they announced it
+        # to us or pulled it from us); insertion-ordered for pruning
+        self._have: dict[bytes, set[str]] = {}
+        # outstanding pulls: hash -> remaining candidate providers (the
+        # first announcer is being pulled; later announcers queue here so
+        # a failed pull falls through instead of re-requesting in parallel)
+        self._wanted: dict[bytes, list[str]] = {}
+        # hashes this node has fully processed (admitted OR rejected):
+        # a re-announce of either must not trigger another pull
+        self._seen: dict[bytes, None] = {}
+        self.stats = {
+            "seen_sent": 0, "seen_recv": 0,
+            "want_sent": 0, "want_suppressed": 0,
+            "tx_bytes_sent": 0, "tx_bytes_recv": 0,
+            "tx_served": 0, "tx_pulled": 0,
+        }
+
+    # -- bookkeeping -----------------------------------------------------
+
+    def _bump(self, name: str, by: int = 1) -> None:
+        self.stats[name] += by
+        telemetry.incr(f"mempool.gossip.{name}", by)
+
+    def _note_have(self, h: bytes, peer: str) -> None:
+        self._have.setdefault(h, set()).add(peer)
+        if len(self._have) > self.MAX_TRACKED:
+            for k in list(self._have)[: self.MAX_TRACKED // 2]:
+                del self._have[k]
+
+    def seen(self, h: bytes) -> bool:
+        """Has this hash already been processed (admitted or refused)?"""
+        return h in self._seen
+
+    def first_seen(self, h: bytes) -> bool:
+        """Mark a hash processed; False if it already was (dedup window)."""
+        if h in self._seen:
+            return False
+        self._seen[h] = None
+        if len(self._seen) > self.MAX_TRACKED:
+            for k in list(self._seen)[: self.MAX_TRACKED // 2]:
+                del self._seen[k]
+        return True
+
+    # -- protocol steps --------------------------------------------------
+
+    def announce_targets(self, h: bytes) -> list[str]:
+        """Peers to send SeenTx{hash, from=self_url} to: everyone not
+        already known to have the content (per-peer have-sets)."""
+        have = self._have.get(h, set())
+        targets = [u for u in self.peers if u not in have]
+        self._bump("seen_sent", len(targets))
+        return targets
+
+    def on_seen(self, h: bytes, from_peer: str) -> bool:
+        """Inbound SeenTx. True = caller should pull (WantTx) from
+        `from_peer`; False = suppressed (we have it, we already processed
+        it, or a pull is already outstanding — the announcer is recorded
+        as a fallback provider for that pull)."""
+        self._bump("seen_recv")
+        if from_peer:
+            self._note_have(h, from_peer)
+        if self.pool.has(h) or h in self._seen:
+            self._bump("want_suppressed")
+            return False
+        if h in self._wanted:
+            if from_peer and from_peer not in self._wanted[h]:
+                self._wanted[h].append(from_peer)
+            self._bump("want_suppressed")
+            return False
+        self._wanted[h] = []
+        self._bump("want_sent")
+        return True
+
+    def serve_want(self, h: bytes, to_peer: str = "") -> bytes | None:
+        """Inbound WantTx: the Tx delivery (None = we no longer have it —
+        committed or evicted between the announce and the pull)."""
+        raw = self.pool.get_raw(h)
+        if raw is not None:
+            self._bump("tx_served")
+            self._bump("tx_bytes_sent", len(raw))
+            if to_peer:
+                self._note_have(h, to_peer)
+        return raw
+
+    def on_delivered(self, h: bytes, raw: bytes, from_peer: str) -> None:
+        """A pulled (or directly pushed) Tx arrived; caller admits it."""
+        self._wanted.pop(h, None)
+        self._bump("tx_pulled")
+        self._bump("tx_bytes_recv", len(raw))
+        if from_peer:
+            self._note_have(h, from_peer)
+
+    def pull_failed(self, h: bytes) -> str | None:
+        """A WantTx pull errored: next candidate provider, or None (want
+        state cleared so a future SeenTx re-triggers the pull)."""
+        waiting = self._wanted.get(h)
+        if waiting:
+            return waiting.pop(0)
+        self._wanted.pop(h, None)
+        return None
+
+    def forget(self, hashes) -> None:
+        """Txs left the pool (committed/expired): drop have/want state so
+        the tracking dicts follow pool membership, not chain history."""
+        for h in hashes:
+            self._have.pop(h, None)
+            self._wanted.pop(h, None)

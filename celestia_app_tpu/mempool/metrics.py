@@ -1,0 +1,56 @@
+"""Mempool plane metrics: admission/eviction counters + pool gauges.
+
+Reference parity: celestia-core's mempool Metrics (mempool/metrics.go —
+Size, SizeBytes, FailedTxs, EvictedTxs, RecheckTimes) plus the CAT
+reactor's gossip counters. Each pool owns a MempoolMetrics instance that
+keeps LOCAL counts (so N in-process validators stay distinguishable —
+every test and /consensus/status reads per-node numbers) and mirrors every
+event into the process-wide `utils/telemetry` registry, which the
+prometheus endpoint and /status already serve.
+"""
+
+from __future__ import annotations
+
+import time
+
+from celestia_app_tpu.utils import telemetry
+
+# counter names (local key == telemetry suffix under "mempool.")
+ADMITTED = "admitted"
+REJECTED = "rejected"
+DUPLICATE = "duplicate"
+EVICTED = "evicted"
+EXPIRED_HEIGHT = "expired_height"
+EXPIRED_TIME = "expired_time"
+RECHECK_DROPPED = "recheck_dropped"
+COMMITTED = "committed"
+
+_COUNTERS = (ADMITTED, REJECTED, DUPLICATE, EVICTED, EXPIRED_HEIGHT,
+             EXPIRED_TIME, RECHECK_DROPPED, COMMITTED)
+
+
+class MempoolMetrics:
+    def __init__(self, registry=None):
+        # registry=None -> the module-global telemetry registry (what the
+        # prometheus endpoint scrapes); tests may pass an isolated one
+        self._reg = registry if registry is not None else telemetry._global
+        self.counters: dict[str, int] = {c: 0 for c in _COUNTERS}
+
+    def incr(self, name: str, by: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + by
+        self._reg.incr(f"mempool.{name}", by)
+
+    def set_size(self, count: int, nbytes: int) -> None:
+        """Pool gauges after every mutation. In a multi-node process the
+        global gauge is last-writer-wins; per-node truth is pool.stats()."""
+        self._reg.gauge("mempool.pool_count", count)
+        self._reg.gauge("mempool.pool_bytes", nbytes)
+
+    def time_reap(self, t0: float) -> None:
+        self._reg.measure_since("mempool.reap", t0)
+
+    def now(self) -> float:  # one place to stub time in tests
+        return time.perf_counter()
+
+    def snapshot(self) -> dict[str, int]:
+        return dict(self.counters)

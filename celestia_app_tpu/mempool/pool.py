@@ -1,0 +1,378 @@
+"""The content-addressable transaction pool (CAT) — THE mempool.
+
+Reference parity: celestia-core's cat pool (mempool/cat/pool.go): every tx
+is keyed by its sha256, admission runs CheckTx exactly once per content
+(a duplicate submission returns the ORIGINAL result instead of re-running
+ante against a bumped sequence and confusing the client), reaping orders
+by gas price while preserving per-sender arrival order (mempool v1
+priority semantics), the pool is capped by bytes AND count with
+lowest-priority eviction, entries expire by TTL in heights and wall-clock
+(TTLNumBlocks / TTLDuration, app/default_overrides.go:265-274), and after
+every commit the survivors are RE-CHECKED against fresh state so
+nonce-stale txs drop instead of wasting a proposal slot (RecheckTx).
+
+All three former mempools route through this class: `chain/node.py` Node,
+`chain/consensus.py` ValidatorNode, and the reactor's mempool-reactor half
+(`chain/reactor.py` + `mempool/gossip.py`). One admission path, one
+eviction policy, one recheck discipline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time as time_mod
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.chain.block import TxResult
+from celestia_app_tpu.mempool.metrics import (
+    ADMITTED,
+    COMMITTED,
+    DUPLICATE,
+    EVICTED,
+    EXPIRED_HEIGHT,
+    EXPIRED_TIME,
+    REJECTED,
+    RECHECK_DROPPED,
+    MempoolMetrics,
+)
+
+
+def tx_hash(raw: bytes) -> bytes:
+    """THE tx key: sha256 of the broadcast bytes (what blocks store, what
+    GetTx/ConfirmTx look up, what SeenTx/WantTx gossip announces)."""
+    return hashlib.sha256(raw).digest()
+
+
+def check_mempool_size(raw: bytes) -> TxResult | None:
+    """THE mempool byte-cap gate (MaxTxBytes, default_overrides.go:271-273),
+    shared by every admission path so they can never disagree on which txs
+    fit. None = within the cap."""
+    if len(raw) > appconsts.MEMPOOL_MAX_TX_BYTES:
+        return TxResult(1, "tx exceeds mempool max bytes", 0, 0, [])
+    return None
+
+
+def priority_order(items: list[tuple[bytes, float, bytes | None]]) -> list[bytes]:
+    """Gas-price-descending reap that preserves PER-SENDER arrival order.
+
+    `items` = [(raw, gas_price, sender)] in arrival order. A plain
+    (-price, arrival) sort would let a sender's later high-fee tx jump its
+    own earlier low-fee one — the later tx then fails the ante sequence
+    check in the proposal filter and is pointlessly delayed a height. Here
+    the sorted positions are kept, but each position is filled with the
+    owning sender's OLDEST pending tx, so priority decides which sender
+    goes first while nonces stay in submission order."""
+    from collections import deque
+
+    def key(i: int):
+        sender = items[i][2]
+        return sender if sender is not None else (b"raw", items[i][0])
+
+    queues: dict = {}
+    for i, (raw, _price, _sender) in enumerate(items):
+        queues.setdefault(key(i), deque()).append(raw)
+    order = sorted(range(len(items)), key=lambda i: (-items[i][1], i))
+    return [queues[key(i)].popleft() for i in order]
+
+
+def parse_tx_meta(raw: bytes) -> tuple[float, bytes | None]:
+    """(fee/gas, signer pubkey) for priority + per-sender lanes; junk that
+    somehow passed CheckTx degrades to zero-priority, anonymous."""
+    from celestia_app_tpu.chain.tx import decode_tx
+    from celestia_app_tpu.da import blob as blob_mod
+
+    try:
+        btx = blob_mod.try_unmarshal_blob_tx(raw)
+        tx = decode_tx(btx.tx if btx is not None else raw)
+        return (tx.body.fee / tx.body.gas_limit, tx.pubkey)
+    except (ValueError, ZeroDivisionError):
+        return (0.0, None)
+
+
+@dataclasses.dataclass
+class PoolTx:
+    raw: bytes
+    hash: bytes
+    gas_price: float
+    sender: bytes | None  # signer pubkey; keys the per-sender FIFO lane
+    height_added: int
+    time_added: float
+    seq: int  # arrival order, pool-global
+    result: TxResult  # the ORIGINAL CheckTx verdict (duplicate returns)
+
+
+class CATPool:
+    """Content-addressable priority mempool; see module docstring."""
+
+    def __init__(
+        self,
+        max_pool_bytes: int = appconsts.MEMPOOL_MAX_POOL_BYTES,
+        max_txs: int = appconsts.MEMPOOL_MAX_TXS,
+        ttl_blocks: int = appconsts.MEMPOOL_TX_TTL_BLOCKS,
+        ttl_seconds: float | None = appconsts.MEMPOOL_TX_TTL_SECONDS,
+        metrics: MempoolMetrics | None = None,
+    ):
+        self.max_pool_bytes = max_pool_bytes
+        self.max_txs = max_txs
+        self.ttl_blocks = ttl_blocks
+        self.ttl_seconds = ttl_seconds  # None disables wall-clock TTL
+        self.metrics = metrics or MempoolMetrics()
+        self._txs: dict[bytes, PoolTx] = {}  # hash -> entry, arrival-ordered
+        self._bytes = 0
+        self._next_seq = 0
+
+    # -- introspection ---------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._txs)
+
+    def __contains__(self, key: bytes) -> bool:
+        """Membership by tx hash (32 bytes) or raw tx bytes."""
+        return (key in self._txs) if len(key) == 32 else (tx_hash(key) in self._txs)
+
+    def has(self, h: bytes) -> bool:
+        return h in self._txs
+
+    def get_raw(self, h: bytes) -> bytes | None:
+        e = self._txs.get(h)
+        return e.raw if e is not None else None
+
+    @property
+    def pool_bytes(self) -> int:
+        return self._bytes
+
+    def entries(self) -> list[PoolTx]:
+        return list(self._txs.values())
+
+    def raws(self) -> list[bytes]:
+        return [e.raw for e in self._txs.values()]
+
+    def stats(self) -> dict:
+        return {
+            "count": len(self._txs),
+            "bytes": self._bytes,
+            **self.metrics.snapshot(),
+        }
+
+    # -- mutation core ---------------------------------------------------
+
+    def _insert(self, raw: bytes, h: bytes, meta: tuple[float, bytes | None],
+                height: int, now: float, result: TxResult) -> None:
+        self._txs[h] = PoolTx(
+            raw=raw, hash=h, gas_price=meta[0], sender=meta[1],
+            height_added=height, time_added=now, seq=self._next_seq,
+            result=result,
+        )
+        self._next_seq += 1
+        self._bytes += len(raw)
+        self.metrics.set_size(len(self._txs), self._bytes)
+
+    def _drop(self, h: bytes, counter: str | None) -> PoolTx | None:
+        e = self._txs.pop(h, None)
+        if e is None:
+            return None
+        self._bytes -= len(e.raw)
+        if counter is not None:
+            self.metrics.incr(counter)
+        self.metrics.set_size(len(self._txs), self._bytes)
+        return e
+
+    def _lane_key(self, e: PoolTx):
+        return e.sender if e.sender is not None else (b"raw", e.hash)
+
+    def _eviction_plan(self, incoming_price: float,
+                       incoming_len: int) -> list[PoolTx] | None:
+        """Plan (without mutating) the evictions that make room for an
+        incoming tx; None = no legal plan, refuse the tx. Computed BEFORE
+        CheckTx runs so a refused tx never touches the check state, and
+        applied only AFTER CheckTx passes so an invalid tx cannot evict
+        anything.
+
+        Victims are always LANE TAILS (each sender's newest pending tx —
+        dropping a lane's oldest entry would strand every later nonce
+        behind a sequence gap), taken cheapest-tail first, and only while
+        the tail is STRICTLY cheaper than the incoming tx — the pool never
+        evicts an equal-or-better tx for a worse one (a tail shielding an
+        older dust tx shields it legitimately: the dust entry cannot be
+        dropped alone without wasting the whole lane behind it)."""
+        count, nbytes = len(self._txs), self._bytes
+        if (count + 1 <= self.max_txs
+                and nbytes + incoming_len <= self.max_pool_bytes):
+            return []
+        lanes: dict = {}
+        for e in self._txs.values():  # arrival-ordered -> lane order
+            lanes.setdefault(self._lane_key(e), []).append(e)
+        victims: list[PoolTx] = []
+        while (count + 1 > self.max_txs
+               or nbytes + incoming_len > self.max_pool_bytes):
+            tails = [lane[-1] for lane in lanes.values() if lane]
+            if not tails:
+                return None  # incoming alone exceeds the byte cap
+            victim = min(tails, key=lambda e: (e.gas_price, -e.seq))
+            if victim.gas_price >= incoming_price:
+                return None
+            lanes[self._lane_key(victim)].pop()
+            victims.append(victim)
+            count -= 1
+            nbytes -= len(victim.raw)
+        return victims
+
+    # -- the single admission path --------------------------------------
+
+    def add(self, raw: bytes, *, height: int, now: float | None = None,
+            check_fn=None, meta: tuple[float, bytes | None] | None = None,
+            ) -> TxResult:
+        """CheckTx + admission. Duplicate content returns the ORIGINAL
+        TxResult without re-running CheckTx (content-addressable dedup —
+        the same raw tx POSTed twice must not be appended twice, and must
+        not get a spurious sequence-mismatch error from its own first
+        copy's CheckTx bump). `check_fn` is App.check_tx (None skips the
+        check — trusted re-injection paths only). `meta` optionally
+        supplies a pre-parsed (gas_price, sender)."""
+        now = time_mod.time() if now is None else now
+        h = tx_hash(raw)
+        existing = self._txs.get(h)
+        if existing is not None:
+            self.metrics.incr(DUPLICATE)
+            return existing.result
+        oversize = check_mempool_size(raw)
+        if oversize is not None:
+            self.metrics.incr(REJECTED)
+            return oversize
+        if meta is None:
+            meta = parse_tx_meta(raw)
+        if (len(self._txs) + 1 > self.max_txs
+                or self._bytes + len(raw) > self.max_pool_bytes):
+            # at a cap: sweep TTL-expired entries before evicting live
+            # ones (the sweep is O(n), so it runs only when space is
+            # actually needed; reap() sweeps on every proposal anyway)
+            self.expire(height, now)
+        # capacity verdict BEFORE CheckTx: App.check_tx WRITES into the
+        # persistent check state (sequence bump, fee deduction) — running
+        # it for a tx the pool then refuses would desync the sender's
+        # whole lane until the next commit resets the state
+        plan = self._eviction_plan(meta[0], len(raw))
+        if plan is None:
+            self.metrics.incr(REJECTED)
+            return TxResult(1, "mempool is full", 0, 0, [])
+        if check_fn is not None:
+            res = check_fn(raw)
+            if res.code != 0:
+                self.metrics.incr(REJECTED)
+                return res
+        else:
+            res = TxResult(0, "", 0, 0, [])
+        # evictions apply only now — an invalid tx must not evict anything
+        for victim in plan:
+            self._drop(victim.hash, EVICTED)
+        self._insert(raw, h, meta, height, now, res)
+        self.metrics.incr(ADMITTED)
+        return res
+
+    # -- lifecycle -------------------------------------------------------
+
+    def expire(self, height: int, now: float | None = None) -> list[PoolTx]:
+        """TTL sweep: drop entries older than ttl_blocks heights OR
+        ttl_seconds wall-clock (both default to the reference's 5-block /
+        5×goal-block-time shape). Returns the dropped entries."""
+        now = time_mod.time() if now is None else now
+        dropped: list[PoolTx] = []
+        for e in list(self._txs.values()):
+            if height - e.height_added >= self.ttl_blocks:
+                dropped.append(self._drop(e.hash, EXPIRED_HEIGHT))
+            elif (self.ttl_seconds is not None
+                  and now - e.time_added >= self.ttl_seconds):
+                dropped.append(self._drop(e.hash, EXPIRED_TIME))
+        return dropped
+
+    def reap(self, height: int, now: float | None = None) -> list[bytes]:
+        """The proposal candidate list: TTL sweep, then gas-price-desc
+        order with per-sender arrival order kept (priority_order — the
+        order FilterTxs receives candidates in, mempool v1 semantics)."""
+        t0 = self.metrics.now()
+        self.expire(height, now)
+        out = priority_order(
+            [(e.raw, e.gas_price, e.sender) for e in self._txs.values()]
+        )
+        self.metrics.time_reap(t0)
+        return out
+
+    def remove_committed(self, txs) -> int:
+        """Drop txs that just committed (by content)."""
+        n = 0
+        for raw in txs:
+            if self._drop(tx_hash(raw), COMMITTED) is not None:
+                n += 1
+        return n
+
+    def recheck(self, check_fn) -> list[PoolTx]:
+        """Post-commit recheck: re-run CheckTx on every survivor against
+        the FRESH check state (reset at commit), in arrival order so a
+        sender's queued nonce chain revalidates front-to-back. Entries the
+        app now refuses (stale sequence, balance spent by a committed tx,
+        fee floor moved) drop instead of wasting a proposal slot. Returns
+        the dropped entries."""
+        dropped: list[PoolTx] = []
+        for e in sorted(self._txs.values(), key=lambda e: e.seq):
+            res = check_fn(e.raw)
+            if res.code != 0:
+                dropped.append(self._drop(e.hash, RECHECK_DROPPED))
+        return dropped
+
+    def clear(self) -> None:
+        self._txs.clear()
+        self._bytes = 0
+        self.metrics.set_size(0, 0)
+
+
+# ---------------------------------------------------------------------------
+# List-compatible views: the pre-CAT mempools were bare lists and tests,
+# tools, and the status surfaces touch them as such (`len(node.mempool)`,
+# `node.mempool.clear()`, `vnode.mempool == []`). These wrappers keep that
+# surface alive over the pool without copying it per access.
+# ---------------------------------------------------------------------------
+
+
+class _PoolView:
+    def __init__(self, pool: CATPool):
+        self._pool = pool
+
+    def _items(self) -> list:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        return len(self._pool)
+
+    def __bool__(self) -> bool:
+        return len(self._pool) > 0
+
+    def __iter__(self):
+        return iter(self._items())
+
+    def __getitem__(self, i):
+        return self._items()[i]
+
+    def __eq__(self, other) -> bool:
+        return self._items() == list(other)
+
+    def __repr__(self) -> str:
+        return repr(self._items())
+
+    def clear(self) -> None:
+        self._pool.clear()
+
+
+class RawTxView(_PoolView):
+    """ValidatorNode.mempool compat: a list of raw tx bytes."""
+
+    def _items(self) -> list[bytes]:
+        return self._pool.raws()
+
+
+class EntryView(_PoolView):
+    """Node.mempool compat: a list of pool entries (MempoolTx-shaped:
+    .raw/.gas_price/.height_added/.sender)."""
+
+    def _items(self) -> list[PoolTx]:
+        return self._pool.entries()

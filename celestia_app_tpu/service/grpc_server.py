@@ -184,8 +184,17 @@ class CosmosTxService:
                           f"tx hash must be hex, got {want!r}")
         with self.lock:
             entry = self.node.committed.get(want_raw)
+            pending = (getattr(self.node, "pool", None) is not None
+                       and entry is None and self.node.pool.has(want_raw))
         if entry is None:
-            context.abort(grpc.StatusCode.NOT_FOUND, f"tx {want} not found")
+            # distinguish "still in the mempool" from "unknown" — a
+            # ConfirmTx poller needs to keep waiting for the former and
+            # may resubmit on the latter (tx_client.go:430 PENDING state)
+            context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"tx {want} pending in mempool" if pending
+                else f"tx {want} not found",
+            )
         height, res = entry
         resp = txpb.tx_response_pb(
             height=height,

@@ -71,7 +71,14 @@ class NodeService:
                 try:
                     if self.path == "/status":
                         with service.lock:
-                            self._send(200, service.router.query("status", {}))
+                            out = service.router.query("status", {})
+                            # mempool plane: per-node CAT pool stats (the
+                            # process-wide gauges also ride the telemetry
+                            # snapshot / prometheus endpoint)
+                            pool = getattr(service.node, "pool", None)
+                            if pool is not None:
+                                out["mempool"] = pool.stats()
+                        self._send(200, out)
                     elif self.path == "/metrics":
                         # Prometheus text exposition (the reference's
                         # metrics provider endpoint, SURVEY §5.1)

@@ -32,6 +32,9 @@ starve vote intake):
   POST /gossip/vote {round, vote}   prevote/precommit from a peer
   POST /gossip/commit {proposal, cert}   a peer's committed height
   GET  /gossip/commit_at?height=H   recent commit record (laggard catch-up)
+  POST /gossip/seen_tx {hash, from} CAT SeenTx announce (want/have gossip)
+  GET  /gossip/want_tx?hash=H       CAT WantTx pull -> {tx: b64} delivery
+  POST /gossip/tx {tx: b64}         direct Tx push (legacy flood delivery)
 """
 
 from __future__ import annotations
@@ -78,6 +81,24 @@ class ValidatorService:
                         q = parse_qs(urlparse(self.path).query)
                         h = int(q.get("height", ["0"])[0])
                         self._send(200, service.reactor.commit_at(h) or {})
+                    elif self.path.startswith("/gossip/want_tx"):
+                        # WantTx pull: serve tx content for an announced
+                        # hash (the Tx delivery of the want/have protocol)
+                        from urllib.parse import parse_qs, urlparse
+
+                        if service.reactor is None:
+                            self._send(404, {"error": "not autonomous"})
+                            return
+                        q = parse_qs(urlparse(self.path).query)
+                        try:
+                            h = bytes.fromhex(q.get("hash", [""])[0])
+                        except ValueError:
+                            self._send(400, {"error": "hash must be hex"})
+                            return
+                        raw = service.reactor.serve_want_tx(h)
+                        self._send(200, {} if raw is None else {
+                            "tx": base64.b64encode(raw).decode()
+                        })
                     elif self.path == "/consensus/snapshot":
                         with service.lock:
                             manifest, chunks = service.vnode.snapshot_chunks()
@@ -104,6 +125,7 @@ class ValidatorService:
                         "/gossip/vote": "on_vote",
                         "/gossip/commit": "on_commit",
                         "/gossip/tx": "on_tx",
+                        "/gossip/seen_tx": "on_seen_tx",
                     }.get(self.path)
                     if gossip is not None:
                         if service.reactor is None:
@@ -153,7 +175,12 @@ class ValidatorService:
             "height": v.app.height,
             "app_version": v.app.app_version,
             "app_hash": v.app.last_app_hash.hex(),
-            "mempool": len(v.mempool),
+            "mempool": len(v.pool),
+            "mempool_bytes": v.pool.pool_bytes,
+            # CAT pool counters (admitted/rejected/duplicate/evicted/
+            # expired_*/recheck_dropped/committed) — per NODE, unlike the
+            # process-wide prometheus endpoint
+            "mempool_stats": v.pool.stats(),
             "locked": v.locked_block.header.hash().hex()
             if v.locked_block is not None else None,
         }
@@ -163,15 +190,22 @@ class ValidatorService:
                 "step": self.reactor.step,
                 "height_view": self.reactor.height_view,
             }
+            out["mempool_gossip"] = dict(self.reactor.mempool_gossip.stats)
         return out
 
-    def attach_reactor(self, peer_urls: list[str], config=None):
+    def attach_reactor(self, peer_urls: list[str], config=None,
+                       self_url: str | None = None):
         """Switch this validator to autonomous mode: start the consensus
-        reactor thread gossiping with `peer_urls` (chain/reactor.py)."""
+        reactor thread gossiping with `peer_urls` (chain/reactor.py).
+        `self_url` is the URL peers reach THIS service at (rides SeenTx
+        announces so peers know whom to pull tx content from); defaults
+        to localhost:port, which matches how the devnet spawner and the
+        in-process test nets address each other."""
         from celestia_app_tpu.chain.reactor import ConsensusReactor
 
         self.reactor = ConsensusReactor(
-            self.vnode, peer_urls, self.lock, config
+            self.vnode, peer_urls, self.lock, config,
+            self_url=self_url or f"http://127.0.0.1:{self.port}",
         )
         self.reactor.start()
         return self.reactor

@@ -23,9 +23,14 @@ class Registry:
     def __init__(self):
         self.counters: dict[str, int] = {}
         self.timers: dict[str, dict] = {}
+        self.gauges: dict[str, float] = {}
 
     def incr(self, name: str, by: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + by
+
+    def gauge(self, name: str, value: float) -> None:
+        """Set-type metric (pool sizes, queue depths): last write wins."""
+        self.gauges[name] = value
 
     def measure_since(self, name: str, t0: float) -> float:
         dt = time.perf_counter() - t0
@@ -39,7 +44,8 @@ class Registry:
         return dt
 
     def snapshot(self) -> dict:
-        out = {"counters": dict(self.counters), "timers": {}}
+        out = {"counters": dict(self.counters), "timers": {},
+               "gauges": dict(self.gauges)}
         for name, t in self.timers.items():
             avg = t["total_s"] / t["count"] if t["count"] else 0.0
             out["timers"][name] = {**t, "avg_s": avg}
@@ -48,6 +54,7 @@ class Registry:
     def reset(self) -> None:
         self.counters.clear()
         self.timers.clear()
+        self.gauges.clear()
 
     def prometheus(self, prefix: str = "celestia") -> str:
         """Prometheus text exposition of the registry (the reference wires
@@ -64,10 +71,15 @@ class Registry:
         # mid-scrape (the docstring's promise that readers see a copy)
         counters = dict(self.counters)
         timers = {k: dict(v) for k, v in dict(self.timers).items()}
+        gauges = dict(self.gauges)
         lines: list[str] = []
         for name, v in sorted(counters.items()):
             m = f"{prefix}_{_san(name)}_total"
             lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {v}")
+        for name, v in sorted(gauges.items()):
+            m = f"{prefix}_{_san(name)}"
+            lines.append(f"# TYPE {m} gauge")
             lines.append(f"{m} {v}")
         for name, t in sorted(timers.items()):
             base = f"{prefix}_{_san(name)}_seconds"
@@ -118,6 +130,7 @@ _global = Registry()
 _traces = TraceTables()
 
 incr = _global.incr
+gauge = _global.gauge
 measure_since = _global.measure_since
 snapshot = _global.snapshot
 prometheus = _global.prometheus
