@@ -620,6 +620,9 @@ def main() -> None:
     if "--mempool" in sys.argv:
         measure_mempool()
         return
+    if "--chaos" in sys.argv:
+        measure_chaos()
+        return
     if "--stream-mesh" in sys.argv:
         measure_stream_mesh()
         return
@@ -720,6 +723,140 @@ def measure_mempool(n_senders: int = 16, txs_per_sender: int = 32) -> None:
         "unit": "ms",
         "pool_count": len(reaped),
     }))
+
+
+def measure_chaos(heights: int = 12, lost: int = 8) -> None:
+    """Fault-plane recovery bench (--chaos). Two BENCH JSON lines:
+
+      {"metric": "crash_replay_ms", ...}        WAL replay wall time for a
+          node that lost its last `lost` durable commits but kept the WAL
+          (the crash-matrix recovery path, chain/consensus.replay_wal)
+      {"metric": "chaos_heal_recovery_s", ...}  wall time from healing a
+          seeded full partition of a 3-reactor devnet to its next
+          committed height (blocks-to-liveness after heal)
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from celestia_app_tpu import faults
+    from celestia_app_tpu.chain import consensus as cons
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.chain.reactor import ReactorConfig
+    from celestia_app_tpu.chain.storage import ChainDB
+    from celestia_app_tpu.service.validator_server import ValidatorService
+
+    def genesis_for(privs, powers):
+        return {
+            "time_unix": 1_700_000_000.0,
+            "accounts": [
+                {"address": p.public_key().address().hex(),
+                 "balance": 10**12} for p in privs
+            ],
+            "validators": [
+                {"operator": p.public_key().address().hex(), "power": w,
+                 "pubkey": p.public_key().compressed.hex()}
+                for p, w in zip(privs, powers)
+            ],
+        }
+
+    # -- 1) crash-replay wall time ---------------------------------------
+    tmp = tempfile.mkdtemp(prefix="chaos-bench-")
+    try:
+        priv = PrivateKey.from_seed(b"chaos-replay")
+        genesis = genesis_for([priv], [10])
+        data_dir = os.path.join(tmp, "data")
+        node = cons.ValidatorNode("val0", priv, genesis, "chaos-bench",
+                                  data_dir=data_dir)
+        net = cons.LocalNetwork([node])
+        t = 1_700_000_000.0
+        for _ in range(heights):
+            t += 1.0
+            net.produce_height(t=t)
+        node.app.close()
+        # the crash: the last `lost` durable commits vanish, the WAL stays
+        keep = heights - lost
+        db = ChainDB(data_dir)
+        db.delete_above(keep)
+        # the native engine's tomb_above removes the (sole) LATEST record
+        # outright; re-point it at the surviving height (the file engine
+        # already did this inside delete_above — set_latest is idempotent)
+        db.backend.set_latest(keep)
+        db.close()
+        node2 = cons.ValidatorNode("val0", priv, genesis, "chaos-bench",
+                                   data_dir=data_dir)
+        node2.app.load()
+        assert node2.app.height == keep
+        t0 = time.perf_counter()
+        replayed = node2.replay_wal()
+        replay_ms = (time.perf_counter() - t0) * 1e3
+        node2.app.close()
+        assert replayed == lost, (replayed, lost)
+        print(json.dumps({
+            "metric": "crash_replay_ms",
+            "value": round(replay_ms, 2),
+            "unit": "ms",
+            "blocks_replayed": replayed,
+            "per_block_ms": round(replay_ms / max(replayed, 1), 2),
+        }), flush=True)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # -- 2) partition heal: blocks-to-liveness ---------------------------
+    faults.reset(seed=7)
+    privs = [PrivateKey.from_seed(b"chaos-%d" % i) for i in range(3)]
+    genesis = genesis_for(privs, [10, 10, 10])
+    nodes = [cons.ValidatorNode(f"val{i}", p, genesis, "chaos-bench")
+             for i, p in enumerate(privs)]
+    services = [ValidatorService(v) for v in nodes]
+    for s in services:
+        s.serve_background()
+    urls = [f"http://127.0.0.1:{s.port}" for s in services]
+    cfg = dict(timeout_propose=5.0, timeout_prevote=2.5,
+               timeout_precommit=2.5, timeout_delta=0.5,
+               block_interval=0.05, poll=0.01, gossip_timeout=1.5,
+               sync_grace=0.5, breaker_reset=1.5)
+    try:
+        for i, s in enumerate(services):
+            s.attach_reactor([u for j, u in enumerate(urls) if j != i],
+                             ReactorConfig(**cfg))
+        deadline = time.monotonic() + 120
+        while (time.monotonic() < deadline
+               and min(n.app.height for n in nodes) < 2):
+            time.sleep(0.05)
+        # isolate val0: no side holds >2/3 of 30 -> full stall
+        ports = [s.port for s in services]
+        faults.arm("net.request", "drop",
+                   match={"owner": "^val0$"})
+        faults.arm("net.request", "drop",
+                   match={"owner": "^val[12]$", "peer": f":{ports[0]}$"})
+        time.sleep(5.0)
+        h0 = max(n.app.height for n in nodes)
+        faults.disarm(point="net.request")
+        t_heal = time.monotonic()
+        deadline = time.monotonic() + 120
+        while (time.monotonic() < deadline
+               and max(n.app.height for n in nodes) <= h0):
+            time.sleep(0.02)
+        recovery_s = time.monotonic() - t_heal
+        # liveness rate: heights committed in the 5 s after recovery
+        h1 = max(n.app.height for n in nodes)
+        time.sleep(5.0)
+        rate = (max(n.app.height for n in nodes) - h1) / 5.0
+        print(json.dumps({
+            "metric": "chaos_heal_recovery_s",
+            "value": round(recovery_s, 3),
+            "unit": "s",
+            "stalled_at": h0,
+            "blocks_per_sec_after_heal": round(rate, 3),
+        }), flush=True)
+    finally:
+        faults.reset()
+        for s in services:
+            try:
+                s.shutdown()
+            except Exception:
+                pass
 
 
 def measure_stream() -> None:

@@ -34,6 +34,7 @@ import hashlib
 import json
 import os
 
+from celestia_app_tpu import faults
 from celestia_app_tpu.chain.app import App
 from celestia_app_tpu.chain.block import Block, Header
 from celestia_app_tpu.chain.crypto import PrivateKey, PublicKey
@@ -668,6 +669,11 @@ class ValidatorNode:
             json.dump(doc, f)
             f.flush()
             os.fsync(f.fileno())
+        # crash point 1 of the commit matrix: the record is fsync'd as a
+        # tmp but NOT renamed — after restart there is no durable WAL
+        # entry for this height (the torn tail the replay scanner skips).
+        # Recovery: commit-record catch-up from peers (blocksync).
+        faults.fire("consensus.wal_append", height=block.header.height)
         os.replace(tmp, self._wal_path(block.header.height))
 
     def _present_set_from_cert(
@@ -766,6 +772,11 @@ class ValidatorNode:
         present = self._present_set_from_cert(src)
         self.write_wal(block, cert, evidence, present=present,
                        record_present=from_proposal)
+        # crash point 2 of the commit matrix: the WAL record IS durable
+        # but no state has been touched. Recovery: replay_wal() re-applies
+        # the recorded block on restart (Tendermint's replay semantics).
+        faults.fire("consensus.post_wal_pre_apply",
+                    height=block.header.height)
         self._apply_evidence(evidence)
         # ordering invariant shared with replay_wal: evidence FIRST, then
         # absences — both paths must compute the absent set against the

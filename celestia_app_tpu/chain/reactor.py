@@ -47,11 +47,10 @@ import dataclasses
 import json
 import threading
 import time
-import urllib.error
-import urllib.request
 
 from celestia_app_tpu.chain import consensus as c
 from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+from celestia_app_tpu.net.transport import PeerClient, TransportConfig
 from celestia_app_tpu.utils import telemetry
 
 
@@ -96,6 +95,14 @@ class ReactorConfig:
     blocksync_batch: int = 64
     statesync_gap: int = 512
     commit_records_keep: int = 10_000
+    # shared-transport hardening (net/transport.py): gossip is fire-and-
+    # forget so sends make ONE attempt (the pull paths recover anything
+    # that matters); `breaker_failures` consecutive failures open the
+    # peer's circuit and sends are SKIPPED (not retried every tick) until
+    # a half-open probe after `breaker_reset` seconds succeeds
+    net_retries: int = 1
+    breaker_failures: int = 3
+    breaker_reset: float = 2.5
 
 
 class ConsensusReactor:
@@ -123,8 +130,23 @@ class ConsensusReactor:
             raise ValueError(
                 "autonomous consensus needs genesis validator pubkeys"
             )
+        # THE peer transport for everything this reactor sends or pulls:
+        # gossip floods, WantTx pulls, status probes, blocksync record
+        # fetches, state sync. One instance so breaker/health state is
+        # per-PEER across all of them — a peer that hard-fails gossip is
+        # also skipped by the pull paths until its half-open probe clears.
+        self.net = PeerClient(
+            TransportConfig(
+                timeout=self.cfg.gossip_timeout,
+                retries=self.cfg.net_retries,
+                failure_threshold=self.cfg.breaker_failures,
+                reset_timeout=self.cfg.breaker_reset,
+            ),
+            name=vnode.name,
+        )
         self.round = 0
         self.step = "idle"
+        self.loop_errors = 0  # counted, surfaced in /consensus/status
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # inbox (guarded by _msg_lock; handlers must never block on the
@@ -184,14 +206,6 @@ class ConsensusReactor:
 
     # -- outbound gossip -------------------------------------------------
 
-    def _post(self, url: str, path: str, payload: dict) -> None:
-        req = urllib.request.Request(
-            url + path, data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"}, method="POST",
-        )
-        with urllib.request.urlopen(req, timeout=self.cfg.gossip_timeout):
-            pass
-
     def _gossip(self, path: str, payload: dict) -> None:
         """Fire-and-forget flood to every peer (fully-connected devnet
         topology). One daemon sender per peer drains a queue, so a dead
@@ -221,10 +235,19 @@ class ConsensusReactor:
                         continue
                     if self.cfg.gossip_delay > 0:  # injected latency
                         time.sleep(self.cfg.gossip_delay)
+                    if not self.net.available(u):
+                        # circuit open: SKIP the peer instead of paying a
+                        # connect timeout per queued message — gossip is
+                        # best-effort and the pull probes recover anything
+                        # that matters once the breaker half-opens
+                        telemetry.incr("net.send_skipped")
+                        continue
                     try:
-                        self._post(u, *item)
-                    except (urllib.error.URLError, OSError, ValueError):
-                        pass
+                        self.net.post(u, *item)
+                    except (OSError, ValueError):
+                        # counted, never silent: the transport's per-peer
+                        # failure tally (net snapshot) carries the detail
+                        telemetry.incr("net.send_failures")
 
             threading.Thread(target=drain, daemon=True).start()
 
@@ -365,18 +388,14 @@ class ConsensusReactor:
         url = provider
         while url:
             try:
-                with urllib.request.urlopen(
-                    f"{url}/gossip/want_tx?hash={h.hex()}",
-                    timeout=self.cfg.gossip_timeout,
-                ) as r:
-                    doc = json.loads(r.read())
+                doc = self.net.get(url, f"/gossip/want_tx?hash={h.hex()}")
                 tx_b64 = doc.get("tx")
                 if tx_b64:
                     raw = base64.b64decode(tx_b64)
                     with self._msg_lock:
                         self.mempool_gossip.on_delivered(h, raw, url)
                     return raw
-            except (urllib.error.URLError, OSError, ValueError):
+            except (OSError, ValueError):
                 pass
             with self._msg_lock:
                 url = self.mempool_gossip.pull_failed(h)
@@ -573,14 +592,23 @@ class ConsensusReactor:
     # -- the state machine ----------------------------------------------
 
     def _run(self) -> None:
+        backoff = 0.2
         while not self._stop.is_set():
             try:
                 committed = self._step_height()
-            except Exception as e:  # keep the reactor alive; log loudly
+            except Exception as e:  # keep the reactor alive — but COUNTED
+                # (reactor.loop_errors) and with escalating backoff, not
+                # the old fixed-0.2s hot loop that could spin a wedged
+                # node at 5 errors/second forever
+                self.loop_errors += 1
+                telemetry.incr("reactor.loop_errors")
                 print(f"[reactor {self.vnode.name}] round error: "
                       f"{type(e).__name__}: {e}", flush=True)
                 committed = False
-                time.sleep(0.2)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+            else:
+                backoff = 0.2
             if committed:
                 self.round = 0
                 time.sleep(self.cfg.block_interval)
@@ -836,40 +864,29 @@ class ConsensusReactor:
         (feeds the same catch-up path inbound gossip does)."""
         for u in self.peers:
             try:
-                with urllib.request.urlopen(
-                    u + "/consensus/status",
-                    timeout=self.cfg.gossip_timeout,
-                ) as r:
-                    st = json.loads(r.read())
+                st = self.net.get(u, "/consensus/status")
                 self._note_height(int(st["height"]) + 1, u)
-            except (urllib.error.URLError, OSError, ValueError, KeyError):
+            except (OSError, ValueError, KeyError):
                 continue
 
     def _fetch_record_from(self, url: str, height: int) -> dict | None:
         try:
-            with urllib.request.urlopen(
-                f"{url}/gossip/commit_at?height={height}",
-                timeout=self.cfg.gossip_timeout,
-            ) as r:
-                doc = json.loads(r.read())
+            doc = self.net.get(url, f"/gossip/commit_at?height={height}")
             return doc or None
-        except (urllib.error.URLError, OSError, ValueError):
+        except (OSError, ValueError):
             return None
 
     def _state_sync_from(self, url: str) -> bool:
         import base64
 
         try:
-            with urllib.request.urlopen(
-                url + "/consensus/snapshot", timeout=30
-            ) as r:
-                doc = json.loads(r.read())
+            doc = self.net.get(url, "/consensus/snapshot", timeout=30)
             chunks = [base64.b64decode(ch) for ch in doc["chunks"]]
             with self.service_lock:
                 c.state_sync_bootstrap(self.vnode, doc["manifest"], chunks)
                 self._refresh_valset()  # the synced state may carry new validators
             return True
-        except (urllib.error.URLError, OSError, ValueError, KeyError):
+        except (OSError, ValueError, KeyError):
             return False
 
     def _step_height(self) -> bool:

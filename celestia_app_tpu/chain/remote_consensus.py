@@ -19,11 +19,10 @@ rejoins the schedule.
 
 from __future__ import annotations
 
-import json
 import urllib.error
-import urllib.request
 
 from celestia_app_tpu.chain import consensus as c
+from celestia_app_tpu.net.transport import PeerClient, TransportConfig
 
 
 class PeerDown(Exception):
@@ -33,30 +32,32 @@ class PeerDown(Exception):
 class RemoteValidator:
     """HTTP handle to one validator process (the reactor's peer)."""
 
-    def __init__(self, url: str, timeout: float = 60.0):
+    def __init__(self, url: str, timeout: float = 60.0,
+                 client: PeerClient | None = None):
         self.url = url.rstrip("/")
         self.timeout = timeout
+        # hardened transport, one attempt per _call (the round schedule
+        # is the retry policy here — a peer absent from a phase is simply
+        # absent); the breaker makes a long-dead peer cost BreakerOpen
+        # speed per phase instead of a connect timeout, and its half-open
+        # probe readmits it when it returns (the documented rejoin path)
+        self.client = client or PeerClient(
+            TransportConfig(timeout=timeout, retries=1),
+            name="orchestrator",
+        )
 
     def _call(self, method: str, path: str, payload: dict | None = None,
               timeout: float | None = None) -> dict:
         try:
-            if method == "GET":
-                req = urllib.request.Request(self.url + path)
-            else:
-                req = urllib.request.Request(
-                    self.url + path,
-                    data=json.dumps(payload or {}).encode(),
-                    headers={"Content-Type": "application/json"},
-                    method="POST",
-                )
-            with urllib.request.urlopen(
-                req, timeout=timeout if timeout is not None else self.timeout
-            ) as r:
-                return json.loads(r.read())
+            return self.client.request(
+                self.url, path,
+                (payload or {}) if method != "GET" else None,
+                timeout=timeout if timeout is not None else self.timeout,
+            )
         except urllib.error.HTTPError as e:
             body = e.read().decode(errors="replace")
             raise ValueError(f"{path} -> {e.code}: {body[:300]}") from None
-        except (urllib.error.URLError, OSError, TimeoutError) as e:
+        except (OSError, TimeoutError) as e:
             raise PeerDown(f"{self.url}{path}: {e}") from None
 
     def status(self, timeout: float | None = None) -> dict:

@@ -42,6 +42,7 @@ import gzip
 import json
 import os
 
+from celestia_app_tpu import faults
 from celestia_app_tpu.chain.block import Block
 
 PRUNE_KEEP = 100  # same rollback window the in-memory history kept
@@ -52,6 +53,11 @@ STATE, DELTA, BLOCK, LATEST = 0, 1, 2, 3
 
 
 def _atomic_write(path: str, data: bytes) -> None:
+    # disk fault point: armed "error" surfaces as the OSError any real
+    # full-disk/EIO failure would; "crash" kills the process here, before
+    # anything of this artifact is durable; "delay" models a slow disk
+    if faults.fire("storage.atomic_write", path=path) == "error":
+        raise OSError(f"injected fault: storage.atomic_write {path}")
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(data)
@@ -312,6 +318,13 @@ class ChainDB:
                 },
             }
             self.backend.put(DELTA, height, self._encode(doc))
+        # crash point 3 of the commit matrix: the commit artifact (and the
+        # block, saved just before) are durable but LATEST still points at
+        # height-1 — the crash-safety contract's "between the two" case.
+        # Recovery: load() resumes at height-1, WAL replay re-commits.
+        if faults.fire("consensus.post_apply_pre_latest",
+                       height=height) == "error":
+            raise OSError("injected fault: consensus.post_apply_pre_latest")
         self.backend.set_latest(height)
         self._prune(height)
         self.backend.sync()

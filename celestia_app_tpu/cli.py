@@ -337,8 +337,8 @@ def cmd_download_genesis(args) -> int:
     """cmd/download_genesis.go analog: fetch (or locally verify) a known
     network's genesis and check it against the pinned SHA-256."""
     import hashlib
-    import urllib.error
-    import urllib.request
+
+    from celestia_app_tpu.net import transport
 
     chain_id = args.chain_id
     if chain_id not in _GENESIS_SHA256:
@@ -352,12 +352,13 @@ def cmd_download_genesis(args) -> int:
                f"master/{chain_id}/genesis.json")
         try:
             os.makedirs(args.home, exist_ok=True)
-            with urllib.request.urlopen(url, timeout=10) as r:
-                data = r.read()
+            # raw bytes, not JSON: the pinned sha256 is over the exact
+            # served bytes
+            data = transport.DEFAULT.request(url, "", raw=True, timeout=10)
             with open(out, "wb") as f:
                 f.write(data)
             downloaded = True
-        except (urllib.error.URLError, OSError) as e:
+        except OSError as e:
             print(f"download failed ({e}); if you already have the file, "
                   f"place it at {out} and re-run to verify its hash",
                   file=sys.stderr)
@@ -687,25 +688,17 @@ def cmd_relayer(args) -> int:
 
     def handle(url: str, seed: str, client_id: str,
                verifying: bool) -> HttpChainHandle:
-        import urllib.request
+        from celestia_app_tpu.net import transport
 
         priv = PrivateKey.from_seed(seed.encode())
         addr = priv.public_key().address()
-        with urllib.request.urlopen(url.rstrip("/") + "/status",
-                                    timeout=10) as r:
-            chain_id = json.load(r)["chain_id"]
+        chain_id = transport.request_json(url, "/status")["chain_id"]
         signer = Signer(chain_id)
         # bootstrap the account number/sequence from the node
-        import urllib.request as _u
-
-        req = _u.Request(
-            url.rstrip("/") + "/abci_query",
-            data=json.dumps({"path": "auth/account",
-                             "data": {"address": addr.hex()}}).encode(),
-            headers={"Content-Type": "application/json"}, method="POST",
-        )
-        with _u.urlopen(req, timeout=10) as r:
-            acc = json.load(r).get("account") or {}
+        acc = transport.request_json(
+            url, "/abci_query",
+            {"path": "auth/account", "data": {"address": addr.hex()}},
+        ).get("account") or {}
         signer.add_account(priv, acc.get("number", 0),
                            acc.get("sequence", 0))
         return HttpChainHandle(url, signer, addr, client_id,
@@ -1016,6 +1009,17 @@ def cmd_validator_serve(args) -> int:
         v2_upgrade_height=home_cfg.get("v2_upgrade_height"),
         upgrade_height_delay=home_cfg.get("upgrade_height_delay"),
     )
+    # fault plane (chaos provisioning): <home>/faults.json arms named
+    # fault points for THIS process at startup — the config-file twin of
+    # the CELESTIA_FAULTS env and the runtime /faults/* admin endpoint
+    faults_path = os.path.join(args.home, "faults.json")
+    if os.path.exists(faults_path):
+        from celestia_app_tpu import faults as faults_mod
+
+        with open(faults_path) as f:
+            armed = faults_mod.arm_from_spec(json.load(f))
+        print(f"armed {len(armed)} fault(s) from faults.json",
+              file=sys.stderr, flush=True)
     try:
         vnode.app.load()  # resume at the durable committed height
     except ValueError:
@@ -1187,10 +1191,10 @@ def _devnet_autonomous(args, privs, genesis) -> int:
     devnet observer role)."""
     import base64
     import time as time_mod
-    import urllib.request
 
     from celestia_app_tpu.chain.tx import MsgSend
     from celestia_app_tpu.client.tx_client import Signer
+    from celestia_app_tpu.net.transport import PeerClient, TransportConfig
 
     n = args.validators
     procs, homes, urls = _spawn_validator_processes(
@@ -1215,22 +1219,20 @@ def _devnet_autonomous(args, privs, genesis) -> int:
                 json.dump(urls, f)
             os.replace(tmp, os.path.join(home, "peers.json"))
 
+        # the observer's transport: breaker state keeps the watch loop
+        # from stalling 5 s per poll on a crashed validator
+        net = PeerClient(TransportConfig(timeout=5.0, retries=1),
+                         name="devnet-observer")
+
         def status(u: str) -> dict | None:
             try:
-                with urllib.request.urlopen(
-                    u + "/consensus/status", timeout=5
-                ) as r:
-                    return json.loads(r.read())
+                return net.get(u, "/consensus/status")
             except OSError:
                 return None
 
         def commit_at(u: str, h: int) -> dict | None:
             try:
-                with urllib.request.urlopen(
-                    f"{u}/gossip/commit_at?height={h}", timeout=5
-                ) as r:
-                    doc = json.loads(r.read())
-                return doc or None
+                return net.get(u, f"/gossip/commit_at?height={h}") or None
             except OSError:
                 return None
 
@@ -1257,17 +1259,14 @@ def _devnet_autonomous(args, privs, genesis) -> int:
                 tx = signer.create_tx(a0, [MsgSend(a0, a1, 1 + sent)],
                                       fee=2000, gas_limit=100_000)
                 try:
-                    req = urllib.request.Request(
-                        urls[sent % n] + "/broadcast_tx",
-                        data=json.dumps({"tx": base64.b64encode(
-                            tx.encode()).decode()}).encode(),
-                        headers={"Content-Type": "application/json"},
-                        method="POST",
+                    res = net.post(
+                        urls[sent % n], "/broadcast_tx",
+                        {"tx": base64.b64encode(tx.encode()).decode()},
+                        timeout=10,
                     )
-                    with urllib.request.urlopen(req, timeout=10) as r:
-                        if json.loads(r.read())["code"] == 0:
-                            signer.accounts[a0].sequence += 1
-                            sent += 1
+                    if res["code"] == 0:
+                        signer.accounts[a0].sequence += 1
+                        sent += 1
                 except OSError:
                     pass
             if lo >= target:
@@ -1614,7 +1613,6 @@ def cmd_das(args) -> int:
 
     if args.url:
         import base64 as b64
-        import urllib.error
 
         from celestia_app_tpu.client.tx_client import HttpNodeClient
         from celestia_app_tpu.da.dah import DataAvailabilityHeader
@@ -1640,20 +1638,19 @@ def cmd_das(args) -> int:
             # structural validation of UNTRUSTED input before anything
             # touches it (bounds, root shapes — dah.validate_basic)
             dah.validate_basic()
-        except (urllib.error.URLError, OSError, ValueError, KeyError) as e:
+        except (OSError, ValueError, KeyError) as e:
             return _unavailable(height, f"fetching DAH failed: {e}")
         if args.trusted_root:
             root_hex = args.trusted_root.lower()
         else:
             header_trusted = False  # bound only to the server's own header
             try:
-                import urllib.request
+                from celestia_app_tpu.net import transport
 
-                with urllib.request.urlopen(
-                    remote.base_url + f"/block/{height}", timeout=30
-                ) as r:
-                    root_hex = json.loads(r.read())["data_hash"]
-            except (urllib.error.URLError, OSError, ValueError, KeyError) as e:
+                root_hex = transport.request_json(
+                    remote.base_url, f"/block/{height}", timeout=30
+                )["data_hash"]
+            except (OSError, ValueError, KeyError) as e:
                 return _unavailable(height, f"fetching header failed: {e}")
         if dah.hash().hex() != root_hex:
             return _unavailable(

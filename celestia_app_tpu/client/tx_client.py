@@ -120,21 +120,20 @@ class HttpNodeClient:
     mode (pkg/user/tx_client.go:320-330 BroadcastMode_SYNC + Simulate)."""
 
     def __init__(self, base_url: str, timeout: float = 30.0):
+        from celestia_app_tpu.net.transport import (
+            PeerClient, TransportConfig,
+        )
+
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.client = PeerClient(
+            TransportConfig(timeout=timeout, retries=2),
+            name="tx-client",
+        )
 
     def _post(self, path: str, payload: dict) -> dict:
-        import json as json_mod
-        import urllib.request
-
-        req = urllib.request.Request(
-            self.base_url + path,
-            data=json_mod.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
-            method="POST",
-        )
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            return json_mod.loads(r.read())
+        return self.client.post(self.base_url, path, payload,
+                                timeout=self.timeout)
 
     def broadcast_tx(self, raw: bytes):
         import base64
@@ -174,13 +173,8 @@ class HttpNodeClient:
         return out
 
     def status(self) -> dict:
-        import json as json_mod
-        import urllib.request
-
-        with urllib.request.urlopen(
-            self.base_url + "/status", timeout=self.timeout
-        ) as r:
-            return json_mod.loads(r.read())
+        return self.client.get(self.base_url, "/status",
+                               timeout=self.timeout)
 
 
 class GrpcNodeClient:

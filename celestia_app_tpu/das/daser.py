@@ -40,12 +40,9 @@ from __future__ import annotations
 
 import base64
 import dataclasses
-import json
 import queue as queue_mod
 import threading
 import time
-import urllib.error
-import urllib.request
 
 import numpy as np
 
@@ -54,6 +51,7 @@ from celestia_app_tpu.chain import light as light_mod
 from celestia_app_tpu.da import fraud, repair, sampling
 from celestia_app_tpu.da.dah import DataAvailabilityHeader
 from celestia_app_tpu.das.checkpoint import Checkpoint, CheckpointStore
+from celestia_app_tpu.net.transport import PeerClient, TransportConfig
 from celestia_app_tpu.utils import nmt_host, telemetry
 
 
@@ -73,19 +71,31 @@ class DASerConfig:
 
 
 class PeerSet:
-    """Round-robin HTTP client over the sampler's peer URLs with
-    exponential backoff: each retry round tries EVERY peer once, so a
-    single withholding/flaky peer never decides availability while an
-    honest peer holds the data."""
+    """Round-robin rotation over the sampler's peer URLs ON TOP of the
+    shared hardened transport (net/transport.py): each retry round tries
+    EVERY peer once, so a single withholding/flaky peer never decides
+    availability while an honest peer holds the data. The per-peer
+    backoff/breaker/health machinery lives in the PeerClient — one
+    implementation shared with the reactor's gossip — while this class
+    keeps the DASer's rotation semantics and its `daser.requests` /
+    `daser.peer_errors` / `daser.retry_rounds` counters."""
 
     def __init__(self, urls: list[str], timeout: float = 5.0,
-                 retries: int = 3, backoff: float = 0.05):
+                 retries: int = 3, backoff: float = 0.05,
+                 client: PeerClient | None = None):
         if not urls:
             raise ValueError("PeerSet needs at least one peer URL")
         self.urls = [u.rstrip("/") for u in urls]
-        self.timeout = timeout
         self.retries = retries
         self.backoff = backoff
+        # one transport attempt per (peer, round): the ROTATION is this
+        # class's retry loop; a dead peer trips its breaker here exactly
+        # as it would under the reactor, and subsequent rounds skip it at
+        # BreakerOpen speed instead of paying connect timeouts
+        self.client = client or PeerClient(
+            TransportConfig(timeout=timeout, retries=1),
+            name="daser",
+        )
         self._i = 0
         self._lock = threading.Lock()
 
@@ -94,17 +104,6 @@ class PeerSet:
             start = self._i
             self._i = (self._i + 1) % len(self.urls)
         return self.urls[start:] + self.urls[:start]
-
-    def _one(self, url: str, path: str, payload: dict | None):
-        if payload is None:
-            req = urllib.request.Request(url + path)
-        else:
-            req = urllib.request.Request(
-                url + path, data=json.dumps(payload).encode(),
-                headers={"Content-Type": "application/json"}, method="POST",
-            )
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            return json.loads(r.read())
 
     def request(self, path: str, payload: dict | None = None):
         """GET (payload None) or POST `path`, rotating peers with
@@ -117,8 +116,8 @@ class PeerSet:
             for url in self._order():
                 try:
                     telemetry.incr("daser.requests")
-                    return self._one(url, path, payload)
-                except (urllib.error.URLError, OSError, ValueError) as e:
+                    return self.client.request(url, path, payload)
+                except (OSError, ValueError) as e:
                     telemetry.incr("daser.peer_errors")
                     last = f"{url}{path}: {type(e).__name__}: {e}"
             if attempt + 1 < self.retries:

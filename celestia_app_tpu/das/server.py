@@ -216,6 +216,15 @@ class SampleCore:
         if held and (row, col) in held:
             self._note(entry, withheld=1)
             raise SampleError(f"cell ({row}, {col}) not served")
+        # env/endpoint-armable twin of withhold(): a "drop"/"error" fault
+        # at das.serve_sample makes THIS node a withholding producer for
+        # matching cells without any in-process fixture access
+        from celestia_app_tpu import faults
+
+        if faults.fire("das.serve_sample", height=entry.height,
+                       row=row, col=col) in ("drop", "error"):
+            self._note(entry, withheld=1)
+            raise SampleError(f"cell ({row}, {col}) not served")
         if axis == "row":
             share, proof = entry.prover.prove_cell(row, col)
         else:

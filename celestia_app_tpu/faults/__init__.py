@@ -1,0 +1,336 @@
+"""Deterministic fault injection: named fault points, seeded actions.
+
+The chaos half of the robustness plane. Production code declares *fault
+points* — `faults.fire("consensus.post_wal_pre_apply", height=h)` — that
+are no-ops until a matching fault is ARMED. Arming happens three ways:
+
+- env: ``CELESTIA_FAULTS`` holds a JSON list of fault specs (or ``@path``
+  to a JSON file), read once at import — how chaos tests arm subprocess
+  validators at spawn. ``CELESTIA_FAULT_SEED`` seeds the registry rng.
+- admin endpoint: ``/faults/*`` on the node HTTP service AND the validator
+  consensus service (route_faults below) — how a chaos harness arms a
+  crash point on one validator of a LIVE devnet.
+- in-process: ``faults.arm(...)`` directly (unit tests, bench --chaos).
+
+Determinism: probabilistic faults draw from ONE ``random.Random(seed)``
+owned by the registry, so a fixed seed reproduces the exact trigger
+sequence — the property the chaos acceptance tests pin. Every trigger is
+counted in telemetry (``faults.<point>.<action>``) and in the spec's own
+``triggered`` counter (visible at GET /faults).
+
+Actions:
+  drop       caller discards the operation (transport: as if the send
+             never happened — the partition primitive)
+  delay      fire() sleeps ``delay_s`` before returning (slow network /
+             slow disk)
+  error      caller raises its domain error (transport: request failed;
+             storage: OSError)
+  duplicate  caller performs the operation twice (gossip amplification)
+  crash      fire() hard-kills the process (``os._exit(137)``) AT the
+             fault point — the crash-matrix primitive; recovery is the
+             restarted process's problem, which is the point
+
+The fault-point catalog (the names production code fires today):
+
+  net.request                   every outbound peer HTTP request
+                                (net/transport.py; ctx: owner, peer, path)
+  storage.atomic_write          chain/storage._atomic_write, before the
+                                tmp-file write (ctx: path)
+  consensus.wal_append          inside ValidatorNode.write_wal, after the
+                                fsync'd tmp but BEFORE the rename — a
+                                crash here leaves NO durable WAL record
+                                (recovery: peer catch-up)
+  consensus.post_wal_pre_apply  after the WAL record is durable, before
+                                evidence/finalize touch state (recovery:
+                                WAL replay)
+  consensus.post_apply_pre_latest
+                                in ChainDB.save_commit, after the commit
+                                artifact is durable but before the LATEST
+                                pointer (recovery: resume at height-1,
+                                then WAL replay)
+  das.serve_sample              das/server.py withholding hook (ctx:
+                                height, row, col) — the env-armable twin
+                                of SampleCore.withhold()
+
+docs/DESIGN.md "The fault plane" and docs/FORMATS.md §9 are the normative
+descriptions of the catalog and the /faults/* admin surface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import re
+import sys
+import threading
+
+from celestia_app_tpu.utils import telemetry
+
+ACTIONS = ("drop", "delay", "error", "duplicate", "crash")
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One armed fault. `point` is matched EXACTLY against the fired
+    point name; `match` holds regex filters over the fire() context
+    (e.g. {"peer": ":1234", "owner": "val[01]"}) — every filter must
+    search-match its context value (a missing context key never
+    matches). `count` bounds total triggers (None = unlimited)."""
+
+    fault_id: int
+    point: str
+    action: str
+    prob: float = 1.0
+    count: int | None = None
+    delay_s: float = 0.05
+    match: dict[str, str] = dataclasses.field(default_factory=dict)
+    triggered: int = 0
+
+    def matches(self, ctx: dict) -> bool:
+        for key, pattern in self.match.items():
+            val = ctx.get(key)
+            if val is None or not re.search(pattern, str(val)):
+                return False
+        return True
+
+    def to_json(self) -> dict:
+        return {
+            "id": self.fault_id,
+            "point": self.point,
+            "action": self.action,
+            "prob": self.prob,
+            "count": self.count,
+            "delay_s": self.delay_s,
+            "match": dict(self.match),
+            "triggered": self.triggered,
+        }
+
+
+class FaultRegistry:
+    """Process-wide fault-point registry (module singleton below). All
+    mutation and firing is lock-guarded: fault points sit on hot
+    network/disk paths touched from many threads."""
+
+    def __init__(self, seed: int | None = None):
+        self._lock = threading.Lock()
+        self._specs: dict[int, FaultSpec] = {}
+        self._next_id = 1
+        self._rng = random.Random(seed)
+        self._fired: dict[str, int] = {}  # per-point trigger counts
+
+    # -- arming -----------------------------------------------------------
+
+    def arm(self, point: str, action: str, *, prob: float = 1.0,
+            count: int | None = None, delay_s: float = 0.05,
+            match: dict[str, str] | None = None) -> int:
+        if action not in ACTIONS:
+            raise ValueError(
+                f"unknown fault action {action!r}; one of {ACTIONS}"
+            )
+        if not point:
+            raise ValueError("fault point name required")
+        # validate match regexes HERE (a 400 at the admin endpoint), not
+        # at fire() time — a malformed pattern raising re.error inside a
+        # production hot path would kill sender threads, not chaos tests
+        for key, pattern in (match or {}).items():
+            try:
+                re.compile(pattern)
+            except re.error as e:
+                raise ValueError(
+                    f"bad match regex for {key!r}: {e}"
+                ) from None
+        with self._lock:
+            fid = self._next_id
+            self._next_id += 1
+            self._specs[fid] = FaultSpec(
+                fault_id=fid, point=point, action=action,
+                prob=float(prob),
+                count=None if count is None else int(count),
+                delay_s=float(delay_s), match=dict(match or {}),
+            )
+        return fid
+
+    def disarm(self, fault_id: int | None = None,
+               point: str | None = None) -> int:
+        """Disarm by id, by point name, or (neither given) everything.
+        Returns how many specs were removed."""
+        with self._lock:
+            if fault_id is not None:
+                return 1 if self._specs.pop(int(fault_id), None) else 0
+            victims = [
+                fid for fid, s in self._specs.items()
+                if point is None or s.point == point
+            ]
+            for fid in victims:
+                del self._specs[fid]
+            return len(victims)
+
+    def reset(self, seed: int | None = None) -> None:
+        """Disarm everything and reseed (chaos-test isolation)."""
+        with self._lock:
+            self._specs.clear()
+            self._rng = random.Random(seed)
+            self._fired.clear()
+
+    def reseed(self, seed: int | None) -> None:
+        with self._lock:
+            self._rng = random.Random(seed)
+
+    # -- firing -----------------------------------------------------------
+
+    def fire(self, point: str, **ctx) -> str | None:
+        """Called AT a fault point. Returns the action the caller must
+        honor ("drop" / "error" / "duplicate"), or None when no armed
+        fault triggers. "delay" faults STACK: every matching delay
+        sleeps here (the caller proceeds normally, late) and scanning
+        continues, so a standing delay never shadows a later-armed
+        terminal fault at the same point; the first matching
+        drop/error/duplicate/crash wins. "crash" never returns."""
+        # lock-free hot-path exit: a GIL-atomic emptiness read — nothing
+        # armed is the overwhelmingly common production state, and every
+        # outbound request / WAL append / atomic write across all threads
+        # passes through here (worst case: one benignly missed
+        # just-armed fault)
+        if not self._specs:
+            return None
+        delay_total = 0.0
+        terminal = None
+        with self._lock:
+            if not self._specs:
+                return None
+            for s in self._specs.values():
+                if s.point != point or not s.matches(ctx):
+                    continue
+                if s.count is not None and s.triggered >= s.count:
+                    continue
+                if s.prob < 1.0 and self._rng.random() >= s.prob:
+                    continue
+                s.triggered += 1
+                self._fired[point] = self._fired.get(point, 0) + 1
+                if s.action == "delay":
+                    delay_total += s.delay_s
+                    continue
+                terminal = s.action
+                break
+        if delay_total > 0.0:
+            telemetry.incr(f"faults.{point}.delay")
+        if terminal is None:
+            if delay_total > 0.0:
+                import time
+
+                time.sleep(delay_total)
+            return None
+        telemetry.incr(f"faults.{point}.{terminal}")
+        if terminal == "crash":
+            print(f"[faults] CRASH at {point} ({ctx})", file=sys.stderr,
+                  flush=True)
+            os._exit(137)
+        if delay_total > 0.0:
+            import time
+
+            time.sleep(delay_total)
+        return terminal
+
+    # -- introspection ----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "armed": [s.to_json() for s in self._specs.values()],
+                "fired": dict(self._fired),
+            }
+
+    def armed_count(self) -> int:
+        with self._lock:
+            return len(self._specs)
+
+
+# ---------------------------------------------------------------------------
+# module singleton + env arming
+# ---------------------------------------------------------------------------
+
+REGISTRY = FaultRegistry(
+    seed=int(os.environ["CELESTIA_FAULT_SEED"])
+    if os.environ.get("CELESTIA_FAULT_SEED") else None
+)
+
+arm = REGISTRY.arm
+disarm = REGISTRY.disarm
+reset = REGISTRY.reset
+fire = REGISTRY.fire
+snapshot = REGISTRY.snapshot
+
+
+def arm_from_spec(specs: list[dict], registry: FaultRegistry = REGISTRY,
+                  ) -> list[int]:
+    """Arm a JSON spec list (the env / admin-endpoint / faults.json
+    shape): [{"point": ..., "action": ..., "prob"?, "count"?,
+    "delay_s"?, "match"?}, ...]."""
+    out = []
+    for doc in specs:
+        out.append(registry.arm(
+            doc["point"], doc["action"],
+            prob=doc.get("prob", 1.0),
+            count=doc.get("count"),
+            delay_s=doc.get("delay_s", 0.05),
+            match=doc.get("match"),
+        ))
+    return out
+
+
+def arm_from_env(registry: FaultRegistry = REGISTRY) -> int:
+    """CELESTIA_FAULTS = JSON list, or @/path/to/specs.json. Malformed
+    env is a loud refusal (a chaos run silently not armed would report
+    fake resilience), but never fatal to the process."""
+    raw = os.environ.get("CELESTIA_FAULTS", "").strip()
+    if not raw:
+        return 0
+    try:
+        if raw.startswith("@"):
+            with open(raw[1:]) as f:
+                specs = json.load(f)
+        else:
+            specs = json.loads(raw)
+        if not isinstance(specs, list):
+            raise ValueError("CELESTIA_FAULTS must be a JSON list")
+        return len(arm_from_spec(specs, registry))
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        print(f"[faults] CELESTIA_FAULTS ignored ({type(e).__name__}: {e})",
+              file=sys.stderr, flush=True)
+        return 0
+
+
+_ENV_ARMED = arm_from_env()
+
+
+# ---------------------------------------------------------------------------
+# the /faults/* admin surface (one router shared by the node HTTP service
+# and the validator consensus service)
+# ---------------------------------------------------------------------------
+
+
+def route_faults(method: str, path: str, payload: dict | None = None) -> dict:
+    """Dispatch a /faults request. Raises ValueError on client mistakes
+    (the servers map that to 400).
+
+      GET  /faults                 -> {"armed": [...], "fired": {...}}
+      POST /faults/arm   {point, action, prob?, count?, delay_s?, match?}
+                                   -> {"id": n}
+      POST /faults/disarm {id} | {point} | {}   -> {"disarmed": n}
+      POST /faults/reset {seed?}   -> {"ok": true}
+    """
+    payload = payload or {}
+    if method == "GET" and path == "/faults":
+        return snapshot()
+    if method == "POST" and path == "/faults/arm":
+        fid = arm_from_spec([payload])[0]
+        return {"id": fid}
+    if method == "POST" and path == "/faults/disarm":
+        n = disarm(fault_id=payload.get("id"), point=payload.get("point"))
+        return {"disarmed": n}
+    if method == "POST" and path == "/faults/reset":
+        REGISTRY.reset(seed=payload.get("seed"))
+        return {"ok": True}
+    raise ValueError(f"no fault route {method} {path}")

@@ -1,0 +1,8 @@
+"""Peer networking: the one hardened HTTP transport (net/transport.py)."""
+
+from celestia_app_tpu.net.transport import (  # noqa: F401
+    BreakerOpen,
+    PeerClient,
+    TransportConfig,
+    TransportError,
+)

@@ -1,0 +1,312 @@
+"""THE hardened peer HTTP transport: timeouts, retries, circuit breakers.
+
+Before this module, raw ``urllib.request.urlopen`` calls were scattered
+across eight modules with divergent timeout/retry behavior and no memory
+of peer health: a dead peer cost every caller a fresh connect timeout on
+every attempt, forever. This is the one client every peer-facing HTTP
+call goes through (a tier-1 lint test enforces it), giving the whole
+process:
+
+- **per-request timeouts** — every request has one; no unbounded waits.
+- **bounded retries with jittered exponential backoff** — retry storms
+  against a struggling peer are the classic self-inflicted outage; the
+  jitter decorrelates the fleet.
+- **a per-peer circuit breaker** (closed -> open -> half-open): after
+  ``failure_threshold`` consecutive failures the peer's circuit OPENS and
+  requests fail instantly (``BreakerOpen``) without touching the socket;
+  after ``reset_timeout`` ONE probe request is let through (half-open) —
+  success closes the circuit, failure re-opens it. The reference's p2p
+  layer gets the same effect from peer eviction + reconnect backoff.
+- **peer health scoring** — per-peer success/failure counts, consecutive-
+  failure streak, EWMA latency, last error; ``snapshot()`` feeds the
+  ``net`` block of ``/consensus/status`` (docs/FORMATS.md §9).
+- **telemetry** — ``net.requests`` / ``net.failures`` /
+  ``net.breaker_open`` / ``net.breaker_rejected`` / ``net.retries``
+  counters plus per-client latency timers, all in the global registry.
+- **fault injection** — every outbound request passes the
+  ``net.request`` fault point (celestia_app_tpu/faults) with context
+  ``{owner, peer, path}``: armed drop/delay/error/duplicate faults act
+  HERE, so chaos tests partition and degrade real nodes without touching
+  the network stack.
+
+Error contract: transport-level failures (refused, timeout, DNS, garbled
+body, injected faults, open breakers) raise ``TransportError`` (an
+``OSError`` — existing ``except OSError`` callers keep working;
+``BreakerOpen`` subclasses it). An HTTP *status* error means the peer is
+ALIVE and answering — it counts as peer health success and propagates as
+``urllib.error.HTTPError`` for callers that read error bodies (the
+relayer's 404-means-absent probe, remote_consensus's refusal mapping).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from celestia_app_tpu import faults
+from celestia_app_tpu.utils import telemetry
+
+
+class TransportError(OSError):
+    """A request failed at the transport level after all retries."""
+
+
+class BreakerOpen(TransportError):
+    """The peer's circuit is open: failed fast, no I/O attempted."""
+
+
+@dataclasses.dataclass
+class TransportConfig:
+    timeout: float = 5.0          # per-request socket timeout (seconds)
+    retries: int = 2              # attempts per request() call
+    backoff: float = 0.05         # base sleep between attempts (doubles)
+    backoff_max: float = 2.0      # backoff ceiling
+    jitter: float = 0.25          # +/- fraction of the backoff, decorrelates
+    failure_threshold: int = 5    # consecutive failures -> breaker opens
+    reset_timeout: float = 3.0    # open -> half-open probe window
+
+
+class _PeerState:
+    """Health record + breaker state for one peer URL (lock: the owning
+    PeerClient's)."""
+
+    __slots__ = ("state", "successes", "failures", "consecutive",
+                 "opened_at", "latency_ms", "last_error", "probing")
+
+    def __init__(self):
+        self.state = "closed"        # closed | open | half-open
+        self.successes = 0
+        self.failures = 0
+        self.consecutive = 0         # consecutive failures
+        self.opened_at = 0.0
+        self.latency_ms = None       # EWMA over successful requests
+        self.last_error = None
+        self.probing = False         # a half-open probe is in flight
+
+    def to_json(self) -> dict:
+        return {
+            "state": self.state,
+            "successes": self.successes,
+            "failures": self.failures,
+            "consecutive_failures": self.consecutive,
+            "latency_ms": round(self.latency_ms, 3)
+            if self.latency_ms is not None else None,
+            "last_error": self.last_error,
+        }
+
+
+class PeerClient:
+    """One hardened HTTP client; holds per-peer breaker/health state, so
+    components that talk to the same peers repeatedly (the reactor, the
+    DASer's PeerSet, an orchestrator) should share one instance across
+    their requests. `name` tags telemetry and the fault context (chaos
+    specs match on it to scope faults to one node of an in-process net)."""
+
+    def __init__(self, cfg: TransportConfig | None = None,
+                 name: str = "peer"):
+        self.cfg = cfg or TransportConfig()
+        self.name = name
+        self._peers: dict[str, _PeerState] = {}
+        self._lock = threading.Lock()
+        # jitter entropy only — never consulted by fault injection, so a
+        # seeded fault run stays deterministic regardless of this rng
+        self._rng = random.Random()
+
+    # -- breaker gate -----------------------------------------------------
+
+    def _peer(self, url: str) -> _PeerState:
+        st = self._peers.get(url)
+        if st is None:
+            st = self._peers[url] = _PeerState()
+        return st
+
+    def available(self, url: str) -> bool:
+        """True when a request to `url` would be ATTEMPTED (circuit
+        closed, half-open, or open-but-probe-eligible). Send loops use
+        this to skip an open peer without paying even the fast
+        BreakerOpen raise per queued message."""
+        url = url.rstrip("/")
+        with self._lock:
+            st = self._peers.get(url)
+            if st is None or st.state != "open":
+                return True
+            return time.monotonic() - st.opened_at >= self.cfg.reset_timeout
+
+    def _admit(self, url: str) -> bool:
+        """Breaker admission for one attempt. Returns True when this
+        attempt is the half-open probe (so failure handling re-opens
+        rather than merely counting)."""
+        with self._lock:
+            st = self._peer(url)
+            if st.state == "closed":
+                return False
+            if st.state == "open":
+                if (time.monotonic() - st.opened_at
+                        < self.cfg.reset_timeout):
+                    telemetry.incr("net.breaker_rejected")
+                    raise BreakerOpen(
+                        f"{self.name}: circuit open for {url} "
+                        f"(last: {st.last_error})"
+                    )
+                st.state = "half-open"
+                st.probing = True
+                return True
+            # half-open: exactly one probe in flight
+            if st.probing:
+                telemetry.incr("net.breaker_rejected")
+                raise BreakerOpen(
+                    f"{self.name}: half-open probe in flight for {url}"
+                )
+            st.probing = True
+            return True
+
+    def _record_success(self, url: str, dt_ms: float) -> None:
+        with self._lock:
+            st = self._peer(url)
+            st.successes += 1
+            st.consecutive = 0
+            st.probing = False
+            if st.state != "closed":
+                telemetry.incr("net.breaker_closed")
+            st.state = "closed"
+            st.latency_ms = dt_ms if st.latency_ms is None else (
+                0.8 * st.latency_ms + 0.2 * dt_ms
+            )
+
+    def _record_failure(self, url: str, err: str, probe: bool) -> None:
+        with self._lock:
+            st = self._peer(url)
+            st.failures += 1
+            st.consecutive += 1
+            st.last_error = err[:200]
+            st.probing = False
+            if probe or st.consecutive >= self.cfg.failure_threshold:
+                if st.state != "open":
+                    telemetry.incr("net.breaker_open")
+                st.state = "open"
+                st.opened_at = time.monotonic()
+        telemetry.incr("net.failures")
+
+    # -- the request path -------------------------------------------------
+
+    def _one(self, url: str, path: str, payload, timeout: float,
+             raw: bool):
+        if payload is None:
+            req = urllib.request.Request(url + path)
+        else:
+            req = urllib.request.Request(
+                url + path, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            body = r.read()
+        return body if raw else json.loads(body)
+
+    def request(self, url: str, path: str, payload: dict | None = None,
+                *, timeout: float | None = None, retries: int | None = None,
+                raw: bool = False):
+        """GET (payload None) or JSON POST ``url + path``; returns the
+        parsed JSON body (bytes with ``raw=True``). Raises BreakerOpen /
+        TransportError / urllib.error.HTTPError per the module error
+        contract."""
+        url = url.rstrip("/")
+        timeout = self.cfg.timeout if timeout is None else timeout
+        attempts = max(1, self.cfg.retries if retries is None else retries)
+        delay = self.cfg.backoff
+        last = "no attempt"
+        for attempt in range(attempts):
+            probe = self._admit(url)  # raises BreakerOpen when rejected
+            t0 = time.perf_counter()
+            try:
+                action = faults.fire("net.request", owner=self.name,
+                                     peer=url, path=path)
+                if action in ("drop", "error"):
+                    # drop: the bytes never leave this process; error: the
+                    # peer "answered garbage" — both are transport
+                    # failures to the caller and to peer health
+                    raise TransportError(
+                        f"injected fault: {action} {url}{path}"
+                    )
+                out = self._one(url, path, payload, timeout, raw)
+                if action == "duplicate":
+                    out = self._one(url, path, payload, timeout, raw)
+            except urllib.error.HTTPError as e:
+                # an HTTP status error is an ANSWER: the peer is alive
+                self._record_success(
+                    url, (time.perf_counter() - t0) * 1e3
+                )
+                telemetry.incr("net.requests")
+                raise e
+            except (urllib.error.URLError, OSError, ValueError,
+                    TimeoutError, http.client.HTTPException) as e:
+                # HTTPException: a garbled/torn HTTP response (e.g.
+                # BadStatusLine) — NOT an OSError subclass, but the same
+                # transport-failure class; it must feed the breaker, not
+                # escape and wedge a half-open probe
+                last = f"{type(e).__name__}: {e}"
+                self._record_failure(url, last, probe)
+                if attempt + 1 < attempts and self.available(url):
+                    telemetry.incr("net.retries")
+                    jit = 1.0 + self.cfg.jitter * (
+                        2.0 * self._rng.random() - 1.0
+                    )
+                    time.sleep(min(delay, self.cfg.backoff_max) * jit)
+                    delay *= 2
+                continue
+            except BaseException as e:
+                # unexpected escape (programming error, non-serializable
+                # payload, injected chaos): record it so a granted
+                # half-open probe can never stay "in flight" forever and
+                # wedge the peer in BreakerOpen
+                self._record_failure(
+                    url, f"{type(e).__name__}: {e}", probe
+                )
+                raise
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            self._record_success(url, dt_ms)
+            telemetry.incr("net.requests")
+            telemetry.measure_since(f"net.{self.name}.request",
+                                    t0)
+            return out
+        raise TransportError(
+            f"{self.name}: {url}{path} failed after {attempts} "
+            f"attempt(s): {last}"
+        )
+
+    def get(self, url: str, path: str, **kw):
+        return self.request(url, path, None, **kw)
+
+    def post(self, url: str, path: str, payload: dict, **kw):
+        return self.request(url, path, payload, **kw)
+
+    # -- health surface ---------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """{peer_url: health} — the ``net`` block of /consensus/status."""
+        with self._lock:
+            return {u: st.to_json() for u, st in self._peers.items()}
+
+    def reset_peer(self, url: str) -> None:
+        with self._lock:
+            self._peers.pop(url.rstrip("/"), None)
+
+
+# Shared default client for one-shot tooling (CLI subcommands, scripts)
+# that has no long-lived component to hang peer state off of. Components
+# with real peer relationships (reactor, DASer, orchestrator) own their
+# instances so their health state is per-component and inspectable.
+DEFAULT = PeerClient(name="default")
+
+
+def request_json(url: str, path: str = "", payload: dict | None = None,
+                 *, timeout: float = 10.0, retries: int = 1):
+    """One-shot convenience over the shared DEFAULT client."""
+    return DEFAULT.request(url, path, payload, timeout=timeout,
+                           retries=retries)

@@ -19,6 +19,8 @@ Endpoints:
   POST /da/prove_shares  {...}         share-range proof (§7.1.7 shim)
   GET  /das/head | /das/header | /das/sample | /das/availability
   POST /das/samples                    DAS sample serving (das/server.py)
+  GET  /faults                         fault-plane admin (armed + fired)
+  POST /faults/arm|disarm|reset        arm/disarm fault points (chaos)
 """
 
 from __future__ import annotations
@@ -132,6 +134,12 @@ class NodeService:
                         except SampleError as e:
                             self._send(404 if "not served" in str(e)
                                        else 400, {"error": str(e)})
+                    elif self.path == "/faults":
+                        # fault-plane admin (celestia_app_tpu/faults):
+                        # armed specs + per-point fire counts
+                        from celestia_app_tpu.faults import route_faults
+
+                        self._send(200, route_faults("GET", self.path))
                     elif self.path.startswith("/block/"):
                         height = int(self.path.split("/")[2])
                         blk = service.node.app.db.load_block(height)
@@ -221,6 +229,16 @@ class NodeService:
                         except SampleError as e:
                             self._send(404 if "not served" in str(e)
                                        else 400, {"error": str(e)})
+                    elif self.path.startswith("/faults/"):
+                        # arm/disarm/reset fault points on a LIVE node —
+                        # the chaos harness's runtime switchboard
+                        from celestia_app_tpu.faults import route_faults
+
+                        try:
+                            self._send(200, route_faults(
+                                "POST", self.path, payload))
+                        except (ValueError, KeyError) as e:
+                            self._send(400, {"error": str(e)})
                     elif self.path == "/ibc/prove":
                         # membership/absence proof of a raw store key: the
                         # relayer's proof source (public data — any light

@@ -35,6 +35,12 @@ starve vote intake):
   POST /gossip/seen_tx {hash, from} CAT SeenTx announce (want/have gossip)
   GET  /gossip/want_tx?hash=H       CAT WantTx pull -> {tx: b64} delivery
   POST /gossip/tx {tx: b64}         direct Tx push (legacy flood delivery)
+
+Fault-plane admin (celestia_app_tpu/faults; docs/FORMATS.md §9):
+  GET  /faults                      armed fault specs + per-point fire counts
+  POST /faults/arm {point, action, ...}   arm a fault; -> {id}
+  POST /faults/disarm {id|point}    disarm one / by point / all
+  POST /faults/reset {seed?}        disarm everything and reseed the rng
 """
 
 from __future__ import annotations
@@ -72,6 +78,13 @@ class ValidatorService:
                     if self.path == "/consensus/status":
                         with service.lock:
                             self._send(200, service._status())
+                    elif self.path == "/faults":
+                        # fault-plane admin surface (celestia_app_tpu/
+                        # faults): chaos harnesses inspect and arm fault
+                        # points on a LIVE validator through it
+                        from celestia_app_tpu.faults import route_faults
+
+                        self._send(200, route_faults("GET", self.path))
                     elif self.path.startswith("/gossip/commit_at"):
                         from urllib.parse import parse_qs, urlparse
 
@@ -143,6 +156,17 @@ class ValidatorService:
                             return
                         self._send(200, {"ok": True})
                         return
+                    if self.path.startswith("/faults/"):
+                        from celestia_app_tpu.faults import route_faults
+
+                        try:
+                            self._send(200, route_faults(
+                                "POST", self.path, payload))
+                        except (ValueError, KeyError) as e:
+                            # malformed spec: 400, matching the node
+                            # service (FORMATS.md §9.1)
+                            self._send(400, {"error": str(e)})
+                        return
                     route = {
                         "/broadcast_tx": service._broadcast_tx,
                         "/consensus/propose": service._propose,
@@ -189,8 +213,13 @@ class ValidatorService:
                 "round": self.reactor.round,
                 "step": self.reactor.step,
                 "height_view": self.reactor.height_view,
+                "loop_errors": self.reactor.loop_errors,
             }
             out["mempool_gossip"] = dict(self.reactor.mempool_gossip.stats)
+            # per-peer transport health: breaker state, success/failure
+            # tallies, EWMA latency (net/transport.py; FORMATS.md §9) —
+            # how an operator (and the chaos tests) see a tripped breaker
+            out["net"] = self.reactor.net.snapshot()
         return out
 
     def attach_reactor(self, peer_urls: list[str], config=None,
@@ -299,12 +328,11 @@ class ValidatorService:
     def _sync(self, p: dict) -> dict:
         """State-sync catch-up over the wire: pull a peer's snapshot and
         adopt it after chunk-hash + app-hash verification."""
-        import urllib.request
+        from celestia_app_tpu.net import transport
 
-        with urllib.request.urlopen(
-            p["peer"].rstrip("/") + "/consensus/snapshot", timeout=30
-        ) as r:
-            doc = json.loads(r.read())
+        doc = transport.request_json(
+            p["peer"], "/consensus/snapshot", timeout=30
+        )
         chunks = [base64.b64decode(ch) for ch in doc["chunks"]]
         before = self.vnode.app.height
         c.state_sync_bootstrap(self.vnode, doc["manifest"], chunks)

@@ -28,21 +28,22 @@ import random
 import sys
 import threading
 import time
-import urllib.request
+
+from celestia_app_tpu.net.transport import PeerClient, TransportConfig
+
+# one shared hardened client for the whole harness (load thread + watch
+# loop): a validator that dies mid-bench trips its breaker once instead
+# of costing every poll a connect timeout
+_NET = PeerClient(TransportConfig(timeout=10.0, retries=1),
+                  name="e2e-bench")
 
 
 def _post(url: str, path: str, payload: dict, timeout: float = 30.0) -> dict:
-    req = urllib.request.Request(
-        url + path, data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"}, method="POST",
-    )
-    with urllib.request.urlopen(req, timeout=timeout) as r:
-        return json.loads(r.read())
+    return _NET.post(url, path, payload, timeout=timeout)
 
 
 def _get(url: str, path: str, timeout: float = 10.0):
-    with urllib.request.urlopen(url + path, timeout=timeout) as r:
-        return json.loads(r.read())
+    return _NET.get(url, path, timeout=timeout)
 
 
 class BlobLoad(threading.Thread):

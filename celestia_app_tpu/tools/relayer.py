@@ -31,9 +31,9 @@ import base64
 import dataclasses
 import json
 import urllib.error
-import urllib.request
 
 from celestia_app_tpu.chain.ibc import ChannelKeeper
+from celestia_app_tpu.net.transport import PeerClient, TransportConfig
 from celestia_app_tpu.chain.state import (
     Context,
     InfiniteGasMeter,
@@ -203,20 +203,23 @@ class HttpChainHandle:
     client_id: str
     verifying: bool = True  # see ChainHandle: say-so relay is opt-in
     timeout: float = 15.0
+    # the hardened transport (net/transport.py); HTTP status errors still
+    # propagate as HTTPError — has_commitment reads 404-means-absent
+    client: PeerClient = None
+
+    def __post_init__(self):
+        if self.client is None:
+            self.client = PeerClient(
+                TransportConfig(timeout=self.timeout, retries=2),
+                name="relayer",
+            )
 
     def _get(self, path: str):
-        with urllib.request.urlopen(self.url.rstrip("/") + path,
-                                    timeout=self.timeout) as r:
-            return json.loads(r.read())
+        return self.client.get(self.url, path, timeout=self.timeout)
 
     def _post(self, path: str, payload: dict):
-        req = urllib.request.Request(
-            self.url.rstrip("/") + path,
-            data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"}, method="POST",
-        )
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            return json.loads(r.read())
+        return self.client.post(self.url, path, payload,
+                                timeout=self.timeout)
 
     def height(self) -> int:
         return self._get("/status")["height"]
