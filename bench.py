@@ -623,6 +623,9 @@ def main() -> None:
     if "--chaos" in sys.argv:
         measure_chaos()
         return
+    if "--obs" in sys.argv:
+        measure_obs()
+        return
     if "--stream-mesh" in sys.argv:
         measure_stream_mesh()
         return
@@ -722,6 +725,81 @@ def measure_mempool(n_senders: int = 16, txs_per_sender: int = 32) -> None:
         "value": round(min(reap_ms), 3),
         "unit": "ms",
         "pool_count": len(reaped),
+    }))
+
+
+def measure_obs(blocks: int = 40, senders: int = 8) -> None:
+    """Observability-plane overhead bench (--obs): the produce-block hot
+    path with full span + histogram instrumentation vs the same path with
+    spans disabled (the CELESTIA_OBS=off gate, flipped in-process via
+    obs.set_enabled). One BENCH JSON line:
+
+      {"metric": "obs_overhead_pct", ...}
+
+    Each measured block carries real ante-checked MsgSend txs so the
+    denominator is a representative block, not an empty square."""
+    from celestia_app_tpu import obs as obs_mod
+    from celestia_app_tpu.chain.app import App
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.chain.tx import MsgSend
+    from celestia_app_tpu.client.tx_client import Signer
+
+    privs = [PrivateKey.from_seed(b"obs-%d" % i) for i in range(senders)]
+    addrs = [p.public_key().address() for p in privs]
+
+    def run(n_blocks: int) -> float:
+        """Fresh node; per-block ms over n_blocks tx-bearing blocks."""
+        app = App(chain_id="obs-bench", engine="host")
+        app.init_chain({
+            "time_unix": 1_700_000_000.0,
+            "accounts": [
+                {"address": a.hex(), "balance": 10**12} for a in addrs
+            ],
+            "validators": [{"operator": addrs[0].hex(), "power": 10}],
+        })
+        node = Node(app)
+        signer = Signer("obs-bench")
+        for i, p in enumerate(privs):
+            signer.add_account(p, number=i)
+
+        def submit_round():
+            for i, a in enumerate(addrs):
+                tx = signer.create_tx(
+                    a, [MsgSend(a, addrs[(i + 1) % senders], 1)],
+                    fee=2000, gas_limit=100_000,
+                )
+                signer.accounts[a].sequence += 1
+                node.broadcast_tx(tx.encode())
+
+        t_block = 1_700_000_001.0
+        submit_round()
+        node.produce_block(t=t_block)  # warm caches outside the clock
+        t0 = time.perf_counter()
+        for _ in range(n_blocks):
+            t_block += 1.0
+            submit_round()
+            node.produce_block(t=t_block)
+        return (time.perf_counter() - t0) / n_blocks * 1e3
+
+    # off first, then on: any residual warm-up penalizes the
+    # INSTRUMENTED side, keeping the reported overhead conservative
+    obs_mod.set_enabled(False)
+    try:
+        off_ms = min(run(blocks) for _ in range(3))
+        obs_mod.set_enabled(True)
+        on_ms = min(run(blocks) for _ in range(3))
+    finally:
+        obs_mod.set_enabled(None)  # back to the CELESTIA_OBS env gate
+    overhead_pct = (on_ms - off_ms) / off_ms * 100.0
+    print(json.dumps({
+        "metric": "obs_overhead_pct",
+        "value": round(overhead_pct, 2),
+        "unit": "%",
+        "instrumented_ms_per_block": round(on_ms, 3),
+        "off_ms_per_block": round(off_ms, 3),
+        "blocks": blocks,
+        "txs_per_block": senders,
     }))
 
 

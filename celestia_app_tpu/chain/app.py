@@ -25,6 +25,7 @@ import dataclasses
 import time as time_mod
 
 from celestia_app_tpu import appconsts
+from celestia_app_tpu import obs
 from celestia_app_tpu.chain import ante as ante_mod
 from celestia_app_tpu.chain import blobstream as blobstream_mod
 from celestia_app_tpu.chain import gov as gov_mod
@@ -122,15 +123,12 @@ class App:
                 != appconsts.DEFAULT_UPGRADE_HEIGHT_DELAY):
             # loud, per ADVICE r5: a delay override is consensus-critical
             # — every validator in the network must carry the same one
-            import sys as _sys
-
-            print(
-                f"[{chain_id}] WARNING: upgrade_height_delay override "
-                f"active ({upgrade_height_delay} blocks, default "
-                f"{appconsts.DEFAULT_UPGRADE_HEIGHT_DELAY}); every "
-                "validator must be provisioned identically or the "
-                "network forks at the x/signal flip",
-                file=_sys.stderr, flush=True,
+            obs.get_logger("chain.app").warning(
+                "upgrade_height_delay override active; every validator "
+                "must be provisioned identically or the network forks "
+                "at the x/signal flip",
+                chain_id=chain_id, delay=upgrade_height_delay,
+                default=appconsts.DEFAULT_UPGRADE_HEIGHT_DELAY,
             )
         self.signal = modules.SignalKeeper(
             self.staking, upgrade_height_delay=upgrade_height_delay
@@ -368,7 +366,12 @@ class App:
 
     def _data_root(self, square: square_mod.Square) -> tuple[dah_mod.DataAvailabilityHeader, bytes]:
         ods = dah_mod.shares_to_ods(square.share_bytes())
-        rows, cols, root = self._pipeline(ods)
+        # one span covers the fused device program: RS extension + NMT
+        # axis roots + data root land in a single dispatch (da/eds.py),
+        # so finer stage attribution needs /debug/profile, not spans
+        with obs.span("da.extend_shares", k=square.size,
+                      engine=self.engine, stages="extend+nmt+root"):
+            rows, cols, root = self._pipeline(ods)
         return dah_mod.DataAvailabilityHeader(tuple(rows), tuple(cols)), root
 
     # ------------------------------------------------------------------
@@ -483,6 +486,20 @@ class App:
         _t0 = time_mod.perf_counter()
         t = t if t is not None else time_mod.time()
         height = self.height + 1
+        # root span of the block lifecycle: the trace id derives from
+        # (chain_id, height), so followers and DAS light nodes stamp the
+        # SAME id with no coordination (docs/DESIGN.md observability)
+        with obs.span(
+            "prepare_proposal", traces=self.traces,
+            trace_id=obs.trace_id_for(self.chain_id, height),
+            height=height, n_candidates=len(raw_txs),
+        ) as sp:
+            out = self._prepare_inner(raw_txs, proposer, t, height, sp)
+        telemetry.measure_since("prepare_proposal", _t0)
+        return out
+
+    def _prepare_inner(self, raw_txs: list[bytes], proposer: bytes,
+                       t: float, height: int, sp) -> ProposalResult:
         threshold = appconsts.subtree_root_threshold(self.app_version)
 
         # Split first; ante-filter ALL normal txs before ANY blob tx, exactly
@@ -547,17 +564,20 @@ class App:
         )
         # square.build may drop txs; admission (sequence chain) depends on the
         # final tx set, so re-filter and rebuild until a fixed point.
-        while True:
-            square = square_mod.build(
-                normal_txs, [e for _, e in kept_blobs], max_sq, threshold
-            )
-            kept_tx_set = set(square.txs)
-            kept_pfb_set = {e.tx for e in square.pfbs}
-            next_normals = [r for r in normal_txs if r in kept_tx_set]
-            next_blobs = [(r, e) for r, e in kept_blobs if e.tx in kept_pfb_set]
-            if len(next_normals) == len(normal_txs) and len(next_blobs) == len(kept_blobs):
-                break
-            normal_txs, kept_blobs = ante_filter(next_normals, next_blobs)
+        with obs.span("square.build"):
+            while True:
+                square = square_mod.build(
+                    normal_txs, [e for _, e in kept_blobs], max_sq, threshold
+                )
+                kept_tx_set = set(square.txs)
+                kept_pfb_set = {e.tx for e in square.pfbs}
+                next_normals = [r for r in normal_txs if r in kept_tx_set]
+                next_blobs = [(r, e) for r, e in kept_blobs
+                              if e.tx in kept_pfb_set]
+                if (len(next_normals) == len(normal_txs)
+                        and len(next_blobs) == len(kept_blobs)):
+                    break
+                normal_txs, kept_blobs = ante_filter(next_normals, next_blobs)
         kept_blob_raws = [r for r, _ in kept_blobs]
         d, root = self._data_root(square)
 
@@ -574,7 +594,7 @@ class App:
             validators_hash=self._validators_hash(),
         )
         block = Block(header=header, txs=tuple(square.txs + kept_blob_raws))
-        telemetry.measure_since("prepare_proposal", _t0)
+        sp.set(n_txs=len(block.txs), square_size=square.size)
         return ProposalResult(block=block, square=square, dah=d)
 
     def _validators_hash(self) -> bytes:
@@ -594,7 +614,13 @@ class App:
         (process_proposal.go:29-35 defer/recover)."""
         _t0 = time_mod.perf_counter()
         try:
-            self._process_proposal_inner(block)
+            with obs.span(
+                "process_proposal", traces=self.traces,
+                trace_id=obs.trace_id_for(self.chain_id,
+                                          block.header.height),
+                height=block.header.height, n_txs=len(block.txs),
+            ):
+                self._process_proposal_inner(block)
             telemetry.incr("process_proposal.accepted")
             return True
         except Exception:
@@ -688,6 +714,14 @@ class App:
     # ------------------------------------------------------------------
 
     def finalize_block(self, block: Block) -> list[TxResult]:
+        with obs.span(
+            "finalize_block", traces=self.traces,
+            trace_id=obs.trace_id_for(self.chain_id, block.header.height),
+            height=block.header.height, n_txs=len(block.txs),
+        ):
+            return self._finalize_inner(block)
+
+    def _finalize_inner(self, block: Block) -> list[TxResult]:
         h = block.header
         ctx = self._deliver_ctx(InfiniteGasMeter(), height=h.height, t=h.time_unix)
 
@@ -970,6 +1004,14 @@ class App:
     SNAPSHOT_KEEP = 100  # bounded rollback window (reference keeps pruned IAVL versions)
 
     def commit(self, block: Block) -> bytes:
+        with obs.span(
+            "commit", traces=self.traces,
+            trace_id=obs.trace_id_for(self.chain_id, block.header.height),
+            height=block.header.height,
+        ):
+            return self._commit_inner(block)
+
+    def _commit_inner(self, block: Block) -> bytes:
         t0 = time_mod.perf_counter()
         # root BEFORE height: lockless readers pairing (height,
         # last_app_hash) — ChainHandle.status_pair — can then never

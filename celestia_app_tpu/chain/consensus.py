@@ -35,6 +35,7 @@ import json
 import os
 
 from celestia_app_tpu import faults
+from celestia_app_tpu import obs
 from celestia_app_tpu.chain.app import App
 from celestia_app_tpu.chain.block import Block, Header
 from celestia_app_tpu.chain.crypto import PrivateKey, PublicKey
@@ -649,32 +650,41 @@ class ValidatorNode:
         if self.wal_dir is None:
             return
 
-        doc = {
-            "evidence": [evidence_to_json(ev) for ev in evidence],
-            "height": block.header.height,
-            **block_to_json(block),
-            "votes": [vote_to_json(v) for v in cert.votes],
-            # the commit round: replay must rebuild the certificate with
-            # it, or a round>0 cert's round-scoped votes count as zero
-            # power (and the presence set reads empty) after restart
-            "cert_round": cert.round,
-        }
-        if record_present:
-            doc["present"] = (
-                None if present is None
-                else sorted(a.hex() for a in present)
-            )
-        tmp = self._wal_path(block.header.height) + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f)
-            f.flush()
-            os.fsync(f.fileno())
-        # crash point 1 of the commit matrix: the record is fsync'd as a
-        # tmp but NOT renamed — after restart there is no durable WAL
-        # entry for this height (the torn tail the replay scanner skips).
-        # Recovery: commit-record catch-up from peers (blocksync).
-        faults.fire("consensus.wal_append", height=block.header.height)
-        os.replace(tmp, self._wal_path(block.header.height))
+        with obs.span(
+            "wal.append", traces=self.app.traces,
+            trace_id=obs.trace_id_for(self.app.chain_id,
+                                      block.header.height),
+            height=block.header.height,
+        ):
+            doc = {
+                "evidence": [evidence_to_json(ev) for ev in evidence],
+                "height": block.header.height,
+                **block_to_json(block),
+                "votes": [vote_to_json(v) for v in cert.votes],
+                # the commit round: replay must rebuild the certificate
+                # with it, or a round>0 cert's round-scoped votes count
+                # as zero power (and the presence set reads empty) after
+                # restart
+                "cert_round": cert.round,
+            }
+            if record_present:
+                doc["present"] = (
+                    None if present is None
+                    else sorted(a.hex() for a in present)
+                )
+            tmp = self._wal_path(block.header.height) + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+                f.flush()
+                os.fsync(f.fileno())
+            # crash point 1 of the commit matrix: the record is fsync'd
+            # as a tmp but NOT renamed — after restart there is no
+            # durable WAL entry for this height (the torn tail the replay
+            # scanner skips). Recovery: commit-record catch-up from peers
+            # (blocksync).
+            faults.fire("consensus.wal_append",
+                        height=block.header.height)
+            os.replace(tmp, self._wal_path(block.header.height))
 
     def _present_set_from_cert(
         self, cert: CommitCertificate | None
@@ -767,32 +777,41 @@ class ValidatorNode:
         the one choice all nodes share (Tendermint's LastCommitInfo-in-
         block wiring). The WAL records the presence set whenever it did
         not come from `cert`."""
-        from_proposal = absent_cert is not ValidatorNode._ABSENT_FROM_CERT
-        src = cert if not from_proposal else absent_cert
-        present = self._present_set_from_cert(src)
-        self.write_wal(block, cert, evidence, present=present,
-                       record_present=from_proposal)
-        # crash point 2 of the commit matrix: the WAL record IS durable
-        # but no state has been touched. Recovery: replay_wal() re-applies
-        # the recorded block on restart (Tendermint's replay semantics).
-        faults.fire("consensus.post_wal_pre_apply",
-                    height=block.header.height)
-        self._apply_evidence(evidence)
-        # ordering invariant shared with replay_wal: evidence FIRST, then
-        # absences — both paths must compute the absent set against the
-        # same post-evidence validator set or replayed nodes diverge
-        self._set_absent(present)
-        results = self.app.finalize_block(block)
-        app_hash = self.app.commit(block)
-        self.certificates[block.header.height] = cert
-        self._record_committed(block, results)
-        self.pool.remove_committed(block.txs)
-        # post-commit recheck (RecheckTx): survivors re-run CheckTx
-        # against the fresh check state so nonce-stale txs (their sender's
-        # sequence advanced in THIS block via a different tx) drop instead
-        # of wasting the next proposal slot
-        self.pool.recheck(self.app.check_tx)
-        return app_hash
+        with obs.span(
+            "apply", traces=self.app.traces,
+            trace_id=obs.trace_id_for(self.app.chain_id,
+                                      block.header.height),
+            height=block.header.height, node=self.name,
+        ):
+            from_proposal = \
+                absent_cert is not ValidatorNode._ABSENT_FROM_CERT
+            src = cert if not from_proposal else absent_cert
+            present = self._present_set_from_cert(src)
+            self.write_wal(block, cert, evidence, present=present,
+                           record_present=from_proposal)
+            # crash point 2 of the commit matrix: the WAL record IS
+            # durable but no state has been touched. Recovery:
+            # replay_wal() re-applies the recorded block on restart
+            # (Tendermint's replay semantics).
+            faults.fire("consensus.post_wal_pre_apply",
+                        height=block.header.height)
+            self._apply_evidence(evidence)
+            # ordering invariant shared with replay_wal: evidence FIRST,
+            # then absences — both paths must compute the absent set
+            # against the same post-evidence validator set or replayed
+            # nodes diverge
+            self._set_absent(present)
+            results = self.app.finalize_block(block)
+            app_hash = self.app.commit(block)
+            self.certificates[block.header.height] = cert
+            self._record_committed(block, results)
+            self.pool.remove_committed(block.txs)
+            # post-commit recheck (RecheckTx): survivors re-run CheckTx
+            # against the fresh check state so nonce-stale txs (their
+            # sender's sequence advanced in THIS block via a different
+            # tx) drop instead of wasting the next proposal slot
+            self.pool.recheck(self.app.check_tx)
+            return app_hash
 
     def _record_committed(self, block: Block, results) -> None:
         """Tx-hash -> (height, result) index backing the gRPC GetTx /

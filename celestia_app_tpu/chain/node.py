@@ -101,18 +101,28 @@ class Node:
 
     def produce_block(self, t: float | None = None) -> tuple[Block, list[TxResult]]:
         t = t if t is not None else time_mod.time()
-        prop = self.app.prepare_proposal(self._reap(), t=t)
-        if not self.app.process_proposal(prop.block):
-            raise RuntimeError("node rejected its own proposal")
-        results = self.app.finalize_block(prop.block)
-        self.app.commit(prop.block)
-        self.blocks.append(prop.block)
+        # one root span for the whole round — prepare/process/finalize/
+        # commit nest under it with the height's deterministic trace id
+        from celestia_app_tpu import obs
 
-        self.pool.remove_committed(prop.block.txs)
-        # post-commit recheck (RecheckTx): survivors re-run CheckTx against
-        # the fresh check state; nonce-stale/now-unfunded txs drop here
-        # instead of wasting the next proposal's slot
-        self.pool.recheck(self.app.check_tx)
+        with obs.span(
+            "block.produce", traces=self.app.traces,
+            trace_id=obs.trace_id_for(self.app.chain_id,
+                                      self.app.height + 1),
+            height=self.app.height + 1,
+        ):
+            prop = self.app.prepare_proposal(self._reap(), t=t)
+            if not self.app.process_proposal(prop.block):
+                raise RuntimeError("node rejected its own proposal")
+            results = self.app.finalize_block(prop.block)
+            self.app.commit(prop.block)
+            self.blocks.append(prop.block)
+
+            self.pool.remove_committed(prop.block.txs)
+            # post-commit recheck (RecheckTx): survivors re-run CheckTx
+            # against the fresh check state; nonce-stale/now-unfunded txs
+            # drop here instead of wasting the next proposal's slot
+            self.pool.recheck(self.app.check_tx)
         record_committed(self.committed, prop.block, results)
         return prop.block, results
 

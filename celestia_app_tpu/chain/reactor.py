@@ -48,10 +48,13 @@ import json
 import threading
 import time
 
+from celestia_app_tpu import obs
 from celestia_app_tpu.chain import consensus as c
 from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
 from celestia_app_tpu.net.transport import PeerClient, TransportConfig
 from celestia_app_tpu.utils import telemetry
+
+log = obs.get_logger("chain.reactor")
 
 
 @dataclasses.dataclass
@@ -210,10 +213,13 @@ class ConsensusReactor:
         """Fire-and-forget flood to every peer (fully-connected devnet
         topology). One daemon sender per peer drains a queue, so a dead
         peer costs ONE blocked thread regardless of message rate, and
-        messages to a live peer stay ordered."""
+        messages to a live peer stay ordered. The enqueuer's span context
+        rides along (obs.capture), so the cross-thread send is recorded
+        as part of the originating round's trace."""
+        ctx = obs.capture()
         for u in self.peers:
             try:
-                self._senders[u].put_nowait((path, payload))
+                self._senders[u].put_nowait((path, payload, ctx))
             except Exception:
                 pass  # queue full (peer long dead): drop — gossip is
                 # best-effort; the pull-probe recovers anything that matters
@@ -233,6 +239,7 @@ class ConsensusReactor:
                         item = qq.get(timeout=1.0)
                     except Exception:
                         continue
+                    path, payload, ctx = item
                     if self.cfg.gossip_delay > 0:  # injected latency
                         time.sleep(self.cfg.gossip_delay)
                     if not self.net.available(u):
@@ -243,7 +250,12 @@ class ConsensusReactor:
                         telemetry.incr("net.send_skipped")
                         continue
                     try:
-                        self.net.post(u, *item)
+                        # resume the enqueuer's span context so this send
+                        # (and the peer's receive, via the trace header
+                        # the transport injects) joins the height's trace
+                        with obs.resume(ctx, "gossip.send", peer=u,
+                                        path=path):
+                            self.net.post(u, path, payload)
                     except (OSError, ValueError):
                         # counted, never silent: the transport's per-peer
                         # failure tally (net snapshot) carries the detail
@@ -329,9 +341,12 @@ class ConsensusReactor:
             self.mempool_gossip.first_seen(h)  # idempotent mark
             targets = self.mempool_gossip.announce_targets(h)
         payload = {"hash": h.hex(), "from": self.self_url}
+        ctx = obs.capture()  # announces join the round's trace too
         for u in targets:
             try:
-                self._senders[u].put_nowait(("/gossip/seen_tx", payload))
+                self._senders[u].put_nowait(
+                    ("/gossip/seen_tx", payload, ctx)
+                )
             except Exception:
                 pass  # best-effort, like all gossip
 
@@ -595,15 +610,14 @@ class ConsensusReactor:
         backoff = 0.2
         while not self._stop.is_set():
             try:
-                committed = self._step_height()
+                committed = self._step_traced()
             except Exception as e:  # keep the reactor alive — but COUNTED
                 # (reactor.loop_errors) and with escalating backoff, not
                 # the old fixed-0.2s hot loop that could spin a wedged
                 # node at 5 errors/second forever
                 self.loop_errors += 1
                 telemetry.incr("reactor.loop_errors")
-                print(f"[reactor {self.vnode.name}] round error: "
-                      f"{type(e).__name__}: {e}", flush=True)
+                log.error("round error", node=self.vnode.name, err=e)
                 committed = False
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 5.0)
@@ -644,9 +658,11 @@ class ConsensusReactor:
                 if not self.vnode.verify_certificate(cert, pubkeys=known):
                     continue
                 if not app.process_proposal(prop.block):
-                    print(f"[reactor {self.vnode.name}] REFUSING certified "
-                          f"block at height {height}: local validation "
-                          "failed (>1/3 byzantine or bug)", flush=True)
+                    log.error(
+                        "REFUSING certified block: local validation "
+                        "failed (>1/3 byzantine or bug)",
+                        node=self.vnode.name, height=height,
+                    )
                     continue
                 self._last_powers = (height, self._powers())
                 h = self.vnode.apply(prop.block, cert,
@@ -845,14 +861,21 @@ class ConsensusReactor:
         passes the full verification in _apply_pending_commit — a single
         peer serving a corrupt/tampered record must not defeat the sync
         while honest peers hold a good one."""
-        for u in self._peer_order(prefer):
-            doc = self._fetch_record_from(u, need)
-            if doc is None:
-                continue
-            self.on_commit(doc)
-            if self._apply_pending_commit():
-                return True
-        return False
+        with obs.span(
+            "blocksync.pull", traces=self.vnode.app.traces,
+            trace_id=obs.trace_id_for(self.vnode.app.chain_id, need),
+            height=need, node=self.vnode.name,
+        ) as sp:
+            for u in self._peer_order(prefer):
+                doc = self._fetch_record_from(u, need)
+                if doc is None:
+                    continue
+                self.on_commit(doc)
+                if self._apply_pending_commit():
+                    sp.set(peer=u)
+                    return True
+            sp.set(error="no applicable record")
+            return False
 
     def _peer_order(self, prefer: str) -> list[str]:
         return ([prefer] if prefer else []) + [
@@ -888,6 +911,20 @@ class ConsensusReactor:
             return True
         except (OSError, ValueError, KeyError):
             return False
+
+    def _step_traced(self) -> bool:
+        """One reactor step under a per-round span: the root every
+        gossip.send / wal.append / apply child of this round hangs off
+        (trace id = the height's deterministic id)."""
+        height = self.vnode.app.height + 1  # label-only read; no lock
+        with obs.span(
+            "reactor.round", traces=self.vnode.app.traces,
+            trace_id=obs.trace_id_for(self.vnode.app.chain_id, height),
+            height=height, round=self.round, node=self.vnode.name,
+        ) as sp:
+            committed = self._step_height()
+            sp.set(committed=committed)
+            return committed
 
     def _step_height(self) -> bool:
         """One (height, round) attempt; True iff a block was committed."""

@@ -1763,6 +1763,21 @@ def cmd_blockscan(args) -> int:
     return 0
 
 
+def cmd_timeline(args) -> int:
+    """Cross-node span waterfall (tools/timeline.py): scrape
+    /trace/spans from every node of a devnet, merge by the deterministic
+    per-height trace ids, render per-height timelines (or dump JSON)."""
+    from celestia_app_tpu.tools import timeline
+
+    return timeline.main(
+        ["--nodes", args.nodes]
+        + (["--height", str(args.height)] if args.height is not None else [])
+        + (["--since", str(args.since)] if args.since else [])
+        + ["--limit", str(args.limit), "--last", str(args.last)]
+        + (["--json"] if args.json else [])
+    )
+
+
 def cmd_txsim(args) -> int:
     from celestia_app_tpu.chain.crypto import PrivateKey
     from celestia_app_tpu.chain.node import Node
@@ -2097,6 +2112,23 @@ def main(argv=None) -> int:
     p.add_argument("--home", required=True)
     p.add_argument("--last", type=int, default=None)
     p.set_defaults(fn=cmd_blocktime)
+
+    p = sub.add_parser(
+        "timeline",
+        help="cross-node span waterfall: scrape /trace/spans from every "
+             "node, merge by trace_id, render per-height timelines",
+    )
+    p.add_argument("--nodes", required=True,
+                   help="comma-separated node/validator service URLs")
+    p.add_argument("--height", type=int, default=None,
+                   help="only this height's trace")
+    p.add_argument("--since", type=int, default=0)
+    p.add_argument("--limit", type=int, default=10_000)
+    p.add_argument("--last", type=int, default=5,
+                   help="render the N most recent heights (text mode)")
+    p.add_argument("--json", action="store_true",
+                   help="dump merged spans as JSON")
+    p.set_defaults(fn=cmd_timeline)
 
     p = sub.add_parser("blockscan")
     p.add_argument("--home", required=True)

@@ -74,8 +74,15 @@ def pipeline_fn(k: int):
 
 @functools.lru_cache(maxsize=None)
 def jitted_pipeline(k: int):
-    """Compiled pipeline for square size k (cached per bucket)."""
-    return jax.jit(pipeline_fn(k))
+    """Compiled pipeline for square size k (cached per bucket).
+    Instrumented (obs/jax_profile): the cache miss counts one
+    ``jax.compilations``; the wrapper splits first-call (compile) from
+    steady-state (execute) latency per program."""
+    from celestia_app_tpu.obs import jax_profile
+
+    jax_profile.note_compile("eds.pipeline", k)
+    return jax_profile.instrument(f"eds.pipeline[{k}]",
+                                  jax.jit(pipeline_fn(k)))
 
 
 @functools.lru_cache(maxsize=None)
@@ -86,7 +93,11 @@ def jitted_pipeline_batched(k: int):
     one-chip analog of the sharded pipeline's `data` axis; BASELINE cfg 5
     throughput). vmap of the single-square program — bit-identical per
     block (tests/test_streaming.py)."""
-    return jax.jit(jax.vmap(pipeline_fn(k)))
+    from celestia_app_tpu.obs import jax_profile
+
+    jax_profile.note_compile("eds.pipeline_batched", k)
+    return jax_profile.instrument(f"eds.pipeline_batched[{k}]",
+                                  jax.jit(jax.vmap(pipeline_fn(k))))
 
 
 def roots_only_fn(k: int):
@@ -103,4 +114,19 @@ def roots_only_fn(k: int):
 
 @functools.lru_cache(maxsize=None)
 def jitted_roots_only(k: int):
-    return jax.jit(roots_only_fn(k))
+    from celestia_app_tpu.obs import jax_profile
+
+    jax_profile.note_compile("eds.roots_only", k)
+    return jax_profile.instrument(f"eds.roots_only[{k}]",
+                                  jax.jit(roots_only_fn(k)))
+
+
+# live jit-cache-size accounting (obs/jax_profile collect_gauges): the
+# gauge reads cache_info().currsize, so bench-driven cache_clear() calls
+# keep it honest
+from celestia_app_tpu.obs import jax_profile as _jax_profile  # noqa: E402
+
+for _factory in (jitted_pipeline, jitted_pipeline_batched,
+                 jitted_roots_only):
+    _jax_profile.register_cache(_factory)
+del _factory, _jax_profile

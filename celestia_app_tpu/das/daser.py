@@ -47,12 +47,15 @@ import time
 import numpy as np
 
 from celestia_app_tpu import appconsts
+from celestia_app_tpu import obs
 from celestia_app_tpu.chain import light as light_mod
 from celestia_app_tpu.da import fraud, repair, sampling
 from celestia_app_tpu.da.dah import DataAvailabilityHeader
 from celestia_app_tpu.das.checkpoint import Checkpoint, CheckpointStore
 from celestia_app_tpu.net.transport import PeerClient, TransportConfig
 from celestia_app_tpu.utils import nmt_host, telemetry
+
+log = obs.get_logger("das.daser")
 
 
 class PeerError(OSError):
@@ -171,6 +174,10 @@ class DASer:
         self.rng = rng if rng is not None else np.random.default_rng()
         # height -> (data_root hex, ods square size), from VERIFIED headers
         self._roots: dict[int, tuple[str, int]] = {}
+        # this light node's OWN span plane (obs/spans.py): rows carry the
+        # same deterministic per-height trace ids the serving chain uses,
+        # so tools/timeline.py merges them into one waterfall
+        self.traces = telemetry.TraceTables()
         self.reports: dict[int, dict] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -247,12 +254,16 @@ class DASer:
 
     def _fetch_cells(self, height: int, cells, axis: str = "row") -> list[dict]:
         """Batched fetch; whole-request failures already rotate peers in
-        PeerSet. Returns the per-cell sample docs (error members kept)."""
-        out = self.peers.request(
-            "/das/samples",
-            {"height": height, "cells": [list(c) for c in cells],
-             "axis": axis},
-        )
+        PeerSet. Returns the per-cell sample docs (error members kept).
+        The span context rides the request (X-Celestia-Trace), so the
+        serving node's das.serve_sample span links back here."""
+        with obs.span("das.fetch_cells", traces=self.traces,
+                      height=height, cells=len(cells), axis=axis):
+            out = self.peers.request(
+                "/das/samples",
+                {"height": height, "cells": [list(c) for c in cells],
+                 "axis": axis},
+            )
         return out["samples"]
 
     def _verify_cells(self, dah: DataAvailabilityHeader,
@@ -285,6 +296,21 @@ class DASer:
         `rng` is the calling worker's own generator (numpy Generators are
         not thread-safe; sharing one across workers would correlate the
         draws the confidence bound assumes independent)."""
+        # the light-node side of the height's trace: same deterministic
+        # id the chain stamps, derived locally from (chain_id, height) —
+        # the DAS sample round-trip joins the block's waterfall
+        with obs.span(
+            "das.sample_height", traces=self.traces,
+            trace_id=obs.trace_id_for(self.light.chain_id, height),
+            height=height, node=self.name,
+        ) as sp:
+            out = self._sample_height_inner(height, root_hex, square_size,
+                                            rng)
+            sp.set(status=out.get("status"))
+            return out
+
+    def _sample_height_inner(self, height: int, root_hex: str,
+                             square_size: int, rng=None) -> dict:
         rng = rng if rng is not None else self.rng
         t0 = time.perf_counter()
         try:
@@ -520,8 +546,7 @@ class DASer:
                 try:
                     self.sync()
                 except Exception as e:  # keep the daemon alive, loudly
-                    print(f"[{self.name}] sweep error: "
-                          f"{type(e).__name__}: {e}", flush=True)
+                    log.error("sweep error", daser=self.name, err=e)
                 self._stop.wait(self.cfg.poll_interval)
 
         self._thread = threading.Thread(target=loop, daemon=True)

@@ -262,16 +262,31 @@ class SampleCore:
         cells = [(int(r), int(c)) for r, c in cells]
         if not cells:
             raise SampleError("empty cell list")
-        entry = self._entry(height)
-        t0 = time.perf_counter()
-        samples = []
-        served = 0
-        for r, c in cells:
-            try:
-                samples.append(self._one(entry, r, c, axis))
-                served += 1
-            except SampleError as e:
-                samples.append({"row": r, "col": c, "error": str(e)})
+        from celestia_app_tpu import obs
+
+        # serve-side span of the DAS round-trip: the height's
+        # deterministic trace id matches the sampling light node's, and
+        # the incoming X-Celestia-Trace header (begin_request) makes the
+        # sampler's fetch span this span's remote parent
+        with obs.span(
+            "das.serve_sample",
+            traces=getattr(self.app, "traces", None),
+            trace_id=obs.trace_id_for(
+                getattr(self.app, "chain_id", ""), height
+            ),
+            height=height, cells=len(cells), axis=axis,
+        ) as sp:
+            entry = self._entry(height)
+            t0 = time.perf_counter()
+            samples = []
+            served = 0
+            for r, c in cells:
+                try:
+                    samples.append(self._one(entry, r, c, axis))
+                    served += 1
+                except SampleError as e:
+                    samples.append({"row": r, "col": c, "error": str(e)})
+            sp.set(served=served)
         telemetry.measure_since("das.sample_batch", t0)
         telemetry.incr("das.samples_served", served)
         telemetry.incr("das.sample_batches")

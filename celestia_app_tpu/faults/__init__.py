@@ -63,7 +63,6 @@ import json
 import os
 import random
 import re
-import sys
 import threading
 
 from celestia_app_tpu.utils import telemetry
@@ -224,8 +223,11 @@ class FaultRegistry:
             return None
         telemetry.incr(f"faults.{point}.{terminal}")
         if terminal == "crash":
-            print(f"[faults] CRASH at {point} ({ctx})", file=sys.stderr,
-                  flush=True)
+            from celestia_app_tpu.obs import log as obs_log
+
+            obs_log.get_logger("faults").error(
+                f"CRASH at {point}", ctx=str(ctx)
+            )
             os._exit(137)
         if delay_total > 0.0:
             import time
@@ -297,8 +299,11 @@ def arm_from_env(registry: FaultRegistry = REGISTRY) -> int:
             raise ValueError("CELESTIA_FAULTS must be a JSON list")
         return len(arm_from_spec(specs, registry))
     except (OSError, ValueError, KeyError, TypeError) as e:
-        print(f"[faults] CELESTIA_FAULTS ignored ({type(e).__name__}: {e})",
-              file=sys.stderr, flush=True)
+        from celestia_app_tpu.obs import log as obs_log
+
+        obs_log.get_logger("faults").warning(
+            "CELESTIA_FAULTS ignored", err=e
+        )
         return 0
 
 

@@ -50,6 +50,7 @@ import urllib.error
 import urllib.request
 
 from celestia_app_tpu import faults
+from celestia_app_tpu import obs
 from celestia_app_tpu.utils import telemetry
 
 
@@ -197,12 +198,20 @@ class PeerClient:
 
     def _one(self, url: str, path: str, payload, timeout: float,
              raw: bool):
+        # span propagation (obs/spans.py): while a span is active on the
+        # calling thread, every peer request carries X-Celestia-Trace so
+        # the serving side links its work into the originating trace
+        headers: dict[str, str] = {}
+        trace = obs.http_header()
+        if trace is not None:
+            headers[obs.TRACE_HEADER] = trace
         if payload is None:
-            req = urllib.request.Request(url + path)
+            req = urllib.request.Request(url + path, headers=headers)
         else:
+            headers["Content-Type"] = "application/json"
             req = urllib.request.Request(
                 url + path, data=json.dumps(payload).encode(),
-                headers={"Content-Type": "application/json"},
+                headers=headers,
                 method="POST",
             )
         with urllib.request.urlopen(req, timeout=timeout) as r:

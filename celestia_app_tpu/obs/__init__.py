@@ -1,0 +1,39 @@
+"""The observability plane: spans, structured logging, JAX profiling.
+
+Three sub-modules, one import surface (``from celestia_app_tpu import
+obs``):
+
+- ``obs.spans`` — context-manager span API over the columnar TraceTables
+  with DETERMINISTIC per-height trace ids (``trace_id_for(chain_id, h)``)
+  so proposer, followers, and DAS light nodes correlate without clock
+  sync; HTTP propagation via the ``X-Celestia-Trace`` header.
+- ``obs.log`` — the leveled structured stderr logger library modules use
+  instead of calling ``print`` (lint-enforced).
+- ``obs.jax_profile`` — the compile-vs-execute split for the jitted
+  pipelines, device gauges, and the /debug/profile capture worker.
+
+Histograms/labels/Prometheus exposition live in utils/telemetry.py (the
+metric registry predates this package and everything already imports it).
+docs/DESIGN.md "The observability plane" has the span model; FORMATS §10
+the wire formats.
+"""
+
+from celestia_app_tpu.obs.log import get_logger  # noqa: F401
+from celestia_app_tpu.obs.spans import (  # noqa: F401
+    NOOP,
+    SPAN_TABLE,
+    TRACE_HEADER,
+    Span,
+    begin_request,
+    capture,
+    enabled,
+    end_request,
+    http_header,
+    resume,
+    route_profile,
+    route_trace,
+    serve_metrics,
+    set_enabled,
+    span,
+    trace_id_for,
+)

@@ -1,0 +1,122 @@
+"""Leveled structured logger for library modules.
+
+Library code (chain/, das/, mempool/, faults/…) must never call
+``print`` — a tier-1 lint test enforces it (tests/test_obs.py), the same
+pattern as the urlopen gate. This is the replacement: a tiny stderr
+logger with
+
+- **levels** (debug/info/warning/error), filtered by ``CELESTIA_LOG_LEVEL``
+  (default ``info``; ``CELESTIA_LOG_LEVEL=error`` quiets a devnet's
+  reactors to real failures only);
+- **structured fields** — ``log.warning("round error", height=h, err=e)``
+  renders ``key=value`` pairs in text mode and proper JSON objects with
+  ``CELESTIA_LOG_FORMAT=json`` (machine-ingestable, one object per line);
+- **telemetry coupling** — every emitted record counts in the global
+  registry (``log.<level>`` counters), so "how many errors did this node
+  log" is scrapeable from /metrics without parsing stderr.
+
+stdlib ``logging`` is deliberately not used: its global config is owned
+by embedding applications, and this package must never reconfigure a
+host process's logging tree.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+from celestia_app_tpu.utils import telemetry
+
+_LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40}
+
+_lock = threading.Lock()
+_config: dict | None = None
+
+
+def _cfg() -> dict:
+    global _config
+    if _config is None:
+        level = os.environ.get("CELESTIA_LOG_LEVEL", "info").strip().lower()
+        _config = {
+            "threshold": _LEVELS.get(level, 20),
+            "json": os.environ.get("CELESTIA_LOG_FORMAT", "").strip().lower()
+            == "json",
+        }
+    return _config
+
+
+def configure(level: str | None = None, json_mode: bool | None = None) -> None:
+    """Override env config (tests, embedding tools). level=None +
+    json_mode=None resets to the environment."""
+    global _config
+    if level is None and json_mode is None:
+        _config = None
+        return
+    cfg = dict(_cfg())
+    if level is not None:
+        cfg["threshold"] = _LEVELS.get(level.lower(), cfg["threshold"])
+    if json_mode is not None:
+        cfg["json"] = bool(json_mode)
+    _config = cfg
+
+
+class Logger:
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def _emit(self, level: str, msg: str, fields: dict) -> None:
+        cfg = _cfg()
+        if _LEVELS[level] < cfg["threshold"]:
+            return
+        telemetry.incr(f"log.{level}")
+        if cfg["json"]:
+            line = json.dumps({
+                "ts": round(time.time(), 3), "level": level,
+                "logger": self.name, "msg": msg,
+                **{k: _jsonable(v) for k, v in fields.items()},
+            })
+        else:
+            kv = " ".join(f"{k}={_jsonable(v)}" for k, v in fields.items())
+            line = f"[{self.name}] {level.upper()}: {msg}" \
+                + (f" {kv}" if kv else "")
+        with _lock:
+            try:
+                sys.stderr.write(line + "\n")
+                sys.stderr.flush()
+            except (OSError, ValueError):
+                pass  # a closed stderr must never crash the library
+
+    def debug(self, msg: str, **fields) -> None:
+        self._emit("debug", msg, fields)
+
+    def info(self, msg: str, **fields) -> None:
+        self._emit("info", msg, fields)
+
+    def warning(self, msg: str, **fields) -> None:
+        self._emit("warning", msg, fields)
+
+    def error(self, msg: str, **fields) -> None:
+        self._emit("error", msg, fields)
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, BaseException):
+        return f"{type(v).__name__}: {v}"
+    return repr(v)
+
+
+_loggers: dict[str, Logger] = {}
+
+
+def get_logger(name: str) -> Logger:
+    lg = _loggers.get(name)
+    if lg is None:
+        lg = _loggers[name] = Logger(name)
+    return lg

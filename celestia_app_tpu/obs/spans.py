@@ -1,0 +1,300 @@
+"""Cross-node span tracing over the columnar trace plane.
+
+The reference measures hot paths per process (telemetry.MeasureSince) and
+pulls per-node columnar tables (celestia-core pkg/trace) — but neither can
+answer "where did block H spend its 400 ms between proposer and light
+node?".  Spans close that gap with three deliberate choices:
+
+- **Deterministic per-height trace ids.** The trace id for block H is
+  ``sha256(chain_id + "/" + H)[:16]`` (`trace_id_for`), so the proposer,
+  every follower, and every DAS light node stamp their spans with the SAME
+  id without any clock sync, id exchange, or coordinator.  A merge tool
+  (tools/timeline.py) only needs to group by trace_id.
+- **Rows, not a protocol.** A finished span is ONE row in the existing
+  ``TraceTables`` ("spans" table): trace_id / span_id / parent_id / name /
+  start_unix / dur_ms / attrs.  It rides the same bounded ring buffers,
+  the same ``/trace/spans`` pull route, and the same per-App isolation the
+  BlockSummary rows already have.
+- **Context propagation that survives sockets and threads.**  Within a
+  thread, spans nest through a thread-local stack.  Across a peer call,
+  the hardened transport (net/transport.py) injects an
+  ``X-Celestia-Trace: <trace_id>:<span_id>`` header and the HTTP services
+  install it as the *incoming* context (`begin_request`), which the next
+  root span on that handler thread adopts as its remote parent.  Across
+  an in-process thread hop (the reactor's sender queues), `capture()` /
+  `resume()` carry the context explicitly.
+
+Recording is gated by ``CELESTIA_OBS`` (off/0/false disables; see
+`enabled`): a disabled span is a shared no-op object, so the hot path
+pays one dict lookup and one truthiness check. ``bench.py --obs``
+measures exactly this on/off delta.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import os
+import threading
+import time
+
+from celestia_app_tpu.utils import telemetry
+
+# the wire header every peer call carries while a span is active
+TRACE_HEADER = "X-Celestia-Trace"
+
+SPAN_TABLE = "spans"
+
+_tls = threading.local()
+# span ids: per-process random prefix + counter — unique across the
+# processes of a devnet without coordination, and cheap to mint
+_SPAN_PREFIX = os.urandom(3).hex()
+_counter = itertools.count(1)
+
+_enabled: bool | None = None
+
+
+def enabled() -> bool:
+    """Span recording gate (CELESTIA_OBS=off|0|false disables). Resolved
+    once and cached; tests/benches flip it with `set_enabled`."""
+    global _enabled
+    if _enabled is None:
+        _enabled = os.environ.get("CELESTIA_OBS", "on").strip().lower() \
+            not in ("off", "0", "false", "no")
+    return _enabled
+
+
+def set_enabled(value: bool | None) -> None:
+    """Override the gate (None = re-read CELESTIA_OBS on next check)."""
+    global _enabled
+    _enabled = None if value is None else bool(value)
+
+
+def trace_id_for(chain_id: str, height: int) -> str:
+    """THE deterministic per-height trace id: every process that knows
+    (chain_id, height) — proposer, follower, light node — derives the
+    same id, so cross-node correlation needs no clock sync or handshake."""
+    return hashlib.sha256(
+        f"{chain_id}/{int(height)}".encode()
+    ).hexdigest()[:16]
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+class Span:
+    """One in-flight span; use as a context manager. `set(**attrs)` adds
+    attributes before exit; the row is written on __exit__."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "sink",
+                 "attrs", "start_unix", "_t0")
+
+    def __init__(self, name: str, trace_id: str, parent_id: str | None,
+                 sink, attrs: dict):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = f"{_SPAN_PREFIX}{next(_counter):06x}"
+        self.parent_id = parent_id
+        self.sink = sink
+        self.attrs = attrs
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        _stack().append(self)
+        self.start_unix = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        dur_ms = (time.perf_counter() - self._t0) * 1e3
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        elif self in st:  # unbalanced exit (generator teardown): heal
+            st.remove(self)
+        if exc_type is not None:
+            self.attrs.setdefault("error", exc_type.__name__)
+        try:
+            self.sink.write(
+                SPAN_TABLE,
+                trace_id=self.trace_id,
+                span_id=self.span_id,
+                parent_id=self.parent_id,
+                name=self.name,
+                start_unix=round(self.start_unix, 6),
+                dur_ms=round(dur_ms, 3),
+                **self.attrs,
+            )
+        except Exception:
+            pass  # observability must never take down the instrumented path
+
+
+class _NoopSpan:
+    """Shared disabled span: context manager + set() that do nothing."""
+
+    __slots__ = ()
+    trace_id = None
+    span_id = None
+
+    def set(self, **attrs) -> "_NoopSpan":
+        return self
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+NOOP = _NoopSpan()
+
+
+def span(name: str, *, traces=None, trace_id: str | None = None, **attrs):
+    """Open a span. Parentage resolution, in order:
+
+    1. an active span on this thread (nesting) — its trace id wins unless
+       an explicit `trace_id` is given (same-id case in practice, since
+       both derive from (chain_id, height));
+    2. the incoming HTTP context installed by `begin_request` — adopted
+       as a REMOTE parent when its trace id matches (or no explicit id
+       was given), which is what links a served request into the caller's
+       trace;
+    3. a fresh root (explicit or random trace id).
+
+    `traces` pins the sink (per-App TraceTables); otherwise the parent's
+    sink, else the process-global tables."""
+    if not enabled():
+        return NOOP
+    st = _stack()
+    parent = st[-1] if st else None
+    incoming = getattr(_tls, "incoming", None) if parent is None else None
+    if parent is not None:
+        sink = traces if traces is not None else parent.sink
+        if trace_id is not None and trace_id != parent.trace_id:
+            # explicit DIFFERENT trace (blocksync pulling another height
+            # under a reactor.round span): a cross-trace parent edge
+            # would orphan this span in per-trace merges — root it in
+            # its own trace instead
+            tid, pid = trace_id, None
+        else:
+            tid = parent.trace_id
+            pid = parent.span_id
+    else:
+        if incoming is not None and (trace_id is None
+                                     or incoming[0] == trace_id):
+            tid, pid = incoming
+        else:
+            tid = trace_id or os.urandom(8).hex()
+            pid = None
+        sink = traces if traces is not None else telemetry._traces
+    return Span(name, tid, pid, sink, attrs)
+
+
+# -- cross-thread / cross-socket propagation --------------------------------
+
+
+def capture():
+    """Snapshot the current span context for another thread (the reactor
+    sender queues); None when no span is active."""
+    st = getattr(_tls, "stack", None)
+    if not st:
+        return None
+    top = st[-1]
+    return (top.trace_id, top.span_id, top.sink)
+
+
+def resume(ctx, name: str, *, traces=None, **attrs):
+    """Open a span on THIS thread parented to a `capture()`d context.
+    No-op when the context is None or recording is off."""
+    if ctx is None or not enabled():
+        return NOOP
+    tid, pid, sink = ctx
+    return Span(name, tid, pid, traces if traces is not None else sink,
+                attrs)
+
+
+def http_header() -> str | None:
+    """Outbound X-Celestia-Trace value for the current span, or None.
+    Called by the peer transport on every request."""
+    st = getattr(_tls, "stack", None)
+    if not st or not enabled():
+        return None
+    top = st[-1]
+    return f"{top.trace_id}:{top.span_id}"
+
+
+def begin_request(headers) -> None:
+    """Install the incoming trace context from request headers (HTTP
+    handler entry); the next ROOT span on this thread adopts it."""
+    raw = headers.get(TRACE_HEADER) if headers is not None else None
+    if raw and ":" in raw:
+        tid, _, sid = raw.partition(":")
+        if tid and sid:
+            _tls.incoming = (tid, sid)
+            return
+    _tls.incoming = None
+
+
+def end_request() -> None:
+    """Clear the incoming context (HTTP handler exit; handler threads are
+    pooled, so a stale context must not leak into the next request)."""
+    _tls.incoming = None
+
+
+# -- shared HTTP surface (ONE implementation for every service) -------------
+
+
+def serve_metrics(handler) -> None:
+    """Write the Prometheus text exposition to a BaseHTTPRequestHandler —
+    the /metrics route of both the node and validator services."""
+    from celestia_app_tpu.utils import telemetry
+
+    body = telemetry.prometheus().encode()
+    handler.send_response(200)
+    handler.send_header("Content-Type", "text/plain; version=0.0.4")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def route_profile(payload: dict) -> tuple[int, dict]:
+    """The POST /debug/profile body -> (status, response) for both HTTP
+    services: runs the on-demand jax.profiler capture, mapping every
+    client-side problem to a 400."""
+    from celestia_app_tpu.obs import jax_profile
+
+    if not isinstance(payload, dict):
+        return 400, {"error": "body must be a JSON object"}
+    try:
+        return 200, jax_profile.capture_profile(
+            payload.get("dir"), seconds=payload.get("seconds", 0.5)
+        )
+    except jax_profile.ProfileError as e:
+        return 400, {"error": str(e)}
+
+
+# -- the /trace/* route, shared by every HTTP service -----------------------
+
+
+def route_trace(traces, path: str) -> dict:
+    """Serve /trace/<table>?since=<index>&limit=<n> from `traces`. Raises
+    ValueError on malformed query (transports answer 400)."""
+    from urllib.parse import parse_qs, urlparse
+
+    parsed = urlparse(path)
+    parts = parsed.path.split("/")
+    table = parts[2] if len(parts) > 2 and parts[2] else ""
+    qs = parse_qs(parsed.query)
+    rows = traces.read(
+        table,
+        since_index=int(qs.get("since", ["0"])[0]),
+        limit=int(qs.get("limit", ["1000"])[0]),
+    )
+    return {"table": table, "rows": rows, "tables": traces.tables()}

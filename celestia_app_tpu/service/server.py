@@ -21,6 +21,13 @@ Endpoints:
   POST /das/samples                    DAS sample serving (das/server.py)
   GET  /faults                         fault-plane admin (armed + fired)
   POST /faults/arm|disarm|reset        arm/disarm fault points (chaos)
+  GET  /metrics                        Prometheus text exposition (§10)
+  GET  /trace/<table>?since=&limit=    columnar trace pull (spans incl.)
+  POST /debug/profile {seconds, dir?}  on-demand jax.profiler capture
+
+Every request's X-Celestia-Trace header (if any) is installed as the
+incoming span context, so serve-side spans join the caller's trace
+(obs/spans.py; docs/FORMATS.md §10).
 """
 
 from __future__ import annotations
@@ -30,6 +37,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from celestia_app_tpu import obs
 from celestia_app_tpu.chain.query import QueryError, QueryRouter
 
 
@@ -70,6 +78,22 @@ class NodeService:
                 self.wfile.write(body)
 
             def do_GET(self):
+                # incoming trace context (X-Celestia-Trace): spans opened
+                # while serving this request join the caller's trace
+                obs.begin_request(self.headers)
+                try:
+                    self._get()
+                finally:
+                    obs.end_request()
+
+            def do_POST(self):
+                obs.begin_request(self.headers)
+                try:
+                    self._post()
+                finally:
+                    obs.end_request()
+
+            def _get(self):
                 try:
                     if self.path == "/status":
                         with service.lock:
@@ -83,40 +107,19 @@ class NodeService:
                         self._send(200, out)
                     elif self.path == "/metrics":
                         # Prometheus text exposition (the reference's
-                        # metrics provider endpoint, SURVEY §5.1)
-                        from celestia_app_tpu.utils import telemetry
-
-                        body = telemetry.prometheus().encode()
-                        self.send_response(200)
-                        self.send_header(
-                            "Content-Type", "text/plain; version=0.0.4"
-                        )
-                        self.send_header("Content-Length", str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
+                        # metrics provider endpoint, SURVEY §5.1); ONE
+                        # implementation shared with the validator
+                        # service (obs.serve_metrics)
+                        obs.serve_metrics(self)
                     elif self.path.startswith("/trace/"):
                         # columnar trace tables (pkg/trace pull, §5.1):
-                        # /trace/<table>?since=<index>&limit=<n> — reads the
-                        # NODE's tables under the service lock (writes come
-                        # from produce_block on another thread)
-                        from urllib.parse import parse_qs, urlparse
-
-                        parsed = urlparse(self.path)
-                        table = parsed.path.split("/")[2]
-                        qs = parse_qs(parsed.query)
-                        traces = service.node.app.traces
-                        with service.lock:
-                            rows = traces.read(
-                                table,
-                                since_index=int(qs.get("since", ["0"])[0]),
-                                limit=int(qs.get("limit", ["1000"])[0]),
-                            )
-                            names = traces.tables()
-                        self._send(200, {
-                            "table": table,
-                            "rows": rows,
-                            "tables": names,
-                        })
+                        # /trace/<table>?since=<index>&limit=<n> — ONE
+                        # router shared with the validator service
+                        # (obs.route_trace); TraceTables locks its own
+                        # reads, so the big writer lock stays out of the
+                        # poll path
+                        self._send(200, obs.route_trace(
+                            service.node.app.traces, self.path))
                     elif self.path.startswith("/das/"):
                         from urllib.parse import parse_qs, urlparse
 
@@ -160,7 +163,7 @@ class NodeService:
                 except Exception as e:
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
-            def do_POST(self):
+            def _post(self):
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(n) or b"{}")
@@ -239,6 +242,10 @@ class NodeService:
                                 "POST", self.path, payload))
                         except (ValueError, KeyError) as e:
                             self._send(400, {"error": str(e)})
+                    elif self.path == "/debug/profile":
+                        # on-demand jax.profiler capture (FORMATS §10.3);
+                        # refuses in processes that never imported jax
+                        self._send(*obs.route_profile(payload))
                     elif self.path == "/ibc/prove":
                         # membership/absence proof of a raw store key: the
                         # relayer's proof source (public data — any light

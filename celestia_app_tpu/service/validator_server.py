@@ -41,6 +41,14 @@ Fault-plane admin (celestia_app_tpu/faults; docs/FORMATS.md §9):
   POST /faults/arm {point, action, ...}   arm a fault; -> {id}
   POST /faults/disarm {id|point}    disarm one / by point / all
   POST /faults/reset {seed?}        disarm everything and reseed the rng
+
+Observability plane (celestia_app_tpu/obs; docs/FORMATS.md §10):
+  GET  /metrics                     Prometheus text exposition — validator
+                                    processes are scrapable, not just nodes
+  GET  /trace/<table>?since=&limit= columnar trace pull (spans included)
+  POST /debug/profile {seconds}     on-demand jax.profiler capture
+Every request's X-Celestia-Trace header is installed as the incoming
+span context, so serve-side spans join the calling node's trace.
 """
 
 from __future__ import annotations
@@ -50,6 +58,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from celestia_app_tpu import obs
 from celestia_app_tpu.chain import consensus as c
 
 
@@ -74,10 +83,42 @@ class ValidatorService:
                 self.wfile.write(body)
 
             def do_GET(self):
+                # incoming trace context (X-Celestia-Trace): serve-side
+                # spans join the calling node's trace (obs/spans.py)
+                obs.begin_request(self.headers)
+                try:
+                    self._get()
+                finally:
+                    obs.end_request()
+
+            def do_POST(self):
+                obs.begin_request(self.headers)
+                try:
+                    self._post()
+                finally:
+                    obs.end_request()
+
+            def _get(self):
                 try:
                     if self.path == "/consensus/status":
                         with service.lock:
                             self._send(200, service._status())
+                    elif self.path == "/metrics":
+                        # Prometheus text exposition — validator
+                        # processes were invisible to scrapers before
+                        # this route (only the node service had it);
+                        # ONE implementation shared with the node
+                        # service (obs.serve_metrics)
+                        obs.serve_metrics(self)
+                    elif self.path.startswith("/trace/"):
+                        # columnar trace pull (spans included) from THIS
+                        # validator's per-app tables — the route e2e
+                        # tooling and tools/timeline.py scrape
+                        try:
+                            self._send(200, obs.route_trace(
+                                service.vnode.app.traces, self.path))
+                        except ValueError as e:
+                            self._send(400, {"error": str(e)})
                     elif self.path == "/faults":
                         # fault-plane admin surface (celestia_app_tpu/
                         # faults): chaos harnesses inspect and arm fault
@@ -126,7 +167,7 @@ class ValidatorService:
                 except Exception as e:
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
-            def do_POST(self):
+            def _post(self):
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(n) or b"{}")
@@ -145,7 +186,15 @@ class ValidatorService:
                             self._send(404, {"error": "not autonomous"})
                             return
                         try:
-                            getattr(service.reactor, gossip)(payload)
+                            # gossip receives are spans too: adopted into
+                            # the sender's trace via the incoming header
+                            with obs.span(
+                                "gossip.recv."
+                                + self.path.rsplit("/", 1)[1],
+                                traces=service.vnode.app.traces,
+                                node=service.vnode.name,
+                            ):
+                                getattr(service.reactor, gossip)(payload)
                         except (KeyError, TypeError, ValueError) as e:
                             # malformed peer input is the peer's problem,
                             # not a server error
@@ -166,6 +215,11 @@ class ValidatorService:
                             # malformed spec: 400, matching the node
                             # service (FORMATS.md §9.1)
                             self._send(400, {"error": str(e)})
+                        return
+                    if self.path == "/debug/profile":
+                        # on-demand jax.profiler capture (FORMATS §10.3);
+                        # refuses on host-engine processes (jax unloaded)
+                        self._send(*obs.route_profile(payload))
                         return
                     route = {
                         "/broadcast_tx": service._broadcast_tx,

@@ -1,0 +1,190 @@
+"""Cross-node trace timeline: merge span tables into per-height waterfalls.
+
+The consumer side of the observability plane (obs/spans.py): every node of
+a devnet serves its span rows at ``/trace/spans``; this tool scrapes them
+all, groups rows by trace_id (deterministic per height — `trace_id_for`),
+and renders a text waterfall answering "where did block H spend its time
+between proposer, followers, and light nodes". It is the analog of the
+reference's e2e trace pullers (celestia-core pkg/trace + the testnet
+tooling that tails BlockSummary/RoundState tables), upgraded with span
+structure.
+
+Library surface (used by tests and the CLI `timeline` command):
+  scrape(urls)                 {node_label: [span rows]} over HTTP
+  merge_spans(rows_by_node)    {trace_id: [rows tagged with "node"]}
+  heights_of(merged)           {height: trace_id} for rows carrying one
+  render_waterfall(rows)       the text waterfall for one trace
+  collect(urls, height=None)   scrape + merge (+ height filter)
+
+The renderer needs only row dicts — in-process TraceTables output works
+the same as scraped JSON, so a light node that serves no HTTP (an
+embedded DASer) can hand its `daser.traces.read("spans")` rows straight
+to merge_spans.
+"""
+
+from __future__ import annotations
+
+import json
+
+from celestia_app_tpu.obs import SPAN_TABLE
+
+BAR_WIDTH = 40
+
+
+def fetch_node_spans(url: str, since: int = 0, limit: int = 10_000,
+                     client=None) -> list[dict]:
+    """Pull one node's span rows over HTTP (node service or validator
+    service — both serve /trace/spans)."""
+    from celestia_app_tpu.net import transport
+
+    client = client or transport.DEFAULT
+    doc = client.get(url.rstrip("/"),
+                     f"/trace/{SPAN_TABLE}?since={since}&limit={limit}")
+    return list(doc.get("rows", []))
+
+
+def scrape(urls: list[str], since: int = 0,
+           limit: int = 10_000) -> dict[str, list[dict]]:
+    """{node_label: rows} for every reachable node; unreachable nodes
+    yield an empty list (a partial devnet still renders)."""
+    out: dict[str, list[dict]] = {}
+    for url in urls:
+        label = url.rstrip("/")
+        try:
+            out[label] = fetch_node_spans(url, since=since, limit=limit)
+        except (OSError, ValueError, KeyError):
+            out[label] = []
+    return out
+
+
+def merge_spans(rows_by_node: dict[str, list[dict]]) -> dict[str, list[dict]]:
+    """Group every node's span rows by trace_id, tagging each row with its
+    source node. Rows inside a trace sort by start_unix — valid across
+    processes on one host (the devnet case); cross-host clock skew only
+    shifts bars, never the parent/child edges."""
+    merged: dict[str, list[dict]] = {}
+    for node, rows in rows_by_node.items():
+        for row in rows:
+            tid = row.get("trace_id")
+            if not tid:
+                continue
+            merged.setdefault(tid, []).append({**row, "node": node})
+    for rows in merged.values():
+        rows.sort(key=lambda r: (r.get("start_unix", 0.0),
+                                 r.get("_index", 0)))
+    return merged
+
+
+def heights_of(merged: dict[str, list[dict]]) -> dict[int, str]:
+    """{height: trace_id} for traces whose rows carry a height attr."""
+    out: dict[int, str] = {}
+    for tid, rows in merged.items():
+        for row in rows:
+            h = row.get("height")
+            if isinstance(h, int):
+                out.setdefault(h, tid)
+                break
+    return out
+
+
+def _depths(rows: list[dict]) -> dict[str, int]:
+    by_id = {r.get("span_id"): r for r in rows if r.get("span_id")}
+
+    def depth(row, hops=0) -> int:
+        if hops > len(rows):  # defensive: a parent cycle must not hang
+            return 0
+        parent = by_id.get(row.get("parent_id"))
+        if parent is None:
+            return 0
+        return 1 + depth(parent, hops + 1)
+
+    return {sid: depth(row) for sid, row in by_id.items()}
+
+
+def render_waterfall(rows: list[dict], width: int = BAR_WIDTH) -> str:
+    """One trace's rows -> a text waterfall: offset from the earliest
+    span, indentation by parent depth, a proportional bar, node label."""
+    if not rows:
+        return "(no spans)"
+    t0 = min(r.get("start_unix", 0.0) for r in rows)
+    t_end = max(r.get("start_unix", 0.0) + r.get("dur_ms", 0.0) / 1e3
+                for r in rows)
+    total_s = max(t_end - t0, 1e-9)
+    depths = _depths(rows)
+    tid = rows[0].get("trace_id", "?")
+    heights = {r["height"] for r in rows if isinstance(r.get("height"), int)}
+    head = f"trace {tid}"
+    if heights:
+        head += f" (height {', '.join(str(h) for h in sorted(heights))})"
+    lines = [head,
+             f"{'offset':>10}  {'dur':>9}  span"]
+    for row in sorted(rows, key=lambda r: (r.get("start_unix", 0.0),
+                                           depths.get(r.get("span_id"), 0))):
+        off_s = row.get("start_unix", 0.0) - t0
+        dur_s = row.get("dur_ms", 0.0) / 1e3
+        lo = min(int(off_s / total_s * width), width - 1)
+        hi = min(max(int((off_s + dur_s) / total_s * width), lo + 1), width)
+        bar = " " * lo + "#" * (hi - lo) + " " * (width - hi)
+        indent = "  " * depths.get(row.get("span_id"), 0)
+        name = row.get("name", "?")
+        node = row.get("node", "")
+        lines.append(
+            f"{off_s * 1e3:8.1f}ms {row.get('dur_ms', 0.0):8.2f}ms "
+            f"|{bar}| {indent}{name}"
+            + (f"  [{node}]" if node else "")
+        )
+    return "\n".join(lines)
+
+
+def collect(urls: list[str], height: int | None = None,
+            since: int = 0, limit: int = 10_000) -> dict:
+    """Scrape + merge a devnet; optionally keep only the given height's
+    trace. Returns {"traces": {trace_id: rows}, "heights": {h: tid}}."""
+    merged = merge_spans(scrape(urls, since=since, limit=limit))
+    heights = heights_of(merged)
+    if height is not None:
+        tid = heights.get(height)
+        merged = {tid: merged[tid]} if tid else {}
+        heights = {height: tid} if tid else {}
+    return {"traces": merged, "heights": heights}
+
+
+def report_text(doc: dict, last: int = 5) -> str:
+    """Render the `last` most recent heights' waterfalls (all traces
+    without a height attr are skipped — they are ad-hoc roots)."""
+    heights = doc.get("heights", {})
+    if not heights:
+        return "(no height-bearing traces found)"
+    chunks = []
+    for h in sorted(heights)[-last:]:
+        chunks.append(render_waterfall(doc["traces"][heights[h]]))
+    return "\n\n".join(chunks)
+
+
+def main(argv=None) -> int:
+    """`python -m celestia_app_tpu.tools.timeline --nodes url1,url2`
+    (the CLI `timeline` subcommand wraps this)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="timeline")
+    ap.add_argument("--nodes", required=True,
+                    help="comma-separated node/validator service URLs")
+    ap.add_argument("--height", type=int, default=None)
+    ap.add_argument("--since", type=int, default=0)
+    ap.add_argument("--limit", type=int, default=10_000)
+    ap.add_argument("--last", type=int, default=5,
+                    help="render the N most recent heights (text mode)")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the merged span rows as JSON instead")
+    args = ap.parse_args(argv)
+    doc = collect([u for u in args.nodes.split(",") if u],
+                  height=args.height, since=args.since, limit=args.limit)
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        print(report_text(doc, last=args.last))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,22 +1,73 @@
-"""Minimal telemetry registry: counters + timing histograms.
+"""Telemetry registry: counters, gauges, bucketed histograms, trace tables.
 
 Reference parity: the reference instruments its hot paths with
 ``telemetry.MeasureSince`` (app/prepare_proposal.go:23,
-app/process_proposal.go:25) and go-metrics counters. This registry is
-process-local and lock-free (CPython dict ops are atomic enough for the
-single-threaded node loop; the HTTP service reads a snapshot copy).
+app/process_proposal.go:25) and go-metrics counters, and serves them
+through a Prometheus endpoint (SURVEY §5.1). This registry is
+process-local and lock-light (CPython dict ops are atomic enough for the
+single-threaded node loop; the HTTP service reads snapshot copies).
+
+Timers are **log-spaced bucketed histograms** (×2 ladder, 1 µs … ~137 s):
+every ``measure_since``/``observe`` lands in a bucket, so ``snapshot()``
+reports p50/p95/p99 estimates (interpolated within the containing bucket
+— error bounded by one bucket width) and ``prometheus()`` emits proper
+``_bucket{le=...}`` / ``_sum`` / ``_count`` histogram families with
+``# HELP`` lines. The nonstandard per-timer max survives as a SEPARATE
+gauge family (``<name>_seconds_max``) so promtool-style parsers accept
+the page. Counters, gauges, and timers all take an optional ``labels``
+dict; labeled series share one family (one HELP/TYPE) in the exposition.
 
 Usage:
     t0 = time.perf_counter()
     ...
     telemetry.measure_since("prepare_proposal", t0)
     telemetry.incr("process_proposal.rejected")
+    telemetry.observe("batch_bytes_s", 0.004, labels={"peer": "val1"})
 Snapshot via telemetry.snapshot() — surfaced in /status and the CLI.
 """
 
 from __future__ import annotations
 
+import bisect
+import threading
 import time
+
+# log-spaced bucket ladder: ×2 per step from 1 µs to ~137 s (28 bounds;
+# a 29th implicit +Inf bucket catches the rest). Wide enough for a jit
+# compile, fine enough that p99 interpolation stays within ~2× truth.
+BUCKET_BOUNDS = tuple(1e-6 * (2.0 ** i) for i in range(28))
+
+
+def _series_key(name: str, labels: dict | None) -> str:
+    """Storage key: the bare name for unlabeled series (the historical
+    snapshot shape), name{k="v",...} for labeled ones."""
+    if not labels:
+        return name
+    inner = ",".join(
+        f'{k}="{labels[k]}"' for k in sorted(labels)
+    )
+    return f"{name}{{{inner}}}"
+
+
+def _quantile(buckets: list[int], count: int, q: float) -> float:
+    """Histogram quantile estimate: find the bucket holding the q-rank
+    observation and interpolate linearly inside it (Prometheus
+    histogram_quantile semantics; error <= one bucket width)."""
+    if count <= 0:
+        return 0.0
+    target = q * count
+    cum = 0
+    for i, n in enumerate(buckets):
+        if n == 0:
+            continue
+        lo = BUCKET_BOUNDS[i - 1] if i > 0 else 0.0
+        hi = BUCKET_BOUNDS[i] if i < len(BUCKET_BOUNDS) \
+            else BUCKET_BOUNDS[-1]
+        if cum + n >= target:
+            frac = (target - cum) / n
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        cum += n
+    return BUCKET_BOUNDS[-1]
 
 
 class Registry:
@@ -24,106 +75,252 @@ class Registry:
         self.counters: dict[str, int] = {}
         self.timers: dict[str, dict] = {}
         self.gauges: dict[str, float] = {}
+        # series key -> (family name, labels) for labeled exposition
+        self._series: dict[str, tuple[str, dict]] = {}
+        self._help: dict[str, str] = {}
+        self._collectors: list = []
 
-    def incr(self, name: str, by: int = 1) -> None:
-        self.counters[name] = self.counters.get(name, 0) + by
+    # -- registration -----------------------------------------------------
 
-    def gauge(self, name: str, value: float) -> None:
+    def set_help(self, name: str, text: str) -> None:
+        """Attach a # HELP line to a metric family (optional; families
+        without one get a generated description)."""
+        self._help[name] = text
+
+    def register_collector(self, fn) -> None:
+        """Scrape-time hook: `fn()` runs (exceptions swallowed) before
+        every snapshot()/prometheus() so gauges that are derived from
+        live state (device memory, cache sizes) stay fresh without a
+        background thread."""
+        if fn not in self._collectors:
+            self._collectors.append(fn)
+
+    def _collect(self) -> None:
+        for fn in list(self._collectors):
+            try:
+                fn()
+            except Exception:
+                pass  # a broken collector must never break a scrape
+
+    def _note_series(self, key: str, name: str, labels: dict | None) -> None:
+        if labels and key not in self._series:
+            self._series[key] = (name, dict(labels))
+
+    # -- writes -----------------------------------------------------------
+
+    def incr(self, name: str, by: int = 1, labels: dict | None = None) -> None:
+        key = _series_key(name, labels)
+        self._note_series(key, name, labels)
+        self.counters[key] = self.counters.get(key, 0) + by
+
+    def gauge(self, name: str, value: float,
+              labels: dict | None = None) -> None:
         """Set-type metric (pool sizes, queue depths): last write wins."""
-        self.gauges[name] = value
+        key = _series_key(name, labels)
+        self._note_series(key, name, labels)
+        self.gauges[key] = value
 
-    def measure_since(self, name: str, t0: float) -> float:
-        dt = time.perf_counter() - t0
-        t = self.timers.setdefault(
-            name, {"count": 0, "total_s": 0.0, "max_s": 0.0, "last_s": 0.0}
-        )
+    def observe(self, name: str, value_s: float,
+                labels: dict | None = None) -> float:
+        """Record one observation (seconds, or any unit — the ladder is
+        unitless) into the named histogram."""
+        key = _series_key(name, labels)
+        self._note_series(key, name, labels)
+        t = self.timers.get(key)
+        if t is None:
+            t = self.timers[key] = {
+                "count": 0, "total_s": 0.0, "max_s": 0.0, "last_s": 0.0,
+                "buckets": [0] * (len(BUCKET_BOUNDS) + 1),
+            }
         t["count"] += 1
-        t["total_s"] += dt
-        t["max_s"] = max(t["max_s"], dt)
-        t["last_s"] = dt
-        return dt
+        t["total_s"] += value_s
+        if value_s > t["max_s"]:
+            t["max_s"] = value_s
+        t["last_s"] = value_s
+        t["buckets"][bisect.bisect_left(BUCKET_BOUNDS, value_s)] += 1
+        return value_s
+
+    def measure_since(self, name: str, t0: float,
+                      labels: dict | None = None) -> float:
+        return self.observe(name, time.perf_counter() - t0, labels=labels)
+
+    # -- reads ------------------------------------------------------------
+
+    def quantiles(self, name: str, qs=(0.5, 0.95, 0.99),
+                  labels: dict | None = None) -> dict[float, float]:
+        t = self.timers.get(_series_key(name, labels))
+        if t is None:
+            return {q: 0.0 for q in qs}
+        buckets, count = list(t["buckets"]), t["count"]
+        return {q: _quantile(buckets, count, q) for q in qs}
 
     def snapshot(self) -> dict:
+        self._collect()
         out = {"counters": dict(self.counters), "timers": {},
                "gauges": dict(self.gauges)}
-        for name, t in self.timers.items():
-            avg = t["total_s"] / t["count"] if t["count"] else 0.0
-            out["timers"][name] = {**t, "avg_s": avg}
+        for name, t in list(self.timers.items()):
+            t = dict(t)
+            buckets = list(t.pop("buckets", ()))
+            count = t["count"]
+            avg = t["total_s"] / count if count else 0.0
+            out["timers"][name] = {
+                **t, "avg_s": avg,
+                "p50_s": _quantile(buckets, count, 0.5),
+                "p95_s": _quantile(buckets, count, 0.95),
+                "p99_s": _quantile(buckets, count, 0.99),
+            }
         return out
 
     def reset(self) -> None:
         self.counters.clear()
         self.timers.clear()
         self.gauges.clear()
+        self._series.clear()
+
+    # -- Prometheus text exposition ---------------------------------------
+
+    def _family(self, key: str) -> tuple[str, str]:
+        """(family name, label string incl. braces or '') for a series."""
+        if key in self._series:
+            name, labels = self._series[key]
+            inner = ",".join(
+                f'{k}="{labels[k]}"' for k in sorted(labels)
+            )
+            return name, inner
+        return key, ""
+
+    @staticmethod
+    def _san(name: str) -> str:
+        return "".join(
+            ch if ch.isalnum() or ch == "_" else "_" for ch in name
+        )
+
+    def _help_line(self, metric: str, family: str, default: str) -> str:
+        return f"# HELP {metric} {self._help.get(family, default)}"
 
     def prometheus(self, prefix: str = "celestia") -> str:
-        """Prometheus text exposition of the registry (the reference wires
+        """Prometheus text exposition (the reference wires
         node.DefaultMetricsProvider + a prometheus endpoint —
         test/util/testnode/full_node.go:44, SURVEY §5.1). Counters become
-        `<prefix>_<name>_total`; timers become `_seconds_{count,sum,max}`."""
-
-        def _san(name: str) -> str:
-            return "".join(
-                ch if ch.isalnum() or ch == "_" else "_" for ch in name
-            )
-
+        ``<prefix>_<name>_total``; timers are real histograms
+        (``_bucket``/``_sum``/``_count``) with the per-timer max exposed
+        as a SEPARATE ``_max`` gauge family; every family carries
+        ``# HELP`` + ``# TYPE``."""
+        self._collect()
         # snapshot copies: another thread may insert a first-time metric
         # mid-scrape (the docstring's promise that readers see a copy)
         counters = dict(self.counters)
-        timers = {k: dict(v) for k, v in dict(self.timers).items()}
+        timers = {k: {**v, "buckets": list(v["buckets"])}
+                  for k, v in dict(self.timers).items()}
         gauges = dict(self.gauges)
+
+        # group series into families so HELP/TYPE appear once per family
+        def families(keys):
+            fams: dict[str, list[tuple[str, str]]] = {}
+            for key in sorted(keys):
+                fam, inner = self._family(key)
+                fams.setdefault(fam, []).append((inner, key))
+            return sorted(fams.items())
+
         lines: list[str] = []
-        for name, v in sorted(counters.items()):
-            m = f"{prefix}_{_san(name)}_total"
+        for fam, members in families(counters):
+            m = f"{prefix}_{self._san(fam)}_total"
+            lines.append(self._help_line(m, fam, f"counter {fam}"))
             lines.append(f"# TYPE {m} counter")
-            lines.append(f"{m} {v}")
-        for name, v in sorted(gauges.items()):
-            m = f"{prefix}_{_san(name)}"
+            for inner, key in members:
+                lbl = f"{{{inner}}}" if inner else ""
+                lines.append(f"{m}{lbl} {counters[key]}")
+        for fam, members in families(gauges):
+            m = f"{prefix}_{self._san(fam)}"
+            lines.append(self._help_line(m, fam, f"gauge {fam}"))
             lines.append(f"# TYPE {m} gauge")
-            lines.append(f"{m} {v}")
-        for name, t in sorted(timers.items()):
-            base = f"{prefix}_{_san(name)}_seconds"
-            lines.append(f"# TYPE {base} summary")
-            lines.append(f"{base}_count {t['count']}")
-            lines.append(f"{base}_sum {t['total_s']:.9f}")
-            lines.append(f"{base}_max {t['max_s']:.9f}")
+            for inner, key in members:
+                lbl = f"{{{inner}}}" if inner else ""
+                lines.append(f"{m}{lbl} {gauges[key]}")
+        timer_fams = families(timers)
+        for fam, members in timer_fams:
+            base = f"{prefix}_{self._san(fam)}_seconds"
+            lines.append(self._help_line(
+                base, fam, f"latency histogram {fam} (seconds)"
+            ))
+            lines.append(f"# TYPE {base} histogram")
+            for inner, key in members:
+                t = timers[key]
+                cum = 0
+                for i, bound in enumerate(BUCKET_BOUNDS):
+                    cum += t["buckets"][i]
+                    le = f'le="{bound:.9g}"'
+                    lbl = f"{{{inner},{le}}}" if inner else f"{{{le}}}"
+                    lines.append(f"{base}_bucket{lbl} {cum}")
+                lbl = f'{{{inner},le="+Inf"}}' if inner \
+                    else '{le="+Inf"}'
+                lines.append(f"{base}_bucket{lbl} {t['count']}")
+                slbl = f"{{{inner}}}" if inner else ""
+                lines.append(f"{base}_sum{slbl} {t['total_s']:.9f}")
+                lines.append(f"{base}_count{slbl} {t['count']}")
+        for fam, members in timer_fams:
+            # the max is NOT a histogram series: its own gauge family
+            # (promtool rejects unknown suffixes inside a histogram)
+            m = f"{prefix}_{self._san(fam)}_seconds_max"
+            lines.append(self._help_line(
+                m, fam + ".max", f"max observed latency of {fam} (seconds)"
+            ))
+            lines.append(f"# TYPE {m} gauge")
+            for inner, key in members:
+                lbl = f"{{{inner}}}" if inner else ""
+                lines.append(f"{m}{lbl} {timers[key]['max_s']:.9f}")
         return "\n".join(lines) + "\n"
 
 
 class TraceTables:
     """Columnar event tracing — the celestia-core ``pkg/trace`` analog
     (SURVEY §5.1): PER-NODE tables of schema'd rows (``BlockSummary``,
-    ``RoundState``-style) that e2e tooling pulls over RPC
-    (test/e2e/testnet/node.go:52-75). Each App owns an instance
-    (`app.traces`) so multi-node in-process networks never interleave;
-    the module-level singleton below serves ad-hoc/process-wide use.
-    Tables are bounded ring buffers; rows carry a monotonically
-    increasing index so pullers can resume."""
+    ``RoundState``-style, and the observability plane's ``spans``) that
+    e2e tooling pulls over RPC (test/e2e/testnet/node.go:52-75). Each App
+    owns an instance (`app.traces`) so multi-node in-process networks
+    never interleave; the module-level singleton below serves
+    ad-hoc/process-wide use. Tables are bounded ring buffers; rows carry
+    a monotonically increasing index so pullers can resume. Writes are
+    locked: spans land from HTTP handler threads and reactor threads
+    concurrently."""
 
     MAX_ROWS = 10_000
 
     def __init__(self):
         self._tables: dict[str, list[dict]] = {}
         self._next_index: dict[str, int] = {}
+        self._lock = threading.Lock()
 
     def write(self, table: str, **row) -> None:
-        rows = self._tables.setdefault(table, [])
-        idx = self._next_index.get(table, 0)
-        rows.append({"_index": idx, **row})
-        self._next_index[table] = idx + 1
-        if len(rows) > self.MAX_ROWS:
-            del rows[: len(rows) - self.MAX_ROWS]
+        with self._lock:
+            rows = self._tables.setdefault(table, [])
+            idx = self._next_index.get(table, 0)
+            rows.append({"_index": idx, **row})
+            self._next_index[table] = idx + 1
+            if len(rows) > self.MAX_ROWS:
+                del rows[: len(rows) - self.MAX_ROWS]
 
-    def read(self, table: str, since_index: int = 0, limit: int = 1000) -> list[dict]:
-        rows = self._tables.get(table, [])
-        return [r for r in rows if r["_index"] >= since_index][:limit]
+    def read(self, table: str, since_index: int = 0,
+             limit: int = 1000) -> list[dict]:
+        """Rows with _index >= since_index (at most `limit`). _index is
+        monotonic within a table, so the resume point is found with
+        bisect + slice — O(log n + limit), not the former O(n) full-table
+        scan e2e pullers paid on every poll tick."""
+        with self._lock:
+            rows = self._tables.get(table, [])
+            start = bisect.bisect_left(
+                rows, since_index, key=lambda r: r["_index"]
+            )
+            return [dict(r) for r in rows[start:start + limit]]
 
     def tables(self) -> list[str]:
-        return sorted(self._tables)
+        with self._lock:
+            return sorted(self._tables)
 
     def reset(self) -> None:
-        self._tables.clear()
-        self._next_index.clear()
+        with self._lock:
+            self._tables.clear()
+            self._next_index.clear()
 
 
 _global = Registry()
@@ -131,10 +328,14 @@ _traces = TraceTables()
 
 incr = _global.incr
 gauge = _global.gauge
+observe = _global.observe
 measure_since = _global.measure_since
+quantiles = _global.quantiles
 snapshot = _global.snapshot
 prometheus = _global.prometheus
 reset = _global.reset
+set_help = _global.set_help
+register_collector = _global.register_collector
 trace = _traces.write
 read_trace = _traces.read
 trace_tables = _traces.tables

@@ -387,7 +387,8 @@ def test_direct_push_delivery_is_reannounced():
     reactor.on_tx({"tx": base64.b64encode(raw).decode()})
     reactor._admit_pending_txs()
     assert vnode.pool.has(tx_hash(raw))
-    announced = [(path, payload) for path, payload in sent
+    # sender items are (path, payload, span_ctx) since the obs plane
+    announced = [(path, payload) for path, payload, _ctx in sent
                  if path == "/gossip/seen_tx"]
     assert len(announced) == 2  # both peers, neither known to have it
     assert all(p["hash"] == tx_hash(raw).hex() and p["from"] == "http://me"
