@@ -623,6 +623,9 @@ def main() -> None:
     if "--chaos" in sys.argv:
         measure_chaos()
         return
+    if "--analyze" in sys.argv:
+        measure_analyze()
+        return
     if "--obs" in sys.argv:
         measure_obs()
         return
@@ -658,6 +661,35 @@ def main() -> None:
               file=sys.stderr)
         return
     _run_parent()
+
+
+def measure_analyze(reps: int = 3) -> None:
+    """Analysis-plane bench (--analyze): wall time of a full-tree run of
+    every registered rule (tools/analyze) against the committed
+    analyze.toml — the cost every tier-1 test run and pre-commit hook
+    pays. Budget: < 10 s on CPU (it is pure-AST work; ~1.5 s today).
+    One BENCH JSON line:
+
+      {"metric": "analyze_wall_s", ...}
+    """
+    from celestia_app_tpu.tools.analyze import run_analysis
+
+    best = None
+    rep = None
+    for _ in range(reps):
+        rep = run_analysis()
+        best = rep.wall_s if best is None else min(best, rep.wall_s)
+    print(json.dumps({
+        "metric": "analyze_wall_s",
+        "analyze_wall_s": round(best, 3),
+        "files_scanned": rep.files_scanned,
+        "rules_run": len(rep.rules_run),
+        "violations": len(rep.violations),
+        "errors": len(rep.errors),
+        "waived": len(rep.waived),
+        "budget_s": 10.0,
+        "within_budget": best < 10.0,
+    }))
 
 
 def measure_mempool(n_senders: int = 16, txs_per_sender: int = 32) -> None:

@@ -27,3 +27,15 @@ Layout:
 """
 
 __version__ = "0.1.0"
+
+# Runtime lock-order detection (the analysis plane's dynamic half):
+# CELESTIA_RACE=1 wraps threading.Lock/RLock before any submodule
+# creates one, so chaos/stress runs — including their subprocess
+# nodes, which inherit the env — record lock acquisition order and
+# surface ABBA inversions. See tools/analyze/racecheck.py.
+import os as _os
+
+if _os.environ.get("CELESTIA_RACE", "").strip() == "1":
+    from celestia_app_tpu.tools.analyze import racecheck as _racecheck
+
+    _racecheck.install()

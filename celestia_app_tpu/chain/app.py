@@ -113,6 +113,7 @@ class App:
         self.last_app_hash = self.store.app_hash()
         self.last_block_hash = b"\x00" * 32
         self.genesis_time: float | None = None
+        self.last_block_time: float | None = None
 
         self.auth = modules.AuthKeeper()
         self.bank = modules.BankKeeper()
@@ -172,9 +173,11 @@ class App:
             "blob/gov_max_square_size": _blob_param(
                 "gov_max_square_size", 1, appconsts.MAX_EXTENDED_SQUARE_WIDTH // 2
             ),
+            # gas prices are sdk.Dec-shaped floats end to end (see the
+            # det-float waiver on wire/txpb.py in analyze.toml)
             "minfee/network_min_gas_price": lambda ctx, v:
                 self.minfee.set_network_min_gas_price(
-                    ctx, _require(v, float, 0.0, 1e12)
+                    ctx, _require(v, float, 0.0, 1e12)  # lint: disable=det-float
                 ),
             "blobstream/data_commitment_window": lambda ctx, v:
                 self.blobstream.set_data_commitment_window(
@@ -351,6 +354,9 @@ class App:
             except Exception:
                 if self.engine == "device":
                     raise
+                # engine=auto: count the silent degrade — a node that
+                # quietly lost its accelerator should show it in /metrics
+                telemetry.incr("app.device_path_fallback")
         # host path: the BLAS+hashlib pipeline (utils/fast_host), bit-equal
         # to the device path and the refimpl oracle (tests/test_fast_host)
         # but ~100x faster than the oracle — a validator process on the
@@ -386,7 +392,10 @@ class App:
         attestations, ...), which replaces the fresh-validator setup and
         restores account sequences so old-chain txs cannot replay."""
         ctx = self._deliver_ctx(InfiniteGasMeter())
-        self.genesis_time = genesis.get("time_unix", time_mod.time())
+        # a missing genesis time must NOT fall back to the wall clock:
+        # every validator initializing the same genesis doc must compute
+        # identical state (the analyzer's det-wallclock rule)
+        self.genesis_time = genesis.get("time_unix", 0)
         if "raw_modules" in genesis:
             # verbatim module restore FIRST — auth/ (account numbers,
             # pubkeys, sequences, the next-number counter) must be in place
@@ -426,12 +435,22 @@ class App:
     # helpers
     # ------------------------------------------------------------------
 
+    def _chain_time(self) -> float:
+        """Deterministic time anchor for contexts not given an explicit
+        block time (check-tx, queries, simulation): the last committed
+        block's header time, before any block the genesis time. A wall-
+        clock read here would let two nodes disagree on check/query
+        results for the same state (det-wallclock)."""
+        if self.last_block_time is not None:
+            return self.last_block_time
+        return self.genesis_time or 0
+
     def _ctx(self, store, gas_meter, *, check: bool, height=None, t=None) -> Context:
         return Context(
             store,
             gas_meter,
             height if height is not None else self.height + 1,
-            t if t is not None else time_mod.time(),
+            t if t is not None else self._chain_time(),
             self.chain_id,
             self.app_version,
             is_check_tx=check,
@@ -483,8 +502,11 @@ class App:
     def prepare_proposal(
         self, raw_txs: list[bytes], proposer: bytes = b"", t: float | None = None
     ) -> ProposalResult:
-        _t0 = time_mod.perf_counter()
-        t = t if t is not None else time_mod.time()
+        _t0 = telemetry.start_timer()
+        # the PROPOSER's wall clock is the protocol's source of header
+        # time (Tendermint BFT-time analog); every other node consumes
+        # block.header.time_unix verbatim
+        t = t if t is not None else time_mod.time()  # lint: disable=det-wallclock
         height = self.height + 1
         # root span of the block lifecycle: the trace id derives from
         # (chain_id, height), so followers and DAS light nodes stamp the
@@ -612,7 +634,7 @@ class App:
     def process_proposal(self, block: Block) -> bool:
         """True = accept. Any validation failure or internal panic rejects
         (process_proposal.go:29-35 defer/recover)."""
-        _t0 = time_mod.perf_counter()
+        _t0 = telemetry.start_timer()
         try:
             with obs.span(
                 "process_proposal", traces=self.traces,
@@ -784,7 +806,9 @@ class App:
                 self.ante.run(fee_ctx, tx)
                 fee_ctx.store.write()
             except Exception:
-                pass
+                # an unre-runnable ante means the failed tx keeps neither
+                # fee nor sequence bump — count it, it undercharges
+                telemetry.incr("app.fee_reapply_errors")
             return TxResult(1, str(e), tx.body.gas_limit, gas.consumed, [])
 
     def simulate_tx(self, raw: bytes) -> TxResult:
@@ -1012,7 +1036,7 @@ class App:
             return self._commit_inner(block)
 
     def _commit_inner(self, block: Block) -> bytes:
-        t0 = time_mod.perf_counter()
+        t0 = telemetry.start_timer()
         # root BEFORE height: lockless readers pairing (height,
         # last_app_hash) — ChainHandle.status_pair — can then never
         # observe a height whose root is still the previous block's;
@@ -1022,6 +1046,7 @@ class App:
         self.last_app_hash = self.store.app_hash()
         self.height = block.header.height
         self.last_block_hash = block.header.hash()
+        self.last_block_time = block.header.time_unix
         meta = self._commit_meta()
         if self.db is not None:
             # durable commit: state + block hit disk atomically before the
@@ -1035,6 +1060,7 @@ class App:
                 "app_version": self.app_version,
                 "last_app_hash": self.last_app_hash,
                 "last_block_hash": self.last_block_hash,
+                "last_block_time": self.last_block_time,
             }
             for h in [
                 h for h in self._history if h <= self.height - self.SNAPSHOT_KEEP
@@ -1066,6 +1092,7 @@ class App:
             "last_block_hash": self.last_block_hash.hex(),
             "chain_id": self.chain_id,
             "genesis_time": self.genesis_time,
+            "last_block_time": self.last_block_time,
         }
 
     def persist_identity(self) -> None:
@@ -1098,6 +1125,16 @@ class App:
         self.last_block_hash = bytes.fromhex(meta["last_block_hash"])
         self.chain_id = meta["chain_id"]
         self.genesis_time = meta["genesis_time"]
+        # restore the deterministic time anchor: the meta carries it
+        # (prune-proof, no block decode); metas written before the
+        # anchor existed fall back to the block, then to genesis time
+        self.last_block_time = meta.get("last_block_time")
+        if self.last_block_time is None and h > 0:
+            try:
+                self.last_block_time = \
+                    self.db.load_block(h).header.time_unix
+            except FileNotFoundError:
+                self.last_block_time = None
         self._check_state = None  # stale mempool overlay dies with the old timeline
         self.state_generation += 1
 
@@ -1116,6 +1153,7 @@ class App:
         self.app_version = snap["app_version"]
         self.last_app_hash = snap["last_app_hash"]
         self.last_block_hash = snap["last_block_hash"]
+        self.last_block_time = snap["last_block_time"]
         self._check_state = None
         self.state_generation += 1
 

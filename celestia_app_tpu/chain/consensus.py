@@ -40,6 +40,7 @@ from celestia_app_tpu.chain.app import App
 from celestia_app_tpu.chain.block import Block, Header
 from celestia_app_tpu.chain.crypto import PrivateKey, PublicKey
 from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+from celestia_app_tpu.utils import telemetry
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1117,6 +1118,7 @@ class LocalNetwork:
             block = proposer.propose(t)
         except Exception:
             # proposer crash = propose-timeout: nil round, rotate
+            telemetry.incr("consensus.propose_errors")
             self._round += 1
             return None, None
         bh = block.header.hash()

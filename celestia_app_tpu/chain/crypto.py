@@ -25,6 +25,8 @@ import dataclasses
 import hashlib
 import hmac
 
+from celestia_app_tpu.utils import telemetry
+
 try:  # OpenSSL-backed fast path
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import ec
@@ -225,6 +227,9 @@ class PublicKey:
             try:
                 return _py_verify(self.compressed, signature, message)
             except Exception:
+                # malformed points/signatures verify False, but COUNTED:
+                # a flood of exploding verifies should show in /metrics
+                telemetry.incr("crypto.verify_errors")
                 return False
         try:
             pub = ec.EllipticCurvePublicKey.from_encoded_point(
@@ -238,6 +243,7 @@ class PublicKey:
             pub.verify(der, _sha(message), ec.ECDSA(Prehashed(hashes.SHA256())))
             return True
         except Exception:
+            telemetry.incr("crypto.verify_errors")
             return False
 
 

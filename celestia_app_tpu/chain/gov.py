@@ -25,7 +25,10 @@ from __future__ import annotations
 
 import json
 
+from celestia_app_tpu import obs
 from celestia_app_tpu.chain.state import Context, get_json, put_json
+
+log = obs.get_logger("chain.gov")
 
 # celestia mainnet-flavored defaults (scaled: periods in seconds)
 DEFAULT_MIN_DEPOSIT = 10_000_000_000  # 10,000 TIA in utia
@@ -267,6 +270,13 @@ class GovKeeper:
                             self._execute(ctx, p)
                             p["status"] = "passed"
                         except Exception as e:
+                            # the failure is consensus state (every node
+                            # records it identically); the log line is
+                            # the operator's pointer to it
+                            log.warning(
+                                "proposal execution failed",
+                                id=p["id"], err=e,
+                            )
                             p["status"] = "failed"
                             p["failure"] = str(e)
                     else:

@@ -221,8 +221,9 @@ class ConsensusReactor:
             try:
                 self._senders[u].put_nowait((path, payload, ctx))
             except Exception:
-                pass  # queue full (peer long dead): drop — gossip is
-                # best-effort; the pull-probe recovers anything that matters
+                # queue full (peer long dead): drop — gossip is best-
+                # effort; the pull-probe recovers anything that matters
+                telemetry.incr("reactor.gossip_dropped")
 
     def _start_senders(self) -> None:
         """One sender queue+thread per peer, created once at start (the
@@ -237,7 +238,7 @@ class ConsensusReactor:
                 while not self._stop.is_set():
                     try:
                         item = qq.get(timeout=1.0)
-                    except Exception:
+                    except queue.Empty:
                         continue
                     path, payload, ctx = item
                     if self.cfg.gossip_delay > 0:  # injected latency
@@ -348,7 +349,7 @@ class ConsensusReactor:
                     ("/gossip/seen_tx", payload, ctx)
                 )
             except Exception:
-                pass  # best-effort, like all gossip
+                telemetry.incr("reactor.gossip_dropped")  # best-effort
 
     def gossip_tx(self, raw: bytes) -> None:
         """Announce a locally-admitted tx to peers (mempool reactor out);
@@ -515,7 +516,8 @@ class ConsensusReactor:
                 elapsed_ms=round((time.monotonic() - t0) * 1e3, 3),
             )
         except Exception:
-            pass  # observability must never kill consensus
+            # observability must never kill consensus — but not silently
+            telemetry.incr("obs.trace_write_errors")
 
     def _wait(self, deadline: float, check):
         """Poll `check` (under _msg_lock) until non-None or deadline."""

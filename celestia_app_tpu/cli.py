@@ -1821,6 +1821,24 @@ def cmd_txsim(args) -> int:
     return 0
 
 
+def cmd_analyze(args) -> int:
+    """The analysis plane (tools/analyze): run every registered rule
+    over the package tree against the committed analyze.toml. Exit 0
+    on a clean (or fully waived) tree, 1 when any error-severity
+    violation survives — the same verdict tests/test_analyze.py pins."""
+    from celestia_app_tpu.tools.analyze import load_config, run_analysis
+    from celestia_app_tpu.tools.analyze.report import to_json_text, to_text
+
+    config = load_config(args.config) if args.config else None
+    only = set(args.rule) if args.rule else None
+    rep = run_analysis(root=args.root, config=config, only_rules=only)
+    if args.json:
+        print(to_json_text(rep))
+    else:
+        print(to_text(rep, verbose=args.verbose))
+    return 1 if rep.errors else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="celestia_app_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -2144,6 +2162,25 @@ def main(argv=None) -> int:
     p.add_argument("--blob-sizes", default="100-2000")
     p.add_argument("--blobs-per-pfb", default="1-3")
     p.set_defaults(fn=cmd_txsim)
+
+    p = sub.add_parser(
+        "analyze",
+        help="static-analysis plane: consensus-determinism, exception "
+             "hygiene, jit purity, and lock-discipline rules over the "
+             "package tree (config: analyze.toml)",
+    )
+    p.add_argument("--json", action="store_true",
+                   help="JSON report (docs/FORMATS.md §11) instead of text")
+    p.add_argument("--root", default=None,
+                   help="directory to analyze (default: the installed "
+                        "celestia_app_tpu package)")
+    p.add_argument("--config", default=None,
+                   help="alternate analyze.toml")
+    p.add_argument("--rule", action="append",
+                   help="run only this rule id (repeatable)")
+    p.add_argument("--verbose", action="store_true",
+                   help="also print waived violations")
+    p.set_defaults(fn=cmd_analyze)
 
     args = ap.parse_args(argv)
     mark = len(_OPEN_APPS)  # only close what THIS invocation opens — tests

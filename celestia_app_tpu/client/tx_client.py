@@ -15,6 +15,7 @@ import re
 
 from celestia_app_tpu import appconsts
 from celestia_app_tpu.chain import modules
+from celestia_app_tpu.utils import telemetry
 from celestia_app_tpu.chain.crypto import PrivateKey
 from celestia_app_tpu.chain.tx import MsgPayForBlobs, MsgSend, Tx, TxBody, sign_tx
 from celestia_app_tpu.da import blob as blob_mod
@@ -391,6 +392,7 @@ class TxClient:
         except Exception:
             # unreachable/failing simulator (HTTP errors, bad body, failed
             # simulation): fall back to the linear model as documented
+            telemetry.incr("txclient.sim_fallback")
             return None
         if isinstance(res, int):
             return res

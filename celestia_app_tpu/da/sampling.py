@@ -21,6 +21,7 @@ import dataclasses
 
 from celestia_app_tpu import appconsts
 from celestia_app_tpu.da.dah import DataAvailabilityHeader
+from celestia_app_tpu.utils import telemetry
 
 NS = appconsts.NAMESPACE_SIZE
 
@@ -88,6 +89,9 @@ def sample_block(
             share, proof = fetch_cell(row, col)
             ok = verify_sample(dah, row, col, share, proof)
         except Exception:
+            # refusals and junk count as failed samples below; the
+            # counter separates "peer errored" from "proof rejected"
+            telemetry.incr("sampling.fetch_errors")
             ok = False
         if ok:
             verified += 1

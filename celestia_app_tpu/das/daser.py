@@ -167,7 +167,9 @@ class DASer:
         self.light = light
         self.store = store
         self.name = name
-        self.cp: Checkpoint = store.load()
+        # the durable sampling watermark; workers consult halted, the
+        # coordinator folds results into it
+        self.cp: Checkpoint = store.load()  # guarded-by: _lock
         self.header_source = header_source or http_header_source(self.peers)
         # the light node's OWN entropy — a withholder that can predict
         # coordinates serves exactly the sampled cells and nothing else
@@ -178,8 +180,14 @@ class DASer:
         # same deterministic per-height trace ids the serving chain uses,
         # so tools/timeline.py merges them into one waterfall
         self.traces = telemetry.TraceTables()
-        self.reports: dict[int, dict] = {}
+        self.reports: dict[int, dict] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
+        # lock-free mirror of cp.halted for the workers' per-height hot
+        # path: _fold holds _lock across an fsync'd checkpoint save, and
+        # samplers must not queue behind the disk just to poll a flag
+        self._halted_evt = threading.Event()
+        if self.cp.halted is not None:
+            self._halted_evt.set()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -187,7 +195,8 @@ class DASer:
 
     @property
     def halted(self) -> bool:
-        return self.cp.halted is not None
+        with self._lock:
+            return self.cp.halted is not None
 
     def _halt(self, height: int, reason: str, data_root: str) -> None:
         with self._lock:
@@ -196,6 +205,7 @@ class DASer:
                     "height": height, "reason": reason,
                     "data_root": data_root,
                 }
+                self._halted_evt.set()
                 self.store.save(self.cp)
         telemetry.incr("daser.halts")
 
@@ -222,7 +232,8 @@ class DASer:
                 # either way this sweep stops following here
                 break
             self._roots[h] = (header.data_hash.hex(), header.square_size)
-            self.cp.network_head = max(self.cp.network_head, h)
+            with self._lock:
+                self.cp.network_head = max(self.cp.network_head, h)
 
     # -- sampling workers ------------------------------------------------
 
@@ -454,12 +465,15 @@ class DASer:
 
     def _pending_heights(self) -> list[tuple[int, str, int]]:
         pend = []
-        for h in range(self.cp.sample_from, self.cp.network_head + 1):
-            if h in self._roots:
-                pend.append((h, *self._roots[h]))
-        for h in sorted(self.cp.failed):
-            if h < self.cp.sample_from and h in self._roots:
-                pend.append((h, *self._roots[h]))  # retry earlier failures
+        with self._lock:
+            for h in range(self.cp.sample_from,
+                           self.cp.network_head + 1):
+                if h in self._roots:
+                    pend.append((h, *self._roots[h]))
+            for h in sorted(self.cp.failed):
+                if h < self.cp.sample_from and h in self._roots:
+                    # retry earlier failures
+                    pend.append((h, *self._roots[h]))
         return pend
 
     def sync(self) -> dict:
@@ -467,11 +481,14 @@ class DASer:
         catch up over every pending height with the bounded worker pool,
         fold results into the checkpoint, and persist it. Returns a
         summary {"head", "sample_from", "sampled", "failed", "halted"}."""
-        if self.halted:
-            return {"halted": self.cp.halted}
+        with self._lock:
+            if self.cp.halted is not None:
+                return {"halted": self.cp.halted}
         self._advance_head()
-        if self.halted:  # a condemned root surfaced during following
-            return {"halted": self.cp.halted}
+        with self._lock:
+            if self.cp.halted is not None:
+                # a condemned root surfaced during following
+                return {"halted": self.cp.halted}
         pending = self._pending_heights()
         results: dict[int, dict] = {}
         if pending:
@@ -480,13 +497,15 @@ class DASer:
                 jobs.put(pending[i:i + self.cfg.job_size])
 
             def worker(rng) -> None:
-                while not self._stop.is_set() and not self.halted:
+                while not self._stop.is_set() \
+                        and not self._halted_evt.is_set():
                     try:
                         job = jobs.get_nowait()
                     except queue_mod.Empty:
                         return
                     for h, root_hex, size in job:
-                        if self._stop.is_set() or self.halted:
+                        if self._stop.is_set() \
+                                or self._halted_evt.is_set():
                             return
                         rep = self._sample_height(h, root_hex, size,
                                                   rng=rng)
@@ -507,42 +526,48 @@ class DASer:
             for t in threads:
                 t.join()
         self._fold(results)
-        return {
-            "head": self.cp.network_head,
-            "sample_from": self.cp.sample_from,
-            "sampled": sorted(h for h, r in results.items()
-                              if r["status"] in ("sampled", "recovered")),
-            "failed": sorted(self.cp.failed),
-            "halted": self.cp.halted,
-        }
+        with self._lock:
+            return {
+                "head": self.cp.network_head,
+                "sample_from": self.cp.sample_from,
+                "sampled": sorted(h for h, r in results.items()
+                                  if r["status"] in ("sampled",
+                                                     "recovered")),
+                "failed": sorted(self.cp.failed),
+                "halted": self.cp.halted,
+            }
 
     def _fold(self, results: dict[int, dict]) -> None:
         """Checkpoint bookkeeping: completed heights clear from the failed
         map; incomplete ones record an attempt; the sample_from watermark
         advances over every height that has a durable disposition."""
         done_now = set()
-        for h, rep in results.items():
-            if rep["status"] in ("sampled", "recovered"):
-                self.cp.failed.pop(h, None)
-                done_now.add(h)
-            elif rep["status"] in ("unavailable", "error"):
-                self.cp.failed[h] = self.cp.failed.get(h, 0) + 1
-        while self.cp.sample_from <= self.cp.network_head and (
-                self.cp.sample_from in done_now
-                or self.cp.sample_from in self.cp.failed):
-            self.cp.sample_from += 1
-        # bound the verified-root map: everything durably sampled and not
-        # awaiting a failed-height retry can go (headers re-verify cheaply)
-        floor = min([self.cp.sample_from] + sorted(self.cp.failed)[:1])
-        for h in [h for h in self._roots if h < floor]:
-            del self._roots[h]
-        self.store.save(self.cp)
+        with self._lock:
+            for h, rep in results.items():
+                if rep["status"] in ("sampled", "recovered"):
+                    self.cp.failed.pop(h, None)
+                    done_now.add(h)
+                elif rep["status"] in ("unavailable", "error"):
+                    self.cp.failed[h] = self.cp.failed.get(h, 0) + 1
+            while self.cp.sample_from <= self.cp.network_head and (
+                    self.cp.sample_from in done_now
+                    or self.cp.sample_from in self.cp.failed):
+                self.cp.sample_from += 1
+            # bound the verified-root map: everything durably sampled
+            # and not awaiting a failed-height retry can go (headers
+            # re-verify cheaply)
+            floor = min(
+                [self.cp.sample_from] + sorted(self.cp.failed)[:1])
+            for h in [h for h in self._roots if h < floor]:
+                del self._roots[h]
+            self.store.save(self.cp)
 
     # -- daemon lifecycle ------------------------------------------------
 
     def run_background(self) -> "DASer":
         def loop() -> None:
-            while not self._stop.is_set() and not self.halted:
+            while not self._stop.is_set() \
+                    and not self._halted_evt.is_set():
                 try:
                     self.sync()
                 except Exception as e:  # keep the daemon alive, loudly

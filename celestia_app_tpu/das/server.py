@@ -104,7 +104,7 @@ class SampleCore:
 
         from celestia_app_tpu.chain.query import QueryError, build_prover
 
-        t0 = time.perf_counter()
+        t0 = telemetry.start_timer()
         guard = self.app_lock if self.app_lock is not None \
             else contextlib.nullcontext()
         try:
@@ -165,7 +165,7 @@ class SampleCore:
         with self._lock:
             if entry.col_prover is not None:
                 return entry.col_prover
-        t0 = time.perf_counter()
+        t0 = telemetry.start_timer()
         eds_t = ExtendedDataSquare(
             np.ascontiguousarray(np.swapaxes(entry.prover.eds.squares, 0, 1))
         )
@@ -277,7 +277,7 @@ class SampleCore:
             height=height, cells=len(cells), axis=axis,
         ) as sp:
             entry = self._entry(height)
-            t0 = time.perf_counter()
+            t0 = telemetry.start_timer()
             samples = []
             served = 0
             for r, c in cells:
@@ -418,6 +418,7 @@ class SampleService:
                     self._send(404 if "not served" in str(e) else 400,
                                {"error": str(e)})
                 except Exception as e:  # never kill the serving thread
+                    telemetry.incr("das.server_errors")
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
             def do_GET(self):

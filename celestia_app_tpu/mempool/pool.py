@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import threading
 import time as time_mod
 
 from celestia_app_tpu import appconsts
@@ -118,46 +119,61 @@ class CATPool:
         self.ttl_blocks = ttl_blocks
         self.ttl_seconds = ttl_seconds  # None disables wall-clock TTL
         self.metrics = metrics or MempoolMetrics()
-        self._txs: dict[bytes, PoolTx] = {}  # hash -> entry, arrival-ordered
-        self._bytes = 0
-        self._next_seq = 0
+        # reentrant: public methods hold it across calls into each other
+        # (add -> expire, reap -> expire). HTTP handler threads, the
+        # reactor's gossip threads, and the node loop all touch the pool
+        # concurrently — membership, byte accounting, and the seq counter
+        # must move together.
+        self._lock = threading.RLock()
+        self._txs: dict[bytes, PoolTx] = {}  # guarded-by: _lock  (hash -> entry, arrival-ordered)
+        self._bytes = 0                      # guarded-by: _lock
+        self._next_seq = 0                   # guarded-by: _lock
 
     # -- introspection ---------------------------------------------------
 
     def __len__(self) -> int:
-        return len(self._txs)
+        with self._lock:
+            return len(self._txs)
 
     def __contains__(self, key: bytes) -> bool:
         """Membership by tx hash (32 bytes) or raw tx bytes."""
-        return (key in self._txs) if len(key) == 32 else (tx_hash(key) in self._txs)
+        with self._lock:
+            return (key in self._txs) if len(key) == 32 \
+                else (tx_hash(key) in self._txs)
 
     def has(self, h: bytes) -> bool:
-        return h in self._txs
+        with self._lock:
+            return h in self._txs
 
     def get_raw(self, h: bytes) -> bytes | None:
-        e = self._txs.get(h)
-        return e.raw if e is not None else None
+        with self._lock:
+            e = self._txs.get(h)
+            return e.raw if e is not None else None
 
     @property
     def pool_bytes(self) -> int:
-        return self._bytes
+        with self._lock:
+            return self._bytes
 
     def entries(self) -> list[PoolTx]:
-        return list(self._txs.values())
+        with self._lock:
+            return list(self._txs.values())
 
     def raws(self) -> list[bytes]:
-        return [e.raw for e in self._txs.values()]
+        with self._lock:
+            return [e.raw for e in self._txs.values()]
 
     def stats(self) -> dict:
-        return {
-            "count": len(self._txs),
-            "bytes": self._bytes,
-            **self.metrics.snapshot(),
-        }
+        with self._lock:
+            return {
+                "count": len(self._txs),
+                "bytes": self._bytes,
+                **self.metrics.snapshot(),
+            }
 
     # -- mutation core ---------------------------------------------------
 
-    def _insert(self, raw: bytes, h: bytes, meta: tuple[float, bytes | None],
+    def _insert_locked(self, raw: bytes, h: bytes, meta: tuple[float, bytes | None],
                 height: int, now: float, result: TxResult) -> None:
         self._txs[h] = PoolTx(
             raw=raw, hash=h, gas_price=meta[0], sender=meta[1],
@@ -168,7 +184,7 @@ class CATPool:
         self._bytes += len(raw)
         self.metrics.set_size(len(self._txs), self._bytes)
 
-    def _drop(self, h: bytes, counter: str | None) -> PoolTx | None:
+    def _drop_locked(self, h: bytes, counter: str | None) -> PoolTx | None:
         e = self._txs.pop(h, None)
         if e is None:
             return None
@@ -181,7 +197,7 @@ class CATPool:
     def _lane_key(self, e: PoolTx):
         return e.sender if e.sender is not None else (b"raw", e.hash)
 
-    def _eviction_plan(self, incoming_price: float,
+    def _eviction_plan_locked(self, incoming_price: float,
                        incoming_len: int) -> list[PoolTx] | None:
         """Plan (without mutating) the evictions that make room for an
         incoming tx; None = no legal plan, refuse the tx. Computed BEFORE
@@ -232,43 +248,46 @@ class CATPool:
         supplies a pre-parsed (gas_price, sender)."""
         now = time_mod.time() if now is None else now
         h = tx_hash(raw)
-        existing = self._txs.get(h)
-        if existing is not None:
-            self.metrics.incr(DUPLICATE)
-            return existing.result
-        oversize = check_mempool_size(raw)
-        if oversize is not None:
-            self.metrics.incr(REJECTED)
-            return oversize
         if meta is None:
-            meta = parse_tx_meta(raw)
-        if (len(self._txs) + 1 > self.max_txs
-                or self._bytes + len(raw) > self.max_pool_bytes):
-            # at a cap: sweep TTL-expired entries before evicting live
-            # ones (the sweep is O(n), so it runs only when space is
-            # actually needed; reap() sweeps on every proposal anyway)
-            self.expire(height, now)
-        # capacity verdict BEFORE CheckTx: App.check_tx WRITES into the
-        # persistent check state (sequence bump, fee deduction) — running
-        # it for a tx the pool then refuses would desync the sender's
-        # whole lane until the next commit resets the state
-        plan = self._eviction_plan(meta[0], len(raw))
-        if plan is None:
-            self.metrics.incr(REJECTED)
-            return TxResult(1, "mempool is full", 0, 0, [])
-        if check_fn is not None:
-            res = check_fn(raw)
-            if res.code != 0:
+            meta = parse_tx_meta(raw)  # parse OUTSIDE the lock (pure)
+        with self._lock:
+            existing = self._txs.get(h)
+            if existing is not None:
+                self.metrics.incr(DUPLICATE)
+                return existing.result
+            oversize = check_mempool_size(raw)
+            if oversize is not None:
                 self.metrics.incr(REJECTED)
-                return res
-        else:
-            res = TxResult(0, "", 0, 0, [])
-        # evictions apply only now — an invalid tx must not evict anything
-        for victim in plan:
-            self._drop(victim.hash, EVICTED)
-        self._insert(raw, h, meta, height, now, res)
-        self.metrics.incr(ADMITTED)
-        return res
+                return oversize
+            if (len(self._txs) + 1 > self.max_txs
+                    or self._bytes + len(raw) > self.max_pool_bytes):
+                # at a cap: sweep TTL-expired entries before evicting
+                # live ones (the sweep is O(n), so it runs only when
+                # space is actually needed; reap() sweeps per proposal)
+                self.expire(height, now)
+            # capacity verdict BEFORE CheckTx: App.check_tx WRITES into
+            # the persistent check state (sequence bump, fee deduction)
+            # — running it for a tx the pool then refuses would desync
+            # the sender's whole lane until the next commit resets it.
+            # The lock is held across CheckTx so two admissions cannot
+            # interleave their plans against the same victims.
+            plan = self._eviction_plan_locked(meta[0], len(raw))
+            if plan is None:
+                self.metrics.incr(REJECTED)
+                return TxResult(1, "mempool is full", 0, 0, [])
+            if check_fn is not None:
+                res = check_fn(raw)
+                if res.code != 0:
+                    self.metrics.incr(REJECTED)
+                    return res
+            else:
+                res = TxResult(0, "", 0, 0, [])
+            # evictions apply only now — an invalid tx must not evict
+            for victim in plan:
+                self._drop_locked(victim.hash, EVICTED)
+            self._insert_locked(raw, h, meta, height, now, res)
+            self.metrics.incr(ADMITTED)
+            return res
 
     # -- lifecycle -------------------------------------------------------
 
@@ -278,12 +297,15 @@ class CATPool:
         5×goal-block-time shape). Returns the dropped entries."""
         now = time_mod.time() if now is None else now
         dropped: list[PoolTx] = []
-        for e in list(self._txs.values()):
-            if height - e.height_added >= self.ttl_blocks:
-                dropped.append(self._drop(e.hash, EXPIRED_HEIGHT))
-            elif (self.ttl_seconds is not None
-                  and now - e.time_added >= self.ttl_seconds):
-                dropped.append(self._drop(e.hash, EXPIRED_TIME))
+        with self._lock:
+            for e in list(self._txs.values()):
+                if height - e.height_added >= self.ttl_blocks:
+                    dropped.append(
+                        self._drop_locked(e.hash, EXPIRED_HEIGHT))
+                elif (self.ttl_seconds is not None
+                      and now - e.time_added >= self.ttl_seconds):
+                    dropped.append(
+                        self._drop_locked(e.hash, EXPIRED_TIME))
         return dropped
 
     def reap(self, height: int, now: float | None = None) -> list[bytes]:
@@ -291,19 +313,22 @@ class CATPool:
         order with per-sender arrival order kept (priority_order — the
         order FilterTxs receives candidates in, mempool v1 semantics)."""
         t0 = self.metrics.now()
-        self.expire(height, now)
-        out = priority_order(
-            [(e.raw, e.gas_price, e.sender) for e in self._txs.values()]
-        )
+        with self._lock:
+            self.expire(height, now)
+            out = priority_order(
+                [(e.raw, e.gas_price, e.sender)
+                 for e in self._txs.values()]
+            )
         self.metrics.time_reap(t0)
         return out
 
     def remove_committed(self, txs) -> int:
         """Drop txs that just committed (by content)."""
         n = 0
-        for raw in txs:
-            if self._drop(tx_hash(raw), COMMITTED) is not None:
-                n += 1
+        with self._lock:
+            for raw in txs:
+                if self._drop_locked(tx_hash(raw), COMMITTED) is not None:
+                    n += 1
         return n
 
     def recheck(self, check_fn) -> list[PoolTx]:
@@ -314,16 +339,19 @@ class CATPool:
         fee floor moved) drop instead of wasting a proposal slot. Returns
         the dropped entries."""
         dropped: list[PoolTx] = []
-        for e in sorted(self._txs.values(), key=lambda e: e.seq):
-            res = check_fn(e.raw)
-            if res.code != 0:
-                dropped.append(self._drop(e.hash, RECHECK_DROPPED))
+        with self._lock:
+            for e in sorted(self._txs.values(), key=lambda e: e.seq):
+                res = check_fn(e.raw)
+                if res.code != 0:
+                    dropped.append(
+                        self._drop_locked(e.hash, RECHECK_DROPPED))
         return dropped
 
     def clear(self) -> None:
-        self._txs.clear()
-        self._bytes = 0
-        self.metrics.set_size(0, 0)
+        with self._lock:
+            self._txs.clear()
+            self._bytes = 0
+            self.metrics.set_size(0, 0)
 
 
 # ---------------------------------------------------------------------------

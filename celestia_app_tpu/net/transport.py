@@ -113,7 +113,9 @@ class PeerClient:
                  name: str = "peer"):
         self.cfg = cfg or TransportConfig()
         self.name = name
-        self._peers: dict[str, _PeerState] = {}
+        # url -> breaker/health state, shared by every thread that
+        # sends through this client
+        self._peers: dict[str, _PeerState] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         # jitter entropy only — never consulted by fault injection, so a
         # seeded fault run stays deterministic regardless of this rng
@@ -121,7 +123,7 @@ class PeerClient:
 
     # -- breaker gate -----------------------------------------------------
 
-    def _peer(self, url: str) -> _PeerState:
+    def _peer_locked(self, url: str) -> _PeerState:
         st = self._peers.get(url)
         if st is None:
             st = self._peers[url] = _PeerState()
@@ -144,7 +146,7 @@ class PeerClient:
         attempt is the half-open probe (so failure handling re-opens
         rather than merely counting)."""
         with self._lock:
-            st = self._peer(url)
+            st = self._peer_locked(url)
             if st.state == "closed":
                 return False
             if st.state == "open":
@@ -169,7 +171,7 @@ class PeerClient:
 
     def _record_success(self, url: str, dt_ms: float) -> None:
         with self._lock:
-            st = self._peer(url)
+            st = self._peer_locked(url)
             st.successes += 1
             st.consecutive = 0
             st.probing = False
@@ -182,7 +184,7 @@ class PeerClient:
 
     def _record_failure(self, url: str, err: str, probe: bool) -> None:
         with self._lock:
-            st = self._peer(url)
+            st = self._peer_locked(url)
             st.failures += 1
             st.consecutive += 1
             st.last_error = err[:200]

@@ -112,7 +112,7 @@ class _Instrumented:
 
                     jax.block_until_ready(out)
                 except Exception:
-                    pass
+                    telemetry.incr("jax.profile_probe_errors")
             telemetry.measure_since(
                 "jax.compile" if first else "jax.execute", t0,
                 labels={"fn": self._name},
@@ -145,6 +145,7 @@ def collect_gauges() -> None:
             return
         devices = jax.devices()
     except Exception:
+        telemetry.incr("jax.profile_probe_errors")
         return
     telemetry.gauge("jax.device_count", len(devices))
     with _lock:
@@ -154,17 +155,18 @@ def collect_gauges() -> None:
             f.cache_info().currsize for f in factories
         )))
     except Exception:
-        pass
+        telemetry.incr("jax.profile_probe_errors")
     try:
         telemetry.gauge("jax.live_buffers", float(len(jax.live_arrays())))
     except Exception:
-        pass
+        telemetry.incr("jax.profile_probe_errors")
     in_use = peak = 0.0
     seen = False
     for d in devices:
         try:
             stats = d.memory_stats()
         except Exception:
+            telemetry.incr("jax.profile_probe_errors")
             stats = None
         if not stats:
             continue

@@ -78,7 +78,9 @@ class Logger:
             line = json.dumps({
                 "ts": round(time.time(), 3), "level": level,
                 "logger": self.name, "msg": msg,
-                **{k: _jsonable(v) for k, v in fields.items()},
+                # log lines are operator output, not consensus: field
+                # order is the writer's insertion order on purpose
+                **{k: _jsonable(v) for k, v in fields.items()},  # lint: disable=det-dict-hash
             })
         else:
             kv = " ".join(f"{k}={_jsonable(v)}" for k, v in fields.items())

@@ -133,7 +133,9 @@ class Span:
                 **self.attrs,
             )
         except Exception:
-            pass  # observability must never take down the instrumented path
+            # must never take down the instrumented path — but count it:
+            # a span plane that silently drops rows looks "quiet", not ok
+            telemetry.incr("obs.span_errors")
 
 
 class _NoopSpan:

@@ -35,6 +35,7 @@ import threading
 import numpy as np
 
 from celestia_app_tpu import appconsts
+from celestia_app_tpu.utils import telemetry
 
 
 class DAError(ValueError):
@@ -224,6 +225,7 @@ class DAService:
                 except DAError as e:
                     out, code = {"error": str(e)}, 400
                 except Exception as e:  # never kill the serving thread
+                    telemetry.incr("http.500")
                     out, code = {"error": f"{type(e).__name__}: {e}"}, 500
                 body = json.dumps(out).encode()
                 self.send_response(code)

@@ -39,6 +39,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from celestia_app_tpu import obs
 from celestia_app_tpu.chain.query import QueryError, QueryRouter
+from celestia_app_tpu.utils import telemetry
 
 
 class NodeService:
@@ -161,6 +162,7 @@ class NodeService:
                     # (non-integer height, bad since=): client errors
                     self._send(400, {"error": str(e)})
                 except Exception as e:
+                    telemetry.incr("http.500")
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
             def _post(self):
@@ -341,6 +343,7 @@ class NodeService:
                     # failing node must look unhealthy.
                     self._send(400, {"error": str(e)})
                 except Exception as e:
+                    telemetry.incr("http.500")
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)

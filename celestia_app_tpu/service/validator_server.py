@@ -60,6 +60,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from celestia_app_tpu import obs
 from celestia_app_tpu.chain import consensus as c
+from celestia_app_tpu.utils import telemetry
 
 
 class ValidatorService:
@@ -165,6 +166,7 @@ class ValidatorService:
                     else:
                         self._send(404, {"error": f"no route {self.path}"})
                 except Exception as e:
+                    telemetry.incr("http.500")
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
             def _post(self):
@@ -237,6 +239,7 @@ class ValidatorService:
                 except ValueError as e:
                     self._send(400, {"error": str(e)})
                 except Exception as e:
+                    telemetry.incr("http.500")
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)

@@ -1,0 +1,55 @@
+"""The analysis plane: an AST lint framework for consensus code.
+
+A consensus state machine forks on *any* nondeterminism — wall-clock
+reads, RNG, float arithmetic, set/dict iteration order — in the
+Prepare/Process/apply path, and degrades silently when exceptions are
+swallowed without a log line or a telemetry counter, when side effects
+leak into jitted device code, or when a lock-guarded structure is
+touched outside its lock. Each of those invariants used to be either a
+one-off regex test (the print and urlopen gates) or nothing at all.
+This package is the single home for all of them:
+
+- ``engine``   — rule registry, pragma handling, file walking, scoping
+- ``config``   — ``analyze.toml`` loader (waivers, per-rule scope)
+- ``rules_*``  — the three rule families (determinism, effects, locks)
+- ``report``   — text and JSON reporters
+- ``racecheck``— the runtime lock-order detector (``CELESTIA_RACE=1``)
+
+Run it as ``python -m celestia_app_tpu analyze [--json]``; the tier-1
+test ``tests/test_analyze.py`` runs the full tree and fails on any
+non-waived violation, so every rule stays green as the codebase grows.
+
+This module keeps imports lazy so ``racecheck`` can be imported from
+``celestia_app_tpu/__init__`` without pulling the whole framework in.
+"""
+
+from __future__ import annotations
+
+
+def load_config(path: str | None = None):
+    from celestia_app_tpu.tools.analyze.config import load_config as _lc
+
+    return _lc(path)
+
+
+def run_analysis(root: str | None = None, config=None,
+                 only_rules=None):
+    from celestia_app_tpu.tools.analyze.engine import run_analysis as _ra
+
+    return _ra(root, config, only_rules=only_rules)
+
+
+def default_package_root() -> str:
+    import os
+
+    import celestia_app_tpu
+
+    return os.path.dirname(os.path.abspath(celestia_app_tpu.__file__))
+
+
+def default_config_path() -> str:
+    import os
+
+    return os.path.join(
+        os.path.dirname(default_package_root()), "analyze.toml"
+    )

@@ -1,0 +1,230 @@
+"""``analyze.toml`` loader.
+
+The container's Python (3.10) predates ``tomllib``, so this module
+carries a deliberately small TOML-subset reader covering exactly what
+the committed config uses: ``[table]`` / ``[[array-of-tables]]``
+headers with dotted bare keys, string / integer / boolean scalars, and
+(possibly multiline) arrays of strings. Anything outside the subset is
+a hard parse error — the config is committed, so failing loudly beats
+guessing.
+
+Config shape (normative — docs/FORMATS.md §11):
+
+    [analyze]
+    exclude = ["__pycache__"]            # path prefixes skipped entirely
+
+    [rules.det-wallclock]
+    severity = "error"                   # error | warning | off
+    include = ["wire/", "chain/app.py",  # path prefixes; a
+               "chain/consensus.py::apply"]  # ``path::symbol`` entry
+    exclude = []                         # scopes to one function/method
+    allow = []                           # rule-specific allowlist paths
+
+    [[waivers]]
+    rule = "det-float"
+    path = "da/sampling.py"
+    reason = "confidence reporting, not consensus state"   # REQUIRED
+
+A waiver downgrades every violation of ``rule`` in ``path`` (prefix
+match) to "waived" — still reported, never fatal. Waivers without a
+reason, and waivers that match nothing (stale), are themselves errors:
+the waiver ledger must stay honest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+class ConfigError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# TOML-subset parsing
+# ---------------------------------------------------------------------------
+
+
+def _parse_scalar(tok: str, where: str):
+    tok = tok.strip()
+    if tok.startswith('"') and tok.endswith('"') and len(tok) >= 2:
+        return tok[1:-1]
+    if tok in ("true", "false"):
+        return tok == "true"
+    try:
+        return int(tok)
+    except ValueError:
+        raise ConfigError(f"{where}: unsupported TOML value {tok!r}")
+
+
+def _split_array_items(body: str, where: str) -> list[str]:
+    items, cur, in_str = [], "", False
+    for ch in body:
+        if ch == '"':
+            in_str = not in_str
+            cur += ch
+        elif ch == "," and not in_str:
+            items.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if in_str:
+        raise ConfigError(f"{where}: unterminated string in array")
+    if cur.strip():
+        items.append(cur)
+    return [i for i in (s.strip() for s in items) if i]
+
+
+def parse_toml_subset(text: str) -> dict:
+    """Parse the TOML subset described in the module docstring into
+    nested dicts; ``[[name]]`` tables become lists of dicts."""
+    root: dict = {}
+    target: dict = root
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        raw = lines[i]
+        line = raw.strip()
+        i += 1
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[[") and line.endswith("]]"):
+            path = line[2:-2].strip()
+            parent = root
+            parts = path.split(".")
+            for p in parts[:-1]:
+                parent = parent.setdefault(p, {})
+            arr = parent.setdefault(parts[-1], [])
+            if not isinstance(arr, list):
+                raise ConfigError(f"line {i}: {path} is not a table array")
+            target = {}
+            arr.append(target)
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            path = line[1:-1].strip()
+            parent = root
+            for p in path.split("."):
+                parent = parent.setdefault(p, {})
+                if not isinstance(parent, dict):
+                    raise ConfigError(f"line {i}: {path} is not a table")
+            target = parent
+            continue
+        if "=" not in line:
+            raise ConfigError(f"line {i}: expected key = value, got {raw!r}")
+        key, _, value = line.partition("=")
+        key = key.strip().strip('"')
+        value = value.strip()
+        # strip a trailing comment outside strings
+        value = _strip_comment(value)
+        if value.startswith("["):
+            # gather lines until the closing bracket (multiline arrays)
+            while "]" not in value:
+                if i >= len(lines):
+                    raise ConfigError(f"line {i}: unterminated array")
+                value += " " + _strip_comment(lines[i].strip())
+                i += 1
+            body = value[value.index("[") + 1:value.rindex("]")]
+            target[key] = [
+                _parse_scalar(tok, f"line {i}")
+                for tok in _split_array_items(body, f"line {i}")
+            ]
+        else:
+            target[key] = _parse_scalar(value, f"line {i}")
+    return root
+
+
+def _strip_comment(value: str) -> str:
+    out, in_str = "", False
+    for ch in value:
+        if ch == '"':
+            in_str = not in_str
+        if ch == "#" and not in_str:
+            break
+        out += ch
+    return out.rstrip()
+
+
+# ---------------------------------------------------------------------------
+# config model
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RuleConfig:
+    severity: str = "error"          # error | warning | off
+    include: list[str] = dataclasses.field(default_factory=list)
+    exclude: list[str] = dataclasses.field(default_factory=list)
+    allow: list[str] = dataclasses.field(default_factory=list)
+    options: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Waiver:
+    rule: str
+    path: str
+    reason: str
+    used: int = 0
+
+
+@dataclasses.dataclass
+class AnalyzeConfig:
+    exclude: list[str] = dataclasses.field(default_factory=list)
+    rules: dict[str, RuleConfig] = dataclasses.field(default_factory=dict)
+    waivers: list[Waiver] = dataclasses.field(default_factory=list)
+    source_path: str | None = None
+
+    def rule(self, rule_id: str) -> RuleConfig:
+        cfg = self.rules.get(rule_id)
+        if cfg is None:
+            cfg = self.rules[rule_id] = RuleConfig()
+        return cfg
+
+
+_KNOWN_RULE_KEYS = {"severity", "include", "exclude", "allow"}
+
+
+def config_from_dict(doc: dict, source_path: str | None = None,
+                     ) -> AnalyzeConfig:
+    cfg = AnalyzeConfig(source_path=source_path)
+    top = doc.get("analyze", {})
+    cfg.exclude = list(top.get("exclude", ["__pycache__"]))
+    for rule_id, body in doc.get("rules", {}).items():
+        if not isinstance(body, dict):
+            raise ConfigError(f"[rules.{rule_id}] is not a table")
+        sev = body.get("severity", "error")
+        if sev not in ("error", "warning", "off"):
+            raise ConfigError(
+                f"[rules.{rule_id}] severity must be error|warning|off, "
+                f"got {sev!r}"
+            )
+        cfg.rules[rule_id] = RuleConfig(
+            severity=sev,
+            include=list(body.get("include", [])),
+            exclude=list(body.get("exclude", [])),
+            allow=list(body.get("allow", [])),
+            options={k: v for k, v in body.items()
+                     if k not in _KNOWN_RULE_KEYS},
+        )
+    for w in doc.get("waivers", []):
+        if "reason" not in w or not str(w["reason"]).strip():
+            raise ConfigError(
+                f"waiver for {w.get('rule')}:{w.get('path')} has no reason "
+                "(every waiver must say why)"
+            )
+        if "rule" not in w or "path" not in w:
+            raise ConfigError(f"waiver missing rule/path: {w}")
+        cfg.waivers.append(
+            Waiver(rule=str(w["rule"]), path=str(w["path"]),
+                   reason=str(w["reason"]))
+        )
+    return cfg
+
+
+def load_config(path: str | None = None) -> AnalyzeConfig:
+    if path is None:
+        from celestia_app_tpu.tools.analyze import default_config_path
+
+        path = default_config_path()
+    with open(path) as f:
+        return config_from_dict(parse_toml_subset(f.read()),
+                                source_path=path)

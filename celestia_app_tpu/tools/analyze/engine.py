@@ -1,0 +1,340 @@
+"""Rule engine: AST walk, scoping, pragmas, waivers.
+
+Rules are small classes registered in ``REGISTRY``; each gets a
+``FileContext`` (parsed AST with parent links, import-alias resolution,
+enclosing-function lookup) and yields ``(line, col, message)`` tuples.
+The engine owns everything rules should not re-implement:
+
+- **scoping** — per-rule ``include``/``exclude``/``allow`` path lists
+  from ``analyze.toml``; an include entry may be ``path::symbol`` to
+  scope a rule to one function/method (the consensus apply path);
+- **pragmas** — ``# lint: disable=<rule>[,<rule>...]`` on the flagged
+  line suppresses the violation entirely (strongest precedence);
+- **waivers** — ``[[waivers]]`` entries downgrade matching violations
+  to non-fatal, and stale waivers (matching nothing) are errors.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+from celestia_app_tpu.tools.analyze.config import AnalyzeConfig, RuleConfig
+
+_PRAGMA_RE = re.compile(r"#\s*lint:\s*disable=([\w\-, ]+)")
+
+
+@dataclasses.dataclass
+class Violation:
+    rule: str
+    severity: str      # "error" | "warning"
+    path: str          # package-relative, posix separators
+    line: int
+    col: int
+    message: str
+    waived: bool = False
+    waiver_reason: str | None = None
+
+    def __str__(self) -> str:
+        tag = "waived" if self.waived else self.severity
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{tag}[{self.rule}] {self.message}")
+
+
+class FileContext:
+    """One parsed source file plus the lookups every rule needs."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path                       # package-relative, posix
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                child._lint_parent = parent  # type: ignore[attr-defined]
+        self.aliases = self._import_aliases()
+        self._funcs = self._function_spans()
+        self.pragmas: dict[int, set[str]] = {}
+        for lineno, text in enumerate(self.lines, 1):
+            m = _PRAGMA_RE.search(text)
+            if m:
+                self.pragmas[lineno] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                }
+
+    # -- imports ---------------------------------------------------------
+
+    def _import_aliases(self) -> dict[str, str]:
+        """local name -> canonical dotted path, from this file's imports
+        (``import numpy as np`` -> np=numpy; ``from time import time as
+        now`` -> now=time.time)."""
+        aliases: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def resolve(self, node: ast.AST) -> str | None:
+        """Canonical dotted name of an expression (through import
+        aliases), or None for anything not a plain Name/Attribute
+        chain — ``np.random.default_rng`` -> ``numpy.random.default_rng``."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        head = self.aliases.get(parts[0], parts[0])
+        return ".".join([head] + parts[1:])
+
+    # -- enclosing functions ---------------------------------------------
+
+    def _function_spans(self) -> list[tuple[int, int, str]]:
+        spans: list[tuple[int, int, str]] = []
+
+        def visit(node: ast.AST, qual: list[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    name = ".".join(qual + [child.name])
+                    spans.append(
+                        (child.lineno, child.end_lineno or child.lineno,
+                         name)
+                    )
+                    visit(child, qual + [child.name])
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, qual + [child.name])
+                else:
+                    visit(child, qual)
+
+        visit(self.tree, [])
+        return spans
+
+    def enclosing_qualname(self, line: int) -> str | None:
+        """Qualified name of the innermost function containing `line`
+        (``ClassName.method.inner``), or None at module level."""
+        best: tuple[int, int, str] | None = None
+        for start, end, name in self._funcs:
+            if start <= line <= end:
+                if best is None or start > best[0]:
+                    best = (start, end, name)
+        return best[2] if best else None
+
+    def parents(self, node: ast.AST):
+        while True:
+            node = getattr(node, "_lint_parent", None)
+            if node is None:
+                return
+            yield node
+
+
+class Rule:
+    id = "base"
+    default_severity = "error"
+    help = ""
+
+    def check(self, ctx: FileContext, cfg: RuleConfig):
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+
+REGISTRY: dict[str, Rule] = {}
+
+
+def register(rule_cls: type[Rule]) -> type[Rule]:
+    REGISTRY[rule_cls.id] = rule_cls()
+    return rule_cls
+
+
+def _load_rules() -> None:
+    # importing the rule modules populates REGISTRY via @register
+    from celestia_app_tpu.tools.analyze import (  # noqa: F401
+        rules_determinism,
+        rules_effects,
+        rules_locks,
+    )
+
+
+# ---------------------------------------------------------------------------
+# scoping
+# ---------------------------------------------------------------------------
+
+
+def _path_matches(path: str, entry: str) -> bool:
+    """Prefix path match: ``wire/`` matches the tree, ``cli.py`` the
+    file. An entry may carry a ``::symbol`` suffix (checked later)."""
+    entry = entry.split("::", 1)[0]
+    return path == entry or path.startswith(entry)
+
+
+def _in_scope(path: str, cfg: RuleConfig) -> bool:
+    if any(_path_matches(path, e) for e in cfg.exclude):
+        return False
+    if any(_path_matches(path, e) for e in cfg.allow):
+        return False
+    if cfg.include:
+        return any(_path_matches(path, e) for e in cfg.include)
+    return True
+
+
+def _symbol_scopes(path: str, cfg: RuleConfig) -> list[str] | None:
+    """The ``::symbol`` restrictions that apply to this file, or None
+    when any plain include (or no include at all) covers it whole."""
+    if not cfg.include:
+        return None
+    symbols: list[str] = []
+    for e in cfg.include:
+        base, _, sym = e.partition("::")
+        if not _path_matches(path, base):
+            continue
+        if not sym:
+            return None
+        symbols.append(sym)
+    return symbols
+
+
+# ---------------------------------------------------------------------------
+# the run
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Report:
+    root: str
+    violations: list[Violation]
+    files_scanned: int
+    rules_run: list[str]
+    config_path: str | None
+    wall_s: float = 0.0
+
+    @property
+    def errors(self) -> list[Violation]:
+        return [v for v in self.violations
+                if not v.waived and v.severity == "error"]
+
+    @property
+    def warnings(self) -> list[Violation]:
+        return [v for v in self.violations
+                if not v.waived and v.severity == "warning"]
+
+    @property
+    def waived(self) -> list[Violation]:
+        return [v for v in self.violations if v.waived]
+
+
+def iter_python_files(root: str, exclude: list[str]):
+    for dirpath, dirnames, filenames in os.walk(root):
+        rel_dir = os.path.relpath(dirpath, root)
+        rel_dir = "" if rel_dir == "." else rel_dir.replace(os.sep, "/")
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if not any(_path_matches(
+                (f"{rel_dir}/{d}" if rel_dir else d) + "/", e)
+                or d == e.rstrip("/") for e in exclude)
+        )
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            rel = f"{rel_dir}/{name}" if rel_dir else name
+            if any(_path_matches(rel, e) for e in exclude):
+                continue
+            yield os.path.join(dirpath, name), rel
+
+
+def run_analysis(root: str | None = None,
+                 config: AnalyzeConfig | None = None,
+                 only_rules: set[str] | None = None) -> Report:
+    """Analyze every ``.py`` under `root` (default: the installed
+    ``celestia_app_tpu`` package) against `config` (default: the
+    committed ``analyze.toml``). Stale waivers surface as synthetic
+    ``stale-waiver`` errors so the ledger cannot rot."""
+    import time
+
+    t0 = time.perf_counter()
+    _load_rules()
+    if only_rules is not None:
+        unknown = sorted(set(only_rules) - set(REGISTRY))
+        if unknown:
+            # a silent empty run would let a renamed rule id turn the
+            # tier-1 wrapper gates (print/urlopen) into no-ops
+            raise ValueError(f"unknown rule id(s): {unknown}")
+    if root is None:
+        from celestia_app_tpu.tools.analyze import default_package_root
+
+        root = default_package_root()
+    if config is None:
+        from celestia_app_tpu.tools.analyze.config import load_config
+
+        config = load_config()
+    for w in config.waivers:
+        w.used = 0
+    violations: list[Violation] = []
+    files = 0
+    rules_run = sorted(
+        rid for rid in REGISTRY
+        if config.rule(rid).severity != "off"
+        and (only_rules is None or rid in only_rules)
+    )
+    for abspath, rel in iter_python_files(root, config.exclude):
+        files += 1
+        with open(abspath, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            ctx = FileContext(rel, source)
+        except SyntaxError as e:
+            violations.append(Violation(
+                rule="parse-error", severity="error", path=rel,
+                line=e.lineno or 0, col=e.offset or 0,
+                message=f"cannot parse: {e.msg}",
+            ))
+            continue
+        for rid in rules_run:
+            rcfg = config.rule(rid)
+            if not _in_scope(rel, rcfg):
+                continue
+            symbols = _symbol_scopes(rel, rcfg)
+            for line, col, msg in REGISTRY[rid].check(ctx, rcfg):
+                if rid in ctx.pragmas.get(line, set()):
+                    continue  # pragma wins over everything
+                if symbols is not None:
+                    qual = ctx.enclosing_qualname(line) or ""
+                    parts = qual.split(".")
+                    if not any(sym in parts for sym in symbols):
+                        continue
+                violations.append(Violation(
+                    rule=rid, severity=rcfg.severity, path=rel,
+                    line=line, col=col, message=msg,
+                ))
+    # waivers: first match wins, counted for staleness
+    for v in violations:
+        for w in config.waivers:
+            if w.rule == v.rule and _path_matches(v.path, w.path):
+                v.waived, v.waiver_reason = True, w.reason
+                w.used += 1
+                break
+    for w in config.waivers:
+        # staleness is only decidable when the waiver's rule actually
+        # ran (a --rule-filtered run must not condemn the others)
+        if w.used == 0 and w.rule in rules_run:
+            violations.append(Violation(
+                rule="stale-waiver", severity="error",
+                path=w.path, line=0, col=0,
+                message=(f"waiver for rule {w.rule!r} matched nothing — "
+                         "remove it (or it is masking a typo)"),
+            ))
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return Report(
+        root=root, violations=violations, files_scanned=files,
+        rules_run=rules_run, config_path=config.source_path,
+        wall_s=time.perf_counter() - t0,
+    )

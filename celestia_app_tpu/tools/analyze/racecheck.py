@@ -1,0 +1,267 @@
+"""Runtime lock-order detector (``CELESTIA_RACE=1``).
+
+Static analysis proves guarded fields are touched under their lock;
+it cannot prove two locks are always taken in the same ORDER. This
+module wraps ``threading.Lock``/``threading.RLock`` so every lock
+created after ``install()`` records, per thread, which locks were held
+when it was acquired. Locks are identified by their **creation site**
+(``file:line``) so all instances created at one site form one class —
+two ``CATPool`` objects locked from different threads do not count as
+an inversion against each other (same-site edges are skipped), but
+``reactor.py:157 -> telemetry.py:292`` observed alongside
+``telemetry.py:292 -> reactor.py:157`` is a real ABBA deadlock waiting
+for the right interleaving, and is recorded as a violation.
+
+Activation: ``celestia_app_tpu/__init__`` calls ``install()`` when
+``CELESTIA_RACE=1`` is in the environment, so chaos/stress subprocesses
+get coverage of every lock in the package from the first import. The
+chaos and stress tier-1 tests run under the flag and assert
+``violations() == []`` at teardown.
+
+This module must stay dependency-free (it is imported from the package
+root before anything else).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_orig_lock = threading.Lock
+_orig_rlock = threading.RLock
+
+# internal state is protected by a RAW (untracked) lock — the detector
+# must never trace itself
+_state_lock = _orig_lock()
+_edges: dict[tuple[str, str], dict] = {}   # (site_a, site_b) -> evidence
+_violations: list[dict] = []
+_installed = False
+_tls = threading.local()
+
+
+def _site(depth_hint: int = 2) -> str:
+    """file:line of the frame that called Lock()/RLock()."""
+    import sys
+
+    f = sys._getframe(depth_hint)
+    # skip frames inside this module (e.g. the factory shims)
+    while f is not None and f.f_globals.get("__name__") == __name__:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    path = f.f_code.co_filename
+    parts = path.replace(os.sep, "/").rsplit("/", 3)
+    return f"{'/'.join(parts[-2:])}:{f.f_lineno}"
+
+
+def _held() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def _note_acquire(lock: "_TrackedLock") -> None:
+    stack = _held()
+    # get_ident, NOT current_thread(): the latter allocates a
+    # _DummyThread during thread bootstrap whose Event goes through the
+    # patched locks and recurses straight back here
+    me = f"tid={threading.get_ident()}"
+    with _state_lock:
+        for held in stack:
+            a, b = held._race_site, lock._race_site
+            if a == b:
+                continue  # same creation site: one lock class, no order
+            if (a, b) not in _edges:
+                _edges[(a, b)] = {"thread": me}
+            rev = _edges.get((b, a))
+            if rev is not None and not _already_reported(a, b):
+                import sys
+
+                msg = (
+                    f"RACECHECK: lock-order inversion: {b} -> {a} "
+                    f"(thread {rev['thread']}) vs {a} -> {b} (thread {me})"
+                )
+                try:
+                    # one greppable stderr line — chaos subprocess logs
+                    # are asserted clean of it (tests/test_chaos.py)
+                    sys.stderr.write(msg + "\n")
+                except Exception:
+                    pass
+                _violations.append({
+                    "first": b, "then": a,
+                    "thread_forward": rev["thread"],
+                    "first_rev": a, "then_rev": b,
+                    "thread_reverse": me,
+                    "message": (
+                        f"lock-order inversion: {b} -> {a} "
+                        f"(thread {rev['thread']}) vs {a} -> {b} "
+                        f"(thread {me})"
+                    ),
+                })
+    stack.append(lock)
+
+
+def _already_reported(a: str, b: str) -> bool:
+    return any(
+        {v["first"], v["then"]} == {a, b} for v in _violations
+    )
+
+
+def _note_release(lock: "_TrackedLock") -> None:
+    stack = _held()
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i] is lock:
+            del stack[i]
+            break
+
+
+class _TrackedLock:
+    """Wrapper over a real Lock/RLock that records acquisition order.
+    Implements the Condition-variable integration surface
+    (``_release_save``/``_acquire_restore``/``_is_owned``) so wrapped
+    locks keep working inside ``threading.Condition``/``Event``."""
+
+    def __init__(self, inner, site: str, reentrant: bool):
+        self._race_inner = inner
+        self._race_site = site
+        self._race_reentrant = reentrant
+        self._race_depth_tls = threading.local()
+
+    # -- depth (RLock reentrancy must not re-record edges) ---------------
+
+    def _depth(self) -> int:
+        return getattr(self._race_depth_tls, "n", 0)
+
+    def _set_depth(self, n: int) -> None:
+        self._race_depth_tls.n = n
+
+    # -- the lock protocol ----------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._race_inner.acquire(blocking, timeout)
+        if ok:
+            if self._depth() == 0:
+                _note_acquire(self)
+            self._set_depth(self._depth() + 1)
+        return ok
+
+    def release(self) -> None:
+        d = self._depth()
+        self._race_inner.release()
+        self._set_depth(max(0, d - 1))
+        if d <= 1:
+            _note_release(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        fn = getattr(self._race_inner, "locked", None)
+        if fn is not None:
+            return fn()
+        # RLock grew .locked() only in 3.13; emulate: owned by me, or
+        # a non-blocking probe fails
+        if self._is_owned():
+            return True
+        if self._race_inner.acquire(False):
+            self._race_inner.release()
+            return False
+        return True
+
+    def __repr__(self) -> str:
+        return f"<TrackedLock {self._race_site} {self._race_inner!r}>"
+
+    # -- Condition integration -------------------------------------------
+
+    def _release_save(self):
+        inner_save = getattr(self._race_inner, "_release_save", None)
+        d = self._depth()
+        state = inner_save() if inner_save else self._race_inner.release()
+        self._set_depth(0)
+        _note_release(self)
+        return (state, d)
+
+    def _acquire_restore(self, saved) -> None:
+        state, d = saved
+        inner_restore = getattr(self._race_inner, "_acquire_restore",
+                                None)
+        if inner_restore:
+            inner_restore(state)
+        else:
+            self._race_inner.acquire()
+        if d > 0:
+            _note_acquire(self)
+        self._set_depth(d)
+
+    def _is_owned(self) -> bool:
+        inner_owned = getattr(self._race_inner, "_is_owned", None)
+        if inner_owned:
+            return inner_owned()
+        if self._race_inner.acquire(False):
+            self._race_inner.release()
+            return False
+        return True
+
+    def _at_fork_reinit(self) -> None:
+        reinit = getattr(self._race_inner, "_at_fork_reinit", None)
+        if reinit:
+            reinit()
+        self._set_depth(0)
+
+
+def _make_lock():
+    return _TrackedLock(_orig_lock(), _site(), reentrant=False)
+
+
+def _make_rlock():
+    return _TrackedLock(_orig_rlock(), _site(), reentrant=True)
+
+
+def install() -> bool:
+    """Patch ``threading.Lock``/``RLock`` with tracking factories.
+    Idempotent; affects locks created AFTER the call, which is every
+    lock in the package when installed from ``celestia_app_tpu/
+    __init__`` (the env hook). Returns True when newly installed."""
+    global _installed
+    if _installed:
+        return False
+    _installed = True
+    threading.Lock = _make_lock
+    threading.RLock = _make_rlock
+    return True
+
+
+def uninstall() -> None:
+    global _installed
+    threading.Lock = _orig_lock
+    threading.RLock = _orig_rlock
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def enabled_by_env() -> bool:
+    return os.environ.get("CELESTIA_RACE", "").strip() == "1"
+
+
+def violations() -> list[dict]:
+    with _state_lock:
+        return [dict(v) for v in _violations]
+
+
+def edges() -> list[tuple[str, str]]:
+    with _state_lock:
+        return sorted(_edges)
+
+
+def reset() -> None:
+    with _state_lock:
+        _edges.clear()
+        _violations.clear()

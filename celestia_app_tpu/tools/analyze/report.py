@@ -1,0 +1,70 @@
+"""Text and JSON reporters for analysis runs.
+
+The JSON schema (normative — docs/FORMATS.md §11):
+
+    {
+      "version": 1,
+      "root": "<analyzed directory>",
+      "config": "<analyze.toml path or null>",
+      "summary": {
+        "files_scanned": N, "rules_run": [...],
+        "errors": N, "warnings": N, "waived": N, "wall_s": F
+      },
+      "violations": [
+        {"rule": str, "severity": "error"|"warning", "path": str,
+         "line": int, "col": int, "message": str,
+         "waived": bool, "waiver_reason": str|null}, ...
+      ]
+    }
+"""
+
+from __future__ import annotations
+
+import json
+
+from celestia_app_tpu.tools.analyze.engine import Report
+
+JSON_VERSION = 1
+
+
+def to_json(report: Report) -> dict:
+    return {
+        "version": JSON_VERSION,
+        "root": report.root,
+        "config": report.config_path,
+        "summary": {
+            "files_scanned": report.files_scanned,
+            "rules_run": list(report.rules_run),
+            "errors": len(report.errors),
+            "warnings": len(report.warnings),
+            "waived": len(report.waived),
+            "wall_s": round(report.wall_s, 4),
+        },
+        "violations": [
+            {
+                "rule": v.rule, "severity": v.severity, "path": v.path,
+                "line": v.line, "col": v.col, "message": v.message,
+                "waived": v.waived, "waiver_reason": v.waiver_reason,
+            }
+            for v in report.violations
+        ],
+    }
+
+
+def to_json_text(report: Report) -> str:
+    return json.dumps(to_json(report), indent=2, sort_keys=False)
+
+
+def to_text(report: Report, verbose: bool = False) -> str:
+    lines = []
+    for v in report.violations:
+        if v.waived and not verbose:
+            continue
+        lines.append(str(v))
+    lines.append(
+        f"analyze: {report.files_scanned} files, "
+        f"{len(report.rules_run)} rules, "
+        f"{len(report.errors)} errors, {len(report.warnings)} warnings, "
+        f"{len(report.waived)} waived ({report.wall_s:.2f}s)"
+    )
+    return "\n".join(lines)

@@ -1,0 +1,201 @@
+"""Consensus-determinism rules.
+
+Every validator must compute bit-identical state from the same block:
+a wall-clock read, an unseeded RNG draw, float rounding, or the
+iteration order of an unsorted set can differ between two honest nodes
+and fork the chain (the failure class arXiv:1910.01247 / 2301.08295
+assume away by construction). These rules are scoped, via
+``analyze.toml``, to the consensus-critical modules: ``wire/``,
+``chain/app.py``, the ``chain/consensus.py`` apply path, ``da/``, and
+the ``das/`` proof-serving code.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from celestia_app_tpu.tools.analyze.engine import (
+    FileContext,
+    Rule,
+    register,
+)
+from celestia_app_tpu.tools.analyze.config import RuleConfig
+
+_WALLCLOCK = {
+    "time.time", "time.time_ns",
+    "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+}
+
+_RNG_EXACT = {"os.urandom", "os.getrandom"}
+_RNG_PREFIXES = ("random.", "numpy.random.", "uuid.", "secrets.",
+                 "jax.random.PRNGKey")
+
+
+@register
+class WallClockRule(Rule):
+    id = "det-wallclock"
+    help = ("wall-clock reads in consensus-critical code fork the chain; "
+            "use the block time threaded through the header")
+
+    def check(self, ctx: FileContext, cfg: RuleConfig):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.resolve(node.func)
+            if name in _WALLCLOCK:
+                yield (node.lineno, node.col_offset,
+                       f"wall-clock read {name}() in consensus-critical "
+                       "code (use the block time from the header/config)")
+
+
+@register
+class RngRule(Rule):
+    id = "det-rng"
+    help = ("ambient randomness in consensus-critical code forks the "
+            "chain; thread a seeded rng from config")
+
+    def check(self, ctx: FileContext, cfg: RuleConfig):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.resolve(node.func)
+            if name is None:
+                continue
+            if name in _RNG_EXACT or any(
+                    name.startswith(p) or name == p.rstrip(".")
+                    for p in _RNG_PREFIXES):
+                yield (node.lineno, node.col_offset,
+                       f"nondeterministic rng {name}() in consensus-"
+                       "critical code (thread a seeded generator from "
+                       "config instead)")
+
+
+@register
+class FloatRule(Rule):
+    id = "det-float"
+    help = ("float arithmetic is not bit-stable across platforms/"
+            "backends; consensus math must stay integral")
+
+    def check(self, ctx: FileContext, cfg: RuleConfig):
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, float)):
+                yield (node.lineno, node.col_offset,
+                       f"float literal {node.value!r} in consensus-"
+                       "critical code (use integers / fixed-point)")
+            elif isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                            ast.Div):
+                yield (node.lineno, node.col_offset,
+                       "true division yields a float in consensus-"
+                       "critical code (use // or integer math)")
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "float"):
+                yield (node.lineno, node.col_offset,
+                       "float() cast in consensus-critical code")
+
+
+def _is_set_expr(node: ast.AST, ctx: FileContext) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        name = ctx.resolve(node.func)
+        if name in ("set", "frozenset"):
+            return True
+        # set ops that return sets: a.union(b) etc. are left to review;
+        # the syntactic cases are the ones that actually bite
+    return False
+
+
+@register
+class SetIterRule(Rule):
+    id = "det-set-iter"
+    help = ("set iteration order depends on hash seeds and insertion "
+            "history; sort before iterating in consensus-critical code")
+
+    def check(self, ctx: FileContext, cfg: RuleConfig):
+        msg = ("iteration over a set is order-nondeterministic in "
+               "consensus-critical code (wrap in sorted())")
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.For) and _is_set_expr(node.iter, ctx):
+                yield (node.iter.lineno, node.iter.col_offset, msg)
+            elif isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                                   ast.DictComp, ast.SetComp)):
+                for gen in node.generators:
+                    if _is_set_expr(gen.iter, ctx):
+                        yield (gen.iter.lineno, gen.iter.col_offset, msg)
+            elif isinstance(node, ast.Call):
+                name = ctx.resolve(node.func)
+                if name in ("list", "tuple", "enumerate") and node.args \
+                        and _is_set_expr(node.args[0], ctx):
+                    yield (node.lineno, node.col_offset, msg)
+
+
+_HASH_FUNCS = {"hashlib.sha256", "hashlib.sha512", "hashlib.md5",
+               "hashlib.blake2b", "json.dumps"}
+_HASH_ATTRS = {"update", "digest", "hexdigest"}
+_DICT_ITER_ATTRS = {"keys", "values", "items"}
+
+
+def _dict_iter_call(node: ast.AST, ctx: FileContext) -> ast.Call | None:
+    """A ``.keys()/.values()/.items()`` call inside `node` that is NOT
+    wrapped in ``sorted(...)`` somewhere on the way up (sorting restores
+    determinism — that is the fix the rule prescribes)."""
+    for sub in ast.walk(node):
+        if not (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in _DICT_ITER_ATTRS
+                and not sub.args):
+            continue
+        if any(isinstance(p, ast.Call) and ctx.resolve(p.func) == "sorted"
+               for p in ctx.parents(sub)):
+            continue
+        return sub
+    return None
+
+
+@register
+class DictHashRule(Rule):
+    id = "det-dict-hash"
+    help = ("dict iteration order is insertion order, which can differ "
+            "between honest nodes; sort before hashing/serializing")
+
+    def check(self, ctx: FileContext, cfg: RuleConfig):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.resolve(node.func)
+            attr = (node.func.attr
+                    if isinstance(node.func, ast.Attribute) else None)
+            if not (name in _HASH_FUNCS or attr in _HASH_ATTRS
+                    or (name or "").startswith("hashlib.")):
+                continue
+            if attr in _HASH_ATTRS and name not in _HASH_FUNCS \
+                    and not (name or "").startswith("hashlib."):
+                # .update() is also the dict-merge verb: only treat it
+                # as a sink on a hash-shaped receiver (h, hasher,
+                # *sha*, *digest*, ...) so `dst.update(src.items())`
+                # stays legal
+                recv = (ctx.resolve(node.func.value) or "")
+                tail = recv.rsplit(".", 1)[-1].lower()
+                if tail not in ("h", "hasher", "hd") and not any(
+                        k in tail for k in ("hash", "sha", "md5",
+                                            "blake", "digest")):
+                    continue
+            if name == "json.dumps" and any(
+                    kw.arg == "sort_keys"
+                    and isinstance(kw.value, ast.Constant)
+                    and bool(kw.value.value)
+                    for kw in node.keywords):
+                continue  # sort_keys=True restores determinism
+            for arg in node.args:
+                hit = _dict_iter_call(arg, ctx)
+                if hit is not None:
+                    yield (hit.lineno, hit.col_offset,
+                           "dict iteration feeding a hash/serialization "
+                           "call (sort the items first — insertion order "
+                           "is not consensus)")
+                    break

@@ -1,0 +1,221 @@
+"""Exception hygiene + effect discipline rules.
+
+- ``except-swallow``: a ``bare except`` / ``except Exception`` handler
+  that neither re-raises, logs through ``obs.log``, nor bumps a
+  telemetry counter is a silent failure sink — ~30 of them hid real
+  errors before this rule existed.
+- ``jit-purity``: side effects inside ``jax.jit``-traced functions run
+  once at trace time (or force a host round-trip) and then silently
+  never again — logging, telemetry, ``np.asarray``, ``float()`` casts,
+  and ``global`` mutation are all bugs inside device code.
+- ``print-call`` / ``raw-urlopen``: the two pre-framework regex gates
+  (tests/test_obs.py, tests/test_faults.py), now framework rules; the
+  old tests are thin wrappers invoking these so tier-1 names persist.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from celestia_app_tpu.tools.analyze.engine import (
+    FileContext,
+    Rule,
+    register,
+)
+from celestia_app_tpu.tools.analyze.config import RuleConfig
+
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception"}
+_TELEMETRY_METHODS = {"incr", "observe", "measure_since", "gauge",
+                      "counter"}
+
+
+def _is_logging_call(node: ast.Call, ctx: FileContext) -> bool:
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    attr = node.func.attr
+    base = ctx.resolve(node.func.value) or ""
+    base_tail = base.rsplit(".", 1)[-1].lower()
+    if attr in _LOG_METHODS and ("log" in base_tail or base_tail in
+                                 ("lg", "obs")):
+        return True
+    # incr/observe/gauge/measure_since are distinctive registry verbs;
+    # accept them on any receiver (telemetry module, self on Registry,
+    # pool.metrics, ...) — a counter bump is a counter bump
+    if attr in _TELEMETRY_METHODS:
+        return True
+    return False
+
+
+def _handler_is_swallowing(handler: ast.ExceptHandler,
+                           ctx: FileContext) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return False
+        if isinstance(node, ast.Call) and _is_logging_call(node, ctx):
+            return False
+    return True
+
+
+def _broad_types(handler: ast.ExceptHandler, ctx: FileContext) -> bool:
+    if handler.type is None:
+        return True  # bare except
+    types = (handler.type.elts if isinstance(handler.type, ast.Tuple)
+             else [handler.type])
+    for t in types:
+        if ctx.resolve(t) in ("Exception", "BaseException",
+                              "builtins.Exception",
+                              "builtins.BaseException"):
+            return True
+    return False
+
+
+@register
+class ExceptSwallowRule(Rule):
+    id = "except-swallow"
+    help = ("broad exception handlers must log via obs.log or bump a "
+            "telemetry counter — silent failure sinks hide real bugs")
+
+    def check(self, ctx: FileContext, cfg: RuleConfig):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _broad_types(node, ctx):
+                continue
+            if _handler_is_swallowing(node, ctx):
+                what = ("bare except" if node.type is None
+                        else "except Exception")
+                yield (node.lineno, node.col_offset,
+                       f"{what} swallows errors silently — log via "
+                       "obs.log or bump a telemetry counter (or narrow "
+                       "the exception type)")
+
+
+# ---------------------------------------------------------------------------
+# jit purity
+# ---------------------------------------------------------------------------
+
+_JIT_NAMES = {"jax.jit", "jit", "pl.pallas_call"}
+_HOST_CALLS = {"numpy.asarray", "numpy.array", "numpy.frombuffer",
+               "jax.device_get"}
+_HOST_ATTRS = {"block_until_ready", "item"}
+
+
+def _is_jit_decorator(dec: ast.AST, ctx: FileContext) -> bool:
+    name = ctx.resolve(dec)
+    if name in _JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        fname = ctx.resolve(dec.func)
+        if fname in _JIT_NAMES:
+            return True
+        if fname in ("functools.partial", "partial") and dec.args:
+            return ctx.resolve(dec.args[0]) in _JIT_NAMES
+    return False
+
+
+def _jitted_functions(ctx: FileContext) -> list[ast.FunctionDef]:
+    """Functions traced by jax: decorated with @jax.jit (directly or via
+    partial), or defined in a scope where ``jax.jit(name, ...)`` /
+    ``jax.jit(lambda ...)`` wraps them (the jitted-factory idiom used
+    all over ops/ and da/)."""
+    jitted: list[ast.FunctionDef] = []
+    wrapped_names: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and ctx.resolve(node.func) in \
+                _JIT_NAMES:
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name):
+                    wrapped_names.add(arg.id)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if any(_is_jit_decorator(d, ctx) for d in node.decorator_list):
+            jitted.append(node)
+        elif node.name in wrapped_names:
+            jitted.append(node)
+    return jitted
+
+
+@register
+class JitPurityRule(Rule):
+    id = "jit-purity"
+    help = ("side effects inside jitted functions run once at trace "
+            "time or force host round-trips — keep device code pure")
+
+    def check(self, ctx: FileContext, cfg: RuleConfig):
+        for fn in _jitted_functions(ctx):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    yield (node.lineno, node.col_offset,
+                           f"global mutation inside jitted {fn.name}() "
+                           "(runs once at trace time, then never again)")
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                name = ctx.resolve(node.func)
+                attr = (node.func.attr
+                        if isinstance(node.func, ast.Attribute)
+                        else None)
+                if name == "print":
+                    yield (node.lineno, node.col_offset,
+                           f"print inside jitted {fn.name}() fires at "
+                           "trace time only (use jax.debug.print)")
+                elif _is_logging_call(node, ctx):
+                    yield (node.lineno, node.col_offset,
+                           f"logging/telemetry inside jitted {fn.name}()"
+                           " fires at trace time only (hoist to the "
+                           "caller)")
+                elif name in _HOST_CALLS:
+                    yield (node.lineno, node.col_offset,
+                           f"{name}() inside jitted {fn.name}() forces "
+                           "a host round-trip per call")
+                elif attr in _HOST_ATTRS:
+                    yield (node.lineno, node.col_offset,
+                           f".{attr}() inside jitted {fn.name}() forces "
+                           "a host sync")
+                elif name == "float" and node.args:
+                    yield (node.lineno, node.col_offset,
+                           f"float() cast inside jitted {fn.name}() "
+                           "concretizes a tracer (host round-trip)")
+
+
+# ---------------------------------------------------------------------------
+# the migrated regex gates
+# ---------------------------------------------------------------------------
+
+
+@register
+class PrintRule(Rule):
+    id = "print-call"
+    help = ("library code logs through celestia_app_tpu.obs.log; print "
+            "is reserved for the CLI and operator tools (rule allow "
+            "list in analyze.toml)")
+
+    def check(self, ctx: FileContext, cfg: RuleConfig):
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                yield (node.lineno, node.col_offset,
+                       "print call in a library module (use "
+                       "celestia_app_tpu.obs.log, or allowlist with a "
+                       "reason)")
+
+
+@register
+class UrlopenRule(Rule):
+    id = "raw-urlopen"
+    help = ("peer I/O goes through the hardened net/transport.py "
+            "PeerClient (timeouts, retries, circuit breaker); raw "
+            "urlopen bypasses all of it")
+
+    def check(self, ctx: FileContext, cfg: RuleConfig):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.resolve(node.func) or ""
+            if name == "urlopen" or name.endswith(".urlopen"):
+                yield (node.lineno, node.col_offset,
+                       "direct urlopen outside net/transport.py (route "
+                       "peer I/O through the hardened PeerClient, or "
+                       "allowlist with a reason)")

@@ -1,0 +1,132 @@
+"""Static lock discipline: the ``# guarded-by:`` convention.
+
+A field annotated at its initialization site —
+
+    self._tables = {}  # guarded-by: _lock
+
+— must only be touched inside a ``with self._lock:`` block. The rule is
+**lexical**: it checks that every ``self.<attr>`` access in the class
+(reads and writes, including inside closures defined in methods) has a
+``with self.<lockname>`` ancestor in the same function. Three escapes:
+
+- ``__init__`` is exempt (construction happens-before publication);
+- methods named ``*_locked`` are exempt — the documented convention
+  for internal helpers whose CALLER holds the lock;
+- an inline ``# lint: disable=lock-guard`` pragma, for the rare
+  benign race that is cheaper to document than to lock.
+
+The runtime half of lock discipline — acquisition-order tracking and
+lock-order-inversion detection under ``CELESTIA_RACE=1`` — lives in
+``racecheck.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from celestia_app_tpu.tools.analyze.engine import (
+    FileContext,
+    Rule,
+    register,
+)
+from celestia_app_tpu.tools.analyze.config import RuleConfig
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+
+
+def _guarded_attrs(cls: ast.ClassDef, ctx: FileContext) -> dict[str, str]:
+    """attr name -> lock attr name, from ``# guarded-by:`` comments on
+    ``self.X = ...`` lines anywhere in the class (conventionally
+    ``__init__``)."""
+    guarded: dict[str, str] = {}
+    for node in ast.walk(cls):
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        for t in targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and t.lineno <= len(ctx.lines)):
+                m = _GUARDED_RE.search(ctx.lines[t.lineno - 1])
+                if m:
+                    guarded[t.attr] = m.group(1)
+    return guarded
+
+
+def _holds_lock(node: ast.AST, lockname: str, ctx: FileContext) -> bool:
+    """True when `node` is lexically inside ``with self.<lockname>``
+    (also accepts a bare ``with <lockname>`` for module-style locks)."""
+    for parent in ctx.parents(node):
+        if not isinstance(parent, (ast.With, ast.AsyncWith)):
+            continue
+        for item in parent.items:
+            e = item.context_expr
+            if (isinstance(e, ast.Attribute) and e.attr == lockname
+                    and isinstance(e.value, ast.Name)
+                    and e.value.id == "self"):
+                return True
+            if isinstance(e, ast.Name) and e.id == lockname:
+                return True
+    return False
+
+
+def _enclosing_method(node: ast.AST, cls: ast.ClassDef,
+                      ctx: FileContext) -> ast.FunctionDef | None:
+    """The class-level method whose body (possibly via nested closures)
+    contains `node`."""
+    method = None
+    for parent in ctx.parents(node):
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            method = parent
+        if parent is cls:
+            return method
+        if isinstance(parent, ast.ClassDef):
+            return None  # a nested class: out of this rule's scope
+    return None
+
+
+@register
+class LockGuardRule(Rule):
+    id = "lock-guard"
+    help = ("fields annotated '# guarded-by: <lock>' may only be "
+            "touched inside 'with self.<lock>:' (or from *_locked "
+            "helpers whose caller holds it)")
+
+    def check(self, ctx: FileContext, cfg: RuleConfig):
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            guarded = _guarded_attrs(cls, ctx)
+            if not guarded:
+                continue
+            for node in ast.walk(cls):
+                if not (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"
+                        and node.attr in guarded):
+                    continue
+                method = _enclosing_method(node, cls, ctx)
+                if method is None:
+                    continue
+                outer = method
+                for p in ctx.parents(method):
+                    if isinstance(p, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                        outer = p
+                    elif p is cls:
+                        break
+                if outer.name == "__init__" or \
+                        outer.name.endswith("_locked") or \
+                        method.name.endswith("_locked"):
+                    continue
+                lockname = guarded[node.attr]
+                if not _holds_lock(node, lockname, ctx):
+                    yield (node.lineno, node.col_offset,
+                           f"self.{node.attr} is guarded-by "
+                           f"{lockname} but accessed outside "
+                           f"'with self.{lockname}' in "
+                           f"{cls.name}.{method.name}()")

@@ -26,7 +26,13 @@ def build(timeout: int = 180) -> bool:
             timeout=timeout,
         )
         return os.path.exists(BINARY)
-    except Exception:
+    except Exception as e:
+        # no toolchain in this container: the caller falls back to the
+        # Python baseline; say so once at debug level instead of nothing
+        from celestia_app_tpu import obs
+
+        _log = obs.get_logger("utils.native")
+        _log.debug("native baseline build unavailable", err=e)
         return False
 
 

@@ -149,5 +149,7 @@ class NativeLog:
     def __del__(self):  # best-effort: tests open/close many
         try:
             self.close()
-        except Exception:
+        except Exception:  # lint: disable=except-swallow
+            # logging (or any import) inside __del__ at interpreter
+            # shutdown can itself raise; silence is the only safe option
             pass

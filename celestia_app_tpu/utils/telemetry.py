@@ -38,6 +38,14 @@ import time
 BUCKET_BOUNDS = tuple(1e-6 * (2.0 ** i) for i in range(28))
 
 
+def start_timer() -> float:
+    """Opaque t0 for ``measure_since``. Consensus-critical modules call
+    this instead of reading ``time.perf_counter`` directly, so the
+    analyzer's det-wallclock rule keeps raw clock reads out of them —
+    the value flows only into telemetry, never into state."""
+    return time.perf_counter()
+
+
 def _series_key(name: str, labels: dict | None) -> str:
     """Storage key: the bare name for unlabeled series (the historical
     snapshot shape), name{k="v",...} for labeled ones."""
@@ -71,12 +79,19 @@ def _quantile(buckets: list[int], count: int, q: float) -> float:
 
 
 class Registry:
+    """Writes land from the node loop, reactor threads, and HTTP
+    handler threads concurrently; the read-modify-write on a counter
+    (``get + 1`` then store) and the multi-field histogram update are
+    NOT atomic under that load, so every access to the four data maps
+    goes through ``_lock`` (the static lock-guard rule enforces it)."""
+
     def __init__(self):
-        self.counters: dict[str, int] = {}
-        self.timers: dict[str, dict] = {}
-        self.gauges: dict[str, float] = {}
+        self._lock = threading.Lock()
+        self.counters: dict[str, int] = {}          # guarded-by: _lock
+        self.timers: dict[str, dict] = {}           # guarded-by: _lock
+        self.gauges: dict[str, float] = {}          # guarded-by: _lock
         # series key -> (family name, labels) for labeled exposition
-        self._series: dict[str, tuple[str, dict]] = {}
+        self._series: dict[str, tuple[str, dict]] = {}  # guarded-by: _lock
         self._help: dict[str, str] = {}
         self._collectors: list = []
 
@@ -96,13 +111,18 @@ class Registry:
             self._collectors.append(fn)
 
     def _collect(self) -> None:
+        # runs OUTSIDE _lock: collectors call gauge()/incr(), which take
+        # it — holding it here would self-deadlock
         for fn in list(self._collectors):
             try:
                 fn()
             except Exception:
-                pass  # a broken collector must never break a scrape
+                # a broken collector must never break a scrape — but a
+                # scrape that silently loses gauges must be visible
+                self.incr("telemetry.collector_errors")
 
-    def _note_series(self, key: str, name: str, labels: dict | None) -> None:
+    def _note_series_locked(self, key: str, name: str,
+                            labels: dict | None) -> None:
         if labels and key not in self._series:
             self._series[key] = (name, dict(labels))
 
@@ -110,34 +130,38 @@ class Registry:
 
     def incr(self, name: str, by: int = 1, labels: dict | None = None) -> None:
         key = _series_key(name, labels)
-        self._note_series(key, name, labels)
-        self.counters[key] = self.counters.get(key, 0) + by
+        with self._lock:
+            self._note_series_locked(key, name, labels)
+            self.counters[key] = self.counters.get(key, 0) + by
 
     def gauge(self, name: str, value: float,
               labels: dict | None = None) -> None:
         """Set-type metric (pool sizes, queue depths): last write wins."""
         key = _series_key(name, labels)
-        self._note_series(key, name, labels)
-        self.gauges[key] = value
+        with self._lock:
+            self._note_series_locked(key, name, labels)
+            self.gauges[key] = value
 
     def observe(self, name: str, value_s: float,
                 labels: dict | None = None) -> float:
         """Record one observation (seconds, or any unit — the ladder is
         unitless) into the named histogram."""
         key = _series_key(name, labels)
-        self._note_series(key, name, labels)
-        t = self.timers.get(key)
-        if t is None:
-            t = self.timers[key] = {
-                "count": 0, "total_s": 0.0, "max_s": 0.0, "last_s": 0.0,
-                "buckets": [0] * (len(BUCKET_BOUNDS) + 1),
-            }
-        t["count"] += 1
-        t["total_s"] += value_s
-        if value_s > t["max_s"]:
-            t["max_s"] = value_s
-        t["last_s"] = value_s
-        t["buckets"][bisect.bisect_left(BUCKET_BOUNDS, value_s)] += 1
+        with self._lock:
+            self._note_series_locked(key, name, labels)
+            t = self.timers.get(key)
+            if t is None:
+                t = self.timers[key] = {
+                    "count": 0, "total_s": 0.0, "max_s": 0.0,
+                    "last_s": 0.0,
+                    "buckets": [0] * (len(BUCKET_BOUNDS) + 1),
+                }
+            t["count"] += 1
+            t["total_s"] += value_s
+            if value_s > t["max_s"]:
+                t["max_s"] = value_s
+            t["last_s"] = value_s
+            t["buckets"][bisect.bisect_left(BUCKET_BOUNDS, value_s)] += 1
         return value_s
 
     def measure_since(self, name: str, t0: float,
@@ -148,19 +172,23 @@ class Registry:
 
     def quantiles(self, name: str, qs=(0.5, 0.95, 0.99),
                   labels: dict | None = None) -> dict[float, float]:
-        t = self.timers.get(_series_key(name, labels))
-        if t is None:
-            return {q: 0.0 for q in qs}
-        buckets, count = list(t["buckets"]), t["count"]
+        with self._lock:
+            t = self.timers.get(_series_key(name, labels))
+            if t is None:
+                return {q: 0.0 for q in qs}
+            buckets, count = list(t["buckets"]), t["count"]
         return {q: _quantile(buckets, count, q) for q in qs}
 
     def snapshot(self) -> dict:
         self._collect()
-        out = {"counters": dict(self.counters), "timers": {},
-               "gauges": dict(self.gauges)}
-        for name, t in list(self.timers.items()):
-            t = dict(t)
-            buckets = list(t.pop("buckets", ()))
+        with self._lock:
+            counters = dict(self.counters)
+            timers = {k: {**v, "buckets": list(v["buckets"])}
+                      for k, v in self.timers.items()}
+            gauges = dict(self.gauges)
+        out = {"counters": counters, "timers": {}, "gauges": gauges}
+        for name, t in timers.items():
+            buckets = t.pop("buckets")
             count = t["count"]
             avg = t["total_s"] / count if count else 0.0
             out["timers"][name] = {
@@ -172,17 +200,19 @@ class Registry:
         return out
 
     def reset(self) -> None:
-        self.counters.clear()
-        self.timers.clear()
-        self.gauges.clear()
-        self._series.clear()
+        with self._lock:
+            self.counters.clear()
+            self.timers.clear()
+            self.gauges.clear()
+            self._series.clear()
 
     # -- Prometheus text exposition ---------------------------------------
 
-    def _family(self, key: str) -> tuple[str, str]:
+    @staticmethod
+    def _family(key: str, series: dict) -> tuple[str, str]:
         """(family name, label string incl. braces or '') for a series."""
-        if key in self._series:
-            name, labels = self._series[key]
+        if key in series:
+            name, labels = series[key]
             inner = ",".join(
                 f'{k}="{labels[k]}"' for k in sorted(labels)
             )
@@ -207,18 +237,20 @@ class Registry:
         as a SEPARATE ``_max`` gauge family; every family carries
         ``# HELP`` + ``# TYPE``."""
         self._collect()
-        # snapshot copies: another thread may insert a first-time metric
-        # mid-scrape (the docstring's promise that readers see a copy)
-        counters = dict(self.counters)
-        timers = {k: {**v, "buckets": list(v["buckets"])}
-                  for k, v in dict(self.timers).items()}
-        gauges = dict(self.gauges)
+        # snapshot copies under the lock: another thread may insert a
+        # first-time metric mid-scrape (readers always see a copy)
+        with self._lock:
+            counters = dict(self.counters)
+            timers = {k: {**v, "buckets": list(v["buckets"])}
+                      for k, v in self.timers.items()}
+            gauges = dict(self.gauges)
+            series = dict(self._series)
 
         # group series into families so HELP/TYPE appear once per family
         def families(keys):
             fams: dict[str, list[tuple[str, str]]] = {}
             for key in sorted(keys):
-                fam, inner = self._family(key)
+                fam, inner = self._family(key, series)
                 fams.setdefault(fam, []).append((inner, key))
             return sorted(fams.items())
 
@@ -287,8 +319,8 @@ class TraceTables:
     MAX_ROWS = 10_000
 
     def __init__(self):
-        self._tables: dict[str, list[dict]] = {}
-        self._next_index: dict[str, int] = {}
+        self._tables: dict[str, list[dict]] = {}   # guarded-by: _lock
+        self._next_index: dict[str, int] = {}      # guarded-by: _lock
         self._lock = threading.Lock()
 
     def write(self, table: str, **row) -> None:

@@ -21,6 +21,7 @@ import hashlib
 
 from celestia_app_tpu.chain import tx as itx
 from celestia_app_tpu.chain.crypto import PublicKey
+from celestia_app_tpu.utils import telemetry
 from celestia_app_tpu.wire import txpb
 from celestia_app_tpu.wire.proto import Fields, decode_varint
 
@@ -56,6 +57,9 @@ class ProtoTx:
                 self.signature, self.sign_doc(chain_id, account_number)
             )
         except Exception:
+            # undecodable pubkey/signature bytes verify False — counted,
+            # so a flood of malformed txs is visible in /metrics
+            telemetry.incr("wire.sig_verify_errors")
             return False
 
 

@@ -1,0 +1,13 @@
+"""BAD: dict order feeds a hash."""
+import hashlib
+import json
+
+
+def state_hash(state: dict) -> bytes:
+    h = hashlib.sha256()
+    h.update(b"".join(state.values()))  # VIOLATION det-dict-hash
+    return h.digest()
+
+
+def serialize(state: dict) -> str:
+    return json.dumps(list(state.items()))  # VIOLATION det-dict-hash

@@ -1,0 +1,13 @@
+"""GOOD: sorted items / sort_keys before hashing."""
+import hashlib
+import json
+
+
+def state_hash(state: dict) -> bytes:
+    h = hashlib.sha256()
+    h.update(b"".join(v for _, v in sorted(state.items())))
+    return h.digest()
+
+
+def serialize(state: dict) -> str:
+    return json.dumps(state, sort_keys=True)

@@ -1,0 +1,12 @@
+"""BAD: float arithmetic in consensus math."""
+
+
+def fee_share(total, n):
+    return total / n  # VIOLATION det-float (true division)
+
+
+HALF = 0.5  # VIOLATION det-float (literal)
+
+
+def cast(x):
+    return float(x)  # VIOLATION det-float (cast)

@@ -1,0 +1,7 @@
+"""GOOD: integer / fixed-point consensus math."""
+
+SCALE = 10**18
+
+
+def fee_share(total, n):
+    return total // n

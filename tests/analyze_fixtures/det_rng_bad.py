@@ -1,0 +1,16 @@
+"""BAD: ambient randomness in consensus code."""
+import os
+import random
+import uuid
+
+
+def pick(items):
+    return random.choice(items)  # VIOLATION det-rng
+
+
+def salt():
+    return os.urandom(8)  # VIOLATION det-rng
+
+
+def ident():
+    return uuid.uuid4()  # VIOLATION det-rng

@@ -1,0 +1,5 @@
+"""GOOD: a seeded generator threaded from config."""
+
+
+def pick(items, rng):
+    return items[int(rng.integers(0, len(items)))]

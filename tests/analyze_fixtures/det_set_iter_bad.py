@@ -1,0 +1,12 @@
+"""BAD: set iteration order reaches consensus."""
+
+
+def roots(items):
+    out = []
+    for x in {i.key for i in items}:  # VIOLATION det-set-iter
+        out.append(x)
+    return out
+
+
+def listed(s):
+    return list(set(s))  # VIOLATION det-set-iter

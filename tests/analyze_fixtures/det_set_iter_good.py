@@ -1,0 +1,5 @@
+"""GOOD: sets are sorted before iteration."""
+
+
+def roots(items):
+    return [x for x in sorted({i.key for i in items})]

@@ -1,0 +1,11 @@
+"""BAD: wall-clock reads in consensus code."""
+import time
+from datetime import datetime
+
+
+def block_time():
+    return time.time()  # VIOLATION det-wallclock
+
+
+def stamp():
+    return datetime.now()  # VIOLATION det-wallclock

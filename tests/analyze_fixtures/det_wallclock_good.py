@@ -1,0 +1,5 @@
+"""GOOD: time threaded from the header."""
+
+
+def block_time(header):
+    return header.time_unix

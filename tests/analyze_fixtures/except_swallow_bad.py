@@ -1,0 +1,15 @@
+"""BAD: broad handlers with no log/counter."""
+
+
+def fetch(fn):
+    try:
+        return fn()
+    except Exception:  # VIOLATION except-swallow
+        return None
+
+
+def run(fn):
+    try:
+        fn()
+    except:  # noqa: E722  VIOLATION except-swallow (bare)
+        pass

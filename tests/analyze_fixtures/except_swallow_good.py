@@ -1,0 +1,34 @@
+"""GOOD: handlers log, count, narrow, or re-raise."""
+from celestia_app_tpu import obs
+from celestia_app_tpu.utils import telemetry
+
+log = obs.get_logger("fixture")
+
+
+def fetch(fn):
+    try:
+        return fn()
+    except Exception as e:
+        log.warning("fetch failed", err=e)
+        return None
+
+
+def run(fn):
+    try:
+        fn()
+    except Exception:
+        telemetry.incr("fixture.errors")
+
+
+def narrow(fn):
+    try:
+        fn()
+    except ValueError:
+        pass  # narrowed: fine
+
+
+def reraise(fn):
+    try:
+        fn()
+    except Exception:
+        raise

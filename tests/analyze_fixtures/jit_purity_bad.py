@@ -1,0 +1,22 @@
+"""BAD: side effects inside jitted functions."""
+import jax
+import numpy as np
+
+from celestia_app_tpu.utils import telemetry
+
+CALLS = 0
+
+
+@jax.jit
+def extend(x):
+    global CALLS  # VIOLATION jit-purity (global mutation)
+    telemetry.incr("extend.calls")  # VIOLATION jit-purity (telemetry)
+    print("tracing", x.shape)  # VIOLATION jit-purity (print)
+    return np.asarray(x) * 2  # VIOLATION jit-purity (host round-trip)
+
+
+def factory():
+    def inner(x):
+        return float(x[0]) + 1  # VIOLATION jit-purity (float cast)
+
+    return jax.jit(inner)

@@ -1,0 +1,16 @@
+"""GOOD: pure device code; effects live in the caller."""
+import jax
+import jax.numpy as jnp
+
+from celestia_app_tpu.utils import telemetry
+
+
+@jax.jit
+def extend(x):
+    return jnp.dot(x, x.T)
+
+
+def extend_and_count(x):
+    out = extend(x)
+    telemetry.incr("extend.calls")  # caller side: fine
+    return out

@@ -1,0 +1,15 @@
+"""BAD: guarded field touched outside its lock."""
+import threading
+
+
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = []  # guarded-by: _lock
+
+    def write(self, row):
+        with self._lock:
+            self._rows.append(row)
+
+    def read(self):
+        return list(self._rows)  # VIOLATION lock-guard

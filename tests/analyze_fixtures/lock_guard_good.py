@@ -1,0 +1,19 @@
+"""GOOD: every access under the lock; _locked helper."""
+import threading
+
+
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = []  # guarded-by: _lock
+
+    def write(self, row):
+        with self._lock:
+            self._append_locked(row)
+
+    def _append_locked(self, row):
+        self._rows.append(row)  # caller holds the lock (convention)
+
+    def read(self):
+        with self._lock:
+            return list(self._rows)

@@ -1,0 +1,7 @@
+"""A violation suppressed by an inline pragma."""
+import time
+
+
+def proposer_time():
+    # the proposer's clock IS the protocol source of header time
+    return time.time()  # lint: disable=det-wallclock

@@ -1,0 +1,5 @@
+"""BAD: print in library code."""
+
+
+def report(x):
+    print("value", x)  # VIOLATION print-call

@@ -1,0 +1,8 @@
+"""GOOD: the structured logger."""
+from celestia_app_tpu import obs
+
+log = obs.get_logger("fixture")
+
+
+def report(x):
+    log.info("value", x=x)

@@ -1,0 +1,7 @@
+"""BAD: raw urlopen bypasses the hardened transport."""
+import urllib.request
+
+
+def fetch(url):
+    with urllib.request.urlopen(url) as r:  # VIOLATION raw-urlopen
+        return r.read()

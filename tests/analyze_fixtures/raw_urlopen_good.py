@@ -1,0 +1,6 @@
+"""GOOD: peer I/O through the hardened client."""
+from celestia_app_tpu.net.transport import PeerClient
+
+
+def fetch(client: PeerClient, url):
+    return client.get(url, "/status")

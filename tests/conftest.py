@@ -56,3 +56,34 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "slow" in item.keywords:
             item.add_marker(skip_slow)
+
+
+@pytest.fixture
+def racecheck_guard():
+    """CELESTIA_RACE=1 for one test: install the runtime lock-order
+    detector (tools/analyze/racecheck), hand it to the test, and FAIL at
+    teardown on any recorded ABBA inversion. The chaos and stress tiers
+    opt in via a module-local autouse wrapper (subprocesses they spawn
+    inherit the env var and install from celestia_app_tpu/__init__)."""
+    from celestia_app_tpu.tools.analyze import racecheck
+
+    prev = os.environ.get("CELESTIA_RACE")
+    os.environ["CELESTIA_RACE"] = "1"
+    newly = racecheck.install()  # False when the env hook already did
+    racecheck.reset()
+    yield racecheck
+    if prev is None:
+        os.environ.pop("CELESTIA_RACE", None)
+    else:
+        os.environ["CELESTIA_RACE"] = prev
+    vios = racecheck.violations()
+    if newly:
+        # leave a session-wide install (CELESTIA_RACE=1 pytest run)
+        # alone — uninstalling here would silently stop tracking for
+        # every later test
+        racecheck.uninstall()
+    racecheck.reset()
+    assert not vios, (
+        "lock-order inversions: "
+        + "; ".join(v["message"] for v in vios)
+    )

@@ -1,0 +1,499 @@
+"""The analysis plane (tools/analyze): rule engine, rules, config,
+reporters, CLI, and the runtime lock-order detector.
+
+Tier-1 contract (ISSUE 5 acceptance):
+- the full package tree analyzes to ZERO non-waived errors against the
+  committed analyze.toml, with at most 10 waivers, each carrying a
+  written reason — removing a waiver (or re-adding a banned call, e.g.
+  ``time.time()`` in chain/app.py) fails here with a message naming the
+  rule, file, and line;
+- every rule is proven live against good/bad fixture pairs under
+  tests/analyze_fixtures/;
+- pragma > waiver > scope precedence holds;
+- the JSON reporter emits the FORMATS §11 schema;
+- the CELESTIA_RACE=1 detector catches a deliberate ABBA lock-order
+  inversion.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from celestia_app_tpu.tools.analyze import (
+    default_config_path,
+    default_package_root,
+    load_config,
+    run_analysis,
+)
+from celestia_app_tpu.tools.analyze.config import (
+    AnalyzeConfig,
+    ConfigError,
+    RuleConfig,
+    Waiver,
+    config_from_dict,
+    parse_toml_subset,
+)
+from celestia_app_tpu.tools.analyze.report import to_json
+from celestia_app_tpu.tools.analyze import racecheck
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analyze_fixtures")
+
+RULES = [
+    "det-wallclock", "det-rng", "det-float", "det-set-iter",
+    "det-dict-hash", "except-swallow", "jit-purity", "lock-guard",
+    "print-call", "raw-urlopen",
+]
+
+
+def _fixture_config() -> AnalyzeConfig:
+    """All rules enabled, unscoped — fixtures opt in per file by name."""
+    return AnalyzeConfig(exclude=["__pycache__"])
+
+
+def _run_fixture(name: str, only: set[str] | None = None):
+    return run_analysis(root=FIXTURES, config=_fixture_config(),
+                        only_rules=only)
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the tree itself is clean
+# ---------------------------------------------------------------------------
+
+
+def test_full_tree_zero_unwaived_violations():
+    """THE gate: every rule over every package file, the committed
+    analyze.toml applied. Any new violation must be fixed, pragma'd with
+    a reason comment, or waived in analyze.toml — never ignored."""
+    rep = run_analysis()
+    assert sorted(rep.rules_run) == sorted(RULES), rep.rules_run
+    msgs = [str(v) for v in rep.errors]
+    assert not msgs, (
+        "analysis plane violations (fix, pragma, or waive with a "
+        f"reason):\n" + "\n".join(msgs)
+    )
+
+
+def test_waiver_budget_and_reasons():
+    """≤ 10 waivers, every one with a non-empty written reason."""
+    cfg = load_config()
+    assert len(cfg.waivers) <= 10, [
+        (w.rule, w.path) for w in cfg.waivers
+    ]
+    for w in cfg.waivers:
+        assert w.reason.strip(), f"waiver {w.rule}:{w.path} has no reason"
+
+
+def test_removing_any_waiver_fails_with_named_violation():
+    """Each committed waiver is load-bearing: strip it and the analyzer
+    must surface at least one error of exactly that rule in exactly that
+    path, with a real line number — proving the waiver ledger cannot
+    hide dead entries and the gate names rule+file+line on failure."""
+    cfg = load_config()
+    assert cfg.waivers, "expected at least one committed waiver"
+    for i, dropped in enumerate(cfg.waivers):
+        stripped = copy.deepcopy(cfg)
+        del stripped.waivers[i]
+        rep = run_analysis(config=stripped)
+        hits = [v for v in rep.errors
+                if v.rule == dropped.rule
+                and v.path.startswith(dropped.path.split("::")[0])]
+        assert hits, (
+            f"waiver {dropped.rule}:{dropped.path} matched nothing "
+            "after removal — it is stale"
+        )
+        assert all(v.line > 0 for v in hits)
+        # the failure message names rule, file, and line
+        assert dropped.rule in str(hits[0]) and dropped.path in str(hits[0])
+
+
+def test_reintroducing_banned_call_is_caught(tmp_path):
+    """A tree that re-adds time.time()/random in chain/app.py (the
+    acceptance example) fails under the COMMITTED config's scoping."""
+    pkg = tmp_path / "pkg"
+    (pkg / "chain").mkdir(parents=True)
+    (pkg / "chain" / "app.py").write_text(
+        "import random\nimport time\n\n\n"
+        "def finalize(txs):\n"
+        "    stamp = time.time()\n"
+        "    random.shuffle(txs)\n"
+        "    return stamp, txs\n"
+    )
+    rep = run_analysis(root=str(pkg), config=load_config())
+    found = {(v.rule, v.path, v.line) for v in rep.errors}
+    assert ("det-wallclock", "chain/app.py", 6) in found, found
+    assert ("det-rng", "chain/app.py", 7) in found, found
+
+
+# ---------------------------------------------------------------------------
+# every rule proven live: good/bad fixture pairs
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_rule_fixture_pair(rule):
+    stem = rule.replace("-", "_")
+    rep = _run_fixture(rule, only={rule})
+    by_file: dict[str, list] = {}
+    for v in rep.violations:
+        by_file.setdefault(v.path, []).append(v)
+    bad = by_file.get(f"{stem}_bad.py", [])
+    good = by_file.get(f"{stem}_good.py", [])
+    assert bad, f"{rule}: bad fixture produced no violation"
+    assert all(v.rule == rule for v in bad)
+    assert not good, (
+        f"{rule}: good fixture flagged: {[str(v) for v in good]}"
+    )
+
+
+def test_bad_fixture_violation_counts():
+    """The bad fixtures carry one VIOLATION marker per expected hit;
+    the analyzer must find every one of them (no silent under-count)."""
+    rep = _run_fixture("all")
+    counts: dict[str, int] = {}
+    for v in rep.violations:
+        counts[v.path] = counts.get(v.path, 0) + 1
+    for name in sorted(os.listdir(FIXTURES)):
+        if not name.endswith("_bad.py"):
+            continue
+        with open(os.path.join(FIXTURES, name)) as f:
+            expected = f.read().count("VIOLATION")
+        assert counts.get(name, 0) >= expected, (
+            f"{name}: expected >= {expected} violations, "
+            f"got {counts.get(name, 0)}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# precedence: pragma > waiver > scope
+# ---------------------------------------------------------------------------
+
+
+def test_pragma_suppresses_entirely():
+    rep = _run_fixture("pragma", only={"det-wallclock"})
+    hits = [v for v in rep.violations if v.path == "pragma_case.py"]
+    assert hits == [], [str(v) for v in hits]
+
+
+def test_pragma_beats_waiver(tmp_path):
+    """A pragma'd line is suppressed (not even counted as waived), and
+    the waiver covering the same file then reports stale."""
+    (tmp_path / "m.py").write_text(
+        "import time\n\n\n"
+        "def f():\n"
+        "    return time.time()  # lint: disable=det-wallclock\n"
+    )
+    cfg = AnalyzeConfig(waivers=[
+        Waiver(rule="det-wallclock", path="m.py", reason="testing")
+    ])
+    rep = run_analysis(root=str(tmp_path), config=cfg,
+                       only_rules={"det-wallclock"})
+    assert not rep.waived
+    assert [v.rule for v in rep.errors] == ["stale-waiver"]
+
+
+def test_waiver_downgrades_and_carries_reason(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import time\n\n\ndef f():\n    return time.time()\n"
+    )
+    cfg = AnalyzeConfig(waivers=[
+        Waiver(rule="det-wallclock", path="m.py",
+               reason="fixture: documented exception")
+    ])
+    rep = run_analysis(root=str(tmp_path), config=cfg,
+                       only_rules={"det-wallclock"})
+    assert not rep.errors
+    assert len(rep.waived) == 1
+    assert rep.waived[0].waiver_reason == "fixture: documented exception"
+
+
+def test_scope_include_and_symbol_scoping(tmp_path):
+    src = ("import time\n\n\n"
+           "def apply(b):\n"
+           "    return time.time()\n\n\n"
+           "def gossip():\n"
+           "    return time.time()\n")
+    (tmp_path / "consensus.py").write_text(src)
+    (tmp_path / "tooling.py").write_text(src)
+    cfg = AnalyzeConfig(rules={
+        "det-wallclock": RuleConfig(include=["consensus.py::apply"]),
+    })
+    rep = run_analysis(root=str(tmp_path), config=cfg,
+                       only_rules={"det-wallclock"})
+    hits = {(v.path, v.line) for v in rep.errors}
+    # only the apply() body of the included file is in scope
+    assert hits == {("consensus.py", 5)}, hits
+
+
+def test_rule_severity_off_and_warning(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import time\n\n\ndef f():\n    return time.time()\n"
+    )
+    off = AnalyzeConfig(rules={"det-wallclock": RuleConfig(severity="off")})
+    rep = run_analysis(root=str(tmp_path), config=off,
+                       only_rules={"det-wallclock"})
+    assert rep.violations == [] and "det-wallclock" not in rep.rules_run
+    warn = AnalyzeConfig(
+        rules={"det-wallclock": RuleConfig(severity="warning")})
+    rep = run_analysis(root=str(tmp_path), config=warn,
+                       only_rules={"det-wallclock"})
+    assert not rep.errors and len(rep.warnings) == 1
+
+
+# ---------------------------------------------------------------------------
+# config loader (the TOML subset) + reporters
+# ---------------------------------------------------------------------------
+
+
+def test_toml_subset_parses_committed_config():
+    with open(default_config_path()) as f:
+        doc = parse_toml_subset(f.read())
+    assert "analyze" in doc and "rules" in doc
+    assert isinstance(doc.get("waivers", []), list)
+    cfg = config_from_dict(doc)
+    assert cfg.rules["print-call"].allow  # the migrated gate allowlists
+    assert cfg.rules["raw-urlopen"].allow == ["net/transport.py"]
+
+
+def test_toml_subset_features_and_errors():
+    doc = parse_toml_subset(
+        '# comment\n[a.b]\nx = "s"  # trailing\nn = 3\nflag = true\n'
+        'arr = [\n  "one",\n  "two",  # c\n]\n[[w]]\nk = "v"\n[[w]]\nk = "u"\n'
+    )
+    assert doc["a"]["b"] == {"x": "s", "n": 3, "flag": True,
+                             "arr": ["one", "two"]}
+    assert [w["k"] for w in doc["w"]] == ["v", "u"]
+    with pytest.raises(ConfigError):
+        parse_toml_subset("x = {inline = 1}\n")
+    with pytest.raises(ConfigError):
+        config_from_dict({"waivers": [{"rule": "r", "path": "p"}]})
+
+
+def test_json_report_schema(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import time\n\n\ndef f():\n    return time.time()\n"
+    )
+    rep = run_analysis(root=str(tmp_path), config=AnalyzeConfig(),
+                       only_rules={"det-wallclock"})
+    doc = to_json(rep)
+    assert doc["version"] == 1
+    assert set(doc["summary"]) == {"files_scanned", "rules_run", "errors",
+                                   "warnings", "waived", "wall_s"}
+    (v,) = doc["violations"]
+    assert set(v) == {"rule", "severity", "path", "line", "col",
+                      "message", "waived", "waiver_reason"}
+    assert v["rule"] == "det-wallclock" and v["path"] == "m.py"
+    assert v["line"] == 5 and v["waived"] is False
+    json.dumps(doc)  # round-trippable
+
+
+def test_cli_analyze_json_subprocess():
+    """The CI surface: `python -m celestia_app_tpu analyze --json` exits
+    0 on the committed tree and emits the §11 schema."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "celestia_app_tpu", "analyze", "--json"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == 1 and doc["summary"]["errors"] == 0
+    assert doc["summary"]["files_scanned"] > 100
+
+
+def test_cli_analyze_fails_on_dirty_tree(tmp_path):
+    pkg = tmp_path / "pkg" / "chain"
+    pkg.mkdir(parents=True)
+    (pkg / "app.py").write_text(
+        "import time\n\n\ndef f():\n    return time.time()\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "celestia_app_tpu", "analyze",
+         "--root", str(tmp_path / "pkg")],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 1
+    assert "det-wallclock" in proc.stdout
+    assert "chain/app.py:5" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# guarded-by annotations: the real structures are actually covered
+# ---------------------------------------------------------------------------
+
+
+def test_known_structures_carry_guarded_by():
+    """The satellite's five structures declare their guard, so the
+    static rule has real coverage from day one."""
+    import ast
+
+    from celestia_app_tpu.tools.analyze.engine import FileContext
+    from celestia_app_tpu.tools.analyze.rules_locks import _guarded_attrs
+
+    root = default_package_root()
+    expect = {
+        ("utils/telemetry.py", "Registry"): {"counters", "timers",
+                                             "gauges"},
+        ("utils/telemetry.py", "TraceTables"): {"_tables", "_next_index"},
+        ("mempool/pool.py", "CATPool"): {"_txs", "_bytes", "_next_seq"},
+        ("net/transport.py", "PeerClient"): {"_peers"},
+        ("das/daser.py", "DASer"): {"cp", "reports"},
+    }
+    found: dict[tuple[str, str], set] = {}
+    for rel_cls in expect:
+        path = os.path.join(root, rel_cls[0])
+        ctx = FileContext(rel_cls[0], open(path).read())
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef) and node.name == rel_cls[1]:
+                found[rel_cls] = set(_guarded_attrs(node, ctx))
+    for key, attrs in expect.items():
+        assert attrs <= found.get(key, set()), (key, found.get(key))
+
+
+# ---------------------------------------------------------------------------
+# the runtime half: lock-order inversion detection (CELESTIA_RACE=1)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def racecheck_installed():
+    racecheck.install()
+    racecheck.reset()
+    try:
+        yield
+    finally:
+        racecheck.uninstall()
+        racecheck.reset()
+
+
+def test_racecheck_catches_abba_inversion(racecheck_installed):
+    """A deliberate ABBA setup: T1 takes A then B, T2 takes B then A.
+    The detector must record an inversion naming both creation sites —
+    without needing the actual deadlock interleaving to strike."""
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+
+    def t1():
+        with lock_a:
+            with lock_b:
+                pass
+
+    def t2():
+        with lock_b:
+            with lock_a:
+                pass
+
+    th1 = threading.Thread(target=t1)
+    th1.start()
+    th1.join()
+    th2 = threading.Thread(target=t2)
+    th2.start()
+    th2.join()
+    vios = racecheck.violations()
+    assert vios, "ABBA inversion not detected"
+    msg = vios[0]["message"]
+    assert "lock-order inversion" in msg
+    # both creation sites named (same file, two distinct lines)
+    assert "test_analyze.py" in vios[0]["first"]
+    assert "test_analyze.py" in vios[0]["then"]
+    assert vios[0]["first"] != vios[0]["then"]
+
+
+def test_racecheck_consistent_order_is_clean(racecheck_installed):
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    for _ in range(3):
+        with lock_a:
+            with lock_b:
+                pass
+    assert racecheck.violations() == []
+
+
+def test_racecheck_same_site_instances_not_inversions(racecheck_installed):
+    """Two instances created at ONE site (e.g. two CATPools) taken in
+    either order are one lock class — not an ABBA report."""
+    def make():
+        return threading.Lock()  # single creation site for both
+
+    a, b = make(), make()
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert racecheck.violations() == []
+
+
+def test_racecheck_rlock_reentrancy_no_self_edge(racecheck_installed):
+    r = threading.RLock()
+    other = threading.Lock()
+    with r:
+        with r:  # reentrant re-acquire must not record edges
+            with other:
+                pass
+    assert racecheck.violations() == []
+
+
+def test_racecheck_tracks_condition_and_event(racecheck_installed):
+    """Wrapped locks keep working inside Condition/Event (the
+    _release_save/_acquire_restore/_is_owned surface)."""
+    cond = threading.Condition()
+    hit = []
+
+    def waiter():
+        with cond:
+            hit.append(cond.wait(timeout=5))
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    ev = threading.Event()
+    with cond:
+        cond.notify()
+    th.join()
+    assert hit == [True]
+    ev.set()
+    assert ev.wait(timeout=1)
+    assert racecheck.violations() == []
+
+
+def test_racecheck_env_hook_in_subprocess():
+    """CELESTIA_RACE=1 installs from celestia_app_tpu/__init__ before
+    any package lock exists — the chaos/stress subprocess path."""
+    code = (
+        "import celestia_app_tpu\n"
+        "from celestia_app_tpu.tools.analyze import racecheck\n"
+        "assert racecheck.installed()\n"
+        "from celestia_app_tpu.mempool.pool import CATPool\n"
+        "p = CATPool()\n"
+        "assert type(p._lock).__name__ == '_TrackedLock', type(p._lock)\n"
+        "p.add(b'x' * 8, height=1)\n"
+        "assert racecheck.violations() == []\n"
+        "print('RACECHECK_OK')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "CELESTIA_RACE": "1", "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "RACECHECK_OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# bench surface
+# ---------------------------------------------------------------------------
+
+
+def test_full_tree_wall_time_budget():
+    """The tier-1/pre-commit cost must stay interactive: < 10 s on CPU
+    (bench.py --analyze reports the measured number as BENCH JSON)."""
+    rep = run_analysis()
+    assert rep.wall_s < 10.0, f"analyze took {rep.wall_s:.1f}s"

@@ -56,6 +56,15 @@ def _seeded_registry():
     faults.reset()
 
 
+@pytest.fixture(autouse=True)
+def _racecheck(racecheck_guard):
+    """The chaos tier runs under CELESTIA_RACE=1 (ISSUE 5): in-process
+    validators get tracked locks directly; subprocess validators inherit
+    the env. Any recorded inversion fails the scenario at teardown
+    (shared racecheck_guard fixture, tests/conftest.py)."""
+    yield
+
+
 def _genesis(privs, powers=None):
     powers = powers or [10] * len(privs)
     return {
@@ -442,6 +451,15 @@ def test_crash_point_matrix(tmp_path):
         # and every crash was the ARMED one, at the armed point
         # (the structured logger renders "[faults] ERROR: CRASH at <pt>")
         assert log.count("CRASH at") == len(CRASH_POINTS), log[-2000:]
+        # the subprocess validators ran under CELESTIA_RACE=1 (inherited
+        # env): the runtime lock-order detector prints one greppable
+        # stderr line per inversion — a whole crash/replay matrix must
+        # produce none, on either node
+        for home in homes:
+            with open(os.path.join(home, "validator.log")) as f:
+                assert "lock-order inversion" not in f.read(), (
+                    f"{home}: lock-order inversion under crash chaos"
+                )
     finally:
         for p in procs:
             try:

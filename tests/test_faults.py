@@ -291,38 +291,19 @@ def test_storage_atomic_write_error_fault(tmp_path):
 # the lint gate: no un-hardened peer I/O outside the transport
 # ---------------------------------------------------------------------------
 
-# modules allowed to call urllib.request.urlopen directly: the transport
-# itself (it IS the hardened path). Non-peer tooling that needs raw
-# urllib must be added here EXPLICITLY with a reason.
-_URLOPEN_ALLOWLIST = {
-    os.path.join("net", "transport.py"),
-}
-
-
 def test_no_direct_urlopen_outside_transport():
     """Future PRs must not reintroduce un-hardened peer I/O: every
     urllib.request.urlopen call site in the package lives in
-    net/transport.py (or is explicitly allowlisted above)."""
-    pkg_root = os.path.dirname(
-        os.path.abspath(faults.__file__)
-    )  # .../celestia_app_tpu/faults
-    pkg_root = os.path.dirname(pkg_root)  # .../celestia_app_tpu
-    offenders = []
-    for dirpath, _dirs, files in os.walk(pkg_root):
-        if "__pycache__" in dirpath:
-            continue
-        for name in files:
-            if not name.endswith(".py"):
-                continue
-            rel = os.path.relpath(os.path.join(dirpath, name), pkg_root)
-            if rel in _URLOPEN_ALLOWLIST:
-                continue
-            with open(os.path.join(dirpath, name)) as f:
-                for lineno, line in enumerate(f, 1):
-                    code = line.split("#", 1)[0]
-                    if "urlopen(" in code:
-                        offenders.append(f"{rel}:{lineno}")
+    net/transport.py. Since PR 5 the gate is the analysis plane's
+    ``raw-urlopen`` rule (tools/analyze); the allowlist lives in
+    analyze.toml. This test keeps the historical tier-1 name as a thin
+    wrapper over the framework."""
+    from celestia_app_tpu.tools.analyze import run_analysis
+
+    rep = run_analysis(only_rules={"raw-urlopen"})
+    offenders = [str(v) for v in rep.errors]
     assert not offenders, (
         "direct urlopen outside net/transport.py (route peer I/O through "
-        f"the hardened PeerClient, or allowlist with a reason): {offenders}"
+        "the hardened PeerClient, or allowlist with a reason in "
+        f"analyze.toml): {offenders}"
     )

@@ -472,39 +472,18 @@ def test_logger_levels_and_json_mode(capsys):
         obs_log.configure()  # back to env defaults
 
 
-# library modules allowed to print: the CLI (human surface) and tools/
-# (operator scripts print their JSON reports). Everything else goes
-# through obs.log — add here EXPLICITLY with a reason.
-_PRINT_ALLOW_PREFIXES = ("tools" + os.sep,)
-_PRINT_ALLOW_FILES = {"cli.py", "__main__.py"}
-_PRINT_RE = re.compile(r"\bprint\(")
-
-
 def test_no_print_in_library_modules():
     """Library code logs through obs.log (leveled, structured,
-    env-filtered) — bare print calls must not come back (same gate
-    pattern as PR 3's urlopen lint)."""
-    import celestia_app_tpu
+    env-filtered) — bare print calls must not come back. Since PR 5 the
+    gate is the analysis plane's ``print-call`` rule (tools/analyze);
+    its allowlist — cli.py, __main__.py, tools/ — lives in analyze.toml
+    with the reasons. This test keeps the historical tier-1 name as a
+    thin wrapper over the framework."""
+    from celestia_app_tpu.tools.analyze import run_analysis
 
-    pkg_root = os.path.dirname(os.path.abspath(celestia_app_tpu.__file__))
-    offenders = []
-    for dirpath, _dirs, files in os.walk(pkg_root):
-        if "__pycache__" in dirpath:
-            continue
-        for name in files:
-            if not name.endswith(".py"):
-                continue
-            rel = os.path.relpath(os.path.join(dirpath, name), pkg_root)
-            if rel in _PRINT_ALLOW_FILES or rel.startswith(
-                _PRINT_ALLOW_PREFIXES
-            ):
-                continue
-            with open(os.path.join(dirpath, name)) as f:
-                for lineno, line in enumerate(f, 1):
-                    code = line.split("#", 1)[0]
-                    if _PRINT_RE.search(code):
-                        offenders.append(f"{rel}:{lineno}")
+    rep = run_analysis(only_rules={"print-call"})
+    offenders = [str(v) for v in rep.errors]
     assert not offenders, (
         "print call in a library module (use celestia_app_tpu.obs.log, "
-        f"or allowlist with a reason): {offenders}"
+        f"or allowlist with a reason in analyze.toml): {offenders}"
     )

@@ -30,6 +30,15 @@ from celestia_app_tpu.service.validator_server import ValidatorService
 CHAIN = "celestia-stress-test"
 
 
+@pytest.fixture(autouse=True)
+def _racecheck(racecheck_guard):
+    """The stress tier runs under CELESTIA_RACE=1 (ISSUE 5): every lock
+    the hammered network creates is wrapped by the runtime lock-order
+    detector; an observed ABBA inversion fails the test at teardown
+    (shared racecheck_guard fixture, tests/conftest.py)."""
+    yield
+
+
 def _post(url: str, path: str, payload: dict, timeout: float = 10.0):
     req = urllib.request.Request(
         url + path, data=json.dumps(payload).encode(),
