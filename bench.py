@@ -548,11 +548,62 @@ def _run_probe_child(timeout_s: float) -> str | None:
     return f"probe rc={r.returncode}: " + " | ".join(tail[-2:])
 
 
+def _run_cpu_fallback(errors: list[str], deadline: float) -> bool:
+    """Relay down: measure on the CPU backend NOW and emit the result
+    with `"backend": "cpu-fallback"` — a labeled real number keeps the
+    perf trajectory continuous instead of burning the whole budget
+    polling a dead tunnel (the relay has answered no probes since round
+    4). True = a final JSON line was emitted."""
+    # this is the bench's LAST act (the alternative is polling a dead
+    # tunnel), so the child gets the whole remaining budget, not the
+    # per-attempt cap: minimal mode on one CPU core runs ~7 min
+    remaining = deadline - time.monotonic()
+    child_timeout = remaining - SAFETY_MARGIN_S
+    if child_timeout < 120:
+        return False
+    env = dict(os.environ)
+    # the axon sitecustomize registers its plugin whenever the pool var
+    # is set, and a DOWN relay hangs ANY backend init — even cpu — so
+    # the fallback child must not see it at all
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CELESTIA_BENCH_CHILD_TIMEOUT"] = str(int(child_timeout))
+    env["CELESTIA_BENCH_MINIMAL"] = "1"   # shortest path to a real number
+    env["CELESTIA_BENCH_SKIP_CAL"] = "1"  # schedule probing is relay-side noise
+    _emit(errors, "provisional: relay down, measuring labeled CPU fallback")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            capture_output=True,
+            text=True,
+            timeout=child_timeout,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        errors.append(f"cpu fallback: timeout after {child_timeout:.0f}s")
+        _emit(errors, "provisional: cpu fallback timed out")
+        return False
+    parsed = _parse_last_json(r.stdout) if r.returncode == 0 else None
+    if parsed is None or parsed.get("value") is None:
+        tail = (r.stderr or "").strip().splitlines()
+        errors.append(
+            f"cpu fallback rc={r.returncode}: " + " | ".join(tail[-2:]))
+        _emit(errors, "provisional: cpu fallback failed")
+        return False
+    parsed["backend"] = "cpu-fallback"
+    parsed["relay_error"] = errors[-1] if errors else ""
+    print(json.dumps(parsed), flush=True)
+    return True
+
+
 def _run_parent() -> None:
     """Deadline-driven measurement loop. Invariants: (a) total wall-clock is
-    bounded by TOTAL_BUDGET_S regardless of how attempts fail, and (b) stdout
+    bounded by TOTAL_BUDGET_S regardless of how attempts fail, (b) stdout
     always ends with a parseable JSON line, even if the driver kills us
-    mid-attempt (provisional lines are flushed before every wait)."""
+    mid-attempt (provisional lines are flushed before every wait), and
+    (c) a dead relay FAILS FAST: one confirming re-probe, then a labeled
+    CPU-fallback measurement instead of polling until the budget dies."""
     deadline = time.monotonic() + TOTAL_BUDGET_S
     errors: list[str] = []
     _emit(errors, "provisional: bench starting")
@@ -566,6 +617,16 @@ def _run_parent() -> None:
         if probe_err is not None:
             errors = errors[-6:]
             errors.append(probe_err)
+            # relay-probe housekeeping (ROADMAP): confirm with one SHORT
+            # re-probe (rules out a transient), then fall back to a
+            # labeled CPU number rather than waiting out the budget
+            second = _run_probe_child(
+                min(PROBE_TIMEOUT_S / 3,
+                    max(10.0, (deadline - time.monotonic()) / 4)))
+            if second is not None:
+                errors.append(second)
+                if _run_cpu_fallback(errors, deadline):
+                    return
             _emit(errors, "provisional: waiting for relay")
             time.sleep(min(20, max(0, deadline - time.monotonic() - SAFETY_MARGIN_S)))
             continue
@@ -616,6 +677,9 @@ def main() -> None:
         return
     if "--proofs" in sys.argv:
         measure_proofs()
+        return
+    if "--admission" in sys.argv:
+        measure_admission()
         return
     if "--mempool" in sys.argv:
         measure_mempool()
@@ -690,6 +754,121 @@ def measure_analyze(reps: int = 3) -> None:
         "budget_s": 10.0,
         "within_budget": best < 10.0,
     }))
+
+
+def measure_admission(n_sigs: int = 512, n_senders: int = 32,
+                      ingest_senders: int = 16,
+                      ingest_txs_per_sender: int = 32) -> None:
+    """Admission-plane bench (--admission). Two BENCH JSON lines:
+
+      {"metric": "sig_verify_per_sec", ...}  batched secp256k1 ECDSA
+          verification throughput (ops/secp256k1: vmapped 10x26-limb
+          field math, complete RCB point formulas, GLV-halved doubling
+          chain; one jit dispatch per 512 lanes) against the scalar
+          `_py_verify` baseline measured IN THE SAME RUN — acceptance is
+          >= 10x scalar on CPU; the >= 100k/s figure stays the recorded
+          target for the next TPU relay window.
+      {"metric": "mempool_ingest_txs_per_sec", ...}  CAT-pool ingest
+          through the TWO-PHASE batched admission path
+          (Node.broadcast_txs: one stateless batch-signature dispatch,
+          then stateful per-tx CheckTx hitting the verified-sig cache) —
+          directly comparable with the PR-2 scalar-path number from
+          --mempool.
+    """
+    import random
+
+    from celestia_app_tpu.chain import crypto
+    from celestia_app_tpu.chain.app import App
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.chain.tx import MsgSend
+    from celestia_app_tpu.client.tx_client import Signer
+    from celestia_app_tpu.ops import secp256k1 as fast
+
+    # -- 1) raw signature-verification throughput ------------------------
+    privs = [PrivateKey.from_seed(b"adm-%d" % (i % n_senders))
+             for i in range(n_sigs)]
+    items = []
+    for i, p in enumerate(privs):
+        msg = b"admission-bench-%d" % i
+        items.append((p.public_key().compressed, p.sign(msg), msg))
+
+    scalar_n = min(48, n_sigs)
+    t0 = time.perf_counter()
+    for it in items[:scalar_n]:
+        assert crypto._py_verify(*it)
+    scalar_per_sec = scalar_n / (time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    mask = fast.verify_batch(items)
+    first_s = time.perf_counter() - t0  # includes the one-time jit compile
+    assert mask.all()
+    best = first_s
+    for _ in range(3):
+        t0 = time.perf_counter()
+        fast.verify_batch(items)
+        best = min(best, time.perf_counter() - t0)
+    batched_per_sec = n_sigs / best
+    backend = "scalar-fallback"
+    if fast.available():
+        import jax
+
+        backend = jax.devices()[0].platform
+    print(json.dumps({
+        "metric": "sig_verify_per_sec",
+        "value": round(batched_per_sec, 1),
+        "unit": "sigs/s",
+        "scalar_per_sec": round(scalar_per_sec, 1),
+        "vs_scalar": round(batched_per_sec / scalar_per_sec, 2),
+        "batch": n_sigs,
+        "compile_s": round(first_s - best, 2),
+        "backend": backend,
+        "tpu_target_per_sec": 100_000,
+    }), flush=True)
+
+    # -- 2) two-phase mempool ingest -------------------------------------
+    chain = "admission-bench"
+    iprivs = [PrivateKey.from_seed(b"ing-%d" % i)
+              for i in range(ingest_senders)]
+    addrs = [p.public_key().address() for p in iprivs]
+    app = App(chain_id=chain, engine="host")
+    app.init_chain({
+        "time_unix": 1_700_000_000.0,
+        "accounts": [{"address": a.hex(), "balance": 10**12}
+                     for a in addrs],
+        "validators": [{"operator": addrs[0].hex(), "power": 10}],
+    })
+    signer = Signer(chain)
+    for i, p in enumerate(iprivs):
+        signer.add_account(p, number=i)
+    rng = random.Random(0)
+    raws: list[bytes] = []
+    for _seq in range(ingest_txs_per_sender):
+        for i, a in enumerate(addrs):
+            tx = signer.create_tx(
+                a, [MsgSend(a, addrs[(i + 1) % ingest_senders], 1)],
+                fee=rng.randint(1_000, 100_000), gas_limit=100_000,
+            )
+            signer.accounts[a].sequence += 1
+            raws.append(tx.encode())
+    node = Node(app)
+    t0 = time.perf_counter()
+    results = node.broadcast_txs(raws)
+    ingest_s = time.perf_counter() - t0
+    admitted = sum(1 for r in results if r.code == 0)
+    from celestia_app_tpu.utils import telemetry
+
+    counters = telemetry.snapshot().get("counters", {})
+    print(json.dumps({
+        "metric": "mempool_ingest_txs_per_sec",
+        "value": round(len(raws) / ingest_s, 1),
+        "unit": "tx/s",
+        "n_txs": len(raws),
+        "admitted": admitted,
+        "path": "two-phase-batched",
+        "batch_verified": counters.get("admission.batch_verified", 0),
+        "scalar_verified": counters.get("admission.sig_scalar_verified", 0),
+    }), flush=True)
 
 
 def measure_mempool(n_senders: int = 16, txs_per_sender: int = 32) -> None:

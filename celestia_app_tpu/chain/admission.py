@@ -1,0 +1,209 @@
+"""The admission plane: two-phase tx admission with batched sig verification.
+
+The per-user hot path used to pay one scalar secp256k1 verification per
+transaction per phase — CheckTx at the mempool door, the PrepareProposal
+ante filter, ProcessProposal on every validator, FinalizeBlock delivery,
+and again on blocksync/WAL replay. This module restructures that into the
+ROADMAP's two-phase admit:
+
+  phase 1 (stateless): a whole batch of pending signatures is verified in
+      ONE vmapped device dispatch (`ops/secp256k1.verify_batch`), and
+      every success is recorded in the App's `VerifiedSigCache` keyed by
+      the exact (pubkey, signature, sign-doc) triple;
+  phase 2 (stateful): the ante chain runs per tx as before — nonce, fee,
+      gas, blob gates — but its signature step consults the cache first,
+      so a tx admitted at CheckTx is NEVER re-verified at proposal,
+      delivery, or replay time.
+
+The cache is sound by construction: a key is inserted only after the
+signature verified TRUE over exactly the bytes the ante would verify, so
+a hit can only skip a verification that would have returned True with
+identical inputs. Consensus results are bit-identical with the cache on,
+off, hot, or cold — prevalidation is an optimization plane, never an
+authority, and any failure inside it degrades to the scalar path (counted
+in telemetry, `admission.prevalidate_errors`).
+
+Telemetry (the counters the tier-1 no-re-verification test pins):
+  admission.sig_cache_hits       ante skipped a verify via the cache
+  admission.sig_scalar_verified  ante ran a scalar verify (cache miss)
+  admission.batch_dispatches     device batch dispatches
+  admission.batch_lanes          signatures sent through the device path
+  admission.batch_verified       lanes that verified and were cached
+  admission.batch_rejected       lanes that failed batch verification
+  admission.prevalidate_below_batch  batches too small for the device
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+
+from celestia_app_tpu.utils import telemetry
+
+# Below this many uncached signatures the device dispatch is not worth
+# its padding (and, on first use in a process, its jit compile): the
+# ante's scalar path fills the cache instead. Tests and the bench pin it
+# via env to force either path.
+MIN_DEVICE_BATCH = int(os.environ.get("CELESTIA_ADMISSION_MIN_BATCH", "16"))
+SIG_CACHE_MAX = 65536
+
+
+def sig_key(pubkey: bytes, signature: bytes, message: bytes) -> bytes:
+    """The cache key: length-framed so no two distinct (pubkey, sig,
+    sign-doc) triples can collide by concatenation ambiguity."""
+    h = hashlib.sha256()
+    for part in (pubkey, signature, message):
+        h.update(len(part).to_bytes(4, "big"))
+        h.update(part)
+    return h.digest()
+
+
+class VerifiedSigCache:
+    """Bounded LRU set of signature triples that verified TRUE.
+
+    Lives on the App (one per state machine); CheckTx, the proposal
+    paths, and replay all share it. Entries are state-independent facts
+    (pure curve math over fixed bytes), so the cache survives rollbacks
+    and reloads untouched."""
+
+    def __init__(self, maxsize: int = SIG_CACHE_MAX):
+        self.maxsize = maxsize
+        self._lock = threading.Lock()
+        self._keys: OrderedDict[bytes, None] = OrderedDict()  # guarded-by: _lock
+
+    key = staticmethod(sig_key)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._keys)
+
+    def hit(self, key: bytes) -> bool:
+        with self._lock:
+            if key in self._keys:
+                self._keys.move_to_end(key)
+                telemetry.incr("admission.sig_cache_hits")
+                return True
+            return False
+
+    def contains(self, key: bytes) -> bool:
+        """Membership probe WITHOUT the hit counter or LRU refresh —
+        prevalidation's dedup uses this so `admission.sig_cache_hits`
+        keeps meaning "the ante skipped a verify"."""
+        with self._lock:
+            return key in self._keys
+
+    def put(self, key: bytes) -> None:
+        with self._lock:
+            self._keys[key] = None
+            self._keys.move_to_end(key)
+            while len(self._keys) > self.maxsize:
+                self._keys.popitem(last=False)
+
+
+def extract_sig_item(app, raw: bytes, store=None):
+    """(pubkey, signature, sign-doc bytes) for one raw tx, or None when
+    the tx cannot be prevalidated — undecodable, policy-rejected sig
+    shape (non-64-byte or high-S, which `PublicKey.verify` refuses before
+    any curve math), or a proto tx whose signer account does not exist
+    yet (its sign doc needs the account number ensure_account will only
+    assign inside the ante). None is never an error: the ante remains
+    the authority and simply verifies those txs on its scalar path."""
+    from celestia_app_tpu.chain.crypto import _N, PublicKey
+    from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+    from celestia_app_tpu.chain.tx import decode_tx
+    from celestia_app_tpu.da import blob as blob_mod
+
+    try:
+        btx = blob_mod.try_unmarshal_blob_tx(raw)
+        tx = decode_tx(btx.tx if btx is not None else raw)
+    except ValueError:
+        return None
+    sig = tx.signature
+    if len(sig) != 64 or int.from_bytes(sig[32:], "big") > _N // 2:
+        return None
+    if getattr(tx, "wire_format", "native") == "proto":
+        addr = PublicKey(tx.pubkey).address()
+        ctx = Context(
+            store if store is not None else app.store,
+            InfiniteGasMeter(), app.height, 0,
+            app.chain_id, app.app_version,
+        )
+        acc = app.auth.account(ctx, addr)
+        if acc is None:
+            return None
+        doc = tx.sign_doc(app.chain_id, acc["number"])
+    else:
+        doc = tx.sign_doc()
+    return (tx.pubkey, sig, doc)
+
+
+def prevalidate(app, raws, *, check_state: bool = False) -> int:
+    """Phase 1: batch-verify the signatures of `raws` that are not
+    already in the App's verified-sig cache, in one device dispatch, and
+    cache the successes. Returns how many lanes verified. Never raises
+    and never rejects anything — a tx that fails (or skips) batch
+    verification simply meets the ante's scalar verify later and fails
+    THERE, with identical semantics."""
+    cache = getattr(app, "sig_cache", None)
+    if cache is None or not raws:
+        return 0
+    from celestia_app_tpu.ops import secp256k1 as fast
+
+    store = None
+    if check_state:
+        # single read: a concurrent commit nulls app._check_state, and a
+        # torn two-read would hand Context a None store. A stale branch
+        # is harmless — the cache only stores state-independent facts.
+        store = app._check_state
+        if store is None:
+            store = app.store
+    items: list[tuple[bytes, bytes, bytes]] = []
+    keys: list[bytes] = []
+    seen: set[bytes] = set()
+    for raw in raws:
+        try:
+            item = extract_sig_item(app, raw, store=store)
+        except Exception:
+            # prevalidation NEVER raises (callers may run it outside the
+            # service lock, racing commits): an unexpected extraction
+            # failure just leaves the tx to the ante's scalar path
+            telemetry.incr("admission.prevalidate_errors")
+            item = None
+        if item is None:
+            continue
+        key = sig_key(*item)
+        if key in seen or cache.contains(key):
+            continue
+        seen.add(key)
+        items.append(item)
+        keys.append(key)
+    if not items:
+        return 0
+    if len(items) < MIN_DEVICE_BATCH or not fast.available():
+        telemetry.incr("admission.prevalidate_below_batch")
+        return 0
+    try:
+        mask = fast.verify_batch(items)
+    except Exception as e:
+        # the scalar path in the ante stays authoritative; count + log
+        telemetry.incr("admission.prevalidate_errors")
+        from celestia_app_tpu import obs
+
+        obs.get_logger("chain.admission").error(
+            "batch sig prevalidation failed; scalar path takes over",
+            err=e,
+        )
+        return 0
+    telemetry.incr("admission.batch_dispatches")
+    telemetry.incr("admission.batch_lanes", by=len(items))
+    verified = 0
+    for ok, key in zip(mask, keys):
+        if bool(ok):
+            cache.put(key)
+            verified += 1
+        else:
+            telemetry.incr("admission.batch_rejected")
+    telemetry.incr("admission.batch_verified", by=verified)
+    return verified

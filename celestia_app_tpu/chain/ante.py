@@ -42,6 +42,7 @@ import dataclasses
 from celestia_app_tpu import appconsts
 from celestia_app_tpu.chain import modules
 from celestia_app_tpu.chain.state import Context
+from celestia_app_tpu.utils import telemetry
 from celestia_app_tpu.chain.tx import (
     MsgBeginRedelegate,
     MsgCreateValidator,
@@ -142,6 +143,11 @@ class AnteHandler:
     min_gas_price: float = appconsts.DEFAULT_MIN_GAS_PRICE
     feegrant: object | None = None  # FeeGrantKeeper when enabled
     ibc: object | None = None  # IBCStack for the redundant-relay decorator
+    # the App's VerifiedSigCache (chain/admission.py): step 5c consults it
+    # before paying a scalar verification, and records its own successes,
+    # so a signature checked ONCE (batched or scalar, any phase) is never
+    # re-verified at proposal, delivery, or replay time
+    sig_cache: object | None = None
 
     def __post_init__(self):
         # node-local floor, parsed once (it is fixed for the handler's life)
@@ -266,13 +272,25 @@ class AnteHandler:
                 raise AnteError(
                     f"account sequence mismatch, expected {acc['sequence']}, got {body.sequence}"
                 )
-            if is_proto:
-                # sign doc covers chain id + account number: a tx signed for
-                # another chain or account number fails right here
-                if not tx.verify_signature(ctx.chain_id, acc["number"]):
+            # the sign doc covers chain id + account number (proto), so a
+            # tx signed for another chain or account number fails here.
+            # The verified-sig cache (admission plane) keys on the EXACT
+            # doc bytes: a hit can only skip a verification that would
+            # have returned True on identical inputs.
+            doc = (tx.sign_doc(ctx.chain_id, acc["number"]) if is_proto
+                   else tx.sign_doc())
+            cache = self.sig_cache
+            key = None
+            if cache is not None:
+                key = cache.key(tx.pubkey, tx.signature, doc)
+            if key is None or not cache.hit(key):
+                # verify over the doc already in hand (verify_signature
+                # would re-serialize the identical bytes)
+                if not PublicKey(tx.pubkey).verify(tx.signature, doc):
                     raise AnteError("signature verification failed")
-            elif not tx.verify_signature():
-                raise AnteError("signature verification failed")
+                telemetry.incr("admission.sig_scalar_verified")
+                if cache is not None:
+                    cache.put(key)
             self.auth.set_pubkey(ctx, signer, tx.pubkey)
 
         # 7. blob decorators

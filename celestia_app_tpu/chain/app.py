@@ -26,6 +26,7 @@ import time as time_mod
 
 from celestia_app_tpu import appconsts
 from celestia_app_tpu import obs
+from celestia_app_tpu.chain import admission as admission_mod
 from celestia_app_tpu.chain import ante as ante_mod
 from celestia_app_tpu.chain import blobstream as blobstream_mod
 from celestia_app_tpu.chain import gov as gov_mod
@@ -281,9 +282,15 @@ class App:
         # one source of truth; use set_begin_order/set_end_order only to
         # diverge from it)
         self.module_manager = mm
+        # the admission plane's verified-sig cache: a (pubkey, sig,
+        # sign-doc) triple verified once — batched at CheckTx or block
+        # prevalidation, or scalar in the ante — is never verified again
+        # in any later phase. State-independent, so rollback/load leave it.
+        self.sig_cache = admission_mod.VerifiedSigCache()
         self.ante = ante_mod.AnteHandler(
             self.auth, self.bank, self.blob, self.minfee, min_gas_price,
             feegrant=self.feegrant, ibc=self.ibc,
+            sig_cache=self.sig_cache,
         )
         # committed-state snapshots for load_height rollback (app/app.go:592);
         # when a ChainDB is attached the window lives on disk instead
@@ -670,6 +677,11 @@ class App:
             self.store.branch(), InfiniteGasMeter(), check=False,
             height=h.height, t=h.time_unix,
         )
+        # admission plane, phase 1: verify the whole block's signatures
+        # in one batched dispatch; the per-tx ante runs below then hit
+        # the verified-sig cache (CheckTx-admitted txs are already in it
+        # and are not re-verified here at all)
+        admission_mod.prevalidate(self, block.txs)
         normal_txs: list[bytes] = []
         pfb_entries: list[PfbEntry] = []
         # Batch all blob commitments of the block in one device pass

@@ -393,6 +393,35 @@ class ValidatorNode:
                              now=time_mod.time(),
                              check_fn=self.app.check_tx)
 
+    def add_txs(self, raws) -> list:
+        """Batched admission (admission plane phase 1 + per-tx CheckTx):
+        an ingest burst pays ONE signature dispatch, not one per tx."""
+        import time as time_mod
+
+        from celestia_app_tpu.chain import admission
+
+        return self.pool.add_batch(
+            raws, height=self.app.height, now=time_mod.time(),
+            check_fn=self.app.check_tx,
+            prevalidate_fn=lambda rs: admission.prevalidate(
+                self.app, rs, check_state=True),
+        )
+
+    def prevalidate_txs(self, raws) -> int:
+        """Admission plane phase 1 ALONE: batch-verify the signatures of
+        not-yet-pooled txs into the verified-sig cache. Stateless and
+        never raises, so the reactor runs it OUTSIDE the service lock —
+        the first qualifying batch pays the kernel's jit compile, which
+        must not stall the consensus loop (a racing commit at worst
+        costs a cache miss, never a wrong verdict)."""
+        from celestia_app_tpu.chain import admission
+        from celestia_app_tpu.mempool.pool import tx_hash
+
+        fresh = [raw for raw in raws if not self.pool.has(tx_hash(raw))]
+        if not fresh:
+            return 0
+        return admission.prevalidate(self.app, fresh, check_state=True)
+
     def reap_mempool(self) -> list[bytes]:
         """Priority order: gas price desc, per-sender arrival order kept —
         the order FilterTxs receives candidates in (mempool v1 semantics;
@@ -874,6 +903,13 @@ class ValidatorNode:
                 self._set_absent(present)
             else:
                 self._mark_absent_from_votes(cert)
+            # admission plane: one batched dispatch verifies the whole
+            # replayed block's signatures (replay skips process_proposal,
+            # where the live path prevalidates); the delivery ante below
+            # hits the verified-sig cache instead of re-verifying per tx
+            from celestia_app_tpu.chain import admission
+
+            admission.prevalidate(self.app, block.txs)
             results = self.app.finalize_block(block)
             self.app.commit(block)
             self.certificates[height] = cert

@@ -22,6 +22,7 @@ Two interchangeable backends, chosen at import:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import hashlib
 import hmac
 
@@ -125,8 +126,15 @@ def _to_affine(p):
 _G = (_GX, _GY, 1)
 
 
+@functools.lru_cache(maxsize=8192)
 def _decompress(compressed: bytes):
-    """(x, y) from a 33-byte SEC1 compressed point; None if invalid."""
+    """(x, y) from a 33-byte SEC1 compressed point; None if invalid.
+
+    LRU-cached on the compressed encoding: repeat senders (the normal
+    case for per-account nonce chains under load) skip the modular
+    square root on every tx. Invalid encodings cache as None, so a
+    malformed-key flood costs one sqrt attempt per distinct key at most,
+    and the bound keeps an adversary from growing the table."""
     if len(compressed) != 33 or compressed[0] not in (2, 3):
         return None
     x = int.from_bytes(compressed[1:], "big")

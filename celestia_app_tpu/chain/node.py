@@ -93,6 +93,18 @@ class Node:
         return self.pool.add(raw, height=self.app.height,
                              check_fn=self.app.check_tx)
 
+    def broadcast_txs(self, raws) -> list[TxResult]:
+        """Batched BroadcastMode_SYNC: one stateless signature
+        prevalidation dispatch (admission plane phase 1), then the usual
+        per-tx stateful CheckTx admission hitting the verified-sig cache."""
+        from celestia_app_tpu.chain import admission
+
+        return self.pool.add_batch(
+            raws, height=self.app.height, check_fn=self.app.check_tx,
+            prevalidate_fn=lambda rs: admission.prevalidate(
+                self.app, rs, check_state=True),
+        )
+
     def _reap(self) -> list[bytes]:
         """Priority order: gas price desc, per-sender arrival order kept."""
         return self.pool.reap(self.app.height)

@@ -419,9 +419,12 @@ class ConsensusReactor:
 
     def _admit_pending_txs(self) -> None:
         """The mempool-reactor loop half: drain queued WantTx pulls and
-        direct deliveries, admit through the ONE CAT admission path
-        (vnode.add_tx), and re-announce admitted txs to peers not known
-        to have them."""
+        direct deliveries, admit through the ONE CAT admission path —
+        two-phase: the whole drained queue pays a single stateless
+        signature-prevalidation dispatch (admission plane phase 1,
+        OUTSIDE the service lock so a first-batch jit compile cannot
+        stall the consensus loop) before the per-tx stateful CheckTx —
+        and re-announce admitted txs to peers not known to have them."""
         with self._msg_lock:
             wants, self._pending_wants = self._pending_wants, []
             pending, self._pending_txs = self._pending_txs, []
@@ -429,11 +432,15 @@ class ConsensusReactor:
             raw = self._pull_tx(h, provider)
             if raw is not None:
                 pending.append((raw, provider))
+        if not pending:
+            return
         from celestia_app_tpu.mempool.pool import tx_hash
 
-        for raw, _src in pending:
-            with self.service_lock:
-                res = self.vnode.add_tx(raw)
+        raws = [raw for raw, _src in pending]
+        self.vnode.prevalidate_txs(raws)
+        with self.service_lock:
+            results = [self.vnode.add_tx(raw) for raw in raws]
+        for (raw, _src), res in zip(pending, results):
             if res.code == 0:
                 # announce UNCONDITIONALLY (not via gossip_tx's dedup
                 # gate): a direct-push delivery already consumed

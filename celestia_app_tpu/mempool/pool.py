@@ -289,6 +289,24 @@ class CATPool:
             self.metrics.incr(ADMITTED)
             return res
 
+    def add_batch(self, raws, *, height: int, now: float | None = None,
+                  check_fn=None, prevalidate_fn=None) -> list[TxResult]:
+        """Two-phase batched admission (the ROADMAP's two-phase admit):
+        phase 1 runs the caller's STATELESS signature prevalidation over
+        the not-yet-pooled txs as one batch — one device dispatch filling
+        the verified-sig cache (chain/admission.py) — and phase 2 runs
+        the standard stateful per-tx admission, whose CheckTx then hits
+        the cache instead of re-verifying each signature. Results align
+        with `raws`; dedup/eviction semantics are exactly `add`'s."""
+        if prevalidate_fn is not None:
+            # membership probe outside phase 2's lock holds; a racing
+            # duplicate only costs a cache lookup, never a double-verify
+            fresh = [raw for raw in raws if not self.has(tx_hash(raw))]
+            if fresh:
+                prevalidate_fn(fresh)
+        return [self.add(raw, height=height, now=now, check_fn=check_fn)
+                for raw in raws]
+
     # -- lifecycle -------------------------------------------------------
 
     def expire(self, height: int, now: float | None = None) -> list[PoolTx]:
