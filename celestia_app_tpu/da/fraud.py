@@ -106,6 +106,58 @@ def generate_befp(
     return BadEncodingProof(axis=axis, index=index, shares=tuple(shares))
 
 
+def _decode_axis(symbols: np.ndarray, present: list[int], k: int) -> np.ndarray:
+    """Unique-codeword reconstruction from EXACTLY k present shares. With
+    k shares the system is exactly determined, so the fused decode-matrix
+    matmul and the FWHT error-locator path produce identical bytes
+    (tests/test_repair.py); take the matmul only when its closure is
+    already cached — a one-shot BEFP must not pay a jit compile."""
+    pattern = tuple(sorted(present))
+    # atomic get, gated on the batch-1 bucket being compiled: neither a
+    # build nor a jit retrace may stall the gossip-rate path
+    run = rs.repair_axes_get(k, pattern, batch_size=1)
+    if run is not None:
+        return np.asarray(run(symbols[None]))[0]
+    return rs.repair_axis(symbols, list(present))
+
+
+def _expected_axis_root(recovered: np.ndarray, axis: str, index: int,
+                        k: int) -> bytes:
+    """Root the header SHOULD carry for the decoded axis — BLIND leaf
+    append (no namespace-order enforcement): a fraudulent row decodes to
+    arbitrary prefixes, and the comparison is against whatever the
+    producer committed, ordered or not. Fast path: the batched device NMT
+    reduction (ops/nmt.eds_axis_roots, shared with the repair sweep
+    engine) once its batch-1 program is warm, so a DASer fleet checks
+    fraud proofs at gossip rate; the shared host recompute
+    (da/repair._axis_root) covers cold programs and device failure,
+    bit-identically."""
+    from celestia_app_tpu.da import repair
+    from celestia_app_tpu.ops import nmt
+    from celestia_app_tpu.utils import telemetry
+
+    recovered = np.ascontiguousarray(recovered, dtype=np.uint8)
+    slab = recovered.reshape(2 * k, -1)
+    # no-compile-on-gossip-path invariant (same as _decode_axis): a cold
+    # (k, batch-1) program would stall the first verification for a full
+    # XLA compile; until something has warmed it, the shared host
+    # recompute (da/repair._axis_root — repair and BEFP verification
+    # must agree on leaf construction, so there is exactly ONE host
+    # implementation of the blind axis tree) IS gossip-rate
+    if nmt.eds_axis_roots_compiled(k, 1):
+        try:
+            return nmt.eds_axis_roots(slab[None], [index], k)[0].tobytes()
+        except Exception as e:
+            # device/backend failure must not decide a fraud verdict:
+            # fall back to the host tree (bit-identical) and count it
+            telemetry.incr("fraud.device_root_fallbacks")
+            from celestia_app_tpu import obs
+
+            obs.get_logger("da.fraud").warning(
+                "device axis-root recompute failed; host fallback", err=e)
+    return repair._axis_root(slab, axis, index, k)
+
+
 def verify_befp(dah: DataAvailabilityHeader, befp: BadEncodingProof) -> bool:
     """True iff the proof demonstrates the header commits a non-codeword.
 
@@ -136,18 +188,12 @@ def verify_befp(dah: DataAvailabilityHeader, befp: BadEncodingProof) -> bool:
                 return False
             symbols[j] = np.frombuffer(swp.share, dtype=np.uint8)
             present.append(j)
-        # decode the unique codeword those k shares determine (FWHT decoder)
-        recovered = rs.repair_axis(symbols, present)
-        # recompute the root the header SHOULD carry for this axis — BLIND
-        # leaf append (no namespace-order enforcement): a fraudulent row
-        # decodes to arbitrary prefixes, and the comparison below is against
-        # whatever the producer committed, ordered or not
-        tree = nmt_host.NmtTree()
-        for j in range(width):
-            r, c = (befp.index, j) if befp.axis == "row" else (j, befp.index)
-            share = recovered[j].tobytes()
-            tree.leaves.append((leaf_ns(r, c, share, k), share))
-        expected = nmt_host.serialize(tree.root())
+        # decode the unique codeword those k shares determine: the fused
+        # decode-matrix matmul (the repair engine's primitive) when the
+        # pattern's closure is already cached, else the FWHT decoder —
+        # both reconstruct the same unique codeword from k shares
+        recovered = _decode_axis(symbols, present, k)
+        expected = _expected_axis_root(recovered, befp.axis, befp.index, k)
         committed = (
             dah.row_roots[befp.index]
             if befp.axis == "row"

@@ -403,8 +403,19 @@ class DASer:
             symbols[r, c] = np.frombuffer(share, dtype=np.uint8)
             present[r, c] = True
         try:
-            repair.repair_eds(symbols, present,
-                              list(dah.row_roots), list(dah.col_roots))
+            # the batched sweep engine (da/repair.py): per-pattern fused
+            # decode matmuls + per-sweep batched root verification; its
+            # da.repair.sweep / da.repair.verify_roots spans land in this
+            # light node's trace tables and nest under das.sample_height
+            t_rep = telemetry.start_timer()
+            try:
+                repair.repair_eds(symbols, present,
+                                  list(dah.row_roots), list(dah.col_roots),
+                                  traces=self.traces)
+            finally:
+                # the fraud/unsolvable outcomes are exactly the repairs
+                # worth timing — measure on every path
+                telemetry.measure_since("daser.repair", t_rep)
         except repair.BadEncodingError as e:
             befp = self._build_befp(height, dah, e.axis, e.index)
             if befp is not None and self.light.submit_fraud_proof(dah, befp):

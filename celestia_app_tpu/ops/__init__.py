@@ -1,0 +1,9 @@
+"""Device kernels. Shared helper: the ONE power-of-two batch-bucket rule
+every shape-bucketed jitted program in this package pads to (jax.jit
+retraces per shape; bucketing bounds per-program compiles at log2(n_max)
+— `ops/rs._RepairAxesRunner`, `ops/nmt.eds_axis_roots`)."""
+
+
+def pow2_bucket(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    return 1 << (n - 1).bit_length() if n > 1 else 1

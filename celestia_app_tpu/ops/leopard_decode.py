@@ -35,6 +35,30 @@ import numpy as np
 from celestia_app_tpu.ops import leopard
 
 
+@functools.lru_cache(maxsize=512)
+def fused_decode_matrix(k: int, use: tuple[int, ...]) -> np.ndarray:
+    """The (2k, k) LABEL-space matrix mapping the k chosen present symbols
+    of an erasure pattern to the FULL 2k codeword: G ·gf D, with D the
+    decode matrix for the pattern (inverse of the generator rows at `use`)
+    and G the generator — decode and re-encode fused into one matmul, the
+    precomputed-decode-matrix technique of arXiv:2108.02692 applied to the
+    Leopard code. Cached per (k, pattern) so a sweep engine pays the O(k^3)
+    inversion once per DISTINCT pattern, then reconstructs every axis
+    sharing it with dense GF matmuls (ops/rs.repair_axes_fn lowers this to
+    a device bit-matmul). Entries are labels ((2k, k) bytes/uint16s); the
+    ~bits²-times-larger GF(2) expansion is built per jitted closure, not
+    hoarded per pattern."""
+    if len(use) != k or tuple(sorted(use)) != tuple(use):
+        raise ValueError(f"use must be k={k} sorted positions, got {use!r}")
+    if leopard.uses_gf16(k):
+        return leopard.matmul16(
+            leopard.generator_matrix16(k), leopard.decode_matrix16(k, use)
+        )
+    return leopard.matmul(
+        leopard.generator_matrix(k), leopard.decode_matrix(k, use)
+    )
+
+
 def _fwht_mod(a: np.ndarray, modulus: int) -> np.ndarray:
     """Walsh–Hadamard transform over the XOR group, values mod `modulus`.
 

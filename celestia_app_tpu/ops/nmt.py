@@ -18,13 +18,15 @@ hashed in one vectorized SHA-256 launch. Roots serialize as min||max||v (90 B)
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from celestia_app_tpu import appconsts
 from celestia_app_tpu.da import namespace as ns_mod
-from celestia_app_tpu.ops import sha256
+from celestia_app_tpu.ops import pow2_bucket, sha256
 
 NS = appconsts.NAMESPACE_SIZE  # 29
 PARITY_NS = np.frombuffer(ns_mod.PARITY_NS_RAW, dtype=np.uint8)
@@ -132,6 +134,72 @@ def nmt_roots(leaf_ns: jax.Array, leaf_data: jax.Array) -> jax.Array:
     L must be a power of two (axis lengths of the extended square always are).
     """
     return roots_from_leaf_nodes(*leaf_nodes(leaf_ns, leaf_data))
+
+
+def eds_axis_leaf_ns(slabs: jax.Array, indices: jax.Array, k: int) -> jax.Array:
+    """Leaf namespaces for a BATCH of EDS axes: (n, 2k, 512) axis slabs +
+    (n,) axis indices -> (n, 2k, 29). Axis i's leaf j sits in Q0 (own
+    share prefix) iff indices[i] < k and j < k, else PARITY — the
+    pkg/wrapper rule (da/fraud.leaf_ns), symmetric under transpose, so the
+    same formula serves row slabs (index = row) and column slabs
+    (index = column)."""
+    in_q0 = (indices[:, None] < k) & (jnp.arange(slabs.shape[1])[None, :] < k)
+    parity = jnp.asarray(PARITY_NS)
+    return jnp.where(in_q0[..., None], slabs[:, :, :NS], parity)
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_eds_axis_roots(k: int, n: int):
+    """Compiled: ((n, 2k, 512) u8 slabs, (n,) i32 indices) -> (n, 90) u8
+    NMT roots. One level-synchronous reduction hashes every tree of the
+    batch per SHA launch — the repair sweep engine's per-sweep root
+    verification and the BEFP fast path both land here. Cached per
+    (k, batch-bucket); callers pad n to a power-of-two bucket so sweeps of
+    varying width reuse a handful of programs."""
+    from celestia_app_tpu.obs import jax_profile
+
+    jax_profile.note_compile("nmt.eds_axis_roots", (k, n))
+
+    def run(slabs: jax.Array, indices: jax.Array) -> jax.Array:
+        return nmt_roots(eds_axis_leaf_ns(slabs, indices, k), slabs)
+
+    return jax.jit(run)
+
+
+# (k, bucket) pairs whose program has actually EXECUTED (jit compiles
+# per shape, so lru presence of the factory is not enough); consumers
+# that must never stall on a compile gate on eds_axis_roots_compiled
+_EXEC_BUCKETS: set[tuple[int, int]] = set()
+
+
+def eds_axis_roots_compiled(k: int, n: int) -> bool:
+    """True iff `eds_axis_roots` for a batch of n axes of a 2k-wide
+    square would dispatch an already-compiled program."""
+    return (k, pow2_bucket(n)) in _EXEC_BUCKETS
+
+
+def eds_axis_roots(slabs: np.ndarray, indices, k: int) -> np.ndarray:
+    """Host wrapper over `jitted_eds_axis_roots`: pads the batch to a
+    power-of-two bucket (pad axes carry index k -> all-parity namespaces,
+    discarded on slice) and returns (n, 90) u8 serialized roots —
+    byte-identical to utils/nmt_host trees over the same leaves (pinned in
+    tests/test_nmt.py / tests/test_repair.py)."""
+    slabs = np.ascontiguousarray(slabs, dtype=np.uint8)
+    n = slabs.shape[0]
+    if n == 0:
+        return np.zeros((0, 90), dtype=np.uint8)
+    bucket = pow2_bucket(n)
+    if bucket != n:
+        slabs = np.concatenate(
+            [slabs, np.zeros((bucket - n, *slabs.shape[1:]), dtype=np.uint8)]
+        )
+    idx = np.full(bucket, k, dtype=np.int32)
+    idx[:n] = np.asarray(indices, dtype=np.int32)
+    out = jitted_eds_axis_roots(k, bucket)(jnp.asarray(slabs),
+                                           jnp.asarray(idx))
+    out = np.asarray(out)[:n]
+    _EXEC_BUCKETS.add((k, bucket))
+    return out
 
 
 def roots_from_leaf_nodes(

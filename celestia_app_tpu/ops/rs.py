@@ -24,14 +24,16 @@ All functions are shape-static per power-of-two k bucket and cached per k.
 
 from __future__ import annotations
 
+import collections
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from celestia_app_tpu import appconsts
-from celestia_app_tpu.ops import leopard
+from celestia_app_tpu.ops import leopard, pow2_bucket
 
 SHARE = appconsts.SHARE_SIZE
 
@@ -239,23 +241,90 @@ def extend_square_np(ods: np.ndarray) -> np.ndarray:
     return np.concatenate([top, bottom], axis=0)
 
 
-@functools.lru_cache(maxsize=256)  # pattern-keyed; entries are (2k, k) LABELS
-def _repair_label_matrix(k: int, use: tuple[int, ...]) -> np.ndarray:
-    """Label-space matrix mapping the k chosen present symbols to the FULL
-    2k codeword: G ·gf D with D the decode matrix for the pattern and G
-    the generator — decode and re-encode fused. Cached in LABEL space
-    ((2k, k) bytes/uint16s); the ~bits²-times-larger GF(2) expansion is
-    built per jitted closure, not hoarded per pattern."""
-    if leopard.uses_gf16(k):
-        return leopard.matmul16(
-            leopard.generator_matrix16(k), leopard.decode_matrix16(k, use)
-        )
-    return leopard.matmul(
-        leopard.generator_matrix(k), leopard.decode_matrix(k, use)
-    )
+# (k, present) -> jitted closure; each entry pins a device bit matrix, so
+# the cache is an explicit LRU (not functools.lru_cache) with hit/miss
+# telemetry. Build-free consumers (the sweep engine's cached-singleton
+# policy, one-shot BEFP verification) use the ATOMIC `repair_axes_get`;
+# `repair_axes_cached` is a test-only probe and racy as a policy hook.
+_AXES_FN_LOCK = threading.Lock()
+_AXES_FN_CACHE: "collections.OrderedDict[tuple, object]" = \
+    collections.OrderedDict()
+_AXES_FN_MAXSIZE = 64
 
 
-@functools.lru_cache(maxsize=16)  # each closure pins a device bit matrix
+def repair_axes_cached(k: int, present: tuple[int, ...]) -> bool:
+    """True iff `repair_axes_fn(k, present)` would be a cache hit (no
+    matrix build, no jit compile). Does not touch LRU order or counters."""
+    with _AXES_FN_LOCK:
+        return (k, tuple(present)) in _AXES_FN_CACHE
+
+
+class _RepairAxesRunner:
+    """Host wrapper around one pattern's jitted matmul: pads every batch
+    to a power-of-two bucket before dispatch (bounding per-pattern
+    compiles to log2(2k) shapes instead of one per batch size — jax.jit
+    retraces per SHAPE, so a bare closure would recompile for every new
+    group width) and records which buckets have actually executed.
+    Build-free consumers gate on `compiled_for(n)`: a cached closure that
+    has never run this batch bucket would still pay a full XLA compile."""
+
+    __slots__ = ("_run", "_buckets", "_lock")
+
+    def __init__(self, run):
+        self._run = run
+        self._buckets: set[int] = set()
+        self._lock = threading.Lock()
+
+    def compiled_for(self, n: int) -> bool:
+        with self._lock:
+            return pow2_bucket(n) in self._buckets
+
+    def __call__(self, symbols_batch) -> np.ndarray:
+        batch = np.asarray(symbols_batch)
+        n = batch.shape[0]
+        bucket = pow2_bucket(n)
+        if bucket != n:
+            batch = np.concatenate([
+                batch,
+                np.zeros((bucket - n, *batch.shape[1:]), dtype=batch.dtype),
+            ])
+        out = np.asarray(self._run(jnp.asarray(batch)))[:n]
+        with self._lock:
+            self._buckets.add(bucket)
+        return out
+
+
+def repair_axes_get(k: int, present: tuple[int, ...],
+                    batch_size: int | None = None):
+    """The cached runner for (k, present), or None — ONE atomic lookup,
+    so a caller that must never pay a build/compile (one-shot BEFP
+    verification, the sweep engine's cached-singleton policy) cannot race
+    an eviction between a peek and a `repair_axes_fn` call. With
+    `batch_size`, the runner is returned only if its power-of-two bucket
+    has already EXECUTED (compiled): presence in the LRU alone does not
+    mean this shape is compiled. A returned runner counts into
+    `repair.matrix_cache_hits`; a None is not a miss (nothing is
+    built)."""
+    from celestia_app_tpu.utils import telemetry
+
+    key = (k, tuple(present))
+    with _AXES_FN_LOCK:
+        run = _AXES_FN_CACHE.get(key)
+        if run is not None:
+            _AXES_FN_CACHE.move_to_end(key)
+    if run is not None and batch_size is not None \
+            and not run.compiled_for(batch_size):
+        return None
+    if run is not None:
+        telemetry.incr("repair.matrix_cache_hits")
+    return run
+
+
+def repair_axes_cache_clear() -> None:
+    with _AXES_FN_LOCK:
+        _AXES_FN_CACHE.clear()
+
+
 def repair_axes_fn(k: int, present: tuple[int, ...]):
     """Jitted BATCHED erasure repair for one shared pattern: the
     TPU-native path for the common DA-repair shape, where whole COLUMNS of
@@ -265,17 +334,41 @@ def repair_axes_fn(k: int, present: tuple[int, ...]):
     per-axis heap decodes.
 
     Returns run((n, 2k, SHARE) uint8, garbage at missing) -> (n, 2k, SHARE)
-    full codewords. NOTE the output is the full RE-ENCODE from the first k
+    full codewords (a `_RepairAxesRunner`: the batch is padded to a
+    power-of-two bucket before the jitted dispatch and the result comes
+    back as numpy, so per-pattern compiles are bounded at log2(2k)
+    shapes). NOTE the output is the full RE-ENCODE from the first k
     sorted present positions: for a consistent codeword it equals
     repair_axis's output bit-for-bit (tests/test_repair.py), but any EXTRA
     present shares are overwritten rather than passed through — a caller
     doing byzantine DETECTION must compare output vs input at present
-    positions (or use the per-axis repair_axis, which preserves them)."""
+    positions (da/repair.py's sweep engine does exactly that, falling
+    back to the FWHT decoder on mismatch so both engines agree
+    bit-for-bit; root-gating alone cannot catch a corrupt present share
+    outside the first-k use-set).
+
+    Closures are LRU-cached per (k, pattern); hits and misses count into
+    `repair.matrix_cache_hits` / `repair.matrix_cache_misses`."""
+    from celestia_app_tpu.utils import telemetry
+
+    key = (k, tuple(present))
+    with _AXES_FN_LOCK:
+        run = _AXES_FN_CACHE.get(key)
+        if run is not None:
+            _AXES_FN_CACHE.move_to_end(key)
+            telemetry.incr("repair.matrix_cache_hits")
+            return run
+    telemetry.incr("repair.matrix_cache_misses")
+    from celestia_app_tpu.obs import jax_profile
+
+    jax_profile.note_compile("rs.repair_axes", k)
+    from celestia_app_tpu.ops import leopard_decode
+
     two_k = 2 * k
     if len(present) < k:
         raise ValueError(f"need at least {k} of {two_k} symbols")
     use = tuple(sorted(present)[:k])
-    labels = _repair_label_matrix(k, use)
+    labels = leopard_decode.fused_decode_matrix(k, use)
     # one branch assigns the matched (matrix, packers) triple — the bit
     # matrix and the bit packers must always come from the same field
     if leopard.uses_gf16(k):
@@ -290,7 +383,12 @@ def repair_axes_fn(k: int, present: tuple[int, ...]):
         x = symbols_batch[:, list(use), :]
         return from_bits(_gf_mix(bitmat, to_bits(x))).astype(jnp.uint8)
 
-    return run
+    runner = _RepairAxesRunner(run)
+    with _AXES_FN_LOCK:
+        _AXES_FN_CACHE[key] = runner
+        while len(_AXES_FN_CACHE) > _AXES_FN_MAXSIZE:
+            _AXES_FN_CACHE.popitem(last=False)
+    return runner
 
 
 def repair_axis(symbols: np.ndarray, present: list[int]) -> np.ndarray:

@@ -73,6 +73,46 @@ def test_befp_proves_a_bad_column():
     assert fraud.verify_befp(dah, befp) is True
 
 
+def test_befp_verdict_identical_on_cached_matmul_path():
+    """The decode-plane fast path: once a proof pattern's fused decode
+    closure is cached, verify_befp reconstructs via the matmul instead of
+    the FWHT solver — with exactly k shares both decoders determine the
+    same unique codeword, so the verdict must be identical on fraudulent
+    AND honest blocks."""
+    from celestia_app_tpu.ops import rs
+
+    k = 4
+    ods = _honest_square(seed=7)
+    eds_arr = _extend(ods)
+    eds_arr[1, 6] ^= 0x77
+    dah = _dah_of(eds_arr)
+    befp = fraud.generate_befp(
+        dah_mod.ExtendedDataSquare(eds_arr), "row", 1,
+        positions=[0, 2, 5, 7],
+    )
+    pattern = tuple(sorted(s.position for s in befp.shares))
+    rs.repair_axes_cache_clear()
+    assert fraud.verify_befp(dah, befp) is True  # FWHT path (cold cache)
+    # prime by executing at batch 1: the fast path gates on the exact
+    # compiled bucket, not mere cache presence — for the decode matmul
+    # AND the device root recompute
+    from celestia_app_tpu.ops import nmt
+
+    rs.repair_axes_fn(k, pattern)(np.zeros((1, 2 * k, 512), np.uint8))
+    assert rs.repair_axes_get(k, pattern, batch_size=1) is not None
+    nmt.eds_axis_roots(np.zeros((1, 2 * k, 512), np.uint8), [0], k)
+    assert nmt.eds_axis_roots_compiled(k, 1)
+    assert fraud.verify_befp(dah, befp) is True  # matmul path, same verdict
+
+    honest = _extend(_honest_square(seed=8))
+    dah_ok = _dah_of(honest)
+    befp_ok = fraud.generate_befp(
+        dah_mod.ExtendedDataSquare(honest), "row", 1,
+        positions=[0, 2, 5, 7],
+    )
+    assert fraud.verify_befp(dah_ok, befp_ok) is False  # cached path too
+
+
 def test_befp_rejects_honest_block():
     """An honest square yields NO valid fraud proof from any axis."""
     ods = _honest_square(seed=7)
