@@ -671,62 +671,43 @@ def _run_parent() -> None:
         time.sleep(min(10, max(0, deadline - time.monotonic() - SAFETY_MARGIN_S)))
 
 
+def _save_baseline() -> None:
+    ms, root, impl = measure_baseline()
+    with open(BASELINE_FILE, "w") as f:
+        json.dump(
+            {
+                "metric": "extend_commit_128_ms",
+                "cpu_ms": ms,
+                "data_root": root,
+                "impl": impl,
+            },
+            f,
+            indent=2,
+        )
+        f.write("\n")
+    print(f"baseline measured: {ms:.1f} ms ({impl}) -> {BASELINE_FILE}",
+          file=sys.stderr)
+
+
+def _stream_batched() -> None:
+    from celestia_app_tpu.parallel import streaming
+
+    print(json.dumps(streaming.bench_stream_batched()))
+
+
 def main() -> None:
-    if "--child" in sys.argv:
+    if "--child" in sys.argv:  # internal: parent-spawned measurement
         _run_child()
         return
-    if "--proofs" in sys.argv:
-        measure_proofs()
+    if "--list" in sys.argv:
+        for name in sorted(MODES):
+            _fn, metrics = MODES[name]
+            print(f"--{name:<18} {metrics}")
         return
-    if "--admission" in sys.argv:
-        measure_admission()
-        return
-    if "--repair" in sys.argv:
-        measure_repair()
-        return
-    if "--mempool" in sys.argv:
-        measure_mempool()
-        return
-    if "--chaos" in sys.argv:
-        measure_chaos()
-        return
-    if "--analyze" in sys.argv:
-        measure_analyze()
-        return
-    if "--obs" in sys.argv:
-        measure_obs()
-        return
-    if "--stream-mesh" in sys.argv:
-        measure_stream_mesh()
-        return
-    if "--stream-batched" in sys.argv:
-        from celestia_app_tpu.parallel import streaming
-
-        print(json.dumps(streaming.bench_stream_batched()))
-        return
-    if "--stream" in sys.argv:
-        measure_stream()
-        return
-    if "--stages" in sys.argv:
-        measure_stages()
-        return
-    if "--measure-baseline" in sys.argv:
-        ms, root, impl = measure_baseline()
-        with open(BASELINE_FILE, "w") as f:
-            json.dump(
-                {
-                    "metric": "extend_commit_128_ms",
-                    "cpu_ms": ms,
-                    "data_root": root,
-                    "impl": impl,
-                },
-                f,
-                indent=2,
-            )
-            f.write("\n")
-        print(f"baseline measured: {ms:.1f} ms ({impl}) -> {BASELINE_FILE}",
-              file=sys.stderr)
-        return
+    for name, (fn, _metrics) in MODES.items():
+        if f"--{name}" in sys.argv:
+            fn()
+            return
     _run_parent()
 
 
@@ -1300,6 +1281,178 @@ def measure_stream_mesh() -> None:
     from celestia_app_tpu.parallel import streaming
 
     print(json.dumps(streaming.bench_stream_mesh()))
+
+
+def measure_block(blocks: int | None = None, senders: int = 8) -> None:
+    """Block-plane e2e bench (--block): the extend-once lifecycle end to
+    end. Three BENCH JSON lines:
+
+      {"metric": "block_e2e_ms", ...}       tx-bearing produce→commit wall
+          time per block through Node.produce_block (prepare → process →
+          finalize → commit — process hits the content-addressed EDS
+          cache prepare populated, so the whole round dispatches exactly
+          ONE extend; `extend_runs_per_block` reports the counter-
+          verified figure).
+      {"metric": "blocks_per_sec", ...}     inverse throughput over the
+          same measured run.
+      {"metric": "first_sample_after_commit_ms", ...}  first DAS sample
+          after the final commit on the WARMED path (the commit handed
+          its cache entry to the SampleCore with provers pre-built) vs
+          the COLD rebuild path (caches cleared). The skip is counter-
+          verified, not just faster wall time: the warm sample must show
+          a `das.square_builds` delta of 0 and a `da.extend_runs` delta
+          of 0, the cold one 1 and 1.
+
+    Backend labeling follows FORMATS §12.2: a CPU measurement is emitted
+    with `"backend": "cpu-fallback"`.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    from celestia_app_tpu.chain.app import App
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.chain.tx import MsgSend
+    from celestia_app_tpu.client.tx_client import Signer
+    from celestia_app_tpu.das.server import SampleCore
+    from celestia_app_tpu.utils import telemetry
+
+    backend = jax.devices()[0].platform
+    if blocks is None:
+        blocks = int(os.environ.get(
+            "CELESTIA_BENCH_BLOCKS", "10" if backend == "cpu" else "30"))
+    if backend == "cpu":
+        backend = "cpu-fallback"
+
+    privs = [PrivateKey.from_seed(b"blk-%d" % i) for i in range(senders)]
+    addrs = [p.public_key().address() for p in privs]
+    tmp = tempfile.mkdtemp(prefix="block-bench-")
+    app = App(chain_id="block-bench", engine="auto", data_dir=tmp)
+    try:
+        app.init_chain({
+            "time_unix": 1_700_000_000.0,
+            "accounts": [
+                {"address": a.hex(), "balance": 10**12} for a in addrs
+            ],
+            "validators": [{"operator": addrs[0].hex(), "power": 10}],
+        })
+        node = Node(app)
+        core = node.attach_das_core(SampleCore(app))
+        signer = Signer("block-bench")
+        for i, p in enumerate(privs):
+            signer.add_account(p, number=i)
+
+        def submit_round():
+            for i, a in enumerate(addrs):
+                tx = signer.create_tx(
+                    a, [MsgSend(a, addrs[(i + 1) % senders], 1)],
+                    fee=2000, gas_limit=100_000,
+                )
+                signer.accounts[a].sequence += 1
+                node.broadcast_tx(tx.encode())
+
+        def counters():
+            return telemetry.snapshot().get("counters", {})
+
+        def delta(c0, c1, key):
+            return c1.get(key, 0) - c0.get(key, 0)
+
+        t_block = 1_700_000_001.0
+        submit_round()
+        node.produce_block(t=t_block)  # compile + warm outside the clock
+        app.da_warmer.wait_idle(60)
+
+        c0 = counters()
+        per_block = []
+        t_run0 = time.perf_counter()
+        for _ in range(blocks):
+            t_block += 1.0
+            submit_round()
+            t0 = time.perf_counter()
+            node.produce_block(t=t_block)
+            per_block.append((time.perf_counter() - t0) * 1e3)
+        run_s = time.perf_counter() - t_run0
+        c1 = counters()
+        extend_runs = delta(c0, c1, "da.extend_runs")
+        print(json.dumps({
+            "metric": "block_e2e_ms",
+            "value": round(min(per_block), 3),
+            "unit": "ms",
+            "mean_ms": round(sum(per_block) / len(per_block), 3),
+            "blocks": blocks,
+            "txs_per_block": senders,
+            "extend_runs_per_block": round(extend_runs / blocks, 3),
+            "backend": backend,
+        }), flush=True)
+        print(json.dumps({
+            "metric": "blocks_per_sec",
+            "value": round(blocks / run_s, 3),
+            "unit": "blocks/s",
+            "blocks": blocks,
+            "txs_per_block": senders,
+            "backend": backend,
+        }), flush=True)
+
+        # -- first sample after commit: warmed vs cold -------------------
+        app.da_warmer.wait_idle(60)
+        height = app.height
+        c_w0 = counters()
+        t0 = time.perf_counter()
+        core.sample(height, 0, 0)
+        warm_ms = (time.perf_counter() - t0) * 1e3
+        c_w1 = counters()
+        warm_builds = delta(c_w0, c_w1, "das.square_builds")
+        warm_extends = delta(c_w0, c_w1, "da.extend_runs")
+
+        cold_core = SampleCore(app)  # no seed listener, fresh height LRU
+        app.eds_cache.clear()  # the content cache must not rescue it
+        c_c0 = counters()
+        t0 = time.perf_counter()
+        cold_core.sample(height, 0, 0)
+        cold_ms = (time.perf_counter() - t0) * 1e3
+        c_c1 = counters()
+        print(json.dumps({
+            "metric": "first_sample_after_commit_ms",
+            "value": round(warm_ms, 3),
+            "unit": "ms",
+            "cold_ms": round(cold_ms, 3),
+            "vs_cold": round(cold_ms / max(warm_ms, 1e-6), 1),
+            "warm_square_builds": warm_builds,
+            "warm_extend_runs": warm_extends,
+            "cold_square_builds": delta(c_c0, c_c1, "das.square_builds"),
+            "cold_extend_runs": delta(c_c0, c_c1, "da.extend_runs"),
+            "skipped_square_build": warm_builds == 0 and warm_extends == 0,
+            "backend": backend,
+        }), flush=True)
+    finally:
+        app.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# -- mode registry (--list prints it) ----------------------------------------
+# name -> (runner, emitted metrics). The default invocation (no flag) runs
+# the deadline-driven headline measurement (`extend_commit_128_ms`).
+MODES = {
+    "block": (measure_block,
+              "block_e2e_ms, blocks_per_sec, first_sample_after_commit_ms"),
+    "proofs": (measure_proofs, "share_proofs_per_sec_128"),
+    "admission": (measure_admission,
+                  "sig_verify_per_sec, mempool_ingest_txs_per_sec"),
+    "repair": (measure_repair, "repair_128_ms, befp_verify_ms"),
+    "mempool": (measure_mempool,
+                "mempool_ingest_txs_per_sec, mempool_reap_ms"),
+    "chaos": (measure_chaos, "crash_replay_ms, chaos_heal_recovery_s"),
+    "analyze": (measure_analyze, "analyze_wall_s"),
+    "obs": (measure_obs, "obs_overhead_pct"),
+    "stream-mesh": (measure_stream_mesh, "stream_mesh blocks/s (stderr+json)"),
+    "stream-batched": (_stream_batched, "stream_batched blocks/s"),
+    "stream": (measure_stream, "stream blocks/s"),
+    "stages": (measure_stages, "per-stage device timings (stderr)"),
+    "measure-baseline": (_save_baseline,
+                         "writes bench_baseline.json (cpu_ms, data_root)"),
+}
 
 
 if __name__ == "__main__":

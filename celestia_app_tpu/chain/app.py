@@ -73,6 +73,7 @@ from celestia_app_tpu.chain.tx import (
 )
 from celestia_app_tpu.da import blob as blob_mod
 from celestia_app_tpu.da import dah as dah_mod
+from celestia_app_tpu.da import edscache as edscache_mod
 from celestia_app_tpu.da import square as square_mod
 from celestia_app_tpu.da.square import PfbEntry
 
@@ -287,6 +288,20 @@ class App:
         # prevalidation, or scalar in the ante — is never verified again
         # in any later phase. State-independent, so rollback/load leave it.
         self.sig_cache = admission_mod.VerifiedSigCache()
+        # the block plane's extend-once machinery (da/edscache.py):
+        # a content-addressed LRU of (EDS, DAH, data root) keyed by the
+        # ODS share bytes — prepare, process, finalize/commit, the query
+        # router, and the DAS serving plane all read the same entry, so
+        # extend+commit dispatches at most once per (node, height).
+        # State-independent (pure function of the key): rollback/load
+        # leave it, exactly like the sig cache.
+        self.eds_cache = edscache_mod.EdsCache()
+        # DAS planes (das/server.SampleCore) register seed_cache_entry
+        # here (via add_da_seed_listener); commit hands each committed
+        # entry over on the warmer's background thread, never under a
+        # service/consensus lock
+        self.da_seed_listeners: list = []
+        self.da_warmer = edscache_mod.ProverWarmer()
         self.ante = ante_mod.AnteHandler(
             self.auth, self.bank, self.blob, self.minfee, min_gas_price,
             feegrant=self.feegrant, ibc=self.ibc,
@@ -340,52 +355,27 @@ class App:
             except OSError:
                 pass
 
-    def _pipeline(self, ods):
-        """ODS -> (row_roots, col_roots, data_root); device when possible."""
-        if self.engine in ("device", "auto"):
-            try:
-                import jax.numpy as jnp
-
-                from celestia_app_tpu.da import eds as eds_mod
-
-                _, rows, cols, root = eds_mod.jitted_pipeline(ods.shape[0])(
-                    jnp.asarray(ods)
-                )
-                import numpy as np
-
-                return (
-                    [bytes(r) for r in np.asarray(rows)],
-                    [bytes(c) for c in np.asarray(cols)],
-                    bytes(np.asarray(root)),
-                )
-            except Exception:
-                if self.engine == "device":
-                    raise
-                # engine=auto: count the silent degrade — a node that
-                # quietly lost its accelerator should show it in /metrics
-                telemetry.incr("app.device_path_fallback")
-        # host path: the BLAS+hashlib pipeline (utils/fast_host), bit-equal
-        # to the device path and the refimpl oracle (tests/test_fast_host)
-        # but ~100x faster than the oracle — a validator process on the
-        # host engine must keep big-blob blocks inside the propose window
-        from celestia_app_tpu.utils import fast_host
-
-        _, rows, cols, root = fast_host.pipeline_fast(ods)
-        return (
-            [bytes(r) for r in rows],
-            [bytes(c) for c in cols],
-            bytes(root),
-        )
-
     def _data_root(self, square: square_mod.Square) -> tuple[dah_mod.DataAvailabilityHeader, bytes]:
+        """(DAH, data_root) for a square — through the extend-once cache:
+        the first caller for a given ODS content pays the real pipeline
+        dispatch (da/edscache.compute_entry: device when possible, the
+        bit-identical fast_host path otherwise); every later phase of the
+        lifecycle — ProcessProposal re-validating what PrepareProposal
+        built, a proposer re-validating its own gossip, the query router,
+        the DAS server — hits the same entry."""
         ods = dah_mod.shares_to_ods(square.share_bytes())
-        # one span covers the fused device program: RS extension + NMT
-        # axis roots + data root land in a single dispatch (da/eds.py),
-        # so finer stage attribution needs /debug/profile, not spans
-        with obs.span("da.extend_shares", k=square.size,
-                      engine=self.engine, stages="extend+nmt+root"):
-            rows, cols, root = self._pipeline(ods)
-        return dah_mod.DataAvailabilityHeader(tuple(rows), tuple(cols)), root
+        key = edscache_mod.cache_key(ods)
+        entry = self.eds_cache.get(key)
+        if entry is None:
+            # one span covers the fused device program: RS extension + NMT
+            # axis roots + data root land in a single dispatch (da/eds.py),
+            # so finer stage attribution needs /debug/profile, not spans
+            with obs.span("da.extend_shares", k=square.size,
+                          engine=self.engine, stages="extend+nmt+root"):
+                entry = self.eds_cache.put(
+                    key, edscache_mod.compute_entry(ods, self.engine)
+                )
+        return entry.dah, entry.data_root
 
     # ------------------------------------------------------------------
     # genesis
@@ -441,6 +431,23 @@ class App:
     # ------------------------------------------------------------------
     # helpers
     # ------------------------------------------------------------------
+
+    def add_da_seed_listener(self, fn) -> None:
+        """Register a commit-seed listener (idempotent: re-attaching the
+        same bound method — a double attach_das_core — must not
+        double-seed every commit). A service being replaced over a
+        long-lived App must deregister its old core via
+        remove_da_seed_listener (the services do, in shutdown())."""
+        if fn not in self.da_seed_listeners:
+            self.da_seed_listeners.append(fn)
+
+    def remove_da_seed_listener(self, fn) -> None:
+        """Deregister a commit-seed listener; absent entries are a no-op
+        (shutdown paths must be idempotent)."""
+        try:
+            self.da_seed_listeners.remove(fn)
+        except ValueError:
+            pass
 
     def _chain_time(self) -> float:
         """Deterministic time anchor for contexts not given an explicit
@@ -1094,6 +1101,21 @@ class App:
             app_hash=self.last_app_hash.hex(),
             app_version=self.app_version,
         )
+        # block plane: hand the committed entry to the DAS serving plane
+        # and pre-build its provers on the warmer's background thread —
+        # scheduling here is O(1) (slot swap + maybe a thread spawn); the
+        # heavy level passes and seed fan-out run OUTSIDE whatever
+        # service/consensus lock wraps this commit, so the first light-
+        # client sample after commit is pure index arithmetic. A miss
+        # (e.g. WAL replay, which never ran ProcessProposal) just means
+        # the DAS plane warms lazily on first demand instead.
+        entry = self.eds_cache.lookup_root(block.header.data_hash)
+        if entry is not None:
+            self.da_warmer.schedule(
+                self.height, entry, self.da_seed_listeners,
+                engine=self.engine, traces=self.traces,
+                chain_id=self.chain_id,
+            )
         return self.last_app_hash
 
     def _commit_meta(self) -> dict:

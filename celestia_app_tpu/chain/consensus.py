@@ -329,11 +329,18 @@ class ValidatorNode:
     def __init__(self, name: str, priv: PrivateKey, genesis: dict,
                  chain_id: str, data_dir: str | None = None,
                  v2_upgrade_height: int | None = None,
-                 upgrade_height_delay: int | None = None):
+                 upgrade_height_delay: int | None = None,
+                 engine: str = "host"):
         self.name = name
         self.priv = priv
         self.address = priv.public_key().address()
-        self.app = App(chain_id=chain_id, engine="host", data_dir=data_dir,
+        # engine="host" stays the validator default (a validator process
+        # must not hang on a dead accelerator relay mid-consensus), but
+        # device-engine validators are constructible now that the block
+        # plane's EDS cache (da/edscache.py) is populated bit-identically
+        # by both engines — a TPU proposer and a host follower land on
+        # the same content-addressed entries and the same roots
+        self.app = App(chain_id=chain_id, engine=engine, data_dir=data_dir,
                        v2_upgrade_height=v2_upgrade_height,
                        upgrade_height_delay=upgrade_height_delay)
         self.app.init_chain(genesis)

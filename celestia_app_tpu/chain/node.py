@@ -109,6 +109,23 @@ class Node:
         """Priority order: gas price desc, per-sender arrival order kept."""
         return self.pool.reap(self.app.height)
 
+    # -- DAS serving (block plane) -------------------------------------
+
+    def attach_das_core(self, core=None):
+        """Create (or adopt) a DAS sample-serving core seeded by this
+        node's commits: every committed height's EDS/DAH cache entry is
+        handed over on the warmer's background thread with provers
+        pre-built (da/edscache.py), so the first sample after a commit
+        is pure index arithmetic. The canonical wiring for in-process
+        embeddings (benches, tests, tools); the HTTP services register
+        their own lock-guarded cores the same way."""
+        if core is None:
+            from celestia_app_tpu.das.server import SampleCore
+
+            core = SampleCore(self.app)
+        self.app.add_da_seed_listener(core.seed_cache_entry)
+        return core
+
     # -- consensus loop ------------------------------------------------
 
     def produce_block(self, t: float | None = None) -> tuple[Block, list[TxResult]]:

@@ -20,7 +20,7 @@ import base64
 from celestia_app_tpu import appconsts
 from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
 from celestia_app_tpu.da import dah as dah_mod
-from celestia_app_tpu.da import proof_device
+from celestia_app_tpu.da import edscache as edscache_mod
 from celestia_app_tpu.da import square as square_mod
 from celestia_app_tpu.da.blob import is_blob_tx, unmarshal_blob_tx
 from celestia_app_tpu.da.square import PfbEntry
@@ -50,49 +50,41 @@ def rebuild_square(app, height: int):
     return block, square
 
 
-def build_prover(app, height: int):
-    """(block, square, BlockProver, data_root) for a committed height —
-    engine-gated like every serving path, shared by the query router and
-    the DAS sample server (das/server.py)."""
+def build_prover_entry(app, height: int):
+    """(block, square, EdsCacheEntry) for a committed height — the
+    extend-once read path shared by the query router and the DAS sample
+    server (das/server.py). The square is reconstructed from the stored
+    block's txs (cheap host work); the EDS/DAH/roots come from the app's
+    content-addressed cache when any lifecycle phase already computed
+    them, and from ONE engine-gated pipeline dispatch
+    (da/edscache.compute_entry — device, or the bit-identical fast_host
+    path for host-engine validators, which must not touch the jax
+    backend: a down accelerator relay HANGS backend init, wedging the
+    HTTP handler mid-service-lock) otherwise."""
     block, square = rebuild_square(app, height)
     ods = dah_mod.shares_to_ods(square.share_bytes())
-    if getattr(app, "engine", "auto") == "host":
-        # host-engine validators must not touch the jax backend even
-        # for queries (a down accelerator relay HANGS backend init,
-        # wedging the HTTP handler mid-service-lock); the host NMT
-        # levels are bit-identical (tests/test_fast_host.py)
-        import numpy as np
-
-        from celestia_app_tpu.utils import fast_host, merkle_host
-
-        eds_np = fast_host.extend_square_fast(ods)
-        k = eds_np.shape[0] // 2
-        # row levels hashed ONCE: the prover consumes all of them and
-        # the row roots are just the last level
-        levels = fast_host.nmt_levels_fast(
-            fast_host._axis_leaf_ns(eds_np, k), eds_np
-        )
-        lm, lx, lv = levels[-1]
-        rows = np.concatenate([lm[:, 0], lx[:, 0], lv[:, 0]], axis=1)
-        eds_t = np.swapaxes(eds_np, 0, 1)
-        cols = fast_host.nmt_roots_fast(
-            fast_host._axis_leaf_ns(eds_t, k), eds_t
-        )
-        root = merkle_host.hash_from_leaves(
-            [bytes(r) for r in rows] + [bytes(c) for c in cols]
-        )
-        d = dah_mod.DataAvailabilityHeader(
-            tuple(bytes(r) for r in rows),
-            tuple(bytes(c) for c in cols),
-        )
-        eds_obj = dah_mod.ExtendedDataSquare(eds_np)
-    else:
-        d, eds_obj, root = dah_mod.new_dah_from_ods(ods)
-        levels = None
-    if root != block.header.data_hash:
+    cache = getattr(app, "eds_cache", None)
+    engine = getattr(app, "engine", "auto")
+    if cache is not None:
+        entry = cache.get_or_compute(ods, engine)
+    else:  # bare apps (fixtures) still get the one-shot pipeline
+        entry = edscache_mod.compute_entry(ods, engine)
+    if entry.data_root != block.header.data_hash:
+        # a Byzantine (or corrupted-store) header can never be served
+        # from the cache: the entry is a pure function of the ODS and the
+        # header must match it — same check, cached or cold
         raise QueryError("recomputed data root mismatches stored header")
-    prover = proof_device.BlockProver(eds_obj, d, levels=levels)
-    return block, square, prover, root
+    return block, square, entry
+
+
+def build_prover(app, height: int):
+    """(block, square, BlockProver, data_root) for a committed height —
+    the tuple-shaped wrapper over build_prover_entry the query routes
+    consume; the prover builds at most once per entry (lazily, or ahead
+    of time by the commit warmer)."""
+    block, square, entry = build_prover_entry(app, height)
+    prover = entry.get_prover(getattr(app, "engine", "auto"))
+    return block, square, prover, entry.data_root
 
 
 class QueryRouter:

@@ -1,0 +1,385 @@
+"""Block plane: the extend-once lifecycle's content-addressed EDS/DAH cache.
+
+The node used to pay the full RS-extend + NMT pipeline up to THREE times
+per height: once at PrepareProposal (chain/app.py, result discarded), once
+at ProcessProposal (the proposer re-validating its own block, and every
+follower validating the gossiped one), and once more when the first light
+client sampled the height (chain/query.build_prover rebuilding the square
+from raw txs). Amortizing the RS/commitment work across protocol phases is
+exactly the cost lever arXiv:2201.08261 optimizes for RS-based DA
+protocols; this module is that amortization:
+
+- **Content addressing.** Entries are keyed by ``sha256(ODS share bytes)``
+  — a pure function of the data square itself, never of a height or a
+  header field a peer claimed. A follower validating a gossiped proposal
+  and the proposer validating its own construct the identical ODS from the
+  txs, so both hit the same entry; a Byzantine header can never poison the
+  cache, because the cached value is a pure function of the key (a wrong
+  ``data_hash`` still fails the header comparison — the cache only changes
+  who pays for recomputing the truth).
+
+- **Engine-gated, bit-identical.** ``compute_entry`` is THE one
+  ODS -> (EDS, row/col roots, data root) implementation for both the
+  device path (da/eds.jitted_pipeline, one fused dispatch) and the host
+  path (utils/fast_host BLAS+hashlib) — previously copy-pasted between
+  ``App._pipeline``, ``chain/query.build_prover``, and
+  ``das/server._build_prover``. The two engines are pinned byte-identical
+  (tests/test_fast_host.py, tests/test_edscache.py), so a cache populated
+  by either serves the other.
+
+- **Lazy provers + background warmup.** Each entry carries its
+  BlockProver (and the transposed col-axis prover BEFP escalation needs)
+  built at most once, on demand, under the entry's own lock — or ahead of
+  demand by ``ProverWarmer``, the single coalescing daemon thread
+  ``App.commit`` hands each committed entry to. The warmer builds the
+  provers and fans the entry out to registered DAS serving planes
+  (``das/server.SampleCore.seed_cache_entry``) WITHOUT holding any
+  service/consensus lock, so the first light-client sample after a commit
+  is pure index arithmetic instead of a rebuild + re-extend.
+
+Telemetry: ``da.extend_runs`` (every real pipeline dispatch),
+``edscache.{hits,misses,evictions,seeded}``, ``edscache.warm_coalesced``
+(a pending warm superseded by a newer commit), ``edscache.warm_errors``.
+Wire/metric formats in docs/FORMATS.md §14; design in docs/DESIGN.md
+"The block plane".
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import os
+import threading
+
+import numpy as np
+
+from celestia_app_tpu import obs
+from celestia_app_tpu.da.dah import DataAvailabilityHeader, ExtendedDataSquare
+from celestia_app_tpu.utils import telemetry
+
+# bounded LRU: at k=128 one entry holds ~32 MB of EDS plus ~24 MB of lazy
+# row+col level arrays once warmed, so the default stays small — the
+# lifecycle only ever needs the in-flight height plus a short serving tail
+DEFAULT_MAX_ENTRIES = int(os.environ.get("CELESTIA_EDSCACHE_ENTRIES", "4"))
+
+
+def cache_key(ods: np.ndarray) -> bytes:
+    """Content address of an original data square: sha256 over the ODS
+    share bytes in row-major order. Shares are fixed-size (512 B) and the
+    count is k*k, so the byte string determines the geometry — two squares
+    collide iff they are the same square.
+
+    Zero-copy: the usual producers (dah.shares_to_ods) hand over C-order
+    arrays, so hashing goes straight over the buffer (`arr.data`) with no
+    8 MB `.tobytes()` staging copy at k=128; `ascontiguousarray` is a
+    no-op then and only copies for exotic layouts. The hash itself is
+    single-digit ms at k=128 (OpenSSL SHA-NI) against the 2-3 full
+    extend+NMT dispatches per height it deduplicates."""
+    arr = np.ascontiguousarray(ods)
+    return hashlib.sha256(arr.data).digest()
+
+
+class EdsCacheEntry:
+    """One cached extension: ``(eds, row_roots, col_roots, data_root)``
+    plus the lazily-built proof machinery. The extension fields are
+    immutable after construction; the provers build at most once, under
+    the entry's own lock (never a service/consensus lock), so concurrent
+    samplers of a fresh entry pay one level pass between them."""
+
+    def __init__(self, eds: ExtendedDataSquare,
+                 dah: DataAvailabilityHeader, data_root: bytes,
+                 levels=None):
+        self.eds = eds
+        self.dah = dah
+        self.data_root = data_root
+        # host-computed row NMT levels (utils/fast_host shape), carried
+        # when the host pipeline produced them anyway; None on the device
+        # path, where the prover's jitted level pass recomputes them
+        self.levels = levels
+        # one lock PER prover: a sampler needing the (already-built) row
+        # prover must never queue behind the warmer's in-progress col
+        # level pass — the two builds are independent
+        self._row_lock = threading.Lock()
+        self._col_lock = threading.Lock()
+        self._prover = None  # guarded-by: _row_lock
+        self._col_prover = None  # guarded-by: _col_lock
+
+    def get_prover(self, engine: str = "auto"):
+        """The row-axis BlockProver, built once (engine-gated)."""
+        with self._row_lock:
+            if self._prover is None:
+                self._prover = build_block_prover(
+                    self.eds, self.dah, engine, levels=self.levels
+                )
+            return self._prover
+
+    def get_col_prover(self, engine: str = "auto"):
+        """Column-axis prover (BEFP escalation serving): the col trees of
+        a square ARE the row trees of its transpose — same leaf-namespace
+        rule (parity iff outside Q0 survives (r,c)->(c,r)), same batched
+        level pass, no per-cell hashing."""
+        with self._col_lock:
+            if self._col_prover is None:
+                t0 = telemetry.start_timer()
+                eds_t = ExtendedDataSquare(
+                    np.ascontiguousarray(
+                        np.swapaxes(self.eds.squares, 0, 1)
+                    )
+                )
+                dah_t = DataAvailabilityHeader(
+                    row_roots=self.dah.col_roots,
+                    col_roots=self.dah.row_roots,
+                )
+                self._col_prover = build_block_prover(eds_t, dah_t, engine)
+                telemetry.measure_since("das.col_tree_build", t0)
+            return self._col_prover
+
+    def warmed(self) -> bool:
+        # fixed acquisition order (row, then col) — no other path nests
+        # the two locks, so no inversion is possible
+        with self._row_lock:
+            row_ready = self._prover is not None
+        with self._col_lock:
+            return row_ready and self._col_prover is not None
+
+
+def compute_entry(ods: np.ndarray, engine: str = "auto") -> EdsCacheEntry:
+    """THE extend+commit dispatch: ODS -> EdsCacheEntry, engine-gated.
+
+    ``engine="device"`` requires the jax path (raises on failure),
+    ``"host"`` never touches jax (the relay-down hang class: a down
+    accelerator relay HANGS backend init, wedging whatever lock the
+    caller holds), ``"auto"`` tries device and degrades loudly. Every
+    call is one real RS+NMT dispatch and counts ``da.extend_runs`` —
+    the telemetry pin tests assert at most one per (node, height)."""
+    telemetry.incr("da.extend_runs")
+    if engine in ("device", "auto"):
+        try:
+            import jax.numpy as jnp
+
+            from celestia_app_tpu.da import eds as eds_mod
+
+            eds_arr, rows, cols, root = eds_mod.jitted_pipeline(
+                ods.shape[0]
+            )(jnp.asarray(ods))
+            dah = DataAvailabilityHeader(
+                row_roots=tuple(bytes(r) for r in np.asarray(rows)),
+                col_roots=tuple(bytes(c) for c in np.asarray(cols)),
+            )
+            return EdsCacheEntry(
+                ExtendedDataSquare(np.asarray(eds_arr)), dah,
+                bytes(np.asarray(root)),
+            )
+        except Exception:
+            if engine == "device":
+                raise
+            # engine=auto: count the silent degrade — a node that
+            # quietly lost its accelerator should show it in /metrics
+            telemetry.incr("app.device_path_fallback")
+    # host path: BLAS+hashlib (utils/fast_host), bit-equal to the device
+    # path and the refimpl oracle. The row levels come out of the same
+    # pass that yields the row roots, so they ride the entry for free —
+    # a later prover build on this entry is pure reshaping.
+    from celestia_app_tpu.utils import fast_host, merkle_host
+
+    eds_arr = fast_host.extend_square_fast(ods)
+    k = eds_arr.shape[0] // 2
+    levels = fast_host.nmt_levels_fast(
+        fast_host._axis_leaf_ns(eds_arr, k), eds_arr
+    )
+    lm, lx, lv = levels[-1]
+    rows = np.concatenate([lm[:, 0], lx[:, 0], lv[:, 0]], axis=1)
+    eds_t = np.swapaxes(eds_arr, 0, 1)
+    cols = fast_host.nmt_roots_fast(
+        fast_host._axis_leaf_ns(eds_t, k), eds_t
+    )
+    root = merkle_host.hash_from_leaves(
+        [bytes(r) for r in rows] + [bytes(c) for c in cols]
+    )
+    dah = DataAvailabilityHeader(
+        row_roots=tuple(bytes(r) for r in rows),
+        col_roots=tuple(bytes(c) for c in cols),
+    )
+    return EdsCacheEntry(ExtendedDataSquare(eds_arr), dah, root,
+                         levels=levels)
+
+
+def build_block_prover(eds: ExtendedDataSquare,
+                       dah: DataAvailabilityHeader,
+                       engine: str = "auto", levels=None):
+    """THE engine-gated BlockProver constructor — the one copy of what
+    chain/query.build_prover and das/server._build_prover used to
+    duplicate (they must stay bit-identical; now they are by
+    construction). Precomputed host ``levels`` win regardless of engine
+    (they are byte-identical to the jitted pass and already paid for)."""
+    from celestia_app_tpu.da import proof_device
+
+    if levels is not None:
+        return proof_device.BlockProver(eds, dah, levels=levels)
+    if engine in ("device", "auto"):
+        try:
+            return proof_device.BlockProver(eds, dah)  # jitted level pass
+        except Exception:
+            if engine == "device":
+                raise
+            telemetry.incr("app.device_path_fallback")
+    from celestia_app_tpu.utils import fast_host
+
+    k = eds.width // 2
+    levels = fast_host.nmt_levels_fast(
+        fast_host._axis_leaf_ns(eds.squares, k), eds.squares
+    )
+    return proof_device.BlockProver(eds, dah, levels=levels)
+
+
+class EdsCache:
+    """Bounded, thread-safe, content-addressed LRU of EdsCacheEntry.
+
+    A secondary index maps ``data_root -> key`` so the commit path — which
+    holds a Block (header with data_hash), not a Square — can find the
+    entry ProcessProposal populated. The index is safe because the data
+    root is itself a pure function of the ODS bytes the key hashes: two
+    different squares cannot share a root without a sha256 collision."""
+
+    def __init__(self, max_entries: int | None = None):
+        self.max_entries = (DEFAULT_MAX_ENTRIES if max_entries is None
+                            else max_entries)
+        self._lock = threading.Lock()
+        self._entries: collections.OrderedDict[bytes, EdsCacheEntry] = \
+            collections.OrderedDict()  # guarded-by: _lock
+        self._by_root: dict[bytes, bytes] = {}  # guarded-by: _lock
+
+    def get(self, key: bytes) -> EdsCacheEntry | None:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                telemetry.incr("edscache.misses")
+                return None
+            self._entries.move_to_end(key)
+            telemetry.incr("edscache.hits")
+            return entry
+
+    def put(self, key: bytes, entry: EdsCacheEntry) -> EdsCacheEntry:
+        """Insert (idempotent: a racing earlier insert wins, so every
+        caller holds the SAME object and lazy prover work is never
+        duplicated). Returns the resident entry."""
+        with self._lock:
+            kept = self._entries.get(key)
+            if kept is None:
+                self._entries[key] = entry
+                self._by_root[entry.data_root] = key
+                kept = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                _, old = self._entries.popitem(last=False)
+                self._by_root.pop(old.data_root, None)
+                telemetry.incr("edscache.evictions")
+            return kept
+
+    def lookup_root(self, data_root: bytes) -> EdsCacheEntry | None:
+        """Commit-side lookup by the header's data_hash (no ODS in hand).
+        Does not count hits/misses — it is bookkeeping, not a serving
+        path; a miss just means the DAS plane warms lazily instead."""
+        with self._lock:
+            key = self._by_root.get(data_root)
+            if key is None:
+                return None
+            self._entries.move_to_end(key)
+            return self._entries[key]
+
+    def get_or_compute(self, ods: np.ndarray,
+                       engine: str = "auto") -> EdsCacheEntry:
+        """The lifecycle read path: one extend per content, ever."""
+        key = cache_key(ods)
+        entry = self.get(key)
+        if entry is not None:
+            return entry
+        return self.put(key, compute_entry(ods, engine))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._by_root.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class ProverWarmer:
+    """Single coalescing background warmup worker.
+
+    ``schedule`` replaces the pending slot (only the NEWEST commit
+    matters — a blocksync batch replaying 64 heights must not queue 64
+    prover builds; superseded slots count ``edscache.warm_coalesced``)
+    and starts a worker thread if none is running. The worker builds the
+    entry's row and col provers and hands the entry to every registered
+    listener (the DAS serving planes' ``seed_cache_entry``), all WITHOUT
+    holding any caller lock, then exits when the slot drains — so idle
+    processes carry no thread."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = None  # guarded-by: _lock
+        self._worker_alive = False  # guarded-by: _lock
+        self._idle = threading.Event()
+        self._idle.set()
+
+    def schedule(self, height: int, entry: EdsCacheEntry, listeners,
+                 engine: str = "auto", traces=None,
+                 chain_id: str = "") -> None:
+        with self._lock:
+            if self._pending is not None:
+                telemetry.incr("edscache.warm_coalesced")
+            self._pending = (height, entry, tuple(listeners), engine,
+                             traces, chain_id)
+            self._idle.clear()
+            if not self._worker_alive:
+                self._worker_alive = True
+                threading.Thread(
+                    target=self._run, daemon=True,
+                    name="edscache-warmer",
+                ).start()
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                item, self._pending = self._pending, None
+                if item is None:
+                    self._worker_alive = False
+                    self._idle.set()
+                    return
+            height, entry, listeners, engine, traces, chain_id = item
+            log = obs.get_logger("da.edscache")
+            try:
+                # the warm span joins the height's deterministic trace, so
+                # the timeline waterfall shows prover warmup hanging off
+                # the same trace id commit/first-sample use
+                with obs.span(
+                    "da.prover_warm", traces=traces,
+                    trace_id=obs.trace_id_for(chain_id, height),
+                    height=height, k=entry.eds.width // 2, engine=engine,
+                ):
+                    entry.get_prover(engine)
+                    entry.get_col_prover(engine)
+            except Exception as e:
+                # warmup is an optimization: a failure must never take
+                # the process down, but it must be visible
+                telemetry.incr("edscache.warm_errors")
+                log.error("prover warmup failed", height=height, err=e)
+                continue  # an unwarmable entry must not be seeded
+            for listener in listeners:
+                try:
+                    listener(height, entry)
+                except Exception as e:
+                    # isolate per listener: one broken serving core must
+                    # not starve the others of the seed
+                    telemetry.incr("edscache.seed_errors")
+                    log.error("seed listener failed", height=height,
+                              listener=getattr(listener, "__qualname__",
+                                               str(listener)), err=e)
+
+    def wait_idle(self, timeout: float | None = None) -> bool:
+        """Block until no warm work is pending or running (tests, bench
+        measurement points)."""
+        return self._idle.wait(timeout)

@@ -31,18 +31,22 @@ zero new hashing code.
 Fault injection: `withhold(height, cells)` makes the server refuse those
 cells — the adversarial fixture the DASer e2e uses to model a
 withholding producer (tests/test_das.py).
+
+Block-plane integration (PR 8): heights are backed by the app's
+content-addressed EDS/DAH cache (da/edscache.py). `App.commit` hands each
+committed entry here via `seed_cache_entry` (registered on
+`app.da_seed_listeners`) from the warmer's background thread with its
+provers pre-built, so the first sample after a commit never rebuilds or
+re-extends; misses single-flight through `_entry` so concurrent samplers
+of a fresh height pay one build between them.
 """
 
 from __future__ import annotations
 
 import collections
-import dataclasses
 import threading
-import time
-from typing import Optional
 
-import numpy as np
-
+from celestia_app_tpu.da import edscache as edscache_mod
 from celestia_app_tpu.da.dah import DataAvailabilityHeader, ExtendedDataSquare
 from celestia_app_tpu.utils import telemetry
 
@@ -52,13 +56,43 @@ class SampleError(ValueError):
     cell): transports map it to a 4xx, never a 500."""
 
 
-@dataclasses.dataclass
 class _Entry:
-    height: int
-    dah: DataAvailabilityHeader
-    root: bytes
-    prover: object  # BlockProver over the row trees
-    col_prover: Optional[object] = None  # lazy: BlockProver over cols
+    """A served height: thin view over the block plane's EdsCacheEntry
+    (da/edscache.py), which owns the EDS/DAH/roots and builds the row and
+    col provers at most once — lazily under its own lock, or ahead of
+    demand by the commit warmer that seeded it here."""
+
+    def __init__(self, height: int, cache_entry: edscache_mod.EdsCacheEntry,
+                 engine: str):
+        self.height = height
+        self.cache_entry = cache_entry
+        self.engine = engine
+        # resolved-prover memo: per-cell proving must not pay the cache
+        # entry's lock per proof (benign race — get_prover is idempotent
+        # and returns the one entry-owned instance)
+        self._prover_view = None
+        self._col_prover_view = None
+
+    @property
+    def dah(self) -> DataAvailabilityHeader:
+        return self.cache_entry.dah
+
+    @property
+    def root(self) -> bytes:
+        return self.cache_entry.data_root
+
+    @property
+    def prover(self):
+        if self._prover_view is None:
+            self._prover_view = self.cache_entry.get_prover(self.engine)
+        return self._prover_view
+
+    @property
+    def col_prover(self):
+        if self._col_prover_view is None:
+            self._col_prover_view = \
+                self.cache_entry.get_col_prover(self.engine)
+        return self._col_prover_view
 
 
 def _b64(b: bytes) -> str:
@@ -87,6 +121,9 @@ class SampleCore:
         self._cache_heights = cache_heights
         self._availability_keep = availability_keep
         self._lock = threading.Lock()
+        # height -> build in progress (single-flight: concurrent samplers
+        # of a fresh height pay ONE square build between them)
+        self._inflight: dict[int, threading.Event] = {}  # guarded-by: _lock
         # height -> serving record (exposed at /das/availability)
         self._availability: dict[int, dict] = {}
         self._withheld: dict[int, set[tuple[int, int]]] = {}
@@ -94,40 +131,84 @@ class SampleCore:
 
     # -- entries ---------------------------------------------------------
 
+    def _engine(self) -> str:
+        return getattr(self.app, "engine", "host")
+
     def _entry(self, height: int) -> _Entry:
-        with self._lock:
-            hit = self._cache.get(height)
-            if hit is not None:
-                self._cache.move_to_end(height)
-                return hit
+        """Cached serving entry for a height; misses are single-flight.
+
+        Two handler threads missing the same height used to both run the
+        full square rebuild under the app lock; now the first registers
+        an in-progress event and builds, later arrivals wait on it
+        (counted ``das.entry_coalesced``) and re-read the cache. A failed
+        build wakes the waiters, and whichever retries first becomes the
+        next builder — an error never wedges the height."""
+        while True:
+            with self._lock:
+                hit = self._cache.get(height)
+                if hit is not None:
+                    self._cache.move_to_end(height)
+                    return hit
+                ev = self._inflight.get(height)
+                if ev is None:
+                    ev = threading.Event()
+                    self._inflight[height] = ev
+                    break
+            telemetry.incr("das.entry_coalesced")
+            ev.wait()
+        try:
+            return self._build_entry(height)
+        finally:
+            with self._lock:
+                self._inflight.pop(height, None)
+            ev.set()
+
+    def _build_entry(self, height: int) -> _Entry:
         import contextlib
 
-        from celestia_app_tpu.chain.query import QueryError, build_prover
+        from celestia_app_tpu.chain.query import QueryError, \
+            build_prover_entry
 
         t0 = telemetry.start_timer()
         guard = self.app_lock if self.app_lock is not None \
             else contextlib.nullcontext()
         try:
             with guard:
-                _block, _square, prover, root = build_prover(self.app, height)
+                _block, _square, cache_entry = \
+                    build_prover_entry(self.app, height)
         except (QueryError, FileNotFoundError, KeyError, ValueError) as e:
             raise SampleError(f"no servable square at height {height}: {e}") \
                 from None
+        telemetry.incr("das.square_builds")
         telemetry.measure_since("das.square_build", t0)
-        entry = _Entry(height=height, dah=prover.dah, root=root,
-                       prover=prover)
+        entry = _Entry(height, cache_entry, self._engine())
         self._remember(entry)
         return entry
+
+    def seed_cache_entry(self, height: int,
+                         cache_entry: edscache_mod.EdsCacheEntry) -> None:
+        """The commit warmer's handoff (App.da_seed_listeners): serve the
+        entry the lifecycle already computed — its provers are typically
+        pre-built by the warmer, so the first sample after commit is pure
+        index arithmetic, with no rebuild and no ``das.square_build``."""
+        telemetry.incr("edscache.seeded")
+        self._seed(height, cache_entry)
 
     def seed_entry(self, height: int,
                    eds: ExtendedDataSquare,
                    dah: DataAvailabilityHeader) -> None:
         """Serve a square already in memory (a block adopted via gossip /
         blocksync whose EDS never hit the tx store, or a test fixture) —
-        bypasses the rebuild-from-txs path but NOT the proof path."""
-        prover = self._build_prover(eds, dah)
-        self._remember(_Entry(height=height, dah=dah, root=dah.hash(),
-                              prover=prover))
+        bypasses the rebuild-from-txs path but NOT the proof path.
+        Counted apart from the commit warmer's handoffs
+        (``edscache.seeded_external`` vs ``edscache.seeded``) so /metrics
+        distinguishes lifecycle seeding from gossip/fixture seeding."""
+        telemetry.incr("edscache.seeded_external")
+        self._seed(height, edscache_mod.EdsCacheEntry(eds, dah, dah.hash()))
+
+    def _seed(self, height: int,
+              cache_entry: edscache_mod.EdsCacheEntry) -> None:
+        self._remember(_Entry(height, cache_entry, self._engine()))
         with self._lock:
             self._max_seeded = max(self._max_seeded, height)
 
@@ -138,46 +219,12 @@ class SampleCore:
             while len(self._cache) > self._cache_heights:
                 self._cache.popitem(last=False)
 
-    def _build_prover(self, eds: ExtendedDataSquare,
-                      dah: DataAvailabilityHeader):
-        """Engine-gated BlockProver construction — device engines run the
-        jitted nmt_levels pass, host engines the bit-identical SIMD
-        levels (a host-engine serving process must never dispatch jax;
-        chain/query.build_prover documents the relay-down hang class)."""
-        from celestia_app_tpu.da import proof_device
-
-        if getattr(self.app, "engine", "host") == "device":
-            return proof_device.BlockProver(eds, dah)
-        from celestia_app_tpu.utils import fast_host
-
-        k = eds.width // 2
-        levels = fast_host.nmt_levels_fast(
-            fast_host._axis_leaf_ns(eds.squares, k), eds.squares
-        )
-        return proof_device.BlockProver(eds, dah, levels=levels)
-
     def _col_prover(self, entry: _Entry):
-        """Column-axis prover, built lazily on the first orthogonal-proof
-        request (only BEFP escalation needs it): the col trees of a
-        square ARE the row trees of its transpose — same leaf-namespace
-        rule (parity iff outside Q0 survives (r,c)->(c,r)), same batched
-        level pass, no per-cell hashing."""
-        with self._lock:
-            if entry.col_prover is not None:
-                return entry.col_prover
-        t0 = telemetry.start_timer()
-        eds_t = ExtendedDataSquare(
-            np.ascontiguousarray(np.swapaxes(entry.prover.eds.squares, 0, 1))
-        )
-        dah_t = DataAvailabilityHeader(
-            row_roots=entry.dah.col_roots, col_roots=entry.dah.row_roots
-        )
-        col_prover = self._build_prover(eds_t, dah_t)
-        telemetry.measure_since("das.col_tree_build", t0)
-        with self._lock:
-            if entry.col_prover is None:
-                entry.col_prover = col_prover
-            return entry.col_prover
+        """Column-axis prover (BEFP escalation serving) — owned by the
+        cache entry (da/edscache.EdsCacheEntry.get_col_prover), which
+        builds it at most once under its own lock; the commit warmer
+        usually pre-built it already."""
+        return entry.col_prover
 
     # -- fault injection (tests / adversarial simulation) ----------------
 

@@ -64,6 +64,11 @@ class NodeService:
         from celestia_app_tpu.das.server import SampleCore
 
         self.das_core = SampleCore(node.app, app_lock=self.lock)
+        # block plane: every commit hands its EDS/DAH cache entry to this
+        # serving core on the warmer's background thread (App.commit ->
+        # ProverWarmer -> seed_cache_entry), so the first /das/sample
+        # after a commit is index arithmetic — no rebuild, no re-extend
+        node.app.add_da_seed_listener(self.das_core.seed_cache_entry)
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -355,5 +360,9 @@ class NodeService:
         return th
 
     def shutdown(self) -> None:
+        # deregister the commit-seed hook so a replaced service's dead
+        # SampleCore stops receiving (and pinning) future entries
+        self.node.app.remove_da_seed_listener(
+            self.das_core.seed_cache_entry)
         self.httpd.shutdown()
         self.httpd.server_close()

@@ -36,6 +36,13 @@ starve vote intake):
   GET  /gossip/want_tx?hash=H       CAT WantTx pull -> {tx: b64} delivery
   POST /gossip/tx {tx: b64}         direct Tx push (legacy flood delivery)
 
+DAS serving plane (das/server.py; docs/FORMATS.md §7, §14):
+  GET  /das/head | /das/header | /das/sample | /das/availability
+  POST /das/samples                 batched sample serving — every commit
+                                    seeds its EDS/DAH cache entry here, so
+                                    post-commit samples never rebuild under
+                                    the consensus lock
+
 Fault-plane admin (celestia_app_tpu/faults; docs/FORMATS.md §9):
   GET  /faults                      armed fault specs + per-point fire counts
   POST /faults/arm {point, action, ...}   arm a fault; -> {id}
@@ -69,6 +76,15 @@ class ValidatorService:
         self.vnode = vnode
         self.lock = threading.Lock()
         self.reactor = None  # set by attach_reactor (autonomous mode)
+        # block plane: validator processes serve DAS samples too — the
+        # commit path seeds every committed height's EDS/DAH cache entry
+        # into this core from the warmer's background thread, so a light
+        # client sampling straight off a validator right after commit
+        # never triggers a rebuild under the consensus lock
+        from celestia_app_tpu.das.server import SampleCore
+
+        self.das_core = SampleCore(vnode.app, app_lock=self.lock)
+        vnode.app.add_da_seed_listener(self.das_core.seed_cache_entry)
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -154,6 +170,27 @@ class ValidatorService:
                         self._send(200, {} if raw is None else {
                             "tx": base64.b64encode(raw).decode()
                         })
+                    elif self.path.startswith("/das/"):
+                        # DAS sample serving (das/server.py): commit-
+                        # seeded entries answer from pre-built provers;
+                        # misses take the service lock inside route_das
+                        # (SampleCore.app_lock), never here
+                        from urllib.parse import parse_qs, urlparse
+
+                        from celestia_app_tpu.das.server import (
+                            SampleError,
+                            route_das,
+                        )
+
+                        parsed = urlparse(self.path)
+                        try:
+                            self._send(200, route_das(
+                                service.das_core, "GET", parsed.path,
+                                parse_qs(parsed.query),
+                            ))
+                        except SampleError as e:
+                            self._send(404 if "not served" in str(e)
+                                       else 400, {"error": str(e)})
                     elif self.path == "/consensus/snapshot":
                         with service.lock:
                             manifest, chunks = service.vnode.snapshot_chunks()
@@ -222,6 +259,21 @@ class ValidatorService:
                         # on-demand jax.profiler capture (FORMATS §10.3);
                         # refuses on host-engine processes (jax unloaded)
                         self._send(*obs.route_profile(payload))
+                        return
+                    if self.path == "/das/samples":
+                        from celestia_app_tpu.das.server import (
+                            SampleError,
+                            route_das,
+                        )
+
+                        try:
+                            self._send(200, route_das(
+                                service.das_core, "POST", self.path,
+                                {}, payload,
+                            ))
+                        except SampleError as e:
+                            self._send(404 if "not served" in str(e)
+                                       else 400, {"error": str(e)})
                         return
                     route = {
                         "/broadcast_tx": service._broadcast_tx,
@@ -409,5 +461,10 @@ class ValidatorService:
     def shutdown(self) -> None:
         if self.reactor is not None:
             self.reactor.stop()
+        # deregister the commit-seed hook: a service rebuilt over a
+        # long-lived vnode must not leave its dead SampleCore receiving
+        # (and pinning) every future height's entries
+        self.vnode.app.remove_da_seed_listener(
+            self.das_core.seed_cache_entry)
         self.httpd.shutdown()
         self.httpd.server_close()

@@ -1,0 +1,351 @@
+"""Block plane: the extend-once lifecycle (da/edscache.py).
+
+Tier-1 pins for ISSUE 8: a proposer's full produce→commit→first-sample
+cycle dispatches exactly ONE extend+NMT pipeline run (`da.extend_runs`),
+a follower's process→finalize→commit→sample likewise; cached and cold
+paths are byte-identical on both engines; eviction recomputes correctly;
+a Byzantine data_hash cannot ride the cache past rejection; and
+concurrent samplers of a fresh height single-flight through ONE square
+build.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.chain.app import App
+from celestia_app_tpu.chain.crypto import PrivateKey
+from celestia_app_tpu.chain.node import Node
+from celestia_app_tpu.chain.tx import MsgSend
+from celestia_app_tpu.client.tx_client import Signer
+from celestia_app_tpu.da import edscache
+from celestia_app_tpu.das.server import SampleCore
+from celestia_app_tpu.utils import telemetry
+
+CHAIN = "edscache-test"
+
+
+def _c(name: str) -> int:
+    return telemetry.snapshot()["counters"].get(name, 0)
+
+
+def _ods(k: int = 4, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    ods = rng.integers(0, 256, size=(k, k, 512), dtype=np.uint8)
+    ods[..., :29] = 0
+    ods[..., 28] = 7  # one user namespace, sorted layout
+    return ods
+
+
+def _app(tmp_path=None, engine: str = "host", n: int = 2):
+    privs = [PrivateKey.from_seed(b"edsc-%d" % i) for i in range(n)]
+    addrs = [p.public_key().address() for p in privs]
+    app = App(chain_id=CHAIN, engine=engine,
+              data_dir=str(tmp_path) if tmp_path is not None else None)
+    app.init_chain({
+        "time_unix": 1_700_000_000.0,
+        "accounts": [{"address": a.hex(), "balance": 10**12}
+                     for a in addrs],
+        "validators": [{"operator": addrs[0].hex(), "power": 10}],
+    })
+    signer = Signer(CHAIN)
+    for i, p in enumerate(privs):
+        signer.add_account(p, number=i)
+    return app, signer, addrs
+
+
+def _txs(signer, addrs, amount: int = 1) -> list[bytes]:
+    out = []
+    for i, a in enumerate(addrs):
+        tx = signer.create_tx(
+            a, [MsgSend(a, addrs[(i + 1) % len(addrs)], amount)],
+            fee=2000, gas_limit=100_000,
+        )
+        signer.accounts[a].sequence += 1
+        out.append(tx.encode())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the telemetry-pinned invariant: one extend per (node, height)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("engine", ["host", "auto"])
+def test_proposer_cycle_dispatches_exactly_one_extend(tmp_path, engine):
+    """produce (prepare + process) → commit → first DAS sample: ONE
+    `da.extend_runs`, zero `das.square_builds` (the commit seeded the
+    serving core from the warmer thread) — on the host engine and the
+    jitted device path alike (CPU backend under tier-1)."""
+    app, signer, addrs = _app(tmp_path, engine=engine)
+    node = Node(app)
+    core = node.attach_das_core(SampleCore(app))
+    try:
+        for raw in _txs(signer, addrs):
+            assert node.broadcast_tx(raw).code == 0
+        c0 = _c("da.extend_runs")
+        node.produce_block(t=1_700_000_001.0)
+        assert app.da_warmer.wait_idle(30)
+        seeded = _c("edscache.seeded")
+        assert seeded >= 1
+        b0 = _c("das.square_builds")
+        out = core.sample(1, 0, 0)
+        assert out["samples"][0]["share"]
+        # the whole cycle paid ONE pipeline dispatch; the sample paid none
+        assert _c("da.extend_runs") - c0 == 1
+        assert _c("das.square_builds") - b0 == 0
+        # and the warmer pre-built both provers before the sample landed
+        assert core._cache[1].cache_entry.warmed()
+    finally:
+        app.close()
+
+
+def test_follower_cycle_dispatches_exactly_one_extend(tmp_path):
+    """A follower validating a gossiped proposal: process → finalize →
+    commit → first sample = ONE extend, on ITS node. Serving works with
+    no block store at all — the seeded entry is the gossip handoff."""
+    proposer, signer, addrs = _app(tmp_path, n=2)
+    follower, _, _ = _app(None, n=2)  # no data_dir: seeding must suffice
+    core = SampleCore(follower)
+    follower.add_da_seed_listener(core.seed_cache_entry)
+    try:
+        raws = _txs(signer, addrs)
+        prop = proposer.prepare_proposal(raws, t=1_700_000_001.0)
+        c0 = _c("da.extend_runs")
+        assert follower.process_proposal(prop.block)
+        follower.finalize_block(prop.block)
+        follower.commit(prop.block)
+        assert follower.da_warmer.wait_idle(30)
+        out = core.sample(1, 0, 0)
+        assert out["data_root"] == prop.block.header.data_hash.hex()
+        assert _c("da.extend_runs") - c0 == 1
+    finally:
+        proposer.close()
+
+
+def test_byzantine_data_hash_rejected_despite_warm_cache(tmp_path):
+    """A wrong header data_hash must reject even when the honest entry is
+    already cached — the cache changes who pays for the truth, never the
+    truth: the entry is a pure function of the ODS, and the header is
+    compared against it the same way hot or cold."""
+    import dataclasses
+
+    proposer, signer, addrs = _app(tmp_path)
+    follower, _, _ = _app(None)
+    try:
+        prop = proposer.prepare_proposal(_txs(signer, addrs),
+                                         t=1_700_000_001.0)
+        bad_header = dataclasses.replace(prop.block.header,
+                                         data_hash=b"\xee" * 32)
+        bad_block = dataclasses.replace(prop.block, header=bad_header)
+        assert not follower.process_proposal(bad_block)
+        # the honest block still validates on the (now warm) cache
+        assert follower.process_proposal(prop.block)
+    finally:
+        proposer.close()
+
+
+# ---------------------------------------------------------------------------
+# differential: cached == cold, host == device, proofs included
+# ---------------------------------------------------------------------------
+
+
+def _entries_equal(a: edscache.EdsCacheEntry, b: edscache.EdsCacheEntry):
+    assert a.data_root == b.data_root
+    assert a.dah.row_roots == b.dah.row_roots
+    assert a.dah.col_roots == b.dah.col_roots
+    assert np.array_equal(a.eds.squares, b.eds.squares)
+
+
+@pytest.mark.backend
+def test_cached_cold_and_cross_engine_byte_identical():
+    ods = _ods(k=4, seed=3)
+    host_cold = edscache.compute_entry(ods, "host")
+    dev_cold = edscache.compute_entry(ods, "auto")  # jitted path (CPU backend)
+    _entries_equal(host_cold, dev_cold)
+
+    cache = edscache.EdsCache(max_entries=2)
+    warm = cache.get_or_compute(ods, "host")
+    again = cache.get_or_compute(ods, "host")
+    assert again is warm  # a hit returns the SAME object
+    _entries_equal(warm, dev_cold)
+
+    # proofs: host-levels prover vs jitted-levels prover, byte for byte
+    ph = host_cold.get_prover("host")
+    pd = dev_cold.get_prover("auto")
+    for (r, c) in [(0, 0), (3, 7), (7, 2), (5, 5)]:
+        sh, prh = ph.prove_cell(r, c)
+        sd, prd = pd.prove_cell(r, c)
+        assert sh == sd
+        assert prh.nodes == prd.nodes
+        assert (prh.start, prh.end, prh.total) == (prd.start, prd.end,
+                                                   prd.total)
+    # col provers too (the BEFP escalation surface)
+    ch = host_cold.get_col_prover("host")
+    cd = dev_cold.get_col_prover("auto")
+    s1, p1 = ch.prove_cell(2, 6)
+    s2, p2 = cd.prove_cell(2, 6)
+    assert s1 == s2 and p1.nodes == p2.nodes
+
+
+def test_eviction_recomputes_byte_identical():
+    cache = edscache.EdsCache(max_entries=1)
+    o1, o2 = _ods(seed=1), _ods(seed=2)
+    e1 = cache.get_or_compute(o1, "host")
+    ev0 = _c("edscache.evictions")
+    e2 = cache.get_or_compute(o2, "host")
+    assert _c("edscache.evictions") - ev0 == 1
+    assert len(cache) == 1
+    # o1 was evicted: recomputing pays a fresh pipeline run but lands on
+    # identical bytes, and the root index followed the eviction
+    assert cache.lookup_root(e1.data_root) is None
+    assert cache.lookup_root(e2.data_root) is e2
+    c0 = _c("da.extend_runs")
+    e1b = cache.get_or_compute(o1, "host")
+    assert _c("da.extend_runs") - c0 == 1
+    _entries_equal(e1, e1b)
+
+
+def test_cache_key_is_content_addressed():
+    o = _ods(seed=4)
+    assert edscache.cache_key(o) == edscache.cache_key(o.copy())
+    o2 = o.copy()
+    o2[0, 0, 100] ^= 1
+    assert edscache.cache_key(o) != edscache.cache_key(o2)
+
+
+# ---------------------------------------------------------------------------
+# single-flight serving + warmer behavior
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_samplers_single_flight(tmp_path, monkeypatch):
+    """Two handler threads missing the same fresh height pay ONE square
+    build between them (the in-progress map in SampleCore._entry)."""
+    from celestia_app_tpu.chain import query as query_mod
+
+    app, signer, addrs = _app(tmp_path)
+    node = Node(app)
+    try:
+        for raw in _txs(signer, addrs):
+            node.broadcast_tx(raw)
+        node.produce_block(t=1_700_000_001.0)
+        app.da_warmer.wait_idle(30)
+        core = SampleCore(app)  # NOT seeded: first sample must build
+
+        calls = []
+        real = query_mod.build_prover_entry
+
+        def slow_build(app_, height):
+            calls.append(height)
+            time.sleep(0.15)  # hold the window open for the second thread
+            return real(app_, height)
+
+        monkeypatch.setattr(query_mod, "build_prover_entry", slow_build)
+        coal0 = _c("das.entry_coalesced")
+        results, errors = [], []
+
+        def sample(cell):
+            try:
+                results.append(core.sample(1, *cell))
+            except Exception as e:  # surface, don't deadlock the join
+                errors.append(e)
+
+        threads = [threading.Thread(target=sample, args=((0, i),))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        assert len(results) == 4
+        assert len(calls) == 1  # ONE build for four concurrent samplers
+        assert _c("das.entry_coalesced") - coal0 >= 1
+        assert len({r["data_root"] for r in results}) == 1
+    finally:
+        app.close()
+
+
+def test_warmer_coalesces_to_newest(tmp_path):
+    """A burst of commits (the blocksync-batch shape) never queues one
+    warm build per height: superseded slots are counted and dropped, and
+    the cache itself still guarantees extend-once for the skipped ones."""
+    app, signer, addrs = _app(tmp_path)
+    node = Node(app)
+    core = node.attach_das_core(SampleCore(app))
+    try:
+        t = 1_700_000_001.0
+        for _ in range(5):
+            for raw in _txs(signer, addrs):
+                node.broadcast_tx(raw)
+            node.produce_block(t=t)
+            t += 1.0
+        assert app.da_warmer.wait_idle(30)
+        # the NEWEST height is always seeded once the warmer drains
+        tip = app.height
+        assert core._cache[tip].cache_entry.warmed()
+        # a warm-skipped height inside the content-cache window still
+        # serves with at most a square rebuild, never a re-extend
+        c0 = _c("da.extend_runs")
+        core.sample(tip - 1, 0, 0)
+        assert _c("da.extend_runs") - c0 == 0
+        # ...while one evicted past the LRU window pays exactly one fresh
+        # pipeline run (bounded memory has a price; it is one, not three)
+        c0 = _c("da.extend_runs")
+        core.sample(1, 0, 0)
+        assert _c("da.extend_runs") - c0 == 1
+    finally:
+        app.close()
+
+
+def test_validator_service_serves_seeded_das_samples(tmp_path):
+    """Validator processes serve /das/* too now: a commit through
+    ValidatorNode.apply seeds the service's SampleCore, and the sample
+    verifies against the height's DAH."""
+    import json as json_mod
+    import urllib.request
+
+    from celestia_app_tpu.chain import consensus as cons
+    from celestia_app_tpu.da import sampling
+    from celestia_app_tpu.da.dah import DataAvailabilityHeader
+    from celestia_app_tpu.das.daser import DASer
+    from celestia_app_tpu.service.validator_server import ValidatorService
+
+    priv = PrivateKey.from_seed(b"edsc-val")
+    addr = priv.public_key().address()
+    genesis = {
+        "time_unix": 1_700_000_000.0,
+        "accounts": [{"address": addr.hex(), "balance": 10**12}],
+        "validators": [{"operator": addr.hex(), "power": 10,
+                        "pubkey": priv.public_key().compressed.hex()}],
+    }
+    vnode = cons.ValidatorNode("val0", priv, genesis, CHAIN,
+                               data_dir=str(tmp_path / "val0"))
+    net = cons.LocalNetwork([vnode])
+    svc = ValidatorService(vnode)
+    svc.serve_background()
+    try:
+        net.produce_height(t=1_700_000_001.0)
+        assert vnode.app.da_warmer.wait_idle(30)
+        url = f"http://127.0.0.1:{svc.port}"
+        with urllib.request.urlopen(url + "/das/header?height=1",
+                                    timeout=10) as r:
+            hdr = json_mod.loads(r.read())
+        dah = DataAvailabilityHeader(
+            tuple(bytes.fromhex(x) for x in hdr["row_roots"]),
+            tuple(bytes.fromhex(x) for x in hdr["col_roots"]),
+        )
+        with urllib.request.urlopen(
+            url + "/das/sample?height=1&row=0&col=0", timeout=10
+        ) as r:
+            doc = json_mod.loads(r.read())
+        share, proof = DASer._decode_sample(doc["samples"][0])
+        assert sampling.verify_sample(dah, 0, 0, share, proof)
+    finally:
+        try:
+            svc.httpd.shutdown()
+        except Exception:
+            pass
+        vnode.app.close()
