@@ -1431,6 +1431,233 @@ def measure_block(blocks: int | None = None, senders: int = 8) -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def measure_sync() -> None:
+    """Sync-plane bench (--sync). Three BENCH JSON lines:
+
+      {"metric": "snapshot_serve_ms", ...}   HTTP round-trip to serve the
+          manifest list plus one chunk from the disk-backed snapshot
+          store (never a capture, never under the service lock).
+      {"metric": "blocksync_blocks_per_sec", ...}  verified replay rate
+          of the pipelined range path (GET /gossip/commits + prefetch
+          window) vs the per-height round-trip baseline, each measured
+          over a real replay window of the SAME chain. A 70 ms
+          per-request latency is injected via the fault plane — the
+          network shape the reference's e2e benchmark models with
+          BitTwister (test/e2e/benchmark/benchmark.go:110-117) — and
+          labeled in the JSON; on bare localhost the replay loop is
+          verification-bound either way and the round-trip being
+          pipelined away would be invisible.
+      {"metric": "state_sync_join_s", ...}   wall time for a fresh joiner
+          to reach the tip of a `CELESTIA_BENCH_SYNC_BLOCKS` (default
+          2000) block chain via chunked snapshot join + tail blocksync,
+          against `full_replay_s` extrapolated from the measured
+          per-height rate over the full chain length (flagged
+          "estimated_from_window"; replaying thousands of blocks for
+          real would measure the same per-height cost N more times).
+
+    Backend labeling follows FORMATS §12.2 ("cpu-fallback" on CPU).
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+
+    from celestia_app_tpu import faults
+    from celestia_app_tpu.chain import consensus as cons
+    from celestia_app_tpu.chain import sync as sync_mod
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.chain.reactor import (
+        ConsensusReactor,
+        ReactorConfig,
+    )
+    from celestia_app_tpu.net import transport
+    from celestia_app_tpu.service.validator_server import ValidatorService
+
+    platform = jax.devices()[0].platform
+    backend = "cpu-fallback" if platform == "cpu" else platform
+    chain_id = "sync-bench"
+    blocks = int(os.environ.get("CELESTIA_BENCH_SYNC_BLOCKS", "2000"))
+    tail = 32  # heights past the newest snapshot (the join's replay tail)
+    window = min(blocks, int(os.environ.get(
+        "CELESTIA_BENCH_SYNC_WINDOW", "192")))
+    base_window = min(blocks, int(os.environ.get(
+        "CELESTIA_BENCH_SYNC_BASE_WINDOW", "96")))
+    rtt_s = float(os.environ.get("CELESTIA_BENCH_SYNC_RTT_MS", "70")) / 1e3
+    snap_interval = max(1, blocks // 4)
+
+    def genesis_for(priv):
+        return {
+            "time_unix": 1_700_000_000.0,
+            "accounts": [{"address": priv.public_key().address().hex(),
+                          "balance": 10**12}],
+            "validators": [{
+                "operator": priv.public_key().address().hex(),
+                "power": 10,
+                "pubkey": priv.public_key().compressed.hex(),
+            }],
+        }
+
+    def grow(vnode, reactor, n):
+        for _ in range(n):
+            height = vnode.app.height + 1
+            last_cert = vnode.certificates.get(height - 1)
+            block = vnode.propose(t=1_700_000_000.0 + height)
+            bh = block.header.hash()
+            digest = cons.Proposal.commit_info_digest(last_cert, ())
+            sig = vnode.priv.sign(cons.Proposal.sign_bytes(
+                chain_id, height, 0, bh, digest))
+            prop = cons.Proposal(height, 0, block, vnode.address, sig,
+                                 last_cert, ())
+            vote = vnode._signed(height, bh, "precommit", 0)
+            cert = cons.CommitCertificate(height, bh, (vote,), 0)
+            vnode.apply(block, cert, absent_cert=last_cert)
+            vnode.clear_lock()
+            reactor._remember_commit(
+                {"proposal": cons.proposal_to_json(prop),
+                 "cert": cons.cert_to_json(cert)}, height)
+
+    tmp = tempfile.mkdtemp(prefix="sync-bench-")
+    faults.reset()
+    try:
+        priv = PrivateKey.from_seed(b"sync-bench-server")
+        genesis = genesis_for(priv)
+        server = cons.ValidatorNode(
+            "srv", priv, genesis, chain_id,
+            data_dir=os.path.join(tmp, "srv", "data"))
+        svc = ValidatorService(server)
+        reactor = ConsensusReactor(
+            server, [], svc.lock,
+            ReactorConfig(snapshot_interval=snap_interval,
+                          snapshot_keep=2))
+        svc.reactor = reactor  # serve routes only; loop not started
+        svc.serve_background()
+        url = f"http://127.0.0.1:{svc.port}"
+        t_build0 = time.perf_counter()
+        grow(server, reactor, blocks + tail)
+        build_s = time.perf_counter() - t_build0
+        target = server.app.height
+        print(f"chain built: {target} heights in {build_s:.1f}s "
+              f"(snapshots at interval {snap_interval})",
+              file=sys.stderr, flush=True)
+
+        # -- 1) snapshot_serve_ms (no injected latency: serve cost only)
+        client = transport.PeerClient(name="sync-bench")
+        reps = 20
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            snaps = client.get(url, "/sync/snapshots")["snapshots"]
+            client.get(
+                url,
+                f"/sync/chunk?height={snaps[0]['height']}&index=0",
+                raw=True,
+            )
+        serve_ms = (time.perf_counter() - t0) * 1e3 / reps
+        print(json.dumps({
+            "metric": "snapshot_serve_ms",
+            "value": round(serve_ms, 3),
+            "unit": "ms",
+            "snapshots_on_disk": len(snaps),
+            "n_chunks": snaps[0]["n_chunks"],
+            "backend": backend,
+        }), flush=True)
+
+        # injected per-request latency (the reference's BitTwister shape):
+        # applies to the JOINERS' outbound requests only — the serving
+        # side makes none
+        faults.arm("net.request", "delay", delay_s=rtt_s,
+                   match={"owner": "^join-"})
+
+        def joiner(name, **cfg):
+            vnode = cons.ValidatorNode(
+                name, PrivateKey.from_seed(name.encode()), genesis,
+                chain_id, data_dir=os.path.join(tmp, name, "data"))
+            defaults = dict(snapshot_interval=0, sync_grace=0.0,
+                            gossip_timeout=10.0)
+            r = ConsensusReactor(
+                vnode, [url], threading.Lock(),
+                ReactorConfig(**{**defaults, **cfg}))
+            return vnode, r
+
+        def replay_to(vnode, r, stop_height, budget_s=1800.0):
+            with r._msg_lock:
+                r._ahead = (stop_height + 1, url,
+                            time.monotonic() - 10)
+            deadline = time.monotonic() + budget_s
+            while (vnode.app.height < stop_height
+                   and time.monotonic() < deadline):
+                r._maybe_catch_up()
+            assert vnode.app.height >= stop_height, (
+                f"{vnode.name} stuck at {vnode.app.height}")
+
+        # -- 2) blocksync_blocks_per_sec: pipelined vs per-height -------
+        vp, rp = joiner("join-pipe", statesync_gap=10**9)
+        t0 = time.perf_counter()
+        replay_to(vp, rp, window)
+        pipe_s = time.perf_counter() - t0
+        pipe_rate = window / pipe_s
+        vb, rb = joiner("join-base", statesync_gap=10**9,
+                        blocksync_pipeline=False)
+        t0 = time.perf_counter()
+        replay_to(vb, rb, base_window)
+        base_s = time.perf_counter() - t0
+        base_rate = base_window / base_s
+        # differential check (untimed): walk both joiners to the SAME
+        # height per-height (the two stop rules differ by one at window
+        # boundaries), then the stores must be byte-identical — or the
+        # speedup is measuring corruption
+        while vb.app.height < vp.app.height:
+            assert rb._replay_height(vb.app.height + 1, prefer=url)
+        while vp.app.height < vb.app.height:
+            assert rp._replay_height(vp.app.height + 1, prefer=url)
+        assert vp.app.store.snapshot() == vb.app.store.snapshot(), (
+            "pipelined and per-height replay diverged"
+        )
+        print(json.dumps({
+            "metric": "blocksync_blocks_per_sec",
+            "value": round(pipe_rate, 2),
+            "unit": "blocks/s",
+            "window_heights": window,
+            "per_height_blocks_per_sec": round(base_rate, 2),
+            "per_height_window_heights": base_window,
+            "vs_per_height": round(pipe_rate / base_rate, 2),
+            "injected_rtt_ms": rtt_s * 1e3,
+            "backend": backend,
+        }), flush=True)
+
+        # -- 3) state_sync_join_s vs (estimated) full replay -------------
+        vj, rj = joiner("join-snap", statesync_gap=tail)
+        t0 = time.perf_counter()
+        replay_to(vj, rj, target)
+        join_s = time.perf_counter() - t0
+        assert vj.app.last_app_hash == server.app.last_app_hash
+        assert vj.app.last_block_hash == server.app.last_block_hash
+        # full replay extrapolated from the measured per-height rate over
+        # the same chain (labeled): replaying all N for real would just
+        # re-measure base_rate N/base_window more times
+        full_replay_s = target / base_rate
+        print(json.dumps({
+            "metric": "state_sync_join_s",
+            "value": round(join_s, 2),
+            "unit": "s",
+            "chain_heights": target,
+            "snapshot_height": target - target % snap_interval,
+            "full_replay_s": round(full_replay_s, 1),
+            "estimated_from_window": base_window,
+            "vs_full_replay": round(full_replay_s / join_s, 1),
+            "injected_rtt_ms": rtt_s * 1e3,
+            "chain_build_s": round(build_s, 1),
+            "backend": backend,
+        }), flush=True)
+        svc.shutdown()
+        server.app.close()
+        for v in (vp, vb, vj):
+            v.app.close()
+    finally:
+        faults.reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 # -- mode registry (--list prints it) ----------------------------------------
 # name -> (runner, emitted metrics). The default invocation (no flag) runs
 # the deadline-driven headline measurement (`extend_commit_128_ms`).
@@ -1444,6 +1671,9 @@ MODES = {
     "mempool": (measure_mempool,
                 "mempool_ingest_txs_per_sec, mempool_reap_ms"),
     "chaos": (measure_chaos, "crash_replay_ms, chaos_heal_recovery_s"),
+    "sync": (measure_sync,
+             "state_sync_join_s, blocksync_blocks_per_sec, "
+             "snapshot_serve_ms"),
     "analyze": (measure_analyze, "analyze_wall_s"),
     "obs": (measure_obs, "obs_overhead_pct"),
     "stream-mesh": (measure_stream_mesh, "stream_mesh blocks/s (stderr+json)"),

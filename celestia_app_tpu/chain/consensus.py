@@ -930,7 +930,13 @@ class ValidatorNode:
         return snapshot_app_chunks(self.app)
 
 
-SNAPSHOT_CHUNK_KEYS = 64
+# keys per state-sync snapshot chunk. Env-tunable (chunking is a serving-
+# local choice: the manifest commits to whatever chunking the server used,
+# and the joiner verifies against THAT manifest) — chaos tests shrink it
+# to force multi-chunk restores out of small devnet states.
+SNAPSHOT_CHUNK_KEYS = int(
+    os.environ.get("CELESTIA_SNAPSHOT_CHUNK_KEYS", "64")
+)
 
 
 def capture_app_snapshot(app: App) -> dict:

@@ -33,12 +33,16 @@ round-scoped (Commit.round), and per-round attribution is exact; the
 unlock-on-higher-polka rule this enables keeps a locked validator live
 when the network polkas a different block in a later round.
 
-Catch-up: a node that misses the commit gossip for its next height asks
-peers for commit records (GET /gossip/commit_at, served from the
-per-height durable record store) and replays them BLOCK-BY-BLOCK through
-the same verification live gossip gets — verified blocksync, any gap
-depth. Verified state sync (/consensus/snapshot) is the fallback for
-gaps beyond cfg.statesync_gap or records no peer can serve.
+Catch-up (the sync plane, chain/sync.py + docs/DESIGN.md): a node that
+misses the commit gossip for its next height pulls peers' commit
+records in batched windows (GET /gossip/commits, per-height
+/gossip/commit_at as the fallback) and replays them through the same
+verification live gossip gets — verified blocksync, any gap depth, with
+the next window prefetched while the current one verifies. Chunked,
+parallel, resumable state sync (GET /sync/snapshots + /sync/chunk,
+app-hash-anchored adoption) covers gaps beyond cfg.statesync_gap or
+records no peer can serve; the node also WRITES interval snapshots for
+peers to join from (cfg.snapshot_interval).
 """
 
 from __future__ import annotations
@@ -98,6 +102,24 @@ class ReactorConfig:
     blocksync_batch: int = 64
     statesync_gap: int = 512
     commit_records_keep: int = 10_000
+    # the sync plane (chain/sync.py): pipelined blocksync pulls commit
+    # records in `blocksync_batch`-height windows via GET /gossip/commits
+    # and prefetches window N+1 on a background thread while window N
+    # runs the unchanged per-height verification — the replay loop is
+    # verification-bound, not RTT-bound. `blocksync_serve_bytes` caps one
+    # served range response; `blocksync_pipeline=False` keeps the
+    # per-height round-trip loop (the differential baseline bench.py
+    # --sync measures against).
+    blocksync_pipeline: bool = True
+    blocksync_serve_bytes: int = 2 << 20
+    # chunked state sync: parallel chunk fetchers per restore, and the
+    # interval snapshots this node WRITES for peers to join from
+    # (default_overrides.go:294-297 interval 1500 keep 2; 0 disables).
+    # Snapshots land under <home>/snapshots via chain/sync.SnapshotStore;
+    # in-memory nodes (no data_dir) never write them.
+    statesync_workers: int = 4
+    snapshot_interval: int = 1500
+    snapshot_keep: int = 2
     # shared-transport hardening (net/transport.py): gossip is fire-and-
     # forget so sends make ONE attempt (the pull paths recover anything
     # that matters); `breaker_failures` consecutive failures open the
@@ -150,6 +172,12 @@ class ConsensusReactor:
         self.round = 0
         self.step = "idle"
         self.loop_errors = 0  # counted, surfaced in /consensus/status
+        # sync-plane failure counters (surfaced in /consensus/status's
+        # reactor block + telemetry): a dead snapshot peer or a failing
+        # record fetch must be VISIBLE, not silently swallowed into the
+        # catch-up loop's return False
+        self.statesync_errors = 0
+        self.blocksync_fetch_errors = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # inbox (guarded by _msg_lock; handlers must never block on the
@@ -191,6 +219,18 @@ class ConsensusReactor:
         # restarted validator repays its jit compile); a second timeout
         # means dead, back to warm windows so rotation stays fast
         self._cold_retry: set[bytes] = set()
+        # pipelined blocksync: the one-slot prefetch window — while the
+        # reactor verifies/applies batch N, a background thread fills
+        # this slot with batch N+1 (chain/sync plane)
+        self._prefetch_lock = threading.Lock()
+        self._prefetched: tuple[int, list[dict]] | None = None  # guarded-by: _prefetch_lock
+        self._prefetch_thread: threading.Thread | None = None  # guarded-by: _prefetch_lock
+        # the snapshot set THIS node serves for chunked state sync
+        # (<home>/snapshots; None for in-memory nodes) — written at
+        # cfg.snapshot_interval commits, outside the writer lock
+        from celestia_app_tpu.chain import sync as sync_mod
+
+        self.snapshot_store = sync_mod.store_for(self.vnode)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -328,6 +368,28 @@ class ConsensusReactor:
             # the in-memory recent window
             doc = self._load_commit_record(height)
         return doc
+
+    def commits_range(self, lo: int, hi: int) -> list[dict]:
+        """Batched blocksync serving (GET /gossip/commits?from=&to=):
+        consecutive commit records from `lo` up to `hi` inclusive,
+        clamped to one cfg.blocksync_batch window and to
+        cfg.blocksync_serve_bytes of encoded payload (always at least
+        one record when one exists). A gap ends the response — the
+        requester falls back to per-height pulls / other peers there."""
+        if lo < 1 or hi < lo:
+            return []
+        hi = min(hi, lo + self.cfg.blocksync_batch - 1)
+        out: list[dict] = []
+        size = 0
+        for h in range(lo, hi + 1):
+            doc = self.commit_at(h)
+            if doc is None:
+                break
+            size += len(json.dumps(doc))
+            if out and size > self.cfg.blocksync_serve_bytes:
+                break
+            out.append(doc)
+        return out
 
     # -- mempool gossip: the CAT want/have reactor (mempool/gossip.py) ---
     # SeenTx (32-byte hash announce) replaces the old full-tx flood; a
@@ -719,6 +781,19 @@ class ConsensusReactor:
                     v for v in self._vote_pool if v.validator not in punished
                 ]
         self._persist_commit_record(doc, height)
+        self._maybe_snapshot(height)
+
+    def _maybe_snapshot(self, height: int) -> None:
+        """Interval state-sync snapshots (the serving half of the sync
+        plane): called on BOTH commit paths after the commit record is
+        durable, always OUTSIDE the writer lock — only the state capture
+        inside sync.maybe_snapshot takes it, briefly."""
+        from celestia_app_tpu.chain import sync as sync_mod
+
+        sync_mod.maybe_snapshot(
+            self.vnode.app, self.service_lock, self.snapshot_store,
+            self.cfg.snapshot_interval, self.cfg.snapshot_keep, height,
+        )
 
     # -- durable commit records (the block store blocksync reads) --------
 
@@ -806,13 +881,15 @@ class ConsensusReactor:
 
     def _maybe_catch_up(self) -> bool:
         """If peers are persistently ahead, replay their served commit
-        records block-by-block with full verification (blocksync), state-
-        syncing only when the gap exceeds cfg.statesync_gap or no peer
-        can serve the needed records. Each replayed height goes through
+        records with full verification (blocksync), state-syncing only
+        when the gap exceeds cfg.statesync_gap or no peer can serve the
+        needed records. Each replayed height goes through
         _apply_pending_commit — proposal signature, certificate against
         THIS node's then-current valset (its own staking state at
         height-1), evidence, ProcessProposal — so a tampered served
-        record cannot advance the chain."""
+        record cannot advance the chain. Replay is pipelined: records
+        arrive in blocksync_batch windows and the next window is
+        prefetched while this one verifies (_blocksync_step)."""
         with self._msg_lock:
             ahead = self._ahead
         if ahead is None:
@@ -830,20 +907,12 @@ class ConsensusReactor:
             # snapshot endpoint must not tax every replay batch with its
             # timeout
             self._statesync_tried = True
-            for u in self._peer_order(peer):
-                if self._state_sync_from(u):
-                    progressed = True
-                    break
-        # verified block-by-block replay (bounded per reactor step; the
-        # _ahead marker persists until fully caught up, so the next step
-        # continues the sync)
-        for _ in range(self.cfg.blocksync_batch):
-            with self.service_lock:
-                need = self.vnode.app.height + 1
-            if need > target:
-                break
-            if not self._replay_height(need, prefer=peer):
-                break
+            if self._state_sync(peer):
+                progressed = True
+        # verified windowed replay (bounded per reactor step; the _ahead
+        # marker persists until fully caught up, so the next step
+        # continues the sync with the prefetched window)
+        if self._blocksync_step(target, peer):
             progressed = True
         with self.service_lock:
             still_behind = self.vnode.app.height + 1 < target
@@ -856,14 +925,129 @@ class ConsensusReactor:
         if not progressed:
             # no peer could serve an applicable record (windows pruned
             # past the gap): verified state sync is the only path left
-            for u in self._peer_order(peer):
-                if self._state_sync_from(u):
-                    progressed = True
-                    with self._msg_lock:
-                        self._ahead = None
-                    self._statesync_tried = False
-                    break
+            if self._state_sync(peer):
+                progressed = True
+                with self._msg_lock:
+                    self._ahead = None
+                self._statesync_tried = False
         return progressed
+
+    # -- pipelined blocksync (the sync plane's replay half) ---------------
+
+    def _blocksync_step(self, target: int, peer: str) -> bool:
+        """Replay up to one blocksync_batch window of heights. The window
+        is taken from the prefetch slot when the previous step armed it
+        (so its fetch overlapped that step's verification), else fetched
+        synchronously via GET /gossip/commits; before applying, the NEXT
+        window's fetch is kicked off in the background — verification,
+        not the round-trip, is the loop's critical path. Heights the
+        batch path could not cover (no range-serving peer, a bad record
+        mid-window) fall back to the per-height _replay_height pull,
+        which tries every peer."""
+        with self.service_lock:
+            need = self.vnode.app.height + 1
+        if need > target:
+            return False
+        progressed = False
+        applied = 0
+        docs: list[dict] = []
+        if self.cfg.blocksync_pipeline:
+            got = self._take_prefetch(need)
+            docs = got if got is not None \
+                else self._fetch_commit_batch(need, target, peer)
+        if docs:
+            # overlap: the next window downloads while THIS one verifies
+            self._start_prefetch(need + len(docs), target, peer)
+            for doc in docs:
+                self.on_commit(doc)
+                if not self._apply_pending_commit():
+                    break
+                applied += 1
+                progressed = True
+        if docs and applied == len(docs):
+            return progressed  # full window applied; next step continues
+        # per-height verified pull for the rest of this step's window
+        for _ in range(self.cfg.blocksync_batch - applied):
+            with self.service_lock:
+                need = self.vnode.app.height + 1
+            if need > target:
+                break
+            if not self._replay_height(need, prefer=peer):
+                break
+            progressed = True
+        return progressed
+
+    def _fetch_commit_batch(self, lo: int, target: int,
+                            prefer: str) -> list[dict]:
+        """One range fetch: consecutive records from `lo`, at most one
+        blocksync_batch window, from the first peer that serves a
+        non-empty consistent prefix. Pre-sync-plane peers 404 the route
+        — they are skipped silently (the per-height path covers them);
+        transport failures are counted + logged."""
+        import urllib.error
+
+        hi = min(target, lo + self.cfg.blocksync_batch - 1)
+        for u in self._peer_order(prefer):
+            if not self.net.available(u):
+                continue  # breaker open: already recorded, skip
+            try:
+                doc = self.net.get(
+                    u, f"/gossip/commits?from={lo}&to={hi}"
+                )
+            except urllib.error.HTTPError:
+                continue  # old peer without the range route
+            except (OSError, ValueError) as e:
+                self._count_fetch_error(u, e)
+                continue
+            got = doc.get("commits") if isinstance(doc, dict) else None
+            out: list[dict] = []
+            for i, d in enumerate(got or []):
+                try:
+                    if int(d["cert"]["height"]) != lo + i:
+                        break  # non-consecutive: keep the good prefix
+                except (KeyError, TypeError, ValueError):
+                    break
+                out.append(d)
+            if out:
+                return out
+        return []
+
+    def _take_prefetch(self, lo: int) -> list[dict] | None:
+        """Claim the prefetched window if it starts exactly at `lo`
+        (waiting briefly for an in-flight fetch); a stale window — the
+        chain moved differently than predicted — is discarded."""
+        with self._prefetch_lock:
+            th = self._prefetch_thread
+        if th is not None:
+            th.join(timeout=self.cfg.gossip_timeout + 1.0)
+            if th.is_alive():
+                return None  # still downloading: don't stall the step
+        with self._prefetch_lock:
+            got, self._prefetched = self._prefetched, None
+            self._prefetch_thread = None
+        if got is None or got[0] != lo:
+            return None
+        return got[1]
+
+    def _start_prefetch(self, lo: int, target: int, prefer: str) -> None:
+        """Arm the one-slot prefetch window for [lo, lo+batch) on a
+        background thread — the pipelining half: this download runs
+        while the caller verifies the window it just took."""
+        if lo > target or not self.cfg.blocksync_pipeline:
+            return
+        with self._prefetch_lock:
+            if self._prefetch_thread is not None:
+                return  # one in-flight prefetch at a time
+
+        def work() -> None:
+            docs = self._fetch_commit_batch(lo, target, prefer)
+            with self._prefetch_lock:
+                self._prefetched = (lo, docs) if docs else None
+
+        th = threading.Thread(target=work, daemon=True)
+        with self._prefetch_lock:
+            self._prefetch_thread = th
+        th.start()
 
     def _replay_height(self, need: int, prefer: str) -> bool:
         """Blocksync one height: try EVERY peer's served record until one
@@ -876,6 +1060,8 @@ class ConsensusReactor:
             height=need, node=self.vnode.name,
         ) as sp:
             for u in self._peer_order(prefer):
+                if not self.net.available(u):
+                    continue  # breaker open: already recorded, skip
                 doc = self._fetch_record_from(u, need)
                 if doc is None:
                     continue
@@ -892,33 +1078,168 @@ class ConsensusReactor:
         ]
 
     def _probe_peer_heights(self) -> None:
-        """GET /consensus/status from each peer; note the max height seen
-        (feeds the same catch-up path inbound gossip does)."""
+        """Probe each peer's height via the lightweight GET
+        /consensus/height (one integer — pulling the full status document
+        with its telemetry/mempool/net blocks every step was pure waste);
+        peers predating the route fall back to /consensus/status. Feeds
+        the same catch-up path inbound gossip does."""
+        import urllib.error
+
         for u in self.peers:
             try:
-                st = self.net.get(u, "/consensus/status")
+                try:
+                    st = self.net.get(u, "/consensus/height")
+                except urllib.error.HTTPError:
+                    st = self.net.get(u, "/consensus/status")
                 self._note_height(int(st["height"]) + 1, u)
-            except (OSError, ValueError, KeyError):
+            except (OSError, ValueError, KeyError, TypeError):
                 continue
+
+    def _count_fetch_error(self, url: str, err: Exception) -> None:
+        """Blocksync fetch failures are counted + logged (never silently
+        folded into a False return): a dead record peer must be visible
+        in /metrics and /consensus/status. Cached breaker rejections are
+        NOT re-counted — the transport recorded the underlying failure
+        once, and a catch-up episode retries peers per height."""
+        from celestia_app_tpu.net.transport import BreakerOpen
+
+        if isinstance(err, BreakerOpen):
+            return
+        self.blocksync_fetch_errors += 1
+        telemetry.incr("reactor.blocksync_fetch_errors")
+        log.warning("blocksync fetch failed", node=self.vnode.name,
+                    peer=url, err=err)
 
     def _fetch_record_from(self, url: str, height: int) -> dict | None:
         try:
             doc = self.net.get(url, f"/gossip/commit_at?height={height}")
             return doc or None
-        except (OSError, ValueError):
+        except (OSError, ValueError) as e:
+            self._count_fetch_error(url, e)
             return None
 
+    # -- state sync (the joining half of the sync plane) -----------------
+
+    def _statesync_workdir(self) -> str | None:
+        import os
+
+        from celestia_app_tpu.chain import sync as sync_mod
+
+        home = sync_mod.home_for(self.vnode)
+        if home is None:
+            return None
+        return os.path.join(home, sync_mod.RESTORE_DIRNAME)
+
+    def _count_statesync_error(self, err: Exception) -> None:
+        self.statesync_errors += 1
+        telemetry.incr("reactor.statesync_errors")
+        log.warning("state sync failed", node=self.vnode.name, err=err)
+
+    def _state_sync(self, prefer: str) -> bool:
+        """Chunked, parallel, resumable state sync across ALL healthy
+        peers (chain/sync.StateSyncClient): discover the newest served
+        manifest, pull chunks concurrently with per-chunk verification
+        and durable resume, then adopt under the writer lock through the
+        unchanged app-hash-anchored state_sync_bootstrap. Peers without
+        the /sync/* routes fall back to the legacy one-shot pull."""
+        import tempfile
+
+        from celestia_app_tpu.chain import sync as sync_mod
+
+        workdir = self._statesync_workdir()
+        ephemeral = workdir is None
+        if ephemeral:  # in-memory node: no resume across restarts anyway
+            workdir = tempfile.mkdtemp(prefix="statesync-")
+        with self.service_lock:
+            floor = self.vnode.app.height
+        client = sync_mod.StateSyncClient(
+            self._peer_order(prefer), workdir, net=self.net,
+            workers=self.cfg.statesync_workers, min_height=floor,
+            name=self.vnode.name,
+        )
+        try:
+            manifest, chunks = client.fetch()
+        except sync_mod.StateSyncUnavailable as e:
+            # nothing chunked to join from: try the legacy one-shot
+            # endpoint peer-by-peer (pre-sync-plane peers serve it)
+            log.info("chunked state sync unavailable",
+                     node=self.vnode.name, err=e)
+            for u in self._peer_order(prefer):
+                if self._state_sync_from(u):
+                    return True
+            return False
+        except (OSError, ValueError) as e:
+            self._count_statesync_error(e)
+            return False
+        finally:
+            if ephemeral:
+                import shutil as shutil_mod
+
+                shutil_mod.rmtree(workdir, ignore_errors=True)
+        try:
+            with self.service_lock:
+                # re-check under the lock: commits may have advanced the
+                # chain past the manifest while chunks were downloading —
+                # adoption must never rewind the node
+                if int(manifest["height"]) <= self.vnode.app.height:
+                    raise ValueError(
+                        f"snapshot at {manifest['height']} no longer "
+                        f"ahead of height {self.vnode.app.height}"
+                    )
+                c.state_sync_bootstrap(self.vnode, manifest, chunks)
+                self._refresh_valset()  # synced state may carry new validators
+        except (ValueError, KeyError) as e:
+            # adoption failed (e.g. the manifest's app_hash lied about
+            # the reassembled store): the restore material is worthless —
+            # REMOVE it, or discover()'s in-progress preference would
+            # latch onto the same poisoned manifest on every retry
+            client.cleanup()
+            self._count_statesync_error(e)
+            return False
+        client.cleanup()
+        telemetry.incr("reactor.statesync_joins")
+        log.info("state sync adopted snapshot", node=self.vnode.name,
+                 height=manifest["height"],
+                 fetched=client.stats["fetched"],
+                 reused=client.stats["reused"])
+        return True
+
     def _state_sync_from(self, url: str) -> bool:
+        """Legacy one-shot pull (GET /consensus/snapshot, the pre-sync-
+        plane protocol) — kept as the fallback for peers that serve no
+        chunked snapshots; failures are counted, not swallowed. Our
+        height rides the ?min_height= query so a peer whose newest disk
+        snapshot is behind us serves a capture instead (a pre-query
+        server 404s the parameterized path; retry bare)."""
         import base64
+        import urllib.error
 
         try:
-            doc = self.net.get(url, "/consensus/snapshot", timeout=30)
+            floor = self.vnode.app.height
+            try:
+                doc = self.net.get(
+                    url, f"/consensus/snapshot?min_height={floor}",
+                    timeout=30,
+                )
+            except urllib.error.HTTPError:
+                doc = self.net.get(url, "/consensus/snapshot",
+                                   timeout=30)
             chunks = [base64.b64decode(ch) for ch in doc["chunks"]]
             with self.service_lock:
+                # the legacy endpoint now serves DISK snapshots, which
+                # can be OLDER than this node's tip (the capture-on-
+                # request original was always the peer's current height):
+                # adopting one would REWIND the chain. Refuse stale.
+                if int(doc["manifest"]["height"]) <= self.vnode.app.height:
+                    raise ValueError(
+                        f"peer snapshot at {doc['manifest']['height']} "
+                        f"is not ahead of height {self.vnode.app.height}"
+                    )
                 c.state_sync_bootstrap(self.vnode, doc["manifest"], chunks)
                 self._refresh_valset()  # the synced state may carry new validators
             return True
-        except (OSError, ValueError, KeyError):
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            self._count_statesync_error(e)
             return False
 
     def _step_traced(self) -> bool:

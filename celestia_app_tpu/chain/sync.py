@@ -1,0 +1,601 @@
+"""The sync plane: chunked, parallel, resumable state sync + snapshot store.
+
+Reference parity: the Cosmos-SDK snapshot store + celestia-core state sync
+(SURVEY L6). A node serving state sync writes interval snapshots to disk
+(``<home>/snapshots/<height>/``: deterministic key-ranged chunks + a
+manifest committing to every chunk's sha256 and the final app hash) and
+serves them FROM DISK — never capture-on-request, never under the service
+lock. A joining node discovers manifests across its peers, pulls chunks
+concurrently from every healthy peer (chunks are content-addressed by the
+manifest, so any peer serving the same snapshot can serve any chunk),
+verifies each chunk on arrival, persists progress with the
+``das/checkpoint.py`` fsync discipline so a crash mid-restore RESUMES
+instead of restarting, and hands the completed chunk set to
+``consensus.state_sync_bootstrap`` — whose app-hash-anchored manifest
+verification is unchanged and remains the adoption gate.
+
+Layout (shared with ``cli.py snapshot create`` and the ``start`` loop):
+
+    <home>/snapshots/<height>/chunk_000000.json ... manifest.json
+    <home>/statesync/<manifest-digest>/          in-progress restore
+        manifest.json                            the resume checkpoint
+        chunk_000000 ...                         verified chunks (fsync'd)
+
+The manifest is written LAST (and restore state is keyed by the manifest
+digest), so a half-written snapshot is never restorable and a restore can
+never mix chunks from two different snapshots.
+
+HTTP surface (both the node service and the validator service):
+
+    GET /sync/snapshots           {"snapshots": [manifest, ...]} newest
+                                  restorable first
+    GET /sync/chunk?height=&index= raw chunk bytes (octet-stream, not b64)
+
+Fault points: ``statesync.mid_restore`` fires after each chunk is durably
+persisted (a crash there must resume, re-fetching only missing chunks);
+``statesync.pre_adopt`` fires once every chunk is verified, before the
+bootstrap adoption. docs/DESIGN.md "The sync plane" and docs/FORMATS.md
+§15 are the normative descriptions.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import threading
+
+from celestia_app_tpu import faults
+from celestia_app_tpu import obs
+from celestia_app_tpu.utils import telemetry
+
+log = obs.get_logger("chain.sync")
+
+SNAPSHOT_DIRNAME = "snapshots"
+RESTORE_DIRNAME = "statesync"
+
+# manifest fields a served snapshot must carry to be restorable (the
+# consensus.encode_app_snapshot output shape; FORMATS §15.1)
+MANIFEST_FIELDS = (
+    "height", "app_hash", "app_version", "chain_id", "genesis_time",
+    "last_block_hash", "n_chunks", "chunk_hashes",
+)
+
+
+class SyncError(ValueError):
+    """Client-side problem on the /sync/* surface (bad params, nothing
+    served); the HTTP services map "not served"/"no such" to 404."""
+
+
+class StateSyncUnavailable(OSError):
+    """No peer serves a restorable snapshot above the requested floor."""
+
+
+def manifest_digest(manifest: dict) -> str:
+    """Content address of a snapshot: sha256 over the canonical (sorted-
+    key) JSON encoding of its manifest. Keys restore progress on disk, so
+    two peers serving byte-identical snapshots share one restore."""
+    return hashlib.sha256(
+        json.dumps(manifest, sort_keys=True).encode()
+    ).hexdigest()
+
+
+def _manifest_ok(m) -> bool:
+    if not isinstance(m, dict):
+        return False
+    if any(k not in m for k in MANIFEST_FIELDS):
+        return False
+    return (isinstance(m["chunk_hashes"], list)
+            and len(m["chunk_hashes"]) == m["n_chunks"])
+
+
+def home_for(node_or_app) -> str | None:
+    """The --home directory a node's durable state lives under (data is
+    ``<home>/data``), or None for an in-memory node."""
+    app = getattr(node_or_app, "app", node_or_app)
+    db = getattr(app, "db", None)
+    if db is None:
+        return None
+    return os.path.dirname(os.path.abspath(db.dir))
+
+
+def store_for(node_or_app) -> "SnapshotStore | None":
+    home = home_for(node_or_app)
+    if home is None:
+        return None
+    return SnapshotStore(os.path.join(home, SNAPSHOT_DIRNAME))
+
+
+def write_snapshot_dir(manifest: dict, chunks: list[bytes],
+                       out_dir: str) -> None:
+    """Persist one snapshot's chunks + manifest into ``out_dir``. The
+    manifest is written last and fsync'd, so a crash mid-write leaves a
+    dir that is never listed as restorable (and gets pruned)."""
+    os.makedirs(out_dir, exist_ok=True)
+    for i, chunk in enumerate(chunks):
+        with open(os.path.join(out_dir, f"chunk_{i:06d}.json"), "wb") as f:
+            f.write(chunk)
+            f.flush()
+            os.fsync(f.fileno())
+    tmp = os.path.join(out_dir, "manifest.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(out_dir, "manifest.json"))
+
+
+def prune_snapshots(root: str, keep: int) -> None:
+    """Keep only the newest ``keep`` RESTORABLE snapshot dirs (the sdk's
+    snapshot-keep-recent semantics; 0 = keep everything). A half-written
+    dir (no manifest.json — a crash mid-write) is deleted outright and
+    never counts toward the kept set."""
+    if keep <= 0 or not os.path.isdir(root):
+        return
+    complete: list[int] = []
+    for name in sorted(os.listdir(root)):
+        path = os.path.join(root, name)
+        if not os.path.isdir(path) or not name.isdigit():
+            continue
+        if os.path.exists(os.path.join(path, "manifest.json")):
+            complete.append(int(name))
+        else:
+            shutil.rmtree(path, ignore_errors=True)
+    for h in sorted(complete, reverse=True)[keep:]:
+        shutil.rmtree(os.path.join(root, str(h)), ignore_errors=True)
+
+
+class SnapshotStore:
+    """The on-disk snapshot set one node SERVES (``<home>/snapshots``).
+    Read paths touch only the filesystem — serving a manifest or a chunk
+    never takes the node's service lock and never captures state."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def heights(self) -> list[int]:
+        """Restorable snapshot heights, newest first."""
+        if not os.path.isdir(self.root):
+            return []
+        out = []
+        for name in os.listdir(self.root):
+            if not name.isdigit():
+                continue
+            if os.path.exists(
+                os.path.join(self.root, name, "manifest.json")
+            ):
+                out.append(int(name))
+        return sorted(out, reverse=True)
+
+    def manifest(self, height: int) -> dict | None:
+        path = os.path.join(self.root, str(height), "manifest.json")
+        try:
+            with open(path) as f:
+                m = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return m if _manifest_ok(m) else None
+
+    def manifests(self) -> list[dict]:
+        """Every restorable manifest, newest first — the /sync/snapshots
+        body. Unreadable/invalid dirs are skipped (and logged), never
+        fatal to serving."""
+        out = []
+        for h in self.heights():
+            m = self.manifest(h)
+            if m is None:
+                log.warning("unreadable snapshot skipped",
+                            root=self.root, height=h)
+                telemetry.incr("sync.bad_snapshot_dirs")
+                continue
+            out.append(m)
+        return out
+
+    def newest(self) -> dict | None:
+        ms = self.manifests()
+        return ms[0] if ms else None
+
+    def chunk(self, height: int, index: int) -> bytes:
+        """Raw chunk bytes from disk. Raises SyncError('... not served')
+        when the snapshot/chunk does not exist (the services map that to
+        404)."""
+        m = self.manifest(height)
+        if m is None:
+            raise SyncError(f"snapshot {height} not served")
+        if not 0 <= index < m["n_chunks"]:
+            raise SyncError(
+                f"chunk index {index} out of range (n_chunks "
+                f"{m['n_chunks']})"
+            )
+        path = os.path.join(self.root, str(height), f"chunk_{index:06d}.json")
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except OSError:
+            raise SyncError(
+                f"chunk {height}/{index} not served"
+            ) from None
+
+    def chunks(self, height: int) -> list[bytes]:
+        m = self.manifest(height)
+        if m is None:
+            raise SyncError(f"snapshot {height} not served")
+        return [self.chunk(height, i) for i in range(m["n_chunks"])]
+
+    def write(self, manifest: dict, chunks: list[bytes]) -> None:
+        write_snapshot_dir(
+            manifest, chunks,
+            os.path.join(self.root, str(manifest["height"])),
+        )
+
+    def prune(self, keep: int) -> None:
+        prune_snapshots(self.root, keep)
+
+
+def maybe_snapshot(app, service_lock, store: SnapshotStore | None,
+                   interval: int, keep: int, height: int) -> dict | None:
+    """The post-commit interval-snapshot hook (default_overrides.go:
+    294-297: interval 1500, keep 2). Only the state CAPTURE holds the
+    service lock; chunk encoding, disk writes, and pruning run outside
+    it. Snapshots are auxiliary: any failure is counted + logged, never
+    fatal to the commit path. Returns the manifest written, or None."""
+    if store is None or interval <= 0 or height % interval != 0:
+        return None
+    from celestia_app_tpu.chain import consensus as c
+
+    try:
+        with service_lock:
+            cap = c.capture_app_snapshot(app)
+        manifest, chunks = c.encode_app_snapshot(cap)
+        store.write(manifest, chunks)
+        store.prune(keep)
+        telemetry.incr("sync.snapshots_written")
+        return manifest
+    except Exception as e:
+        telemetry.incr("sync.snapshot_write_errors")
+        log.error("interval snapshot failed", height=height, err=e)
+        return None
+
+
+def route_sync(store: SnapshotStore | None, path: str, query: dict):
+    """Dispatch a GET /sync/* request (one router shared by the node HTTP
+    service and the validator consensus service). Returns a JSON-able
+    dict, or raw ``bytes`` for /sync/chunk (the handler sends those as
+    application/octet-stream). Raises SyncError on client mistakes; the
+    services map messages containing "not served" to 404."""
+    if path == "/sync/snapshots":
+        if store is None:
+            return {"snapshots": []}
+        return {"snapshots": store.manifests()}
+    if path == "/sync/chunk":
+        if store is None:
+            raise SyncError("state sync not served")
+        try:
+            height = int(query.get("height", ["0"])[0])
+            index = int(query.get("index", ["0"])[0])
+        except (TypeError, ValueError):
+            raise SyncError("height and index must be integers") from None
+        return store.chunk(height, index)
+    raise SyncError(f"no sync route {path}")
+
+
+# ---------------------------------------------------------------------------
+# the joiner: discovery + parallel, resumable chunk fetch
+# ---------------------------------------------------------------------------
+
+
+class StateSyncClient:
+    """Fetch one snapshot's chunks from many peers, in parallel, resumably.
+
+    Peers serve manifests at GET /sync/snapshots; the client picks the
+    newest height above ``min_height`` (among peers serving the same
+    height, the manifest more peers agree on wins — adoption still
+    verifies the app hash, so a majority of liars only wastes fetches).
+    Chunks land under ``workdir/<manifest-digest>/`` one fsync'd file
+    each, so a crashed restore resumes by re-verifying what is already
+    on disk and fetching ONLY the missing chunks. A chunk whose sha256
+    does not match the manifest is re-fetched from the next peer and the
+    serving peer is penalized on the shared PeerClient health score
+    (``net.penalize``) — its breaker eventually opens and the fetchers
+    skip it entirely.
+    """
+
+    def __init__(self, peers: list[str], workdir: str, net=None,
+                 workers: int = 4, min_height: int = 0,
+                 name: str = "statesync"):
+        from celestia_app_tpu.net.transport import PeerClient
+
+        self.peers = [u.rstrip("/") for u in peers if u]
+        self.workdir = workdir
+        self.net = net if net is not None else PeerClient(name=name)
+        self.workers = max(1, int(workers))
+        self.min_height = int(min_height)
+        self._lock = threading.Lock()
+        # the shared chunk table the fetcher threads coordinate through
+        self._queue: list[int] = []       # guarded-by: _lock
+        self._have: set[int] = set()      # guarded-by: _lock
+        self._errors: list[str] = []      # guarded-by: _lock
+        self.stats = {                    # guarded-by: _lock
+            "fetched": 0,     # chunks pulled over the network this run
+            "reused": 0,      # chunks already on disk (verified) at start
+            "bad_chunks": 0,  # hash-mismatched arrivals (re-fetched)
+            "peers": 0,
+        }
+        self._root: str | None = None  # set by fetch()
+
+    # -- discovery --------------------------------------------------------
+
+    def discover(self) -> tuple[dict, list[str]]:
+        """(manifest, serving peers) for the newest restorable snapshot
+        above min_height. Raises StateSyncUnavailable when no peer
+        serves one."""
+        by_key: dict[tuple[int, str], tuple[dict, list[str]]] = {}
+        last_err = "no peers"
+        for u in self.peers:
+            if not self.net.available(u):
+                continue
+            try:
+                doc = self.net.get(u, "/sync/snapshots")
+            except (OSError, ValueError) as e:
+                last_err = f"{u}: {type(e).__name__}: {e}"
+                continue
+            for m in (doc.get("snapshots") or []):
+                if not _manifest_ok(m):
+                    continue
+                h = int(m["height"])
+                if h <= self.min_height:
+                    continue
+                key = (h, manifest_digest(m))
+                if key not in by_key:
+                    by_key[key] = (m, [])
+                by_key[key][1].append(u)
+        if not by_key:
+            raise StateSyncUnavailable(
+                f"no restorable snapshot above height {self.min_height} "
+                f"({last_err})"
+            )
+        # resume preference: an IN-PROGRESS restore pins its manifest as
+        # long as any peer still serves it — on a busy chain the newest
+        # snapshot moves every interval, and chasing it would turn every
+        # crash into a from-scratch restart (the checkpoint would never
+        # be reused). A finished restore removes its workdir (cleanup),
+        # so this only ever latches genuinely interrupted syncs.
+        in_progress = [
+            kv for kv in by_key.items()
+            if os.path.exists(os.path.join(self.workdir, kv[0][1],
+                                           "manifest.json"))
+        ]
+        pool = in_progress or list(by_key.items())
+        # newest height first; among equals, the most-replicated manifest
+        (_h, _d), (manifest, sources) = max(
+            pool, key=lambda kv: (kv[0][0], len(kv[1][1])),
+        )
+        return manifest, sources
+
+    # -- the restore state machine ---------------------------------------
+
+    def fetch(self) -> tuple[dict, list[bytes]]:
+        """Discover, then pull every missing chunk concurrently across
+        the serving peers; returns (manifest, chunks) ready for
+        ``consensus.state_sync_bootstrap``. Fires
+        ``statesync.mid_restore`` after each durable chunk write and
+        ``statesync.pre_adopt`` once the set is complete."""
+        manifest, sources = self.discover()
+        digest = manifest_digest(manifest)
+        root = os.path.join(self.workdir, digest)
+        self._root = root
+        os.makedirs(root, exist_ok=True)
+        # superseded restores (a crashed sync whose manifest no peer
+        # serves any more — discover() would have preferred it otherwise)
+        # are dead weight up to a full snapshot each: prune them now that
+        # this restore is committed to `digest`
+        for name in sorted(os.listdir(self.workdir)):
+            if name != digest and os.path.isdir(
+                os.path.join(self.workdir, name)
+            ):
+                shutil.rmtree(os.path.join(self.workdir, name),
+                              ignore_errors=True)
+        self._save_checkpoint(root, manifest)
+        n = int(manifest["n_chunks"])
+        height = int(manifest["height"])
+
+        missing = self._scan_existing(root, manifest)
+        reused = n - len(missing)
+        with self._lock:
+            self._queue = list(missing)
+            self._have = set(range(n)) - set(missing)
+            self.stats["reused"] = reused
+            self.stats["peers"] = len(sources)
+            self._errors = []
+        if reused:
+            log.info("state sync resumed from checkpoint",
+                     height=height, reused=reused,
+                     missing=len(missing))
+            telemetry.incr("sync.restores_resumed")
+
+        if missing:
+            threads = [
+                threading.Thread(
+                    target=self._worker,
+                    args=(root, manifest, sources, w),
+                    daemon=True,
+                )
+                for w in range(min(self.workers, len(missing)))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        with self._lock:
+            have = len(self._have)
+            err = self._errors[-1] if self._errors else None
+        if have != n:
+            telemetry.incr("sync.restore_failures")
+            raise StateSyncUnavailable(
+                f"restore incomplete ({have}/{n} chunks): "
+                f"{err or 'peers exhausted'}"
+            )
+        chunks = []
+        for i in range(n):
+            with open(os.path.join(root, f"chunk_{i:06d}"), "rb") as f:
+                chunks.append(f.read())
+        # crash point: every chunk durable + verified, snapshot NOT yet
+        # adopted — a restart must reuse the full set (fetched == 0)
+        action = faults.fire("statesync.pre_adopt", height=height)
+        if action in ("drop", "error"):
+            raise StateSyncUnavailable(
+                "injected fault: statesync.pre_adopt"
+            )
+        return manifest, chunks
+
+    def cleanup(self) -> None:
+        """Drop the restore workdir after a successful adoption."""
+        if self._root is not None:
+            shutil.rmtree(self._root, ignore_errors=True)
+
+    def _save_checkpoint(self, root: str, manifest: dict) -> None:
+        """The resume record (das/checkpoint.py discipline: tmp + fsync +
+        replace): the manifest this restore is pinned to. Chunk files are
+        their own progress record — content-verified on resume — so the
+        checkpoint never over-claims."""
+        path = os.path.join(root, "manifest.json")
+        if os.path.exists(path):
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _scan_existing(self, root: str, manifest: dict) -> list[int]:
+        """Resume: verify every chunk file already on disk against the
+        manifest hash; corrupt/torn files are dropped and re-fetched.
+        Returns the missing indices."""
+        missing = []
+        for i in range(int(manifest["n_chunks"])):
+            path = os.path.join(root, f"chunk_{i:06d}")
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                missing.append(i)
+                continue
+            if (hashlib.sha256(data).hexdigest()
+                    != manifest["chunk_hashes"][i]):
+                os.unlink(path)
+                missing.append(i)
+        return missing
+
+    def _worker(self, root: str, manifest: dict, sources: list[str],
+                offset: int) -> None:
+        """One fetcher: drain the shared queue, trying peers round-robin
+        from a per-worker offset (so concurrent workers spread across the
+        peer set), verify-on-arrival, persist durably, fire the
+        mid-restore crash point."""
+        height = int(manifest["height"])
+        while True:
+            with self._lock:
+                if self._errors:
+                    return  # a sibling aborted: stop cleanly
+                if not self._queue:
+                    return
+                index = self._queue.pop(0)
+            data = self._fetch_chunk(manifest, sources, index, offset)
+            if data is None:
+                with self._lock:
+                    self._errors.append(
+                        f"chunk {index}: no peer served a valid copy"
+                    )
+                return
+            path = os.path.join(root, f"chunk_{index:06d}")
+            tmp = f"{path}.tmp.{offset}"
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            with self._lock:
+                self._have.add(index)
+                self.stats["fetched"] += 1
+            telemetry.incr("sync.chunks_fetched")
+            # crash point: THIS chunk is durable, others may not be — a
+            # killed joiner must resume, re-fetching only what's missing
+            action = faults.fire("statesync.mid_restore",
+                                 height=height, index=index)
+            if action in ("drop", "error"):
+                with self._lock:
+                    self._errors.append(
+                        "injected fault: statesync.mid_restore"
+                    )
+                return
+
+    def _fetch_chunk(self, manifest: dict, sources: list[str], index: int,
+                     offset: int) -> bytes | None:
+        """Pull one chunk, rotating across the serving peers; a
+        hash-mismatched body penalizes the peer on the shared health
+        score and falls through to the next one."""
+        height = int(manifest["height"])
+        want = manifest["chunk_hashes"][index]
+        order = sources[offset % len(sources):] + \
+            sources[:offset % len(sources)]
+        for u in order:
+            if not self.net.available(u):
+                continue
+            try:
+                data = self.net.get(
+                    u, f"/sync/chunk?height={height}&index={index}",
+                    raw=True,
+                )
+            except (OSError, ValueError) as e:
+                # transient peer failure: the transport's health score
+                # already recorded it; rotate to the next source
+                telemetry.incr("sync.chunk_fetch_errors")
+                log.warning("chunk fetch failed", peer=u, index=index,
+                            err=e)
+                continue
+            if hashlib.sha256(data).hexdigest() != want:
+                # content-hash mismatch: a corrupt (or lying) peer —
+                # count it against its health score so the breaker
+                # eventually skips it, and try the next peer
+                self.net.penalize(u, f"bad chunk {height}/{index}")
+                telemetry.incr("sync.bad_chunks")
+                with self._lock:
+                    self.stats["bad_chunks"] += 1
+                continue
+            return data
+        return None
+
+
+def legacy_snapshot_doc(vnode_or_app, store: SnapshotStore | None,
+                        service_lock=None, min_height: int = 0) -> dict:
+    """The legacy one-shot ``GET /consensus/snapshot`` body, now a thin
+    adapter over the chunked plane: the newest restorable DISK snapshot
+    when the node serves one (no capture, no lock), else the pre-sync-
+    plane capture-on-request (under the service lock) so fresh chains
+    and existing callers keep working. ``min_height`` (the
+    ``?min_height=`` query) is the PULLER's current height: a node
+    already past every disk snapshot gets a capture — the original
+    endpoint always served the tip, and a joiner whose height sits
+    between the newest snapshot and the tip must not be stranded.
+    Deprecated — FORMATS §15.4."""
+    import base64
+
+    from celestia_app_tpu.chain import consensus as c
+
+    app = getattr(vnode_or_app, "app", vnode_or_app)
+    manifest = store.newest() if store is not None else None
+    if manifest is not None and int(manifest["height"]) > min_height:
+        chunks = store.chunks(int(manifest["height"]))
+    else:
+        if service_lock is not None:
+            with service_lock:
+                cap = c.capture_app_snapshot(app)
+        else:
+            cap = c.capture_app_snapshot(app)
+        manifest, chunks = c.encode_app_snapshot(cap)
+    return {
+        "manifest": manifest,
+        "chunks": [base64.b64encode(ch).decode() for ch in chunks],
+    }

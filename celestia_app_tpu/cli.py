@@ -1077,13 +1077,24 @@ def cmd_validator_serve(args) -> int:
                 return
             with open(peers_path) as f:
                 peers = json.load(f)
-            cfg = None
+            from celestia_app_tpu.chain.reactor import ReactorConfig
+
+            cfg_doc = {}
             cfg_path = os.path.join(args.home, "reactor.json")
             if os.path.exists(cfg_path):
-                from celestia_app_tpu.chain.reactor import ReactorConfig
-
                 with open(cfg_path) as f:
-                    cfg = ReactorConfig(**json.load(f))
+                    cfg_doc = json.load(f)
+            # sync plane: the home config's snapshot knobs (the same keys
+            # cmd_start reads) feed the reactor's interval-snapshot hook;
+            # an explicit reactor.json entry wins
+            if "snapshot_interval" not in cfg_doc and \
+                    "snapshot_interval_blocks" in home_cfg:
+                cfg_doc["snapshot_interval"] = \
+                    home_cfg["snapshot_interval_blocks"]
+            if "snapshot_keep" not in cfg_doc and \
+                    "snapshot_keep_recent" in home_cfg:
+                cfg_doc["snapshot_keep"] = home_cfg["snapshot_keep_recent"]
+            cfg = ReactorConfig(**cfg_doc)
             svc.attach_reactor([u for u in peers if u !=
                                 f"http://127.0.0.1:{svc.port}"], cfg)
             print(f"{vnode.name}: autonomous reactor up "
@@ -1497,14 +1508,13 @@ def cmd_devnet(args) -> int:
 
 
 def _write_snapshot_files(manifest: dict, chunks: list, out_dir: str) -> None:
-    """Persist already-captured snapshot chunks + manifest (manifest last,
-    so a half-written snapshot is never restorable)."""
-    os.makedirs(out_dir, exist_ok=True)
-    for i, c in enumerate(chunks):
-        with open(os.path.join(out_dir, f"chunk_{i:06d}.json"), "wb") as f:
-            f.write(c)
-    with open(os.path.join(out_dir, "manifest.json"), "w") as f:
-        json.dump(manifest, f, indent=2)
+    """Persist already-captured snapshot chunks + manifest — ONE writer
+    (chain/sync.write_snapshot_dir, manifest last + fsync'd, so a
+    half-written snapshot is never restorable) shared with the sync
+    plane's interval-snapshot hook and the /sync/* serving store."""
+    from celestia_app_tpu.chain import sync as sync_mod
+
+    sync_mod.write_snapshot_dir(manifest, chunks, out_dir)
 
 
 def _write_snapshot(app, out_dir: str) -> dict:
@@ -1519,26 +1529,12 @@ def _write_snapshot(app, out_dir: str) -> dict:
 
 
 def _prune_snapshots(root: str, keep: int) -> None:
-    """Keep only the newest `keep` RESTORABLE snapshot dirs
-    (default_overrides.go:294-297 keep-recent; 0 = keep everything, the
-    sdk's snapshot-keep-recent semantics). A half-written dir (no
-    manifest.json — a crash mid-write) is deleted outright and never
-    counts toward the kept set, so it can't displace the last restorable
-    snapshot."""
-    import shutil
+    """Keep-recent pruning, delegated to the sync plane's ONE
+    implementation (chain/sync.prune_snapshots; default_overrides.go:
+    294-297 semantics, 0 = keep everything)."""
+    from celestia_app_tpu.chain import sync as sync_mod
 
-    if keep <= 0 or not os.path.isdir(root):
-        return
-    complete = []
-    for name in os.listdir(root):
-        if not name.isdigit():
-            continue
-        if os.path.exists(os.path.join(root, name, "manifest.json")):
-            complete.append(int(name))
-        else:
-            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
-    for h in sorted(complete, reverse=True)[keep:]:
-        shutil.rmtree(os.path.join(root, str(h)), ignore_errors=True)
+    sync_mod.prune_snapshots(root, keep)
 
 
 def cmd_snapshot(args) -> int:

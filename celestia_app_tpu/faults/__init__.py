@@ -51,6 +51,13 @@ The fault-point catalog (the names production code fires today):
   das.serve_sample              das/server.py withholding hook (ctx:
                                 height, row, col) — the env-armable twin
                                 of SampleCore.withhold()
+  statesync.mid_restore         chain/sync.py, after EACH state-sync
+                                chunk is durably persisted (ctx: height,
+                                index) — a crash here must RESUME,
+                                re-fetching only the missing chunks
+  statesync.pre_adopt           chain/sync.py, every chunk verified but
+                                the snapshot NOT yet adopted (ctx:
+                                height) — a restart reuses the full set
 
 docs/DESIGN.md "The fault plane" and docs/FORMATS.md §9 are the normative
 descriptions of the catalog and the /faults/* admin surface.

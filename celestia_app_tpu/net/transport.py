@@ -297,6 +297,17 @@ class PeerClient:
     def post(self, url: str, path: str, payload: dict, **kw):
         return self.request(url, path, payload, **kw)
 
+    def penalize(self, url: str, reason: str) -> None:
+        """Application-level failure report: the peer ANSWERED, but with
+        content that failed verification (e.g. a state-sync chunk whose
+        sha256 mismatched its manifest). Feeds the peer's health score
+        and consecutive-failure streak exactly like a transport failure,
+        so a corrupt-serving peer is deprioritized and — past the
+        failure threshold — breaker-skipped entirely."""
+        self._record_failure(url.rstrip("/"), f"penalized: {reason[:160]}",
+                             False)
+        telemetry.incr("net.penalized")
+
     # -- health surface ---------------------------------------------------
 
     def snapshot(self) -> dict:

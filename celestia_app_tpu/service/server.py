@@ -19,6 +19,8 @@ Endpoints:
   POST /da/prove_shares  {...}         share-range proof (§7.1.7 shim)
   GET  /das/head | /das/header | /das/sample | /das/availability
   POST /das/samples                    DAS sample serving (das/server.py)
+  GET  /sync/snapshots                 state-sync manifests, newest first
+  GET  /sync/chunk?height=&index=      raw snapshot chunk bytes (§15)
   GET  /faults                         fault-plane admin (armed + fired)
   POST /faults/arm|disarm|reset        arm/disarm fault points (chaos)
   GET  /metrics                        Prometheus text exposition (§10)
@@ -69,6 +71,12 @@ class NodeService:
         # ProverWarmer -> seed_cache_entry), so the first /das/sample
         # after a commit is index arithmetic — no rebuild, no re-extend
         node.app.add_da_seed_listener(self.das_core.seed_cache_entry)
+        # sync plane: serve the interval snapshots the start loop writes
+        # to <home>/snapshots (chain/sync.py) — straight from disk, never
+        # a capture, never under the service lock
+        from celestia_app_tpu.chain import sync as sync_mod
+
+        self.sync_store = sync_mod.store_for(node)
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -79,6 +87,15 @@ class NodeService:
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_raw(self, code: int, body: bytes) -> None:
+                # /sync/chunk serves raw bytes (octet-stream, NOT base64)
+                self.send_response(code)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -143,6 +160,27 @@ class NodeService:
                         except SampleError as e:
                             self._send(404 if "not served" in str(e)
                                        else 400, {"error": str(e)})
+                    elif self.path.startswith("/sync/"):
+                        # chunked state-sync serving (chain/sync.py):
+                        # manifests + raw chunks from disk, lock-free
+                        from urllib.parse import parse_qs, urlparse
+
+                        from celestia_app_tpu.chain import sync as sync_mod
+
+                        parsed = urlparse(self.path)
+                        try:
+                            out = sync_mod.route_sync(
+                                service.sync_store, parsed.path,
+                                parse_qs(parsed.query),
+                            )
+                        except sync_mod.SyncError as e:
+                            self._send(404 if "not served" in str(e)
+                                       else 400, {"error": str(e)})
+                            return
+                        if isinstance(out, bytes):
+                            self._send_raw(200, out)
+                        else:
+                            self._send(200, out)
                     elif self.path == "/faults":
                         # fault-plane admin (celestia_app_tpu/faults):
                         # armed specs + per-point fire counts

@@ -14,15 +14,21 @@ certificate against its own genesis pubkeys + staking powers
 (`ValidatorNode.verify_certificate`) before applying — the orchestrator is
 a scheduler, not a trusted party (a forged /consensus/commit is refused).
 
-Routes (all JSON):
+Routes (all JSON unless noted):
   GET  /consensus/status            {name, height, app_hash, chain_id, mempool}
+  GET  /consensus/height            {height} — the lightweight probe
   POST /broadcast_tx {tx: b64}      CheckTx + mempool admission
   POST /consensus/propose {time}    -> {block}    (PrepareProposal or lock)
   POST /consensus/prevote {block}   -> {vote}     (ProcessProposal inside)
   POST /consensus/precommit {block?, polka, round} -> {vote}  (lock if polka)
   POST /consensus/commit {block, cert, evidence} -> {app_hash}
-  GET  /consensus/snapshot          {manifest, chunks: [b64]} (state sync)
-  POST /consensus/sync {peer}       pull + verify a peer's snapshot
+
+Sync plane (chain/sync.py; docs/FORMATS.md §15):
+  GET  /sync/snapshots              {snapshots: [manifest,...]} newest first
+  GET  /sync/chunk?height=&index=   raw chunk bytes (octet-stream)
+  GET  /gossip/commits?from=&to=    {commits: [...]} batched blocksync
+  GET  /consensus/snapshot          DEPRECATED one-shot adapter (§15.4)
+  POST /consensus/sync {peer}       DEPRECATED orchestrated pull adapter
 
 Autonomous (gossip) mode adds the peer-to-peer plane consumed by
 chain/reactor.py — these routes deliberately BYPASS the big writer lock
@@ -62,6 +68,7 @@ from __future__ import annotations
 
 import base64
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -85,6 +92,13 @@ class ValidatorService:
 
         self.das_core = SampleCore(vnode.app, app_lock=self.lock)
         vnode.app.add_da_seed_listener(self.das_core.seed_cache_entry)
+        # sync plane: the snapshot set this process serves for chunked
+        # state sync (<home>/snapshots, written by the reactor's interval
+        # hook / the CLI start loop); None for in-memory nodes — /sync/*
+        # then serves an empty manifest list and 404s chunks
+        from celestia_app_tpu.chain import sync as sync_mod
+
+        self.sync_store = sync_mod.store_for(vnode)
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -95,6 +109,16 @@ class ValidatorService:
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_raw(self, code: int, body: bytes) -> None:
+                # /sync/chunk serves raw bytes (octet-stream, NOT base64):
+                # chunk transfers must not pay the 4/3 b64 inflation
+                self.send_response(code)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -120,6 +144,34 @@ class ValidatorService:
                     if self.path == "/consensus/status":
                         with service.lock:
                             self._send(200, service._status())
+                    elif self.path == "/consensus/height":
+                        # the lightweight height probe (sync plane): one
+                        # integer, no lock, no telemetry/mempool/net
+                        # blocks — what reactor._probe_peer_heights polls
+                        self._send(200,
+                                   {"height": service.vnode.app.height})
+                    elif self.path.startswith("/sync/"):
+                        # chunked state-sync serving (chain/sync.py):
+                        # manifests + raw chunks straight from disk —
+                        # never a capture, never under the service lock
+                        from urllib.parse import parse_qs, urlparse
+
+                        from celestia_app_tpu.chain import sync as sync_mod
+
+                        parsed = urlparse(self.path)
+                        try:
+                            out = sync_mod.route_sync(
+                                service.sync_store, parsed.path,
+                                parse_qs(parsed.query),
+                            )
+                        except sync_mod.SyncError as e:
+                            self._send(404 if "not served" in str(e)
+                                       else 400, {"error": str(e)})
+                            return
+                        if isinstance(out, bytes):
+                            self._send_raw(200, out)
+                        else:
+                            self._send(200, out)
                     elif self.path == "/metrics":
                         # Prometheus text exposition — validator
                         # processes were invisible to scrapers before
@@ -152,6 +204,22 @@ class ValidatorService:
                         q = parse_qs(urlparse(self.path).query)
                         h = int(q.get("height", ["0"])[0])
                         self._send(200, service.reactor.commit_at(h) or {})
+                    elif self.path.startswith("/gossip/commits"):
+                        # batched blocksync serving (sync plane): one
+                        # response carries a whole verification window of
+                        # commit records, bytes-capped by the reactor
+                        from urllib.parse import parse_qs, urlparse
+
+                        if service.reactor is None:
+                            self._send(404, {"error": "not autonomous"})
+                            return
+                        q = parse_qs(urlparse(self.path).query)
+                        lo = int(q.get("from", ["0"])[0])
+                        hi = int(q.get("to", ["0"])[0])
+                        self._send(200, {
+                            "commits":
+                                service.reactor.commits_range(lo, hi),
+                        })
                     elif self.path.startswith("/gossip/want_tx"):
                         # WantTx pull: serve tx content for an announced
                         # hash (the Tx delivery of the want/have protocol)
@@ -191,15 +259,25 @@ class ValidatorService:
                         except SampleError as e:
                             self._send(404 if "not served" in str(e)
                                        else 400, {"error": str(e)})
-                    elif self.path == "/consensus/snapshot":
-                        with service.lock:
-                            manifest, chunks = service.vnode.snapshot_chunks()
-                        self._send(200, {
-                            "manifest": manifest,
-                            "chunks": [
-                                base64.b64encode(ch).decode() for ch in chunks
-                            ],
-                        })
+                    elif self.path.split("?", 1)[0] \
+                            == "/consensus/snapshot":
+                        # DEPRECATED one-shot pull (FORMATS §15.4), now a
+                        # thin adapter over the chunked plane: the newest
+                        # restorable disk snapshot ahead of the puller's
+                        # ?min_height= (no capture, no lock), else the
+                        # legacy capture-on-request so fresh chains and
+                        # already-ahead pullers keep bootstrapping
+                        from urllib.parse import parse_qs, urlparse
+
+                        from celestia_app_tpu.chain import sync as sync_mod
+
+                        q = parse_qs(urlparse(self.path).query)
+                        self._send(200, sync_mod.legacy_snapshot_doc(
+                            service.vnode, service.sync_store,
+                            service_lock=service.lock,
+                            min_height=int(
+                                q.get("min_height", ["0"])[0]),
+                        ))
                     else:
                         self._send(404, {"error": f"no route {self.path}"})
                 except Exception as e:
@@ -323,6 +401,11 @@ class ValidatorService:
                 "step": self.reactor.step,
                 "height_view": self.reactor.height_view,
                 "loop_errors": self.reactor.loop_errors,
+                # sync-plane failure visibility: a dead snapshot peer or
+                # failing record fetches show up HERE, not as silence
+                "statesync_errors": self.reactor.statesync_errors,
+                "blocksync_fetch_errors":
+                    self.reactor.blocksync_fetch_errors,
             }
             out["mempool_gossip"] = dict(self.reactor.mempool_gossip.stats)
             # per-peer transport health: breaker state, success/failure
@@ -435,16 +518,65 @@ class ValidatorService:
         return {"app_hash": app_hash.hex(), "height": self.vnode.app.height}
 
     def _sync(self, p: dict) -> dict:
-        """State-sync catch-up over the wire: pull a peer's snapshot and
-        adopt it after chunk-hash + app-hash verification."""
+        """State-sync catch-up over the wire (DEPRECATED orchestrated
+        route, FORMATS §15.4) — now a thin adapter over the chunked
+        plane: a peer serving /sync/* gets the parallel, verified,
+        resumable chunk fetch; one that predates it falls back to the
+        legacy one-shot /consensus/snapshot pull. Adoption goes through
+        the unchanged app-hash-anchored state_sync_bootstrap either way."""
+        import tempfile
+
+        from celestia_app_tpu.chain import sync as sync_mod
         from celestia_app_tpu.net import transport
 
-        doc = transport.request_json(
-            p["peer"], "/consensus/snapshot", timeout=30
-        )
-        chunks = [base64.b64decode(ch) for ch in doc["chunks"]]
         before = self.vnode.app.height
-        c.state_sync_bootstrap(self.vnode, doc["manifest"], chunks)
+        home = sync_mod.home_for(self.vnode)
+        ephemeral = home is None
+        workdir = (tempfile.mkdtemp(prefix="statesync-") if ephemeral
+                   else os.path.join(home, sync_mod.RESTORE_DIRNAME))
+        client = sync_mod.StateSyncClient(
+            [p["peer"]], workdir, min_height=before,
+            name=self.vnode.name,
+        )
+        try:
+            try:
+                manifest, chunks = client.fetch()
+            except sync_mod.StateSyncUnavailable:
+                import urllib.error
+
+                try:
+                    doc = transport.request_json(
+                        p["peer"],
+                        f"/consensus/snapshot?min_height={before}",
+                        timeout=30,
+                    )
+                except urllib.error.HTTPError:
+                    # pre-query peer: exact-path route only
+                    doc = transport.request_json(
+                        p["peer"], "/consensus/snapshot", timeout=30
+                    )
+                manifest = doc["manifest"]
+                chunks = [base64.b64decode(ch) for ch in doc["chunks"]]
+            # the legacy endpoint can serve a DISK snapshot OLDER than
+            # this node (the capture-on-request original was always the
+            # peer's tip): adopting it would rewind the chain
+            if int(manifest["height"]) <= before:
+                raise ValueError(
+                    f"peer snapshot at {manifest['height']} is not "
+                    f"ahead of height {before}"
+                )
+            c.state_sync_bootstrap(self.vnode, manifest, chunks)
+            client.cleanup()
+        except Exception:
+            # failed adoption: drop the restore material, or the resume
+            # preference would latch onto the same manifest next call
+            client.cleanup()
+            raise
+        finally:
+            if ephemeral:
+                import shutil
+
+                shutil.rmtree(workdir, ignore_errors=True)
         return {"height": self.vnode.app.height, "from_height": before,
                 "app_hash": self.vnode.app.last_app_hash.hex()}
 

@@ -1,0 +1,743 @@
+"""Sync plane tier-1: chunked state sync + pipelined blocksync (ISSUE 9).
+
+Coverage map (docs/DESIGN.md "The sync plane"):
+
+- a chunked join (manifest discovery → parallel verified chunk fetch →
+  app-hash-anchored adoption → pipelined tail blocksync) converges to the
+  serving node's block AND app hashes;
+- a corrupt chunk from one peer is detected on arrival, re-fetched from
+  another peer, and the bad peer's transport health score drops;
+- a restore interrupted mid-way resumes from its on-disk checkpoint,
+  fetching ONLY the missing chunks (counter-pinned);
+- range (pipelined) blocksync produces a byte-identical final state to
+  the per-height round-trip loop on the same chain;
+- the /gossip/commits serving window respects blocksync_batch and the
+  served-bytes cap, and the fetch side never over-pulls the window;
+- the legacy one-shot /consensus/snapshot endpoint is a thin adapter
+  over the chunked plane (disk-backed when a snapshot store exists,
+  capture-on-request fallback otherwise);
+- subprocess chaos: a joiner armed with the ``statesync.mid_restore``
+  crash point dies between chunk writes (exit 137), restarts, resumes
+  from the checkpoint (re-fetched chunks counter-pinned below the full
+  count), and converges to the survivor's chain.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from celestia_app_tpu import faults
+from celestia_app_tpu.chain import consensus as c
+from celestia_app_tpu.chain import sync as sync_mod
+from celestia_app_tpu.chain.crypto import PrivateKey
+from celestia_app_tpu.chain.reactor import ConsensusReactor, ReactorConfig
+from celestia_app_tpu.service.validator_server import ValidatorService
+
+CHAIN = "celestia-sync-test"
+T0 = 1_700_000_000.0
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset(seed=7)
+    yield
+    faults.reset()
+
+
+@pytest.fixture(autouse=True)
+def _small_chunks(monkeypatch):
+    """Shrink chunking so a small devnet state spans several chunks —
+    the parallel/resume machinery needs more than one to mean anything."""
+    monkeypatch.setattr(c, "SNAPSHOT_CHUNK_KEYS", 4)
+    yield
+
+
+def _genesis(privs, powers=None):
+    powers = powers or [10] * len(privs)
+    return {
+        "time_unix": T0,
+        "accounts": [
+            {"address": p.public_key().address().hex(), "balance": 10**12}
+            for p in privs
+        ],
+        "validators": [
+            {
+                "operator": p.public_key().address().hex(),
+                "power": w,
+                "pubkey": p.public_key().compressed.hex(),
+            }
+            for p, w in zip(privs, powers)
+        ],
+    }
+
+
+def _grow(vnode, reactor, n: int) -> None:
+    """Commit `n` empty blocks through the real propose/sign/apply path,
+    persisting the reactor's commit records (and interval snapshots) the
+    way a live autonomous validator would — without running the loop
+    thread, so the chain shape is deterministic."""
+    for _ in range(n):
+        height = vnode.app.height + 1
+        last_cert = vnode.certificates.get(height - 1)
+        block = vnode.propose(t=T0 + height)
+        bh = block.header.hash()
+        digest = c.Proposal.commit_info_digest(last_cert, ())
+        sig = vnode.priv.sign(
+            c.Proposal.sign_bytes(CHAIN, height, 0, bh, digest)
+        )
+        prop = c.Proposal(height, 0, block, vnode.address, sig,
+                          last_cert, ())
+        vote = vnode._signed(height, bh, "precommit", 0)
+        cert = c.CommitCertificate(height, bh, (vote,), 0)
+        vnode.apply(block, cert, absent_cert=last_cert)
+        vnode.clear_lock()
+        reactor._remember_commit(
+            {"proposal": c.proposal_to_json(prop),
+             "cert": c.cert_to_json(cert)},
+            height,
+        )
+
+
+class _ServingNet:
+    """One serving validator (with disk home, commit records, interval
+    snapshots, HTTP service + inert reactor for the /gossip and /sync
+    routes) plus helpers to mint joiners against it."""
+
+    def __init__(self, tmp_path, heights: int = 17,
+                 snapshot_interval: int = 5):
+        self.tmp = str(tmp_path)
+        self.priv = PrivateKey.from_seed(b"sync-server")
+        self.genesis = _genesis([self.priv])
+        self.server = c.ValidatorNode(
+            "srv", self.priv, self.genesis, CHAIN,
+            data_dir=os.path.join(self.tmp, "srv", "data"),
+        )
+        self.svc = ValidatorService(self.server)
+        self.reactor = ConsensusReactor(
+            self.server, [], self.svc.lock,
+            ReactorConfig(snapshot_interval=snapshot_interval,
+                          snapshot_keep=2),
+        )
+        self.svc.reactor = self.reactor  # routes only; loop not started
+        self.svc.serve_background()
+        self.url = f"http://127.0.0.1:{self.svc.port}"
+        _grow(self.server, self.reactor, heights)
+
+    def joiner(self, name: str, **cfg) -> tuple:
+        vnode = c.ValidatorNode(
+            name, PrivateKey.from_seed(name.encode()), self.genesis,
+            CHAIN, data_dir=os.path.join(self.tmp, name, "data"),
+        )
+        defaults = dict(snapshot_interval=0, statesync_gap=3,
+                        sync_grace=0.0, blocksync_batch=4)
+        reactor = ConsensusReactor(
+            vnode, [self.url], threading.Lock(),
+            ReactorConfig(**{**defaults, **cfg}),
+        )
+        return vnode, reactor
+
+    def catch_up(self, vnode, reactor, timeout: float = 60.0) -> None:
+        # _note_height semantics: the ahead-marker carries peer height + 1
+        with reactor._msg_lock:
+            reactor._ahead = (self.server.app.height + 1, self.url,
+                              time.monotonic() - 10)
+        deadline = time.monotonic() + timeout
+        while (vnode.app.height < self.server.app.height
+               and time.monotonic() < deadline):
+            reactor._maybe_catch_up()
+        assert vnode.app.height == self.server.app.height, (
+            f"joiner stuck at {vnode.app.height} "
+            f"(target {self.server.app.height})"
+        )
+
+    def stop(self):
+        self.svc.shutdown()
+
+
+@pytest.fixture()
+def net(tmp_path):
+    n = _ServingNet(tmp_path)
+    yield n
+    n.stop()
+
+
+def _get(url, path, timeout=5.0):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return r.read()
+
+
+# ---------------------------------------------------------------------------
+# chunked join end to end
+# ---------------------------------------------------------------------------
+
+
+def test_chunked_join_converges(net):
+    """Fresh joiner: manifest discovery, multi-chunk verified fetch,
+    adoption, pipelined tail blocksync — block + app hashes converge."""
+    snaps = json.loads(_get(net.url, "/sync/snapshots"))["snapshots"]
+    assert [m["height"] for m in snaps] == sorted(
+        (m["height"] for m in snaps), reverse=True
+    )
+    assert snaps[0]["n_chunks"] > 1  # the fixture forces multi-chunk
+    vnode, reactor = net.joiner("join-a")
+    net.catch_up(vnode, reactor)
+    assert vnode.app.last_app_hash == net.server.app.last_app_hash
+    assert vnode.app.last_block_hash == net.server.app.last_block_hash
+    # the join actually used the chunked plane (not block replay from 1):
+    # heights below the adopted snapshot carry no WAL on the joiner
+    assert reactor.statesync_errors == 0
+    assert not os.path.exists(
+        os.path.join(vnode.wal_dir, f"{1:020d}.json")
+    )
+
+
+def test_chunk_raw_bytes_and_404(net):
+    """/sync/chunk serves raw bytes (not base64/JSON) and 404s unknown
+    snapshots; /consensus/height is the lightweight probe."""
+    m = json.loads(_get(net.url, "/sync/snapshots"))["snapshots"][0]
+    raw = _get(net.url, f"/sync/chunk?height={m['height']}&index=0")
+    import hashlib
+
+    assert hashlib.sha256(raw).hexdigest() == m["chunk_hashes"][0]
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(net.url, "/sync/chunk?height=999999&index=0")
+    assert ei.value.code == 404
+    assert json.loads(_get(net.url, "/consensus/height")) == {
+        "height": net.server.app.height
+    }
+
+
+# ---------------------------------------------------------------------------
+# corrupt chunk: re-fetch elsewhere + health penalty
+# ---------------------------------------------------------------------------
+
+
+class _CorruptPeer:
+    """A peer that serves the REAL manifest list but flips a byte in
+    every chunk body — the lying-server shape content addressing exists
+    to catch."""
+
+    def __init__(self, good_url: str):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                body = _get(good_url, self.path)
+                if self.path.startswith("/sync/chunk"):
+                    body = bytes([body[0] ^ 0xFF]) + body[1:]
+                    ctype = "application/octet-stream"
+                else:
+                    ctype = "application/json"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                outer.served += 1
+
+        self.served = 0
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_corrupt_chunk_refetched_and_peer_penalized(net, tmp_path):
+    bad = _CorruptPeer(net.url)
+    try:
+        client = sync_mod.StateSyncClient(
+            [bad.url, net.url], str(tmp_path / "restore"), workers=2,
+        )
+        manifest, chunks = client.fetch()
+        # every corrupt arrival was caught by verify-on-arrival and
+        # re-fetched from the honest peer; adoption material is intact
+        assert client.stats["bad_chunks"] >= 1
+        assert len(chunks) == manifest["n_chunks"]
+        app = c.ValidatorNode(
+            "restorer", PrivateKey.from_seed(b"restorer"), net.genesis,
+            CHAIN,
+        )
+        c.state_sync_bootstrap(app, manifest, chunks)
+        assert app.app.height == manifest["height"]
+        # the penalty landed on the shared health score
+        health = client.net.snapshot()[bad.url]
+        assert health["failures"] >= 1
+        assert "penalized" in (health["last_error"] or "")
+    finally:
+        bad.stop()
+
+
+# ---------------------------------------------------------------------------
+# resume: only the missing chunks are fetched
+# ---------------------------------------------------------------------------
+
+
+def test_mid_restore_resume_fetches_only_missing(net, tmp_path):
+    workdir = str(tmp_path / "restore")
+    # abort the restore right after the FIRST durable chunk write (the
+    # in-process twin of the statesync.mid_restore crash point)
+    faults.arm("statesync.mid_restore", "error", count=1)
+    c1 = sync_mod.StateSyncClient([net.url], workdir, workers=1)
+    with pytest.raises(OSError):
+        c1.fetch()
+    assert c1.stats["fetched"] == 1
+    faults.reset(seed=7)
+
+    # resume: the checkpoint (manifest + verified chunk files) pins the
+    # re-fetch count to exactly the missing set
+    c2 = sync_mod.StateSyncClient([net.url], workdir, workers=2)
+    manifest, chunks = c2.fetch()
+    n = manifest["n_chunks"]
+    assert c2.stats["reused"] == 1
+    assert c2.stats["fetched"] == n - 1  # counter-pinned: no re-fetch
+    vnode = c.ValidatorNode(
+        "resumer", PrivateKey.from_seed(b"resumer"), net.genesis, CHAIN,
+    )
+    c.state_sync_bootstrap(vnode, manifest, chunks)
+    assert vnode.app.last_app_hash.hex() == manifest["app_hash"]
+
+    # pre_adopt interruption: the full set is on disk, a restart reuses
+    # ALL of it (fetched == 0)
+    faults.arm("statesync.pre_adopt", "error", count=1)
+    c3 = sync_mod.StateSyncClient([net.url], str(tmp_path / "r2"),
+                                  workers=2)
+    with pytest.raises(OSError):
+        c3.fetch()
+    assert c3.stats["fetched"] == n
+    faults.reset(seed=7)
+    c4 = sync_mod.StateSyncClient([net.url], str(tmp_path / "r2"),
+                                  workers=2)
+    _m, _ch = c4.fetch()
+    assert c4.stats["fetched"] == 0
+    assert c4.stats["reused"] == n
+
+
+def test_corrupt_checkpoint_chunk_refetched(net, tmp_path):
+    """A torn/corrupted on-disk chunk (crash mid-write shapes) fails the
+    resume scan's content check and is re-fetched, never trusted."""
+    workdir = str(tmp_path / "restore")
+    c1 = sync_mod.StateSyncClient([net.url], workdir, workers=2)
+    manifest, _ = c1.fetch()
+    digest = sync_mod.manifest_digest(manifest)
+    victim = os.path.join(workdir, digest, "chunk_000000")
+    with open(victim, "wb") as f:
+        f.write(b"torn")
+    c2 = sync_mod.StateSyncClient([net.url], workdir, workers=2)
+    m2, chunks = c2.fetch()
+    assert c2.stats["fetched"] == 1  # only the damaged one
+    assert c2.stats["reused"] == m2["n_chunks"] - 1
+    import hashlib
+
+    assert [hashlib.sha256(ch).hexdigest() for ch in chunks] \
+        == m2["chunk_hashes"]
+
+
+# ---------------------------------------------------------------------------
+# range blocksync ≡ per-height blocksync; window discipline
+# ---------------------------------------------------------------------------
+
+
+def test_range_blocksync_byte_identical_to_per_height(net):
+    va, ra = net.joiner("join-range", statesync_gap=10_000)
+    vb, rb = net.joiner("join-height", statesync_gap=10_000,
+                        blocksync_pipeline=False)
+    net.catch_up(va, ra)
+    net.catch_up(vb, rb)
+    assert va.app.last_app_hash == vb.app.last_app_hash
+    assert va.app.last_block_hash == vb.app.last_block_hash
+    # byte-identical final state, the strongest equivalence we can pin
+    assert va.app.store.snapshot() == vb.app.store.snapshot()
+    assert va.app.store.snapshot() == net.server.app.store.snapshot()
+
+
+def test_prefetch_window_respects_blocksync_batch(net):
+    vnode, reactor = net.joiner("join-window", blocksync_batch=4)
+    docs = reactor._fetch_commit_batch(1, net.server.app.height, net.url)
+    assert 0 < len(docs) <= 4  # the fetch side clamps to its window
+    assert [d["cert"]["height"] for d in docs] == [1, 2, 3, 4]
+    # serving side clamps to ITS batch window too, regardless of to=
+    body = json.loads(_get(
+        net.url, f"/gossip/commits?from=1&to={10_000}"
+    ))["commits"]
+    assert len(body) <= net.reactor.cfg.blocksync_batch
+    # and to the served-bytes cap (always at least one record)
+    net.reactor.cfg.blocksync_serve_bytes = 10
+    try:
+        capped = json.loads(_get(
+            net.url, "/gossip/commits?from=1&to=64"
+        ))["commits"]
+        assert len(capped) == 1
+    finally:
+        net.reactor.cfg.blocksync_serve_bytes = 2 << 20
+    # a gap ends the response instead of skipping heights
+    assert json.loads(_get(
+        net.url, "/gossip/commits?from=999&to=1002"
+    ))["commits"] == []
+
+
+def test_prefetch_overlaps_next_window(net):
+    """After taking window N, the reactor arms the prefetch slot for
+    window N+1; the next step consumes it without a synchronous fetch."""
+    vnode, reactor = net.joiner("join-pipe", blocksync_batch=4,
+                                statesync_gap=10_000)
+    target = net.server.app.height + 1
+    with reactor._msg_lock:
+        reactor._ahead = (target, net.url, time.monotonic() - 10)
+    assert reactor._maybe_catch_up()
+    assert vnode.app.height >= 4  # one full window applied
+    got = reactor._take_prefetch(vnode.app.height + 1)
+    assert got is not None  # the N+1 window was already downloading
+    assert got[0]["cert"]["height"] == vnode.app.height + 1
+
+
+# ---------------------------------------------------------------------------
+# legacy adapters
+# ---------------------------------------------------------------------------
+
+
+def test_legacy_snapshot_adapter(net, tmp_path):
+    """GET /consensus/snapshot serves the newest DISK snapshot when the
+    node has a store (no capture), and capture-on-request for storeless
+    nodes — existing callers keep working either way."""
+    doc = json.loads(_get(net.url, "/consensus/snapshot"))
+    newest_disk = json.loads(
+        _get(net.url, "/sync/snapshots")
+    )["snapshots"][0]
+    assert doc["manifest"] == newest_disk  # disk-backed, not a capture
+    # a joiner can still bootstrap from the legacy doc
+    import base64
+
+    vnode = c.ValidatorNode(
+        "legacy", PrivateKey.from_seed(b"legacy"), net.genesis, CHAIN,
+    )
+    c.state_sync_bootstrap(
+        vnode, doc["manifest"],
+        [base64.b64decode(ch) for ch in doc["chunks"]],
+    )
+    assert vnode.app.height == newest_disk["height"]
+
+    # storeless (in-memory) validator: capture-on-request fallback at
+    # the CURRENT height
+    mem = c.ValidatorNode(
+        "mem", PrivateKey.from_seed(b"mem"), net.genesis, CHAIN,
+    )
+    svc2 = ValidatorService(mem)
+    svc2.serve_background()
+    try:
+        doc2 = json.loads(
+            _get(f"http://127.0.0.1:{svc2.port}", "/consensus/snapshot")
+        )
+        assert doc2["manifest"]["height"] == mem.app.height
+    finally:
+        svc2.shutdown()
+
+
+def test_stale_snapshot_never_rewinds(net):
+    """The legacy one-shot endpoint now serves DISK snapshots, which can
+    be OLDER than the puller's tip (the capture-on-request original never
+    was): adoption must refuse rather than rewind the chain."""
+    vnode, reactor = net.joiner("join-ahead")
+    net.catch_up(vnode, reactor)  # tip (17) > newest disk snapshot (15)
+    h = vnode.app.height
+    errors_before = reactor.statesync_errors
+    assert not reactor._state_sync_from(net.url)
+    assert vnode.app.height == h  # no rewind
+    assert reactor.statesync_errors == errors_before + 1  # counted
+
+
+def test_legacy_sync_between_snapshot_and_tip(net):
+    """A puller whose height sits BETWEEN the peer's newest disk
+    snapshot and its tip must still legacy-sync: its ?min_height= makes
+    the adapter serve a capture (the pre-sync-plane behavior) instead of
+    the stale disk snapshot the rewind guard would refuse."""
+    vnode, reactor = net.joiner("join-mid", statesync_gap=10_000)
+    # per-height replay to 16: past the newest disk snapshot (15),
+    # behind the tip (17)
+    while vnode.app.height < 16:
+        assert reactor._replay_height(vnode.app.height + 1,
+                                      prefer=net.url)
+    assert reactor._state_sync_from(net.url)  # capture path, not stale
+    assert vnode.app.height == net.server.app.height
+    assert vnode.app.last_app_hash == net.server.app.last_app_hash
+
+
+def test_open_breaker_not_counted_as_fetch_errors(net):
+    """A peer whose circuit is already open is SKIPPED by the blocksync
+    pulls — cached breaker rejections must not flood the fetch-error
+    counter (the transport recorded the underlying failure once)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead = f"http://127.0.0.1:{s.getsockname()[1]}"
+    s.close()  # bound then closed: instant connection-refused
+    # breaker_reset large so the circuit stays open (no half-open probe
+    # window) for the whole assertion sequence
+    vnode, reactor = net.joiner("join-breaker", breaker_reset=30.0)
+    reactor.peers = [dead, net.url]
+    reactor.net.cfg.failure_threshold = 1
+    # one real failure opens the circuit (and is counted once)
+    assert reactor._fetch_record_from(dead, 1) is None
+    opened_at = reactor.blocksync_fetch_errors
+    assert opened_at == 1
+    for h in (1, 2, 3):  # open breaker: skipped, not re-counted
+        assert reactor._replay_height(h, prefer=dead)
+    docs = reactor._fetch_commit_batch(4, 6, prefer=dead)
+    assert [d["cert"]["height"] for d in docs] == [4, 5, 6]
+    assert reactor.blocksync_fetch_errors == opened_at
+
+
+class _LyingAppHashPeer:
+    """Serves self-consistent chunk hashes under a manifest whose
+    app_hash does NOT match the reassembled store — passes every
+    per-chunk check, fails only at adoption."""
+
+    def __init__(self, good_url: str):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                body = _get(good_url, self.path)
+                ctype = "application/octet-stream"
+                if self.path == "/sync/snapshots":
+                    doc = json.loads(body)
+                    for m in doc["snapshots"]:
+                        m["app_hash"] = "00" * 32
+                    body = json.dumps(doc).encode()
+                    ctype = "application/json"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_failed_adoption_drops_checkpoint(net):
+    """A manifest whose chunks verify but whose app_hash lies fails at
+    state_sync_bootstrap; the restore material must be REMOVED — the
+    resume preference would otherwise latch onto the poisoned manifest
+    on every retry and wedge state sync behind one lying peer."""
+    bad = _LyingAppHashPeer(net.url)
+    try:
+        vnode, reactor = net.joiner("join-lied")
+        reactor.peers = [bad.url]
+        assert not reactor._state_sync("")
+        assert reactor.statesync_errors >= 1
+        workdir = reactor._statesync_workdir()
+        leftovers = os.listdir(workdir) if os.path.isdir(workdir) else []
+        assert leftovers == [], f"poisoned checkpoint kept: {leftovers}"
+        assert vnode.app.height == 0  # nothing adopted
+    finally:
+        bad.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos: the statesync.mid_restore crash point, as a real process death
+# ---------------------------------------------------------------------------
+
+SUB_REACTOR = {
+    "timeout_propose": 6.0,
+    "timeout_prevote": 3.0,
+    "timeout_precommit": 3.0,
+    "timeout_delta": 1.0,
+    "block_interval": 0.1,
+    "poll": 0.01,
+    "gossip_timeout": 2.0,
+    "sync_grace": 0.5,
+}
+
+
+def _spawn(home, seed, genesis, reactor_cfg, fault_specs=None, port=0):
+    os.makedirs(home, exist_ok=True)
+    with open(os.path.join(home, "genesis.json"), "w") as f:
+        json.dump(genesis, f)
+    with open(os.path.join(home, "key.json"), "w") as f:
+        json.dump({"seed_hex": seed.encode().hex(),
+                   "name": os.path.basename(home)}, f)
+    with open(os.path.join(home, "reactor.json"), "w") as f:
+        json.dump({**SUB_REACTOR, **reactor_cfg}, f)
+    fpath = os.path.join(home, "faults.json")
+    if fault_specs is not None:
+        with open(fpath, "w") as f:
+            json.dump(fault_specs, f)
+    elif os.path.exists(fpath):
+        os.unlink(fpath)
+    ep = os.path.join(home, "endpoint.json")
+    if os.path.exists(ep):
+        os.unlink(ep)
+    env = {**os.environ, "CELESTIA_SNAPSHOT_CHUNK_KEYS": "4"}
+    log_f = open(os.path.join(home, "validator.log"), "a")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "celestia_app_tpu", "validator-serve",
+         "--home", home, "--chain-id", "celestia-sync-chaos",
+         "--autonomous", "--port", str(port)],
+        stdout=log_f, stderr=subprocess.STDOUT, env=env,
+    )
+    log_f.close()
+    return proc
+
+
+def _endpoint(home, timeout=120.0):
+    ep = os.path.join(home, "endpoint.json")
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(ep):
+            with open(ep) as f:
+                doc = json.load(f)
+            return f"http://{doc['host']}:{doc['port']}"
+        time.sleep(0.25)
+    raise AssertionError(f"{home} never published an endpoint")
+
+
+def _status(url):
+    try:
+        return json.loads(_get(url, "/consensus/status"))
+    except OSError:
+        return None
+
+
+def _wait(pred, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = pred()
+        if out:
+            return out
+        time.sleep(0.25)
+    raise AssertionError(f"timeout: {what}")
+
+
+def test_chaos_mid_restore_crash_resumes_and_converges(tmp_path):
+    """The acceptance scenario: a real joiner PROCESS dies at the armed
+    ``statesync.mid_restore`` point (exit 137, between chunk writes),
+    restarts, resumes from its on-disk checkpoint — re-fetched chunks
+    counter-pinned below the full count via the resume log line — and
+    converges to the survivor's block + app hashes."""
+    seeds = ["sync-chaos-0", "sync-chaos-1"]
+    privs = [PrivateKey.from_seed(s.encode()) for s in seeds]
+    # only val0 is a genesis validator: it commits alone at full speed;
+    # the joiner is a full node catching up from zero
+    genesis = _genesis(privs[:1])
+    genesis["accounts"].append({
+        "address": privs[1].public_key().address().hex(),
+        "balance": 10**12,
+    })
+    homes = [str(tmp_path / f"val{i}") for i in range(2)]
+
+    # keep=0 (retain every interval snapshot): the joiner's crashed
+    # restore must still find ITS manifest served after the restart —
+    # the resume-preference path the busy-chain design requires
+    server = _spawn(homes[0], seeds[0], genesis,
+                    {"snapshot_interval": 4, "snapshot_keep": 0,
+                     "block_interval": 0.25})
+    joiner = None
+    try:
+        url0 = _endpoint(homes[0])
+        with open(os.path.join(homes[0], "peers.json"), "w") as f:
+            json.dump([url0], f)
+        # a busy chain: well past the joiner's statesync_gap, with at
+        # least one interval snapshot on disk
+        _wait(lambda: (_status(url0) or {}).get("height", 0) >= 9,
+              120.0, "server chain growth")
+        _wait(lambda: json.loads(_get(url0, "/sync/snapshots"))
+              .get("snapshots"), 30.0, "server snapshot on disk")
+
+        # the joiner: statesync_gap small so it snapshots instead of
+        # replaying, armed to CRASH between chunk writes
+        joiner = _spawn(
+            homes[1], seeds[1], genesis, {"statesync_gap": 4},
+            fault_specs=[{"point": "statesync.mid_restore",
+                          "action": "crash", "count": 1}],
+        )
+        url1 = _endpoint(homes[1])
+        port1 = int(url1.rsplit(":", 1)[1])
+        with open(os.path.join(homes[1], "peers.json"), "w") as f:
+            json.dump([url0, url1], f)
+        assert joiner.wait(timeout=120) == 137, (
+            "joiner should die AT statesync.mid_restore"
+        )
+        # the checkpoint survived the crash: manifest + >=1 chunk file
+        restore_root = os.path.join(homes[1], "statesync")
+        digests = os.listdir(restore_root)
+        assert digests, "no restore checkpoint on disk after crash"
+        files = os.listdir(os.path.join(restore_root, digests[0]))
+        assert "manifest.json" in files
+        n_chunks_done = len([f for f in files if f.startswith("chunk_")
+                             and not f.endswith(".tmp")])
+        assert n_chunks_done >= 1
+
+        # restart WITHOUT the fault: resume, then converge
+        joiner = _spawn(homes[1], seeds[1], genesis,
+                        {"statesync_gap": 4}, port=port1)
+        _endpoint(homes[1])
+
+        def _converged():
+            # converged = the joiner replayed PAST its initial target on
+            # the survivor's chain: at the joiner's own tip, both nodes
+            # serve the identical commit record (the chain keeps growing
+            # at block_interval, so "equal heights" is a moving target —
+            # hash identity at the joiner's tip is the real invariant)
+            s1 = _status(url1)
+            if not s1 or s1["height"] < 9:
+                return None
+            h = s1["height"]
+            try:
+                d0 = json.loads(
+                    _get(url0, f"/gossip/commit_at?height={h}"))
+                d1 = json.loads(
+                    _get(url1, f"/gossip/commit_at?height={h}"))
+            except OSError:
+                return None
+            if not d0 or not d1:
+                return None
+            return h, d0, d1
+
+        h, d0, d1 = _wait(_converged, 180.0, "joiner convergence")
+        assert d0["cert"]["block_hash"] == d1["cert"]["block_hash"]
+        assert (d0["proposal"]["block"]["header"]["app_hash"]
+                == d1["proposal"]["block"]["header"]["app_hash"])
+
+        with open(os.path.join(homes[1], "validator.log")) as f:
+            log = f.read()
+        # the crash was the armed one, at the armed point
+        assert "CRASH at statesync.mid_restore" in log
+        # counter-pinned resume: the restarted joiner logged reused>0
+        # (strictly below the full chunk count was already proven by the
+        # crash landing mid-restore with >=1 chunk durable)
+        assert "state sync resumed from checkpoint" in log
+        assert "state sync adopted snapshot" in log
+    finally:
+        for p in (server, joiner):
+            if p is None:
+                continue
+            try:
+                p.terminate()
+                p.wait(timeout=5)
+            except Exception:
+                p.kill()
