@@ -339,6 +339,135 @@ def measure_stages(reps: int = 10) -> None:
     )
 
 
+def measure_codec(ks=None) -> None:
+    """Codec-plane bench (--codec): the two DA commitment schemes head to
+    head, per cost that matters at millions of sampling light clients.
+    One BENCH JSON line:
+
+      {"metric": "codec_head_to_head", "k": {"32": {scheme: {...}}, ...}}
+
+    Per scheme at each k: `encode_ms` (one full commit dispatch, warm
+    best-of-reps), `proof_bytes_per_sample` (EXACT canonical wire bytes
+    of one sample proof, FORMATS §16.3 — not JSON/base64 inflation),
+    `hashes_per_sample_verify` (sha256 invocations a verifier pays),
+    `samples_to_99_confidence` (the scheme's own catch probability —
+    2D-RS's combinatorial 1/4 vs CMT's measured peeling threshold),
+    `commitment_bytes` (the once-per-block download: 4k NMT roots vs the
+    CMT root hash list), `repair_ms` (reconstruction from a 1/4-erased
+    block: the batched sweep engine vs the peeling decoder),
+    `fraud_proof_bytes` + `fraud_verify_ms` (a BEFP's k shares vs CMT's
+    one parity equation). The acceptance gate — the paper's headline —
+    is CMT `proof_bytes_per_sample` strictly below 2D-RS at k=128.
+    Backend labeling per FORMATS §12.2 (`"backend": "cpu-fallback"`).
+    """
+    import jax
+
+    from celestia_app_tpu.da import codec as dacodec
+    from celestia_app_tpu.testing import malicious
+
+    if ks is None:
+        ks = tuple(int(x) for x in os.environ.get(
+            "CELESTIA_BENCH_CODEC_K", "32,128").split(","))
+    reps = int(os.environ.get("CELESTIA_BENCH_CODEC_REPS", "3"))
+    backend = jax.devices()[0].platform
+    if backend == "cpu":
+        backend = "cpu-fallback"
+    out: dict = {}
+    for k in ks:
+        ods = _bench_ods(k)
+        per_k: dict = {}
+        for name in ("rs2d-nmt", "cmt-ldpc"):
+            codec = dacodec.get(name)
+            entry = codec.compute_entry(ods)  # warm (jit compiles)
+            encode_ms = None
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                codec.compute_entry(ods)
+                dt = (time.perf_counter() - t0) * 1e3
+                encode_ms = dt if encode_ms is None else min(encode_ms, dt)
+            doc = codec.commitments_doc(entry)
+            comm = codec.commitments_from_doc(doc, entry.data_root.hex(),
+                                              k)
+            space = codec.sample_space(comm)
+            cell = space[len(space) // 3]
+            sample_doc = codec.open_sample(entry, cell)
+            assert codec.verify_sample(comm, sample_doc) is not None
+            proof_bytes = codec.sample_wire_bytes(sample_doc, comm)
+            commitment_bytes = (
+                sum(len(h) for h in comm.root_hashes)
+                if name == "cmt-ldpc"
+                else sum(len(r) for r in comm.row_roots)
+                + sum(len(r) for r in comm.col_roots))
+            # repair from a 1/4-erased block (seeded mask; the CMT seed
+            # is pinned inside its peeling threshold — see ops/ldpc.py)
+            rng = np.random.default_rng(1)
+            n = len(space)
+            drop = set(
+                int(i) for i in rng.choice(n, size=n // 4, replace=False)
+            )
+            samples = {}
+            for i, c in enumerate(space):
+                if i not in drop:
+                    d = codec.open_sample(entry, c)
+                    got = codec.verify_sample(comm, d)
+                    samples[c] = got[1]
+            t0 = time.perf_counter()
+            rec = codec.repair(comm, samples)
+            repair_ms = (time.perf_counter() - t0) * 1e3
+            assert np.array_equal(np.asarray(rec), ods)
+            # incorrect-coding fraud: commit a corrupt symbol, prove it
+            if name == "cmt-ldpc":
+                bad = malicious.cmt_bad_parity_entry(ods, equation=3)
+                location = (0, 3)
+            else:
+                bad = malicious.rs2d_bad_parity_entry(ods, row=1)
+                location = ("row", 1)
+            bad_comm = bad.dah
+            fp = codec.build_fraud_proof(bad, location)
+            assert codec.verify_fraud_proof(bad_comm, fp) is True
+            if name == "cmt-ldpc":
+                fraud_bytes = sum(
+                    codec.sample_wire_bytes(m.doc, bad_comm)
+                    for m in fp.members)
+            else:
+                from celestia_app_tpu import appconsts
+
+                fraud_bytes = sum(
+                    len(s.share)
+                    + len(s.proof.nodes) * appconsts.NMT_ROOT_SIZE
+                    for s in fp.shares)
+            fraud_ms = None
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                assert codec.verify_fraud_proof(bad_comm, fp) is True
+                dt = (time.perf_counter() - t0) * 1e3
+                fraud_ms = dt if fraud_ms is None else min(fraud_ms, dt)
+            per_k[name] = {
+                "encode_ms": round(encode_ms, 3),
+                "proof_bytes_per_sample": proof_bytes,
+                "hashes_per_sample_verify":
+                    codec.hashes_per_sample_verify(comm),
+                "samples_to_99_confidence":
+                    codec.samples_for_confidence(0.99),
+                "catch_probability": codec.catch_probability(),
+                "commitment_bytes": commitment_bytes,
+                "repair_ms": round(repair_ms, 3),
+                "fraud_proof_bytes": fraud_bytes,
+                "fraud_verify_ms": round(fraud_ms, 3),
+            }
+        out[str(k)] = per_k
+    headline = None
+    if "128" in out:
+        headline = (out["128"]["cmt-ldpc"]["proof_bytes_per_sample"]
+                    < out["128"]["rs2d-nmt"]["proof_bytes_per_sample"])
+    print(json.dumps({
+        "metric": "codec_head_to_head",
+        "backend": backend,
+        "k": out,
+        "cmt_proof_smaller_at_128": headline,
+    }))
+
+
 def measure_proofs(n_proofs: int = 10_000) -> None:
     """BASELINE config 3: batched share-proof generation, proofs/sec.
 
@@ -701,10 +830,11 @@ def main() -> None:
         return
     if "--list" in sys.argv:
         for name in sorted(MODES):
-            _fn, metrics = MODES[name]
-            print(f"--{name:<18} {metrics}")
+            _fn, metrics, desc = MODES[name]
+            print(f"--{name:<18} {desc}")
+            print(f"  {'':<18} emits: {metrics}")
         return
-    for name, (fn, _metrics) in MODES.items():
+    for name, (fn, _metrics, _desc) in MODES.items():
         if f"--{name}" in sys.argv:
             fn()
             return
@@ -1659,29 +1789,49 @@ def measure_sync() -> None:
 
 
 # -- mode registry (--list prints it) ----------------------------------------
-# name -> (runner, emitted metrics). The default invocation (no flag) runs
-# the deadline-driven headline measurement (`extend_commit_128_ms`).
+# name -> (runner, emitted metrics, one-line description). The default
+# invocation (no flag) runs the deadline-driven headline measurement
+# (`extend_commit_128_ms`).
 MODES = {
     "block": (measure_block,
-              "block_e2e_ms, blocks_per_sec, first_sample_after_commit_ms"),
-    "proofs": (measure_proofs, "share_proofs_per_sec_128"),
+              "block_e2e_ms, blocks_per_sec, first_sample_after_commit_ms",
+              "extend-once block lifecycle: e2e commit + first sample"),
+    "proofs": (measure_proofs, "share_proofs_per_sec_128",
+               "batched share-proof serving throughput at k=128"),
     "admission": (measure_admission,
-                  "sig_verify_per_sec, mempool_ingest_txs_per_sec"),
-    "repair": (measure_repair, "repair_128_ms, befp_verify_ms"),
+                  "sig_verify_per_sec, mempool_ingest_txs_per_sec",
+                  "batched on-device secp256k1 + two-phase tx admission"),
+    "repair": (measure_repair, "repair_128_ms, befp_verify_ms",
+               "decode plane: 1/4-erased EDS repair + BEFP verification"),
+    "codec": (measure_codec,
+              "encode_ms, proof_bytes_per_sample, "
+              "samples_to_99_confidence, repair_ms, fraud_verify_ms",
+              "DA commitment schemes head to head: 2D-RS+NMT vs CMT"),
     "mempool": (measure_mempool,
-                "mempool_ingest_txs_per_sec, mempool_reap_ms"),
-    "chaos": (measure_chaos, "crash_replay_ms, chaos_heal_recovery_s"),
+                "mempool_ingest_txs_per_sec, mempool_reap_ms",
+                "CAT pool ingest + priority reap throughput"),
+    "chaos": (measure_chaos, "crash_replay_ms, chaos_heal_recovery_s",
+              "fault plane: WAL crash replay + partition-heal liveness"),
     "sync": (measure_sync,
              "state_sync_join_s, blocksync_blocks_per_sec, "
-             "snapshot_serve_ms"),
-    "analyze": (measure_analyze, "analyze_wall_s"),
-    "obs": (measure_obs, "obs_overhead_pct"),
-    "stream-mesh": (measure_stream_mesh, "stream_mesh blocks/s (stderr+json)"),
-    "stream-batched": (_stream_batched, "stream_batched blocks/s"),
-    "stream": (measure_stream, "stream blocks/s"),
-    "stages": (measure_stages, "per-stage device timings (stderr)"),
+             "snapshot_serve_ms",
+             "sync plane: chunked state-sync join vs full replay"),
+    "analyze": (measure_analyze, "analyze_wall_s",
+                "full-tree static-analysis wall time (tier-1 cost)"),
+    "obs": (measure_obs, "obs_overhead_pct",
+            "observability overhead on the produce-block path"),
+    "stream-mesh": (measure_stream_mesh,
+                    "stream_mesh blocks/s (stderr+json)",
+                    "multi-device sharded streaming pipeline"),
+    "stream-batched": (_stream_batched, "stream_batched blocks/s",
+                       "single-device batched block streaming"),
+    "stream": (measure_stream, "stream blocks/s",
+               "single-square streaming pipeline"),
+    "stages": (measure_stages, "per-stage device timings (stderr)",
+               "per-stage device timings of the extend+commit pipeline"),
     "measure-baseline": (_save_baseline,
-                         "writes bench_baseline.json (cpu_ms, data_root)"),
+                         "writes bench_baseline.json (cpu_ms, data_root)",
+                         "record the native CPU baseline reference"),
 }
 
 

@@ -72,6 +72,7 @@ from celestia_app_tpu.chain.tx import (
     decode_tx,
 )
 from celestia_app_tpu.da import blob as blob_mod
+from celestia_app_tpu.da import codec as dacodec
 from celestia_app_tpu.da import dah as dah_mod
 from celestia_app_tpu.da import edscache as edscache_mod
 from celestia_app_tpu.da import square as square_mod
@@ -82,7 +83,9 @@ from celestia_app_tpu.da.square import PfbEntry
 class ProposalResult:
     block: Block
     square: square_mod.Square
-    dah: dah_mod.DataAvailabilityHeader
+    # the scheme's commitments object: a DataAvailabilityHeader under
+    # the default codec, a da/cmt.CmtCommitments under cmt-ldpc
+    dah: object
 
 
 class App:
@@ -96,6 +99,7 @@ class App:
         upgrade_height_delay: int | None = None,
         data_dir: str | None = None,
         invariant_check_period: int = 0,  # crisis: 0 = only at genesis/on demand
+        da_scheme: str = "rs2d-nmt",  # DA commitment scheme (da/codec.py)
     ):
         self.invariant_check_period = invariant_check_period
         self.traces = telemetry.TraceTables()  # per-node trace tables (§5.1)
@@ -103,6 +107,10 @@ class App:
         self.chain_id = chain_id
         self.app_version = app_version
         self.engine = engine
+        # the codec plane: which construction data_hash commits under —
+        # a consensus parameter (every validator of a chain must run the
+        # same scheme; ProcessProposal rejects mismatched headers)
+        self.codec = dacodec.get(da_scheme)
         # node-local (operator-set) min gas price; served by the gRPC node
         # Config route the reference's QueryMinimumGasPrice reads first
         self.min_gas_price = min_gas_price
@@ -355,25 +363,32 @@ class App:
             except OSError:
                 pass
 
-    def _data_root(self, square: square_mod.Square) -> tuple[dah_mod.DataAvailabilityHeader, bytes]:
-        """(DAH, data_root) for a square — through the extend-once cache:
-        the first caller for a given ODS content pays the real pipeline
-        dispatch (da/edscache.compute_entry: device when possible, the
-        bit-identical fast_host path otherwise); every later phase of the
-        lifecycle — ProcessProposal re-validating what PrepareProposal
-        built, a proposer re-validating its own gossip, the query router,
-        the DAS server — hits the same entry."""
+    def _data_root(self, square: square_mod.Square):
+        """(commitments, data_root) for a square — through the
+        extend-once cache: the first caller for a given (scheme, ODS)
+        content pays the real encode dispatch (da/edscache.compute_entry
+        routed through the codec plane: the 2D-RS+NMT pipeline or the
+        CMT layer build, device when possible, the bit-identical host
+        path otherwise); every later phase of the lifecycle —
+        ProcessProposal re-validating what PrepareProposal built, a
+        proposer re-validating its own gossip, the query router, the DAS
+        server — hits the same entry. The commitments object is the
+        scheme's (a DataAvailabilityHeader or CmtCommitments); its
+        ``hash()`` is the data root either way."""
+        scheme = self.codec.name
         ods = dah_mod.shares_to_ods(square.share_bytes())
-        key = edscache_mod.cache_key(ods)
+        key = edscache_mod.cache_key(ods, scheme)
         entry = self.eds_cache.get(key)
         if entry is None:
             # one span covers the fused device program: RS extension + NMT
             # axis roots + data root land in a single dispatch (da/eds.py),
             # so finer stage attribution needs /debug/profile, not spans
             with obs.span("da.extend_shares", k=square.size,
-                          engine=self.engine, stages="extend+nmt+root"):
+                          engine=self.engine, scheme=scheme,
+                          stages="extend+nmt+root"):
                 entry = self.eds_cache.put(
-                    key, edscache_mod.compute_entry(ods, self.engine)
+                    key,
+                    edscache_mod.compute_entry(ods, self.engine, scheme),
                 )
         return entry.dah, entry.data_root
 
@@ -628,6 +643,7 @@ class App:
             app_version=self.app_version,
             last_block_hash=self.last_block_hash,
             validators_hash=self._validators_hash(),
+            da_scheme=self.codec.scheme_id,
         )
         block = Block(header=header, txs=tuple(square.txs + kept_blob_raws))
         sp.set(n_txs=len(block.txs), square_size=square.size)
@@ -679,6 +695,14 @@ class App:
             # validator derives from state — a forged commitment would let
             # light clients be pointed at a fake set
             raise ValueError("validators hash mismatch")
+        if h.da_scheme != self.codec.scheme_id:
+            # the DA scheme is a consensus parameter: a proposer running
+            # a different codec would commit a data root this node can
+            # neither recompute nor sample — reject before paying for
+            # the (wrong-scheme) encode below
+            raise ValueError(
+                f"DA scheme mismatch: header {h.da_scheme}, "
+                f"node runs {self.codec.scheme_id} ({self.codec.name})")
 
         ctx = self._ctx(
             self.store.branch(), InfiniteGasMeter(), check=False,

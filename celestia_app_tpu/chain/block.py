@@ -25,6 +25,10 @@ class Header:
     # addresses ARE pubkey hashes, so a candidate set is checkable against
     # this commitment plus the address derivation (chain/light.py).
     validators_hash: bytes = b"\x00" * 32
+    # DA commitment scheme id (da/codec.py): what construction data_hash
+    # commits under. 0 = 2D-RS+NMT, the only pre-codec-plane scheme;
+    # absent on old wire docs ⇒ 0 (FORMATS §16.1)
+    da_scheme: int = 0
 
     def encode(self) -> bytes:
         cid = self.chain_id.encode()
@@ -45,6 +49,12 @@ class Header:
         out += uvarint(self.app_version)
         out += self.last_block_hash
         out += self.validators_hash
+        if self.da_scheme:
+            # suffix-encoded ONLY for non-default schemes, so every
+            # header hash the chain ever produced under 2D-RS+NMT is
+            # unchanged by the codec plane (back-compat rule, FORMATS
+            # §16.1); a non-zero scheme id domain-separates itself
+            out += uvarint(self.da_scheme)
         return bytes(out)
 
     def hash(self) -> bytes:

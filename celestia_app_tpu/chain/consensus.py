@@ -128,7 +128,7 @@ class CommitCertificate:
 
 
 def header_to_json(h: Header) -> dict:
-    return {
+    doc = {
         "chain_id": h.chain_id,
         "height": h.height,
         "time_unix": h.time_unix,
@@ -140,6 +140,12 @@ def header_to_json(h: Header) -> dict:
         "last_block_hash": h.last_block_hash.hex(),
         "validators_hash": h.validators_hash.hex(),
     }
+    if h.da_scheme:
+        # emitted only for non-default schemes: default-scheme docs stay
+        # byte-identical to pre-codec-plane ones (WAL/socket/snapshot
+        # encodings are shared — FORMATS §16.1)
+        doc["da_scheme"] = h.da_scheme
+    return doc
 
 
 def header_from_json(d: dict) -> Header:
@@ -158,6 +164,8 @@ def header_from_json(d: dict) -> Header:
         # this code — failing loudly here beats silently re-hashing it to a
         # value none of its stored votes cover
         validators_hash=bytes.fromhex(d["validators_hash"]),
+        # absent ⇒ 0 = 2D-RS+NMT (the codec plane's back-compat rule)
+        da_scheme=d.get("da_scheme", 0),
     )
 
 
@@ -330,7 +338,8 @@ class ValidatorNode:
                  chain_id: str, data_dir: str | None = None,
                  v2_upgrade_height: int | None = None,
                  upgrade_height_delay: int | None = None,
-                 engine: str = "host"):
+                 engine: str = "host",
+                 da_scheme: str = "rs2d-nmt"):
         self.name = name
         self.priv = priv
         self.address = priv.public_key().address()
@@ -342,7 +351,8 @@ class ValidatorNode:
         # the same content-addressed entries and the same roots
         self.app = App(chain_id=chain_id, engine=engine, data_dir=data_dir,
                        v2_upgrade_height=v2_upgrade_height,
-                       upgrade_height_delay=upgrade_height_delay)
+                       upgrade_height_delay=upgrade_height_delay,
+                       da_scheme=da_scheme)
         self.app.init_chain(genesis)
         # THE mempool: the shared CAT pool (celestia_app_tpu/mempool) —
         # the pre-CAT validator list grew unboundedly (no cap, no TTL) and
@@ -944,7 +954,7 @@ def capture_app_snapshot(app: App) -> dict:
     committed store + chain identity at one instant. Cheap (dict copy);
     the expensive chunk encoding happens in encode_app_snapshot, safely
     outside the lock."""
-    return {
+    capture = {
         "items": app.store.snapshot(),  # already a fresh copy (state.py)
         "height": app.height,
         "app_hash": app.last_app_hash.hex(),
@@ -953,6 +963,16 @@ def capture_app_snapshot(app: App) -> dict:
         "genesis_time": app.genesis_time,
         "last_block_hash": app.last_block_hash.hex(),
     }
+    codec = getattr(app, "codec", None)
+    if codec is not None and codec.scheme_id:
+        # codec plane: a joiner must refuse a snapshot from a chain run
+        # under a different DA scheme (its stored blocks would neither
+        # replay nor serve samples here). Stamped only for non-default
+        # schemes so default-scheme manifests — and their content
+        # digests, which key restore resume dirs — stay byte-identical
+        # to pre-plane ones (FORMATS §16.1 back-compat rule).
+        capture["da_scheme"] = codec.name
+    return capture
 
 
 def encode_app_snapshot(capture: dict) -> tuple[dict, list[bytes]]:
@@ -988,6 +1008,14 @@ def state_sync_bootstrap(node_or_app, manifest: dict, chunks: list[bytes]) -> No
     trusted header's app_hash — altered chunks are rejected wholesale.
     Accepts a ValidatorNode or a bare App."""
     app = getattr(node_or_app, "app", node_or_app)
+    codec = getattr(app, "codec", None)
+    local_scheme = codec.name if codec is not None else "rs2d-nmt"
+    if manifest.get("da_scheme", "rs2d-nmt") != local_scheme:
+        # codec plane: adopting another scheme's state would leave this
+        # node unable to replay or serve the chain it just joined
+        raise ValueError(
+            f"snapshot is from a {manifest.get('da_scheme')!r}-scheme "
+            f"chain; this node runs {local_scheme!r}")
     if len(chunks) != manifest["n_chunks"]:
         raise ValueError("chunk count mismatch")
     for i, c in enumerate(chunks):

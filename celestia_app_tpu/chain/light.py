@@ -61,16 +61,40 @@ class LightClient:
         if check_set:
             self._check_set(trusted.validators, trusted.powers)
 
-    def submit_fraud_proof(self, dah, befp) -> bool:
-        """A gossiped bad-encoding fraud proof (da/fraud.BadEncodingProof)
-        against a block's DAH: if it VERIFIES — the committed roots carry a
-        non-codeword — the data root is condemned and any header carrying
-        it will be refused. Returns whether the proof checked out."""
+    def submit_fraud_proof(self, commitments, proof) -> bool:
+        """A gossiped incorrect-coding fraud proof against a block's DA
+        commitments: a da/fraud.BadEncodingProof against a DAH, or a
+        da/cmt.CmtFraudProof against CmtCommitments — the proof type
+        selects the codec (da/codec.py). If it VERIFIES — the committed
+        roots carry an invalid codeword — the data root
+        (``commitments.hash()``, whichever scheme) is condemned and any
+        header carrying it will be refused. Returns whether the proof
+        checked out."""
+        from celestia_app_tpu.da import codec as dacodec
         from celestia_app_tpu.da import fraud
 
-        if not fraud.verify_befp(dah, befp):
+        if isinstance(proof, fraud.BadEncodingProof):
+            codec = dacodec.get(dacodec.RS2D_NAME)
+        else:
+            from celestia_app_tpu.da import cmt
+
+            if not isinstance(proof, cmt.CmtFraudProof):
+                return False
+            codec = dacodec.get(dacodec.CMT_NAME)
+        try:
+            ok = codec.verify_fraud_proof(commitments, proof)
+        except Exception:
+            # untrusted input end to end: a proof whose type does not
+            # match the commitments' scheme (e.g. a BEFP against
+            # CmtCommitments) must be refused, never escape — this API
+            # promises a bool verdict on gossip
+            from celestia_app_tpu.utils import telemetry
+
+            telemetry.incr("light.malformed_fraud_proofs")
             return False
-        self.condemned_roots.add(dah.hash())
+        if not ok:
+            return False
+        self.condemned_roots.add(commitments.hash())
         return True
 
     @staticmethod

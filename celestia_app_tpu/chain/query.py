@@ -65,10 +65,12 @@ def build_prover_entry(app, height: int):
     ods = dah_mod.shares_to_ods(square.share_bytes())
     cache = getattr(app, "eds_cache", None)
     engine = getattr(app, "engine", "auto")
+    codec = getattr(app, "codec", None)
+    scheme = codec.name if codec is not None else "rs2d-nmt"
     if cache is not None:
-        entry = cache.get_or_compute(ods, engine)
+        entry = cache.get_or_compute(ods, engine, scheme)
     else:  # bare apps (fixtures) still get the one-shot pipeline
-        entry = edscache_mod.compute_entry(ods, engine)
+        entry = edscache_mod.compute_entry(ods, engine, scheme)
     if entry.data_root != block.header.data_hash:
         # a Byzantine (or corrupted-store) header can never be served
         # from the cache: the entry is a pure function of the ODS and the
@@ -83,6 +85,12 @@ def build_prover(app, height: int):
     consume; the prover builds at most once per entry (lazily, or ahead
     of time by the commit warmer)."""
     block, square, entry = build_prover_entry(app, height)
+    if entry.scheme != "rs2d-nmt":
+        # share/tx inclusion proofs are an NMT-range construction; other
+        # codec-plane schemes serve their own sample proofs via /das/*
+        raise QueryError(
+            f"share proofs are not defined under DA scheme "
+            f"{entry.scheme!r}")
     prover = entry.get_prover(getattr(app, "engine", "auto"))
     return block, square, prover, entry.data_root
 

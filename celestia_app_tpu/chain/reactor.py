@@ -1156,6 +1156,7 @@ class ConsensusReactor:
             self._peer_order(prefer), workdir, net=self.net,
             workers=self.cfg.statesync_workers, min_height=floor,
             name=self.vnode.name,
+            da_scheme=sync_mod.scheme_of(self.vnode),
         )
         try:
             manifest, chunks = client.fetch()

@@ -90,6 +90,20 @@ def _manifest_ok(m) -> bool:
             and len(m["chunk_hashes"]) == m["n_chunks"])
 
 
+def manifest_scheme(m: dict) -> str:
+    """DA scheme a snapshot's chain runs under; absent ⇒ the default
+    (pre-codec-plane manifests never carried it — FORMATS §16.1)."""
+    return m.get("da_scheme", "rs2d-nmt")
+
+
+def scheme_of(node_or_app) -> str:
+    """The DA scheme a node/app runs under (its configured codec);
+    the local-side twin of `manifest_scheme`."""
+    app = getattr(node_or_app, "app", node_or_app)
+    codec = getattr(app, "codec", None)
+    return codec.name if codec is not None else "rs2d-nmt"
+
+
 def home_for(node_or_app) -> str | None:
     """The --home directory a node's durable state lives under (data is
     ``<home>/data``), or None for an in-memory node."""
@@ -303,7 +317,8 @@ class StateSyncClient:
 
     def __init__(self, peers: list[str], workdir: str, net=None,
                  workers: int = 4, min_height: int = 0,
-                 name: str = "statesync"):
+                 name: str = "statesync",
+                 da_scheme: str = "rs2d-nmt"):
         from celestia_app_tpu.net.transport import PeerClient
 
         self.peers = [u.rstrip("/") for u in peers if u]
@@ -311,6 +326,8 @@ class StateSyncClient:
         self.net = net if net is not None else PeerClient(name=name)
         self.workers = max(1, int(workers))
         self.min_height = int(min_height)
+        # only same-scheme snapshots are restorable (codec plane)
+        self.da_scheme = da_scheme
         self._lock = threading.Lock()
         # the shared chunk table the fetcher threads coordinate through
         self._queue: list[int] = []       # guarded-by: _lock
@@ -342,6 +359,11 @@ class StateSyncClient:
                 continue
             for m in (doc.get("snapshots") or []):
                 if not _manifest_ok(m):
+                    continue
+                if manifest_scheme(m) != self.da_scheme:
+                    # wrong-scheme snapshots are unrestorable here;
+                    # skip at discovery instead of after a full pull
+                    # (state_sync_bootstrap would refuse them anyway)
                     continue
                 h = int(m["height"])
                 if h <= self.min_height:

@@ -59,6 +59,7 @@ def _make_app(home: str):
         invariant_check_period=cfg.get("invariant_check_period", 0),
         v2_upgrade_height=cfg.get("v2_upgrade_height"),
         upgrade_height_delay=cfg.get("upgrade_height_delay"),
+        da_scheme=cfg.get("da_scheme", "rs2d-nmt"),
     )
     import weakref
 
@@ -393,6 +394,7 @@ def _write_config(home: str, chain_id: str, engine: str = "auto") -> None:
                 "chain_id": chain_id,
                 "app_version": 1,
                 "engine": engine,
+                "da_scheme": "rs2d-nmt",
                 "min_gas_price": appconsts.DEFAULT_MIN_GAS_PRICE,
                 "invariant_check_period": 0,
                 "v2_upgrade_height": None,
@@ -1008,6 +1010,10 @@ def cmd_validator_serve(args) -> int:
         # validator is provisioned with
         v2_upgrade_height=home_cfg.get("v2_upgrade_height"),
         upgrade_height_delay=home_cfg.get("upgrade_height_delay"),
+        # the DA commitment scheme (codec plane) is consensus-critical
+        # like the upgrade knobs above: every validator of a chain must
+        # be provisioned with the same one (absent ⇒ rs2d-nmt)
+        da_scheme=home_cfg.get("da_scheme", "rs2d-nmt"),
     )
     # fault plane (chaos provisioning): <home>/faults.json arms named
     # fault points for THIS process at startup — the config-file twin of

@@ -119,8 +119,23 @@ def new_dah_from_ods(ods: np.ndarray) -> tuple[DataAvailabilityHeader, ExtendedD
     return dah, ExtendedDataSquare(np.asarray(eds)), bytes(np.asarray(data_root))
 
 
-def min_dah() -> DataAvailabilityHeader:
-    """DAH of the minimum square: one tail-padding share (reference :176-190)."""
-    share = shares_mod.tail_padding_share()
-    dah, _, _ = new_dah_from_ods(shares_to_ods([share]))
-    return dah
+def min_dah(scheme: str = "rs2d-nmt"):
+    """Commitments of the minimum (empty-block) square — one tail-padding
+    share — under the given DA scheme: the DataAvailabilityHeader of
+    reference :176-190 for the default, the scheme's own commitments
+    object otherwise (codec plane, da/codec.py). Either way
+    ``.hash()`` is the scheme's genesis/empty data root (pinned per
+    scheme in tests/test_codec_iface.py)."""
+    if scheme == "rs2d-nmt":
+        share = shares_mod.tail_padding_share()
+        dah, _, _ = new_dah_from_ods(shares_to_ods([share]))
+        return dah
+    from celestia_app_tpu.da import codec as dacodec
+
+    return dacodec.get(scheme).min_entry().dah
+
+
+def min_data_root(scheme: str = "rs2d-nmt") -> bytes:
+    """The empty-block data root per scheme (the value an empty-block
+    header carries under that scheme)."""
+    return min_dah(scheme).hash()

@@ -63,7 +63,7 @@ from celestia_app_tpu.utils import telemetry
 DEFAULT_MAX_ENTRIES = int(os.environ.get("CELESTIA_EDSCACHE_ENTRIES", "4"))
 
 
-def cache_key(ods: np.ndarray) -> bytes:
+def cache_key(ods: np.ndarray, scheme: str = "rs2d-nmt") -> bytes:
     """Content address of an original data square: sha256 over the ODS
     share bytes in row-major order. Shares are fixed-size (512 B) and the
     count is k*k, so the byte string determines the geometry — two squares
@@ -74,9 +74,17 @@ def cache_key(ods: np.ndarray) -> bytes:
     8 MB `.tobytes()` staging copy at k=128; `ascontiguousarray` is a
     no-op then and only copies for exotic layouts. The hash itself is
     single-digit ms at k=128 (OpenSSL SHA-NI) against the 2-3 full
-    extend+NMT dispatches per height it deduplicates."""
+    extend+NMT dispatches per height it deduplicates.
+
+    Non-default codec-plane schemes (da/codec.py) prefix their name so
+    the same square encoded under two schemes occupies two entries;
+    the default scheme's keys stay byte-identical to pre-plane keys."""
     arr = np.ascontiguousarray(ods)
-    return hashlib.sha256(arr.data).digest()
+    h = hashlib.sha256()
+    if scheme != "rs2d-nmt":
+        h.update(scheme.encode() + b"\x00")
+    h.update(arr.data)
+    return h.digest()
 
 
 class EdsCacheEntry:
@@ -84,7 +92,13 @@ class EdsCacheEntry:
     plus the lazily-built proof machinery. The extension fields are
     immutable after construction; the provers build at most once, under
     the entry's own lock (never a service/consensus lock), so concurrent
-    samplers of a fresh entry pay one level pass between them."""
+    samplers of a fresh entry pay one level pass between them.
+
+    The ``scheme``/``k``/``warm`` surface is the codec plane's common
+    entry contract (da/codec.py): non-default schemes cache their own
+    entry types (e.g. da/cmt.CmtEntry) in the same EdsCache."""
+
+    scheme = "rs2d-nmt"
 
     def __init__(self, eds: ExtendedDataSquare,
                  dah: DataAvailabilityHeader, data_root: bytes,
@@ -134,6 +148,15 @@ class EdsCacheEntry:
                 telemetry.measure_since("das.col_tree_build", t0)
             return self._col_prover
 
+    @property
+    def k(self) -> int:
+        return self.eds.width // 2
+
+    def warm(self, engine: str = "auto") -> None:
+        """Pre-build both provers (the warmer's per-scheme hook)."""
+        self.get_prover(engine)
+        self.get_col_prover(engine)
+
     def warmed(self) -> bool:
         # fixed acquisition order (row, then col) — no other path nests
         # the two locks, so no inversion is possible
@@ -143,15 +166,27 @@ class EdsCacheEntry:
             return row_ready and self._col_prover is not None
 
 
-def compute_entry(ods: np.ndarray, engine: str = "auto") -> EdsCacheEntry:
-    """THE extend+commit dispatch: ODS -> EdsCacheEntry, engine-gated.
+def compute_entry(ods: np.ndarray, engine: str = "auto",
+                  scheme: str = "rs2d-nmt"):
+    """THE encode+commit dispatch: ODS -> scheme entry, engine-gated.
 
     ``engine="device"`` requires the jax path (raises on failure),
     ``"host"`` never touches jax (the relay-down hang class: a down
     accelerator relay HANGS backend init, wedging whatever lock the
     caller holds), ``"auto"`` tries device and degrades loudly. Every
-    call is one real RS+NMT dispatch and counts ``da.extend_runs`` —
-    the telemetry pin tests assert at most one per (node, height)."""
+    call is one real encode dispatch and counts ``da.extend_runs`` —
+    the telemetry pin tests assert at most one per (node, height),
+    whichever scheme the chain runs. The default scheme's body below is
+    the pre-codec-plane pipeline, untouched (byte-identity pinned in
+    tests/test_codec_iface.py); other schemes dispatch through the
+    codec registry's raw encode hook (da/codec.py) — an unknown scheme
+    raises BEFORE the counter moves (no phantom extend_runs)."""
+    if scheme != "rs2d-nmt":
+        from celestia_app_tpu.da import codec as codec_mod
+
+        codec = codec_mod.get(scheme)  # CodecError on unknown schemes
+        telemetry.incr("da.extend_runs")
+        return codec._encode_impl(ods, engine)
     telemetry.incr("da.extend_runs")
     if engine in ("device", "auto"):
         try:
@@ -287,14 +322,15 @@ class EdsCache:
             self._entries.move_to_end(key)
             return self._entries[key]
 
-    def get_or_compute(self, ods: np.ndarray,
-                       engine: str = "auto") -> EdsCacheEntry:
-        """The lifecycle read path: one extend per content, ever."""
-        key = cache_key(ods)
+    def get_or_compute(self, ods: np.ndarray, engine: str = "auto",
+                       scheme: str = "rs2d-nmt") -> EdsCacheEntry:
+        """The lifecycle read path: one encode per (scheme, content),
+        ever."""
+        key = cache_key(ods, scheme)
         entry = self.get(key)
         if entry is not None:
             return entry
-        return self.put(key, compute_entry(ods, engine))
+        return self.put(key, compute_entry(ods, engine, scheme))
 
     def clear(self) -> None:
         with self._lock:
@@ -358,10 +394,10 @@ class ProverWarmer:
                 with obs.span(
                     "da.prover_warm", traces=traces,
                     trace_id=obs.trace_id_for(chain_id, height),
-                    height=height, k=entry.eds.width // 2, engine=engine,
+                    height=height, k=entry.k, engine=engine,
+                    scheme=entry.scheme,
                 ):
-                    entry.get_prover(engine)
-                    entry.get_col_prover(engine)
+                    entry.warm(engine)
             except Exception as e:
                 # warmup is an optimization: a failure must never take
                 # the process down, but it must be visible

@@ -38,10 +38,31 @@ class SampleReport:
         return not self.failed
 
 
+def catch_confidence(s: int, scheme: str = "rs2d-nmt") -> float:
+    """Availability confidence after s verified samples, per scheme:
+    1 - (1 - alpha)^s with alpha the SCHEME'S catch probability (the
+    codec plane's per-scheme threshold, da/codec.py — 2D-RS's
+    combinatorial 1/4, CMT's peeling threshold)."""
+    from celestia_app_tpu.da import codec as dacodec
+
+    return dacodec.get(scheme).confidence(s)
+
+
+def samples_for_confidence(target: float = 0.99,
+                           scheme: str = "rs2d-nmt") -> int:
+    """Smallest s with catch_confidence(s, scheme) >= target."""
+    from celestia_app_tpu.da import codec as dacodec
+
+    return dacodec.get(scheme).samples_for_confidence(target)
+
+
 def withholding_catch_confidence(s: int) -> float:
-    """1 - (3/4)^s: the standard DAS bound (a withholding producer must
-    hide > 1/4 of extended cells to lose any original share)."""
-    return 1.0 - 0.75**s
+    """1 - (3/4)^s: the standard 2D-RS DAS bound (a withholding producer
+    must hide > 1/4 of extended cells to lose any original share). The
+    historical name for the default scheme's instance of
+    `catch_confidence`; other schemes have their own thresholds on the
+    codec interface."""
+    return catch_confidence(s, "rs2d-nmt")
 
 
 def leaf_namespace(row: int, col: int, share: bytes, k: int) -> bytes:

@@ -49,6 +49,7 @@ import numpy as np
 from celestia_app_tpu import appconsts
 from celestia_app_tpu import obs
 from celestia_app_tpu.chain import light as light_mod
+from celestia_app_tpu.da import codec as dacodec
 from celestia_app_tpu.da import fraud, repair, sampling
 from celestia_app_tpu.da.dah import DataAvailabilityHeader
 from celestia_app_tpu.das.checkpoint import Checkpoint, CheckpointStore
@@ -237,19 +238,17 @@ class DASer:
 
     # -- sampling workers ------------------------------------------------
 
-    def _fetch_dah(self, height: int, root_hex: str,
-                   square_size: int) -> DataAvailabilityHeader:
+    def _fetch_commitments(self, height: int, root_hex: str,
+                           square_size: int):
+        """(codec, commitments) for a height: the served commitments doc
+        names its scheme (absent ⇒ rs2d-nmt) and the codec parses AND
+        verifies it against the certified root — bounds/shapes first,
+        binding second, all on untrusted input (da/codec.py). For the
+        default scheme this is exactly the old inline DAH checks."""
         doc = self.peers.request(f"/das/header?height={height}")
-        dah = DataAvailabilityHeader(
-            row_roots=tuple(bytes.fromhex(x) for x in doc["row_roots"]),
-            col_roots=tuple(bytes.fromhex(x) for x in doc["col_roots"]),
-        )
-        dah.validate_basic()  # untrusted input: bounds/shapes first
-        if dah.hash().hex() != root_hex:
-            raise ValueError("served DAH does not bind to the certified root")
-        if len(dah.row_roots) != 2 * square_size:
-            raise ValueError("served DAH width contradicts the header")
-        return dah
+        codec = dacodec.get(doc.get("scheme", dacodec.RS2D_NAME))
+        return codec, codec.commitments_from_doc(doc, root_hex,
+                                                 square_size)
 
     @staticmethod
     def _decode_sample(s: dict) -> tuple[bytes, nmt_host.NmtRangeProof]:
@@ -325,10 +324,17 @@ class DASer:
         rng = rng if rng is not None else self.rng
         t0 = time.perf_counter()
         try:
-            dah = self._fetch_dah(height, root_hex, square_size)
+            codec, commitments = self._fetch_commitments(
+                height, root_hex, square_size)
         except (PeerError, ValueError, KeyError) as e:
             telemetry.incr("daser.header_fetch_failures")
             return {"status": "error", "error": str(e)}
+        if codec.name != dacodec.RS2D_NAME:
+            out = self._sample_height_codec(height, codec, commitments,
+                                            root_hex, rng)
+            telemetry.measure_since("daser.sample_height", t0)
+            return out
+        dah = commitments
         width = len(dah.row_roots)
         s = self.cfg.samples_per_header
         coords = [
@@ -370,6 +376,151 @@ class DASer:
         out = {**report, **self._escalate(height, dah, root_hex)}
         telemetry.measure_since("daser.sample_height", t0)
         return out
+
+    # -- non-default schemes: codec-interface sampling + escalation ------
+
+    def _verify_cells_codec(self, codec, commitments,
+                            docs: list[dict]) -> tuple[dict, list]:
+        """Split served docs into {cell: (payload, doc)} verified via
+        the codec and the list of failed cells. The full doc rides along
+        because a fraud proof's members carry their served proofs."""
+        good: dict[tuple[int, int], tuple] = {}
+        failed: list[tuple[int, int]] = []
+        for s in docs:
+            coord = (int(s["row"]), int(s["col"]))
+            if "error" in s:
+                failed.append(coord)
+                continue
+            got = codec.verify_sample(commitments, s)
+            if got is not None and got[0] == coord:
+                good[coord] = (got[1], s)
+            else:
+                failed.append(coord)
+        return good, failed
+
+    def _sample_height_codec(self, height: int, codec, commitments,
+                             root_hex: str, rng) -> dict:
+        """One height under a non-default scheme (today: cmt-ldpc).
+        Same shape as the 2D-RS flow — draw, batch-fetch, verify,
+        retry, escalate — but cells are the codec's sample space and
+        confidence is the codec's own arithmetic (its catch probability
+        differs per construction)."""
+        s = self.cfg.samples_per_header
+        space = codec.sample_space(commitments)
+        cells = [space[int(rng.integers(0, len(space)))]
+                 for _ in range(s)]
+        try:
+            docs = self._fetch_cells(height, cells)
+        except PeerError as e:
+            return {"status": "error", "error": str(e)}
+        good, failed = self._verify_cells_codec(codec, commitments, docs)
+        delay = self.cfg.backoff
+        for _ in range(self.cfg.retries):
+            if not failed:
+                break
+            time.sleep(delay)
+            delay *= 2
+            try:
+                docs = self._fetch_cells(height, failed)
+            except PeerError:
+                continue
+            recovered, failed = self._verify_cells_codec(
+                codec, commitments, docs)
+            good.update(recovered)
+        telemetry.incr("daser.samples_verified", len(good))
+        report = {
+            "samples": s,
+            "verified": len(good),
+            "failed": sorted(set(failed)),
+            "confidence": codec.confidence(s),
+            "scheme": codec.name,
+        }
+        if not failed:
+            telemetry.incr("daser.headers_sampled")
+            return {**report, "status": "sampled"}
+        telemetry.incr("daser.samples_failed", len(set(failed)))
+        return {**report,
+                **self._escalate_codec(height, codec, commitments,
+                                       root_hex)}
+
+    def _escalate_codec(self, height: int, codec, commitments,
+                        root_hex: str) -> dict:
+        """Codec-interface escalation: fetch every obtainable base
+        symbol in bounded batches, run the scheme's repair (the peeling
+        decoder for cmt-ldpc), and either clear the block, condemn it
+        with a verified fraud proof, or record it unavailable. Scheme-
+        generic: the only detection type caught is the interface's
+        BadEncodingDetected base, and proof assembly goes through the
+        codec's fraud_cells/fraud_proof_from_members hooks."""
+        telemetry.incr("daser.escalations")
+        space = codec.sample_space(commitments)
+        docs_map: dict[tuple[int, int], tuple] = {}
+        chunk = 256  # bounded request batches (the rs2d row discipline)
+        for start in range(0, len(space), chunk):
+            try:
+                docs = self._fetch_cells(height,
+                                         space[start:start + chunk])
+            except PeerError:
+                continue
+            good, _failed = self._verify_cells_codec(codec, commitments,
+                                                     docs)
+            docs_map.update(good)
+        if not docs_map:
+            return {"status": "unavailable",
+                    "error": "no peer served any reconstruction cells"}
+        samples = {cell: payload
+                   for cell, (payload, _doc) in docs_map.items()}
+        try:
+            t_rep = telemetry.start_timer()
+            try:
+                codec.repair(commitments, samples)
+            finally:
+                telemetry.measure_since("daser.repair", t_rep)
+        except dacodec.BadEncodingDetected as e:
+            proof = self._build_codec_fraud(height, codec, commitments,
+                                            docs_map, e.location)
+            if proof is not None and self.light.submit_fraud_proof(
+                    commitments, proof):
+                telemetry.incr("daser.befp_verified")
+                self._halt(height, "bad-encoding", root_hex)
+                return {"status": "fraud",
+                        "location": list(e.location)}
+            telemetry.incr("daser.befp_failed")
+            return {"status": "unavailable",
+                    "error": f"bad encoding at {e.location} but fraud "
+                             "proof could not be assembled"}
+        except ValueError as e:
+            telemetry.incr("daser.unavailable")
+            return {"status": "unavailable", "error": str(e)}
+        telemetry.incr("daser.recovered")
+        return {"status": "recovered"}
+
+    def _build_codec_fraud(self, height: int, codec, commitments,
+                           docs_map: dict, location):
+        """Assemble the scheme's compact fraud proof from served symbol
+        docs (each already carries its own inclusion proof); any member
+        missing from the escalation sweep is fetched by its cell."""
+        try:
+            cells = codec.fraud_cells(commitments, location)
+        except NotImplementedError:
+            return None
+        carried = []
+        for cell in cells:
+            got = docs_map.get(cell)
+            if got is None:
+                try:
+                    docs = self._fetch_cells(height, [cell])
+                except PeerError:
+                    return None
+                good, _failed = self._verify_cells_codec(
+                    codec, commitments, docs)
+                got = good.get(cell)
+            if got is None:
+                return None
+            payload, doc = got
+            carried.append((cell, payload, doc))
+        return codec.fraud_proof_from_members(commitments, location,
+                                              carried)
 
     # -- escalation: repair -> fraud proof -------------------------------
 

@@ -46,6 +46,7 @@ from __future__ import annotations
 import collections
 import threading
 
+from celestia_app_tpu.da import codec as codec_mod
 from celestia_app_tpu.da import edscache as edscache_mod
 from celestia_app_tpu.da.dah import DataAvailabilityHeader, ExtendedDataSquare
 from celestia_app_tpu.utils import telemetry
@@ -74,8 +75,20 @@ class _Entry:
         self._col_prover_view = None
 
     @property
-    def dah(self) -> DataAvailabilityHeader:
+    def dah(self):
+        """The scheme's commitments object (a DataAvailabilityHeader
+        under rs2d-nmt, a CmtCommitments under cmt-ldpc)."""
         return self.cache_entry.dah
+
+    @property
+    def scheme(self) -> str:
+        return self.cache_entry.scheme
+
+    @property
+    def width(self) -> int:
+        """Extended-square width (2k) — the geometry stat availability
+        records carry for every scheme."""
+        return 2 * self.cache_entry.k
 
     @property
     def root(self) -> bytes:
@@ -206,6 +219,13 @@ class SampleCore:
         telemetry.incr("edscache.seeded_external")
         self._seed(height, edscache_mod.EdsCacheEntry(eds, dah, dah.hash()))
 
+    def seed_scheme_entry(self, height: int, cache_entry) -> None:
+        """Scheme-generic twin of seed_entry: serve ANY codec-plane
+        entry already in memory (e.g. a da/cmt.CmtEntry a test fixture
+        or gossip handoff holds). Counted with the external seeds."""
+        telemetry.incr("edscache.seeded_external")
+        self._seed(height, cache_entry)
+
     def _seed(self, height: int,
               cache_entry: edscache_mod.EdsCacheEntry) -> None:
         self._remember(_Entry(height, cache_entry, self._engine()))
@@ -244,21 +264,25 @@ class SampleCore:
         return {"height": max(self.app.height, self._max_seeded)}
 
     def header(self, height: int) -> dict:
+        """The scheme's commitments doc (+height): the old DAH shape
+        (row/col roots) under rs2d-nmt — now with a "scheme" member old
+        clients ignore — or the CMT parameter/root-hash doc (FORMATS
+        §16.2). Either binds to the certified data root."""
         entry = self._entry(height)
-        return {
-            "height": height,
-            "square_width": len(entry.dah.row_roots),
-            "row_roots": [r.hex() for r in entry.dah.row_roots],
-            "col_roots": [c.hex() for c in entry.dah.col_roots],
-            "data_root": entry.root.hex(),
-        }
+        codec = codec_mod.get(entry.scheme)
+        return {"height": height,
+                **codec.commitments_doc(entry.cache_entry)}
 
     def _one(self, entry: _Entry, row: int, col: int, axis: str) -> dict:
-        width = len(entry.dah.row_roots)
-        if not (0 <= row < width and 0 <= col < width):
-            raise SampleError(
-                f"cell ({row}, {col}) outside the {width}x{width} square"
-            )
+        if entry.scheme == codec_mod.RS2D_NAME:
+            width = len(entry.dah.row_roots)
+            if not (0 <= row < width and 0 <= col < width):
+                raise SampleError(
+                    f"cell ({row}, {col}) outside the {width}x{width} "
+                    "square"
+                )
+        # ONE withholding/fault gate for every scheme: (row, col) is the
+        # generic wire cell pair ((layer, index) for non-default codecs)
         held = self._withheld.get(entry.height)
         if held and (row, col) in held:
             self._note(entry, withheld=1)
@@ -272,6 +296,8 @@ class SampleCore:
                        row=row, col=col) in ("drop", "error"):
             self._note(entry, withheld=1)
             raise SampleError(f"cell ({row}, {col}) not served")
+        if entry.scheme != codec_mod.RS2D_NAME:
+            return self._one_codec(entry, row, col)
         if axis == "row":
             share, proof = entry.prover.prove_cell(row, col)
         else:
@@ -290,6 +316,19 @@ class SampleCore:
                 "nodes": [_b64(n) for n in proof.nodes],
             },
         }
+
+    def _one_codec(self, entry: _Entry, layer: int, index: int) -> dict:
+        """Non-default-scheme cell: the wire (row, col) pair is the
+        scheme's (layer, index) — FORMATS §16.3. The withholding fixture
+        and the das.serve_sample fault point already gated in _one."""
+        codec = codec_mod.get(entry.scheme)
+        try:
+            doc = codec.open_sample(entry.cache_entry, (layer, index))
+        except codec_mod.CodecError as e:
+            raise SampleError(str(e)) from None
+        # row/col aliases keep the batched-response shape uniform across
+        # schemes (per-cell error members, availability bookkeeping)
+        return {"row": layer, "col": index, **doc}
 
     def sample(self, height: int, row: int, col: int,
                axis: str = "row") -> dict:
@@ -342,8 +381,9 @@ class SampleCore:
         return {
             "height": height,
             "data_root": entry.root.hex(),
+            "scheme": entry.scheme,
             "axis": axis,
-            "square_width": len(entry.dah.row_roots),
+            "square_width": entry.width,
             "samples": samples,
         }
 
@@ -355,7 +395,7 @@ class SampleCore:
             rec = self._availability.setdefault(entry.height, {
                 "height": entry.height,
                 "data_root": entry.root.hex(),
-                "square_width": len(entry.dah.row_roots),
+                "square_width": entry.width,
                 "samples_served": 0,
                 "batches": 0,
                 "withheld_refusals": 0,

@@ -1,0 +1,365 @@
+"""Sparse GF(2) LDPC (LDGM) codes for the Coded Merkle Tree scheme.
+
+The CMT construction (arXiv:1910.01247) codes every tree layer with a
+sparse erasure code whose *peeling* decoder gives (a) cheap repair from
+any large-enough symbol subset and (b) O(1)-sized incorrect-coding fraud
+proofs: one violated parity equation, carried with the Merkle proofs of
+its d+1 members. This module is the code itself, scheme-agnostic:
+
+- **Construction.** Systematic LDGM: coded = [data || parity], parity p
+  is the XOR of ``degree`` distinct data symbols. The neighbor table is a
+  pure function of (n_data, degree, tag) — ``degree`` deterministic
+  pseudorandom permutations of [0, n_data) from a splitmix64 stream
+  seeded by sha256(tag), with collisions probed away — so every node
+  derives the identical code from the scheme parameters alone; nothing
+  rides the wire, and fraud verifiers recompute the equation membership
+  they check against (da/cmt.py).
+
+- **Encode.** Host engine: one XOR-gather (``np.bitwise_xor.reduce`` over
+  the gathered neighbor symbols). Device engine: the same GF(2) algebra
+  as ops/rs.py — unpack symbols to bits, ONE bit-matmul
+  ``(G @ data_bits) & 1`` with the dense 0/1 generator on the MXU, pack —
+  jitted per (n_data, symbol-size) bucket. Bit-identical by construction
+  (pinned in tests/test_codec_iface.py).
+
+- **Peeling decode.** Iterative degree-1 resolution expressed as masked
+  matmul sweeps (the fused-decode-matrix discipline of
+  ops/leopard_decode.py): per sweep, ``M @ unknown`` counts unknowns per
+  equation, ``(M * known) @ sym_bits`` XORs each equation's known
+  members, and equations with exactly one unknown scatter that XOR into
+  their missing symbol. Equation→symbol assignment is made deterministic
+  by a commutative scatter-min (the LOWEST equation index resolving a
+  symbol wins), so the host numpy sweep and the jitted lax.while_loop
+  sweep recover byte-identical symbols even from *inconsistent* (fraud)
+  inputs.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+
+import numpy as np
+
+# Regular degree of every parity equation (and, by the permutation
+# construction, of every data symbol). Rate is fixed at 1/2: n_parity ==
+# n_data, so the coded layer is exactly twice the data layer. Degree 8
+# is the measured sweet spot for peeling under random erasure at this
+# rate: d<=4 collapses below a 1/8 erasure fraction at large n, d=6
+# holds 1/8 but not 1/4, d=8 peels a 1/4-erased layer w.h.p. from n=16
+# through n=16384 (the k=128 base layer) — the margin behind the
+# scheme's declared sampling threshold (da/cmt.py CATCH_BP).
+DEGREE = 8
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer: u64 counters -> u64 keys.
+    Platform-pinned integer arithmetic (wrapping u64), no RNG state."""
+    z = (x + np.uint64(0x9E3779B97F4A7C15)).astype(np.uint64)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
+@functools.lru_cache(maxsize=64)
+def parity_indices(n_data: int, degree: int = DEGREE,
+                   tag: bytes = b"cmt") -> np.ndarray:
+    """(n_data, d) int32 neighbor table: parity p = XOR of data[idx[p]].
+
+    d = min(degree, n_data). Column j is a deterministic pseudorandom
+    permutation of [0, n_data) (seeded from sha256(tag || j || n_data)),
+    so data-symbol degree is exactly d as well; within-row collisions are
+    resolved by +1 probing, preserving distinctness per equation (a
+    repeated neighbor would XOR-cancel out of the code)."""
+    d = min(degree, n_data)
+    cols = []
+    for j in range(d):
+        seed = int.from_bytes(
+            hashlib.sha256(
+                tag + b"/" + j.to_bytes(4, "big")
+                + n_data.to_bytes(8, "big")
+            ).digest()[:8],
+            "big",
+        )
+        with np.errstate(over="ignore"):
+            keys = _splitmix64(
+                np.uint64(seed) + np.arange(n_data, dtype=np.uint64)
+            )
+        cols.append(np.argsort(keys, kind="stable").astype(np.int32))
+    idx = np.stack(cols, axis=1)  # (n_data, d)
+    for j in range(1, d):
+        while True:
+            dup = (idx[:, :j] == idx[:, j:j + 1]).any(axis=1)
+            if not dup.any():
+                break
+            idx[dup, j] = (idx[dup, j] + 1) % n_data
+    idx.setflags(write=False)
+    return idx
+
+
+@functools.lru_cache(maxsize=64)
+def membership(n_data: int, degree: int = DEGREE,
+               tag: bytes = b"cmt") -> np.ndarray:
+    """(n_parity, n_coded) u8 0/1 membership matrix of every parity
+    equation over the CODED symbols: the idx neighbors plus the parity
+    symbol itself (coded index n_data + p). The device sweep's fixed
+    per-layer matrix."""
+    idx = parity_indices(n_data, degree, tag)
+    n_parity, d = idx.shape
+    m = np.zeros((n_parity, 2 * n_data), dtype=np.uint8)
+    rows = np.repeat(np.arange(n_parity), d)
+    m[rows, idx.ravel()] = 1
+    m[np.arange(n_parity), n_data + np.arange(n_parity)] = 1
+    m.setflags(write=False)
+    return m
+
+
+# ---------------------------------------------------------------------------
+# encode
+# ---------------------------------------------------------------------------
+
+
+def encode_host(data: np.ndarray, n_data: int | None = None,
+                degree: int = DEGREE, tag: bytes = b"cmt") -> np.ndarray:
+    """(n_data, S) u8 data symbols -> (n_data, S) u8 parity symbols, pure
+    numpy XOR-gather (the host engine's encode; ~ms even at the k=128
+    base layer, where the matmul formulation would be a 1 TFLOP GEMM)."""
+    n = data.shape[0] if n_data is None else n_data
+    idx = parity_indices(n, degree, tag)
+    return np.bitwise_xor.reduce(data[idx], axis=1)
+
+
+@functools.lru_cache(maxsize=32)
+def jitted_encode(n_data: int, sym_bytes: int, degree: int = DEGREE,
+                  tag: bytes = b"cmt"):
+    """Compiled device encode for one layer geometry: (n_data, S) u8 ->
+    (n_data, S) u8 parity as ONE GF(2) bit-matmul (G @ data_bits) & 1 —
+    the ops/rs.py playbook with the LDGM generator as the bit matrix.
+    The generator rides as a closed-over device constant per (n_data, S)
+    bucket; upper CMT layers reuse buckets across heights."""
+    import jax
+    import jax.numpy as jnp
+
+    from celestia_app_tpu.obs import jax_profile
+
+    jax_profile.note_compile("ldpc.encode", (n_data, sym_bytes))
+    idx = parity_indices(n_data, degree, tag)
+    g = np.zeros((n_data, n_data), dtype=np.int8)
+    g[np.repeat(np.arange(n_data), idx.shape[1]), idx.ravel()] = 1
+    g = jnp.asarray(g)
+
+    def run(data: jax.Array) -> jax.Array:
+        shifts = jnp.arange(8, dtype=jnp.uint8)
+        bits = ((data[..., None] >> shifts) & 1).reshape(n_data, -1)
+        out = jnp.einsum("pq,qs->ps", g, bits.astype(jnp.int8),
+                         preferred_element_type=jnp.int32) & 1
+        by = out.reshape(n_data, sym_bytes, 8).astype(jnp.uint8)
+        weights = (1 << jnp.arange(8, dtype=jnp.uint8))
+        return jnp.sum(by * weights, axis=-1).astype(jnp.uint8)
+
+    return jax.jit(run)
+
+
+def auto_wants_device() -> bool:
+    """Whether engine="auto" should take the jitted path: only on a real
+    accelerator backend. On CPU the XOR-gather/hashlib host paths beat
+    XLA's dense bit-matmuls by orders of magnitude at the base-layer
+    sizes (the same reasoning that makes utils/fast_host the CPU
+    baseline); the matmul formulation exists for the MXU. "device"
+    still forces the jitted path on any backend (bit-identity tests)."""
+    try:
+        import jax
+
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        # no usable jax at all: fall to host, visibly
+        from celestia_app_tpu.utils import telemetry
+
+        telemetry.incr("app.device_path_fallback")
+        return False
+
+
+def encode(data: np.ndarray, engine: str = "auto", degree: int = DEGREE,
+           tag: bytes = b"cmt") -> np.ndarray:
+    """Engine-gated parity encode; both paths bit-identical."""
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    if engine == "auto" and not auto_wants_device():
+        return encode_host(data, degree=degree, tag=tag)
+    if engine in ("device", "auto"):
+        try:
+            import jax.numpy as jnp
+
+            run = jitted_encode(data.shape[0], data.shape[1], degree, tag)
+            return np.asarray(run(jnp.asarray(data)))
+        except Exception:
+            if engine == "device":
+                raise
+            from celestia_app_tpu.utils import telemetry
+
+            telemetry.incr("app.device_path_fallback")
+    return encode_host(data, degree=degree, tag=tag)
+
+
+# ---------------------------------------------------------------------------
+# peeling decode
+# ---------------------------------------------------------------------------
+
+
+def peel_host(symbols: np.ndarray, known: np.ndarray,
+              degree: int = DEGREE,
+              tag: bytes = b"cmt") -> tuple[np.ndarray, np.ndarray, int]:
+    """Peel erasures out of one coded layer on the host.
+
+    ``symbols`` is (n_coded, S) u8 with arbitrary bytes at unknown
+    positions; ``known`` (n_coded,) bool. Returns (symbols, known,
+    sweeps) with every peelable symbol resolved — the caller decides
+    whether a residual unknown set means unavailability. Inputs are not
+    mutated. Resolution rule (shared with the device sweep, so the two
+    engines agree even on inconsistent fraud inputs): per sweep, every
+    equation with exactly one unknown member resolves it to the XOR of
+    its known members; when several equations target the same symbol the
+    LOWEST equation index wins."""
+    n_coded = symbols.shape[0]
+    n_data = n_coded // 2
+    idx = parity_indices(n_data, degree, tag)
+    d = idx.shape[1]
+    members = np.concatenate(
+        [idx, (n_data + np.arange(n_data, dtype=np.int32))[:, None]],
+        axis=1,
+    )  # (n_parity, d+1)
+    symbols = symbols.copy()
+    known = known.copy()
+    sweeps = 0
+    while True:
+        unk = ~known
+        m_unk = unk[members]  # (n_parity, d+1)
+        cnt = m_unk.sum(axis=1)
+        resolvable = cnt == 1
+        if not resolvable.any():
+            return symbols, known, sweeps
+        sweeps += 1
+        masked = symbols * known[:, None]
+        eqxor = np.bitwise_xor.reduce(masked[members], axis=1)
+        targets = members[resolvable,
+                          np.argmax(m_unk[resolvable], axis=1)]
+        # lowest-equation-wins on contended targets (mirrors the device
+        # sweep's commutative scatter-min)
+        eq_ids = np.flatnonzero(resolvable)
+        best = np.full(n_coded, len(members), dtype=np.int64)
+        np.minimum.at(best, targets, eq_ids)
+        chosen = best[targets] == eq_ids
+        symbols[targets[chosen]] = eqxor[resolvable][chosen]
+        known[targets[chosen]] = True
+
+
+@functools.lru_cache(maxsize=32)
+def jitted_peel(n_data: int, sym_bytes: int, degree: int = DEGREE,
+                tag: bytes = b"cmt"):
+    """Compiled whole-peel program for one layer geometry: a
+    lax.while_loop of masked-matmul sweeps entirely on device —
+    ``M @ unknown`` counts unknowns per equation, ``(M*known) @ bits``
+    XORs known members, a scatter-min picks one equation per target
+    (commutative, hence deterministic), and a one-hot matmul scatters
+    the resolved bits. One dispatch peels to fixpoint."""
+    import jax
+    import jax.numpy as jnp
+
+    from celestia_app_tpu.obs import jax_profile
+
+    jax_profile.note_compile("ldpc.peel", (n_data, sym_bytes))
+    m_np = membership(n_data, degree, tag)
+    n_parity, n_coded = m_np.shape
+    # int8 end to end with int32 ACCUMULATION only (the jitted_encode
+    # discipline): the dense membership matrix is 512 MB at the k=128
+    # base layer already — an int32 copy would quadruple it
+    m = jnp.asarray(m_np, dtype=jnp.int8)
+
+    def body(state):
+        bits, known, _progressed, sweeps = state
+        kn = known.astype(jnp.int8)
+        cnt = jnp.einsum("pq,q->p", m, 1 - kn,
+                         preferred_element_type=jnp.int32)
+        resolvable = cnt == 1
+        eqxor = (jnp.einsum("pq,qs->ps", m * kn[None, :], bits,
+                            preferred_element_type=jnp.int32)
+                 & 1).astype(jnp.int8)  # (n_parity, 8S)
+        tgt_onehot = m * (1 - kn)[None, :]  # the single unknown member
+        t = jnp.argmax(tgt_onehot, axis=1)  # (n_parity,)
+        eqid = jnp.where(resolvable, jnp.arange(n_parity), n_parity)
+        best = jnp.full((n_coded,), n_parity, dtype=jnp.int32) \
+            .at[t].min(eqid.astype(jnp.int32))
+        chosen = resolvable & (jnp.arange(n_parity) == best[t])
+        sel = tgt_onehot * chosen[:, None]  # one-hot rows, disjoint tgts
+        new_bits = jnp.einsum("pq,ps->qs", sel, eqxor,
+                              preferred_element_type=jnp.int32) & 1
+        newly = jnp.einsum("pq->q", sel.astype(jnp.int32)) > 0
+        bits = jnp.where(newly[:, None], new_bits.astype(jnp.int8), bits)
+        known = known | newly
+        return bits, known, newly.any(), sweeps + 1
+
+    def run(sym_bits: jax.Array, known: jax.Array):
+        # progressed seeds True so the first sweep always runs; the loop
+        # exits after the first sweep that resolves nothing
+        state = (sym_bits.astype(jnp.int8), known, jnp.bool_(True),
+                 jnp.int32(0))
+        bits, kn, _p, sweeps = jax.lax.while_loop(
+            lambda s: s[2], body, state)
+        return bits.astype(jnp.uint8), kn, sweeps
+
+    return jax.jit(run)
+
+
+def _u8_to_bits(x: np.ndarray) -> np.ndarray:
+    return np.unpackbits(x, axis=-1, bitorder="little")
+
+
+def _bits_to_u8(b: np.ndarray) -> np.ndarray:
+    return np.packbits(b.astype(np.uint8), axis=-1, bitorder="little")
+
+
+def peel(symbols: np.ndarray, known: np.ndarray, engine: str = "auto",
+         degree: int = DEGREE,
+         tag: bytes = b"cmt") -> tuple[np.ndarray, np.ndarray, int]:
+    """Engine-gated peeling; device and host are bit-identical (pinned in
+    tests/test_codec_iface.py, including on inconsistent inputs)."""
+    symbols = np.ascontiguousarray(symbols, dtype=np.uint8)
+    known = np.asarray(known, dtype=bool)
+    if engine == "auto" and not auto_wants_device():
+        return peel_host(symbols, known, degree, tag)
+    if engine in ("device", "auto"):
+        try:
+            import jax.numpy as jnp
+
+            n_data = symbols.shape[0] // 2
+            run = jitted_peel(n_data, symbols.shape[1], degree, tag)
+            bits, kn, sweeps = run(
+                jnp.asarray(_u8_to_bits(symbols)), jnp.asarray(known))
+            return (_bits_to_u8(np.asarray(bits)), np.asarray(kn),
+                    int(sweeps) - 1)  # final sweep makes no progress
+        except Exception:
+            if engine == "device":
+                raise
+            from celestia_app_tpu.utils import telemetry
+
+            telemetry.incr("app.device_path_fallback")
+    return peel_host(symbols, known, degree, tag)
+
+
+def check_equations(symbols: np.ndarray, known: np.ndarray,
+                    degree: int = DEGREE,
+                    tag: bytes = b"cmt") -> np.ndarray:
+    """Parity-equation audit over one coded layer: ascending ids of
+    VIOLATED equations among those with every member known. A violation
+    on fully-verified members is exactly an incorrect-coding fraud
+    (da/cmt.py carries the lowest one as the proof's equation)."""
+    n_coded = symbols.shape[0]
+    n_data = n_coded // 2
+    idx = parity_indices(n_data, degree, tag)
+    members = np.concatenate(
+        [idx, (n_data + np.arange(n_data, dtype=np.int32))[:, None]],
+        axis=1,
+    )
+    full = known[members].all(axis=1)
+    eqxor = np.bitwise_xor.reduce(symbols[members], axis=1)
+    bad = full & eqxor.any(axis=1)
+    return np.flatnonzero(bad).astype(np.int64)

@@ -537,6 +537,7 @@ class ValidatorService:
         client = sync_mod.StateSyncClient(
             [p["peer"]], workdir, min_height=before,
             name=self.vnode.name,
+            da_scheme=sync_mod.scheme_of(self.vnode),
         )
         try:
             try:

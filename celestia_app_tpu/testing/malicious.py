@@ -96,3 +96,60 @@ def out_of_order_prepare(app, raw_txs: list[bytes], t: float) -> Block:
     # rejection exercises the data-root check and nothing else
     forged = dataclasses.replace(block.header, data_hash=root)
     return Block(header=forged, txs=block.txs)
+
+
+def cmt_bad_parity_entry(ods: np.ndarray, equation: int,
+                         xor_byte: int = 0x5A,
+                         engine: str = "host"):
+    """Malicious CMT producer (codec plane, da/cmt.py): encode the ODS
+    honestly, then corrupt base-layer parity symbol `equation` BEFORE
+    hashing — the commitments bind the corrupt symbol, so sampling alone
+    verifies it, and only the peeling decoder's parity-equation audit
+    (one violated equation = the whole fraud proof) can convict. The CMT
+    analog of blind_dah's committed non-codeword."""
+    from celestia_app_tpu.da import cmt
+
+    honest = cmt.build_layers(ods, engine)
+    k = ods.shape[0]
+    n_data0 = k * k
+    layer0 = honest.layers[0].copy()
+    layer0[n_data0 + equation, 0] ^= xor_byte
+    # rebuild every layer ABOVE the corruption from the corrupt hashes
+    # (the producer commits a self-consistent tree over bad symbols)
+    layers = [layer0]
+    hash_lists = [cmt._hash_symbols(layer0, engine)]
+    data = hash_lists[0].reshape(-1, cmt.Q * cmt.HASH_BYTES)
+    for _ in cmt.layer_plan(k)[1:]:
+        from celestia_app_tpu.ops import ldpc
+
+        parity = ldpc.encode(data, engine)
+        coded = np.concatenate([data, parity], axis=0)
+        hash_lists.append(cmt._hash_symbols(coded, engine))
+        layers.append(coded)
+        data = hash_lists[-1].reshape(-1, cmt.Q * cmt.HASH_BYTES)
+    commitments = cmt.CmtCommitments(
+        k=k, root_hashes=tuple(bytes(h) for h in hash_lists[-1]))
+    return cmt.CmtEntry(commitments, layers, hash_lists)
+
+
+def rs2d_bad_parity_entry(ods: np.ndarray, row: int = 1,
+                          xor_byte: int = 0x5A):
+    """Malicious 2D-RS producer (codec plane): extend honestly, corrupt
+    one parity cell of `row`, and commit NMT trees over the RESULT — a
+    committed non-codeword whose samples all verify, convictable only by
+    a BEFP. The one shared fixture for the rs2d fraud accept/reject
+    conformance and the --codec bench (duplicate copies of a
+    security-sensitive fixture drift)."""
+    from celestia_app_tpu.da import edscache as edscache_mod
+    from celestia_app_tpu.utils import fast_host
+
+    k = ods.shape[0]
+    eds = fast_host.extend_square_fast(ods).copy()
+    eds[row, k + 2] ^= xor_byte
+    rows, cols = fast_host.axis_roots_fast(eds)
+    dah = dah_mod.DataAvailabilityHeader(
+        row_roots=tuple(bytes(r) for r in rows),
+        col_roots=tuple(bytes(c) for c in cols),
+    )
+    return edscache_mod.EdsCacheEntry(
+        dah_mod.ExtendedDataSquare(eds), dah, dah.hash())

@@ -1,0 +1,227 @@
+"""Codec plane e2e: a devnet running WHOLESALE on the CMT scheme.
+
+The ISSUE 10 acceptance story: a 2-validator chain configured with
+``da_scheme="cmt-ldpc"`` commits blocks whose headers carry the scheme
+id, serves CMT sample proofs over real HTTP, and a DASer light node —
+speaking only the codec interface — verifies samples, and when a
+certified block turns out to be withheld AND mis-coded, escalates
+through the peeling decoder to a one-equation incorrect-coding fraud
+proof, condemns the data root in its light client, and halts.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.chain import consensus, light
+from celestia_app_tpu.chain.block import Header, validators_hash_of
+from celestia_app_tpu.chain.crypto import PrivateKey
+from celestia_app_tpu.chain.tx import MsgSend
+from celestia_app_tpu.client.tx_client import Signer
+from celestia_app_tpu.da import cmt as cmt_mod
+from celestia_app_tpu.da import codec as dacodec
+from celestia_app_tpu.das.checkpoint import CheckpointStore
+from celestia_app_tpu.das.daser import (
+    DASer,
+    DASerConfig,
+    PeerSet,
+    http_header_source,
+)
+from celestia_app_tpu.service.server import NodeService
+from celestia_app_tpu.testing import malicious
+
+sys.path.insert(0, os.path.dirname(__file__))
+from test_consensus_multinode import CHAIN, _genesis  # noqa: E402
+
+
+def _cmt_network(tmp_path, n=2):
+    privs = [PrivateKey.from_seed(bytes([i + 1])) for i in range(n)]
+    genesis = _genesis(privs)
+    nodes = [
+        consensus.ValidatorNode(
+            f"val{i}", privs[i], genesis, CHAIN,
+            data_dir=str(tmp_path / f"val{i}"),
+            da_scheme="cmt-ldpc",
+        )
+        for i in range(n)
+    ]
+    net = consensus.LocalNetwork(nodes)
+    signer = Signer(CHAIN)
+    for i, p in enumerate(privs):
+        signer.add_account(p, number=i)
+    return net, signer, privs
+
+
+def _trust(net) -> light.TrustedState:
+    return light.TrustedState(
+        height=0, header_hash=b"",
+        validators={n.address: n.priv.public_key().compressed
+                    for n in net.nodes},
+        powers={n.address: 10 for n in net.nodes},
+    )
+
+
+def _seed_hitting_cmt(n_base: int, withheld: set, s: int) -> int:
+    """A sampler seed whose first s base-layer draws hit a withheld
+    cell (the deterministic stand-in for the 1-(1-alpha)^s catch)."""
+    for seed in range(500):
+        rng = np.random.default_rng(seed).spawn(1)[0]
+        cells = {(0, int(rng.integers(0, n_base))) for _ in range(s)}
+        if cells & withheld:
+            return seed
+    raise AssertionError("no hitting seed in range — widen the search")
+
+
+def test_cmt_devnet_commits_samples_and_condemns_fraud(tmp_path):
+    net, signer, privs = _cmt_network(tmp_path)
+    a0 = privs[0].public_key().address()
+    a1 = privs[1].public_key().address()
+    t = 1_700_000_000.0
+    for i in range(2):
+        tx = signer.create_tx(a0, [MsgSend(a0, a1, 100 + i)],
+                              fee=2000, gas_limit=100_000)
+        assert net.broadcast_tx(tx.encode())
+        signer.accounts[a0].sequence += 1
+        t += 10.0
+        blk, cert = net.produce_height(t=t)
+        assert blk is not None and cert is not None
+        # the header commits the scheme; every validator agreed
+        assert blk.header.da_scheme == dacodec.SCHEME_CMT
+    assert len({n.app.last_app_hash for n in net.nodes}) == 1
+
+    node = net.nodes[0]
+    svc = NodeService(node, port=0)
+    svc.serve_background()
+    url = f"http://127.0.0.1:{svc.port}"
+    codec = dacodec.get("cmt-ldpc")
+    try:
+        # ---- wholesale sampling over real HTTP ------------------------
+        cfg = DASerConfig(samples_per_header=8, workers=2, job_size=2,
+                          retries=2, backoff=0.01)
+        store = CheckpointStore(str(tmp_path / "daser" / "cp.json"))
+        d = DASer([url], light.LightClient(CHAIN, _trust(net)), store,
+                  cfg=cfg, rng=np.random.default_rng(42), name="cmt-d0")
+        out = d.sync()
+        assert out["halted"] is None
+        assert out["head"] == 2 and out["sampled"] == [1, 2]
+        for h in (1, 2):
+            rep = d.reports[h]
+            assert rep["status"] == "sampled"
+            assert rep["scheme"] == "cmt-ldpc"
+            assert rep["confidence"] == codec.confidence(8)
+
+        # ---- the byzantine height: certified, withheld, mis-coded ----
+        k = 4
+        rng = np.random.RandomState(5)
+        ods = rng.randint(0, 256, size=(k, k, appconsts.SHARE_SIZE),
+                          dtype=np.uint8)
+        bad_eq = 3
+        entry = malicious.cmt_bad_parity_entry(ods, equation=bad_eq)
+        comm = entry.commitments
+        app = node.app
+        bad_h = app.height + 1
+        header = Header(
+            chain_id=CHAIN, height=bad_h, time_unix=1_700_000_999.0,
+            data_hash=entry.data_root, square_size=k,
+            app_hash=b"\x77" * 32, proposer=node.address,
+            app_version=app.app_version,
+            last_block_hash=app.last_block_hash,
+            validators_hash=validators_hash_of(
+                [(n.address, 10) for n in net.nodes]),
+            da_scheme=dacodec.SCHEME_CMT,
+        )
+        votes = tuple(
+            consensus.Vote(
+                bad_h, header.hash(), n.address,
+                n.priv.sign(consensus.Vote.sign_bytes(
+                    CHAIN, bad_h, header.hash(), "precommit", 0)),
+                "precommit", 0,
+            )
+            for n in net.nodes
+        )
+        cert = consensus.CommitCertificate(bad_h, header.hash(), votes, 0)
+        svc.das_core.seed_scheme_entry(bad_h, entry)
+        # withhold a quarter of the base layer, but never a member of
+        # the bad equation: the fraud must stay provable from served
+        # symbols after the peeling decoder recovers the rest
+        members = set(cmt_mod.equation_members(comm, 0, bad_eq))
+        candidates = [i for i in range(comm.n_base) if i not in members]
+        withheld = {(0, i) for i in candidates[: comm.n_base // 4]}
+        svc.das_core.withhold(bad_h, withheld)
+
+        peers = PeerSet([url], timeout=5.0, retries=2, backoff=0.01)
+        base_source = http_header_source(peers)
+
+        def source(h):
+            if h == bad_h:
+                return header, cert
+            return base_source(h)
+
+        hunter = DASer(
+            peers, light.LightClient(CHAIN, _trust(net)), store,
+            cfg=cfg, header_source=source,
+            rng=np.random.default_rng(
+                _seed_hitting_cmt(comm.n_base, withheld, 8)),
+            name="cmt-hunter",
+        )
+        out = hunter.sync()
+        assert out["halted"] is not None
+        assert out["halted"]["height"] == bad_h
+        assert out["halted"]["reason"] == "bad-encoding"
+        assert out["halted"]["data_root"] == entry.data_root.hex()
+        rep = hunter.reports[bad_h]
+        assert rep["status"] == "fraud"
+        assert rep["location"] == [0, bad_eq]
+        # the verified one-equation proof condemned the root: the
+        # certified header would now be refused outright
+        assert entry.data_root in hunter.light.condemned_roots
+        fresh = light.LightClient(CHAIN, _trust(net))
+        fresh.condemned_roots.add(entry.data_root)
+        with pytest.raises(light.LightClientError, match="condemned"):
+            fresh.update(header, cert)
+
+        # ---- halted checkpoint survives restart -----------------------
+        reborn = DASer([url], light.LightClient(CHAIN, _trust(net)),
+                       store, cfg=cfg, name="cmt-post-halt")
+        assert reborn.halted
+        assert reborn.sync() == {"halted": out["halted"]}
+    finally:
+        svc.shutdown()
+
+
+def test_cmt_withheld_but_honest_block_recovers(tmp_path):
+    """Withholding WITHOUT mis-coding: escalation's peeling repair
+    completes against the commitments, so the block is recovered, not
+    condemned (the availability/validity split, per scheme)."""
+    net, _signer, _privs = _cmt_network(tmp_path)
+    t = 1_700_000_000.0
+    blk, _ = net.produce_height(t=t + 10)
+    node = net.nodes[0]
+    svc = NodeService(node, port=0)
+    svc.serve_background()
+    url = f"http://127.0.0.1:{svc.port}"
+    try:
+        codec = dacodec.get("cmt-ldpc")
+        doc = svc.das_core.header(1)
+        comm = codec.commitments_from_doc(
+            doc, blk.header.data_hash.hex(), blk.header.square_size)
+        # withhold a sliver (empty block: tiny base layer)
+        withheld = {(0, 0)}
+        svc.das_core.withhold(1, withheld)
+        cfg = DASerConfig(samples_per_header=8, workers=1, job_size=2,
+                          retries=2, backoff=0.01)
+        d = DASer(
+            [url], light.LightClient(CHAIN, _trust(net)),
+            CheckpointStore(str(tmp_path / "d2" / "cp.json")), cfg=cfg,
+            rng=np.random.default_rng(
+                _seed_hitting_cmt(comm.n_base, withheld, 8)),
+            name="cmt-recover",
+        )
+        out = d.sync()
+        assert out["halted"] is None
+        assert d.reports[1]["status"] == "recovered"
+    finally:
+        svc.shutdown()
