@@ -1788,6 +1788,199 @@ def measure_sync() -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def measure_serve() -> None:
+    """Serving-plane bench (--serve). Per scheme, one BENCH JSON line:
+
+      {"metric": "samples_served_per_sec", "scheme": S,
+       "value": <pack-served samples/s>, "live_samples_per_sec": ...,
+       "vs_live": ..., "p99_sample_ms": <live p99 per request>,
+       "pack_p99_ms": ..., "pack_hit_ratio": ...,
+       "sampler_round_trips_per_height": ..., "samplers": N, ...}
+
+    Three measurements against one in-process devnet per scheme:
+
+    - **live baseline**: `tools/dasload.py` drives N concurrent
+      persistent-connection samplers (default 1000,
+      ``CELESTIA_BENCH_SERVE_SAMPLERS``), each batching 16 drawn cells
+      per request through the live `POST /das/samples` assembly path.
+    - **pack-served**: the same fleet fetching static proof-pack chunks
+      (`GET /das/pack/chunk`, sha256-verified against the manifest) for
+      warm heights — no lock, no assembly; a chunk delivers every proof
+      doc it covers, which is the pack model's serving economics.
+    - **catch-up round-trips**: a real DASer (das/daser.py) light node
+      catches up over the warm window via the multi-height batched
+      sampler (one /das/headers + one grouped /das/samples per window);
+      ``sampler_round_trips_per_height`` is the counter-verified
+      sampling-path request count divided by heights sampled — the
+      header-following (/ibc/header certificate) fetches are the light
+      client's own sequential-verification cost, not the sampling
+      plane's.
+
+    Backend labeling follows FORMATS §12.2 ("cpu-fallback" on CPU).
+    Env knobs: CELESTIA_BENCH_SERVE_SAMPLERS (1000), _REQUESTS (3),
+    _K (16: the seeded load squares' ODS width), _WINDOW (8),
+    _SCHEMES ("rs2d-nmt,cmt-ldpc").
+    """
+    import resource
+    import shutil
+    import tempfile
+
+    import jax
+
+    from celestia_app_tpu.chain import consensus as cons
+    from celestia_app_tpu.chain import light as light_mod
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.da import edscache as edscache_mod
+    from celestia_app_tpu.das.checkpoint import CheckpointStore
+    from celestia_app_tpu.das.daser import DASer, DASerConfig
+    from celestia_app_tpu.service.server import NodeService
+    from celestia_app_tpu.tools import dasload
+    from celestia_app_tpu.utils import telemetry
+
+    platform = jax.devices()[0].platform
+    backend = "cpu-fallback" if platform == "cpu" else platform
+    samplers = int(os.environ.get("CELESTIA_BENCH_SERVE_SAMPLERS", "1000"))
+    requests = int(os.environ.get("CELESTIA_BENCH_SERVE_REQUESTS", "3"))
+    k_load = int(os.environ.get("CELESTIA_BENCH_SERVE_K", "16"))
+    window = int(os.environ.get("CELESTIA_BENCH_SERVE_WINDOW", "8"))
+    schemes = os.environ.get("CELESTIA_BENCH_SERVE_SCHEMES",
+                             "rs2d-nmt,cmt-ldpc").split(",")
+    # a thousand keep-alive samplers hold a thousand sockets each side
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < 4 * samplers:
+        resource.setrlimit(resource.RLIMIT_NOFILE,
+                           (min(4 * samplers, hard), hard))
+
+    def genesis_for(priv):
+        return {
+            "time_unix": 1_700_000_000.0,
+            "accounts": [{"address": priv.public_key().address().hex(),
+                          "balance": 10**12}],
+            "validators": [{
+                "operator": priv.public_key().address().hex(),
+                "power": 10,
+                "pubkey": priv.public_key().compressed.hex(),
+            }],
+        }
+
+    def grow(vnode, n):
+        for _ in range(n):
+            height = vnode.app.height + 1
+            last_cert = vnode.certificates.get(height - 1)
+            block = vnode.propose(t=1_700_000_000.0 + height)
+            bh = block.header.hash()
+            vote = vnode._signed(height, bh, "precommit", 0)
+            cert = cons.CommitCertificate(height, bh, (vote,), 0)
+            vnode.apply(block, cert, absent_cert=last_cert)
+            vnode.clear_lock()
+
+    def counters():
+        return telemetry.snapshot().get("counters", {})
+
+    for scheme in schemes:
+        chain_id = f"serve-bench-{scheme}"
+        tmp = tempfile.mkdtemp(prefix="serve-bench-")
+        try:
+            priv = PrivateKey.from_seed(b"serve-bench")
+            genesis = genesis_for(priv)
+            vnode = cons.ValidatorNode(
+                "srv", priv, genesis, chain_id,
+                data_dir=os.path.join(tmp, "srv", "data"),
+                da_scheme=scheme, pack_keep=0)  # keep every pack
+            svc = NodeService(vnode, port=0)
+            svc.serve_background()
+            url = f"http://127.0.0.1:{svc.port}"
+            grow(vnode, window)
+            vnode.app.da_warmer.wait_idle(60)
+            # every chain height needs its pack for the warm window
+            # (the warmer coalesces under rapid commits; build is
+            # idempotent for the ones it did reach)
+            for h in range(1, vnode.app.height + 1):
+                vnode.app.pack_store.build(
+                    h, svc.das_core._entry(h).cache_entry)
+
+            # seeded load heights: k_load squares are the meatier
+            # serving shape (the chain's own empty blocks are k=1)
+            rng = np.random.default_rng(0)
+            load_heights = []
+            for i in range(4):
+                ods = rng.integers(0, 256, size=(k_load, k_load, 512),
+                                   dtype=np.uint8)
+                ods[..., :29] = 0
+                ods[..., 28] = 7
+                entry = edscache_mod.compute_entry(ods, "host",
+                                                   scheme=scheme)
+                h = 1000 + i
+                svc.das_core.seed_scheme_entry(h, entry)
+                vnode.app.pack_store.build(h, entry)
+                load_heights.append(h)
+
+            live = dasload.run_load(url, load_heights,
+                                    samplers=samplers, requests=requests,
+                                    cells=16, mode="live")
+            print(f"[{scheme}] live: {live['samples_per_sec']}/s "
+                  f"p99 {live['p99_ms']}ms errors {live['errors']}",
+                  file=sys.stderr, flush=True)
+            pack = dasload.run_load(url, load_heights,
+                                    samplers=samplers, requests=requests,
+                                    cells=16, mode="pack")
+            print(f"[{scheme}] pack: {pack['samples_per_sec']}/s "
+                  f"p99 {pack['p99_ms']}ms errors {pack['errors']}",
+                  file=sys.stderr, flush=True)
+
+            # -- catch-up round trips: a real DASer over the warm window
+            trust = light_mod.TrustedState(
+                height=0, header_hash=b"",
+                validators={vnode.address:
+                            priv.public_key().compressed},
+                powers={vnode.address: 10},
+            )
+            daser = DASer(
+                [url], light_mod.LightClient(chain_id, trust),
+                CheckpointStore(os.path.join(tmp, "cp.json")),
+                cfg=DASerConfig(samples_per_header=16, workers=1,
+                                job_size=window, retries=2,
+                                backoff=0.01),
+                rng=np.random.default_rng(7), name="serve-bench-daser",
+            )
+            c0 = counters()
+            out = daser.sync()
+            c1 = counters()
+            trips = (c1.get("daser.sampling_round_trips", 0)
+                     - c0.get("daser.sampling_round_trips", 0))
+            heights_swept = (c1.get("daser.heights_swept", 0)
+                             - c0.get("daser.heights_swept", 0))
+            rtph = trips / max(1, heights_swept)
+            sampled_ok = len(out.get("sampled", [])) == window
+            vs_live = (pack["samples_per_sec"]
+                       / max(1e-9, live["samples_per_sec"]))
+            print(json.dumps({
+                "metric": "samples_served_per_sec",
+                "value": pack["samples_per_sec"],
+                "unit": "samples/s",
+                "scheme": scheme,
+                "live_samples_per_sec": live["samples_per_sec"],
+                "vs_live": round(vs_live, 2),
+                "p99_sample_ms": live["p99_ms"],
+                "pack_p99_ms": pack["p99_ms"],
+                "pack_hit_ratio": pack["pack_hit_ratio"],
+                "sampler_round_trips_per_height": round(rtph, 3),
+                "window_heights": window,
+                "window_sampled_ok": sampled_ok,
+                "samplers": samplers,
+                "requests_per_sampler": requests,
+                "cells_per_request": 16,
+                "load_square_k": k_load,
+                "live_errors": live["errors"],
+                "pack_errors": pack["errors"],
+                "backend": backend,
+            }), flush=True)
+            svc.shutdown()
+            vnode.app.close()
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 # -- mode registry (--list prints it) ----------------------------------------
 # name -> (runner, emitted metrics, one-line description). The default
 # invocation (no flag) runs the deadline-driven headline measurement
@@ -1816,6 +2009,11 @@ MODES = {
              "state_sync_join_s, blocksync_blocks_per_sec, "
              "snapshot_serve_ms",
              "sync plane: chunked state-sync join vs full replay"),
+    "serve": (measure_serve,
+              "samples_served_per_sec, sampler_round_trips_per_height, "
+              "p99_sample_ms, pack_hit_ratio",
+              "serving plane: pack-served vs live sampling under "
+              "thousand-sampler load"),
     "analyze": (measure_analyze, "analyze_wall_s",
                 "full-tree static-analysis wall time (tier-1 cost)"),
     "obs": (measure_obs, "obs_overhead_pct",

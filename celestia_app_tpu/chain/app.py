@@ -22,6 +22,7 @@ JAX backend is available, with a bit-identical host fallback (utils/refimpl).
 from __future__ import annotations
 
 import dataclasses
+import os
 import time as time_mod
 
 from celestia_app_tpu import appconsts
@@ -100,6 +101,9 @@ class App:
         data_dir: str | None = None,
         invariant_check_period: int = 0,  # crisis: 0 = only at genesis/on demand
         da_scheme: str = "rs2d-nmt",  # DA commitment scheme (da/codec.py)
+        # proof packs (das/packs.py): newest-N packs kept on disk
+        # (0 = keep all, None = packs disabled); needs a data_dir
+        pack_keep: int | None = None,
     ):
         self.invariant_check_period = invariant_check_period
         self.traces = telemetry.TraceTables()  # per-node trace tables (§5.1)
@@ -310,6 +314,19 @@ class App:
         # service/consensus lock
         self.da_seed_listeners: list = []
         self.da_warmer = edscache_mod.ProverWarmer()
+        # serving plane (das/packs.py): disk-backed nodes precompute a
+        # static proof pack per warm height under <home>/packs, pruned
+        # keep-newest-N; in-memory nodes serve live assembly only.
+        # pack_keep=0 keeps every pack; None disables packs entirely.
+        self.pack_store = None
+        if self.db is not None and pack_keep is not None:
+            from celestia_app_tpu.das import packs as packs_mod
+
+            self.pack_store = packs_mod.PackStore(
+                os.path.join(os.path.dirname(os.path.abspath(self.db.dir)),
+                             packs_mod.PACK_DIRNAME),
+                keep=pack_keep,
+            )
         self.ante = ante_mod.AnteHandler(
             self.auth, self.bank, self.blob, self.minfee, min_gas_price,
             feegrant=self.feegrant, ibc=self.ibc,
@@ -1138,7 +1155,7 @@ class App:
             self.da_warmer.schedule(
                 self.height, entry, self.da_seed_listeners,
                 engine=self.engine, traces=self.traces,
-                chain_id=self.chain_id,
+                chain_id=self.chain_id, pack_store=self.pack_store,
             )
         return self.last_app_hash
 

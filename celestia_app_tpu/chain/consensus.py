@@ -339,7 +339,8 @@ class ValidatorNode:
                  v2_upgrade_height: int | None = None,
                  upgrade_height_delay: int | None = None,
                  engine: str = "host",
-                 da_scheme: str = "rs2d-nmt"):
+                 da_scheme: str = "rs2d-nmt",
+                 pack_keep: int | None = None):
         self.name = name
         self.priv = priv
         self.address = priv.public_key().address()
@@ -352,7 +353,7 @@ class ValidatorNode:
         self.app = App(chain_id=chain_id, engine=engine, data_dir=data_dir,
                        v2_upgrade_height=v2_upgrade_height,
                        upgrade_height_delay=upgrade_height_delay,
-                       da_scheme=da_scheme)
+                       da_scheme=da_scheme, pack_keep=pack_keep)
         self.app.init_chain(genesis)
         # THE mempool: the shared CAT pool (celestia_app_tpu/mempool) —
         # the pre-CAT validator list grew unboundedly (no cap, no TTL) and

@@ -72,6 +72,28 @@ class StateSyncUnavailable(OSError):
     """No peer serves a restorable snapshot above the requested floor."""
 
 
+def fsync_write(path: str, data: bytes) -> None:
+    """Write ``data`` durably: the bytes hit the platters before the call
+    returns. THE chunk-file write every chunked plane shares (state-sync
+    chunks here, proof-pack chunks in das/packs.py)."""
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def atomic_json_write(path: str, doc: dict, indent: int | None = None) -> None:
+    """The das/checkpoint.py discipline (tmp + fsync + os.replace) for a
+    JSON document: a crash mid-save leaves either the previous file or
+    nothing — never a torn manifest."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=indent)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def manifest_digest(manifest: dict) -> str:
     """Content address of a snapshot: sha256 over the canonical (sorted-
     key) JSON encoding of its manifest. Keys restore progress on disk, so
@@ -128,16 +150,9 @@ def write_snapshot_dir(manifest: dict, chunks: list[bytes],
     dir that is never listed as restorable (and gets pruned)."""
     os.makedirs(out_dir, exist_ok=True)
     for i, chunk in enumerate(chunks):
-        with open(os.path.join(out_dir, f"chunk_{i:06d}.json"), "wb") as f:
-            f.write(chunk)
-            f.flush()
-            os.fsync(f.fileno())
-    tmp = os.path.join(out_dir, "manifest.json.tmp")
-    with open(tmp, "w") as f:
-        json.dump(manifest, f, indent=2)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, os.path.join(out_dir, "manifest.json"))
+        fsync_write(os.path.join(out_dir, f"chunk_{i:06d}.json"), chunk)
+    atomic_json_write(os.path.join(out_dir, "manifest.json"), manifest,
+                      indent=2)
 
 
 def prune_snapshots(root: str, keep: int) -> None:
@@ -484,12 +499,7 @@ class StateSyncClient:
         path = os.path.join(root, "manifest.json")
         if os.path.exists(path):
             return
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(manifest, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        atomic_json_write(path, manifest)
 
     def _scan_existing(self, root: str, manifest: dict) -> list[int]:
         """Resume: verify every chunk file already on disk against the

@@ -60,6 +60,7 @@ def _make_app(home: str):
         v2_upgrade_height=cfg.get("v2_upgrade_height"),
         upgrade_height_delay=cfg.get("upgrade_height_delay"),
         da_scheme=cfg.get("da_scheme", "rs2d-nmt"),
+        pack_keep=cfg.get("pack_keep", 4),
     )
     import weakref
 
@@ -395,6 +396,9 @@ def _write_config(home: str, chain_id: str, engine: str = "auto") -> None:
                 "app_version": 1,
                 "engine": engine,
                 "da_scheme": "rs2d-nmt",
+                # serving plane (das/packs.py): newest-N proof packs
+                # kept under <home>/packs (0 = keep all, null = off)
+                "pack_keep": 4,
                 "min_gas_price": appconsts.DEFAULT_MIN_GAS_PRICE,
                 "invariant_check_period": 0,
                 "v2_upgrade_height": None,
@@ -786,9 +790,13 @@ def cmd_das_serve(args) -> int:
 
     app, _cfg = _make_app(args.home)
     core = SampleCore(app, cache_heights=args.cache_heights)
+    if getattr(args, "no_packs", False):
+        core.pack_store = None
     svc = SampleService(core, port=args.listen)
+    packs_on = core.pack_store is not None
     print(f"das-serve: http on :{svc.port} (height {app.height}, "
-          f"engine={getattr(app, 'engine', 'host')})", flush=True)
+          f"engine={getattr(app, 'engine', 'host')}, "
+          f"packs={'on' if packs_on else 'off'})", flush=True)
     try:
         svc.serve_forever()
     except KeyboardInterrupt:
@@ -836,6 +844,7 @@ def cmd_das_follow(args) -> int:
         samples_per_header=args.samples,
         workers=args.workers,
         poll_interval=args.interval,
+        prefer_packs=not getattr(args, "no_packs", False),
     )
     daser = DASer(list(args.peer), light, store, cfg=cfg,
                   rng=np.random.default_rng(args.seed), name="das-follow")
@@ -1014,6 +1023,9 @@ def cmd_validator_serve(args) -> int:
         # like the upgrade knobs above: every validator of a chain must
         # be provisioned with the same one (absent ⇒ rs2d-nmt)
         da_scheme=home_cfg.get("da_scheme", "rs2d-nmt"),
+        # serving plane: precompute static proof packs at warm time
+        # (<home>/packs, newest-N kept; null = off)
+        pack_keep=home_cfg.get("pack_keep", 4),
     )
     # fault plane (chaos provisioning): <home>/faults.json arms named
     # fault points for THIS process at startup — the config-file twin of
@@ -1823,6 +1835,20 @@ def cmd_txsim(args) -> int:
     return 0
 
 
+def cmd_dasload(args) -> int:
+    """Serving-plane load harness (tools/dasload.py): drive N concurrent
+    persistent-connection samplers at a devnet's /das/* surface and
+    print the JSON report (samples_per_sec, p99_ms, pack_hit_ratio)."""
+    from celestia_app_tpu.tools import dasload
+
+    argv = ["--url", args.url, "--samplers", str(args.samplers),
+            "--requests", str(args.requests), "--cells", str(args.cells),
+            "--mode", args.mode]
+    if args.heights:
+        argv += ["--heights", args.heights]
+    return dasload.main(argv)
+
+
 def cmd_analyze(args) -> int:
     """The analysis plane (tools/analyze): run every registered rule
     over the package tree against the committed analyze.toml. Exit 0
@@ -1952,6 +1978,9 @@ def main(argv=None) -> int:
     p.add_argument("--listen", type=int, default=26660)
     p.add_argument("--cache-heights", type=int, default=4,
                    help="LRU square-cache depth (per-height row trees)")
+    p.add_argument("--no-packs", action="store_true",
+                   help="disable static proof-pack serving (GET /das/pack"
+                        "*) even when <home>/packs holds packs")
     p.set_defaults(fn=cmd_das_serve)
 
     p = sub.add_parser(
@@ -1975,6 +2004,9 @@ def main(argv=None) -> int:
                    help="sampling rng seed (default: fresh entropy)")
     p.add_argument("--once", action="store_true",
                    help="exit 0 once caught up to the served head")
+    p.add_argument("--no-packs", action="store_true",
+                   help="never fetch advertised proof-pack chunks; "
+                        "sample via live /das/samples only")
     p.set_defaults(fn=cmd_das_follow)
 
     p = sub.add_parser(
@@ -2164,6 +2196,22 @@ def main(argv=None) -> int:
     p.add_argument("--blob-sizes", default="100-2000")
     p.add_argument("--blobs-per-pfb", default="1-3")
     p.set_defaults(fn=cmd_txsim)
+
+    p = sub.add_parser(
+        "dasload",
+        help="serving-plane load harness (tools/dasload.py): thousands "
+             "of concurrent persistent-connection samplers against a "
+             "devnet's /das/* surface; prints the JSON report")
+    p.add_argument("--url", required=True)
+    p.add_argument("--samplers", type=int, default=1000)
+    p.add_argument("--requests", type=int, default=3)
+    p.add_argument("--cells", type=int, default=16)
+    p.add_argument("--mode", choices=("live", "pack", "auto"),
+                   default="auto")
+    p.add_argument("--heights", default="",
+                   help="comma-separated heights (default: last 8 below "
+                        "the served head)")
+    p.set_defaults(fn=cmd_dasload)
 
     p = sub.add_parser(
         "analyze",

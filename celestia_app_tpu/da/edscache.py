@@ -363,12 +363,12 @@ class ProverWarmer:
 
     def schedule(self, height: int, entry: EdsCacheEntry, listeners,
                  engine: str = "auto", traces=None,
-                 chain_id: str = "") -> None:
+                 chain_id: str = "", pack_store=None) -> None:
         with self._lock:
             if self._pending is not None:
                 telemetry.incr("edscache.warm_coalesced")
             self._pending = (height, entry, tuple(listeners), engine,
-                             traces, chain_id)
+                             traces, chain_id, pack_store)
             self._idle.clear()
             if not self._worker_alive:
                 self._worker_alive = True
@@ -385,7 +385,8 @@ class ProverWarmer:
                     self._worker_alive = False
                     self._idle.set()
                     return
-            height, entry, listeners, engine, traces, chain_id = item
+            (height, entry, listeners, engine, traces, chain_id,
+             pack_store) = item
             log = obs.get_logger("da.edscache")
             try:
                 # the warm span joins the height's deterministic trace, so
@@ -414,6 +415,25 @@ class ProverWarmer:
                     log.error("seed listener failed", height=height,
                               listener=getattr(listener, "__qualname__",
                                                str(listener)), err=e)
+            if pack_store is not None:
+                # serving plane (das/packs.py): the warmer owns warm
+                # time, so this is where the height's static proof pack
+                # is precomputed — provers are already built, so pack
+                # assembly is pure index arithmetic + JSON + fsync.
+                # Packs are an optimization: failure is counted and
+                # logged, never fatal, and serving falls back to live
+                # assembly.
+                try:
+                    with obs.span(
+                        "packs.build", traces=traces,
+                        trace_id=obs.trace_id_for(chain_id, height),
+                        height=height, scheme=entry.scheme,
+                    ):
+                        pack_store.build(height, entry)
+                except Exception as e:
+                    telemetry.incr("packs.build_errors")
+                    log.error("proof-pack build failed", height=height,
+                              err=e)
 
     def wait_idle(self, timeout: float | None = None) -> bool:
         """Block until no warm work is pending or running (tests, bench

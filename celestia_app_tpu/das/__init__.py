@@ -1,12 +1,14 @@
 """DAS plane: sample-proof serving (full nodes) + DASer daemon (light
 nodes) — the celestia-node DASer analog over this framework's DA core.
 
-Server plane: das/server.py (SampleCore + routes + standalone service).
+Server plane: das/server.py (SampleCore + routes + standalone service)
+with das/packs.py static proof packs behind /das/pack*.
 Client plane: das/daser.py (DASer) over das/checkpoint.py persistence.
 """
 
 from celestia_app_tpu.das.checkpoint import Checkpoint, CheckpointStore
 from celestia_app_tpu.das.daser import DASer, DASerConfig, PeerSet
+from celestia_app_tpu.das.packs import PackError, PackStore
 from celestia_app_tpu.das.server import (
     SampleCore,
     SampleError,
@@ -19,6 +21,8 @@ __all__ = [
     "CheckpointStore",
     "DASer",
     "DASerConfig",
+    "PackError",
+    "PackStore",
     "PeerSet",
     "SampleCore",
     "SampleError",

@@ -13,11 +13,20 @@ claim for each header it follows:
   by a bounded pool of parallel workers (celestia-node's coordinator +
   catch-up workers), so a node that was down for a thousand blocks
   backfills at worker-pool parallelism while the head keeps advancing.
+  A multi-height job samples as a WINDOW (serving plane, FORMATS §17.1):
+  one batched /das/headers fetch + one grouped /das/samples round-trip
+  cover the whole job, so sampling round-trips per height drop toward
+  1/window instead of one request per (height, retry).
 - **sampling**: s cells per header, drawn from THIS node's own rng
   (predictable coordinates let a withholder serve exactly what's asked),
-  fetched in one batched request, each share verified against the DAH
-  (da/sampling.verify_sample). Failures retry with exponential backoff
-  across every peer before anything escalates.
+  fetched in one batched request — or sliced out of the height's static
+  proof pack when the serving peer advertises one (§17.2): chunks are
+  sha256-checked against the manifest, every doc still verifies through
+  the normal per-sample path, and any shortfall falls back to live
+  assembly (a tampered chunk additionally penalizes the peer). Failures
+  retry as a subset — immediately on the next peer in rotation
+  (``daser.partial_retries``), then with exponential backoff — before
+  anything escalates.
 - **escalation** (a failed sample after retries): fetch every obtainable
   cell, verify each, and run the 2D repair fixpoint (da/repair.repair_eds)
   over the authenticated shares. Repair completing means the block WAS
@@ -67,11 +76,18 @@ class PeerError(OSError):
 class DASerConfig:
     samples_per_header: int = 16  # s: confidence 1-(3/4)^s ≈ 0.99 at 16
     workers: int = 3  # parallel catch-up workers (bounded in-flight)
-    job_size: int = 8  # heights per catch-up job
+    job_size: int = 8  # heights per catch-up job — AND the multi-height
+    # sampling window: a whole job goes out as one batched
+    # POST /das/samples {groups} round-trip (serving plane, §17.1)
     retries: int = 3  # per-request peer-rotation rounds
     backoff: float = 0.05  # base backoff seconds (doubles per round)
     request_timeout: float = 5.0
     poll_interval: float = 0.25  # head-follow pause in run_background
+    # prefer static proof-pack chunks when a serving peer advertises
+    # them on /das/header (§17.2); verified chunks carry the same docs
+    # as live assembly, a tampered chunk penalizes the peer and falls
+    # back to live /das/samples. No-op against pack-less peers.
+    prefer_packs: bool = True
 
 
 class PeerSet:
@@ -109,18 +125,28 @@ class PeerSet:
             self._i = (self._i + 1) % len(self.urls)
         return self.urls[start:] + self.urls[:start]
 
-    def request(self, path: str, payload: dict | None = None):
+    def request(self, path: str, payload: dict | None = None,
+                raw: bool = False):
         """GET (payload None) or POST `path`, rotating peers with
         exponential backoff between rounds; raises PeerError when every
         peer failed every round. HTTP error bodies ({"error": ...}) are
         treated as refusals and retried on the next peer."""
+        return self.request_from(path, payload, raw=raw)[1]
+
+    def request_from(self, path: str, payload: dict | None = None,
+                     raw: bool = False):
+        """`request`, but returns ``(peer_url, body)`` — callers that
+        verify content hashes (pack chunk fetches) need to know WHICH
+        peer served the bytes so a mismatch can be penalized on the
+        shared health score (net.penalize)."""
         last = "no peers"
         delay = self.backoff
         for attempt in range(self.retries):
             for url in self._order():
                 try:
                     telemetry.incr("daser.requests")
-                    return self.client.request(url, path, payload)
+                    return url, self.client.request(url, path, payload,
+                                                    raw=raw)
                 except (OSError, ValueError) as e:
                     telemetry.incr("daser.peer_errors")
                     last = f"{url}{path}: {type(e).__name__}: {e}"
@@ -129,6 +155,23 @@ class PeerSet:
                 time.sleep(delay)
                 delay *= 2
         raise PeerError(f"all peers failed: {last}")
+
+    def penalize(self, url: str, reason: str) -> None:
+        """Content-level failure report (e.g. a pack chunk whose sha256
+        mismatched its manifest): feeds the shared transport's per-peer
+        health score so a corrupt-serving peer is eventually
+        breaker-skipped (net/transport.PeerClient.penalize)."""
+        self.client.penalize(url, reason)
+
+    def request_pinned(self, url: str, path: str,
+                       payload: dict | None = None, raw: bool = False):
+        """One attempt against ONE specific peer — no rotation. Pack
+        chunk fetches use this: a chunk must be fetched from the peer
+        whose manifest advertised it (chunk boundaries are per-node
+        config), or an honest peer could be penalized for another
+        node's advert. Raises OSError/ValueError on failure."""
+        telemetry.incr("daser.requests")
+        return self.client.request(url, path, payload, raw=raw)
 
 
 def http_header_source(peers: PeerSet):
@@ -187,6 +230,11 @@ class DASer:
         # path: _fold holds _lock across an fsync'd checkpoint save, and
         # samplers must not queue behind the disk just to poll a flag
         self._halted_evt = threading.Event()
+        # consecutive whole-window batch-route failures; >= 2 disables
+        # the batched /das/headers + {groups} paths for this DASer (a
+        # legacy peer set must not cost every window two rotation-and-
+        # backoff exhaustions before the per-height fallback)
+        self._batch_failures = 0  # guarded-by: _lock
         if self.cp.halted is not None:
             self._halted_evt.set()
         self._stop = threading.Event()
@@ -238,17 +286,80 @@ class DASer:
 
     # -- sampling workers ------------------------------------------------
 
+    @staticmethod
+    def _parse_header_doc(doc: dict, root_hex: str, square_size: int):
+        """(codec, commitments, pack-advert|None) from a served
+        commitments doc: the doc names its scheme (absent ⇒ rs2d-nmt)
+        and the codec parses AND verifies it against the certified root
+        — bounds/shapes first, binding second, all on untrusted input
+        (da/codec.py). The optional "pack" member advertises the
+        height's static proof pack (§17.2); it is shape-checked here and
+        content-checked chunk by chunk at fetch time."""
+        codec = dacodec.get(doc.get("scheme", dacodec.RS2D_NAME))
+        commitments = codec.commitments_from_doc(doc, root_hex,
+                                                 square_size)
+        pack = doc.get("pack")
+        if not (isinstance(pack, dict)
+                and isinstance(pack.get("chunk_hashes"), list)
+                and isinstance(pack.get("chunk_cells"), int)
+                and pack.get("chunk_cells", 0) > 0
+                and pack.get("data_root") == root_hex):
+            pack = None
+        return codec, commitments, pack
+
     def _fetch_commitments(self, height: int, root_hex: str,
                            square_size: int):
-        """(codec, commitments) for a height: the served commitments doc
-        names its scheme (absent ⇒ rs2d-nmt) and the codec parses AND
-        verifies it against the certified root — bounds/shapes first,
-        binding second, all on untrusted input (da/codec.py). For the
-        default scheme this is exactly the old inline DAH checks."""
-        doc = self.peers.request(f"/das/header?height={height}")
-        codec = dacodec.get(doc.get("scheme", dacodec.RS2D_NAME))
-        return codec, codec.commitments_from_doc(doc, root_hex,
-                                                 square_size)
+        """One height's parsed header doc (the per-height fallback of
+        the batched /das/headers window fetch). The pack advert (if
+        any) is stamped with the peer that served it — chunk fetches
+        pin to that peer."""
+        telemetry.incr("daser.sampling_round_trips")
+        url, doc = self.peers.request_from(
+            f"/das/header?height={height}")
+        codec, commitments, pack = self._parse_header_doc(
+            doc, root_hex, square_size)
+        if pack is not None:
+            pack = {**pack, "peer": url}
+        return codec, commitments, pack
+
+    def _batch_routes_ok(self) -> bool:
+        with self._lock:
+            return self._batch_failures < 2
+
+    def _note_batch(self, ok: bool) -> None:
+        """Memoize whether the peer set answers the batched window
+        routes: a legacy peer set would otherwise cost every window two
+        full rotation-with-backoff exhaustions before falling back."""
+        with self._lock:
+            self._batch_failures = 0 if ok else self._batch_failures + 1
+
+    def _fetch_headers_batch(self, job) -> tuple[str | None,
+                                                 dict[int, dict]]:
+        """(serving peer, height -> raw header doc) for a window, in
+        ONE round-trip (POST /das/headers). Heights the peer could not
+        serve (or a peer set without the batched route at all) simply
+        come back absent — callers fall back to the per-height fetch."""
+        heights = [h for h, _root, _size in job]
+        if not self._batch_routes_ok():
+            return None, {}
+        try:
+            telemetry.incr("daser.sampling_round_trips")
+            url, out = self.peers.request_from("/das/headers",
+                                               {"heights": heights})
+            docs = out["headers"]
+        except (PeerError, KeyError, TypeError, ValueError):
+            self._note_batch(False)
+            return None, {}
+        self._note_batch(True)
+        got: dict[int, dict] = {}
+        for doc in docs if isinstance(docs, list) else []:
+            try:
+                h = int(doc["height"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if h in heights and "error" not in doc:
+                got[h] = doc
+        return url, got
 
     @staticmethod
     def _decode_sample(s: dict) -> tuple[bytes, nmt_host.NmtRangeProof]:
@@ -269,12 +380,129 @@ class DASer:
         serving node's das.serve_sample span links back here."""
         with obs.span("das.fetch_cells", traces=self.traces,
                       height=height, cells=len(cells), axis=axis):
+            telemetry.incr("daser.sampling_round_trips")
             out = self.peers.request(
                 "/das/samples",
                 {"height": height, "cells": [list(c) for c in cells],
                  "axis": axis},
             )
         return out["samples"]
+
+    def _fetch_groups(self, draws: dict[int, list]) -> dict[int, dict]:
+        """height -> single-height-shaped response for a window of
+        heights, in ONE round-trip (POST /das/samples {groups}): the
+        rewrite that takes catch-up from one request per (height, retry)
+        to ~1/window. Raises PeerError when no peer serves the window."""
+        if not self._batch_routes_ok():
+            return {}
+        groups = [{"height": h, "cells": [list(c) for c in cells]}
+                  for h, cells in sorted(draws.items())]
+        with obs.span("das.fetch_window", traces=self.traces,
+                      heights=len(groups),
+                      cells=sum(len(g["cells"]) for g in groups)):
+            telemetry.incr("daser.sampling_round_trips")
+            try:
+                out = self.peers.request("/das/samples",
+                                         {"groups": groups})
+            except PeerError:
+                self._note_batch(False)
+                raise
+        got: dict[int, dict] = {}
+        for resp in out.get("groups") or []:
+            try:
+                got[int(resp["height"])] = resp
+            except (KeyError, TypeError, ValueError):
+                continue
+        if got:
+            self._note_batch(True)
+        return got
+
+    def _verify_docs(self, codec, commitments,
+                     docs: list[dict]) -> tuple[dict, list]:
+        """Scheme-dispatched doc verification: the rs2d inline DAH path
+        or the codec interface — one call site for every fetch flavor
+        (live batch, window group, pack chunk)."""
+        if codec.name == dacodec.RS2D_NAME:
+            return self._verify_cells(commitments, docs)
+        return self._verify_cells_codec(codec, commitments, docs)
+
+    # -- proof packs (client side) ----------------------------------------
+
+    def _fetch_verified_chunk(self, height: int, ci: int,
+                              want_hash: str,
+                              peer: str) -> list[dict] | None:
+        """One sha-verified pack chunk, PINNED to the peer whose header
+        doc advertised the manifest (chunk boundaries/hashes are
+        per-node config, so fetching from a rotated peer could penalize
+        an honest node for another's advert). Returns the decoded doc
+        list, or None on any shortfall; a hash-mismatched or
+        undecodable body penalizes the advertising peer — corrupt (or
+        lying) static serving must never decide availability."""
+        import hashlib
+
+        from celestia_app_tpu.das import packs as packs_mod
+
+        try:
+            telemetry.incr("daser.sampling_round_trips")
+            data = self.peers.request_pinned(
+                peer, f"/das/pack/chunk?height={height}&index={ci}",
+                raw=True,
+            )
+        except (OSError, ValueError):
+            telemetry.incr("daser.pack_fallbacks")
+            return None
+        if hashlib.sha256(data).hexdigest() != want_hash:
+            telemetry.incr("daser.pack_chunk_rejected")
+            self.peers.penalize(
+                peer, f"pack chunk {height}/{ci} hash mismatch")
+            return None
+        try:
+            return packs_mod.decode_chunk(data)
+        except ValueError:
+            telemetry.incr("daser.pack_chunk_rejected")
+            self.peers.penalize(
+                peer, f"pack chunk {height}/{ci} undecodable")
+            return None
+
+    def _fetch_pack_docs(self, height: int, pack: dict, cells,
+                         codec, commitments) -> list[dict] | None:
+        """The sampled cells' docs out of static pack chunks: map each
+        cell to its chunk by sample-space position, fetch the distinct
+        chunks (pinned to the advertising peer), verify each chunk's
+        sha256 against the advertised manifest, and slice out the cell
+        docs. Returns None on ANY shortfall — the caller falls back to
+        live assembly. Note the cell docs themselves are verified by
+        the normal per-sample path afterwards, so a lying manifest buys
+        an adversary nothing."""
+        peer = pack.get("peer")
+        if peer is None:
+            return None
+        space = codec.sample_space(commitments)
+        index_of = {cell: i for i, cell in enumerate(space)}
+        chunk_cells = int(pack["chunk_cells"])
+        need: dict[int, list] = {}
+        for cell in cells:
+            i = index_of.get(tuple(cell))
+            if i is None:
+                return None
+            need.setdefault(i // chunk_cells, []).append((cell, i))
+        hashes = pack["chunk_hashes"]
+        docs: list[dict] = []
+        for ci in sorted(need):
+            if not 0 <= ci < len(hashes):
+                return None
+            chunk_docs = self._fetch_verified_chunk(height, ci,
+                                                    hashes[ci], peer)
+            if chunk_docs is None:
+                return None
+            for _cell, i in need[ci]:
+                off = i - ci * chunk_cells
+                if not 0 <= off < len(chunk_docs):
+                    telemetry.incr("daser.pack_fallbacks")
+                    return None
+                docs.append(chunk_docs[off])
+        telemetry.incr("daser.pack_samples", len(docs))
+        return docs
 
     def _verify_cells(self, dah: DataAvailabilityHeader,
                       docs: list[dict]) -> tuple[dict, list]:
@@ -324,31 +552,93 @@ class DASer:
         rng = rng if rng is not None else self.rng
         t0 = time.perf_counter()
         try:
-            codec, commitments = self._fetch_commitments(
+            codec, commitments, pack = self._fetch_commitments(
                 height, root_hex, square_size)
         except (PeerError, ValueError, KeyError) as e:
             telemetry.incr("daser.header_fetch_failures")
             return {"status": "error", "error": str(e)}
-        if codec.name != dacodec.RS2D_NAME:
-            out = self._sample_height_codec(height, codec, commitments,
-                                            root_hex, rng)
-            telemetry.measure_since("daser.sample_height", t0)
-            return out
-        dah = commitments
-        width = len(dah.row_roots)
+        cells = self._draw(codec, commitments, rng)
+        out = self._sample_cells(height, codec, commitments, root_hex,
+                                 cells, pack)
+        telemetry.measure_since("daser.sample_height", t0)
+        return out
+
+    def _draw(self, codec, commitments, rng) -> list[tuple[int, int]]:
+        """s cells from THIS sampler's own rng — uniform over the
+        scheme's sample space (the rs2d draw stays the exact legacy pair
+        sequence, so seeded samplers reproduce pre-window coordinates)."""
         s = self.cfg.samples_per_header
-        coords = [
-            (int(rng.integers(0, width)), int(rng.integers(0, width)))
-            for _ in range(s)
-        ]
-        try:
-            docs = self._fetch_cells(height, coords)
-        except PeerError as e:
-            return {"status": "error", "error": str(e)}
-        good, failed = self._verify_cells(dah, docs)
-        # per-cell retries: a refused/garbled cell may be served by the
-        # next peer in rotation (PeerSet advances its starting peer per
-        # request); deterministic refusals exhaust and escalate
+        if codec.name == dacodec.RS2D_NAME:
+            width = len(commitments.row_roots)
+            return [
+                (int(rng.integers(0, width)), int(rng.integers(0, width)))
+                for _ in range(s)
+            ]
+        space = codec.sample_space(commitments)
+        return [space[int(rng.integers(0, len(space)))]
+                for _ in range(s)]
+
+    def _sample_cells(self, height: int, codec, commitments,
+                      root_hex: str, cells, pack,
+                      prefetched: list[dict] | None = None) -> dict:
+        """Verify + retry + escalate one height's drawn cells. The docs
+        come from (in preference order) a window group fetch
+        (``prefetched``), the height's static proof pack, or a live
+        batched fetch — all three verify through the same per-sample
+        path, so the source never weakens the availability claim."""
+        s = len(cells)
+        docs = prefetched
+        if docs is None and pack is not None and self.cfg.prefer_packs:
+            docs = self._fetch_pack_docs(height, pack, cells, codec,
+                                         commitments)
+        if docs is None:
+            try:
+                docs = self._fetch_cells(height, cells)
+            except PeerError as e:
+                return {"status": "error", "error": str(e)}
+        good, failed = self._verify_docs(codec, commitments, docs)
+        good, failed = self._retry_failed(height, codec, commitments,
+                                          good, failed)
+        telemetry.incr("daser.samples_verified", len(good))
+        report = {
+            "samples": s,
+            "verified": len(good),
+            "failed": sorted(set(failed)),
+        }
+        if codec.name == dacodec.RS2D_NAME:
+            report["confidence"] = sampling.withholding_catch_confidence(s)
+        else:
+            report["confidence"] = codec.confidence(s)
+            report["scheme"] = codec.name
+        if not failed:
+            telemetry.incr("daser.headers_sampled")
+            return {**report, "status": "sampled"}
+        telemetry.incr("daser.samples_failed", len(set(failed)))
+        if codec.name == dacodec.RS2D_NAME:
+            return {**report,
+                    **self._escalate(height, commitments, root_hex,
+                                     pack=pack)}
+        return {**report,
+                **self._escalate_codec(height, codec, commitments,
+                                       root_hex, pack=pack)}
+
+    def _retry_failed(self, height: int, codec, commitments, good: dict,
+                      failed: list) -> tuple[dict, list]:
+        """Per-cell retries: a refused/garbled cell may be served by the
+        next peer in rotation (PeerSet advances its starting peer per
+        request). The FIRST retry of a partially-failed batch goes out
+        immediately — one flaky cell must not cost the whole batch a
+        backoff sleep (counted ``daser.partial_retries``); deterministic
+        refusals then exhaust the backed-off rounds and escalate."""
+        if failed:
+            telemetry.incr("daser.partial_retries")
+            try:
+                docs = self._fetch_cells(height, failed)
+                recovered, failed = self._verify_docs(codec, commitments,
+                                                      docs)
+                good.update(recovered)
+            except PeerError:
+                pass
         delay = self.cfg.backoff
         for _ in range(self.cfg.retries):
             if not failed:
@@ -359,23 +649,70 @@ class DASer:
                 docs = self._fetch_cells(height, failed)
             except PeerError:
                 continue
-            recovered, failed = self._verify_cells(dah, docs)
+            recovered, failed = self._verify_docs(codec, commitments,
+                                                  docs)
             good.update(recovered)
-        telemetry.incr("daser.samples_verified", len(good))
-        report = {
-            "samples": s,
-            "verified": len(good),
-            "failed": sorted(set(failed)),
-            "confidence": sampling.withholding_catch_confidence(s),
-        }
-        if not failed:
-            telemetry.incr("daser.headers_sampled")
-            telemetry.measure_since("daser.sample_height", t0)
-            return {**report, "status": "sampled"}
-        telemetry.incr("daser.samples_failed", len(set(failed)))
-        out = {**report, **self._escalate(height, dah, root_hex)}
-        telemetry.measure_since("daser.sample_height", t0)
-        return out
+        return good, failed
+
+    # -- the catch-up window (serving plane) -----------------------------
+
+    def _sample_window(self, job, rng) -> dict[int, dict]:
+        """One catch-up job sampled as a WINDOW: one batched header
+        fetch plus one multi-height grouped sample fetch cover every
+        height in the job, so sampling round-trips per height drop
+        toward 1/window (was one request per (height, retry)). Each
+        height still verifies, retries, and escalates independently —
+        a bad height in a window costs only its own follow-ups."""
+        reports: dict[int, dict] = {}
+        ctx: dict[int, tuple] = {}
+        header_peer, header_docs = self._fetch_headers_batch(job)
+        for h, root_hex, size in job:
+            doc = header_docs.get(h)
+            try:
+                if doc is not None:
+                    codec, commitments, pack = self._parse_header_doc(
+                        doc, root_hex, size)
+                    if pack is not None:
+                        # chunk fetches pin to the advertising peer
+                        pack = {**pack, "peer": header_peer}
+                    ctx[h] = (codec, commitments, pack)
+                else:
+                    ctx[h] = self._fetch_commitments(h, root_hex, size)
+            except (PeerError, ValueError, KeyError) as e:
+                telemetry.incr("daser.header_fetch_failures")
+                reports[h] = {"status": "error", "error": str(e)}
+        draws = {h: self._draw(ctx[h][0], ctx[h][1], rng)
+                 for h, _root, _size in job if h in ctx}
+        groups: dict[int, dict] = {}
+        if draws:
+            try:
+                groups = self._fetch_groups(draws)
+            except PeerError:
+                # no peer served the window: per-height fetches below
+                # (pack or live) still get their chance
+                groups = {}
+        for h, root_hex, _size in job:
+            if h in reports or self._stop.is_set() \
+                    or self._halted_evt.is_set():
+                continue
+            codec, commitments, pack = ctx[h]
+            resp = groups.get(h)
+            prefetched = (resp.get("samples")
+                          if resp is not None and "error" not in resp
+                          else None)
+            with obs.span(
+                "das.sample_height", traces=self.traces,
+                trace_id=obs.trace_id_for(self.light.chain_id, h),
+                height=h, node=self.name, window=len(job),
+            ) as sp:
+                t0 = time.perf_counter()
+                rep = self._sample_cells(h, codec, commitments, root_hex,
+                                         draws[h], pack,
+                                         prefetched=prefetched)
+                telemetry.measure_since("daser.sample_height", t0)
+                sp.set(status=rep.get("status"))
+            reports[h] = rep
+        return reports
 
     # -- non-default schemes: codec-interface sampling + escalation ------
 
@@ -398,53 +735,8 @@ class DASer:
                 failed.append(coord)
         return good, failed
 
-    def _sample_height_codec(self, height: int, codec, commitments,
-                             root_hex: str, rng) -> dict:
-        """One height under a non-default scheme (today: cmt-ldpc).
-        Same shape as the 2D-RS flow — draw, batch-fetch, verify,
-        retry, escalate — but cells are the codec's sample space and
-        confidence is the codec's own arithmetic (its catch probability
-        differs per construction)."""
-        s = self.cfg.samples_per_header
-        space = codec.sample_space(commitments)
-        cells = [space[int(rng.integers(0, len(space)))]
-                 for _ in range(s)]
-        try:
-            docs = self._fetch_cells(height, cells)
-        except PeerError as e:
-            return {"status": "error", "error": str(e)}
-        good, failed = self._verify_cells_codec(codec, commitments, docs)
-        delay = self.cfg.backoff
-        for _ in range(self.cfg.retries):
-            if not failed:
-                break
-            time.sleep(delay)
-            delay *= 2
-            try:
-                docs = self._fetch_cells(height, failed)
-            except PeerError:
-                continue
-            recovered, failed = self._verify_cells_codec(
-                codec, commitments, docs)
-            good.update(recovered)
-        telemetry.incr("daser.samples_verified", len(good))
-        report = {
-            "samples": s,
-            "verified": len(good),
-            "failed": sorted(set(failed)),
-            "confidence": codec.confidence(s),
-            "scheme": codec.name,
-        }
-        if not failed:
-            telemetry.incr("daser.headers_sampled")
-            return {**report, "status": "sampled"}
-        telemetry.incr("daser.samples_failed", len(set(failed)))
-        return {**report,
-                **self._escalate_codec(height, codec, commitments,
-                                       root_hex)}
-
     def _escalate_codec(self, height: int, codec, commitments,
-                        root_hex: str) -> dict:
+                        root_hex: str, pack: dict | None = None) -> dict:
         """Codec-interface escalation: fetch every obtainable base
         symbol in bounded batches, run the scheme's repair (the peeling
         decoder for cmt-ldpc), and either clear the block, condemn it
@@ -454,14 +746,11 @@ class DASer:
         codec's fraud_cells/fraud_proof_from_members hooks."""
         telemetry.incr("daser.escalations")
         space = codec.sample_space(commitments)
-        docs_map: dict[tuple[int, int], tuple] = {}
         chunk = 256  # bounded request batches (the rs2d row discipline)
-        for start in range(0, len(space), chunk):
-            try:
-                docs = self._fetch_cells(height,
-                                         space[start:start + chunk])
-            except PeerError:
-                continue
+        batches = [space[start:start + chunk]
+                   for start in range(0, len(space), chunk)]
+        docs_map: dict[tuple[int, int], tuple] = {}
+        for docs in self._fetch_all_docs(height, pack, batches):
             good, _failed = self._verify_cells_codec(codec, commitments,
                                                      docs)
             docs_map.update(good)
@@ -522,10 +811,40 @@ class DASer:
         return codec.fraud_proof_from_members(commitments, location,
                                               carried)
 
+    def _fetch_all_docs(self, height: int, pack: dict | None,
+                        batches: list[list]):
+        """Escalation's full fetch, yielding doc lists: every pack chunk
+        when the height advertises one (static bytes, each sha-verified
+        and pinned to the advertising peer — at k=128 this replaces 256
+        assembled row requests with 256 file reads), else the bounded
+        live batches. Any pack shortfall falls back to the live batches
+        wholesale."""
+        peer = pack.get("peer") if pack is not None else None
+        if peer is not None and self.cfg.prefer_packs:
+            all_docs: list[list[dict]] = []
+            for ci, want in enumerate(pack["chunk_hashes"]):
+                chunk_docs = self._fetch_verified_chunk(height, ci,
+                                                        want, peer)
+                if chunk_docs is None:
+                    all_docs = []
+                    break
+                all_docs.append(chunk_docs)
+            if all_docs:
+                telemetry.incr(
+                    "daser.pack_samples",
+                    sum(len(d) for d in all_docs))
+                yield from all_docs
+                return
+        for batch in batches:
+            try:
+                yield self._fetch_cells(height, batch)
+            except PeerError:
+                continue
+
     # -- escalation: repair -> fraud proof -------------------------------
 
     def _escalate(self, height: int, dah: DataAvailabilityHeader,
-                  root_hex: str) -> dict:
+                  root_hex: str, pack: dict | None = None) -> dict:
         """A sample failed after retries: fetch everything obtainable,
         reconstruct, and either clear the block (it WAS available),
         condemn it with a verified BEFP, or record it unavailable."""
@@ -537,12 +856,11 @@ class DASer:
         # A failed row batch just leaves its cells absent; the crossword
         # tolerates holes up to the repair threshold.
         docs: list[dict] = []
-        for r in range(width):
-            try:
-                docs += self._fetch_cells(
-                    height, [(r, c) for c in range(width)])
-            except PeerError:
-                continue
+        for batch_docs in self._fetch_all_docs(
+            height, pack,
+            [[(r, c) for c in range(width)] for r in range(width)],
+        ):
+            docs += batch_docs
         if not docs:
             return {"status": "unavailable",
                     "error": "no peer served any reconstruction cells"}
@@ -665,6 +983,16 @@ class DASer:
                         job = jobs.get_nowait()
                     except queue_mod.Empty:
                         return
+                    if len(job) > 1:
+                        # the serving-plane catch-up rewrite: the whole
+                        # job goes out as one multi-height window
+                        # (batched headers + grouped samples) instead of
+                        # one request per height
+                        reps = self._sample_window(job, rng)
+                        with self._lock:
+                            results.update(reps)
+                            self.reports.update(reps)
+                        continue
                     for h, root_hex, size in job:
                         if self._stop.is_set() \
                                 or self._halted_evt.is_set():
@@ -704,6 +1032,7 @@ class DASer:
         map; incomplete ones record an attempt; the sample_from watermark
         advances over every height that has a durable disposition."""
         done_now = set()
+        telemetry.incr("daser.heights_swept", len(results))
         with self._lock:
             for h, rep in results.items():
                 if rep["status"] in ("sampled", "recovered"):

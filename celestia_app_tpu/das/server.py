@@ -11,12 +11,23 @@ Entries sit behind a bounded LRU keyed by height — the same discipline as
 the DA service's square cache (service/da_service.DACore).
 
 Routes (mounted on the node HTTP service and the standalone das-serve
-sidecar; wire format in docs/FORMATS.md §7):
+sidecar; wire format in docs/FORMATS.md §7 and §17):
 
   GET  /das/head                        serving tip {"height": H}
   GET  /das/header?height=H             DAH (row+col roots) + data root
+                                        (+ "pack" advertisement, §17.2)
   GET  /das/sample?height&row&col[&axis]   one cell + NMT proof
   POST /das/samples {height, cells, axis?} batched multi-cell variant
+  POST /das/samples {groups: [{height, cells}...], axis?}
+                                        multi-HEIGHT batched variant: one
+                                        round-trip serves a whole catch-up
+                                        window, entries resolved in one
+                                        pass and dispatched per scheme/k
+                                        bucket (§17.1)
+  POST /das/headers {heights: [...]}    batched commitments docs
+  GET  /das/pack?height=H               proof-pack manifest (§17.2)
+  GET  /das/pack/chunk?height=H&index=I raw pack chunk bytes — static
+                                        serving, no lock, no assembly
   GET  /das/availability?height=H       per-height serving record
 
 `axis` selects which committed root the proof hangs under: "row" (the
@@ -28,9 +39,18 @@ cells alone. Column trees are the row trees of the TRANSPOSED square
 outside Q0), so the col prover reuses the same batched device path with
 zero new hashing code.
 
+Serving-plane split (FORMATS §17.4): live assembly counts
+``das.live_assembled``; static pack serving counts ``das.pack_hits`` /
+``das.pack_misses`` — both split per height in the /das/availability
+record, so operators can see how much of the fleet's demand the static
+path absorbs. Pack routes never build an entry (and never extend): the
+height's data root resolves from the serving cache or the block store.
+
 Fault injection: `withhold(height, cells)` makes the server refuse those
 cells — the adversarial fixture the DASer e2e uses to model a
-withholding producer (tests/test_das.py).
+withholding producer (tests/test_das.py). Note the withholding gate
+models the LIVE path; a node serving static packs is the honest-server
+shape (a real withholder simply does not publish packs).
 
 Block-plane integration (PR 8): heights are backed by the app's
 content-addressed EDS/DAH cache (da/edscache.py). `App.commit` hands each
@@ -49,6 +69,7 @@ import threading
 from celestia_app_tpu.da import codec as codec_mod
 from celestia_app_tpu.da import edscache as edscache_mod
 from celestia_app_tpu.da.dah import DataAvailabilityHeader, ExtendedDataSquare
+from celestia_app_tpu.das import packs as packs_mod
 from celestia_app_tpu.utils import telemetry
 
 
@@ -123,12 +144,18 @@ class SampleCore:
     level arrays, so concurrent samplers never serialize on hashing."""
 
     def __init__(self, app, cache_heights: int = 4,
-                 availability_keep: int = 256, app_lock=None):
+                 availability_keep: int = 256, app_lock=None,
+                 pack_store: packs_mod.PackStore | None = None):
         self.app = app
         # writer lock of the process hosting the app (NodeService shares
         # its service lock): square REBUILDS take it so serving never
         # races a commit mid-store; cached-entry serving stays lock-free
         self.app_lock = app_lock
+        # the static proof-pack store (das/packs.py): built at warm time
+        # by the app's ProverWarmer, served here as raw bytes. Defaults
+        # to the app's own (<home>/packs); None on pack-less processes.
+        self.pack_store = (pack_store if pack_store is not None
+                           else getattr(app, "pack_store", None))
         self._cache: collections.OrderedDict[int, _Entry] = \
             collections.OrderedDict()
         self._cache_heights = cache_heights
@@ -270,8 +297,15 @@ class SampleCore:
         §16.2). Either binds to the certified data root."""
         entry = self._entry(height)
         codec = codec_mod.get(entry.scheme)
-        return {"height": height,
-                **codec.commitments_doc(entry.cache_entry)}
+        doc = {"height": height,
+               **codec.commitments_doc(entry.cache_entry)}
+        # pack advertisement (§17.2): zero-extra-round-trip discovery —
+        # a sampler that just fetched commitments knows whether (and how)
+        # this height is servable as static bytes. Old clients ignore it.
+        pack = self._pack_advert(entry)
+        if pack is not None:
+            doc["pack"] = pack
+        return doc
 
     def _one(self, entry: _Entry, row: int, col: int, axis: str) -> dict:
         if entry.scheme == codec_mod.RS2D_NAME:
@@ -299,12 +333,15 @@ class SampleCore:
         if entry.scheme != codec_mod.RS2D_NAME:
             return self._one_codec(entry, row, col)
         if axis == "row":
-            share, proof = entry.prover.prove_cell(row, col)
-        else:
-            # transposed prover: cell (row, col) lives at (col, row) of
-            # the transpose; its proof hangs under col_roots[col] and
-            # covers leaf range [row, row+1)
-            share, proof = self._col_prover(entry).prove_cell(col, row)
+            # the shared doc builder (das/packs.live_cell_doc): the pack
+            # builder runs the SAME function, so pack bytes ≡ live bytes
+            # by construction (pinned in tests/test_serving.py)
+            return packs_mod.live_cell_doc(
+                entry.cache_entry, (row, col), prover=entry.prover)
+        # transposed prover: cell (row, col) lives at (col, row) of
+        # the transpose; its proof hangs under col_roots[col] and
+        # covers leaf range [row, row+1)
+        share, proof = self._col_prover(entry).prove_cell(col, row)
         return {
             "row": row,
             "col": col,
@@ -321,14 +358,11 @@ class SampleCore:
         """Non-default-scheme cell: the wire (row, col) pair is the
         scheme's (layer, index) — FORMATS §16.3. The withholding fixture
         and the das.serve_sample fault point already gated in _one."""
-        codec = codec_mod.get(entry.scheme)
         try:
-            doc = codec.open_sample(entry.cache_entry, (layer, index))
+            return packs_mod.live_cell_doc(entry.cache_entry,
+                                           (layer, index))
         except codec_mod.CodecError as e:
             raise SampleError(str(e)) from None
-        # row/col aliases keep the batched-response shape uniform across
-        # schemes (per-cell error members, availability bookkeeping)
-        return {"row": layer, "col": index, **doc}
 
     def sample(self, height: int, row: int, col: int,
                axis: str = "row") -> dict:
@@ -343,11 +377,26 @@ class SampleCore:
         proofs. Per-cell failures (withheld, out of range) come back as
         {"row","col","error"} members so a partially-served batch still
         helps a reconstructing DASer."""
+        cells = self._check_cells(cells, axis)
+        entry = self._entry(height)
+        return self._serve_group(entry, height, cells, axis)
+
+    @staticmethod
+    def _check_cells(cells, axis: str) -> list[tuple[int, int]]:
         if axis not in ("row", "col"):
             raise SampleError(f"axis must be 'row' or 'col', not {axis!r}")
         cells = [(int(r), int(c)) for r, c in cells]
         if not cells:
             raise SampleError("empty cell list")
+        return cells
+
+    def _serve_group(self, entry: _Entry, height: int,
+                     cells: list[tuple[int, int]], axis: str) -> dict:
+        """One height's batch against a resolved entry — THE one serving
+        body behind both the single-height POST /das/samples and every
+        group of the multi-height variant, so the two responses are
+        byte-identical per height by construction (pinned in
+        tests/test_serving.py)."""
         from celestia_app_tpu import obs
 
         # serve-side span of the DAS round-trip: the height's
@@ -362,7 +411,6 @@ class SampleCore:
             ),
             height=height, cells=len(cells), axis=axis,
         ) as sp:
-            entry = self._entry(height)
             t0 = telemetry.start_timer()
             samples = []
             served = 0
@@ -375,9 +423,13 @@ class SampleCore:
             sp.set(served=served)
         telemetry.measure_since("das.sample_batch", t0)
         telemetry.incr("das.samples_served", served)
+        # serving-plane accounting (FORMATS §17.4): these samples were
+        # assembled live — the pack counters' counterpart
+        telemetry.incr("das.live_assembled", served)
         telemetry.incr("das.sample_batches")
         self._note(entry, served=served, batches=1,
-                   col_proofs=served if axis == "col" else 0)
+                   col_proofs=served if axis == "col" else 0,
+                   live=served)
         return {
             "height": height,
             "data_root": entry.root.hex(),
@@ -387,24 +439,196 @@ class SampleCore:
             "samples": samples,
         }
 
+    def sample_groups(self, groups, axis: str = "row") -> dict:
+        """Multi-height batched serving: one request resolves every
+        group's height against the edscache in ONE pass, then serves the
+        groups in (scheme, k) bucket order — heights sharing a codec
+        dispatch shape run back to back, the batching the device path
+        wants — while the response keeps the REQUEST order (each member
+        byte-identical to the single-height response for that group).
+        A height that cannot be resolved yields {"height", "error"} so
+        the rest of the window still serves."""
+        if not isinstance(groups, list) or not groups:
+            raise SampleError("samples needs a non-empty 'groups' list")
+        parsed: list[tuple[int, list[tuple[int, int]]]] = []
+        for g in groups:
+            try:
+                height = int(g["height"])
+            except (KeyError, TypeError, ValueError):
+                raise SampleError(
+                    "each group needs an integer 'height'") from None
+            cells = g.get("cells")
+            if not isinstance(cells, list):
+                raise SampleError(
+                    f"group for height {height} needs a 'cells' list")
+            try:
+                parsed.append((height, self._check_cells(cells, axis)))
+            except SampleError:
+                raise  # already the accurate message (empty list, axis)
+            except (TypeError, ValueError):
+                raise SampleError(
+                    "each cell must be a [row, col] pair") from None
+        # resolve every entry first (single-flight per height), bucketing
+        # by (scheme, k) so same-shape heights serve consecutively
+        resolved: dict[int, _Entry | SampleError] = {}
+        for height, _cells in parsed:
+            if height in resolved:
+                continue
+            try:
+                resolved[height] = self._entry(height)
+            except SampleError as e:
+                resolved[height] = e
+        order = sorted(
+            range(len(parsed)),
+            key=lambda i: (
+                (resolved[parsed[i][0]].scheme,
+                 resolved[parsed[i][0]].cache_entry.k)
+                if isinstance(resolved[parsed[i][0]], _Entry)
+                else ("", 0),
+                i,
+            ),
+        )
+        out: list[dict | None] = [None] * len(parsed)
+        for i in order:
+            height, cells = parsed[i]
+            got = resolved[height]
+            if isinstance(got, SampleError):
+                out[i] = {"height": height, "error": str(got)}
+                continue
+            out[i] = self._serve_group(got, height, cells, axis)
+        telemetry.incr("das.multi_height_batches")
+        telemetry.incr("das.batch_heights", len(parsed))
+        return {"axis": axis, "groups": out}
+
+    def headers_many(self, heights) -> dict:
+        """Batched commitments docs — the window sampler's one-round-trip
+        header fetch. Per-height failures come back as {"height",
+        "error"} members."""
+        if not isinstance(heights, list) or not heights:
+            raise SampleError("headers needs a non-empty 'heights' list")
+        docs = []
+        for h in heights:
+            try:
+                docs.append(self.header(int(h)))
+            except SampleError as e:
+                docs.append({"height": int(h), "error": str(e)})
+            except (TypeError, ValueError):
+                raise SampleError(
+                    "each height must be an integer") from None
+        return {"headers": docs}
+
+    # -- proof packs (static serving; das/packs.py) ----------------------
+
+    def _pack_root(self, height: int) -> bytes:
+        """The height's data root WITHOUT building a square: cached
+        serving entries first, then the durable block store. Raises
+        SampleError when the height is unknown — pack routes must never
+        trigger an extend."""
+        with self._lock:
+            hit = self._cache.get(height)
+        if hit is not None:
+            return hit.root
+        db = getattr(self.app, "db", None)
+        if db is not None:
+            try:
+                return db.load_block(height).header.data_hash
+            except (OSError, KeyError, ValueError):
+                pass
+        # an unknown height is a pack miss too (global counter only: a
+        # per-height record here would let an unauthenticated request
+        # stream for arbitrary heights evict every genuine record from
+        # the bounded availability map)
+        telemetry.incr("das.pack_misses")
+        raise SampleError(f"pack for height {height} not served")
+
+    def pack_manifest(self, height: int) -> dict:
+        """GET /das/pack: the height's pack manifest, or a 404-mapped
+        refusal when no complete pack exists (counted das.pack_misses —
+        the sampler falls back to live assembly)."""
+        if self.pack_store is None:
+            telemetry.incr("das.pack_misses")
+            raise SampleError(f"pack for height {height} not served")
+        m = self.pack_store.manifest(self._pack_root(height))
+        if m is None:
+            telemetry.incr("das.pack_misses")
+            self._note_height(height, pack_misses=1)
+            raise SampleError(f"pack for height {height} not served")
+        return m
+
+    def pack_chunk(self, height: int, index: int) -> bytes:
+        """GET /das/pack/chunk: raw chunk bytes straight from disk — no
+        lock, no assembly, no JSON; the CDN-shaped hot path. Counted
+        das.pack_hits (misses das.pack_misses)."""
+        if self.pack_store is None:
+            telemetry.incr("das.pack_misses")
+            raise SampleError(f"pack for height {height} not served")
+        try:
+            data = self.pack_store.chunk(self._pack_root(height), index)
+        except packs_mod.PackError as e:
+            telemetry.incr("das.pack_misses")
+            self._note_height(height, pack_misses=1)
+            raise SampleError(str(e)) from None
+        telemetry.incr("das.pack_hits")
+        self._note_height(height, pack_hits=1)
+        return data
+
+    def _pack_advert(self, entry: _Entry) -> dict | None:
+        """The compact pack advertisement riding /das/header (§17.2), or
+        None when this node serves no pack for the height."""
+        if self.pack_store is None:
+            return None
+        m = self.pack_store.manifest(entry.root)
+        if m is None:
+            return None
+        return packs_mod.advertised(m)
+
     # -- availability records -------------------------------------------
 
+    _RECORD_ZEROS = (
+        "samples_served", "batches", "withheld_refusals",
+        "col_proofs_served", "pack_hits", "pack_misses", "live_assembled",
+    )
+
+    def _record_locked(self, height: int, data_root: str | None,
+                       width: int | None) -> dict:
+        rec = self._availability.get(height)
+        if rec is None:
+            rec = self._availability[height] = {
+                "height": height,
+                "data_root": data_root,
+                "square_width": width,
+                **{k: 0 for k in self._RECORD_ZEROS},
+            }
+        elif rec["data_root"] is None and data_root is not None:
+            # a pack-only record learns its identity when live serving
+            # (or a later pack route) resolves the entry
+            rec["data_root"] = data_root
+            rec["square_width"] = width
+        return rec
+
     def _note(self, entry: _Entry, served: int = 0, batches: int = 0,
-              withheld: int = 0, col_proofs: int = 0) -> None:
+              withheld: int = 0, col_proofs: int = 0,
+              live: int = 0) -> None:
         with self._lock:
-            rec = self._availability.setdefault(entry.height, {
-                "height": entry.height,
-                "data_root": entry.root.hex(),
-                "square_width": entry.width,
-                "samples_served": 0,
-                "batches": 0,
-                "withheld_refusals": 0,
-                "col_proofs_served": 0,
-            })
+            rec = self._record_locked(entry.height, entry.root.hex(),
+                                      entry.width)
             rec["samples_served"] += served
             rec["batches"] += batches
             rec["withheld_refusals"] += withheld
             rec["col_proofs_served"] += col_proofs
+            rec["live_assembled"] += live
+            while len(self._availability) > self._availability_keep:
+                self._availability.pop(min(self._availability))
+
+    def _note_height(self, height: int, pack_hits: int = 0,
+                     pack_misses: int = 0) -> None:
+        """Pack-route bookkeeping: records pack serving for heights the
+        live plane may never have resolved (static chunk serving builds
+        no entry on purpose)."""
+        with self._lock:
+            rec = self._record_locked(height, None, None)
+            rec["pack_hits"] += pack_hits
+            rec["pack_misses"] += pack_misses
             while len(self._availability) > self._availability_keep:
                 self._availability.pop(min(self._availability))
 
@@ -416,18 +640,19 @@ class SampleCore:
         # never-served height: the same record shape with null identity
         # fields (FORMATS.md §7.1) so clients can read one schema
         return {"height": height, "data_root": None, "square_width": None,
-                "samples_served": 0, "batches": 0,
-                "withheld_refusals": 0, "col_proofs_served": 0}
+                **{k: 0 for k in self._RECORD_ZEROS}}
 
 
 # -- one router shared by every transport -----------------------------------
 
 
 def route_das(core: SampleCore, method: str, path: str,
-              query: dict, payload: dict | None = None) -> dict:
+              query: dict, payload: dict | None = None):
     """Dispatch a /das/* request. `query` holds the GET params (strings);
     POST bodies arrive in `payload`. Raises SampleError for every
-    malformed input (transports answer 4xx)."""
+    malformed input (transports answer 4xx). Returns a JSON-able dict —
+    or raw ``bytes`` for /das/pack/chunk, which the transports send as
+    application/octet-stream (the chain/sync.route_sync convention)."""
 
     def _int(src: dict, key: str) -> int:
         try:
@@ -451,8 +676,18 @@ def route_das(core: SampleCore, method: str, path: str,
                                _int(query, "col"), axis=_axis(query))
         if path == "/das/availability":
             return core.availability(_int(query, "height"))
+        if path == "/das/pack":
+            return core.pack_manifest(_int(query, "height"))
+        if path == "/das/pack/chunk":
+            return core.pack_chunk(_int(query, "height"),
+                                   _int(query, "index"))
     elif method == "POST" and path == "/das/samples":
         payload = payload or {}
+        if "groups" in payload:
+            # the multi-height window variant (§17.1): the legacy
+            # single-height body stays exactly as it was
+            return core.sample_groups(payload["groups"],
+                                      axis=_axis(payload))
         cells = payload.get("cells")
         if not isinstance(cells, list):
             raise SampleError("samples needs a 'cells' list of [row, col]")
@@ -463,6 +698,9 @@ def route_das(core: SampleCore, method: str, path: str,
                 from None
         return core.sample_many(_int(payload, "height"), pairs,
                                 axis=_axis(payload))
+    elif method == "POST" and path == "/das/headers":
+        payload = payload or {}
+        return core.headers_many(payload.get("heights"))
     raise SampleError(f"no DAS route {method} {path}")
 
 
@@ -484,6 +722,10 @@ class SampleService:
         self.core = core
 
         class Handler(BaseHTTPRequestHandler):
+            # keep-alive (HTTP/1.1): samplers hold persistent
+            # connections; every response sets Content-Length
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, fmt, *args):
                 pass
 
@@ -495,12 +737,25 @@ class SampleService:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_raw(self, code: int, body: bytes) -> None:
+                # /das/pack/chunk serves raw bytes (octet-stream, NOT
+                # base64) — the static CDN-shaped path
+                self.send_response(code)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def _route(self, method: str, payload: dict | None) -> None:
                 parsed = urlparse(self.path)
                 try:
                     out = route_das(service.core, method, parsed.path,
                                     parse_qs(parsed.query), payload)
-                    self._send(200, out)
+                    if isinstance(out, bytes):
+                        self._send_raw(200, out)
+                    else:
+                        self._send(200, out)
                 except SampleError as e:
                     self._send(404 if "not served" in str(e) else 400,
                                {"error": str(e)})
@@ -520,7 +775,12 @@ class SampleService:
                     return
                 self._route("POST", payload)
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        class Server(ThreadingHTTPServer):
+            # sampler fleets connect in bursts; the stdlib default
+            # listen backlog of 5 resets most of a burst on arrival
+            request_queue_size = 1024
+
+        self._httpd = Server((host, port), Handler)
         self.port = self._httpd.server_address[1]
 
     def serve_background(self):

@@ -58,6 +58,13 @@ The fault-point catalog (the names production code fires today):
   statesync.pre_adopt           chain/sync.py, every chunk verified but
                                 the snapshot NOT yet adopted (ctx:
                                 height) — a restart reuses the full set
+  packs.mid_write               das/packs.py, after EACH pack chunk is
+                                durably written, before the manifest
+                                (ctx: height, data_root, index) — a
+                                crash here leaves a manifest-less dir
+                                that is never served and gets pruned;
+                                the node stays servable via live
+                                assembly
 
 docs/DESIGN.md "The fault plane" and docs/FORMATS.md §9 are the normative
 descriptions of the catalog and the /faults/* admin surface.

@@ -80,6 +80,11 @@ class NodeService:
         service = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive: a thousand-sampler fleet must not pay
+            # a TCP handshake per sample round (every response carries
+            # Content-Length, so pipelined framing is always correct)
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, fmt, *args):  # quiet
                 pass
 
@@ -153,10 +158,15 @@ class NodeService:
 
                         parsed = urlparse(self.path)
                         try:
-                            self._send(200, route_das(
+                            out = route_das(
                                 service.das_core, "GET", parsed.path,
                                 parse_qs(parsed.query),
-                            ))
+                            )
+                            if isinstance(out, bytes):
+                                # /das/pack/chunk: raw static bytes
+                                self._send_raw(200, out)
+                            else:
+                                self._send(200, out)
                         except SampleError as e:
                             self._send(404 if "not served" in str(e)
                                        else 400, {"error": str(e)})
@@ -389,7 +399,12 @@ class NodeService:
                     telemetry.incr("http.500")
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        class Server(ThreadingHTTPServer):
+            # a thousand-sampler fleet connects in one burst: the stdlib
+            # default listen backlog of 5 resets most of it on arrival
+            request_queue_size = 1024
+
+        self.httpd = Server((host, port), Handler)
         self.port = self.httpd.server_address[1]
 
     def serve_background(self) -> threading.Thread:

@@ -102,6 +102,11 @@ class ValidatorService:
         service = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive (serving plane): gossip peers and
+            # sampler fleets reuse connections; every response carries
+            # Content-Length
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, fmt, *args):  # quiet
                 pass
 
@@ -252,10 +257,15 @@ class ValidatorService:
 
                         parsed = urlparse(self.path)
                         try:
-                            self._send(200, route_das(
+                            out = route_das(
                                 service.das_core, "GET", parsed.path,
                                 parse_qs(parsed.query),
-                            ))
+                            )
+                            if isinstance(out, bytes):
+                                # /das/pack/chunk: raw static bytes
+                                self._send_raw(200, out)
+                            else:
+                                self._send(200, out)
                         except SampleError as e:
                             self._send(404 if "not served" in str(e)
                                        else 400, {"error": str(e)})
@@ -338,7 +348,7 @@ class ValidatorService:
                         # refuses on host-engine processes (jax unloaded)
                         self._send(*obs.route_profile(payload))
                         return
-                    if self.path == "/das/samples":
+                    if self.path in ("/das/samples", "/das/headers"):
                         from celestia_app_tpu.das.server import (
                             SampleError,
                             route_das,
@@ -372,7 +382,12 @@ class ValidatorService:
                     telemetry.incr("http.500")
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        class Server(ThreadingHTTPServer):
+            # burst connects (gossip storms, sampler fleets): the stdlib
+            # default listen backlog of 5 resets most of a burst
+            request_queue_size = 1024
+
+        self.httpd = Server((host, port), Handler)
         self.port = self.httpd.server_address[1]
 
     # -- handlers (under self.lock) --------------------------------------

@@ -1,0 +1,476 @@
+"""Serving plane (ISSUE 11): multi-height batched sampling, static proof
+packs, and the DASer's window/pack client paths.
+
+Pins the plane's two identity contracts — a multi-height batch response
+is byte-identical per height to the single-height responses, and
+pack-served proof docs are byte-identical to live-assembled ones — for
+BOTH codec schemes, plus the operational properties: tampered pack
+chunks are rejected (peer penalized, live fallback), a crash at
+``packs.mid_write`` leaves a servable node (no torn pack ever served),
+warm heights serve with zero extend dispatches, catch-up over a warm
+window costs ~2 sampling round-trips total, and the immediate
+partial-retry path is counter-pinned.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu import faults
+from celestia_app_tpu.chain import consensus as cons
+from celestia_app_tpu.chain import light as light_mod
+from celestia_app_tpu.chain.app import App
+from celestia_app_tpu.chain.crypto import PrivateKey
+from celestia_app_tpu.chain.node import Node
+from celestia_app_tpu.chain.tx import MsgSend
+from celestia_app_tpu.client.tx_client import Signer
+from celestia_app_tpu.das import packs as packs_mod
+from celestia_app_tpu.das.checkpoint import CheckpointStore
+from celestia_app_tpu.das.daser import DASer, DASerConfig
+from celestia_app_tpu.das.server import SampleCore, SampleError
+from celestia_app_tpu.service.server import NodeService
+from celestia_app_tpu.utils import telemetry
+
+SCHEMES = ("rs2d-nmt", "cmt-ldpc")
+
+
+def _counters():
+    return telemetry.snapshot().get("counters", {})
+
+
+def _delta(c0, c1, key):
+    return c1.get(key, 0) - c0.get(key, 0)
+
+
+def _canon(doc) -> str:
+    return json.dumps(doc, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# plain node fixtures (no consensus): server-side contracts
+# ---------------------------------------------------------------------------
+
+
+def _serving_node(tmp_path, scheme="rs2d-nmt", blocks=3, pack_keep=4):
+    """(app, node, core): a disk-backed single-proposer chain with
+    `blocks` committed tx-bearing heights and every height's proof pack
+    built (the warmer coalesces under rapid commits, so stragglers are
+    built explicitly — build is idempotent)."""
+    priv = PrivateKey.from_seed(b"serving")
+    addr = priv.public_key().address()
+    app = App(chain_id=f"serving-{scheme}", engine="host",
+              data_dir=str(tmp_path / "data"), da_scheme=scheme,
+              pack_keep=pack_keep)
+    app.init_chain({
+        "time_unix": 1_700_000_000.0,
+        "accounts": [{"address": addr.hex(), "balance": 10**12}],
+        "validators": [{"operator": addr.hex(), "power": 10}],
+    })
+    node = Node(app)
+    core = node.attach_das_core(SampleCore(app))
+    signer = Signer(app.chain_id)
+    signer.add_account(priv, number=0)
+    for i in range(blocks):
+        tx = signer.create_tx(addr, [MsgSend(addr, addr, 1 + i)],
+                              fee=2000, gas_limit=100_000)
+        signer.accounts[addr].sequence += 1
+        node.broadcast_tx(tx.encode())
+        node.produce_block(t=1_700_000_000.0 + i + 1)
+    app.da_warmer.wait_idle(30)
+    for h in range(1, blocks + 1):
+        app.pack_store.build(h, core._entry(h).cache_entry)
+    return app, node, core
+
+
+@pytest.mark.parametrize("scheme", SCHEMES)
+def test_multi_height_batch_is_byte_identical_per_height(tmp_path, scheme):
+    app, _node, core = _serving_node(tmp_path, scheme=scheme, blocks=3)
+    try:
+        cells = [[0, 0], [1, 1], [0, 1]]
+        out = core.sample_groups(
+            [{"height": h, "cells": cells} for h in (1, 2, 3)])
+        assert [g["height"] for g in out["groups"]] == [1, 2, 3]
+        for i, h in enumerate((1, 2, 3)):
+            single = core.sample_many(h, [tuple(c) for c in cells])
+            assert _canon(out["groups"][i]) == _canon(single)
+        # an unresolvable height degrades to an error member while the
+        # rest of the window still serves
+        mixed = core.sample_groups([
+            {"height": 2, "cells": cells},
+            {"height": 99, "cells": cells},
+        ])
+        assert _canon(mixed["groups"][0]) == \
+            _canon(core.sample_many(2, [tuple(c) for c in cells]))
+        assert mixed["groups"][1]["height"] == 99
+        assert "error" in mixed["groups"][1]
+    finally:
+        app.close()
+
+
+@pytest.mark.parametrize("scheme", SCHEMES)
+def test_pack_bytes_identical_to_live_assembly(tmp_path, scheme):
+    """THE pack identity pin: every doc in every chunk equals the live
+    /das/samples doc for that cell, and the chunk bytes hash to the
+    manifest entry (content addressing holds end to end)."""
+    import hashlib
+
+    app, _node, core = _serving_node(tmp_path, scheme=scheme, blocks=2)
+    try:
+        for h in (1, 2):
+            m = core.pack_manifest(h)
+            assert m["scheme"] == scheme
+            assert m["data_root"] == \
+                app.db.load_block(h).header.data_hash.hex()
+            served = 0
+            for ci in range(m["n_chunks"]):
+                data = core.pack_chunk(h, ci)
+                assert hashlib.sha256(data).hexdigest() == \
+                    m["chunk_hashes"][ci]
+                docs = packs_mod.decode_chunk(data)
+                live = core.sample_many(
+                    h, [(d["row"], d["col"]) for d in docs])["samples"]
+                assert _canon(docs) == _canon(live)
+                served += len(docs)
+            assert served == m["n_cells"]
+            # the header doc advertises exactly the manifest's pack view
+            hdr = core.header(h)
+            assert hdr["pack"] == packs_mod.advertised(m)
+    finally:
+        app.close()
+
+
+def test_pack_counters_and_availability_record(tmp_path):
+    app, _node, core = _serving_node(tmp_path, blocks=1)
+    try:
+        c0 = _counters()
+        core.pack_chunk(1, 0)
+        core.sample_many(1, [(0, 0), (1, 1)])
+        with pytest.raises(SampleError):
+            core.pack_manifest(99)  # no pack for an unknown height
+        c1 = _counters()
+        assert _delta(c0, c1, "das.pack_hits") == 1
+        assert _delta(c0, c1, "das.pack_misses") == 1
+        assert _delta(c0, c1, "das.live_assembled") == 2
+        rec = core.availability(1)
+        assert rec["pack_hits"] >= 1
+        assert rec["live_assembled"] >= 2
+        assert rec["pack_misses"] == 0  # the miss was height 99
+        # unknown heights count the GLOBAL miss only — a per-height
+        # record would let arbitrary-height request streams evict every
+        # genuine record from the bounded availability map
+        rec99 = core.availability(99)
+        assert rec99["pack_misses"] == 0 and rec99["data_root"] is None
+        assert 99 not in core._availability
+        # prometheus exposition carries the counters (satellite: the
+        # /metrics surface distinguishes pack-served from live)
+        text = telemetry.prometheus()
+        assert "das_pack_hits" in text and "das_live_assembled" in text
+    finally:
+        app.close()
+
+
+def test_pack_crash_safety_and_prune(tmp_path):
+    """A build killed at packs.mid_write leaves a manifest-less dir:
+    never advertised, never served, pruned by the next build — and the
+    node keeps serving live the whole time. Pruning keeps newest-N."""
+    app, node, core = _serving_node(tmp_path, blocks=2, pack_keep=2)
+    try:
+        store = app.pack_store
+        # grow two more heights WITHOUT letting the warmer pack them:
+        # arm an error at the fault point first
+        faults.arm("packs.mid_write", "error")
+        priv = PrivateKey.from_seed(b"serving")
+        addr = priv.public_key().address()
+        signer = Signer(app.chain_id)
+        signer.add_account(priv, number=0,
+                           sequence=2)
+        tx = signer.create_tx(addr, [MsgSend(addr, addr, 77)],
+                              fee=2000, gas_limit=100_000)
+        node.broadcast_tx(tx.encode())
+        node.produce_block(t=1_700_000_100.0)
+        app.da_warmer.wait_idle(30)
+        h = app.height
+        entry = core._entry(h).cache_entry
+        with pytest.raises(OSError):
+            store.build(h, entry)
+        root_hex = entry.data_root.hex()
+        torn = store.path_for(root_hex)
+        assert os.path.isdir(torn)
+        assert not os.path.exists(os.path.join(torn, "manifest.json"))
+        # servable state: no pack advertised (404-mapped), live serving
+        # still answers, and the header doc carries no pack member
+        with pytest.raises(SampleError, match="not served"):
+            core.pack_manifest(h)
+        assert "pack" not in core.header(h)
+        out = core.sample_many(h, [(0, 0)])
+        assert "error" not in out["samples"][0]
+        # recovery: disarm, rebuild, serve — byte-identical to live
+        faults.reset()
+        m = store.build(h, entry)
+        assert core.pack_manifest(h) == m
+        docs = packs_mod.decode_chunk(core.pack_chunk(h, 0))
+        live = core.sample_many(
+            h, [(d["row"], d["col"]) for d in docs])["samples"]
+        assert _canon(docs) == _canon(live)
+        # the torn dir became a complete pack; prune keeps newest 2
+        complete = [
+            name for name in os.listdir(store.root)
+            if os.path.exists(os.path.join(store.root, name,
+                                           "manifest.json"))
+        ]
+        assert len(complete) <= 2
+        assert root_hex in complete  # newest height survives the prune
+    finally:
+        faults.reset()
+        app.close()
+
+
+def test_warm_height_serves_with_zero_extends(tmp_path):
+    """The extend-once pin extended to the serving plane: a warm height
+    answers live batches, multi-height groups, AND pack chunks with a
+    da.extend_runs delta of 0 (and no square rebuild)."""
+    app, _node, core = _serving_node(tmp_path, blocks=2)
+    try:
+        c0 = _counters()
+        core.sample_many(2, [(0, 0), (1, 1)])
+        core.sample_groups([{"height": h, "cells": [[0, 0]]}
+                            for h in (1, 2)])
+        core.pack_chunk(2, 0)
+        core.pack_manifest(2)
+        c1 = _counters()
+        assert _delta(c0, c1, "da.extend_runs") == 0
+        assert _delta(c0, c1, "das.square_builds") == 0
+    finally:
+        app.close()
+
+
+# ---------------------------------------------------------------------------
+# consensus-backed fixtures: the DASer client paths over real HTTP
+# ---------------------------------------------------------------------------
+
+
+def _vchain(tmp_path, blocks=1, scheme="rs2d-nmt", pack_keep=4,
+            with_packs=True):
+    """(vnode, svc, url, trust): a one-validator certified chain served
+    by a NodeService — commit certificates back the DASer's light
+    client, packs back the static path."""
+    priv = PrivateKey.from_seed(b"serve-val")
+    genesis = {
+        "time_unix": 1_700_000_000.0,
+        "accounts": [{"address": priv.public_key().address().hex(),
+                      "balance": 10**12}],
+        "validators": [{
+            "operator": priv.public_key().address().hex(),
+            "power": 10,
+            "pubkey": priv.public_key().compressed.hex(),
+        }],
+    }
+    vnode = cons.ValidatorNode(
+        "srv", priv, genesis, f"serve-chain-{scheme}",
+        data_dir=str(tmp_path / "srv" / "data"), da_scheme=scheme,
+        pack_keep=pack_keep if with_packs else None)
+    for _ in range(blocks):
+        height = vnode.app.height + 1
+        last_cert = vnode.certificates.get(height - 1)
+        block = vnode.propose(t=1_700_000_000.0 + height)
+        bh = block.header.hash()
+        vote = vnode._signed(height, bh, "precommit", 0)
+        cert = cons.CommitCertificate(height, bh, (vote,), 0)
+        vnode.apply(block, cert, absent_cert=last_cert)
+        vnode.clear_lock()
+    svc = NodeService(vnode, port=0)
+    svc.serve_background()
+    vnode.app.da_warmer.wait_idle(30)
+    if with_packs:
+        for h in range(1, vnode.app.height + 1):
+            vnode.app.pack_store.build(
+                h, svc.das_core._entry(h).cache_entry)
+    trust = light_mod.TrustedState(
+        height=0, header_hash=b"",
+        validators={vnode.address: priv.public_key().compressed},
+        powers={vnode.address: 10},
+    )
+    return vnode, svc, f"http://127.0.0.1:{svc.port}", trust
+
+
+def _daser(url, trust, tmp_path, chain_id, **cfg):
+    defaults = dict(samples_per_header=4, workers=1, retries=2,
+                    backoff=0.01)
+    return DASer(
+        [url], light_mod.LightClient(chain_id, trust),
+        CheckpointStore(str(tmp_path / "cp" / "cp.json")),
+        cfg=DASerConfig(**{**defaults, **cfg}),
+        rng=np.random.default_rng(11), name="serving-daser",
+    )
+
+
+def test_daser_samples_from_pack_chunks(tmp_path):
+    """Single-height head-follow with an advertised pack: the DASer
+    verifies its draws out of sha-checked static chunks — no live
+    assembly request at all — and the availability claim is unchanged."""
+    vnode, svc, url, trust = _vchain(tmp_path, blocks=1)
+    try:
+        daser = _daser(url, trust, tmp_path, vnode.app.chain_id)
+        c0 = _counters()
+        out = daser.sync()
+        c1 = _counters()
+        assert out["halted"] is None and out["sampled"] == [1]
+        assert daser.reports[1]["status"] == "sampled"
+        assert _delta(c0, c1, "daser.pack_samples") >= 4
+        assert _delta(c0, c1, "das.pack_hits") >= 1
+        # the live assembly path never ran for the sampled cells
+        assert _delta(c0, c1, "das.live_assembled") == 0
+    finally:
+        svc.shutdown()
+        vnode.app.close()
+
+
+def test_daser_rejects_tampered_pack_chunk_and_falls_back(tmp_path):
+    """A tampered chunk (bytes no longer hash to the manifest entry) is
+    rejected client-side: the serving peer is penalized on the shared
+    health score and the height is sampled via live assembly instead —
+    integrity of the static path never gates availability."""
+    vnode, svc, url, trust = _vchain(tmp_path, blocks=1)
+    try:
+        store = vnode.app.pack_store
+        m = svc.das_core.pack_manifest(1)
+        chunk_path = os.path.join(store.path_for(m["data_root"]),
+                                  m["chunk_hashes"][0] + ".chunk")
+        with open(chunk_path, "r+b") as f:
+            raw = bytearray(f.read())
+            raw[len(raw) // 2] ^= 0xFF
+            f.seek(0)
+            f.write(raw)
+        daser = _daser(url, trust, tmp_path, vnode.app.chain_id)
+        c0 = _counters()
+        out = daser.sync()
+        c1 = _counters()
+        assert out["halted"] is None and out["sampled"] == [1]
+        assert daser.reports[1]["status"] == "sampled"
+        assert _delta(c0, c1, "daser.pack_chunk_rejected") >= 1
+        assert _delta(c0, c1, "net.penalized") >= 1
+        assert _delta(c0, c1, "das.live_assembled") >= 4  # the fallback
+        # the penalty landed on the serving peer's health record
+        health = daser.peers.client.snapshot()[url]
+        assert health["failures"] >= 1
+        assert "pack chunk" in health["last_error"]
+    finally:
+        svc.shutdown()
+        vnode.app.close()
+
+
+def test_window_catchup_costs_two_round_trips(tmp_path):
+    """Catch-up over a warm 4-height window: one batched /das/headers +
+    one grouped /das/samples — sampling round-trips per height 0.5,
+    every height sampled with the per-height report shape intact."""
+    vnode, svc, url, trust = _vchain(tmp_path, blocks=4,
+                                     with_packs=False)
+    try:
+        daser = _daser(url, trust, tmp_path, vnode.app.chain_id,
+                       job_size=4)
+        c0 = _counters()
+        out = daser.sync()
+        c1 = _counters()
+        assert out["halted"] is None
+        assert out["sampled"] == [1, 2, 3, 4]
+        for h in (1, 2, 3, 4):
+            rep = daser.reports[h]
+            assert rep["status"] == "sampled"
+            # verified counts DISTINCT coords (duplicate draws over a
+            # tiny square collapse), failures none
+            assert rep["samples"] == 4 and rep["failed"] == []
+            assert 1 <= rep["verified"] <= 4
+            assert 0.0 < rep["confidence"] < 1.0
+        trips = _delta(c0, c1, "daser.sampling_round_trips")
+        swept = _delta(c0, c1, "daser.heights_swept")
+        assert swept == 4
+        assert trips == 2, trips  # headers batch + grouped samples
+        assert _delta(c0, c1, "das.multi_height_batches") == 1
+    finally:
+        svc.shutdown()
+        vnode.app.close()
+
+
+@pytest.mark.parametrize("scheme", SCHEMES)
+def test_window_catchup_serves_both_schemes(tmp_path, scheme):
+    """The window path is scheme-generic: grouped responses carry each
+    scheme's docs and the codec-interface verification accepts them."""
+    vnode, svc, url, trust = _vchain(tmp_path, blocks=2, scheme=scheme)
+    try:
+        daser = _daser(url, trust, tmp_path, vnode.app.chain_id,
+                       job_size=2)
+        out = daser.sync()
+        assert out["halted"] is None and out["sampled"] == [1, 2]
+        for h in (1, 2):
+            rep = daser.reports[h]
+            assert rep["status"] == "sampled"
+            if scheme != "rs2d-nmt":
+                assert rep["scheme"] == scheme
+    finally:
+        svc.shutdown()
+        vnode.app.close()
+
+
+def test_partial_retry_is_immediate_and_counter_pinned(tmp_path):
+    """One transiently-failed cell of a batch retries IMMEDIATELY on the
+    next rotation (daser.partial_retries == 1) instead of paying the
+    whole batch a backoff sleep; the height still lands 'sampled'."""
+    vnode, svc, url, trust = _vchain(tmp_path, blocks=1,
+                                     with_packs=False)
+    try:
+        # exactly ONE serve-side drop, then the cell serves normally
+        faults.arm("das.serve_sample", "drop", count=1)
+        daser = _daser(url, trust, tmp_path, vnode.app.chain_id)
+        c0 = _counters()
+        out = daser.sync()
+        c1 = _counters()
+        assert out["halted"] is None and out["sampled"] == [1]
+        assert daser.reports[1]["status"] == "sampled"
+        assert daser.reports[1]["failed"] == []
+        assert _delta(c0, c1, "daser.partial_retries") == 1
+        assert _delta(c0, c1, "daser.escalations") == 0
+    finally:
+        faults.reset()
+        svc.shutdown()
+        vnode.app.close()
+
+
+def test_sidecar_serves_pack_chunks_over_keepalive_http(tmp_path):
+    """The das-serve sidecar shape: raw chunk bytes (octet-stream) and
+    JSON routes answered over ONE persistent HTTP/1.1 connection."""
+    import hashlib
+    import http.client
+
+    from celestia_app_tpu.das.server import SampleService
+
+    app, _node, core = _serving_node(tmp_path, blocks=1)
+    svc = SampleService(core, port=0).serve_background()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", svc.port,
+                                          timeout=10)
+        conn.request("GET", "/das/pack?height=1")
+        r = conn.getresponse()
+        assert r.status == 200
+        m = json.loads(r.read())
+        conn.request("GET", "/das/pack/chunk?height=1&index=0")
+        r = conn.getresponse()  # same socket: keep-alive survived
+        assert r.status == 200
+        assert r.getheader("Content-Type") == "application/octet-stream"
+        data = r.read()
+        assert hashlib.sha256(data).hexdigest() == m["chunk_hashes"][0]
+        # out-of-range index: 400 (the sync plane's chunk-route
+        # semantics); unknown height: 404 ("not served")
+        conn.request("GET", "/das/pack/chunk?height=1&index=99")
+        r = conn.getresponse()
+        assert r.status == 400
+        r.read()
+        conn.request("GET", "/das/pack?height=99")
+        r = conn.getresponse()
+        assert r.status == 404
+        r.read()
+        conn.close()
+    finally:
+        svc.shutdown()
+        app.close()
