@@ -843,30 +843,53 @@ def main() -> None:
 
 def measure_analyze(reps: int = 3) -> None:
     """Analysis-plane bench (--analyze): wall time of a full-tree run of
-    every registered rule (tools/analyze) against the committed
-    analyze.toml — the cost every tier-1 test run and pre-commit hook
-    pays. Budget: < 10 s on CPU (it is pure-AST work; ~1.5 s today).
-    One BENCH JSON line:
+    every registered rule (tools/analyze, call-graph taint included)
+    against the committed analyze.toml — the cost every tier-1 test run
+    and pre-commit hook pays. Cold clears the per-file incremental
+    cache first; warm re-runs against it (ISSUE 12 gate: warm ≤ cold/3
+    — every file unchanged, so only the interprocedural re-link runs).
+    Budget: < 10 s cold on CPU (pure-AST work). One BENCH JSON line:
 
-      {"metric": "analyze_wall_s", ...}
+      {"metric": "analyze_wall_s", ...,
+       "analyze_cold_wall_s": F, "analyze_warm_wall_s": F}
     """
+    import os
+    import tempfile
+
     from celestia_app_tpu.tools.analyze import run_analysis
 
-    best = None
+    cache_path = os.path.join(tempfile.gettempdir(),
+                              f"analyze_bench_cache_{os.getpid()}.json")
+    best_cold = best_warm = None
     rep = None
-    for _ in range(reps):
-        rep = run_analysis()
-        best = rep.wall_s if best is None else min(best, rep.wall_s)
+    try:
+        for _ in range(reps):
+            if os.path.exists(cache_path):
+                os.unlink(cache_path)
+            cold = run_analysis(cache=cache_path)
+            rep = warm = run_analysis(cache=cache_path)
+            assert warm.cache_misses == 0, warm.cache_misses
+            best_cold = (cold.wall_s if best_cold is None
+                         else min(best_cold, cold.wall_s))
+            best_warm = (warm.wall_s if best_warm is None
+                         else min(best_warm, warm.wall_s))
+    finally:
+        if os.path.exists(cache_path):
+            os.unlink(cache_path)
     print(json.dumps({
         "metric": "analyze_wall_s",
-        "analyze_wall_s": round(best, 3),
+        "analyze_wall_s": round(best_cold, 3),
+        "analyze_cold_wall_s": round(best_cold, 3),
+        "analyze_warm_wall_s": round(best_warm, 3),
+        "warm_speedup": round(best_cold / max(best_warm, 1e-9), 1),
         "files_scanned": rep.files_scanned,
         "rules_run": len(rep.rules_run),
         "violations": len(rep.violations),
         "errors": len(rep.errors),
         "waived": len(rep.waived),
         "budget_s": 10.0,
-        "within_budget": best < 10.0,
+        "within_budget": best_cold < 10.0,
+        "warm_within_third": best_warm <= best_cold / 3.0,
     }))
 
 
@@ -2014,8 +2037,10 @@ MODES = {
               "p99_sample_ms, pack_hit_ratio",
               "serving plane: pack-served vs live sampling under "
               "thousand-sampler load"),
-    "analyze": (measure_analyze, "analyze_wall_s",
-                "full-tree static-analysis wall time (tier-1 cost)"),
+    "analyze": (measure_analyze,
+                "analyze_cold_wall_s, analyze_warm_wall_s",
+                "full-tree static analysis (call-graph taint included) "
+                "cold vs incremental-cache warm"),
     "obs": (measure_obs, "obs_overhead_pct",
             "observability overhead on the produce-block path"),
     "stream-mesh": (measure_stream_mesh,

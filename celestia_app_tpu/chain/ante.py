@@ -225,8 +225,9 @@ class AnteHandler:
             # simulation probes carry placeholder fees (the SDK's simulate
             # mode skips the min-gas-price adequacy check the same way)
             raise AnteError(
-                f"insufficient gas price: {body.fee / body.gas_limit:.9f} "
-                f"< min {floor_atto / appconsts.ATTO:.9f}"
+                # display-only divisions: the gate above compares ints
+                f"insufficient gas price: {body.fee / body.gas_limit:.9f} "  # lint: disable=det-float
+                f"< min {floor_atto / appconsts.ATTO:.9f}"  # lint: disable=det-float
             )
 
         signer = self._signer(body)

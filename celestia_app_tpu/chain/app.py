@@ -552,7 +552,7 @@ class App:
         # the PROPOSER's wall clock is the protocol's source of header
         # time (Tendermint BFT-time analog); every other node consumes
         # block.header.time_unix verbatim
-        t = t if t is not None else time_mod.time()  # lint: disable=det-wallclock
+        t = t if t is not None else time_mod.time()  # lint: disable=det-wallclock,det-reach
         height = self.height + 1
         # root span of the block lifecycle: the trace id derives from
         # (chain_id, height), so followers and DAS light nodes stamp the

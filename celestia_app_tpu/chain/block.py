@@ -40,7 +40,7 @@ class Header:
         # fraction. Half-up rounding (int(x+0.5)), NOT Python's half-even
         # round(), so Go's math.Round / C's round() reproduce the same hash.
         whole = int(self.time_unix)
-        frac_ns = int((self.time_unix - whole) * 1e9 + 0.5)
+        frac_ns = int((self.time_unix - whole) * 1e9 + 0.5)  # lint: disable=det-float
         out += (whole * 1_000_000_000 + frac_ns).to_bytes(8, "big")
         out += self.data_hash
         out += uvarint(self.square_size)

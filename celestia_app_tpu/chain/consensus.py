@@ -408,7 +408,9 @@ class ValidatorNode:
         import time as time_mod
 
         return self.pool.add(raw, height=self.app.height,
-                             now=time_mod.time(),
+                             # mempool TTL stamp: node-local pool state,
+                             # never hashed
+                             now=time_mod.time(),  # lint: disable=det-wallclock
                              check_fn=self.app.check_tx)
 
     def add_txs(self, raws) -> list:
@@ -419,7 +421,8 @@ class ValidatorNode:
         from celestia_app_tpu.chain import admission
 
         return self.pool.add_batch(
-            raws, height=self.app.height, now=time_mod.time(),
+            # mempool TTL stamp: node-local pool state, never hashed
+            raws, height=self.app.height, now=time_mod.time(),  # lint: disable=det-wallclock
             check_fn=self.app.check_tx,
             prevalidate_fn=lambda rs: admission.prevalidate(
                 self.app, rs, check_state=True),

@@ -269,7 +269,8 @@ class PrivateKey:
     def generate(cls) -> "PrivateKey":
         import secrets
 
-        return cls(secrets.randbelow(_N - 1) + 1)
+        # key GENERATION is operator-side entropy, never consensus
+        return cls(secrets.randbelow(_N - 1) + 1)  # lint: disable=det-rng
 
     def _key(self):
         return ec.derive_private_key(self.scalar, _CURVE)

@@ -321,7 +321,7 @@ class MinFeeKeeper:
 
     def network_min_gas_price(self, ctx: Context) -> float:
         """Display-only float view (status endpoints, logs)."""
-        return self.network_min_gas_price_atto(ctx) / appconsts.ATTO
+        return self.network_min_gas_price_atto(ctx) / appconsts.ATTO  # lint: disable=det-float
 
     def set_network_min_gas_price(self, ctx: Context, price) -> None:
         """Accepts a float/decimal literal or an already-scaled int is NOT

@@ -129,7 +129,9 @@ class Node:
     # -- consensus loop ------------------------------------------------
 
     def produce_block(self, t: float | None = None) -> tuple[Block, list[TxResult]]:
-        t = t if t is not None else time_mod.time()
+        # the PROPOSER's clock is the protocol's source of header time
+        # (same prerogative as App.prepare_proposal)
+        t = t if t is not None else time_mod.time()  # lint: disable=det-wallclock
         # one root span for the whole round — prepare/process/finalize/
         # commit nest under it with the height's deterministic trace id
         from celestia_app_tpu import obs

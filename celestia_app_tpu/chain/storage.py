@@ -285,12 +285,10 @@ class ChainDB:
         """Persist one commit. ``store`` is the live KVStore: its change log
         (drain_changes) becomes the delta; a full snapshot is written at the
         first durable commit, every FULL_INTERVAL commits, or on demand."""
-        import time as time_mod
-
         from celestia_app_tpu import obs
         from celestia_app_tpu.utils import telemetry
 
-        t0 = time_mod.perf_counter()
+        t0 = telemetry.start_timer()
         with obs.span("storage.save_commit", height=height):
             self._save_commit_inner(height, store, meta,
                                     force_full=force_full)

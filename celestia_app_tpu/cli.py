@@ -1849,17 +1849,92 @@ def cmd_dasload(args) -> int:
     return dasload.main(argv)
 
 
+def _git_changed_package_files(pkg_root: str) -> set[str] | None:
+    """Package-relative paths of .py files changed vs HEAD (staged,
+    unstaged, and untracked), or None when git is unavailable."""
+    import subprocess
+
+    pkg_root = os.path.abspath(pkg_root)
+    try:
+        top = subprocess.run(
+            ["git", "-C", pkg_root, "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, timeout=30)
+        if top.returncode != 0:
+            return None
+        repo = top.stdout.strip()
+        diff = subprocess.run(
+            ["git", "-C", repo, "diff", "--name-only", "HEAD"],
+            capture_output=True, text=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "-C", repo, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, timeout=30)
+        if diff.returncode != 0 or untracked.returncode != 0:
+            return None
+    except (OSError, subprocess.SubprocessError):
+        return None
+    changed: set[str] = set()
+    for line in (diff.stdout + untracked.stdout).splitlines():
+        abspath = os.path.join(repo, line.strip())
+        rel = os.path.relpath(abspath, pkg_root)
+        if line.strip().endswith(".py") and not rel.startswith(".."):
+            changed.add(rel.replace(os.sep, "/"))
+    return changed
+
+
 def cmd_analyze(args) -> int:
     """The analysis plane (tools/analyze): run every registered rule
     over the package tree against the committed analyze.toml. Exit 0
     on a clean (or fully waived) tree, 1 when any error-severity
-    violation survives — the same verdict tests/test_analyze.py pins."""
+    violation survives — the same verdict tests/test_analyze.py pins —
+    and 2 on operator error (unknown --rule names the registry)."""
     from celestia_app_tpu.tools.analyze import load_config, run_analysis
+    from celestia_app_tpu.tools.analyze.engine import registered_rule_ids
     from celestia_app_tpu.tools.analyze.report import to_json_text, to_text
 
     config = load_config(args.config) if args.config else None
-    only = set(args.rule) if args.rule else None
-    rep = run_analysis(root=args.root, config=config, only_rules=only)
+    only = None
+    if args.rule:
+        only = {r.strip() for spec in args.rule
+                for r in spec.split(",") if r.strip()}
+        known = registered_rule_ids()
+        unknown = sorted(only - known)
+        if unknown:
+            print(f"analyze: unknown rule(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            print(f"registered rules: {', '.join(sorted(known))}",
+                  file=sys.stderr)
+            return 2
+    rep = run_analysis(root=args.root, config=config, only_rules=only,
+                       cache=not args.no_cache)
+    if args.scopes:
+        from celestia_app_tpu.tools.analyze.taint import scopes_report
+
+        if rep.program is None:
+            print("analyze: --scopes needs the interprocedural rules "
+                  "enabled (det-reach)", file=sys.stderr)
+            return 2
+        print(scopes_report(rep.program,
+                            config if config else load_config()))
+        return 1 if rep.errors else 0
+    if args.changed:
+        changed = _git_changed_package_files(rep.root)
+        if changed is None:
+            print("analyze: --changed needs a git checkout",
+                  file=sys.stderr)
+            return 2
+
+        def _touches_changed(v) -> bool:
+            # interprocedural violations anchor at the ROOT of the
+            # chain (blocking-under-lock reports at the lock holder),
+            # so an edit to any file on the call path must surface too
+            if v.path in changed:
+                return True
+            return any(node.split("::")[0] in changed
+                       for node in (v.call_path or ()))
+
+        rep.violations = [v for v in rep.violations
+                          if _touches_changed(v)]
     if args.json:
         print(to_json_text(rep))
     else:
@@ -2227,9 +2302,22 @@ def main(argv=None) -> int:
     p.add_argument("--config", default=None,
                    help="alternate analyze.toml")
     p.add_argument("--rule", action="append",
-                   help="run only this rule id (repeatable)")
+                   help="run only these rule ids (comma-separated, "
+                        "repeatable); unknown names exit 2 listing "
+                        "the registry")
     p.add_argument("--verbose", action="store_true",
                    help="also print waived violations")
+    p.add_argument("--scopes", action="store_true",
+                   help="print the computed consensus-reachable scope "
+                        "audit (det-reach roots -> minimal det-* "
+                        "include lists) instead of violations")
+    p.add_argument("--changed", action="store_true",
+                   help="report only violations in files changed vs "
+                        "git HEAD (dev loop; the full tree still "
+                        "feeds the call graph)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="disable the per-file incremental result cache "
+                        "(.analyze_cache.json)")
     p.set_defaults(fn=cmd_analyze)
 
     args = ap.parse_args(argv)

@@ -41,7 +41,9 @@ def _axis_leaf_ns(eds: jax.Array, k: int) -> jax.Array:
     two_k = 2 * k
     idx = jnp.arange(two_k)
     in_q0 = (idx[:, None] < k) & (idx[None, :] < k)  # (2k, 2k)
-    parity = jnp.asarray(np.frombuffer(ns_mod.PARITY_NS_RAW, dtype=np.uint8))
+    # trace-time constant: numpy over a module-level byte string, baked
+    # into the program — not a per-call host round-trip
+    parity = jnp.asarray(np.frombuffer(ns_mod.PARITY_NS_RAW, dtype=np.uint8))  # lint: disable=jit-purity
     return jnp.where(in_q0[..., None], eds[:, :, :NS], parity)
 
 

@@ -120,7 +120,9 @@ class EdsCacheEntry:
 
     def get_prover(self, engine: str = "auto"):
         """The row-axis BlockProver, built once (engine-gated)."""
-        with self._row_lock:
+        # the build-once lock EXISTS to serialize this first build (jit
+        # compile included); samplers queue here instead of rebuilding
+        with self._row_lock:  # lint: disable=blocking-under-lock
             if self._prover is None:
                 self._prover = build_block_prover(
                     self.eds, self.dah, engine, levels=self.levels
@@ -132,7 +134,8 @@ class EdsCacheEntry:
         a square ARE the row trees of its transpose — same leaf-namespace
         rule (parity iff outside Q0 survives (r,c)->(c,r)), same batched
         level pass, no per-cell hashing."""
-        with self._col_lock:
+        # build-once serialization, same reasoning as get_prover
+        with self._col_lock:  # lint: disable=blocking-under-lock
             if self._col_prover is None:
                 t0 = telemetry.start_timer()
                 eds_t = ExtendedDataSquare(

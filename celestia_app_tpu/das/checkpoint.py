@@ -65,12 +65,18 @@ class CheckpointStore:
             return Checkpoint.from_json(json.load(f))
 
     def save(self, cp: Checkpoint) -> None:
+        self.save_doc(cp.to_json())
+
+    def save_doc(self, doc: dict) -> None:
+        """Write an already-snapshotted ``Checkpoint.to_json()`` doc —
+        callers that guard their checkpoint with a lock snapshot under
+        the lock and pay the fsync OUTSIDE it (blocking-under-lock)."""
         d = os.path.dirname(self.path)
         if d:
             os.makedirs(d, exist_ok=True)
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(cp.to_json(), f)
+            json.dump(doc, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)

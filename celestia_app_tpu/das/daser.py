@@ -248,6 +248,12 @@ class DASer:
             return self.cp.halted is not None
 
     def _halt(self, height: int, reason: str, data_root: str) -> None:
+        # snapshot under the lock, fsync OUTSIDE it (found by the
+        # blocking-under-lock rule): workers polling `halted` must not
+        # queue behind a disk flush. A concurrent _fold save racing
+        # this write is harmless — both docs are valid checkpoints and
+        # the store's atomic replace can only UNDER-claim progress.
+        doc = None
         with self._lock:
             if self.cp.halted is None:
                 self.cp.halted = {
@@ -255,7 +261,9 @@ class DASer:
                     "data_root": data_root,
                 }
                 self._halted_evt.set()
-                self.store.save(self.cp)
+                doc = self.cp.to_json()
+        if doc is not None:
+            self.store.save_doc(doc)
         telemetry.incr("daser.halts")
 
     # -- header following (coordinator; sequential light-client trust) ---
@@ -1051,7 +1059,10 @@ class DASer:
                 [self.cp.sample_from] + sorted(self.cp.failed)[:1])
             for h in [h for h in self._roots if h < floor]:
                 del self._roots[h]
-            self.store.save(self.cp)
+            doc = self.cp.to_json()
+        # fsync outside the lock (blocking-under-lock): status polls and
+        # worker folds must not stall on the checkpoint flush
+        self.store.save_doc(doc)
 
     # -- daemon lifecycle ------------------------------------------------
 

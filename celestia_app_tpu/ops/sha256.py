@@ -93,7 +93,9 @@ def sha256(msgs: jax.Array) -> jax.Array:
     tail = np.zeros(total - msg_len, dtype=np.uint8)
     tail[0] = 0x80
     bit_len = msg_len * 8
-    tail[-8:] = np.frombuffer(bit_len.to_bytes(8, "big"), dtype=np.uint8)
+    # trace-time constant: L is static, so the padding tail is host
+    # numpy over Python ints, baked into the traced program
+    tail[-8:] = np.frombuffer(bit_len.to_bytes(8, "big"), dtype=np.uint8)  # lint: disable=jit-purity
     padded = jnp.concatenate(
         [msgs, jnp.broadcast_to(jnp.asarray(tail), (n, tail.shape[0]))], axis=1
     )

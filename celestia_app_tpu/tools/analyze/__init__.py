@@ -33,10 +33,10 @@ def load_config(path: str | None = None):
 
 
 def run_analysis(root: str | None = None, config=None,
-                 only_rules=None):
+                 only_rules=None, cache=None):
     from celestia_app_tpu.tools.analyze.engine import run_analysis as _ra
 
-    return _ra(root, config, only_rules=only_rules)
+    return _ra(root, config, only_rules=only_rules, cache=cache)
 
 
 def default_package_root() -> str:

@@ -1,0 +1,167 @@
+"""Per-file incremental result cache for ``run_analysis``.
+
+One JSON document (default: ``.analyze_cache.json`` next to the
+analyzed package root, gitignored — FORMATS §11.4), holding up to
+``MAX_RUNS`` key-namespaced run slots so alternating run shapes (the
+full pre-commit sweep vs a ``--rule``-filtered dev loop, or two sibling
+roots sharing the parent directory) stay warm side by side instead of
+evicting each other:
+
+    {
+      "version": 2,
+      "runs": {
+        "<key sha256 hex>": {
+          "chain/app.py": {
+            "sha": "<sha256 of the file bytes>",
+            "violations": {"det-wallclock": [[line, col, msg], ...]},
+            "fragment": {...callgraph.build_fragment...} | null
+          }, ...
+        }, ...
+      }
+    }
+
+The ``key`` folds together everything that can change a per-file
+result besides the file itself: the analyzed root's absolute path, the
+config (its committed bytes, or a canonical dump for in-memory
+configs), the rule-set *source* (sha256 over every
+``tools/analyze/*.py``, so editing any rule auto-invalidates — no
+hand-bumped version constant to forget), the fragment schema version,
+the set of rules being run, and the Python minor version (AST shapes
+differ). Per-file entries store violations post-pragma and
+post-symbol-scope (both are functions of the keyed inputs) but
+pre-waiver — waivers are applied at assembly so staleness accounting
+stays global. Interprocedural rules are never cached: they re-link and
+re-run from the (cached) fragments on every run, which is what keeps a
+one-file edit's warm run both fast and byte-identical to cold.
+
+Within a run slot, entries not touched in that run (deleted/renamed
+files) are dropped on save; the current run's slot is re-inserted
+last, and the oldest slots are trimmed past ``MAX_RUNS``. The write is
+tmp+rename so a killed run never leaves a torn cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+
+CACHE_VERSION = 2
+MAX_RUNS = 4
+
+
+def default_cache_path(root: str) -> str:
+    """Next to (not inside) the analyzed package: for the installed
+    package root that is the repo root's ``.analyze_cache.json``."""
+    parent = os.path.dirname(os.path.abspath(root))
+    return os.path.join(parent, ".analyze_cache.json")
+
+
+def rules_source_hash() -> str:
+    """sha256 over the analyze framework's own sources — editing any
+    rule, the engine, or this module invalidates every cached result."""
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for name in sorted(os.listdir(pkg_dir)):
+        if not name.endswith(".py"):
+            continue
+        h.update(name.encode())
+        with open(os.path.join(pkg_dir, name), "rb") as f:
+            h.update(hashlib.sha256(f.read()).digest())
+    return h.hexdigest()
+
+
+def config_hash(config) -> str:
+    """Committed configs hash by file bytes; in-memory configs by a
+    canonical dump of their dataclass fields."""
+    if config.source_path and os.path.exists(config.source_path):
+        with open(config.source_path, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+    doc = {
+        "exclude": list(config.exclude),
+        "rules": {
+            rid: {
+                "severity": rc.severity,
+                "include": list(rc.include),
+                "exclude": list(rc.exclude),
+                "allow": list(rc.allow),
+                "options": rc.options,
+            }
+            for rid, rc in sorted(config.rules.items())
+        },
+        "waivers": [[w.rule, w.path, w.reason] for w in config.waivers],
+    }
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True).encode()).hexdigest()
+
+
+def cache_key(config, rules_run: list[str], root: str) -> str:
+    from celestia_app_tpu.tools.analyze.callgraph import FRAGMENT_VERSION
+
+    h = hashlib.sha256()
+    h.update(f"v{CACHE_VERSION}/frag{FRAGMENT_VERSION}/"
+             f"py{sys.version_info[0]}.{sys.version_info[1]}/".encode())
+    h.update(os.path.abspath(root).encode() + b"/")
+    h.update(rules_source_hash().encode())
+    h.update(config_hash(config).encode())
+    h.update(",".join(sorted(rules_run)).encode())
+    return h.hexdigest()
+
+
+class ResultCache:
+    def __init__(self, path: str, key: str, runs: dict):
+        self.path = path
+        self.key = key
+        self._runs = runs                      # other keys' slots
+        self._files = runs.pop(key, {})        # this run's slot
+        self._touched: dict[str, dict] = {}
+        self._dirty = False
+
+    @classmethod
+    def open(cls, path: str, key: str) -> "ResultCache":
+        runs: dict = {}
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+            if (doc.get("version") == CACHE_VERSION
+                    and isinstance(doc.get("runs"), dict)):
+                runs = doc["runs"]
+        except (OSError, ValueError):
+            pass  # missing/torn cache = cold start, never an error
+        return cls(path, key, runs)
+
+    def lookup(self, rel: str, sha: str) -> dict | None:
+        entry = self._files.get(rel)
+        if entry is None or entry.get("sha") != sha:
+            return None
+        self._touched[rel] = entry
+        return entry
+
+    def put(self, rel: str, sha: str, violations: dict,
+            fragment: dict | None) -> None:
+        self._touched[rel] = {
+            "sha": sha, "violations": violations, "fragment": fragment,
+        }
+        self._dirty = True
+
+    def save(self) -> None:
+        if not self._dirty and set(self._touched) == set(self._files):
+            return
+        runs = dict(self._runs)
+        runs[self.key] = self._touched  # current run last (newest)
+        while len(runs) > MAX_RUNS:
+            runs.pop(next(iter(runs)))  # trim oldest slot
+        doc = {"version": CACHE_VERSION, "runs": runs}
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f, separators=(",", ":"))
+            os.replace(tmp, self.path)
+        except OSError:
+            # an unwritable cache dir degrades to cold runs, silently
+            # by design: the cache is a pure accelerator
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass

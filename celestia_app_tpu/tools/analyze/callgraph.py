@@ -1,0 +1,765 @@
+"""Whole-package call graph for the interprocedural rules.
+
+Two stages, split so the incremental cache can persist the per-file
+half and re-derive only what changed:
+
+1. ``build_fragment(ctx)`` — a **per-file, JSON-serializable** summary:
+   every function definition (qualified ``Class.method.inner`` names),
+   its outgoing call *descriptors* (resolved as far as one file can —
+   import aliases via ``FileContext.resolve``, ``self.`` receivers,
+   ``self.<attr>.`` receivers typed from ``__init__`` assignments),
+   the taint marks the engines need (wall-clock/rng/env/hash-iter
+   sources, blocking operations, jit-impurity findings), the
+   ``with self.<lock>`` frames with the calls made inside them, and
+   the class table (methods, bases, attribute types).
+
+2. ``Program(fragments)`` — links descriptors into concrete edges
+   against the global definition table. Resolution order:
+
+   - ``dotted``  — ``celestia_app_tpu.da.eds.extend_shares`` maps the
+     longest module prefix to a file (``da/eds.py``) and the remainder
+     to a qualname (classes resolve to ``__init__``);
+   - ``local``   — a module-level function or class in the same file;
+   - ``self``    — the enclosing class's method table, then package
+     base classes (linear MRO walk);
+   - ``selfattr``— ``self.codec.open_sample()`` through the attribute
+     type recorded from ``self.codec = SomeCodec(...)``;
+   - ``attr``    — **conservative dynamic-dispatch fallback**: an
+     unresolvable receiver links the call to *every* package method of
+     that name (this is what keeps the codec registry's
+     ``_encode_impl`` hooks and duck-typed stores in the graph).
+     Names that collide with builtin container/str/file methods are
+     skipped — linking every ``.get()`` to every ``get`` method would
+     drown the graph in noise, and those calls never reach package
+     code through a builtin receiver anyway;
+   - ``closure`` / bare references — defining a nested function or
+     passing ``self._loop`` as a callback edges to it (how the warmer
+     threads and seed listeners stay reachable).
+
+Sound where it can be, conservative where it cannot: missing dynamic
+edges are the only false-negative channel, and the fallback policy
+above is the documented trade (DESIGN, "The analysis plane").
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from celestia_app_tpu.tools.analyze.engine import FileContext
+from celestia_app_tpu.tools.analyze.rules_determinism import (
+    _RNG_EXACT,
+    _RNG_PREFIXES,
+    _WALLCLOCK,
+    _dict_iter_call,
+    _HASH_FUNCS,
+)
+
+FRAGMENT_VERSION = 3
+
+PACKAGE = "celestia_app_tpu"
+
+# attr-fallback names skipped because they collide with builtin
+# container/str/bytes/file/threading methods — a call through one of
+# these on an unknown receiver is overwhelmingly a builtin, not package
+# dispatch. Package hook names (``_encode_impl``, ``open_sample``,
+# ``repair`` ...) are distinctive and stay linked.
+FALLBACK_SKIP = frozenset({
+    "get", "items", "keys", "values", "update", "append", "extend",
+    "add", "pop", "popitem", "clear", "copy", "remove", "discard",
+    "sort", "reverse", "insert", "count", "index", "join", "split",
+    "rsplit", "splitlines", "strip", "lstrip", "rstrip", "encode",
+    "decode", "format", "replace", "startswith", "endswith", "lower",
+    "upper", "title", "zfill", "read", "write", "readline",
+    "readlines", "close", "flush", "seek", "tell", "fileno",
+    "hexdigest", "digest", "hex", "to_bytes", "from_bytes",
+    "bit_length", "acquire", "release", "wait", "notify", "notify_all",
+    "set", "is_set", "put", "get_nowait", "put_nowait", "start",
+    "cancel", "done", "result", "submit", "shutdown", "group",
+    "groups", "match", "search", "findall", "finditer", "sub",
+    "item", "tolist", "tobytes", "astype", "reshape", "flatten",
+    "transpose", "setdefault", "union", "intersection", "difference",
+    "issubset", "mkdir", "exists", "send", "recv", "connect", "bind",
+    "name", "next", "degree", "request", "getresponse", "sync",
+})
+
+# see Program._fallback: these layers call the library, never the
+# reverse, so name-match fallback must not land in them
+_FALLBACK_TARGET_EXCLUDE = ("tools/", "cli.py", "testing/", "client/",
+                            "service/")
+
+# blocking-operation classification (the ``blocking-under-lock`` sinks)
+_BLOCK_SLEEP = {"time.sleep"}
+_BLOCK_FSYNC = {"os.fsync", "os.fdatasync"}
+_BLOCK_NET_EXACT = {"socket.socket", "socket.create_connection"}
+_BLOCK_NET_PREFIX = ("urllib.request.", "http.client.", "subprocess.")
+# THE definition of "wraps a function for device tracing" — shared by
+# the per-file jit-purity rule (rules_effects imports it), the
+# transitive pass, and the blocking-under-lock jit-compile sink, so
+# the passes can never disagree on which functions are jitted
+JIT_WRAPPERS = {"jax.jit", "jit", "pl.pallas_call", "jax.pmap"}
+_BLOCK_JIT = JIT_WRAPPERS
+
+# jit-impurity body findings (shared with rules_effects via
+# ``impure_findings`` below)
+_JIT_HOST_CALLS = {"numpy.asarray", "numpy.array", "numpy.frombuffer",
+                   "jax.device_get"}
+_JIT_HOST_ATTRS = {"block_until_ready", "item"}
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception"}
+_TELEMETRY_METHODS = {"incr", "observe", "measure_since", "gauge",
+                      "counter"}
+
+
+def _is_logging_call(node: ast.Call, ctx: FileContext) -> bool:
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    attr = node.func.attr
+    base = ctx.resolve(node.func.value) or ""
+    base_tail = base.rsplit(".", 1)[-1].lower()
+    if attr in _LOG_METHODS and ("log" in base_tail or base_tail in
+                                 ("lg", "obs")):
+        return True
+    return attr in _TELEMETRY_METHODS
+
+
+def impure_findings(fn: ast.AST, ctx: FileContext,
+                    label: str) -> list[list]:
+    """The jit-purity body checks for ONE function body, shared by the
+    per-file rule (rules_effects) and the transitive program pass:
+    ``[line, col, message]`` rows, message framed with `label`."""
+    out: list[list] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            out.append([node.lineno, node.col_offset,
+                        f"global mutation inside jitted {label} "
+                        "(runs once at trace time, then never again)"])
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        name = ctx.resolve(node.func)
+        attr = (node.func.attr
+                if isinstance(node.func, ast.Attribute) else None)
+        if name == "print":
+            out.append([node.lineno, node.col_offset,
+                        f"print inside jitted {label} fires at trace "
+                        "time only (use jax.debug.print)"])
+        elif _is_logging_call(node, ctx):
+            out.append([node.lineno, node.col_offset,
+                        f"logging/telemetry inside jitted {label} "
+                        "fires at trace time only (hoist to the "
+                        "caller)"])
+        elif name in _JIT_HOST_CALLS:
+            out.append([node.lineno, node.col_offset,
+                        f"{name}() inside jitted {label} forces a "
+                        "host round-trip per call"])
+        elif attr in _JIT_HOST_ATTRS:
+            out.append([node.lineno, node.col_offset,
+                        f".{attr}() inside jitted {label} forces a "
+                        "host sync"])
+        elif name == "float" and node.args:
+            out.append([node.lineno, node.col_offset,
+                        f"float() cast inside jitted {label} "
+                        "concretizes a tracer (host round-trip)"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-file fragment
+# ---------------------------------------------------------------------------
+
+
+def _classify_source(name: str | None) -> tuple[str, str] | None:
+    """(kind, what) when a resolved call name is a determinism taint
+    source: wall-clock, ambient rng, or an environment read."""
+    if name is None:
+        return None
+    if name in _WALLCLOCK:
+        return ("wallclock", name)
+    if name in _RNG_EXACT or any(
+            name.startswith(p) or name == p.rstrip(".")
+            for p in _RNG_PREFIXES):
+        return ("rng", name)
+    if name == "os.getenv" or name.startswith("os.environ"):
+        return ("env", name)
+    return None
+
+
+def _classify_blocking(name: str | None, attr: str | None,
+                       ) -> tuple[str, str] | None:
+    if name is not None:
+        if name in _BLOCK_SLEEP:
+            return ("sleep", name)
+        if name in _BLOCK_FSYNC:
+            return ("fsync", name)
+        if (name in _BLOCK_NET_EXACT or name == "urlopen"
+                or name.endswith(".urlopen")
+                or name.startswith(_BLOCK_NET_PREFIX)):
+            return ("net", name)
+        if name in _BLOCK_JIT:
+            return ("jit-compile", name)
+        tail = name.rsplit(".", 1)[-1]
+        if tail.startswith("jitted_"):
+            # the repo's jitted-factory naming convention: calling a
+            # factory can pay an XLA compile on a cold cache
+            return ("jit-compile", name)
+    if attr == "block_until_ready":
+        return ("jit-compile", f".{attr}")
+    return None
+
+
+def _qualify(ctx: FileContext) -> dict[ast.AST, str]:
+    """def/class node -> dotted qualname (``Class.method.inner``)."""
+    quals: dict[ast.AST, str] = {}
+
+    def visit(node: ast.AST, qual: list[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                q = qual + [child.name]
+                quals[child] = ".".join(q)
+                visit(child, q)
+            else:
+                visit(child, qual)
+
+    visit(ctx.tree, [])
+    return quals
+
+
+def _enclosing_function(node: ast.AST, ctx: FileContext,
+                        quals: dict) -> ast.AST | None:
+    for p in ctx.parents(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return p
+    return None
+
+
+def _descriptor(func_expr: ast.AST, ctx: FileContext,
+                cls_name: str | None) -> list | None:
+    """Call-target descriptor for a call's func expression, or None
+    when nothing package-resolvable can come of it."""
+    if isinstance(func_expr, ast.Attribute):
+        recv = func_expr.value
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            return ["self", func_expr.attr]
+        if (isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self"):
+            return ["selfattr", recv.attr, func_expr.attr]
+        name = ctx.resolve(func_expr)
+        if name is not None and name.startswith(PACKAGE + "."):
+            return ["dotted", name]
+        if name is not None:
+            # a resolvable Name-rooted chain: if the root is an import
+            # alias this is an external-module call (numpy.*, jax.*) —
+            # falling back by attr name would invent edges into
+            # unrelated package methods
+            root = func_expr
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in ctx.aliases:
+                return None
+        return ["attr", func_expr.attr]
+    if isinstance(func_expr, ast.Name):
+        name = ctx.resolve(func_expr)
+        if name is None:
+            return None
+        if name.startswith(PACKAGE + "."):
+            return ["dotted", name]
+        if "." not in name:
+            return ["local", name]
+    return None
+
+
+def jitted_fn_nodes(ctx: FileContext) -> set[ast.AST]:
+    """Functions traced by jax: @jax.jit/@partial(jax.jit)/@jax.pmap
+    decoration, or wrapped by a ``jax.jit(name)``-style call in the
+    same file. The ONE detector both jit-purity passes use."""
+    wrapped: set[str] = set()
+    jitted: set[ast.AST] = set()
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call)
+                and ctx.resolve(node.func) in JIT_WRAPPERS):
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name):
+                    wrapped.add(arg.id)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        decs = node.decorator_list
+        hit = False
+        for d in decs:
+            name = ctx.resolve(d)
+            if name in JIT_WRAPPERS:
+                hit = True
+            elif isinstance(d, ast.Call):
+                fname = ctx.resolve(d.func)
+                if fname in JIT_WRAPPERS:
+                    hit = True
+                elif fname in ("functools.partial", "partial") and d.args \
+                        and ctx.resolve(d.args[0]) in JIT_WRAPPERS:
+                    hit = True
+        if hit or node.name in wrapped:
+            jitted.add(node)
+    return jitted
+
+
+def _lock_frame(node: ast.AST, ctx: FileContext) -> tuple | None:
+    """(lockname, with_line) when `node` sits inside a ``with
+    self.<lock>``/``with <lock>`` frame whose context name looks like a
+    lock (contains 'lock'); innermost wins."""
+    for p in ctx.parents(node):
+        if not isinstance(p, (ast.With, ast.AsyncWith)):
+            continue
+        for item in p.items:
+            e = item.context_expr
+            lockname = None
+            if (isinstance(e, ast.Attribute)
+                    and isinstance(e.value, ast.Name)
+                    and e.value.id == "self"):
+                lockname = e.attr
+            elif isinstance(e, ast.Name):
+                lockname = e.id
+            if lockname is not None and "lock" in lockname.lower():
+                return (lockname, p.lineno)
+    return None
+
+
+def build_fragment(ctx: FileContext) -> dict:
+    """The per-file, cacheable half of the call graph (module
+    docstring, stage 1)."""
+    quals = _qualify(ctx)
+    functions: dict[str, dict] = {}
+    classes: dict[str, dict] = {}
+
+    # class table: methods, bases, self-attr types from __init__
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        qual = quals[node]
+        if "." in qual:
+            continue  # nested classes: out of scope
+        bases = []
+        for b in node.bases:
+            name = ctx.resolve(b)
+            if name:
+                bases.append(name)
+        attr_types: dict[str, str] = {}
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            if not (isinstance(sub.value, ast.Call)):
+                continue
+            vname = ctx.resolve(sub.value.func)
+            if not vname:
+                continue
+            for t in sub.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    attr_types[t.attr] = vname
+        classes[node.name] = {"bases": bases, "attr_types": attr_types}
+
+    jitted_nodes = jitted_fn_nodes(ctx)
+
+    fn_nodes = [n for n in quals
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    node_by_qual = {quals[n]: n for n in fn_nodes}
+
+    for fn in fn_nodes:
+        qual = quals[fn]
+        # enclosing class (for self-resolution): the nearest ClassDef
+        # ancestor whose qual is a prefix
+        cls_name = None
+        for p in ctx.parents(fn):
+            if isinstance(p, ast.ClassDef) and p in quals:
+                cls_name = quals[p].split(".")[0]
+                break
+        info = {
+            "line": fn.lineno,
+            "end": fn.end_lineno or fn.lineno,
+            "class": cls_name,
+            "calls": [],
+            "refs": [],
+            "sources": [],
+            "blocking": [],
+            "impure": impure_findings(fn, ctx, f"{qual}()"),
+            "jitted": fn in jitted_nodes,
+            "locks": [],
+        }
+        lock_blocks: dict[tuple, dict] = {}
+
+        def _lock_entry(frame):
+            if frame not in lock_blocks:
+                lock_blocks[frame] = {"lock": frame[0], "line": frame[1],
+                                      "calls": [], "blocking": []}
+            return lock_blocks[frame]
+
+        for node in ast.walk(fn):
+            # nested defs belong to the nested function, not this one —
+            # but defining them is a conservative closure edge
+            if node is not fn and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if _enclosing_function(node, ctx, quals) is fn:
+                    info["calls"].append(
+                        ["closure", quals[node], node.lineno])
+                continue
+            if _enclosing_function(node, ctx, quals) is not fn:
+                continue
+            if isinstance(node, ast.Call):
+                name = ctx.resolve(node.func)
+                attr = (node.func.attr
+                        if isinstance(node.func, ast.Attribute) else None)
+                src = _classify_source(name)
+                if src is not None:
+                    info["sources"].append([src[0], node.lineno, src[1]])
+                blk = _classify_blocking(name, attr)
+                frame = _lock_frame(node, ctx)
+                if blk is not None:
+                    info["blocking"].append([blk[0], node.lineno, blk[1]])
+                    if frame is not None:
+                        _lock_entry(frame)["blocking"].append(
+                            [blk[0], node.lineno, blk[1]])
+                desc = _descriptor(node.func, ctx, cls_name)
+                if desc is not None:
+                    info["calls"].append(desc + [node.lineno])
+                    if frame is not None:
+                        _lock_entry(frame)["calls"].append(
+                            desc + [node.lineno])
+                # hash-iteration source: dict/set iteration feeding a
+                # hash/serialization sink (the det-dict-hash detector)
+                if (name in _HASH_FUNCS
+                        or (name or "").startswith("hashlib.")):
+                    for arg in node.args:
+                        hit = _dict_iter_call(arg, ctx)
+                        if hit is not None:
+                            info["sources"].append(
+                                ["hash-iter", hit.lineno,
+                                 f"dict/set iteration into {name}"])
+                            break
+            elif isinstance(node, (ast.Attribute, ast.Name)):
+                # bare references to package functions (callbacks,
+                # Thread targets, listener registration)
+                if isinstance(node, ast.Attribute):
+                    par = node._lint_parent  # type: ignore[attr-defined]
+                    if (isinstance(par, ast.Call)
+                            and par.func is node):
+                        continue  # already handled as a call
+                    if (isinstance(node.value, ast.Name)
+                            and node.value.id == "self"):
+                        info["refs"].append(["self", node.attr,
+                                             node.lineno])
+                        continue
+                    name = ctx.resolve(node)
+                    if name and name.startswith(PACKAGE + "."):
+                        info["refs"].append(["dotted", name, node.lineno])
+                elif isinstance(node, ast.Name):
+                    par = node._lint_parent  # type: ignore[attr-defined]
+                    if (isinstance(par, ast.Call) and par.func is node):
+                        continue
+                    if isinstance(par, (ast.Attribute,)):
+                        continue
+                    if node.id in node_by_qual and isinstance(
+                            par, (ast.keyword, ast.Call, ast.Tuple,
+                                  ast.List, ast.Dict, ast.Return,
+                                  ast.Assign)):
+                        info["refs"].append(["local", node.id,
+                                             node.lineno])
+            # os.environ read outside a call (subscript / in-test)
+            if (isinstance(node, ast.Attribute)
+                    and ctx.resolve(node) == "os.environ"):
+                info["sources"].append(["env", node.lineno, "os.environ"])
+        info["locks"] = list(lock_blocks.values())
+        # one source row per (kind, line): `os.environ.get(...)` is one
+        # env read, not a call hit plus an attribute hit
+        dedup: dict[tuple, list] = {}
+        for row in info["sources"]:
+            dedup.setdefault((row[0], row[1]), row)
+        info["sources"] = sorted(dedup.values(),
+                                 key=lambda r: (r[1], r[0]))
+        functions[qual] = info
+
+    return {
+        "version": FRAGMENT_VERSION,
+        "path": ctx.path,
+        "functions": functions,
+        "classes": classes,
+        "pragmas": {str(k): sorted(v) for k, v in ctx.pragmas.items()},
+    }
+
+
+# ---------------------------------------------------------------------------
+# linking
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Node:
+    id: str                 # "chain/app.py::App.prepare_proposal"
+    path: str
+    qual: str
+    line: int
+    end: int
+    jitted: bool
+    sources: list           # [kind, line, what]
+    blocking: list          # [kind, line, what]
+    impure: list            # [line, col, msg]
+    locks: list             # resolved at link time
+
+
+class Program:
+    """The linked whole-package call graph (module docstring, stage 2)."""
+
+    def __init__(self, fragments: dict[str, dict]):
+        self.fragments = fragments
+        self.nodes: dict[str, Node] = {}
+        self.edges: dict[str, list[tuple[str, int]]] = {}
+        # module dotted path -> file path
+        self._mods: dict[str, str] = {}
+        # (path, class) tables
+        self._classes: dict[tuple[str, str], dict] = {}
+        # method name -> [node ids] (the attr-fallback index)
+        self._by_method: dict[str, list[str]] = {}
+        self._link()
+
+    # -- def tables ------------------------------------------------------
+
+    def _module_of(self, path: str) -> str:
+        mod = path[:-3] if path.endswith(".py") else path
+        if mod.endswith("/__init__"):
+            mod = mod[: -len("/__init__")]
+        dotted = mod.replace("/", ".")
+        return f"{PACKAGE}.{dotted}" if dotted else PACKAGE
+
+    def _link(self) -> None:
+        for path, frag in self.fragments.items():
+            self._mods[self._module_of(path)] = path
+            for cname, cinfo in frag.get("classes", {}).items():
+                self._classes[(path, cname)] = cinfo
+            for qual, info in frag.get("functions", {}).items():
+                nid = f"{path}::{qual}"
+                self.nodes[nid] = Node(
+                    id=nid, path=path, qual=qual,
+                    line=info["line"], end=info["end"],
+                    jitted=bool(info.get("jitted")),
+                    sources=info.get("sources", []),
+                    blocking=info.get("blocking", []),
+                    impure=info.get("impure", []),
+                    locks=[],
+                )
+                parts = qual.split(".")
+                if len(parts) == 2:  # Class.method — the only shape
+                    # reachable through attribute dispatch; indexing
+                    # module-level functions here would let every
+                    # ``lax.scan``-style external call alias a package
+                    # function of the same name
+                    self._by_method.setdefault(parts[1], []).append(nid)
+        for path, frag in self.fragments.items():
+            for qual, info in frag.get("functions", {}).items():
+                nid = f"{path}::{qual}"
+                out: list[tuple[str, int]] = []
+                for desc in info.get("calls", []):
+                    out.extend(self._resolve(path, qual, info, desc))
+                for desc in info.get("refs", []):
+                    kind, name, line = desc
+                    out.extend(self._resolve(
+                        path, qual, info, [kind, name, line],
+                        ref=True))
+                seen = set()
+                uniq = []
+                for tgt, line in out:
+                    if tgt not in seen:
+                        seen.add(tgt)
+                        uniq.append((tgt, line))
+                self.edges[nid] = uniq
+                node = self.nodes[nid]
+                for lk in info.get("locks", []):
+                    callees: list[tuple[str, int]] = []
+                    for desc in lk.get("calls", []):
+                        callees.extend(
+                            self._resolve(path, qual, info, desc))
+                    node.locks.append({
+                        "lock": lk["lock"], "line": lk["line"],
+                        "callees": callees,
+                        "blocking": lk.get("blocking", []),
+                    })
+
+    # -- resolution ------------------------------------------------------
+
+    def _resolve_dotted(self, name: str, line: int) -> list:
+        """celestia_app_tpu.<...>.<sym>[.<sym2>] -> node ids."""
+        parts = name.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:cut])
+            path = self._mods.get(mod)
+            if path is None:
+                continue
+            rest = parts[cut:]
+            if not rest:
+                return []
+            qual = ".".join(rest)
+            nid = f"{path}::{qual}"
+            if nid in self.nodes:
+                return [(nid, line)]
+            if (path, rest[0]) in self._classes:
+                if len(rest) >= 2:
+                    # ClassRef.method through an import of the class
+                    return self._method(path, rest[0], rest[1], line)
+                # a bare class: edge its __init__ (construction runs it)
+                return self._class_init(path, rest[0], line)
+            return []
+        return []
+
+    def _class_init(self, path: str, cname: str, line: int) -> list:
+        hit = self._method(path, cname, "__init__", line)
+        return hit
+
+    def _method(self, path: str, cname: str, mname: str,
+                line: int) -> list:
+        """Method lookup with a linear package-bases walk."""
+        seen: set[tuple[str, str]] = set()
+        stack = [(path, cname)]
+        while stack:
+            p, c = stack.pop()
+            if (p, c) in seen:
+                continue
+            seen.add((p, c))
+            nid = f"{p}::{c}.{mname}"
+            if nid in self.nodes:
+                return [(nid, line)]
+            cinfo = self._classes.get((p, c))
+            if cinfo is None:
+                continue
+            for base in cinfo.get("bases", []):
+                if base.startswith(PACKAGE + "."):
+                    bp = base.split(".")
+                    for cut in range(len(bp) - 1, 0, -1):
+                        bpath = self._mods.get(".".join(bp[:cut]))
+                        if bpath is not None and cut == len(bp) - 1:
+                            stack.append((bpath, bp[-1]))
+                            break
+                elif "." not in base and (p, base) in self._classes:
+                    stack.append((p, base))
+        return []
+
+    def _fallback(self, mname: str, line: int) -> list:
+        if mname in FALLBACK_SKIP or mname.startswith("__"):
+            return []
+        # layers that sit ABOVE the library (operator tooling, the
+        # client SDK, the HTTP/gRPC adapters, test harness twins) are
+        # never dynamic-dispatch TARGETS of library code — they call
+        # down, not vice versa; linking into them invents
+        # relayer/load-harness/duck-type-twin paths
+        return [(nid, line) for nid in self._by_method.get(mname, [])
+                if not nid.startswith(_FALLBACK_TARGET_EXCLUDE)]
+
+    def _resolve(self, path: str, qual: str, info: dict,
+                 desc: list, ref: bool = False) -> list:
+        kind = desc[0]
+        line = desc[-1]
+        if kind == "dotted":
+            return self._resolve_dotted(desc[1], line)
+        if kind == "local":
+            name = desc[1]
+            nid = f"{path}::{name}"
+            if nid in self.nodes:
+                return [(nid, line)]
+            if (path, name) in self._classes:
+                return self._class_init(path, name, line)
+            return []
+        if kind == "closure":
+            nid = f"{path}::{desc[1]}"
+            return [(nid, line)] if nid in self.nodes else []
+        if kind == "self":
+            cls = info.get("class")
+            if cls is not None:
+                hit = self._method(path, cls, desc[1], line)
+                if hit:
+                    return hit
+            if ref:
+                # a bare ``self.x`` reference is almost always a data
+                # attribute read — name-fallback here would link every
+                # ``self.height`` to every ``height()`` in the package
+                return []
+            return self._fallback(desc[1], line)
+        if kind == "selfattr":
+            cls = info.get("class")
+            attrname, mname = desc[1], desc[2]
+            if cls is not None:
+                cinfo = self._classes.get((path, cls), {})
+                tname = cinfo.get("attr_types", {}).get(attrname)
+                if tname:
+                    if tname.startswith(PACKAGE + "."):
+                        hit = self._resolve_dotted(
+                            f"{tname}.{mname}", line)
+                        if hit:
+                            return hit
+                    elif (path, tname) in self._classes:
+                        hit = self._method(path, tname, mname, line)
+                        if hit:
+                            return hit
+            return self._fallback(mname, line)
+        if kind == "attr":
+            return self._fallback(desc[1], line)
+        return []
+
+    # -- traversal -------------------------------------------------------
+
+    def resolve_entry(self, entry: str) -> str | None:
+        """``path::symbol`` config entry -> node id (exact qualname, or
+        unique suffix match so ``App.commit`` finds
+        ``chain/app.py::App.commit``)."""
+        if entry in self.nodes:
+            return entry
+        path, _, sym = entry.partition("::")
+        if not sym:
+            return None
+        cands = [nid for nid in self.nodes
+                 if self.nodes[nid].path == path
+                 and (self.nodes[nid].qual == sym
+                      or self.nodes[nid].qual.endswith("." + sym))]
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def reachable(self, roots: list[str],
+                  stop) -> tuple[set[str], dict[str, tuple[str, str]]]:
+        """BFS over edges from `roots` (node ids). `stop(node)` True
+        halts traversal INTO that node (it is neither visited nor
+        expanded). Returns (visited, parents) where parents maps node ->
+        (parent node, root) for shortest-path reconstruction."""
+        visited: set[str] = set()
+        parents: dict[str, tuple[str, str]] = {}
+        queue: list[tuple[str, str]] = []
+        for r in roots:
+            if r in self.nodes and not stop(self.nodes[r]):
+                if r not in visited:
+                    visited.add(r)
+                    parents[r] = (None, r)  # type: ignore[arg-type]
+                    queue.append((r, r))
+        i = 0
+        while i < len(queue):
+            nid, root = queue[i]
+            i += 1
+            for tgt, _line in self.edges.get(nid, []):
+                if tgt in visited:
+                    continue
+                node = self.nodes.get(tgt)
+                if node is None or stop(node):
+                    continue
+                visited.add(tgt)
+                parents[tgt] = (nid, root)
+                queue.append((tgt, root))
+        return visited, parents
+
+    def call_path(self, parents: dict, nid: str) -> list[str]:
+        """Root-first chain of node ids ending at `nid`."""
+        chain = [nid]
+        cur = nid
+        while True:
+            ent = parents.get(cur)
+            if ent is None or ent[0] is None:
+                break
+            cur = ent[0]
+            chain.append(cur)
+        chain.reverse()
+        return chain

@@ -36,11 +36,18 @@ class Violation:
     message: str
     waived: bool = False
     waiver_reason: str | None = None
+    # interprocedural rules (det-reach, scope-drift, blocking-under-
+    # lock, transitive jit-purity) attach the root→sink chain of
+    # "path::qualname" node ids; per-file rules leave it None
+    call_path: list[str] | None = None
 
     def __str__(self) -> str:
         tag = "waived" if self.waived else self.severity
-        return (f"{self.path}:{self.line}:{self.col}: "
+        base = (f"{self.path}:{self.line}:{self.col}: "
                 f"{tag}[{self.rule}] {self.message}")
+        if self.call_path:
+            base += " | call path: " + " -> ".join(self.call_path)
+        return base
 
 
 class FileContext:
@@ -148,6 +155,26 @@ class Rule:
         yield  # pragma: no cover
 
 
+class ProgramRule(Rule):
+    """A whole-program rule: runs ONCE over the linked call graph
+    (``callgraph.Program``) after the per-file pass, yielding complete
+    ``Violation`` objects (severity is overwritten from config, pragma
+    and waiver precedence apply as usual). Program rules self-scope —
+    the engine's per-file include filtering does not apply, because a
+    violation's path (the sink) and its scope anchor (the root holding
+    the lock / the consensus root) are different files."""
+
+    whole_program = True
+
+    def check(self, ctx: FileContext, cfg: RuleConfig):
+        return iter(())
+
+    def check_program(self, program, config: AnalyzeConfig,
+                      cfg: RuleConfig):
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+
 REGISTRY: dict[str, Rule] = {}
 
 
@@ -162,7 +189,14 @@ def _load_rules() -> None:
         rules_determinism,
         rules_effects,
         rules_locks,
+        taint,
     )
+
+
+def registered_rule_ids() -> set[str]:
+    """All registered rule ids (loads the rule modules)."""
+    _load_rules()
+    return set(REGISTRY)
 
 
 # ---------------------------------------------------------------------------
@@ -216,6 +250,11 @@ class Report:
     rules_run: list[str]
     config_path: str | None
     wall_s: float = 0.0
+    cache_hits: int = 0
+    cache_misses: int = 0
+    # the linked callgraph.Program when any program rule ran (the
+    # --scopes audit reads it); never serialized
+    program: object = None
 
     @property
     def errors(self) -> list[Violation]:
@@ -253,11 +292,19 @@ def iter_python_files(root: str, exclude: list[str]):
 
 def run_analysis(root: str | None = None,
                  config: AnalyzeConfig | None = None,
-                 only_rules: set[str] | None = None) -> Report:
+                 only_rules: set[str] | None = None,
+                 cache: bool | str | None = None) -> Report:
     """Analyze every ``.py`` under `root` (default: the installed
     ``celestia_app_tpu`` package) against `config` (default: the
     committed ``analyze.toml``). Stale waivers surface as synthetic
-    ``stale-waiver`` errors so the ledger cannot rot."""
+    ``stale-waiver`` errors so the ledger cannot rot.
+
+    `cache` enables the per-file incremental result cache (True for
+    the default location next to `root`, or an explicit path): files
+    whose sha256 is unchanged reuse their per-file violations and
+    call-graph fragment; the interprocedural rules re-link and re-run
+    from fragments every time (FORMATS §11.4)."""
+    import hashlib
     import time
 
     t0 = time.perf_counter()
@@ -285,18 +332,68 @@ def run_analysis(root: str | None = None,
         if config.rule(rid).severity != "off"
         and (only_rules is None or rid in only_rules)
     )
+    program_rules = [rid for rid in rules_run
+                     if hasattr(REGISTRY[rid], "check_program")]
+    need_program = bool(program_rules)
+    result_cache = None
+    if cache:
+        from celestia_app_tpu.tools.analyze import cache as cache_mod
+
+        result_cache = cache_mod.ResultCache.open(
+            cache if isinstance(cache, str)
+            else cache_mod.default_cache_path(root),
+            cache_mod.cache_key(config, rules_run, root),
+        )
+    cache_hits = cache_misses = 0
+    fragments: dict[str, dict] = {}
+    pragmas_by_file: dict[str, dict[int, set[str]]] = {}
+
+    from celestia_app_tpu.tools.analyze import callgraph
+
     for abspath, rel in iter_python_files(root, config.exclude):
         files += 1
-        with open(abspath, encoding="utf-8") as f:
-            source = f.read()
+        with open(abspath, "rb") as f:
+            data = f.read()
+        sha = hashlib.sha256(data).hexdigest()
+        entry = result_cache.lookup(rel, sha) if result_cache else None
+        if entry is not None:
+            cache_hits += 1
+            for rid, rows in entry["violations"].items():
+                # "parse-error" is synthetic (not a registered rule):
+                # it must survive warm runs like any other result
+                if rid != "parse-error" and rid not in rules_run:
+                    continue
+                sev = ("error" if rid == "parse-error"
+                       else config.rule(rid).severity)
+                for line, col, msg in rows:
+                    violations.append(Violation(
+                        rule=rid, severity=sev,
+                        path=rel, line=line, col=col, message=msg,
+                    ))
+            frag = entry.get("fragment")
+            if frag is not None:
+                fragments[rel] = frag
+                pragmas_by_file[rel] = {
+                    int(k): set(v)
+                    for k, v in frag.get("pragmas", {}).items()
+                }
+            continue
+        cache_misses += 1
+        source = data.decode("utf-8")
+        rows_by_rule: dict[str, list] = {}
         try:
             ctx = FileContext(rel, source)
         except SyntaxError as e:
+            rows_by_rule["parse-error"] = [
+                [e.lineno or 0, e.offset or 0,
+                 f"cannot parse: {e.msg}"]]
             violations.append(Violation(
                 rule="parse-error", severity="error", path=rel,
                 line=e.lineno or 0, col=e.offset or 0,
                 message=f"cannot parse: {e.msg}",
             ))
+            if result_cache:
+                result_cache.put(rel, sha, rows_by_rule, None)
             continue
         for rid in rules_run:
             rcfg = config.rule(rid)
@@ -311,10 +408,32 @@ def run_analysis(root: str | None = None,
                     parts = qual.split(".")
                     if not any(sym in parts for sym in symbols):
                         continue
+                rows_by_rule.setdefault(rid, []).append(
+                    [line, col, msg])
                 violations.append(Violation(
                     rule=rid, severity=rcfg.severity, path=rel,
                     line=line, col=col, message=msg,
                 ))
+        frag = None
+        if need_program or result_cache:
+            frag = callgraph.build_fragment(ctx)
+            fragments[rel] = frag
+            pragmas_by_file[rel] = ctx.pragmas
+        if result_cache:
+            result_cache.put(rel, sha, rows_by_rule, frag)
+    program = None
+    if need_program:
+        program = callgraph.Program(fragments)
+        for rid in program_rules:
+            rcfg = config.rule(rid)
+            for v in REGISTRY[rid].check_program(program, config, rcfg):
+                if v.rule in pragmas_by_file.get(v.path, {}).get(
+                        v.line, set()):
+                    continue  # pragma wins over everything
+                v.severity = rcfg.severity
+                violations.append(v)
+    if result_cache:
+        result_cache.save()
     # waivers: first match wins, counted for staleness
     for v in violations:
         for w in config.waivers:
@@ -332,9 +451,12 @@ def run_analysis(root: str | None = None,
                 message=(f"waiver for rule {w.rule!r} matched nothing — "
                          "remove it (or it is masking a typo)"),
             ))
-    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule,
+                                   v.message))
     return Report(
         root=root, violations=violations, files_scanned=files,
         rules_run=rules_run, config_path=config.source_path,
         wall_s=time.perf_counter() - t0,
+        cache_hits=cache_hits, cache_misses=cache_misses,
+        program=program,
     )

@@ -67,20 +67,31 @@ def _note_acquire(lock: "_TrackedLock") -> None:
     # _DummyThread during thread bootstrap whose Event goes through the
     # patched locks and recurses straight back here
     me = f"tid={threading.get_ident()}"
+    # where THIS acquire happened (not where the lock was created) —
+    # the triage aid ISSUE 12 asks for: the report carries both the
+    # creation sites and each thread's acquisition stack
+    acq_site = _site(1)
+    my_stack = [f"{h._race_site}@{s}" for h, s in stack]
+    my_stack.append(f"{lock._race_site}@{acq_site}")
     with _state_lock:
-        for held in stack:
+        for held, _held_at in stack:
             a, b = held._race_site, lock._race_site
             if a == b:
                 continue  # same creation site: one lock class, no order
             if (a, b) not in _edges:
-                _edges[(a, b)] = {"thread": me}
+                _edges[(a, b)] = {"thread": me, "stack": my_stack}
             rev = _edges.get((b, a))
             if rev is not None and not _already_reported(a, b):
                 import sys
 
+                fwd_stack = " < ".join(rev.get("stack", []))
+                rev_stack = " < ".join(my_stack)
                 msg = (
                     f"RACECHECK: lock-order inversion: {b} -> {a} "
-                    f"(thread {rev['thread']}) vs {a} -> {b} (thread {me})"
+                    f"(thread {rev['thread']}; acquired {fwd_stack}) vs "
+                    f"{a} -> {b} (thread {me}; acquired {rev_stack}) | "
+                    f"locks created at A={a}, B={b} "
+                    "(site@site = lock-creation@acquisition)"
                 )
                 try:
                     # one greppable stderr line — chaos subprocess logs
@@ -91,15 +102,18 @@ def _note_acquire(lock: "_TrackedLock") -> None:
                 _violations.append({
                     "first": b, "then": a,
                     "thread_forward": rev["thread"],
+                    "stack_forward": list(rev.get("stack", [])),
                     "first_rev": a, "then_rev": b,
                     "thread_reverse": me,
+                    "stack_reverse": list(my_stack),
                     "message": (
                         f"lock-order inversion: {b} -> {a} "
-                        f"(thread {rev['thread']}) vs {a} -> {b} "
-                        f"(thread {me})"
+                        f"(thread {rev['thread']}; acquired {fwd_stack})"
+                        f" vs {a} -> {b} (thread {me}; acquired "
+                        f"{rev_stack})"
                     ),
                 })
-    stack.append(lock)
+    stack.append((lock, acq_site))
 
 
 def _already_reported(a: str, b: str) -> bool:
@@ -111,7 +125,7 @@ def _already_reported(a: str, b: str) -> bool:
 def _note_release(lock: "_TrackedLock") -> None:
     stack = _held()
     for i in range(len(stack) - 1, -1, -1):
-        if stack[i] is lock:
+        if stack[i][0] is lock:
             del stack[i]
             break
 

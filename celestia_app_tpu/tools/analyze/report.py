@@ -3,19 +3,25 @@
 The JSON schema (normative — docs/FORMATS.md §11):
 
     {
-      "version": 1,
+      "version": 2,
       "root": "<analyzed directory>",
       "config": "<analyze.toml path or null>",
       "summary": {
         "files_scanned": N, "rules_run": [...],
-        "errors": N, "warnings": N, "waived": N, "wall_s": F
+        "errors": N, "warnings": N, "waived": N, "wall_s": F,
+        "cache_hits": N, "cache_misses": N
       },
       "violations": [
         {"rule": str, "severity": "error"|"warning", "path": str,
          "line": int, "col": int, "message": str,
-         "waived": bool, "waiver_reason": str|null}, ...
+         "waived": bool, "waiver_reason": str|null,
+         "call_path": ["path::qualname", ...]}, ...
       ]
     }
+
+``call_path`` is the root→sink chain of call-graph node ids for the
+interprocedural rules (det-reach, scope-drift, blocking-under-lock,
+transitive jit-purity) and ``[]`` for per-file rules.
 """
 
 from __future__ import annotations
@@ -24,7 +30,7 @@ import json
 
 from celestia_app_tpu.tools.analyze.engine import Report
 
-JSON_VERSION = 1
+JSON_VERSION = 2
 
 
 def to_json(report: Report) -> dict:
@@ -39,12 +45,15 @@ def to_json(report: Report) -> dict:
             "warnings": len(report.warnings),
             "waived": len(report.waived),
             "wall_s": round(report.wall_s, 4),
+            "cache_hits": report.cache_hits,
+            "cache_misses": report.cache_misses,
         },
         "violations": [
             {
                 "rule": v.rule, "severity": v.severity, "path": v.path,
                 "line": v.line, "col": v.col, "message": v.message,
                 "waived": v.waived, "waiver_reason": v.waiver_reason,
+                "call_path": list(v.call_path or ()),
             }
             for v in report.violations
         ],

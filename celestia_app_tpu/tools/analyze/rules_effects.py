@@ -24,26 +24,14 @@ from celestia_app_tpu.tools.analyze.engine import (
 )
 from celestia_app_tpu.tools.analyze.config import RuleConfig
 
-_LOG_METHODS = {"debug", "info", "warning", "error", "exception"}
-_TELEMETRY_METHODS = {"incr", "observe", "measure_since", "gauge",
-                      "counter"}
-
-
-def _is_logging_call(node: ast.Call, ctx: FileContext) -> bool:
-    if not isinstance(node.func, ast.Attribute):
-        return False
-    attr = node.func.attr
-    base = ctx.resolve(node.func.value) or ""
-    base_tail = base.rsplit(".", 1)[-1].lower()
-    if attr in _LOG_METHODS and ("log" in base_tail or base_tail in
-                                 ("lg", "obs")):
-        return True
-    # incr/observe/gauge/measure_since are distinctive registry verbs;
-    # accept them on any receiver (telemetry module, self on Registry,
-    # pool.metrics, ...) — a counter bump is a counter bump
-    if attr in _TELEMETRY_METHODS:
-        return True
-    return False
+# the logging/telemetry classifier and the jit detectors live ONCE in
+# callgraph.py (the fragment builder needs them too); re-exported here
+# so the rule file keeps reading naturally
+from celestia_app_tpu.tools.analyze.callgraph import (  # noqa: E402
+    _is_logging_call,
+    impure_findings,
+    jitted_fn_nodes,
+)
 
 
 def _handler_is_swallowing(handler: ast.ExceptHandler,
@@ -94,89 +82,63 @@ class ExceptSwallowRule(Rule):
 # jit purity
 # ---------------------------------------------------------------------------
 
-_JIT_NAMES = {"jax.jit", "jit", "pl.pallas_call"}
-_HOST_CALLS = {"numpy.asarray", "numpy.array", "numpy.frombuffer",
-               "jax.device_get"}
-_HOST_ATTRS = {"block_until_ready", "item"}
-
-
-def _is_jit_decorator(dec: ast.AST, ctx: FileContext) -> bool:
-    name = ctx.resolve(dec)
-    if name in _JIT_NAMES:
-        return True
-    if isinstance(dec, ast.Call):
-        fname = ctx.resolve(dec.func)
-        if fname in _JIT_NAMES:
-            return True
-        if fname in ("functools.partial", "partial") and dec.args:
-            return ctx.resolve(dec.args[0]) in _JIT_NAMES
-    return False
-
-
-def _jitted_functions(ctx: FileContext) -> list[ast.FunctionDef]:
-    """Functions traced by jax: decorated with @jax.jit (directly or via
-    partial), or defined in a scope where ``jax.jit(name, ...)`` /
-    ``jax.jit(lambda ...)`` wraps them (the jitted-factory idiom used
-    all over ops/ and da/)."""
-    jitted: list[ast.FunctionDef] = []
-    wrapped_names: set[str] = set()
-    for node in ast.walk(ctx.tree):
-        if isinstance(node, ast.Call) and ctx.resolve(node.func) in \
-                _JIT_NAMES:
-            for arg in node.args[:1]:
-                if isinstance(arg, ast.Name):
-                    wrapped_names.add(arg.id)
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if any(_is_jit_decorator(d, ctx) for d in node.decorator_list):
-            jitted.append(node)
-        elif node.name in wrapped_names:
-            jitted.append(node)
-    return jitted
-
 
 @register
 class JitPurityRule(Rule):
     id = "jit-purity"
     help = ("side effects inside jitted functions run once at trace "
-            "time or force host round-trips — keep device code pure")
+            "time or force host round-trips — keep device code pure; "
+            "checked transitively through the call graph")
 
     def check(self, ctx: FileContext, cfg: RuleConfig):
-        for fn in _jitted_functions(ctx):
-            for node in ast.walk(fn):
-                if isinstance(node, ast.Global):
-                    yield (node.lineno, node.col_offset,
-                           f"global mutation inside jitted {fn.name}() "
-                           "(runs once at trace time, then never again)")
+        for fn in sorted(jitted_fn_nodes(ctx), key=lambda n: n.lineno):
+            for line, col, msg in impure_findings(
+                    fn, ctx, f"{fn.name}()"):
+                yield (line, col, msg)
+
+    def check_program(self, program, config, cfg: RuleConfig):
+        """The transitive closure (ISSUE 12): helpers *called from*
+        jitted program bodies are held to the same purity bar. The
+        per-file pass above already covers everything lexically inside
+        a jitted function (nested defs included), so this pass reports
+        only reached functions outside every jitted span."""
+        from celestia_app_tpu.tools.analyze.engine import (
+            Violation,
+            _in_scope,
+        )
+        from celestia_app_tpu.tools.analyze.taint import _barrier
+
+        jit_spans: dict[str, list[tuple[int, int]]] = {}
+        for node in program.nodes.values():
+            if node.jitted:
+                jit_spans.setdefault(node.path, []).append(
+                    (node.line, node.end))
+        stop = _barrier(cfg.allow)
+        seen: set[tuple[str, int, int]] = set()
+        for nid in sorted(program.nodes):
+            j = program.nodes[nid]
+            if not j.jitted or not _in_scope(j.path, cfg):
+                continue
+            visited, parents = program.reachable([nid], stop)
+            for tid in sorted(visited):
+                n = program.nodes[tid]
+                if tid == nid or n.jitted:
                     continue
-                if not isinstance(node, ast.Call):
-                    continue
-                name = ctx.resolve(node.func)
-                attr = (node.func.attr
-                        if isinstance(node.func, ast.Attribute)
-                        else None)
-                if name == "print":
-                    yield (node.lineno, node.col_offset,
-                           f"print inside jitted {fn.name}() fires at "
-                           "trace time only (use jax.debug.print)")
-                elif _is_logging_call(node, ctx):
-                    yield (node.lineno, node.col_offset,
-                           f"logging/telemetry inside jitted {fn.name}()"
-                           " fires at trace time only (hoist to the "
-                           "caller)")
-                elif name in _HOST_CALLS:
-                    yield (node.lineno, node.col_offset,
-                           f"{name}() inside jitted {fn.name}() forces "
-                           "a host round-trip per call")
-                elif attr in _HOST_ATTRS:
-                    yield (node.lineno, node.col_offset,
-                           f".{attr}() inside jitted {fn.name}() forces "
-                           "a host sync")
-                elif name == "float" and node.args:
-                    yield (node.lineno, node.col_offset,
-                           f"float() cast inside jitted {fn.name}() "
-                           "concretizes a tracer (host round-trip)")
+                if any(lo <= n.line <= hi
+                       for lo, hi in jit_spans.get(n.path, [])):
+                    continue  # lexically inside a jitted fn: per-file
+                for line, col, msg in n.impure:
+                    key = (n.path, line, col)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield Violation(
+                        rule=self.id, severity=cfg.severity,
+                        path=n.path, line=line, col=col,
+                        message=(msg + f" [transitively reached from "
+                                 f"jitted {j.qual}()]"),
+                        call_path=program.call_path(parents, tid),
+                    )
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,335 @@
+"""Interprocedural taint + reachability rules over the call graph.
+
+PR 5's determinism rules are *intraprocedural*: they flag a wall-clock
+read only when it sits lexically inside a file someone remembered to
+add to an ``analyze.toml`` include list. These rules close the two
+holes that leaves:
+
+- ``det-reach``  — taint sources (wall-clock, ambient RNG,
+  ``os.environ`` reads, unordered dict/set iteration feeding a hash)
+  **reachable from the consensus roots** are errors, wherever they
+  live. Roots are configured (``[rules.det-reach] roots = [...]``) as
+  ``path::symbol`` entries — the ABCI surface, ``ValidatorNode.apply``,
+  every codec's encode/verify/repair/fraud hooks, the sync plane's
+  manifest/chunk digest writers, the pack doc builders. ``allow``
+  entries are traversal **barriers**: the observability and fault
+  planes are deliberately non-consensus (their outputs never feed a
+  hash) and are not descended into. A configured root that no longer
+  resolves is itself an error — the root ledger cannot rot either.
+
+- ``scope-drift`` — the consensus-reachable function set computed
+  above must be *covered* by the hand-maintained include lists of the
+  checked det-* rules (``check = [...]``). A reachable function missing
+  from a list is an error naming the file, the rule, and the call path
+  that makes it consensus — so forgetting to append a new file to
+  ``analyze.toml`` (the PR 7–11 ritual) is now impossible.
+  ``analyze --scopes`` prints the computed lists for auditing.
+
+- ``blocking-under-lock`` — network calls, ``fsync``, ``sleep``, or
+  potential jit compiles reachable while a ``with self.<lock>`` frame
+  is held. The static complement of racecheck's runtime ABBA detector:
+  racecheck needs the bad interleaving to strike; this rule finds the
+  stall-under-lock before it ships.
+
+``jit-purity`` additionally gains a transitive closure pass (in
+``rules_effects``, built on this module): helpers *called from* jitted
+program bodies are checked, not just the jitted function's own body.
+
+All three report the full root→sink call path in text and ``--json``
+(the ``call_path`` field, FORMATS §11).
+"""
+
+from __future__ import annotations
+
+from celestia_app_tpu.tools.analyze.engine import (
+    ProgramRule,
+    Violation,
+    register,
+    _in_scope,
+)
+from celestia_app_tpu.tools.analyze.config import AnalyzeConfig, RuleConfig
+
+_SOURCE_KINDS = {
+    "wallclock": "wall-clock read",
+    "rng": "nondeterministic rng",
+    "env": "environment read",
+    "hash-iter": "unordered iteration feeding a hash",
+}
+
+_BLOCK_KINDS = {
+    "sleep": "sleep",
+    "fsync": "fsync",
+    "net": "network call",
+    "jit-compile": "potential jit compile",
+    "subprocess": "subprocess",
+}
+
+
+def entry_covers(entry: str, path: str, qual: str) -> bool:
+    """Does a ``path[::symbol]`` config entry cover function `qual` of
+    file `path`? Same semantics as the engine's include scoping: prefix
+    path match, and a ``::symbol`` suffix matches when the symbol is a
+    component of the qualified name (``apply`` covers
+    ``ValidatorNode.apply`` and its closures)."""
+    base, _, sym = entry.partition("::")
+    if not (path == base or path.startswith(base)):
+        return False
+    if not sym:
+        return True
+    return sym in qual.split(".")
+
+
+def _barrier(allow: list[str]):
+    def stop(node) -> bool:
+        return any(entry_covers(e, node.path, node.qual) for e in allow)
+    return stop
+
+
+def _resolve_roots(program, rcfg: RuleConfig):
+    """(resolved node ids, [missing-entry violations])."""
+    roots: list[str] = []
+    missing: list[Violation] = []
+    for entry in rcfg.options.get("roots", []):
+        nid = program.resolve_entry(str(entry))
+        if nid is None:
+            missing.append(Violation(
+                rule="det-reach", severity="error",
+                path=str(entry).split("::")[0], line=0, col=0,
+                message=(f"det-reach root {entry!r} not found in the "
+                         "call graph (stale analyze.toml entry, or the "
+                         "function moved — the root ledger must track "
+                         "the code)"),
+            ))
+        else:
+            roots.append(nid)
+    return roots, missing
+
+
+def consensus_reachability(program, config: AnalyzeConfig):
+    """The shared computation: (visited, parents, roots, missing) for
+    the det-reach roots/barriers in `config` — used by det-reach,
+    scope-drift, and ``analyze --scopes``."""
+    rcfg = config.rule("det-reach")
+    roots, missing = _resolve_roots(program, rcfg)
+    visited, parents = program.reachable(roots, _barrier(rcfg.allow))
+    return visited, parents, roots, missing
+
+
+@register
+class DetReachRule(ProgramRule):
+    id = "det-reach"
+    help = ("taint sources (wall-clock, rng, os.environ, unordered "
+            "iteration into hashes) reachable from the configured "
+            "consensus roots fork the chain — reported with the full "
+            "call path")
+
+    def check_program(self, program, config: AnalyzeConfig,
+                      rcfg: RuleConfig):
+        if not rcfg.options.get("roots"):
+            yield Violation(
+                rule=self.id, severity="error", path="analyze.toml",
+                line=0, col=0,
+                message=("det-reach is enabled but [rules.det-reach] "
+                         "configures no roots — an empty root set makes "
+                         "the rule a silent no-op"),
+            )
+            return
+        visited, parents, roots, missing = consensus_reachability(
+            program, config)
+        for v in missing:
+            yield v
+        # sorted: set iteration is hash-order and would make the report
+        # differ across processes (PYTHONHASHSEED)
+        for nid in sorted(visited):
+            node = program.nodes[nid]
+            chain = program.call_path(parents, nid)
+            root_qual = program.nodes[chain[0]].qual
+            for kind, line, what in node.sources:
+                label = _SOURCE_KINDS.get(kind, kind)
+                yield Violation(
+                    rule=self.id, severity="error", path=node.path,
+                    line=int(line), col=0,
+                    message=(f"{label} ({what}) in {node.qual}() is "
+                             f"reachable from consensus root "
+                             f"{root_qual}() — every validator must "
+                             "compute identical bytes on this path"),
+                    call_path=chain,
+                )
+
+
+@register
+class ScopeDriftRule(ProgramRule):
+    id = "scope-drift"
+    help = ("the computed consensus-reachable set must be covered by "
+            "the checked rules' hand-written include lists in "
+            "analyze.toml — a reachable file missing from a det-* list "
+            "is a silent hole in the determinism gate")
+
+    def check_program(self, program, config: AnalyzeConfig,
+                      rcfg: RuleConfig):
+        checked = [str(r) for r in rcfg.options.get("check", [])]
+        if not checked:
+            yield Violation(
+                rule=self.id, severity="error", path="analyze.toml",
+                line=0, col=0,
+                message=("scope-drift is enabled but [rules.scope-drift]"
+                         " configures no checked rules (check = [...])"),
+            )
+            return
+        visited, parents, roots, _missing = consensus_reachability(
+            program, config)
+        for rid in checked:
+            tcfg = config.rule(rid)
+            entries = list(tcfg.include)
+            if not entries:
+                continue  # no include list = whole tree in scope
+            covered_entries: set[str] = set()
+            seen_files: set[str] = set()
+            # sorted: the per-file representative (first uncovered
+            # function) must not depend on set hash order
+            for nid in sorted(visited):
+                node = program.nodes[nid]
+                hits = [e for e in entries
+                        if entry_covers(e, node.path, node.qual)]
+                covered_entries.update(hits)
+                if hits:
+                    continue
+                if any(entry_covers(e, node.path, node.qual)
+                       for e in tcfg.allow):
+                    continue
+                if any(entry_covers(e, node.path, node.qual)
+                       for e in rcfg.allow):
+                    continue
+                if node.path in seen_files:
+                    continue
+                seen_files.add(node.path)
+                chain = program.call_path(parents, nid)
+                root_qual = program.nodes[chain[0]].qual
+                yield Violation(
+                    rule=self.id, severity="error", path=node.path,
+                    line=node.line, col=0,
+                    message=(f"{node.path} is consensus-reachable "
+                             f"({node.qual}() via root {root_qual}()) "
+                             f"but not covered by [rules.{rid}] "
+                             "include/allow in analyze.toml — the "
+                             "determinism scope has drifted from the "
+                             "code"),
+                    call_path=chain,
+                )
+
+
+@register
+class BlockingUnderLockRule(ProgramRule):
+    id = "blocking-under-lock"
+    help = ("network calls, fsync, sleep, or potential jit compiles "
+            "reachable while a 'with self.<lock>' frame is held stall "
+            "every thread queued on that lock — hoist the slow work "
+            "outside the critical section")
+
+    def check_program(self, program, config: AnalyzeConfig,
+                      rcfg: RuleConfig):
+        stop = _barrier(rcfg.allow)
+        reported: set[tuple] = set()
+        for nid in program.nodes:
+            holder = program.nodes[nid]
+            if not holder.locks:
+                continue
+            if not _in_scope(holder.path, rcfg):
+                continue
+            for frame in holder.locks:
+                lock, wline = frame["lock"], frame["line"]
+                # blocking ops lexically inside the with body
+                for kind, line, what in frame["blocking"]:
+                    key = (holder.path, wline, kind, holder.path, line)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    yield Violation(
+                        rule=self.id, severity="error",
+                        path=holder.path, line=wline, col=0,
+                        message=(f"{_BLOCK_KINDS.get(kind, kind)} "
+                                 f"({what}) at {holder.path}:{line} "
+                                 f"inside 'with self.{lock}' "
+                                 f"({holder.qual}()) blocks every "
+                                 "thread queued on the lock"),
+                        call_path=[nid],
+                    )
+                # ... and ops reachable through the calls made there
+                callees = [t for t, _l in frame["callees"]]
+                visited, parents = program.reachable(callees, stop)
+                for tid in sorted(visited):
+                    tnode = program.nodes[tid]
+                    for kind, line, what in tnode.blocking:
+                        key = (holder.path, wline, kind, tnode.path,
+                               line)
+                        if key in reported:
+                            continue
+                        reported.add(key)
+                        chain = [nid] + program.call_path(parents, tid)
+                        yield Violation(
+                            rule=self.id, severity="error",
+                            path=holder.path, line=wline, col=0,
+                            message=(f"{_BLOCK_KINDS.get(kind, kind)} "
+                                     f"({what}) at {tnode.path}:{line} "
+                                     "is reachable while "
+                                     f"'with self.{lock}' is held at "
+                                     f"{holder.path}:{wline} "
+                                     f"({holder.qual}())"),
+                            call_path=chain,
+                        )
+
+
+# ---------------------------------------------------------------------------
+# --scopes: the audit surface
+# ---------------------------------------------------------------------------
+
+
+def scopes_report(program, config: AnalyzeConfig) -> str:
+    """Human-readable computed-scope audit for ``analyze --scopes``:
+    the consensus-reachable set, and per checked rule the computed
+    minimal include list, entries covering nothing reachable (deletion
+    candidates), and reachable-but-uncovered files (the drift)."""
+    rcfg = config.rule("det-reach")
+    visited, parents, roots, missing = consensus_reachability(
+        program, config)
+    by_file: dict[str, list] = {}
+    for nid in sorted(visited):
+        node = program.nodes[nid]
+        by_file.setdefault(node.path, []).append(node.qual)
+    lines = [
+        f"consensus-reachable: {len(visited)} functions in "
+        f"{len(by_file)} files ({len(roots)} roots, "
+        f"{len(rcfg.allow)} barrier entries)",
+    ]
+    for entry in missing:
+        lines.append(f"  MISSING ROOT: {entry.message}")
+    checked = [str(r) for r in
+               config.rule("scope-drift").options.get("check", [])]
+    sd_allow = config.rule("scope-drift").allow
+    for rid in checked:
+        tcfg = config.rule(rid)
+        lines.append(f"\n[rules.{rid}] computed minimal include:")
+        used: set[str] = set()
+        for path in sorted(by_file):
+            quals = by_file[path]
+            hits = {e for e in tcfg.include
+                    for q in quals if entry_covers(e, path, q)}
+            used |= hits
+            allowed = all(
+                any(entry_covers(e, path, q) for e in
+                    (list(tcfg.allow) + list(sd_allow) + list(rcfg.allow)))
+                or any(entry_covers(e, path, q) for e in hits)
+                for q in quals)
+            mark = " " if (hits or allowed) else "!"
+            lines.append(f"  {mark} {path}  "
+                         f"({len(quals)} reachable: "
+                         f"{', '.join(sorted(quals)[:4])}"
+                         f"{', ...' if len(quals) > 4 else ''})")
+        unused = [e for e in tcfg.include if e not in used]
+        if unused:
+            lines.append(f"  unused include entries (cover nothing "
+                         f"reachable — deletion candidates): {unused}")
+    lines.append(
+        "\nlines marked '!' are reachable but uncovered (scope-drift "
+        "errors); barrier files are not listed.")
+    return "\n".join(lines)

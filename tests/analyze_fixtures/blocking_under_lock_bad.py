@@ -1,0 +1,22 @@
+"""BAD: blocking operations reachable while a lock frame is held."""
+import os
+import time
+import threading
+
+
+class Service:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = {}
+
+    def slow_update(self, key, value):
+        with self._lock:  # VIOLATION blocking-under-lock (sleep, via helper)
+            self.state[key] = value
+            self._settle()
+
+    def direct_flush(self, fd):
+        with self._lock:  # VIOLATION blocking-under-lock (fsync, lexical)
+            os.fsync(fd)
+
+    def _settle(self):
+        time.sleep(0.1)

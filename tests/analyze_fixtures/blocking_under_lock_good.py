@@ -1,0 +1,25 @@
+"""GOOD: the critical section stays pure; slow work happens outside."""
+import os
+import time
+import threading
+
+
+class Service:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = {}
+
+    def update(self, key, value):
+        with self._lock:
+            self.state[key] = value
+        self._settle()  # outside the lock
+
+    def flush(self, fd):
+        snapshot = None
+        with self._lock:
+            snapshot = dict(self.state)
+        os.fsync(fd)  # outside the lock
+        return snapshot
+
+    def _settle(self):
+        time.sleep(0.1)

@@ -1,0 +1,18 @@
+"""BAD: taint sources reachable from the configured consensus root
+(the fixture config roots ``det_reach_bad.py::consensus_root``)."""
+import os
+import time
+
+
+def consensus_root(block):
+    body = _digest_inputs(block)
+    return _stamp(body)
+
+
+def _digest_inputs(block):
+    salt = os.environ.get("CELESTIA_SALT", "")  # VIOLATION det-reach (env)
+    return [salt, *block]
+
+
+def _stamp(body):
+    return (time.time(), body)  # VIOLATION det-reach (wall-clock)

@@ -1,0 +1,16 @@
+"""GOOD: the root's closure is pure; the wall-clock read lives in an
+operator probe the root never calls."""
+import time
+
+
+def consensus_root(block):
+    return _canonical(block)
+
+
+def _canonical(block):
+    return sorted(block)
+
+
+def operator_probe():
+    # unreachable from consensus_root: allowed
+    return time.time()

@@ -20,3 +20,15 @@ def factory():
         return float(x[0]) + 1  # VIOLATION jit-purity (float cast)
 
     return jax.jit(inner)
+
+
+@jax.jit
+def extend_transitive(x):
+    # the helper is OUTSIDE any jitted body: only the call-graph
+    # closure pass (ISSUE 12) can see its impurity
+    return _helper_scale(x)
+
+
+def _helper_scale(x):
+    telemetry.incr("scale.calls")  # VIOLATION jit-purity (transitive)
+    return x * 2

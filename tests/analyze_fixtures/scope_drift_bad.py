@@ -1,0 +1,11 @@
+"""BAD: this file is consensus-reachable (the fixture config roots
+``scope_drift_bad.py::reachable_root``) but the checked rule's include
+list does NOT cover it."""
+
+
+def reachable_root(block):  # VIOLATION scope-drift (uncovered file)
+    return _helper(block)
+
+
+def _helper(block):
+    return list(block)

@@ -1,0 +1,10 @@
+"""GOOD: consensus-reachable AND covered by the checked rule's include
+list in the fixture config."""
+
+
+def covered_root(block):
+    return _helper(block)
+
+
+def _helper(block):
+    return list(block)

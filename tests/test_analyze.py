@@ -49,12 +49,30 @@ RULES = [
     "det-wallclock", "det-rng", "det-float", "det-set-iter",
     "det-dict-hash", "except-swallow", "jit-purity", "lock-guard",
     "print-call", "raw-urlopen",
+    # the interprocedural family (ISSUE 12)
+    "det-reach", "scope-drift", "blocking-under-lock",
 ]
 
 
 def _fixture_config() -> AnalyzeConfig:
-    """All rules enabled, unscoped — fixtures opt in per file by name."""
-    return AnalyzeConfig(exclude=["__pycache__"])
+    """All rules enabled, unscoped — fixtures opt in per file by name.
+    The interprocedural rules additionally need roots / a checked
+    include list, pointed at the fixture files; ``det-fixture`` is a
+    config-only pseudo-rule (never registered, never run) standing in
+    for the hand list scope-drift audits."""
+    cfg = AnalyzeConfig(exclude=["__pycache__"])
+    cfg.rules["det-reach"] = RuleConfig(options={"roots": [
+        "det_reach_bad.py::consensus_root",
+        "det_reach_good.py::consensus_root",
+        "scope_drift_bad.py::reachable_root",
+        "scope_drift_good.py::covered_root",
+    ]})
+    cfg.rules["scope-drift"] = RuleConfig(
+        options={"check": ["det-fixture"]})
+    cfg.rules["det-fixture"] = RuleConfig(include=[
+        "scope_drift_good.py", "det_reach_bad.py", "det_reach_good.py",
+    ])
+    return cfg
 
 
 def _run_fixture(name: str, only: set[str] | None = None):
@@ -282,14 +300,16 @@ def test_json_report_schema(tmp_path):
     rep = run_analysis(root=str(tmp_path), config=AnalyzeConfig(),
                        only_rules={"det-wallclock"})
     doc = to_json(rep)
-    assert doc["version"] == 1
+    assert doc["version"] == 2
     assert set(doc["summary"]) == {"files_scanned", "rules_run", "errors",
-                                   "warnings", "waived", "wall_s"}
+                                   "warnings", "waived", "wall_s",
+                                   "cache_hits", "cache_misses"}
     (v,) = doc["violations"]
     assert set(v) == {"rule", "severity", "path", "line", "col",
-                      "message", "waived", "waiver_reason"}
+                      "message", "waived", "waiver_reason", "call_path"}
     assert v["rule"] == "det-wallclock" and v["path"] == "m.py"
     assert v["line"] == 5 and v["waived"] is False
+    assert v["call_path"] == []  # per-file rules carry no chain
     json.dumps(doc)  # round-trippable
 
 
@@ -303,7 +323,7 @@ def test_cli_analyze_json_subprocess():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     doc = json.loads(proc.stdout)
-    assert doc["version"] == 1 and doc["summary"]["errors"] == 0
+    assert doc["version"] == 2 and doc["summary"]["errors"] == 0
     assert doc["summary"]["files_scanned"] > 100
 
 
@@ -404,6 +424,13 @@ def test_racecheck_catches_abba_inversion(racecheck_installed):
     assert "test_analyze.py" in vios[0]["first"]
     assert "test_analyze.py" in vios[0]["then"]
     assert vios[0]["first"] != vios[0]["then"]
+    # ISSUE 12 triage aid: each thread's acquisition stack rides along
+    # (creation-site@acquisition-site entries), in the message too
+    for key in ("stack_forward", "stack_reverse"):
+        stack = vios[0][key]
+        assert len(stack) == 2 and all("@" in s for s in stack), stack
+        assert all("test_analyze.py" in s for s in stack)
+    assert "acquired" in msg
 
 
 def test_racecheck_consistent_order_is_clean(racecheck_installed):
@@ -488,12 +515,220 @@ def test_racecheck_env_hook_in_subprocess():
 
 
 # ---------------------------------------------------------------------------
+# the interprocedural family (ISSUE 12): call paths, scope-drift, cache
+# ---------------------------------------------------------------------------
+
+
+def test_det_reach_call_path_content():
+    """Interprocedural violations carry the full root→sink chain, in
+    the object, the JSON field, and the text rendering."""
+    rep = _run_fixture("det-reach", only={"det-reach"})
+    hits = [v for v in rep.violations if v.path == "det_reach_bad.py"]
+    assert len(hits) == 2, [str(v) for v in hits]
+    assert all(v.call_path for v in hits)
+    stamp = [v for v in hits if "wall-clock" in v.message][0]
+    assert stamp.call_path == ["det_reach_bad.py::consensus_root",
+                               "det_reach_bad.py::_stamp"]
+    env = [v for v in hits if "environment" in v.message][0]
+    assert env.call_path == ["det_reach_bad.py::consensus_root",
+                             "det_reach_bad.py::_digest_inputs"]
+    assert "call path:" in str(stamp)
+    doc = to_json(rep)
+    jhits = [v for v in doc["violations"]
+             if v["path"] == "det_reach_bad.py"]
+    assert jhits and all(v["call_path"] for v in jhits)
+
+
+def test_det_reach_missing_root_is_error(tmp_path):
+    """A configured root that no longer resolves is itself an error —
+    the root ledger cannot rot silently."""
+    (tmp_path / "m.py").write_text("def f():\n    return 1\n")
+    cfg = AnalyzeConfig(rules={"det-reach": RuleConfig(
+        options={"roots": ["m.py::gone"]})})
+    rep = run_analysis(root=str(tmp_path), config=cfg,
+                       only_rules={"det-reach"})
+    assert any("not found" in v.message and "m.py::gone" in v.message
+               for v in rep.errors), [str(v) for v in rep.errors]
+
+
+def test_blocking_under_lock_call_path():
+    rep = _run_fixture("blocking-under-lock",
+                       only={"blocking-under-lock"})
+    bad = [v for v in rep.violations
+           if v.path == "blocking_under_lock_bad.py"]
+    assert len(bad) == 2, [str(v) for v in bad]
+    via_helper = [v for v in bad if "sleep" in v.message][0]
+    assert via_helper.call_path == [
+        "blocking_under_lock_bad.py::Service.slow_update",
+        "blocking_under_lock_bad.py::Service._settle",
+    ]
+    lexical = [v for v in bad if "fsync" in v.message][0]
+    assert lexical.line == 18  # reported AT the with statement
+
+
+def test_jit_purity_transitive_call_path():
+    rep = _run_fixture("jit-purity", only={"jit-purity"})
+    hits = [v for v in rep.violations
+            if v.path == "jit_purity_bad.py" and v.call_path]
+    assert hits, "transitive closure produced nothing"
+    (t,) = [v for v in hits if "transitively reached" in v.message]
+    assert t.call_path == ["jit_purity_bad.py::extend_transitive",
+                           "jit_purity_bad.py::_helper_scale"]
+
+
+def test_scope_drift_fixture_pair_names_file():
+    rep = _run_fixture("scope-drift", only={"scope-drift"})
+    bad = [v for v in rep.violations if v.path == "scope_drift_bad.py"]
+    good = [v for v in rep.violations
+            if v.path == "scope_drift_good.py"]
+    assert len(bad) == 1 and not good, [str(v) for v in rep.violations]
+    assert "[rules.det-fixture]" in bad[0].message
+    assert bad[0].call_path  # the chain that makes it consensus
+
+
+@pytest.mark.parametrize("rid,entry", [
+    ("det-wallclock", "wire/"),
+    ("det-float", "da/"),
+    ("det-rng", "chain/app.py"),
+    ("det-set-iter", "das/packs.py"),
+])
+def test_scope_drift_deleting_committed_entry_fails(rid, entry):
+    """THE anti-rot gate (acceptance): strip one include entry from the
+    committed config and scope-drift must fail naming a file that entry
+    covered — every hand-list entry is load-bearing."""
+    cfg = load_config()
+    assert entry in cfg.rule(rid).include
+    cfg.rule(rid).include.remove(entry)
+    rep = run_analysis(config=cfg, only_rules={"scope-drift"})
+    hits = [v for v in rep.errors if v.rule == "scope-drift"
+            and v.path.startswith(entry.split("::")[0])
+            and f"[rules.{rid}]" in v.message]
+    assert hits, (rid, entry, [str(v) for v in rep.errors][:5])
+    assert all(v.call_path for v in hits)
+
+
+def test_scopes_report_audit_surface():
+    """`analyze --scopes` material: the computed set names the known
+    consensus files, and the committed lists carry no dead entries."""
+    from celestia_app_tpu.tools.analyze.taint import scopes_report
+
+    rep = run_analysis()
+    assert rep.program is not None
+    text = scopes_report(rep.program, load_config())
+    assert "consensus-reachable:" in text
+    for expected in ("chain/app.py", "da/eds.py", "wire/txpb.py",
+                     "das/packs.py", "[rules.det-wallclock]"):
+        assert expected in text, expected
+    assert "unused include entries" not in text, text
+    assert "MISSING ROOT" not in text
+
+
+def test_cache_warm_identity_and_single_file_invalidation(tmp_path):
+    """The incremental cache (ISSUE 12 satellite): a warm run is
+    byte-identical to a fresh uncached run, and editing one file
+    re-derives exactly that file."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(
+        "import time\n\n\ndef f():\n    return time.time()\n")
+    (pkg / "n.py").write_text("def g():\n    return 1\n")
+    cache = str(tmp_path / "cache.json")
+    cfg = AnalyzeConfig()
+
+    def norm(rep):
+        doc = to_json(rep)
+        for k in ("wall_s", "cache_hits", "cache_misses"):
+            doc["summary"].pop(k)
+        return json.dumps(doc, sort_keys=True)
+
+    cold = run_analysis(root=str(pkg), config=cfg, cache=cache)
+    assert cold.cache_misses == 2 and cold.cache_hits == 0
+    warm = run_analysis(root=str(pkg), config=cfg, cache=cache)
+    assert warm.cache_misses == 0 and warm.cache_hits == 2
+    fresh = run_analysis(root=str(pkg), config=cfg)
+    assert norm(warm) == norm(cold) == norm(fresh)
+    # single-file edit: only that file re-derives, results stay honest
+    (pkg / "n.py").write_text(
+        "import time\n\n\ndef g():\n    return time.time()\n")
+    edited = run_analysis(root=str(pkg), config=cfg, cache=cache)
+    assert edited.cache_misses == 1 and edited.cache_hits == 1
+    fresh2 = run_analysis(root=str(pkg), config=cfg)
+    assert norm(edited) == norm(fresh2)
+    assert any(v.path == "n.py" for v in edited.errors)
+    # parse errors are synthetic, not a registered rule — they must
+    # survive warm runs too
+    (pkg / "n.py").write_text("def broken(:\n")
+    cold3 = run_analysis(root=str(pkg), config=cfg, cache=cache)
+    warm3 = run_analysis(root=str(pkg), config=cfg, cache=cache)
+    assert warm3.cache_misses == 0
+    assert norm(warm3) == norm(cold3)
+    assert any(v.rule == "parse-error" for v in warm3.errors)
+
+
+def test_cache_invalidated_by_config_change(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import time\n\n\ndef f():\n    return time.time()\n")
+    cache = str(tmp_path / "cache.json")
+    run_analysis(root=str(tmp_path), config=AnalyzeConfig(),
+                 cache=cache, only_rules={"det-wallclock"})
+    # a different config (severity flip) must not reuse entries
+    warn = AnalyzeConfig(
+        rules={"det-wallclock": RuleConfig(severity="warning")})
+    rep = run_analysis(root=str(tmp_path), config=warn, cache=cache,
+                       only_rules={"det-wallclock"})
+    assert rep.cache_hits == 0 and rep.cache_misses == 1
+    assert not rep.errors and len(rep.warnings) == 1
+
+
+def test_cache_namespaces_rule_sets_side_by_side(tmp_path):
+    """Alternating run shapes (full sweep vs --rule dev loop) keep
+    separate warm slots — one must not evict the other."""
+    (tmp_path / "m.py").write_text(
+        "import time\n\n\ndef f():\n    return time.time()\n")
+    cache = str(tmp_path / "cache.json")
+    cfg = AnalyzeConfig()
+    run_analysis(root=str(tmp_path), config=cfg, cache=cache)
+    run_analysis(root=str(tmp_path), config=cfg, cache=cache,
+                 only_rules={"det-wallclock"})
+    full = run_analysis(root=str(tmp_path), config=cfg, cache=cache)
+    dev = run_analysis(root=str(tmp_path), config=cfg, cache=cache,
+                       only_rules={"det-wallclock"})
+    assert full.cache_misses == 0 and dev.cache_misses == 0
+
+
+def test_cli_rule_comma_list_and_unknown_exit_2(tmp_path):
+    # chain/app.py so the committed config's det-wallclock scope applies
+    (tmp_path / "chain").mkdir()
+    (tmp_path / "chain" / "app.py").write_text(
+        "import time\n\n\ndef f():\n    return time.time()\n")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    # unknown rule: exit 2, registry on stderr, nothing analyzed
+    proc = subprocess.run(
+        [sys.executable, "-m", "celestia_app_tpu", "analyze",
+         "--root", str(tmp_path), "--rule", "bogus,det-wallclock"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "unknown rule(s): bogus" in proc.stderr
+    assert "det-reach" in proc.stderr  # the registry listing
+    # comma-separated list runs both rules
+    proc = subprocess.run(
+        [sys.executable, "-m", "celestia_app_tpu", "analyze",
+         "--root", str(tmp_path), "--no-cache", "--json",
+         "--rule", "det-wallclock,det-rng"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["summary"]["rules_run"] == ["det-rng", "det-wallclock"]
+
+
+# ---------------------------------------------------------------------------
 # bench surface
 # ---------------------------------------------------------------------------
 
 
 def test_full_tree_wall_time_budget():
     """The tier-1/pre-commit cost must stay interactive: < 10 s on CPU
-    (bench.py --analyze reports the measured number as BENCH JSON)."""
+    cold (bench.py --analyze reports cold AND cache-warm numbers as
+    BENCH JSON; the warm gate lives there)."""
     rep = run_analysis()
     assert rep.wall_s < 10.0, f"analyze took {rep.wall_s:.1f}s"
