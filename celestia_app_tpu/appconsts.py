@@ -46,8 +46,16 @@ SUPPORTED_SHARE_VERSIONS = (0,)
 SHARE_VERSION_ZERO = 0
 
 MIN_SQUARE_SIZE = 1
-# Upper bound on axis length of the *extended* square = 2 * 128.
-MAX_EXTENDED_SQUARE_WIDTH = 256
+# Upper bound on axis length of the *extended* square = 2 * 512. The
+# reference stops at 2*128; the mesh plane (parallel/mesh_engine.py)
+# admits k=256/512 squares through the sharded pipeline, so the layout
+# accounting and wire parsing must accept them. Consensus-visible
+# bounds do NOT read this constant: the CONSENSUS cap (and the
+# gov_max_square_size validation bound, chain/app.py) stays the
+# versioned square_size_upper_bound (128) unless a chain explicitly
+# raises it via the consensus-critical `max_square_size` home config
+# key — this constant only bounds what the plumbing can express.
+MAX_EXTENDED_SQUARE_WIDTH = 1024
 
 # NMT node serialization: minNs(29) || maxNs(29) || sha256 digest(32).
 NMT_ROOT_SIZE = 2 * NAMESPACE_SIZE + 32  # 90

@@ -94,7 +94,7 @@ class App:
         self,
         chain_id: str = "celestia-tpu-1",
         app_version: int = 1,
-        engine: str = "auto",  # "device" | "host" | "auto"
+        engine: str = "auto",  # "device" | "host" | "auto" | "mesh"
         min_gas_price: float = appconsts.DEFAULT_MIN_GAS_PRICE,
         v2_upgrade_height: int | None = None,
         upgrade_height_delay: int | None = None,
@@ -104,6 +104,12 @@ class App:
         # proof packs (das/packs.py): newest-N packs kept on disk
         # (0 = keep all, None = packs disabled); needs a data_dir
         pack_keep: int | None = None,
+        # mesh plane: raise the versioned hard cap on the square size so
+        # k=256/512 squares are admitted end to end (the gov param still
+        # gates below it). CONSENSUS-CRITICAL like upgrade_height_delay:
+        # every validator must carry the same value (home config key
+        # `max_square_size`, never an env var). None = reference cap.
+        max_square_size: int | None = None,
     ):
         self.invariant_check_period = invariant_check_period
         self.traces = telemetry.TraceTables()  # per-node trace tables (§5.1)
@@ -118,6 +124,26 @@ class App:
         # node-local (operator-set) min gas price; served by the gRPC node
         # Config route the reference's QueryMinimumGasPrice reads first
         self.min_gas_price = min_gas_price
+        self.max_square_size = max_square_size
+        if max_square_size is not None and max_square_size != \
+                appconsts.square_size_upper_bound(app_version):
+            if max_square_size < 1 \
+                    or (max_square_size & (max_square_size - 1)) or \
+                    max_square_size > appconsts.MAX_EXTENDED_SQUARE_WIDTH // 2:
+                raise ValueError(
+                    f"max_square_size must be a power of two <= "
+                    f"{appconsts.MAX_EXTENDED_SQUARE_WIDTH // 2}, "
+                    f"got {max_square_size}")
+            # loud, same policy as upgrade_height_delay: the square-size
+            # cap feeds ProcessProposal's accept/reject — divergent caps
+            # fork the network at the first big block
+            obs.get_logger("chain.app").warning(
+                "max_square_size override active; every validator must "
+                "be provisioned identically or the network forks at the "
+                "first block exceeding the reference cap",
+                chain_id=chain_id, max_square_size=max_square_size,
+                reference=appconsts.square_size_upper_bound(app_version),
+            )
         self.v2_upgrade_height = v2_upgrade_height
         self.store = KVStore()
         # durable storage: commits + blocks persist under data_dir; a
@@ -182,10 +208,20 @@ class App:
                 self.gov.set_params(ctx, params)
             return setter
 
+        # gov_max_square_size's validation bound is CONSENSUS-visible
+        # (a param-change tx above it fails; divergent bounds would
+        # diverge tx results and fork): it must be THIS CHAIN's hard
+        # cap — the reference bound (128) unless the chain opted into
+        # the mesh plane's max_square_size — never the plumbing-wide
+        # MAX_EXTENDED_SQUARE_WIDTH, which admits sizes this chain
+        # refuses to build
+        gov_square_cap = (
+            max_square_size if max_square_size is not None
+            else appconsts.square_size_upper_bound(app_version))
         param_router = {
             "blob/gas_per_blob_byte": _blob_param("gas_per_blob_byte", 1, 1 << 20),
             "blob/gov_max_square_size": _blob_param(
-                "gov_max_square_size", 1, appconsts.MAX_EXTENDED_SQUARE_WIDTH // 2
+                "gov_max_square_size", 1, gov_square_cap
             ),
             # gas prices are sdk.Dec-shaped floats end to end (see the
             # det-float waiver on wire/txpb.py in analyze.toml)
@@ -506,11 +542,13 @@ class App:
         return self._ctx(self.store.branch(), gas_meter, check=False, height=height, t=t)
 
     def max_effective_square_size(self, ctx: Context) -> int:
-        """min(gov param, versioned hard cap) — app/square_size.go:9-23."""
-        return min(
-            self.blob.params(ctx)["gov_max_square_size"],
-            appconsts.square_size_upper_bound(self.app_version),
-        )
+        """min(gov param, hard cap) — app/square_size.go:9-23. The hard
+        cap is the versioned reference bound unless the mesh plane's
+        consensus-critical ``max_square_size`` override raises it (the
+        k=256/512 admission path; see __init__)."""
+        hard = (self.max_square_size if self.max_square_size is not None
+                else appconsts.square_size_upper_bound(self.app_version))
+        return min(self.blob.params(ctx)["gov_max_square_size"], hard)
 
     # ------------------------------------------------------------------
     # CheckTx (mempool admission)

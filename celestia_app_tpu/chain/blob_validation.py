@@ -27,7 +27,9 @@ def batch_commitments(blobs: list, subtree_root_threshold: int,
     validator must NEVER touch the jax backend here: with the accelerator
     relay down, backend init does not fail, it HANGS, wedging consensus
     the first time a block carries >= 4 blobs."""
-    if engine in ("device", "auto") and len(blobs) >= 4:
+    # "mesh" is device-class: the mesh plane shards the EDS pipeline,
+    # and its commitment batches take the same single-dispatch path
+    if engine in ("device", "auto", "mesh") and len(blobs) >= 4:
         try:
             from celestia_app_tpu.da import commitment_device
 

@@ -61,6 +61,7 @@ def _make_app(home: str):
         upgrade_height_delay=cfg.get("upgrade_height_delay"),
         da_scheme=cfg.get("da_scheme", "rs2d-nmt"),
         pack_keep=cfg.get("pack_keep", 4),
+        max_square_size=cfg.get("max_square_size"),
     )
     import weakref
 
@@ -396,6 +397,13 @@ def _write_config(home: str, chain_id: str, engine: str = "auto") -> None:
                 "app_version": 1,
                 "engine": engine,
                 "da_scheme": "rs2d-nmt",
+                # mesh plane (docs/FORMATS.md §18.1): max_square_size
+                # raises the CONSENSUS square cap to admit k=256/512
+                # (null = reference 128; every validator must match);
+                # produce_batch > 1 batch-extends that many planned
+                # blocks per device dispatch on the produce path
+                "max_square_size": None,
+                "produce_batch": 1,
                 # serving plane (das/packs.py): newest-N proof packs
                 # kept under <home>/packs (0 = keep all, null = off)
                 "pack_keep": 4,
@@ -487,10 +495,29 @@ def cmd_start(args) -> int:
     )
     snap_keep = cfg.get("snapshot_keep_recent", appconsts.SNAPSHOT_KEEP_RECENT)
     snap_root = os.path.join(args.home, "snapshots")
+    # mesh plane: produce_batch > 1 plans that many blocks from the
+    # mempool and batch-extends them in ONE device dispatch before the
+    # per-block rounds run (chain/producer.py; FORMATS §18.1). The
+    # planning+extend runs OUTSIDE the service lock — only the per-block
+    # consensus round holds it, exactly as with batching off.
+    produce_batch = max(1, int(cfg.get("produce_batch", 1)))
     produced = 0
     try:
         while args.blocks is None or produced < args.blocks:
             time.sleep(args.block_time)
+            # one plan+warm per BATCH WINDOW (planning B squares per
+            # produced block would multiply the greedy layout work by
+            # B); a mid-window mempool change just means a per-block
+            # extend for the affected heights
+            if produce_batch > 1 and produced % produce_batch == 0:
+                from celestia_app_tpu.chain import producer
+
+                try:
+                    plans = producer.plan_block_squares(
+                        app, node._reap(), produce_batch)
+                    producer.warm_block_batch(app, plans)
+                except Exception as e:
+                    print(f"produce prewarm failed: {e}", file=sys.stderr)
             with svc.lock:
                 blk, results = node.produce_block()
             produced += 1
@@ -1026,6 +1053,14 @@ def cmd_validator_serve(args) -> int:
         # serving plane: precompute static proof packs at warm time
         # (<home>/packs, newest-N kept; null = off)
         pack_keep=home_cfg.get("pack_keep", 4),
+        # mesh plane: the consensus-critical k=256/512 square-cap
+        # override — provisioned identically across the chain or absent
+        max_square_size=home_cfg.get("max_square_size"),
+        # validators default to engine=host (the relay-hang policy —
+        # _ensure_home_config writes "host"); a home explicitly
+        # provisioned with "mesh"/"device"/"auto" opts in, which is how
+        # a mesh validator (and its produce_batch prewarm) is deployed
+        engine=home_cfg.get("engine", "host"),
     )
     # fault plane (chaos provisioning): <home>/faults.json arms named
     # fault points for THIS process at startup — the config-file twin of
@@ -1112,6 +1147,11 @@ def cmd_validator_serve(args) -> int:
             if "snapshot_keep" not in cfg_doc and \
                     "snapshot_keep_recent" in home_cfg:
                 cfg_doc["snapshot_keep"] = home_cfg["snapshot_keep_recent"]
+            # mesh plane: the produce→commit batching knob rides the
+            # same home-config feed (an explicit reactor.json wins)
+            if "produce_batch" not in cfg_doc and \
+                    "produce_batch" in home_cfg:
+                cfg_doc["produce_batch"] = home_cfg["produce_batch"]
             cfg = ReactorConfig(**cfg_doc)
             svc.attach_reactor([u for u in peers if u !=
                                 f"http://127.0.0.1:{svc.port}"], cfg)

@@ -37,11 +37,26 @@ protocols; this module is that amortization:
   service/consensus lock, so the first light-client sample after a commit
   is pure index arithmetic instead of a rebuild + re-extend.
 
+- **Mesh engine + device residency (the mesh plane).** ``compute_entry``
+  gains a fourth engine: ``"mesh"`` dispatches through the sharded
+  shard_map pipeline (parallel/mesh_engine.py — k rows split over the
+  ``seq`` ICI axis, bit-identical to the single-device program), and the
+  auto/device engines route any square of ``k >= CELESTIA_MESH_MIN_K``
+  (default 256) there automatically. Mesh-built entries are
+  ``DeviceEntry``: the EDS and (once warmed) the NMT level arrays stay
+  on device, and host bytes materialize lazily — only when a proof or
+  serve path actually needs them — each materialization counting
+  ``edscache.host_crossings``. The produce path's batched dispatch
+  (chain/producer.py) inserts the same entry type, so an
+  extend→commit→prover-warm chain hands device arrays, not bytes,
+  between stages.
+
 Telemetry: ``da.extend_runs`` (every real pipeline dispatch),
 ``edscache.{hits,misses,evictions,seeded}``, ``edscache.warm_coalesced``
-(a pending warm superseded by a newer commit), ``edscache.warm_errors``.
-Wire/metric formats in docs/FORMATS.md §14; design in docs/DESIGN.md
-"The block plane".
+(a pending warm superseded by a newer commit), ``edscache.warm_errors``,
+``edscache.host_crossings`` (device-resident arrays materialized to
+host). Wire/metric formats in docs/FORMATS.md §14 and §18; design in
+docs/DESIGN.md "The block plane" and "The mesh plane".
 """
 
 from __future__ import annotations
@@ -61,6 +76,32 @@ from celestia_app_tpu.utils import telemetry
 # row+col level arrays once warmed, so the default stays small — the
 # lifecycle only ever needs the in-flight height plus a short serving tail
 DEFAULT_MAX_ENTRIES = int(os.environ.get("CELESTIA_EDSCACHE_ENTRIES", "4"))
+
+# the entry-count cap alone stops bounding memory once big squares are
+# admitted: a k=512 entry is ~512 MB of EDS before levels, so four of
+# them would silently pin >2 GB. The LRU is therefore ALSO bytes-aware:
+# entries are charged a conservative static estimate (EDS bytes x2 —
+# the x2 covers the row+col level arrays a warmed entry carries; see
+# entry_nbytes) against CELESTIA_EDSCACHE_BYTES, and eviction runs while
+# EITHER cap is exceeded. The newest entry is always retained even when
+# it alone exceeds the byte budget (the in-flight height must be
+# servable); at k <= 128 the default budget never binds, so historical
+# behavior is unchanged.
+DEFAULT_MAX_BYTES = int(os.environ.get("CELESTIA_EDSCACHE_BYTES",
+                                       str(1 << 30)))
+
+
+def entry_nbytes(entry) -> int:
+    """Conservative byte charge for one cached entry: (2k)^2 x 512 of
+    EDS, doubled for the per-orientation NMT level arrays a warmed entry
+    holds (leaf level alone is (2k)^2 x 90 per orientation; inner levels
+    add half that again). Entry types that know better (da/cmt.CmtEntry
+    and friends) can expose their own ``nbytes()``."""
+    own = getattr(entry, "nbytes", None)
+    if callable(own):
+        return int(own())
+    two_k = 2 * entry.k
+    return two_k * two_k * 512 * 2
 
 
 def cache_key(ods: np.ndarray, scheme: str = "rs2d-nmt") -> bytes:
@@ -103,7 +144,7 @@ class EdsCacheEntry:
     def __init__(self, eds: ExtendedDataSquare,
                  dah: DataAvailabilityHeader, data_root: bytes,
                  levels=None):
-        self.eds = eds
+        self._eds = eds
         self.dah = dah
         self.data_root = data_root
         # host-computed row NMT levels (utils/fast_host shape), carried
@@ -118,6 +159,19 @@ class EdsCacheEntry:
         self._prover = None  # guarded-by: _row_lock
         self._col_prover = None  # guarded-by: _col_lock
 
+    @property
+    def eds(self) -> ExtendedDataSquare:
+        """The host extended square. A plain attribute read here; the
+        device-resident subclass overrides this with a lazy,
+        crossing-counted materialization."""
+        return self._eds
+
+    def residency(self) -> str:
+        """Where the entry's square bytes live: "host" for the classic
+        entry; the device-resident subclass reports "device" until a
+        proof/serve path materializes, then "device+host"."""
+        return "host"
+
     def get_prover(self, engine: str = "auto"):
         """The row-axis BlockProver, built once (engine-gated)."""
         # the build-once lock EXISTS to serialize this first build (jit
@@ -129,24 +183,31 @@ class EdsCacheEntry:
                 )
             return self._prover
 
+    def _transposed_square(self):
+        """(eds_t, dah_t): the transposed square whose ROW trees are
+        this square's column trees — the leaf-namespace rule is
+        transpose-invariant (parity iff outside Q0 survives
+        (r,c)->(c,r)), so a col-axis prover is a row prover over this
+        pair. The ONE copy of the construction both col-prover builds
+        (base and device-resident) share."""
+        eds_t = ExtendedDataSquare(
+            np.ascontiguousarray(np.swapaxes(self.eds.squares, 0, 1))
+        )
+        dah_t = DataAvailabilityHeader(
+            row_roots=self.dah.col_roots,
+            col_roots=self.dah.row_roots,
+        )
+        return eds_t, dah_t
+
     def get_col_prover(self, engine: str = "auto"):
-        """Column-axis prover (BEFP escalation serving): the col trees of
-        a square ARE the row trees of its transpose — same leaf-namespace
-        rule (parity iff outside Q0 survives (r,c)->(c,r)), same batched
-        level pass, no per-cell hashing."""
+        """Column-axis prover (BEFP escalation serving): see
+        _transposed_square — same batched level pass, no per-cell
+        hashing."""
         # build-once serialization, same reasoning as get_prover
         with self._col_lock:  # lint: disable=blocking-under-lock
             if self._col_prover is None:
                 t0 = telemetry.start_timer()
-                eds_t = ExtendedDataSquare(
-                    np.ascontiguousarray(
-                        np.swapaxes(self.eds.squares, 0, 1)
-                    )
-                )
-                dah_t = DataAvailabilityHeader(
-                    row_roots=self.dah.col_roots,
-                    col_roots=self.dah.row_roots,
-                )
+                eds_t, dah_t = self._transposed_square()
                 self._col_prover = build_block_prover(eds_t, dah_t, engine)
                 telemetry.measure_since("das.col_tree_build", t0)
             return self._col_prover
@@ -169,6 +230,160 @@ class EdsCacheEntry:
             return row_ready and self._col_prover is not None
 
 
+class DeviceEntry(EdsCacheEntry):
+    """A mesh-plane entry whose big arrays live on device.
+
+    Construction hands over the device EDS (sharded over the mesh when
+    the sharded pipeline built it) plus the HOST commitments — axis
+    roots and data root are what every protocol phase compares, and at
+    4k x 90 B they are not worth keeping remote. Everything else obeys
+    the device-residency contract:
+
+    - ``warm()`` runs the row+col NMT *level* passes on device and keeps
+      the results there — the prover-warm stage of a batched produce
+      chain never touches the host.
+    - ``.eds`` / the provers materialize host bytes lazily, only when a
+      proof or serve path actually needs them; every device->host array
+      fetch counts ``edscache.host_crossings`` (the --mesh bench pins
+      this at 0/block on the warmed produce path).
+
+    Locking mirrors the base class's per-prover discipline: ONE lock
+    per lazily-built resource (host EDS, row levels, col levels), so a
+    sampler fetching the square never queues behind the warmer's
+    in-progress col-orientation level pass (a first-call jit compile).
+    Lock order: a prover lock (``_row_lock``/``_col_lock``, inherited)
+    may take a resource lock inside it; the resource locks never nest
+    with each other or with the prover locks, so no inversion is
+    possible."""
+
+    def __init__(self, eds_dev, dah: DataAvailabilityHeader,
+                 data_root: bytes):
+        super().__init__(None, dah, data_root)
+        self._eds_dev = eds_dev  # device (2k, 2k, 512), possibly sharded
+        # _eds (inherited) is the lazily-materialized host square;
+        # device-side NMT level stacks, row and col orientation
+        self._eds_lock = threading.Lock()
+        self._levels_lock = threading.Lock()
+        self._col_levels_lock = threading.Lock()
+        self._levels_dev = None  # guarded-by: _levels_lock
+        self._col_levels_dev = None  # guarded-by: _col_levels_lock
+
+    @property
+    def k(self) -> int:
+        # geometry from the device array's shape — never a host fetch
+        return int(self._eds_dev.shape[0]) // 2
+
+    def residency(self) -> str:
+        # deliberately lock-free: this is availability-record telemetry
+        # read per served response, and taking _eds_lock here would
+        # stall every note behind an in-progress (possibly hundreds of
+        # MB) materialization. The race is benign and one-directional:
+        # _eds only ever goes None -> set
+        return "device+host" if self._eds is not None else "device"
+
+    @staticmethod
+    def _crossing(what: str) -> None:
+        telemetry.incr("edscache.host_crossings")
+        telemetry.incr(f"edscache.host_crossings.{what}")
+
+    @property
+    def eds(self) -> ExtendedDataSquare:
+        """Host square bytes, materialized on first need (one counted
+        crossing; later reads are free)."""
+        with self._eds_lock:
+            if self._eds is None:
+                t0 = telemetry.start_timer()
+                self._eds = ExtendedDataSquare(np.asarray(self._eds_dev))
+                self._crossing("eds")
+                telemetry.measure_since("edscache.host_fetch", t0)
+            return self._eds
+
+    def _device_levels(self, col: bool):
+        """Device NMT levels for one orientation, computed (and kept)
+        on device at most once — the warm stage's unit of work. Each
+        orientation has its own build-once lock (same policy as
+        get_prover): concurrent warmers/provers pay one level pass (jit
+        compile included) between them — and ONLY between them, the
+        other orientation and the EDS fetch never queue here."""
+        return self._device_col_levels() if col else \
+            self._device_row_levels()
+
+    def _device_row_levels(self):
+        from celestia_app_tpu.da import proof_device
+
+        # build-once serialization (see _device_levels)
+        with self._levels_lock:  # lint: disable=blocking-under-lock
+            if self._levels_dev is None:
+                self._levels_dev = proof_device._jitted_row_levels(
+                    self.k)(self._eds_dev)
+            return self._levels_dev
+
+    def _device_col_levels(self):
+        import jax.numpy as jnp
+
+        from celestia_app_tpu.da import proof_device
+
+        # build-once serialization (see _device_levels)
+        with self._col_levels_lock:  # lint: disable=blocking-under-lock
+            if self._col_levels_dev is None:
+                arr = jnp.swapaxes(jnp.asarray(self._eds_dev), 0, 1)
+                self._col_levels_dev = proof_device._jitted_row_levels(
+                    self.k)(arr)
+            return self._col_levels_dev
+
+    def _host_levels(self, col: bool):
+        """Materialized level arrays for a prover build (one counted
+        crossing per orientation)."""
+        levels = self._device_levels(col)
+        t0 = telemetry.start_timer()
+        out = [(np.asarray(m), np.asarray(x), np.asarray(v))
+               for m, x, v in levels]
+        self._crossing("col_levels" if col else "levels")
+        telemetry.measure_since("edscache.host_fetch", t0)
+        return out
+
+    def warm(self, engine: str = "auto") -> None:
+        """Device-side warm: pre-run both orientations' level passes ON
+        DEVICE. Provers (which need host bytes for share payloads) stay
+        lazy — the first actual proof pays the materialization, counted;
+        a produce->commit->warm chain that nobody samples never crosses
+        the host boundary at all."""
+        self._device_levels(col=False)
+        self._device_levels(col=True)
+
+    def warmed(self) -> bool:
+        # fixed acquisition order (row, then col), same as the base
+        # class's warmed(): nothing nests these two the other way
+        with self._levels_lock:
+            row_ready = self._levels_dev is not None
+        with self._col_levels_lock:
+            return row_ready and self._col_levels_dev is not None
+
+    def get_prover(self, engine: str = "auto"):
+        with self._row_lock:  # lint: disable=blocking-under-lock
+            if self._prover is None:
+                from celestia_app_tpu.da import proof_device
+
+                self._prover = proof_device.BlockProver(
+                    self.eds, self.dah,
+                    levels=self._host_levels(col=False),
+                )
+            return self._prover
+
+    def get_col_prover(self, engine: str = "auto"):
+        with self._col_lock:  # lint: disable=blocking-under-lock
+            if self._col_prover is None:
+                from celestia_app_tpu.da import proof_device
+
+                t0 = telemetry.start_timer()
+                eds_t, dah_t = self._transposed_square()
+                self._col_prover = proof_device.BlockProver(
+                    eds_t, dah_t, levels=self._host_levels(col=True)
+                )
+                telemetry.measure_since("das.col_tree_build", t0)
+            return self._col_prover
+
+
 def compute_entry(ods: np.ndarray, engine: str = "auto",
                   scheme: str = "rs2d-nmt"):
     """THE encode+commit dispatch: ODS -> scheme entry, engine-gated.
@@ -176,22 +391,49 @@ def compute_entry(ods: np.ndarray, engine: str = "auto",
     ``engine="device"`` requires the jax path (raises on failure),
     ``"host"`` never touches jax (the relay-down hang class: a down
     accelerator relay HANGS backend init, wedging whatever lock the
-    caller holds), ``"auto"`` tries device and degrades loudly. Every
-    call is one real encode dispatch and counts ``da.extend_runs`` —
-    the telemetry pin tests assert at most one per (node, height),
-    whichever scheme the chain runs. The default scheme's body below is
-    the pre-codec-plane pipeline, untouched (byte-identity pinned in
-    tests/test_codec_iface.py); other schemes dispatch through the
-    codec registry's raw encode hook (da/codec.py) — an unknown scheme
-    raises BEFORE the counter moves (no phantom extend_runs)."""
+    caller holds), ``"auto"`` tries device and degrades loudly,
+    ``"mesh"`` prefers the sharded multi-device pipeline
+    (parallel/mesh_engine.py; returns a device-resident ``DeviceEntry``)
+    whenever the square can shard, and is device-class otherwise — an
+    unshardable square (the k=1 empty block) or a mesh failure takes the
+    single-device jax path, never the host fallback; under auto/device,
+    squares of ``k >= CELESTIA_MESH_MIN_K`` (default 256) take the mesh
+    automatically when one exists, degrading to the single-device path
+    on failure (counted). All four engines are pinned bit-identical.
+    Every call is one real encode dispatch and counts ``da.extend_runs``
+    — the telemetry pin tests assert at most one per (node, height),
+    whichever scheme the chain runs. The default scheme's single-device
+    body below is the pre-codec-plane pipeline, untouched (byte-identity
+    pinned in tests/test_codec_iface.py); other schemes dispatch through
+    the codec registry's raw encode hook (da/codec.py) — an unknown
+    scheme raises BEFORE the counter moves (no phantom extend_runs), and
+    "mesh" maps to "auto" for them (the sharded program is the default
+    codec's)."""
     if scheme != "rs2d-nmt":
         from celestia_app_tpu.da import codec as codec_mod
 
         codec = codec_mod.get(scheme)  # CodecError on unknown schemes
         telemetry.incr("da.extend_runs")
-        return codec._encode_impl(ods, engine)
+        return codec._encode_impl(
+            ods, "auto" if engine == "mesh" else engine
+        )
     telemetry.incr("da.extend_runs")
-    if engine in ("device", "auto"):
+    if engine in ("mesh", "device", "auto"):
+        from celestia_app_tpu.parallel import mesh_engine
+
+        k = int(ods.shape[0])
+        if (engine == "mesh" and mesh_engine.mesh_for(k) is not None) \
+                or (engine != "mesh" and mesh_engine.mesh_active_for(k)):
+            try:
+                return mesh_engine.compute_entry_mesh(ods)
+            except Exception:
+                # the single-device program computes the identical
+                # bytes — degrade loudly and continue below. "mesh" is
+                # device-class: an unshardable square (k=1 empty block)
+                # or a mesh failure takes the single-device jax path,
+                # and only a jax failure there raises.
+                telemetry.incr("mesh.engine_fallbacks")
+    if engine in ("device", "auto", "mesh"):
         try:
             import jax.numpy as jnp
 
@@ -209,7 +451,7 @@ def compute_entry(ods: np.ndarray, engine: str = "auto",
                 bytes(np.asarray(root)),
             )
         except Exception:
-            if engine == "device":
+            if engine in ("device", "mesh"):
                 raise
             # engine=auto: count the silent degrade — a node that
             # quietly lost its accelerator should show it in /metrics
@@ -217,10 +459,19 @@ def compute_entry(ods: np.ndarray, engine: str = "auto",
     # host path: BLAS+hashlib (utils/fast_host), bit-equal to the device
     # path and the refimpl oracle. The row levels come out of the same
     # pass that yields the row roots, so they ride the entry for free —
-    # a later prover build on this entry is pure reshaping.
+    # a later prover build on this entry is pure reshaping. Big squares
+    # (k >= 256, the GF(2^16) code fast_host's BLAS formulation does not
+    # cover) take Leopard's quasilinear host FFT encoder instead — the
+    # NMT/level passes below are field-agnostic — so a host-engine
+    # validator can follow a k=256/512 mesh chain.
+    from celestia_app_tpu.ops import leopard
+    from celestia_app_tpu.ops import rs as rs_ops
     from celestia_app_tpu.utils import fast_host, merkle_host
 
-    eds_arr = fast_host.extend_square_fast(ods)
+    if leopard.uses_gf16(ods.shape[0]):
+        eds_arr = rs_ops.extend_square_np(ods)
+    else:
+        eds_arr = fast_host.extend_square_fast(ods)
     k = eds_arr.shape[0] // 2
     levels = fast_host.nmt_levels_fast(
         fast_host._axis_leaf_ns(eds_arr, k), eds_arr
@@ -249,16 +500,19 @@ def build_block_prover(eds: ExtendedDataSquare,
     chain/query.build_prover and das/server._build_prover used to
     duplicate (they must stay bit-identical; now they are by
     construction). Precomputed host ``levels`` win regardless of engine
-    (they are byte-identical to the jitted pass and already paid for)."""
+    (they are byte-identical to the jitted pass and already paid for).
+    ``engine="mesh"`` is device-class here: prover level passes are a
+    single-dispatch program either way (DeviceEntry overrides its own
+    prover builds to reuse on-mesh levels before this is reached)."""
     from celestia_app_tpu.da import proof_device
 
     if levels is not None:
         return proof_device.BlockProver(eds, dah, levels=levels)
-    if engine in ("device", "auto"):
+    if engine in ("device", "auto", "mesh"):
         try:
             return proof_device.BlockProver(eds, dah)  # jitted level pass
         except Exception:
-            if engine == "device":
+            if engine in ("device", "mesh"):
                 raise
             telemetry.incr("app.device_path_fallback")
     from celestia_app_tpu.utils import fast_host
@@ -279,13 +533,17 @@ class EdsCache:
     root is itself a pure function of the ODS bytes the key hashes: two
     different squares cannot share a root without a sha256 collision."""
 
-    def __init__(self, max_entries: int | None = None):
+    def __init__(self, max_entries: int | None = None,
+                 max_bytes: int | None = None):
         self.max_entries = (DEFAULT_MAX_ENTRIES if max_entries is None
                             else max_entries)
+        self.max_bytes = (DEFAULT_MAX_BYTES if max_bytes is None
+                          else max_bytes)
         self._lock = threading.Lock()
         self._entries: collections.OrderedDict[bytes, EdsCacheEntry] = \
             collections.OrderedDict()  # guarded-by: _lock
         self._by_root: dict[bytes, bytes] = {}  # guarded-by: _lock
+        self._nbytes = 0  # charged-byte total  # guarded-by: _lock
 
     def get(self, key: bytes) -> EdsCacheEntry | None:
         with self._lock:
@@ -306,11 +564,18 @@ class EdsCache:
             if kept is None:
                 self._entries[key] = entry
                 self._by_root[entry.data_root] = key
+                self._nbytes += entry_nbytes(entry)
                 kept = entry
             self._entries.move_to_end(key)
-            while len(self._entries) > self.max_entries:
+            # evict while EITHER cap is exceeded — but always retain the
+            # newest entry (the in-flight height must stay servable even
+            # when a single big-square entry exceeds the byte budget)
+            while len(self._entries) > 1 and (
+                    len(self._entries) > self.max_entries
+                    or self._nbytes > self.max_bytes):
                 _, old = self._entries.popitem(last=False)
                 self._by_root.pop(old.data_root, None)
+                self._nbytes -= entry_nbytes(old)
                 telemetry.incr("edscache.evictions")
             return kept
 
@@ -339,6 +604,13 @@ class EdsCache:
         with self._lock:
             self._entries.clear()
             self._by_root.clear()
+            self._nbytes = 0
+
+    def nbytes(self) -> int:
+        """Charged-byte total of resident entries (static estimates —
+        see entry_nbytes)."""
+        with self._lock:
+            return self._nbytes
 
     def __len__(self) -> int:
         with self._lock:

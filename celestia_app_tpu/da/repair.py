@@ -32,6 +32,17 @@ sweep, tests/test_repair.py):
   (ops/rs.repair_axis) plus a host NmtTree per axis — kept as the
   independent differential reference.
 
+Mesh sharding (the mesh plane, PR 13): when
+`parallel/mesh_engine.mesh_active_for(k)` holds — k at or above
+CELESTIA_MESH_MIN_K with two or more devices — the batched engine's two
+device programs run sharded over the flat device list: the per-pattern
+fused decode matmul (ops/rs._RepairAxesRunner) and the per-sweep NMT
+root reduction (ops/nmt.eds_axis_roots) both split their pow2-padded
+batch dimension across devices before dispatch. The programs themselves
+are untouched (jit partitions by input sharding), so mesh-sharded and
+single-device sweeps are bit-identical by construction — k=256/512
+repair is the same crossword, spread over the ICI.
+
 Byzantine detection: when the input shares are AUTHENTIC (each proven
 against the DAH before being fed here — the caller's job, as in DAS), a
 root mismatch on a repaired or fully-present axis means the block

@@ -597,6 +597,10 @@ class SampleCore:
                 "height": height,
                 "data_root": data_root,
                 "square_width": width,
+                # mesh plane: where the height's square bytes live
+                # ("device" until a proof materializes them — see
+                # da/edscache.DeviceEntry; "host" for classic entries)
+                "residency": None,
                 **{k: 0 for k in self._RECORD_ZEROS},
             }
         elif rec["data_root"] is None and data_root is not None:
@@ -609,9 +613,12 @@ class SampleCore:
     def _note(self, entry: _Entry, served: int = 0, batches: int = 0,
               withheld: int = 0, col_proofs: int = 0,
               live: int = 0) -> None:
+        residency = entry.cache_entry.residency() \
+            if hasattr(entry.cache_entry, "residency") else "host"
         with self._lock:
             rec = self._record_locked(entry.height, entry.root.hex(),
                                       entry.width)
+            rec["residency"] = residency
             rec["samples_served"] += served
             rec["batches"] += batches
             rec["withheld_refusals"] += withheld
@@ -640,7 +647,7 @@ class SampleCore:
         # never-served height: the same record shape with null identity
         # fields (FORMATS.md §7.1) so clients can read one schema
         return {"height": height, "data_root": None, "square_width": None,
-                **{k: 0 for k in self._RECORD_ZEROS}}
+                "residency": None, **{k: 0 for k in self._RECORD_ZEROS}}
 
 
 # -- one router shared by every transport -----------------------------------

@@ -195,8 +195,19 @@ def eds_axis_roots(slabs: np.ndarray, indices, k: int) -> np.ndarray:
         )
     idx = np.full(bucket, k, dtype=np.int32)
     idx[:n] = np.asarray(indices, dtype=np.int32)
-    out = jitted_eds_axis_roots(k, bucket)(jnp.asarray(slabs),
-                                           jnp.asarray(idx))
+    # mesh plane: split the padded tree batch over the flat device list
+    # when active for this square size — the level-synchronous reduction
+    # is per-tree, so jit partitions it cleanly by input sharding and
+    # the roots come back bit-identical (tests/test_mesh_plane.py)
+    from celestia_app_tpu.parallel import mesh_engine
+
+    slabs_dev = mesh_engine.maybe_shard_batch(slabs, k)
+    idx_dev = mesh_engine.maybe_shard_batch(idx, k)
+    if slabs_dev is slabs:
+        slabs_dev = jnp.asarray(slabs)
+    if idx_dev is idx:
+        idx_dev = jnp.asarray(idx)
+    out = jitted_eds_axis_roots(k, bucket)(slabs_dev, idx_dev)
     out = np.asarray(out)[:n]
     _EXEC_BUCKETS.add((k, bucket))
     return out

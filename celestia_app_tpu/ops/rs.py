@@ -268,12 +268,13 @@ class _RepairAxesRunner:
     Build-free consumers gate on `compiled_for(n)`: a cached closure that
     has never run this batch bucket would still pay a full XLA compile."""
 
-    __slots__ = ("_run", "_buckets", "_lock")
+    __slots__ = ("_run", "_buckets", "_lock", "_k")
 
-    def __init__(self, run):
+    def __init__(self, run, k: int = 0):
         self._run = run
         self._buckets: set[int] = set()
         self._lock = threading.Lock()
+        self._k = k  # square size, for the mesh plane's sharding gate
 
     def compiled_for(self, n: int) -> bool:
         with self._lock:
@@ -288,7 +289,19 @@ class _RepairAxesRunner:
                 batch,
                 np.zeros((bucket - n, *batch.shape[1:]), dtype=batch.dtype),
             ])
-        out = np.asarray(self._run(jnp.asarray(batch)))[:n]
+        # mesh plane: when active for this square size, split the padded
+        # batch over the flat device list BEFORE dispatch — the jitted
+        # fused-decode matmul partitions by input sharding, so the
+        # repair sweep runs mesh-sharded with identical bytes (the pow2
+        # bucket discipline already makes shard extents shape-static)
+        dev_batch = batch
+        if self._k:
+            from celestia_app_tpu.parallel import mesh_engine
+
+            dev_batch = mesh_engine.maybe_shard_batch(batch, self._k)
+        if dev_batch is batch:
+            dev_batch = jnp.asarray(batch)
+        out = np.asarray(self._run(dev_batch))[:n]
         with self._lock:
             self._buckets.add(bucket)
         return out
@@ -383,7 +396,7 @@ def repair_axes_fn(k: int, present: tuple[int, ...]):
         x = symbols_batch[:, list(use), :]
         return from_bits(_gf_mix(bitmat, to_bits(x))).astype(jnp.uint8)
 
-    runner = _RepairAxesRunner(run)
+    runner = _RepairAxesRunner(run, k=k)
     with _AXES_FN_LOCK:
         _AXES_FN_CACHE[key] = runner
         while len(_AXES_FN_CACHE) > _AXES_FN_MAXSIZE:

@@ -143,13 +143,18 @@ def _local_pipeline(k: int, n_seq: int):
         # 5. Transpose back to row-sharding for the row trees: split the 2k
         #    rows (axis 2) across devices, gather all columns on axis 1 —
         #    shares for the EDS output, digests for the row-tree leaves.
-        rows_back = lax.all_to_all(
-            eds_cols, SEQ_AXIS, split_axis=2, concat_axis=1, tiled=True
-        )  # (B_l, 2k cols in global order, 2k/n owned rows, S)
+        #    Shares and digests agree on the leading (B_l, 2k/n cols, 2k
+        #    rows) geometry, so they ride ONE all-to-all packed along the
+        #    byte axis (one collective instead of two; also dodges an XLA
+        #    CPU all-to-all combiner bug that mis-matches the two
+        #    operands' layouts at small seq extents).
+        packed = jnp.concatenate([eds_cols, col_vs], axis=3)
+        packed_back = lax.all_to_all(
+            packed, SEQ_AXIS, split_axis=2, concat_axis=1, tiled=True
+        )  # (B_l, 2k cols in global order, 2k/n owned rows, S+32)
+        rows_back = packed_back[..., :SHARE]
+        vs_back = packed_back[..., SHARE:]
         eds_rows = jnp.swapaxes(rows_back, 1, 2)  # (B_l, 2k/n, 2k, S)
-        vs_back = lax.all_to_all(
-            col_vs, SEQ_AXIS, split_axis=2, concat_axis=1, tiled=True
-        )  # (B_l, 2k cols, 2k/n owned rows, 32)
         row_vs = jnp.swapaxes(vs_back, 1, 2)  # (B_l, 2k/n, 2k, 32)
 
         # 6. Row NMT roots for owned rows: namespaces recomputed locally

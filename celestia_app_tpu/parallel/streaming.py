@@ -18,6 +18,32 @@ import time
 import numpy as np
 
 from celestia_app_tpu.da import eds as eds_mod
+from celestia_app_tpu.utils import telemetry
+
+
+def _fetch_pending(pending):
+    """Resolve one in-flight dispatch: ONE ``jax.device_get`` on the
+    whole output tuple (a single transfer sync instead of per-leaf
+    ``np.asarray`` round-trips), wall-clock routed through the telemetry
+    timer so the overlap win is observable in /metrics, not just
+    benchable. A fetch that arrives before the device finished counts
+    ``streaming.overlap_stalls`` — the host outran the device, so the
+    pipeline is device-bound there."""
+    import jax
+
+    ready = getattr(pending[3], "is_ready", None)
+    if ready is not None:
+        try:
+            if not ready():
+                telemetry.incr("streaming.overlap_stalls")
+        except Exception:
+            # relay backends may not implement readiness probes; the
+            # stall counter just degrades to "unknown" there
+            telemetry.incr("streaming.readiness_unsupported")
+    t0 = telemetry.start_timer()
+    out = jax.device_get(pending)
+    telemetry.measure_since("streaming.fetch", t0)
+    return out
 
 
 def stream_blocks(layout_fn, n_blocks: int, k: int, *, pipeline=None):
@@ -26,7 +52,9 @@ def stream_blocks(layout_fn, n_blocks: int, k: int, *, pipeline=None):
     ``layout_fn(i) -> (k, k, 512) uint8 ODS`` is the HOST work (square
     layout); the device computes block i while the host lays out block i+1.
     Returns the list of 32-byte data roots, in order.
-    """
+    ``streaming.blocks_in_flight`` gauges the pipeline depth (1 while a
+    dispatch is outstanding); see `_fetch_pending` for the fetch-side
+    counters."""
     import jax
 
     if n_blocks <= 0:
@@ -37,10 +65,12 @@ def stream_blocks(layout_fn, n_blocks: int, k: int, *, pipeline=None):
     for i in range(n_blocks):
         ods = layout_fn(i)  # host: lay out block i
         out = run(jax.device_put(ods))  # device: async dispatch
+        telemetry.gauge("streaming.blocks_in_flight", 1)
         if pending is not None:
-            roots.append(bytes(np.asarray(pending[3])))  # block on i-1
+            roots.append(bytes(_fetch_pending(pending)[3]))  # block on i-1
         pending = out
-    roots.append(bytes(np.asarray(pending[3])))
+    roots.append(bytes(_fetch_pending(pending)[3]))
+    telemetry.gauge("streaming.blocks_in_flight", 0)
     return roots
 
 
@@ -65,10 +95,12 @@ def _stream_batches(layout_fn, n_batches: int, run) -> list[bytes]:
     for i in range(n_batches):
         batch = layout_fn(i)  # host: lay out batch i
         out = run(batch)  # device/mesh: async dispatch
+        telemetry.gauge("streaming.blocks_in_flight", batch.shape[0])
         if pending is not None:
-            roots.extend(bytes(r) for r in np.asarray(pending[3]))
+            roots.extend(bytes(r) for r in _fetch_pending(pending)[3])
         pending = out
-    roots.extend(bytes(r) for r in np.asarray(pending[3]))
+    roots.extend(bytes(r) for r in _fetch_pending(pending)[3])
+    telemetry.gauge("streaming.blocks_in_flight", 0)
     return roots
 
 

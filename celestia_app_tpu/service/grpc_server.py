@@ -381,7 +381,7 @@ class GrpcTxServer:
 
             da_core = DACore(
                 engine="device" if getattr(node.app, "engine", "host")
-                == "device" else "host"
+                in ("device", "mesh") else "host"
             )
         self.da = DAGrpcService(da_core)
         q = self.queries

@@ -57,7 +57,7 @@ class NodeService:
 
         self.da_core = DACore(
             engine="device" if getattr(node.app, "engine", "host")
-            == "device" else "host"
+            in ("device", "mesh") else "host"
         )
         # the DAS sample-serving plane (das/server.py): committed blocks
         # answered cell-by-cell with NMT proofs from cached row trees.

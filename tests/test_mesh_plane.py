@@ -1,0 +1,505 @@
+"""Mesh plane (ISSUE 13): sharded production lifecycle, device-resident
+entries, batched produce.
+
+Runs on the 8-virtual-device CPU mesh (conftest sets
+``XLA_FLAGS=--xla_force_host_platform_device_count=8``). The contract
+under test: the mesh engine is the PRODUCTION dispatch — bit-identical
+to the single-device/host engines at every co-supported size (entries,
+DAH roots, data roots, row+col cell proofs), device-resident until a
+proof/serve path actually needs host bytes (pinned by the
+``edscache.host_crossings`` counter), and the batched produce path
+commits the exact block/app hashes of per-block production.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.da import edscache
+from celestia_app_tpu.utils import telemetry
+
+
+def _random_ods(k: int, seed: int) -> np.ndarray:
+    ods = np.random.default_rng(seed).integers(
+        0, 256, size=(k, k, 512), dtype=np.uint8)
+    ods[:, :, 0] = 0
+    ods[:, :, 1:19] = 0
+    return ods
+
+
+def _counter(name: str) -> int:
+    return telemetry.snapshot().get("counters", {}).get(name, 0)
+
+
+def _assert_proofs_equal(a, b):
+    sa, pa = a
+    sb, pb = b
+    assert sa == sb
+    assert (pa.start, pa.end, pa.total) == (pb.start, pb.end, pb.total)
+    assert pa.nodes == pb.nodes
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: mesh entry == host/single-device entry
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("k", [8, 32])
+def test_mesh_entry_bit_identical_to_host(k):
+    """Sharded compute_entry == host compute_entry byte for byte:
+    EDS, row/col roots, data root, and row+col cell proofs."""
+    ods = _random_ods(k, 1000 + k)
+    host = edscache.compute_entry(ods, "host")
+    mesh = edscache.compute_entry(ods, "mesh")
+    assert isinstance(mesh, edscache.DeviceEntry)
+
+    assert mesh.data_root == host.data_root
+    assert mesh.dah.row_roots == host.dah.row_roots
+    assert mesh.dah.col_roots == host.dah.col_roots
+    assert mesh.k == host.k == k
+    np.testing.assert_array_equal(mesh.eds.squares, host.eds.squares)
+
+    ph, pm = host.get_prover("host"), mesh.get_prover()
+    ch, cm = host.get_col_prover("host"), mesh.get_col_prover()
+    rng = np.random.default_rng(k)
+    for _ in range(4):
+        r, c = (int(x) for x in rng.integers(0, 2 * k, size=2))
+        _assert_proofs_equal(ph.prove_cell(r, c), pm.prove_cell(r, c))
+        # col-axis proof: cell (r, c) at (c, r) of the transpose
+        _assert_proofs_equal(ch.prove_cell(c, r), cm.prove_cell(c, r))
+
+
+def test_mesh_engine_via_auto_routing(monkeypatch):
+    """Under engine="auto", squares at/above CELESTIA_MESH_MIN_K route
+    through the mesh and come back device-resident; below it they take
+    the classic single-device path."""
+    monkeypatch.setenv("CELESTIA_MESH_MIN_K", "16")
+    big = edscache.compute_entry(_random_ods(16, 7), "auto")
+    small = edscache.compute_entry(_random_ods(8, 7), "auto")
+    assert isinstance(big, edscache.DeviceEntry)
+    assert not isinstance(small, edscache.DeviceEntry)
+
+
+def test_mesh_engine_unshardable_square_degrades():
+    """engine="mesh" is device-class for the k=1 empty block (nothing
+    to shard): it must produce the classic entry, not raise — a mesh
+    validator committing an empty height stays alive."""
+    from celestia_app_tpu.da import dah as dah_mod
+    from celestia_app_tpu.da import square as square_mod
+
+    ods = dah_mod.shares_to_ods(square_mod.empty_square().share_bytes())
+    entry = edscache.compute_entry(ods, "mesh")
+    host = edscache.compute_entry(ods, "host")
+    assert entry.data_root == host.data_root
+
+
+# ---------------------------------------------------------------------------
+# device residency: host crossings only when a proof/serve path needs bytes
+# ---------------------------------------------------------------------------
+
+
+def test_device_residency_and_host_crossings():
+    """The extend->commit->warm chain never crosses the host boundary;
+    the first proof materializes (counted), later proofs are free."""
+    k = 8
+    entry = edscache.compute_entry(_random_ods(k, 42), "mesh")
+    assert entry.residency() == "device"
+
+    c0 = _counter("edscache.host_crossings")
+    # what the lifecycle reads at Prepare/Process/commit: commitments
+    assert len(entry.dah.row_roots) == 2 * k
+    assert len(entry.data_root) == 32
+    # the warmer's per-scheme hook: device-side level passes only
+    entry.warm()
+    assert entry.warmed()
+    assert _counter("edscache.host_crossings") == c0
+    assert entry.residency() == "device"
+
+    # first proof: EDS + row levels materialize (2 counted crossings)
+    entry.get_prover().prove_cell(0, 0)
+    after_first = _counter("edscache.host_crossings")
+    assert after_first > c0
+    assert entry.residency() == "device+host"
+    # steady state: pure index arithmetic, zero further crossings
+    entry.get_prover().prove_cell(1, 3)
+    entry.get_prover().prove_cell(2 * k - 1, 2 * k - 1)
+    assert _counter("edscache.host_crossings") == after_first
+
+
+def test_device_entry_serves_das_with_crossings_pinned():
+    """A seeded device-resident entry serves /das/* — the first sample
+    pays the (counted) materialization, every later sample has a
+    host_crossings delta of exactly 0."""
+    from celestia_app_tpu.chain.app import App
+    from celestia_app_tpu.das.server import SampleCore
+
+    k = 8
+    app = App(chain_id="mesh-serve")
+    app.init_chain({"time_unix": 0})
+    core = SampleCore(app)
+    entry = edscache.compute_entry(_random_ods(k, 99), "mesh")
+    entry.warm()
+    core.seed_cache_entry(5, entry)
+
+    host = edscache.compute_entry(_random_ods(k, 99), "host")
+    # first proof per orientation pays the (counted) materialization
+    first = core.sample(5, 0, 0)
+    first_col = core.sample(5, 7, 1, axis="col")
+    c0 = _counter("edscache.host_crossings")
+    again = core.sample(5, 3, 4)
+    col = core.sample(5, 2, 6, axis="col")
+    assert _counter("edscache.host_crossings") == c0, \
+        "a warmed device entry must serve later samples crossing-free"
+    # and the served docs equal the host engine's byte for byte
+    core_h = SampleCore(app)
+    core_h.seed_cache_entry(5, host)
+    assert first == core_h.sample(5, 0, 0)
+    assert first_col == core_h.sample(5, 7, 1, axis="col")
+    assert again == core_h.sample(5, 3, 4)
+    assert col == core_h.sample(5, 2, 6, axis="col")
+    # the availability record surfaces the residency
+    assert core.availability(5)["residency"] == "device+host"
+
+
+# ---------------------------------------------------------------------------
+# batched produce: same hashes as per-block, extends paid in the batch
+# ---------------------------------------------------------------------------
+
+
+def _funded_pair(chain_id: str, n: int = 4):
+    from celestia_app_tpu.chain.app import App
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.client.tx_client import Signer
+
+    privs = [PrivateKey.from_seed(b"mesh-%d" % i) for i in range(n)]
+    addrs = [p.public_key().address() for p in privs]
+    app = App(chain_id=chain_id, engine="auto")
+    app.init_chain({
+        "time_unix": 1_700_000_000.0,
+        "accounts": [{"address": a.hex(), "balance": 10**12}
+                     for a in addrs],
+        "validators": [{"operator": addrs[0].hex(), "power": 10}],
+        # a small gov cap so a handful of txs spans several blocks and
+        # the batch planner actually plans >1 square
+        "gov_max_square_size": 2,
+    })
+    node = Node(app)
+    signer = Signer(chain_id)
+    for i, p in enumerate(privs):
+        signer.add_account(p, number=i)
+    return app, node, signer, addrs
+
+
+def _submit_sends(node, signer, addrs, rounds: int):
+    from celestia_app_tpu.chain.tx import MsgSend
+
+    for _ in range(rounds):
+        for i, a in enumerate(addrs):
+            tx = signer.create_tx(
+                a, [MsgSend(a, addrs[(i + 1) % len(addrs)], 1)],
+                fee=2000, gas_limit=100_000,
+            )
+            signer.accounts[a].sequence += 1
+            node.broadcast_tx(tx.encode())
+
+
+def test_batched_produce_commits_identical_hashes():
+    """produce_blocks_batched == per-block produce_block: identical
+    block hashes and app hashes at every height; the batch pays the
+    extends (one per height, inside the batched dispatch) and the
+    per-block rounds hit the cache."""
+    app_a, node_a, signer_a, addrs_a = _funded_pair("mesh-batch-eq")
+    app_b, node_b, signer_b, addrs_b = _funded_pair("mesh-batch-eq")
+    _submit_sends(node_a, signer_a, addrs_a, rounds=4)
+    _submit_sends(node_b, signer_b, addrs_b, rounds=4)
+
+    d0 = _counter("mesh.batched_dispatches")
+    m0 = _counter("producer.plan_misses")
+    out_a = node_a.produce_blocks_batched(3, t=1_700_000_100.0)
+    assert _counter("mesh.batched_dispatches") > d0
+    assert _counter("producer.plan_misses") == m0, \
+        "every planned square must be hit by its produce round"
+
+    blocks_b = [node_b.produce_block(t=1_700_000_100.0 + i)
+                for i in range(3)]
+    assert len(out_a) == 3
+    for (blk_a, _), (blk_b, _) in zip(out_a, blocks_b):
+        assert blk_a.header.hash() == blk_b.header.hash()
+        assert blk_a.header.data_hash == blk_b.header.data_hash
+        assert blk_a.txs == blk_b.txs
+    assert app_a.last_app_hash == app_b.last_app_hash
+
+
+def test_prewarm_proposals_is_pure_prefetch():
+    """ValidatorNode.prewarm_proposals (the reactor produce_batch knob)
+    warms the cache without changing any consensus bytes."""
+    from celestia_app_tpu.chain import consensus as c
+    from celestia_app_tpu.chain.crypto import PrivateKey
+
+    priv = PrivateKey.from_seed(b"mesh-prewarm")
+    genesis = {
+        "time_unix": 0,
+        "accounts": [{"address":
+                      priv.public_key().address().hex(),
+                      "balance": 10**12}],
+        "validators": [{"operator":
+                        priv.public_key().address().hex(), "power": 1}],
+    }
+    a = c.ValidatorNode("a", priv, genesis, "mesh-prewarm")
+    b = c.ValidatorNode("b", priv, genesis, "mesh-prewarm")
+    a.prewarm_proposals(2)  # empty mempool: plans nothing, must not blow
+    blk_a = a.propose(t=1.0)
+    blk_b = b.propose(t=1.0)
+    assert blk_a.header.hash() == blk_b.header.hash()
+
+
+# ---------------------------------------------------------------------------
+# e2e: a mesh-engine chain through Prepare/Process/commit/serve
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_engine_chain_matches_host_chain():
+    """Two chains over the same txs — engine="mesh" vs engine="host" —
+    commit identical headers, and their served samples are
+    byte-identical. This is the end-to-end PrepareProposal /
+    ProcessProposal / serve pin at a CI-affordable size."""
+    from celestia_app_tpu.chain.app import App
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.client.tx_client import Signer
+    from celestia_app_tpu.chain.tx import MsgSend
+    from celestia_app_tpu.das.server import SampleCore
+
+    def chain(engine):
+        priv = PrivateKey.from_seed(b"mesh-e2e")
+        addr = priv.public_key().address()
+        app = App(chain_id="mesh-e2e", engine=engine)
+        app.init_chain({
+            "time_unix": 1_700_000_000.0,
+            "accounts": [{"address": addr.hex(), "balance": 10**12}],
+            "validators": [{"operator": addr.hex(), "power": 1}],
+        })
+        node = Node(app)
+        # attach BEFORE committing: in-memory nodes serve from the
+        # commit warmer's seed (no block store to rebuild from)
+        core = node.attach_das_core(SampleCore(app))
+        signer = Signer("mesh-e2e")
+        signer.add_account(priv, number=0)
+        tx = signer.create_tx(addr, [MsgSend(addr, addr, 1)],
+                              fee=2000, gas_limit=100_000)
+        node.broadcast_tx(tx.encode())
+        blk, _ = node.produce_block(t=1_700_000_001.0)
+        app.da_warmer.wait_idle(30)
+        return app, core, blk
+
+    app_m, core_m, blk_m = chain("mesh")
+    app_h, core_h, blk_h = chain("host")
+    assert blk_m.header.hash() == blk_h.header.hash()
+    assert app_m.last_app_hash == app_h.last_app_hash
+    assert core_m.sample(1, 0, 0) == core_h.sample(1, 0, 0)
+    assert core_m.sample(1, 1, 1, axis="col") == \
+        core_h.sample(1, 1, 1, axis="col")
+
+
+# ---------------------------------------------------------------------------
+# mesh-sharded repair + prover ops stay bit-identical
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_sharded_ops_bit_identical(monkeypatch):
+    """With the mesh active (min_k lowered), the repair sweep's two
+    device programs — the fused decode matmul and the batched NMT root
+    reduction — run with their batch dimension sharded over the device
+    list, and a full 2D repair equals the scalar engine byte for byte."""
+    from celestia_app_tpu.da import repair as repair_mod
+    from celestia_app_tpu.ops import nmt as nmt_ops
+
+    k = 8
+    entry = edscache.compute_entry(_random_ods(k, 321), "host")
+    eds = entry.eds.squares
+
+    # batched NMT roots, sharded vs not: identical bytes
+    slabs = np.stack([eds[i] for i in range(2 * k)])
+    idx = list(range(2 * k))
+    plain = nmt_ops.eds_axis_roots(slabs, idx, k)
+    monkeypatch.setenv("CELESTIA_MESH_MIN_K", "4")
+    s0 = _counter("mesh.batch_shards")
+    sharded = nmt_ops.eds_axis_roots(slabs, idx, k)
+    assert _counter("mesh.batch_shards") > s0, "batch must have sharded"
+    np.testing.assert_array_equal(plain, sharded)
+
+    # whole-columns erasure: one shared pattern, mesh-sharded decode
+    present = np.ones((2 * k, 2 * k), dtype=bool)
+    present[:, k + 2:2 * k] = False  # k-2 columns lost
+    garbled = eds.copy()
+    garbled[~present] = 0
+    row_roots = [bytes(r) for r in entry.dah.row_roots]
+    col_roots = [bytes(c) for c in entry.dah.col_roots]
+    fixed = repair_mod.repair_eds(garbled, present, row_roots, col_roots,
+                                  engine="batched")
+    monkeypatch.delenv("CELESTIA_MESH_MIN_K")
+    fixed_scalar = repair_mod.repair_eds(garbled, present, row_roots,
+                                         col_roots, engine="scalar")
+    np.testing.assert_array_equal(fixed, fixed_scalar)
+    np.testing.assert_array_equal(fixed, eds)
+
+
+# ---------------------------------------------------------------------------
+# square-cap plumbing: k=256/512 admitted end to end
+# ---------------------------------------------------------------------------
+
+
+def test_max_square_size_plumbing():
+    """The consensus cap override admits k=256/512 layouts (gov param
+    still gates below it); invalid overrides are refused loudly."""
+    from celestia_app_tpu.chain.app import App
+    from celestia_app_tpu.chain.state import InfiniteGasMeter
+
+    app = App(chain_id="mesh-cap", max_square_size=512)
+    app.init_chain({"time_unix": 0, "gov_max_square_size": 512})
+    ctx = app._ctx(app.store.branch(), InfiniteGasMeter(), check=False)
+    assert app.max_effective_square_size(ctx) == 512
+
+    # default chains keep the reference cap even with a big gov param
+    ref = App(chain_id="mesh-cap-ref")
+    ref.init_chain({"time_unix": 0, "gov_max_square_size": 512})
+    ctx_r = ref._ctx(ref.store.branch(), InfiniteGasMeter(), check=False)
+    assert ref.max_effective_square_size(ctx_r) == \
+        appconsts.square_size_upper_bound(1)
+
+    with pytest.raises(ValueError):
+        App(chain_id="bad", max_square_size=300)  # not a power of two
+    with pytest.raises(ValueError):
+        App(chain_id="bad", max_square_size=1024)  # above the plumbing
+
+
+def test_square_layout_at_k256():
+    """Layout accounting (host-only, no extend) admits a k=256 square:
+    a blob bigger than the k=128 capacity lays out at 256 under the
+    raised cap and is refused under the reference cap."""
+    from celestia_app_tpu.da import blob as blob_mod
+    from celestia_app_tpu.da import namespace as ns_mod
+    from celestia_app_tpu.da import square as square_mod
+    from celestia_app_tpu.da.square import PfbEntry
+
+    ns = ns_mod.Namespace.v0(b"\x07" * 10)
+    # > 128^2 shares of content => needs k=256
+    data = bytes(140 * 140 * appconsts.CONTINUATION_SPARSE_SHARE_CONTENT_SIZE)
+    blob = blob_mod.Blob(namespace=ns, data=data, share_version=0)
+    entry = PfbEntry(tx=b"\x01" * 64, blobs=(blob,))
+
+    sq = square_mod.construct([], [entry], 256, 64)
+    assert sq.size == 256
+    with pytest.raises(ValueError):
+        square_mod.construct([], [entry], 128, 64)
+
+
+# ---------------------------------------------------------------------------
+# bytes-aware LRU (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_edscache_bytes_aware_eviction():
+    """The LRU bounds BYTES as well as entries: big squares evict down
+    to the budget, the newest entry always survives, and the count cap
+    still applies."""
+    k = 8
+    # one k=8 entry charges (16*16*512)*2 = 256 KiB
+    one = edscache.entry_nbytes(edscache.compute_entry(
+        _random_ods(k, 0), "host"))
+    cache = edscache.EdsCache(max_entries=10, max_bytes=2 * one)
+    entries = []
+    for i in range(4):
+        ods = _random_ods(k, 500 + i)
+        e = edscache.compute_entry(ods, "host")
+        entries.append((edscache.cache_key(ods), e))
+        cache.put(*entries[-1])
+    assert len(cache) == 2  # byte budget binds before the count cap
+    assert cache.nbytes() <= 2 * one
+    # newest two survive, oldest two evicted
+    assert cache.get(entries[3][0]) is not None
+    assert cache.get(entries[2][0]) is not None
+    assert cache.get(entries[0][0]) is None
+
+    # a single over-budget entry is still retained (newest-entry rule)
+    tiny = edscache.EdsCache(max_entries=10, max_bytes=1)
+    tiny.put(*entries[0])
+    assert len(tiny) == 1
+
+
+# ---------------------------------------------------------------------------
+# streaming observability (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_counters_and_fetch_timer():
+    from celestia_app_tpu.parallel import streaming
+
+    k = 8
+    layouts = [streaming._synthetic_layout(k, i) for i in range(3)]
+    roots = streaming.stream_blocks(lambda i: layouts[i], 3, k)
+    assert len(roots) == 3
+    snap = telemetry.snapshot()
+    timers = snap.get("timers", {})
+    assert any(name.startswith("streaming.fetch") for name in timers), \
+        f"fetch wall-clock must ride the telemetry timers: {list(timers)}"
+    gauges = snap.get("gauges", {})
+    assert "streaming.blocks_in_flight" in gauges
+    assert gauges["streaming.blocks_in_flight"] == 0  # drained
+
+
+# ---------------------------------------------------------------------------
+# the big squares themselves (slow tier: minutes of GF(2^16) on CPU)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_mesh_k256_extend_commit_end_to_end():
+    """k=256 (the streaming target, GF(2^16) codec) through the mesh
+    engine: entry bit-identical to the HOST engine (the quasilinear FFT
+    + SIMD-hash reference — the single-device jit program at this size
+    is minutes more of the same already-pinned program), commitments
+    well-formed, device-resident."""
+    k = 256
+    ods = _random_ods(k, 256)
+    entry = edscache.compute_entry(ods, "mesh")
+    assert isinstance(entry, edscache.DeviceEntry)
+    host = edscache.compute_entry(ods, "host")
+    assert entry.data_root == host.data_root
+    assert entry.dah.row_roots == host.dah.row_roots
+    assert entry.dah.col_roots == host.dah.col_roots
+    np.testing.assert_array_equal(entry.eds.squares, host.eds.squares)
+
+
+@pytest.mark.slow
+def test_mesh_k512_extend_commit_repair():
+    """k=512 through extend+commit on the mesh, then a mesh-sharded
+    repair of a column-erased corner of the square's rows (a full 2D
+    k=512 repair is hours on CPU; the sharded decode program and root
+    verification are exercised at full width here)."""
+    from celestia_app_tpu.ops import nmt as nmt_ops
+    from celestia_app_tpu.ops import rs
+
+    k = 512
+    ods = _random_ods(k, 512)
+    entry = edscache.compute_entry(ods, "mesh")
+    assert isinstance(entry, edscache.DeviceEntry)
+    assert len(entry.dah.row_roots) == 2 * k
+    eds = entry.eds.squares
+
+    # repair a batch of rows with a shared whole-columns erasure at
+    # full k=512 width through the fused decode matmul...
+    present = tuple(range(k))  # first k of 2k present
+    run = rs.repair_axes_fn(k, present)
+    rows = eds[:8].copy()
+    garbled = rows.copy()
+    garbled[:, k:, :] = 0
+    out = run(garbled)
+    np.testing.assert_array_equal(out, rows)
+    # ...and verify their roots through the batched NMT reduction
+    got = nmt_ops.eds_axis_roots(rows, list(range(8)), k)
+    want = [bytes(r) for r in entry.dah.row_roots[:8]]
+    assert [g.tobytes() for g in got] == want
