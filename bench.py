@@ -2210,6 +2210,64 @@ def measure_mesh() -> None:
     }), flush=True)
 
 
+def measure_scenario() -> None:
+    """Scenario-plane bench (--scenario). One BENCH JSON line per
+    (scenario, scheme) cell of the matrix, each the scenario's verdict
+    (FORMATS §19.2): blocks_to_detection, liveness_gap_s,
+    false_condemnation_rate, recovery_s, plus the event-trace digest —
+    the determinism witness (same seed reprints identical lines).
+
+    The matrix: honest (the zero-false-condemnation control),
+    withholding at each scheme's recoverability threshold, committed
+    incorrect coding escalated to a verified fraud proof, and a
+    partition-heal churn — per scheme, all on one seeded virtual
+    timeline per cell. Pure host/CPU work (consensus + sampling +
+    repair at small k): no relay involvement, no backend probe.
+
+    Knobs: CELESTIA_BENCH_SCENARIO_{VALIDATORS,LIGHTS,HEIGHTS,SEED} and
+    CELESTIA_BENCH_SCENARIOS (comma list to sub-select)."""
+    import tempfile
+
+    from celestia_app_tpu.sim import run_scenario, scenario_spec
+
+    n_val = int(os.environ.get("CELESTIA_BENCH_SCENARIO_VALIDATORS", "8"))
+    n_light = int(os.environ.get("CELESTIA_BENCH_SCENARIO_LIGHTS", "64"))
+    heights = int(os.environ.get("CELESTIA_BENCH_SCENARIO_HEIGHTS", "5"))
+    seed = int(os.environ.get("CELESTIA_BENCH_SCENARIO_SEED", "0"))
+    names = [s for s in os.environ.get(
+        "CELESTIA_BENCH_SCENARIOS",
+        "honest,withhold-threshold,incorrect-coding,partition-churn",
+    ).split(",") if s]
+    for scenario in names:
+        for scheme in ("rs2d-nmt", "cmt-ldpc"):
+            doc = scenario_spec(scenario, scheme=scheme, seed=seed,
+                                validators=n_val, light_nodes=n_light,
+                                heights=heights)
+            t0 = time.perf_counter()
+            v = run_scenario(doc, workdir=tempfile.mkdtemp(
+                prefix=f"bench-sim-{scenario}-"))
+            wall = time.perf_counter() - t0
+            print(json.dumps({
+                "metric": "scenario_verdict",
+                "scenario": scenario,
+                "scheme": scheme,
+                "seed": seed,
+                "validators": v["validators"],
+                "light_nodes": v["light_nodes"],
+                "heights_committed": v["heights_committed"],
+                "blocks_to_detection": v["blocks_to_detection"],
+                "liveness_gap_s": v["liveness_gap_s"],
+                "false_condemnation_rate": v["false_condemnation_rate"],
+                "recovery_s": v["recovery_s"],
+                "light_halts": v["light_halts"],
+                "unavailable_reports": v["unavailable_reports"],
+                "events": v["events"],
+                "trace_digest": v["trace_digest"],
+                "wall_s": round(wall, 3),
+                "backend": "host",
+            }), flush=True)
+
+
 MODES = {
     "block": (measure_block,
               "block_e2e_ms, blocks_per_sec, first_sample_after_commit_ms",
@@ -2230,6 +2288,12 @@ MODES = {
                 "CAT pool ingest + priority reap throughput"),
     "chaos": (measure_chaos, "crash_replay_ms, chaos_heal_recovery_s",
               "fault plane: WAL crash replay + partition-heal liveness"),
+    "scenario": (measure_scenario,
+                 "scenario_verdict: blocks_to_detection, liveness_gap_s, "
+                 "false_condemnation_rate, recovery_s (per scenario x "
+                 "scheme)",
+                 "scenario plane: seeded virtual-time adversarial matrix "
+                 "over the validator + light-node fleet"),
     "sync": (measure_sync,
              "state_sync_join_s, blocksync_blocks_per_sec, "
              "snapshot_serve_ms",

@@ -410,24 +410,20 @@ class ValidatorNode:
         path — the pool's byte gate (default_overrides.go:271-273),
         hash dedup (a duplicate submission returns the ORIGINAL result),
         and cap eviction included."""
-        import time as time_mod
-
+        # mempool TTL stamp: the POOL's injected clock supplies it
+        # (node-local state, never hashed) — SystemClock in production,
+        # the scenario plane's VirtualClock under simulation
         return self.pool.add(raw, height=self.app.height,
-                             # mempool TTL stamp: node-local pool state,
-                             # never hashed
-                             now=time_mod.time(),  # lint: disable=det-wallclock
                              check_fn=self.app.check_tx)
 
     def add_txs(self, raws) -> list:
         """Batched admission (admission plane phase 1 + per-tx CheckTx):
         an ingest burst pays ONE signature dispatch, not one per tx."""
-        import time as time_mod
-
         from celestia_app_tpu.chain import admission
 
+        # TTL stamp comes from the pool's injected clock (see add_tx)
         return self.pool.add_batch(
-            # mempool TTL stamp: node-local pool state, never hashed
-            raws, height=self.app.height, now=time_mod.time(),  # lint: disable=det-wallclock
+            raws, height=self.app.height,
             check_fn=self.app.check_tx,
             prevalidate_fn=lambda rs: admission.prevalidate(
                 self.app, rs, check_state=True),

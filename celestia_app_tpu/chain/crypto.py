@@ -194,6 +194,11 @@ def _py_sign(x: int, message: bytes) -> bytes:
 
 
 def _py_verify(compressed: bytes, signature: bytes, message: bytes) -> bool:
+    return _py_verify_digest(compressed, signature, _sha(message))
+
+
+def _py_verify_digest(compressed: bytes, signature: bytes,
+                      digest: bytes) -> bool:
     q = _decompress(compressed)
     if q is None:
         return False
@@ -201,7 +206,7 @@ def _py_verify(compressed: bytes, signature: bytes, message: bytes) -> bool:
     s = int.from_bytes(signature[32:], "big")
     if not (1 <= r < _N and 1 <= s < _N):
         return False
-    z = int.from_bytes(_sha(message), "big") % _N
+    z = int.from_bytes(digest, "big") % _N
     w = _inv(s, _N)
     u1 = z * w % _N
     u2 = r * w % _N
@@ -215,6 +220,53 @@ def _py_verify(compressed: bytes, signature: bytes, message: bytes) -> bool:
 # ---------------------------------------------------------------------------
 # Public API (backend-independent)
 # ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=1 << 16)
+def _verify_memo(compressed: bytes, signature: bytes,
+                 digest: bytes) -> int:
+    """The one ECDSA verification body, memoized; 1 valid / 0 invalid /
+    -1 exploded (callers count the telemetry per CALL, so a flood of
+    replayed malformed triples still shows in /metrics).
+
+    Verification is a pure function of (pubkey, sha256(message),
+    signature), and the same triple is verified many times across one
+    process: every validator re-checks the same certificate votes in
+    cert.verify and _present_set_from_cert, and a light-node FLEET (the
+    scenario plane runs hundreds in one process) verifies the identical
+    certificates per node. The memo collapses those to one curve
+    operation per unique triple — an adversary gains nothing (a
+    0/-1 verdict is cached as hard as a 1). Keys hold the 32-byte
+    DIGEST, never message bytes (the admission plane's VerifiedSigCache
+    made the same choice for the same reason: the tx path verifies
+    multi-MB sign docs, and raw-bytes keys would let 65536 entries pin
+    gigabytes); ~130 B/entry, bounded LRU."""
+    if not _HAVE_OPENSSL:
+        try:
+            return 1 if _py_verify_digest(compressed, signature,
+                                          digest) else 0
+        except Exception:
+            # malformed point/signature: unique explosions counted here,
+            # per-CALL flood visibility counted by the caller
+            telemetry.incr("crypto.verify_errors_unique")
+            return -1
+    try:
+        pub = ec.EllipticCurvePublicKey.from_encoded_point(
+            _CURVE, compressed)
+        r = int.from_bytes(signature[:32], "big")
+        s = int.from_bytes(signature[32:], "big")
+        from cryptography.hazmat.primitives.asymmetric.utils import (
+            encode_dss_signature,
+        )
+
+        der = encode_dss_signature(r, s)
+        pub.verify(der, digest, ec.ECDSA(Prehashed(hashes.SHA256())))
+        return 1
+    except Exception:
+        # the OpenSSL backend signals an invalid signature by raising
+        # too — kept in the counted class, exactly as before the memo
+        telemetry.incr("crypto.verify_errors_unique")
+        return -1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -231,28 +283,14 @@ class PublicKey:
         s = int.from_bytes(signature[32:], "big")
         if s > _N // 2:
             return False  # reject high-S: tx bytes must not be malleable
-        if not _HAVE_OPENSSL:
-            try:
-                return _py_verify(self.compressed, signature, message)
-            except Exception:
-                # malformed points/signatures verify False, but COUNTED:
-                # a flood of exploding verifies should show in /metrics
-                telemetry.incr("crypto.verify_errors")
-                return False
-        try:
-            pub = ec.EllipticCurvePublicKey.from_encoded_point(
-                _CURVE, self.compressed)
-            r = int.from_bytes(signature[:32], "big")
-            from cryptography.hazmat.primitives.asymmetric.utils import (
-                encode_dss_signature,
-            )
-
-            der = encode_dss_signature(r, s)
-            pub.verify(der, _sha(message), ec.ECDSA(Prehashed(hashes.SHA256())))
-            return True
-        except Exception:
+        code = _verify_memo(self.compressed, signature, _sha(message))
+        if code < 0:
+            # counted per call (not per unique triple): a flood of
+            # exploding verifies must show in /metrics even when the
+            # memo answers it
             telemetry.incr("crypto.verify_errors")
             return False
+        return code == 1
 
 
 @dataclasses.dataclass(frozen=True)

@@ -50,7 +50,6 @@ from __future__ import annotations
 import dataclasses
 import json
 import threading
-import time
 
 from celestia_app_tpu import obs
 from celestia_app_tpu.chain import consensus as c
@@ -143,11 +142,20 @@ class ConsensusReactor:
 
     def __init__(self, vnode, peer_urls: list[str], service_lock,
                  config: ReactorConfig | None = None,
-                 self_url: str = ""):
+                 self_url: str = "", clock=None):
+        from celestia_app_tpu.utils import clock as clock_mod
+
         self.vnode = vnode
         self.peers = [u.rstrip("/") for u in peer_urls]
         self.service_lock = service_lock
         self.cfg = config or ReactorConfig()
+        # THE reactor time source (utils/clock.py): every poll/backoff/
+        # deadline/timestamp below reads it — SystemClock by default
+        # (production behavior pinned unchanged), a VirtualClock when a
+        # scheduler drives this reactor on simulated time. Handed down to
+        # the transport so breaker timers and retry backoffs ride the
+        # same timeline.
+        self.clock = clock if clock is not None else clock_mod.SYSTEM
         # peer-visible URL of THIS node: rides SeenTx announces so the
         # receiver knows whom to WantTx-pull the content from
         self.self_url = self_url.rstrip("/")
@@ -176,6 +184,7 @@ class ConsensusReactor:
                 reset_timeout=self.cfg.breaker_reset,
             ),
             name=vnode.name,
+            clock=self.clock,
         )
         self.round = 0
         self.step = "idle"
@@ -293,7 +302,7 @@ class ConsensusReactor:
                         continue
                     path, payload, ctx = item
                     if self.cfg.gossip_delay > 0:  # injected latency
-                        time.sleep(self.cfg.gossip_delay)
+                        self.clock.sleep(self.cfg.gossip_delay)
                     if not self.net.available(u):
                         # circuit open: SKIP the peer instead of paying a
                         # connect timeout per queued message — gossip is
@@ -535,7 +544,7 @@ class ConsensusReactor:
         if height > self.vnode.app.height + 1:
             with self._msg_lock:
                 if self._ahead is None:
-                    self._ahead = (height, peer, time.monotonic())
+                    self._ahead = (height, peer, self.clock.monotonic())
                 elif self._ahead[0] < height:
                     self._ahead = (height, peer or self._ahead[1],
                                    self._ahead[2])
@@ -593,22 +602,26 @@ class ConsensusReactor:
         try:
             self.vnode.app.traces.write(
                 "round_state", height=height, round=round_, step=step,
-                elapsed_ms=round((time.monotonic() - t0) * 1e3, 3),
+                elapsed_ms=round((self.clock.monotonic() - t0) * 1e3, 3),
             )
         except Exception:
             # observability must never kill consensus — but not silently
             telemetry.incr("obs.trace_write_errors")
 
     def _wait(self, deadline: float, check):
-        """Poll `check` (under _msg_lock) until non-None or deadline."""
+        """Poll `check` (under _msg_lock) until non-None or deadline.
+        The poll pause is the clock's INTERRUPTIBLE wait-with-wakeup —
+        stop() wakes it immediately instead of losing up to a full poll
+        interval per fixed sleep, and a VirtualClock resolves it against
+        simulated time so a scheduler can preempt an idle node."""
         while not self._stop.is_set():
             with self._msg_lock:
                 got = check()
             if got is not None:
                 return got
-            if time.monotonic() >= deadline:
+            if self.clock.monotonic() >= deadline:
                 return None
-            time.sleep(self.cfg.poll)
+            self.clock.wait(self._stop, self.cfg.poll)
         return None
 
     def _prune(self, floor_height: int) -> None:
@@ -696,18 +709,24 @@ class ConsensusReactor:
             except Exception as e:  # keep the reactor alive — but COUNTED
                 # (reactor.loop_errors) and with escalating backoff, not
                 # the old fixed-0.2s hot loop that could spin a wedged
-                # node at 5 errors/second forever
+                # node at 5 errors/second forever. Both this backoff and
+                # the inter-height pause below are the clock's
+                # interruptible wait: stop() no longer blocks behind a
+                # sleeping loop (the old fixed time.sleep could hold
+                # stop() for a full interval), and a VirtualClock lets
+                # the sim scheduler preempt an idle node instead of
+                # burning virtual-time steps.
                 self.loop_errors += 1
                 telemetry.incr("reactor.loop_errors")
                 log.error("round error", node=self.vnode.name, err=e)
                 committed = False
-                time.sleep(backoff)
+                self.clock.wait(self._stop, backoff)
                 backoff = min(backoff * 2, 5.0)
             else:
                 backoff = 0.2
             if committed:
                 self.round = 0
-                time.sleep(self.cfg.block_interval)
+                self.clock.wait(self._stop, self.cfg.block_interval)
 
     def _apply_pending_commit(self) -> bool:
         """Adopt a gossiped commit for our next height, if one is queued.
@@ -906,7 +925,7 @@ class ConsensusReactor:
         if ahead is None:
             return False
         target, peer, since = ahead
-        if time.monotonic() - since < self.cfg.sync_grace:
+        if self.clock.monotonic() - since < self.cfg.sync_grace:
             return False
         progressed = False
         with self.service_lock:
@@ -1280,7 +1299,7 @@ class ConsensusReactor:
             my_last_cert = self.vnode.certificates.get(height - 1)
         self.height_view = height
         r = self.round
-        _t_round = time.monotonic()
+        _t_round = self.clock.monotonic()
 
         # ---- propose ----
         self.step = "propose"
@@ -1315,7 +1334,7 @@ class ConsensusReactor:
                     self.vnode.app.chain_id, pool,
                     self.vnode.known_pubkeys(),
                 ))
-                block = self.vnode.propose(t=time.time())
+                block = self.vnode.propose(t=self.clock.now())
             digest = c.Proposal.commit_info_digest(my_last_cert, evidence)
             sig = self.vnode.priv.sign(c.Proposal.sign_bytes(
                 self.vnode.app.chain_id, height, r, block.header.hash(),
@@ -1336,7 +1355,7 @@ class ConsensusReactor:
         expected = self.proposer_for(height, r)
         force_cold = (expected not in self._seen_proposers
                       or expected in self._cold_retry)
-        deadline = time.monotonic() + self._timeout(
+        deadline = self.clock.monotonic() + self._timeout(
             "propose", force_cold=force_cold
         )
         prop = self._wait(
@@ -1388,7 +1407,7 @@ class ConsensusReactor:
                 return b"nil"  # sentinel: round is dead, move on
             return None
 
-        deadline = time.monotonic() + self._timeout("prevote")
+        deadline = self.clock.monotonic() + self._timeout("prevote")
         polka = self._wait(deadline, polka_check)
         polka_hash = polka if isinstance(polka, bytes) and polka != b"nil" \
             else None
@@ -1437,7 +1456,7 @@ class ConsensusReactor:
             # gossip and is adopted at the top of the next attempt
             cert_votes = None
         else:
-            deadline = time.monotonic() + self._timeout("precommit")
+            deadline = self.clock.monotonic() + self._timeout("precommit")
             cert_votes = self._wait(deadline, quorum_check)
 
         # a certificate is only actionable if WE hold the matching

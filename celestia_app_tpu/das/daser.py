@@ -102,12 +102,18 @@ class PeerSet:
 
     def __init__(self, urls: list[str], timeout: float = 5.0,
                  retries: int = 3, backoff: float = 0.05,
-                 client: PeerClient | None = None):
+                 client: PeerClient | None = None, clock=None):
         if not urls:
             raise ValueError("PeerSet needs at least one peer URL")
+        from celestia_app_tpu.utils import clock as clock_mod
+
         self.urls = [u.rstrip("/") for u in urls]
         self.retries = retries
         self.backoff = backoff
+        # retry-round backoff time source: SystemClock by default; the
+        # scenario plane injects its VirtualClock so rotation backoffs
+        # cost virtual seconds (utils/clock.py)
+        self.clock = clock if clock is not None else clock_mod.SYSTEM
         # one transport attempt per (peer, round): the ROTATION is this
         # class's retry loop; a dead peer trips its breaker here exactly
         # as it would under the reactor, and subsequent rounds skip it at
@@ -152,7 +158,7 @@ class PeerSet:
                     last = f"{url}{path}: {type(e).__name__}: {e}"
             if attempt + 1 < self.retries:
                 telemetry.incr("daser.retry_rounds")
-                time.sleep(delay)
+                self.clock.sleep(delay)
                 delay *= 2
         raise PeerError(f"all peers failed: {last}")
 
@@ -202,11 +208,19 @@ class DASer:
     def __init__(self, peers, light: light_mod.LightClient,
                  store: CheckpointStore,
                  cfg: DASerConfig | None = None,
-                 header_source=None, rng=None, name: str = "daser"):
+                 header_source=None, rng=None, name: str = "daser",
+                 clock=None):
+        from celestia_app_tpu.utils import clock as clock_mod
+
         self.cfg = cfg or DASerConfig()
+        # sweep/retry/backoff time source (utils/clock.py): SystemClock
+        # by default; the scenario plane injects its VirtualClock so one
+        # process can run hundreds of samplers over hours of chain time
+        self.clock = clock if clock is not None else clock_mod.SYSTEM
         self.peers = peers if isinstance(peers, PeerSet) else PeerSet(
             peers, timeout=self.cfg.request_timeout,
             retries=self.cfg.retries, backoff=self.cfg.backoff,
+            clock=self.clock,
         )
         self.light = light
         self.store = store
@@ -651,7 +665,7 @@ class DASer:
         for _ in range(self.cfg.retries):
             if not failed:
                 break
-            time.sleep(delay)
+            self.clock.sleep(delay)
             delay *= 2
             try:
                 docs = self._fetch_cells(height, failed)
@@ -1074,7 +1088,10 @@ class DASer:
                     self.sync()
                 except Exception as e:  # keep the daemon alive, loudly
                     log.error("sweep error", daser=self.name, err=e)
-                self._stop.wait(self.cfg.poll_interval)
+                # interruptible head-follow pause through the injected
+                # clock: stop() wakes it immediately, and a VirtualClock
+                # resolves it against simulated time
+                self.clock.wait(self._stop, self.cfg.poll_interval)
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()

@@ -22,7 +22,6 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import threading
-import time as time_mod
 
 from celestia_app_tpu import appconsts
 from celestia_app_tpu.chain.block import TxResult
@@ -113,12 +112,20 @@ class CATPool:
         ttl_blocks: int = appconsts.MEMPOOL_TX_TTL_BLOCKS,
         ttl_seconds: float | None = appconsts.MEMPOOL_TX_TTL_SECONDS,
         metrics: MempoolMetrics | None = None,
+        clock=None,
     ):
         self.max_pool_bytes = max_pool_bytes
         self.max_txs = max_txs
         self.ttl_blocks = ttl_blocks
         self.ttl_seconds = ttl_seconds  # None disables wall-clock TTL
         self.metrics = metrics or MempoolMetrics()
+        # THE wall-clock TTL time source (utils/clock.py): SystemClock by
+        # default; a simulated pool takes the scenario's VirtualClock so
+        # TTL expiry runs on virtual seconds, deterministically. Public —
+        # embedders (the scenario plane) re-point it after construction.
+        from celestia_app_tpu.utils import clock as clock_mod
+
+        self.clock = clock if clock is not None else clock_mod.SYSTEM
         # reentrant: public methods hold it across calls into each other
         # (add -> expire, reap -> expire). HTTP handler threads, the
         # reactor's gossip threads, and the node loop all touch the pool
@@ -246,7 +253,7 @@ class CATPool:
         copy's CheckTx bump). `check_fn` is App.check_tx (None skips the
         check — trusted re-injection paths only). `meta` optionally
         supplies a pre-parsed (gas_price, sender)."""
-        now = time_mod.time() if now is None else now
+        now = self.clock.now() if now is None else now
         h = tx_hash(raw)
         if meta is None:
             meta = parse_tx_meta(raw)  # parse OUTSIDE the lock (pure)
@@ -313,7 +320,7 @@ class CATPool:
         """TTL sweep: drop entries older than ttl_blocks heights OR
         ttl_seconds wall-clock (both default to the reference's 5-block /
         5×goal-block-time shape). Returns the dropped entries."""
-        now = time_mod.time() if now is None else now
+        now = self.clock.now() if now is None else now
         dropped: list[PoolTx] = []
         with self._lock:
             for e in list(self._txs.values()):

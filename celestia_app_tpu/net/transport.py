@@ -110,9 +110,16 @@ class PeerClient:
     specs match on it to scope faults to one node of an in-process net)."""
 
     def __init__(self, cfg: TransportConfig | None = None,
-                 name: str = "peer"):
+                 name: str = "peer", clock=None):
         self.cfg = cfg or TransportConfig()
         self.name = name
+        # THE retry-backoff + breaker time source (utils/clock.py):
+        # SystemClock by default (behavior unchanged); components running
+        # under the scenario plane hand their VirtualClock down so
+        # breaker open-timers and backoff sleeps run on virtual seconds
+        from celestia_app_tpu.utils import clock as clock_mod
+
+        self.clock = clock if clock is not None else clock_mod.SYSTEM
         # url -> breaker/health state, shared by every thread that
         # sends through this client
         self._peers: dict[str, _PeerState] = {}  # guarded-by: _lock
@@ -139,7 +146,8 @@ class PeerClient:
             st = self._peers.get(url)
             if st is None or st.state != "open":
                 return True
-            return time.monotonic() - st.opened_at >= self.cfg.reset_timeout
+            return (self.clock.monotonic() - st.opened_at
+                    >= self.cfg.reset_timeout)
 
     def _admit(self, url: str) -> bool:
         """Breaker admission for one attempt. Returns True when this
@@ -150,7 +158,7 @@ class PeerClient:
             if st.state == "closed":
                 return False
             if st.state == "open":
-                if (time.monotonic() - st.opened_at
+                if (self.clock.monotonic() - st.opened_at
                         < self.cfg.reset_timeout):
                     telemetry.incr("net.breaker_rejected")
                     raise BreakerOpen(
@@ -193,7 +201,7 @@ class PeerClient:
                 if st.state != "open":
                     telemetry.incr("net.breaker_open")
                 st.state = "open"
-                st.opened_at = time.monotonic()
+                st.opened_at = self.clock.monotonic()
         telemetry.incr("net.failures")
 
     # -- the request path -------------------------------------------------
@@ -268,7 +276,8 @@ class PeerClient:
                     jit = 1.0 + self.cfg.jitter * (
                         2.0 * self._rng.random() - 1.0
                     )
-                    time.sleep(min(delay, self.cfg.backoff_max) * jit)
+                    self.clock.sleep(
+                        min(delay, self.cfg.backoff_max) * jit)
                     delay *= 2
                 continue
             except BaseException as e:

@@ -1,0 +1,934 @@
+"""The simulation world: validators, light nodes, topology, verdicts.
+
+One :class:`Simulation` runs tens of ``ValidatorNode``-backed consensus
+reactors plus hundreds of real ``das/daser.DASer`` light nodes on ONE
+seeded virtual timeline (sim/scheduler.py):
+
+- :class:`SimNet` — topology: partition groups, node up/down, eclipse
+  allowlists, and seeded per-message latency for consensus gossip.
+- :class:`SimTransport` — a PeerClient-shaped direct-call transport: the
+  DASer's PeerSet speaks the REAL wire routes (/das/*, /ibc/header)
+  against the REAL das/server.SampleCore of each validator, with no HTTP
+  and no real sockets, so a hundred samplers cost function calls. Every
+  request still passes the ``net.request`` fault point, so seeded fault
+  specs act here exactly as on the production transport.
+- :class:`SimValidator` — an event-driven Tendermint round machine over
+  ``chain/consensus.ValidatorNode``: propose → prevote → (polka? lock) →
+  precommit → commit as scheduler events with per-message latency and
+  phase timeouts, the same vote/lock/apply primitives the production
+  reactor uses (prevote_on runs ProcessProposal; apply runs the full
+  finalize+commit with certificate-derived presence accounting). The
+  engine never perturbs consensus bytes: proposal timestamps come from
+  the fixed per-height schedule, so fault-free runs commit identical
+  block and app hashes under EVERY seed (pinned in
+  tests/test_scenarios.py).
+- :class:`SimLightNode` — a real DASer (virtual clock injected) swept on
+  the timeline: verified header following through its own LightClient,
+  sampling/retry/escalation/fraud-proof assembly, halting — all the
+  production code paths, hundreds of instances in one process.
+
+Determinism contract: a Simulation executes ONE event at a time on the
+caller's thread; all randomness (event tiebreaks, latencies, sampler
+draws) descends from the one scenario seed; all time descends from the
+one VirtualClock. Consensus-vote gossip is only ever faulted
+symmetrically (partitions and whole-node downs — never probabilistic
+per-message drops), so every validator that assembles a certificate for
+a height assembles the same one and presence accounting cannot fork
+app hashes within a run. Background warmer threads only pre-build
+caches whose contents are content-addressed; verdicts never read them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import urllib.parse
+
+import numpy as np
+
+from celestia_app_tpu import faults
+from celestia_app_tpu.chain import consensus as c
+from celestia_app_tpu.chain import light as light_mod
+from celestia_app_tpu.chain.crypto import PrivateKey
+from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
+from celestia_app_tpu.das.checkpoint import Checkpoint
+from celestia_app_tpu.das.daser import DASer, DASerConfig, PeerSet
+from celestia_app_tpu.das.server import SampleCore, SampleError, route_das
+from celestia_app_tpu.net.transport import TransportError
+from celestia_app_tpu.sim.scheduler import Scheduler
+
+
+# ---------------------------------------------------------------------------
+# topology + transport
+# ---------------------------------------------------------------------------
+
+
+class SimNet:
+    """Who can reach whom, and how late. Registered handlers answer the
+    wire routes for ``sim://<name>`` URLs; partition groups / down sets /
+    eclipse allowlists gate every delivery and every direct request."""
+
+    def __init__(self, sched: Scheduler, latency: tuple[float, float]):
+        self.sched = sched
+        self.latency = latency
+        self.handlers: dict[str, object] = {}  # "sim://name" -> router fn
+        self.group: dict[str, int] = {}  # partition group (default 0)
+        self.down: set[str] = set()
+        # light-node eclipse: name -> allowed peer names (None = all)
+        self.allowed: dict[str, set[str] | None] = {}
+        self.dropped = 0
+
+    def register(self, name: str, router) -> str:
+        url = f"sim://{name}"
+        self.handlers[url] = router
+        return url
+
+    def link_ok(self, a: str, b: str) -> bool:
+        if a in self.down or b in self.down:
+            return False
+        if self.group.get(a, 0) != self.group.get(b, 0):
+            return False
+        for src, dst in ((a, b), (b, a)):
+            allow = self.allowed.get(src)
+            if allow is not None and dst not in allow:
+                return False
+        return True
+
+    def draw_latency(self) -> float:
+        lo, hi = self.latency
+        return lo + (hi - lo) * self.sched.rng.random()
+
+    def deliver(self, src: str, dst: str, fn, label: str) -> None:
+        """Schedule a one-way message: dropped when the link is cut NOW
+        (a partition heal never resurrects in-flight messages — they
+        were sent into the void)."""
+        if not self.link_ok(src, dst):
+            self.dropped += 1
+            return
+        self.sched.call_after(self.draw_latency(), fn, label)
+
+
+class SimTransport:
+    """PeerClient-shaped direct-call transport over SimNet handlers.
+
+    Serves the DASer's PeerSet: ``request(url, path, payload, raw=)``
+    plus the ``available``/``penalize``/``snapshot`` surface. Requests
+    are synchronous function calls (zero virtual latency — scheduled
+    events carry the timeline; retry backoffs in the callers advance it),
+    but every one passes the ``net.request`` fault point with the same
+    context the production transport fires, so scenario fault specs
+    (drop/error, matched on owner/peer/path) behave identically here."""
+
+    def __init__(self, net: SimNet, owner: str):
+        self.net = net
+        self.owner = owner
+        self.penalties: dict[str, int] = {}
+
+    def request(self, url: str, path: str, payload: dict | None = None,
+                *, timeout: float | None = None,
+                retries: int | None = None, raw: bool = False):
+        url = url.rstrip("/")
+        dst = url[len("sim://"):]
+        if not self.net.link_ok(self.owner, dst):
+            raise TransportError(f"{self.owner}: no route to {url}")
+        action = faults.fire("net.request", owner=self.owner, peer=url,
+                            path=path)
+        if action in ("drop", "error"):
+            raise TransportError(f"injected fault: {action} {url}{path}")
+        router = self.net.handlers.get(url)
+        if router is None:
+            raise TransportError(f"unknown sim peer {url}")
+        parsed = urllib.parse.urlparse(path)
+        query = urllib.parse.parse_qs(parsed.query)
+        method = "GET" if payload is None else "POST"
+        try:
+            out = router(method, parsed.path, query, payload)
+        except SampleError as e:
+            # the HTTP services answer 4xx here; to the rotating caller
+            # that is a refusal to retry elsewhere
+            raise ValueError(str(e)) from None
+        if action == "duplicate":
+            out = router(method, parsed.path, query, payload)
+        return out
+
+    def get(self, url: str, path: str, **kw):
+        return self.request(url, path, None, **kw)
+
+    def post(self, url: str, path: str, payload: dict, **kw):
+        return self.request(url, path, payload, **kw)
+
+    def available(self, url: str) -> bool:
+        dst = url.rstrip("/")[len("sim://"):]
+        return self.net.link_ok(self.owner, dst)
+
+    def penalize(self, url: str, reason: str) -> None:
+        self.penalties[url] = self.penalties.get(url, 0) + 1
+
+    def health_snapshot(self) -> dict:
+        """The PeerClient.snapshot() analog, under its own name — the
+        shared `snapshot` spelling would alias this class into the
+        state-snapshot call graph the analysis plane walks."""
+        return {"penalties": dict(self.penalties)}
+
+
+class MemoryCheckpointStore:
+    """In-memory stand-in for das/checkpoint.CheckpointStore: hundreds of
+    simulated samplers need no fsync'd file each."""
+
+    def __init__(self):
+        self.doc: dict | None = None
+
+    def load(self) -> Checkpoint:
+        return (Checkpoint() if self.doc is None
+                else Checkpoint.from_json(self.doc))
+
+    def save_doc(self, doc: dict) -> None:
+        self.doc = doc
+
+
+# ---------------------------------------------------------------------------
+# the validator reactor (event-driven)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SimConsensusConfig:
+    """Phase timeouts and pacing, all in VIRTUAL seconds. Defaults are
+    sized so a fault-free round completes in a few latency hops and a
+    dead proposer costs one timeout_propose; commit_grace must exceed the
+    worst-case message latency so every live validator's precommit is in
+    every certificate (the determinism note in the module docstring)."""
+
+    timeout_propose: float = 3.0
+    timeout_prevote: float = 2.0
+    timeout_precommit: float = 2.0
+    # after quorum: wait for stragglers (Tendermint TimeoutCommit) unless
+    # every validator's precommit already arrived
+    commit_grace: float = 0.5
+    block_interval: float = 1.0  # pause between committed heights
+    block_time: float = 10.0  # header timestamp spacing (chain seconds)
+    catchup_poll: float = 1.0  # laggard pull probe period
+    catchup_batch: int = 64  # heights replayed per poll
+
+
+class SimValidator:
+    """One validator as scheduler events over a ValidatorNode."""
+
+    def __init__(self, sim: "Simulation", index: int, vnode):
+        self.sim = sim
+        self.index = index
+        self.vnode = vnode
+        self.name = vnode.name
+        self.core = SampleCore(vnode.app)
+        self.cfg = sim.ccfg
+        self.lazy = False  # never proposes (scenario op)
+        # (height, round) currently being worked + the step within it;
+        # stale timeout events compare against these and no-op
+        self.cur: tuple[int, int] = (0, 0)
+        self.step = "idle"
+        self.proposals: dict[tuple[int, int], c.Block] = {}
+        self.prevotes: dict[tuple[int, int], dict[bytes, c.Vote]] = {}
+        self.precommits: dict[tuple[int, int], dict[bytes, c.Vote]] = {}
+        self.records: dict[int, tuple] = {}  # height -> (block, cert)
+        self.pending: dict[int, tuple] = {}  # future gossiped commits
+        self.app_hashes: dict[int, str] = {}
+        self._poll_i = 0  # catch-up peer rotation cursor
+
+    # -- helpers ---------------------------------------------------------
+
+    @property
+    def up(self) -> bool:
+        return self.name not in self.sim.net.down
+
+    def _powers(self) -> dict[bytes, int]:
+        app = self.vnode.app
+        ctx = Context(app.store, InfiniteGasMeter(), app.height, 0,
+                      app.chain_id, app.app_version)
+        return dict(app.staking.validators(ctx))
+
+    def _rotation(self) -> list[bytes]:
+        known = self.vnode.known_pubkeys()
+        rot = sorted(op for op in self._powers() if op in known)
+        return rot or sorted(known)
+
+    def proposer_for(self, height: int, round_: int) -> bytes:
+        rot = self._rotation()
+        return rot[(height + round_) % len(rot)]
+
+    def _broadcast(self, kind: str, payload: tuple) -> None:
+        for peer in self.sim.validators:
+            if peer is self:
+                continue
+            self.sim.net.deliver(
+                self.name, peer.name,
+                lambda p=peer: p.on_message(kind, payload),
+                f"{peer.name}.on_{kind}",
+            )
+
+    # -- height/round lifecycle -----------------------------------------
+
+    def begin_height(self, height: int) -> None:
+        if not self.up:
+            return
+        if self.vnode.app.height + 1 != height:
+            return  # stale schedule (a gossiped commit advanced us)
+        if height > self.sim.spec.heights:
+            self.step = "idle"
+            return  # target chain length reached: stop producing
+        self.cur = (height, 0)
+        self.start_round(height, 0)
+
+    def _schedule_next_height(self) -> None:
+        nxt = self.vnode.app.height + 1
+        self.sim.sched.call_after(
+            self.cfg.block_interval,
+            lambda h=nxt: self.begin_height(h),
+            f"{self.name}.begin_height h={nxt}",
+        )
+
+    def start_round(self, height: int, round_: int) -> None:
+        if not self.up or self.cur != (height, round_):
+            return
+        self.step = "propose"
+        proposer = self.proposer_for(height, round_)
+        if proposer == self.vnode.address and not self.lazy:
+            self.sim.tx_hook(height, self)
+            block = self.vnode.propose(t=self.sim.block_timestamp(height))
+            self.proposals[(height, round_)] = block
+            self._broadcast("proposal", (height, round_, block))
+            self._enter_prevote(height, round_, block)
+            return
+        got = self.proposals.get((height, round_))
+        if got is not None:
+            # the proposal outran our inter-height pause: prevote NOW —
+            # waiting for the propose timeout here would leave this
+            # node's precommit out of an otherwise-full certificate,
+            # leaking event timing into presence accounting
+            self._enter_prevote(height, round_, got)
+            return
+        self.sim.sched.call_after(
+            self.cfg.timeout_propose,
+            lambda: self._on_propose_timeout(height, round_),
+            f"{self.name}.propose_timeout h={height} r={round_}",
+        )
+
+    def _on_propose_timeout(self, height: int, round_: int) -> None:
+        if not self.up or self.cur != (height, round_) \
+                or self.step != "propose":
+            return
+        self._enter_prevote(height, round_, None)
+
+    def _acceptable(self, block: c.Block, height: int,
+                    round_: int) -> bool:
+        hdr = block.header
+        return (hdr.height == height
+                and hdr.last_block_hash == self.vnode.app.last_block_hash)
+
+    def _enter_prevote(self, height: int, round_: int,
+                       block: c.Block | None) -> None:
+        self.step = "prevote"
+        if block is not None and self._acceptable(block, height, round_):
+            pv = self.vnode.prevote_on(block, round_)  # ProcessProposal
+        else:
+            pv = self.vnode._signed(height, None, "prevote", round_)
+        self._record_vote(pv)
+        self._broadcast("vote", (pv,))
+        self.sim.sched.call_after(
+            self.cfg.timeout_prevote,
+            lambda: self._on_prevote_timeout(height, round_),
+            f"{self.name}.prevote_timeout h={height} r={round_}",
+        )
+        self._check_polka(height, round_)
+
+    def _on_prevote_timeout(self, height: int, round_: int) -> None:
+        if not self.up or self.cur != (height, round_) \
+                or self.step != "prevote":
+            return
+        # no polka observed in time: precommit nil, keep listening
+        self._enter_precommit(height, round_, None)
+
+    def _enter_precommit(self, height: int, round_: int,
+                         block: c.Block | None) -> None:
+        self.step = "precommit"
+        if block is not None:
+            self.vnode.on_polka(block, round_)
+            pc = self.vnode.precommit_on(block, round_)
+        else:
+            pc = self.vnode.precommit_on(None, round_)
+        self._record_vote(pc)
+        self._broadcast("vote", (pc,))
+        self.sim.sched.call_after(
+            self.cfg.timeout_precommit,
+            lambda: self._on_precommit_timeout(height, round_),
+            f"{self.name}.precommit_timeout h={height} r={round_}",
+        )
+        self._check_quorum(height, round_)
+
+    def _on_precommit_timeout(self, height: int, round_: int) -> None:
+        if not self.up or self.cur != (height, round_) \
+                or self.step != "precommit":
+            return
+        self._fail_round(height, round_)
+
+    def _fail_round(self, height: int, round_: int) -> None:
+        self.sim.sched.note(f"{self.name}.round_failed h={height} "
+                            f"r={round_}")
+        self.cur = (height, round_ + 1)
+        self.start_round(height, round_ + 1)
+
+    # -- gossip intake ---------------------------------------------------
+
+    def on_message(self, kind: str, payload: tuple) -> None:
+        if not self.up:
+            return
+        if kind == "proposal":
+            height, round_, block = payload
+            self.proposals.setdefault((height, round_), block)
+            if self.cur == (height, round_) and self.step == "propose":
+                self._enter_prevote(height, round_, block)
+        elif kind == "vote":
+            (vote,) = payload
+            self._record_vote(vote)
+            if self.cur == (vote.height, vote.round):
+                if vote.phase == "prevote" and self.step == "prevote":
+                    self._check_polka(vote.height, vote.round)
+                elif vote.phase == "precommit" \
+                        and self.step in ("precommit", "commit-wait"):
+                    self._check_quorum(vote.height, vote.round)
+        elif kind == "commit":
+            height, block, cert = payload
+            if height == self.vnode.app.height + 1:
+                if self._adopt(block, cert):
+                    self._drain_pending()
+                    self._schedule_next_height()
+            elif height > self.vnode.app.height + 1:
+                self.pending.setdefault(height, (block, cert))
+
+    def _record_vote(self, vote: c.Vote) -> None:
+        pool = self.prevotes if vote.phase == "prevote" else self.precommits
+        pool.setdefault((vote.height, vote.round), {}) \
+            .setdefault(vote.validator, vote)
+
+    # -- tallies ---------------------------------------------------------
+
+    def _check_polka(self, height: int, round_: int) -> None:
+        if self.cur != (height, round_) or self.step != "prevote":
+            return
+        powers = self._powers()
+        total = sum(powers.values())
+        pool = self.prevotes.get((height, round_), {})
+        by_hash: dict[bytes, int] = {}
+        nil_power = 0
+        for v in pool.values():
+            p = powers.get(v.validator, 0)
+            if v.block_hash is None:
+                nil_power += p
+            else:
+                by_hash[v.block_hash] = by_hash.get(v.block_hash, 0) + p
+        for bh in sorted(by_hash):
+            if by_hash[bh] * 3 <= total * 2:
+                continue
+            prop = self.proposals.get((height, round_))
+            mine = pool.get(self.vnode.address)
+            if (prop is not None and prop.header.hash() == bh
+                    and mine is not None and mine.block_hash == bh
+                    and self.vnode.lock_permits(bh, round_)):
+                self._enter_precommit(height, round_, prop)
+            else:
+                self._enter_precommit(height, round_, None)
+            return
+        if nil_power * 3 > total * 2:
+            self._fail_round(height, round_)
+
+    def _check_quorum(self, height: int, round_: int) -> None:
+        if self.cur != (height, round_) \
+                or self.step not in ("precommit", "commit-wait"):
+            return
+        powers = self._powers()
+        total = sum(powers.values())
+        pool = self.precommits.get((height, round_), {})
+        by_hash: dict[bytes, int] = {}
+        for v in pool.values():
+            if v.block_hash is not None:
+                by_hash[v.block_hash] = (by_hash.get(v.block_hash, 0)
+                                         + powers.get(v.validator, 0))
+        for bh in sorted(by_hash):
+            if by_hash[bh] * 3 <= total * 2:
+                continue
+            if self.proposals.get((height, round_)) is None or \
+                    self.proposals[(height, round_)].header.hash() != bh:
+                return  # cert without the block: let gossip deliver it
+            have = sum(1 for v in pool.values() if v.block_hash == bh)
+            if have == len(powers):
+                # every validator's precommit arrived: commit NOW (the
+                # fault-free fast path — certificates are full and
+                # therefore identical at every assembler)
+                self._finalize(height, round_, bh)
+            elif self.step != "commit-wait":
+                # quorum but stragglers possible: Tendermint's
+                # TimeoutCommit — wait a grace so every live vote lands
+                # in the certificate before it freezes
+                self.step = "commit-wait"
+                self.sim.sched.call_after(
+                    self.cfg.commit_grace,
+                    lambda: self._finalize(height, round_, bh),
+                    f"{self.name}.commit_grace h={height} r={round_}",
+                )
+            return
+
+    # -- commit ----------------------------------------------------------
+
+    def _finalize(self, height: int, round_: int, bh: bytes) -> None:
+        if not self.up or self.vnode.app.height >= height:
+            return
+        pool = self.precommits.get((height, round_), {})
+        votes = tuple(pool[a] for a in sorted(pool)
+                      if pool[a].block_hash == bh)
+        cert = c.CommitCertificate(height, bh, votes, round_)
+        block = self.proposals[(height, round_)]
+        ah = self.vnode.apply(block, cert)
+        self.vnode.clear_lock()
+        self.app_hashes[height] = ah.hex()
+        self.records[height] = (block, cert)
+        self.step = "committed"
+        self._prune(height)
+        self.sim._note_commit(self, height, block, cert)
+        self._broadcast("commit", (height, block, cert))
+        self._schedule_next_height()
+
+    def _adopt(self, block: c.Block, cert: c.CommitCertificate) -> bool:
+        """Laggard path: apply a gossiped/pulled commit after full local
+        verification (cert against OUR trust roots, then ProcessProposal
+        — a tampered record must never advance the chain)."""
+        vnode = self.vnode
+        height = vnode.app.height + 1
+        if cert.height != height \
+                or cert.block_hash != block.header.hash():
+            return False
+        if block.header.last_block_hash != vnode.app.last_block_hash:
+            return False
+        if not vnode.verify_certificate(cert):
+            return False
+        if not vnode.app.process_proposal(block):
+            return False
+        ah = vnode.apply(block, cert)
+        vnode.clear_lock()
+        self.app_hashes[height] = ah.hex()
+        self.records[height] = (block, cert)
+        self._prune(height)
+        self.sim._note_commit(self, height, block, cert, adopted=True)
+        return True
+
+    def _drain_pending(self) -> None:
+        while True:
+            nxt = self.vnode.app.height + 1
+            got = self.pending.pop(nxt, None)
+            if got is None or not self._adopt(*got):
+                break
+
+    def _prune(self, height: int) -> None:
+        floor = height  # keep only the live height's round state
+        for pool in (self.proposals, self.prevotes, self.precommits):
+            for key in [k for k in pool if k[0] <= floor]:
+                del pool[key]
+        for h in [h for h in self.pending if h <= floor]:
+            del self.pending[h]
+
+    # -- catch-up (partition heal / restart / late join) -----------------
+
+    def catchup_poll(self) -> None:
+        """Periodic pull probe: ask one reachable peer (seeded rotation)
+        for commit records above our height and replay them through the
+        verified _adopt path — the sim analog of the reactor's
+        blocksync. Reschedules itself for the simulation's lifetime."""
+        if self.up:
+            peers = [p for p in self.sim.validators if p is not self]
+            for off in range(len(peers)):
+                peer = peers[(self._poll_i + off) % len(peers)]
+                if not self.sim.net.link_ok(self.name, peer.name):
+                    continue
+                nxt = self.vnode.app.height + 1
+                if nxt not in peer.records:
+                    continue
+                applied = 0
+                while applied < self.cfg.catchup_batch:
+                    got = peer.records.get(self.vnode.app.height + 1)
+                    if got is None or not self._adopt(*got):
+                        break
+                    applied += 1
+                if applied:
+                    self.sim.sched.note(
+                        f"{self.name}.catchup applied={applied} "
+                        f"from={peer.name}")
+                    self._schedule_next_height()
+                    break
+            self._poll_i += 1
+        self.sim.sched.call_after(
+            self.cfg.catchup_poll, self.catchup_poll, "")
+
+    # -- scenario ops ----------------------------------------------------
+
+    def go_down(self) -> None:
+        self.sim.net.down.add(self.name)
+        self.step = "down"
+        self.sim.sched.note(f"{self.name}.down")
+
+    def go_up(self) -> None:
+        self.sim.net.down.discard(self.name)
+        self.sim.sched.note(f"{self.name}.up")
+        self.vnode.clear_lock()
+        self._schedule_next_height()
+
+    # -- the wire routes (SimTransport handler) --------------------------
+
+    def route(self, method: str, path: str, query: dict, payload):
+        if path.startswith("/das/"):
+            return route_das(self.core, method, path, query, payload)
+        if path == "/ibc/header" and method == "POST":
+            height = int((payload or {})["height"])
+            got = self.records.get(height)
+            if got is None:
+                raise SampleError(f"height {height} not certified here")
+            block, cert = got
+            return {"header": c.header_to_json(block.header),
+                    "cert": c.cert_to_json(cert)}
+        if path == "/consensus/height":
+            return {"height": self.vnode.app.height}
+        raise SampleError(f"no sim route {method} {path}")
+
+
+# ---------------------------------------------------------------------------
+# light nodes
+# ---------------------------------------------------------------------------
+
+
+class SimLightNode:
+    """One DASer light node on the virtual timeline."""
+
+    def __init__(self, sim: "Simulation", index: int):
+        self.sim = sim
+        self.index = index
+        self.name = f"light{index}"
+        spec = sim.spec
+        urls = [f"sim://{v.name}" for v in sim.validators]
+        transport = SimTransport(sim.net, self.name)
+        peers = PeerSet(urls, retries=2, backoff=0.02, client=transport,
+                        clock=sim.sched.clock)
+        trust = light_mod.TrustedState(
+            height=0, header_hash=b"",
+            validators={v.vnode.address:
+                        v.vnode.priv.public_key().compressed
+                        for v in sim.validators},
+            powers={v.vnode.address: 10 for v in sim.validators},
+        )
+        from celestia_app_tpu.das import daser as daser_mod
+
+        base = daser_mod.http_header_source(peers)
+
+        def source(h: int):
+            forged = sim.forged_headers.get(h)
+            if forged is not None:
+                return forged
+            return base(h)
+
+        cfg = DASerConfig(
+            samples_per_header=spec.samples_per_header,
+            workers=1, job_size=4, retries=2, backoff=0.02,
+            prefer_packs=False,
+        )
+        # one independent child stream per light node off the scenario
+        # seed: sampler draws are seeded end to end, never ambient
+        rng = np.random.default_rng([spec.seed, 7700 + index])  # lint: disable=det-rng
+        self.daser = DASer(
+            peers, light_mod.LightClient(sim.chain_id, trust),
+            MemoryCheckpointStore(), cfg=cfg, header_source=source,
+            rng=rng, name=self.name, clock=sim.sched.clock,
+        )
+        self._seen: dict[int, str] = {}  # height -> last reported status
+        self.halt: dict | None = None
+
+    def sweep(self) -> None:
+        if self.name in self.sim.net.down:
+            self._reschedule()
+            return
+        if self.daser.halted:
+            self._note_halt()
+            return  # terminal: no more sweeps for this node
+        self.daser.sync()
+        for h in sorted(self.daser.reports):
+            rep = self.daser.reports[h]
+            if self._seen.get(h) != rep["status"]:
+                self._seen[h] = rep["status"]
+                self.sim._note_report(self, h, rep)
+        if self.daser.halted:
+            self._note_halt()
+            return
+        self._reschedule()
+
+    def _note_halt(self) -> None:
+        if self.halt is None:
+            with self.daser._lock:
+                self.halt = dict(self.daser.cp.halted or {})
+            self.sim._note_light_halt(self, self.halt)
+
+    def _reschedule(self) -> None:
+        self.sim.sched.call_after(
+            self.sim.spec.sweep_interval, self.sweep,
+            f"{self.name}.sweep",
+        )
+
+
+# ---------------------------------------------------------------------------
+# the simulation
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SimSpec:
+    """The declarative world description (FORMATS.md §19.1). ``ops`` is
+    the adversarial program — see sim/scenarios.py for the op grammar."""
+
+    name: str = "honest"
+    seed: int = 0
+    validators: int = 8
+    light_nodes: int = 64
+    heights: int = 6
+    scheme: str = "rs2d-nmt"
+    samples_per_header: int = 2
+    txs_per_height: int = 0
+    sweep_interval: float = 1.0
+    latency: tuple[float, float] = (0.005, 0.02)
+    duration: float = 0.0  # 0 = auto from heights
+    ops: tuple = ()
+    # fault-registry arms (faults.arm_from_spec shape): armed for the
+    # run with the registry reseeded to the scenario seed, so
+    # probabilistic faults (e.g. net.request drops against the light
+    # fleet's transport) trigger in a reproducible sequence
+    faults: tuple = ()
+
+    def auto_duration(self, ccfg: SimConsensusConfig) -> float:
+        if self.duration > 0:
+            return self.duration
+        # heights at one block_interval each + room for two full timeout
+        # cascades and a sampling tail. The fleet term matters: every
+        # light node's retry/escalation backoffs ADVANCE the one shared
+        # timeline (a sleep anywhere is virtual seconds everywhere), so
+        # a large fleet hammering a withheld height inflates clock time
+        # without slowing event order — budget for it or the run wall
+        # cuts the chain's tail off.
+        per = ccfg.block_interval + 0.2
+        return self.heights * per + 2 * (
+            ccfg.timeout_propose + ccfg.timeout_prevote
+            + ccfg.timeout_precommit) + 6.0 + 0.15 * self.light_nodes
+
+    @staticmethod
+    def from_dict(doc: dict) -> "SimSpec":
+        known = {f.name for f in dataclasses.fields(SimSpec)}
+        unknown = set(doc) - known - {"consensus"}
+        if unknown:
+            raise ValueError(f"unknown scenario spec keys: {sorted(unknown)}")
+        kw = {k: v for k, v in doc.items() if k in known}
+        if "latency" in kw:
+            kw["latency"] = tuple(kw["latency"])
+        if "ops" in kw:
+            kw["ops"] = tuple(dict(op) for op in kw["ops"])
+        if "faults" in kw:
+            kw["faults"] = tuple(dict(f) for f in kw["faults"])
+        return SimSpec(**kw)
+
+
+class Simulation:
+    """Build the world from a SimSpec, run it, surface raw results.
+    Verdict computation (metrics + expectations) lives in scenarios.py."""
+
+    def __init__(self, spec: SimSpec, workdir: str,
+                 ccfg: SimConsensusConfig | None = None):
+        self.spec = spec
+        self.ccfg = ccfg or SimConsensusConfig()
+        self.chain_id = f"sim-{spec.name}"
+        self.sched = Scheduler(spec.seed)
+        self.net = SimNet(self.sched, spec.latency)
+        self.forged_headers: dict[int, tuple] = {}
+        # results
+        self.commit_times: dict[int, float] = {}  # first commit per h
+        self.val_commit_log: list[tuple] = []  # (t, name, height)
+        self.block_hashes: dict[int, str] = {}
+        self.app_hashes: dict[int, str] = {}
+        self.detections: list[dict] = []  # non-"sampled" light reports
+        self.light_halts: list[dict] = []
+        self.divergence: list[str] = []
+        self._commit_hooks: dict[int, list] = {}  # height -> [fn(sim)]
+        self._tx_seq = 0
+
+        # validator identities are a function of the SLOT, never the
+        # seed: the seed explores event orderings of the SAME world, so
+        # fault-free consensus bytes stay seed-invariant (satellite pin)
+        privs = [PrivateKey.from_seed(f"sim-val-{i}".encode())
+                 for i in range(spec.validators)]
+        genesis = {
+            "time_unix": self.sched.clock.epoch,
+            "accounts": [
+                {"address": p.public_key().address().hex(),
+                 "balance": 10**13}
+                for p in privs
+            ],
+            "validators": [
+                {"operator": p.public_key().address().hex(), "power": 10,
+                 "pubkey": p.public_key().compressed.hex()}
+                for p in privs
+            ],
+        }
+        self.genesis = genesis
+        self.privs = privs
+        self.validators: list[SimValidator] = []
+        vnodes = []
+        for i, p in enumerate(privs):
+            vnode = c.ValidatorNode(
+                f"val{i}", p, genesis, self.chain_id,
+                data_dir=os.path.join(workdir, f"val{i}"),
+                da_scheme=spec.scheme,
+            )
+            # mempool TTL on the virtual timeline (the injected-clock
+            # satellite): stamps and expiry run in simulated seconds
+            vnode.pool.clock = self.sched.clock
+            vnodes.append(vnode)
+        # peer pubkey exchange (the LocalNetwork handshake analog)
+        peer_keys = {v.address: v.priv.public_key().compressed
+                     for v in vnodes}
+        for v in vnodes:
+            v.validator_pubkeys = {**peer_keys, **v.validator_pubkeys}
+        order = sorted(range(len(vnodes)),
+                       key=lambda i: vnodes[i].address)
+        for slot, i in enumerate(order):
+            sv = SimValidator(self, slot, vnodes[i])
+            self.validators.append(sv)
+            self.net.register(sv.name, sv.route)
+        self.lights = [SimLightNode(self, i)
+                       for i in range(spec.light_nodes)]
+        # the tx signer: account 0 funds every injected MsgSend; content
+        # is a pure function of (chain height, injection counter), so
+        # fault-free runs commit identical blocks under every seed
+        from celestia_app_tpu.client.tx_client import Signer
+
+        self.signer = Signer(self.chain_id)
+        for i, p in enumerate(privs):
+            self.signer.add_account(p, number=i)
+
+    # -- schedule-time helpers ------------------------------------------
+
+    def block_timestamp(self, height: int) -> float:
+        """Header timestamps follow the fixed per-height schedule, NOT
+        the event clock: consensus bytes must be seed-independent in
+        fault-free runs (the engine never perturbs consensus)."""
+        return self.sched.clock.epoch + height * self.ccfg.block_time
+
+    def validator_by_index(self, i: int) -> SimValidator:
+        return self.validators[i % len(self.validators)]
+
+    def at(self, t: float, fn, label: str) -> None:
+        self.sched.call_at(t, fn, label)
+
+    def on_commit_height(self, height: int, fn) -> None:
+        """Run `fn(sim, committer)` when the FIRST validator commits
+        `height` — the committer is the only node guaranteed to hold the
+        height's state at that instant."""
+        self._commit_hooks.setdefault(height, []).append(fn)
+
+    def withhold_everywhere(self, height: int, cells) -> None:
+        for v in self.validators:
+            v.core.withhold(height, cells)
+
+    def tx_hook(self, height: int, proposer: SimValidator) -> None:
+        """Deterministic per-height load: inject txs_per_height MsgSends
+        into the proposer's pool right before it proposes. Sequence
+        numbers follow the injection counter, so content is identical
+        under every seed (fault-free) and every re-run (same seed)."""
+        from celestia_app_tpu.chain.tx import MsgSend
+
+        n = self.spec.txs_per_height
+        if n <= 0:
+            return
+        a0 = self.privs[0].public_key().address()
+        a1 = self.privs[1 % len(self.privs)].public_key().address()
+        for _ in range(n):
+            self.signer.accounts[a0].sequence = self._tx_seq
+            tx = self.signer.create_tx(
+                a0, [MsgSend(a0, a1, 1000 + self._tx_seq)],
+                fee=2000, gas_limit=100_000,
+            )
+            res = proposer.vnode.add_tx(tx.encode())
+            if res.code == 0:
+                self._tx_seq += 1
+
+    # -- result intake ---------------------------------------------------
+
+    def _note_commit(self, val: SimValidator, height: int, block, cert,
+                     adopted: bool = False) -> None:
+        t = self.sched.clock.monotonic()
+        bh = block.header.hash().hex()
+        ah = val.app_hashes[height]
+        self.val_commit_log.append((round(t, 9), val.name, height))
+        if height not in self.commit_times:
+            self.commit_times[height] = round(t, 9)
+            self.block_hashes[height] = bh
+            self.app_hashes[height] = ah
+        else:
+            if (self.block_hashes[height], self.app_hashes[height]) \
+                    != (bh, ah):
+                self.divergence.append(
+                    f"h={height} {val.name}: block/app hash mismatch")
+        self.sched.note(
+            f"{val.name}.{'adopt' if adopted else 'commit'} h={height} "
+            f"block={bh[:12]} app={ah[:12]}")
+        for fn in self._commit_hooks.pop(height, []):
+            fn(self, val)
+
+    def _note_report(self, lightnode: SimLightNode, height: int,
+                     rep: dict) -> None:
+        status = rep["status"]
+        if status in ("sampled", "recovered"):
+            return
+        self.detections.append({
+            "t": round(self.sched.clock.monotonic(), 9),
+            "light": lightnode.name,
+            "height": height,
+            "status": status,
+            "chain_height": max(self.commit_times, default=0),
+        })
+        self.sched.note(
+            f"{lightnode.name}.report h={height} status={status}")
+
+    def _note_light_halt(self, lightnode: SimLightNode,
+                         halt: dict) -> None:
+        self.light_halts.append({
+            "t": round(self.sched.clock.monotonic(), 9),
+            "light": lightnode.name,
+            **halt,
+        })
+        self.sched.note(
+            f"{lightnode.name}.halt h={halt.get('height')} "
+            f"reason={halt.get('reason')}")
+
+    # -- run -------------------------------------------------------------
+
+    def run(self) -> "Simulation":
+        spec = self.spec
+        for v in self.validators:
+            self.sched.call_at(0.0, lambda v=v: v.begin_height(1),
+                               f"{v.name}.begin_height h=1")
+            self.sched.call_after(
+                self.ccfg.catchup_poll
+                * (1.0 + self.sched.rng.random()),  # lint: disable=det-rng
+                v.catchup_poll, "")
+        for i, ln in enumerate(self.lights):
+            # seeded phase offsets spread the fleet across the sweep
+            # period instead of thundering at one instant
+            self.sched.call_at(
+                0.5 + spec.sweep_interval * self.sched.rng.random(),  # lint: disable=det-rng
+                ln.sweep, f"{ln.name}.sweep")
+        self.sched.run(until=spec.auto_duration(self.ccfg))
+        if self.divergence:
+            raise AssertionError(
+                "consensus divergence in simulation: "
+                + "; ".join(self.divergence[:5]))
+        return self

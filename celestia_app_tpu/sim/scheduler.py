@@ -1,0 +1,100 @@
+"""Seeded discrete-event scheduler: the simulation's one timeline.
+
+A classic event-heap simulator with one deliberate twist: *concurrent*
+events (equal firing times) are ordered by a tiebreak drawn from the
+scheduler's own seeded rng at schedule time, not by insertion order. Two
+runs with the same seed therefore execute the identical event sequence
+(byte-identical trace); two runs with different seeds explore different
+interleavings of the same concurrent events — exactly the adversarial
+reordering the consensus and DAS planes must be invariant to (the
+fault-free cross-seed app-hash pin in tests/test_scenarios.py).
+
+Events run to completion on the caller's thread; there is no real
+concurrency anywhere in a simulation, which is what makes hundreds of
+nodes deterministic in one process. Callbacks may advance the clock
+further (a DASer retry backoff sleeps virtual seconds mid-event) and may
+schedule new events at or after the current instant.
+
+The execution trace (``(time, label)`` per executed event, plus any
+``note()`` rows callbacks append) is the determinism witness: its sha256
+is part of every scenario verdict.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import random
+
+from celestia_app_tpu.utils.clock import VirtualClock
+
+
+class Scheduler:
+    """One seeded event heap bound to one VirtualClock."""
+
+    def __init__(self, seed: int, epoch: float = 1_700_000_000.0):
+        self.seed = seed
+        self.clock = VirtualClock(epoch=epoch)
+        # seeded at construction from the scenario seed: THE one entropy
+        # root of a simulation (det-rng scope pins that nothing else in
+        # sim/ draws ambient randomness)
+        self.rng = random.Random(seed)  # lint: disable=det-rng
+        # (time, tiebreak, seq, label, fn) — seq is the last-resort
+        # total-order key so equal (time, tiebreak) pairs cannot compare
+        # the (uncomparable) callbacks
+        self._heap: list[tuple] = []
+        self._seq = 0
+        self.executed = 0
+        self.trace: list[tuple[float, str]] = []
+
+    # -- scheduling ------------------------------------------------------
+
+    def call_at(self, t: float, fn, label: str = "") -> None:
+        t = max(t, self.clock.monotonic())
+        heapq.heappush(
+            self._heap, (t, self.rng.random(), self._seq, label, fn)
+        )
+        self._seq += 1
+
+    def call_after(self, dt: float, fn, label: str = "") -> None:
+        self.call_at(self.clock.monotonic() + max(dt, 0.0), fn, label)
+
+    # -- the run loop ----------------------------------------------------
+
+    def note(self, label: str) -> None:
+        """Append a trace row at the current instant (scenario hooks and
+        node decisions use this so verdict-relevant transitions are part
+        of the determinism witness, not only event firings)."""
+        self.trace.append((round(self.clock.monotonic(), 9), label))
+
+    def run(self, until: float, max_events: int = 2_000_000) -> None:
+        """Execute events in (time, tiebreak, seq) order until the heap
+        drains, simulated time passes `until`, or the event bound trips
+        (a runaway-feedback backstop, far above any real scenario)."""
+        while self._heap and self.executed < max_events:
+            t, _tie, _seq, label, fn = self._heap[0]
+            if t > until:
+                break
+            heapq.heappop(self._heap)
+            self.clock.advance_to(t)
+            self.executed += 1
+            if label:
+                self.trace.append((round(t, 9), label))
+            fn()
+        if (self.executed >= max_events and self._heap
+                and self._heap[0][0] <= until):
+            # only a run that still HAD due work when the bound tripped
+            # is a runaway; landing exactly on the bound with a drained
+            # (or post-horizon) heap is a completed run
+            raise RuntimeError(
+                f"scheduler exceeded {max_events} events before t={until}"
+            )
+        self.clock.advance_to(until)
+
+    # -- the determinism witness ----------------------------------------
+
+    def trace_digest(self) -> str:
+        h = hashlib.sha256()
+        for t, label in self.trace:
+            h.update(f"{t:.9f} {label}\n".encode())
+        return h.hexdigest()

@@ -153,6 +153,13 @@ def _strip_comment(value: str) -> str:
 class RuleConfig:
     severity: str = "error"          # error | warning | off
     include: list[str] = dataclasses.field(default_factory=list)
+    # scanned exactly like `include`, but DECLARED extra scope: files a
+    # rule covers on purpose beyond the consensus-reachable set (e.g.
+    # the scenario plane's sim/ + utils/clock.py under det-wallclock),
+    # so the scope audit does not flag them as dead include entries. If
+    # one ever becomes consensus-reachable, scope-drift still demands
+    # its promotion into `include`.
+    include_extra: list[str] = dataclasses.field(default_factory=list)
     exclude: list[str] = dataclasses.field(default_factory=list)
     allow: list[str] = dataclasses.field(default_factory=list)
     options: dict = dataclasses.field(default_factory=dict)
@@ -180,7 +187,8 @@ class AnalyzeConfig:
         return cfg
 
 
-_KNOWN_RULE_KEYS = {"severity", "include", "exclude", "allow"}
+_KNOWN_RULE_KEYS = {"severity", "include", "include_extra", "exclude",
+                    "allow"}
 
 
 def config_from_dict(doc: dict, source_path: str | None = None,
@@ -200,6 +208,7 @@ def config_from_dict(doc: dict, source_path: str | None = None,
         cfg.rules[rule_id] = RuleConfig(
             severity=sev,
             include=list(body.get("include", [])),
+            include_extra=list(body.get("include_extra", [])),
             exclude=list(body.get("exclude", [])),
             allow=list(body.get("allow", [])),
             options={k: v for k, v in body.items()

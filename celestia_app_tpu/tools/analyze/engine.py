@@ -216,18 +216,19 @@ def _in_scope(path: str, cfg: RuleConfig) -> bool:
         return False
     if any(_path_matches(path, e) for e in cfg.allow):
         return False
-    if cfg.include:
-        return any(_path_matches(path, e) for e in cfg.include)
+    if cfg.include or cfg.include_extra:
+        return any(_path_matches(path, e)
+                   for e in (*cfg.include, *cfg.include_extra))
     return True
 
 
 def _symbol_scopes(path: str, cfg: RuleConfig) -> list[str] | None:
     """The ``::symbol`` restrictions that apply to this file, or None
     when any plain include (or no include at all) covers it whole."""
-    if not cfg.include:
+    if not cfg.include and not cfg.include_extra:
         return None
     symbols: list[str] = []
-    for e in cfg.include:
+    for e in (*cfg.include, *cfg.include_extra):
         base, _, sym = e.partition("::")
         if not _path_matches(path, base):
             continue

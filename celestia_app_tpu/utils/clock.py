@@ -1,0 +1,130 @@
+"""THE time source abstraction: every library-side sleep/backoff/TTL clock.
+
+The scenario plane (docs/DESIGN.md "The scenario plane") needs to run
+hours of chain time in seconds, deterministically: tens of validators and
+hundreds of DASer light nodes in one process, same seed ⇒ byte-identical
+event trace. That is impossible while the reactor's poll loops, the
+transport's retry backoff and breaker timers, the DASer's sweep/retry
+backoffs, and the mempool's wall-clock TTL stamps each read ``time.time``
+/ ``time.monotonic`` / ``time.sleep`` directly — so those components now
+take an injected :class:`Clock`.
+
+Two implementations:
+
+- :class:`SystemClock` (the module singleton ``SYSTEM``) is the default
+  everywhere: it delegates straight to the ``time`` module, so production
+  behavior is unchanged (pinned by the pre-existing reactor/DASer/
+  transport test suites, which never pass a clock).
+- :class:`VirtualClock` is the simulation time source: ``now()`` returns
+  simulated seconds, ``sleep()`` ADVANCES simulated time instead of
+  blocking, and ``wait()`` resolves an event wait against simulated time.
+  The sim scheduler (celestia_app_tpu/sim/scheduler.py) owns one and
+  steps it from a seeded event heap.
+
+The one behavioral improvement to the default path: ``wait(event, t)`` is
+the *interruptible* sleep — ``SystemClock.wait`` is ``event.wait(t)`` —
+so loops that used to hard-sleep (``time.sleep(poll)``) and made
+``stop()`` block up to a full poll interval now wake the moment their
+stop event is set.
+
+Determinism contract (enforced by the analysis plane: this module and
+``sim/`` ride the det-wallclock/det-rng scopes in analyze.toml): the ONLY
+raw wall-clock reads live in ``SystemClock``, below, each carrying an
+explicit pragma — any other ``time.time()``/``random`` reachable from a
+scenario run is a tree error.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+
+
+class Clock:
+    """Abstract time source. ``now()`` is wall-clock-shaped (unix
+    seconds: block timestamps, TTL stamps); ``monotonic()`` is
+    deadline-shaped (never goes backwards; breaker timers, phase
+    timeouts). A VirtualClock serves both from the one simulated
+    timeline."""
+
+    def now(self) -> float:
+        raise NotImplementedError
+
+    def monotonic(self) -> float:
+        raise NotImplementedError
+
+    def sleep(self, seconds: float) -> None:
+        raise NotImplementedError
+
+    def wait(self, event: threading.Event, timeout: float) -> bool:
+        """Interruptible sleep: return as soon as `event` is set (True)
+        or `timeout` elapses (the event's state). THE primitive every
+        stoppable loop must use instead of a bare sleep."""
+        raise NotImplementedError
+
+
+class SystemClock(Clock):
+    """Real time — the production default, pinned to the ``time``
+    module's behavior exactly."""
+
+    def now(self) -> float:
+        return _time.time()  # lint: disable=det-wallclock
+
+    def monotonic(self) -> float:
+        return _time.monotonic()  # lint: disable=det-wallclock
+
+    def sleep(self, seconds: float) -> None:
+        _time.sleep(seconds)
+
+    def wait(self, event: threading.Event, timeout: float) -> bool:
+        return event.wait(timeout)
+
+
+#: The process default. Components accept ``clock=None`` and fall back to
+#: this, so existing call sites (and production processes) are unchanged.
+SYSTEM = SystemClock()
+
+
+class VirtualClock(Clock):
+    """Simulated time. ``sleep(dt)`` advances the timeline by ``dt``
+    immediately — inside a simulation event, backoffs and retry delays
+    cost virtual seconds, not real ones — and ``wait(event, t)`` resolves
+    instantly against simulated time. The scheduler additionally calls
+    :meth:`advance_to` when it pops each event, so time never runs
+    backwards (events scheduled in the past run "late" at the current
+    simulated instant, exactly like an overloaded real node).
+
+    ``now()`` is ``epoch + elapsed``: wall-clock-shaped consumers (TTL
+    stamps) see plausible unix times while ``monotonic()`` counts
+    simulated seconds from zero.
+    """
+
+    def __init__(self, epoch: float = 1_700_000_000.0):
+        self.epoch = epoch
+        self._t = 0.0  # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    def now(self) -> float:
+        return self.epoch + self.monotonic()
+
+    def monotonic(self) -> float:
+        with self._lock:
+            return self._t
+
+    def sleep(self, seconds: float) -> None:
+        if seconds <= 0:
+            return
+        with self._lock:
+            self._t += seconds
+
+    def wait(self, event: threading.Event, timeout: float) -> bool:
+        if event.is_set():
+            return True
+        self.sleep(timeout)
+        return event.is_set()
+
+    def advance_to(self, t: float) -> None:
+        """Move simulated time forward to `t` (never backwards)."""
+        with self._lock:
+            if t > self._t:
+                self._t = t

@@ -1,0 +1,237 @@
+"""Scenario-plane foundations: the Clock abstraction, the seeded event
+scheduler, and the clock-threaded library loops (reactor interruptible
+waits, transport breaker timers, DASer/PeerSet backoffs, mempool TTL
+stamps) — the satellite pins of ISSUE 14."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from celestia_app_tpu.sim.scheduler import Scheduler
+from celestia_app_tpu.utils.clock import SYSTEM, SystemClock, VirtualClock
+
+
+# -- the clock abstraction --------------------------------------------------
+
+
+def test_virtual_clock_semantics():
+    clk = VirtualClock(epoch=1_700_000_000.0)
+    assert clk.monotonic() == 0.0
+    assert clk.now() == 1_700_000_000.0
+    clk.sleep(2.5)
+    assert clk.monotonic() == 2.5
+    assert clk.now() == 1_700_000_002.5
+    clk.sleep(-1.0)  # negative sleeps are no-ops, never rewinds
+    assert clk.monotonic() == 2.5
+    clk.advance_to(1.0)  # never backwards
+    assert clk.monotonic() == 2.5
+    clk.advance_to(10.0)
+    assert clk.monotonic() == 10.0
+
+
+def test_virtual_clock_wait_resolves_against_virtual_time():
+    clk = VirtualClock()
+    ev = threading.Event()
+    t0 = time.monotonic()
+    assert clk.wait(ev, 3600.0) is False  # an hour of chain time...
+    assert time.monotonic() - t0 < 1.0  # ...in real milliseconds
+    assert clk.monotonic() == 3600.0
+    ev.set()
+    assert clk.wait(ev, 10.0) is True
+    assert clk.monotonic() == 3600.0  # a set event costs no virtual time
+
+
+def test_system_clock_wait_is_interruptible():
+    ev = threading.Event()
+    threading.Timer(0.05, ev.set).start()
+    t0 = time.monotonic()
+    assert SystemClock().wait(ev, 30.0) is True
+    assert time.monotonic() - t0 < 5.0  # woke on the event, not timeout
+
+
+# -- the seeded scheduler ---------------------------------------------------
+
+
+def _ordering(seed: int) -> list[str]:
+    sched = Scheduler(seed)
+    out: list[str] = []
+    for name in "abcdefgh":
+        # all at the same instant: order is decided by the seeded
+        # tiebreak alone
+        sched.call_at(1.0, lambda n=name: out.append(n), f"ev.{name}")
+    sched.run(until=2.0)
+    return out
+
+
+def test_scheduler_seeded_ordering_is_deterministic():
+    assert _ordering(7) == _ordering(7)
+    orders = {tuple(_ordering(s)) for s in range(6)}
+    assert len(orders) > 1  # different seeds explore different orders
+
+
+def test_scheduler_trace_and_time():
+    sched = Scheduler(0)
+    seen = []
+    sched.call_after(1.0, lambda: seen.append(sched.clock.monotonic()),
+                     "one")
+    sched.call_after(0.25, lambda: sched.call_after(
+        0.25, lambda: seen.append(sched.clock.monotonic()), "inner"),
+        "outer")
+    sched.run(until=10.0)
+    assert seen == [0.5, 1.0]
+    assert [label for _t, label in sched.trace] == ["outer", "inner",
+                                                    "one"]
+    assert sched.trace_digest() == sched.trace_digest()
+
+
+def test_scheduler_event_bound_trips():
+    sched = Scheduler(0)
+
+    def feedback():
+        sched.call_after(0.001, feedback, "loop")
+
+    sched.call_at(0.0, feedback, "loop")
+    with pytest.raises(RuntimeError, match="exceeded"):
+        sched.run(until=1e9, max_events=500)
+
+
+# -- reactor: interruptible waits (satellite 2) -----------------------------
+
+
+def _one_validator_reactor(tmp_path, poll: float, block_interval: float):
+    from celestia_app_tpu.chain import consensus as c
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.chain.reactor import (
+        ConsensusReactor,
+        ReactorConfig,
+    )
+
+    priv = PrivateKey.from_seed(b"sim-engine-reactor")
+    genesis = {
+        "time_unix": 1_700_000_000.0,
+        "accounts": [{"address": priv.public_key().address().hex(),
+                      "balance": 10**12}],
+        "validators": [{"operator": priv.public_key().address().hex(),
+                        "power": 10,
+                        "pubkey": priv.public_key().compressed.hex()}],
+    }
+    vnode = c.ValidatorNode("solo", priv, genesis, "sim-reactor-test",
+                            data_dir=str(tmp_path / "solo"))
+    cfg = ReactorConfig(poll=poll, block_interval=block_interval,
+                        timeout_propose=poll * 2, timeout_prevote=poll,
+                        timeout_precommit=poll)
+    return vnode, ConsensusReactor(vnode, [], threading.Lock(), cfg)
+
+
+def test_reactor_stop_does_not_block_on_sleeps(tmp_path):
+    """stop() used to lose up to a full poll/block_interval to fixed
+    time.sleep calls (chain/reactor.py error + inter-height paths); the
+    clock's wait-with-wakeup returns the moment _stop is set."""
+    vnode, reactor = _one_validator_reactor(
+        tmp_path, poll=5.0, block_interval=30.0)
+    reactor.start()
+    try:
+        deadline = time.monotonic() + 60.0
+        while vnode.app.height < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert vnode.app.height >= 1, "solo validator never committed"
+    finally:
+        # the reactor now sits in the 30 s inter-height pause (or a 5 s
+        # poll wait); both must be interrupted by stop() immediately
+        t0 = time.monotonic()
+        reactor.stop()
+        elapsed = time.monotonic() - t0
+    assert elapsed < 3.0, f"stop() blocked {elapsed:.1f}s behind a sleep"
+
+
+def test_reactor_defaults_to_system_clock(tmp_path):
+    vnode, reactor = _one_validator_reactor(tmp_path, 0.02, 0.05)
+    assert reactor.clock is SYSTEM
+    assert reactor.net.clock is SYSTEM  # handed down to the transport
+
+
+# -- transport breaker + backoff on an injected clock -----------------------
+
+
+def test_breaker_timers_run_on_the_injected_clock():
+    from celestia_app_tpu.net.transport import PeerClient, TransportConfig
+
+    clk = VirtualClock()
+    pc = PeerClient(TransportConfig(failure_threshold=2,
+                                    reset_timeout=10.0),
+                    name="simtest", clock=clk)
+    url = "http://127.0.0.1:1"
+    assert pc.available(url)
+    pc.penalize(url, "bad chunk")
+    pc.penalize(url, "bad chunk")
+    assert not pc.available(url)  # breaker opened on the virtual clock
+    clk.sleep(9.0)
+    assert not pc.available(url)
+    clk.sleep(1.0)  # reset_timeout reached in VIRTUAL seconds
+    assert pc.available(url)
+
+
+def test_peerset_backoff_advances_virtual_time_only():
+    from celestia_app_tpu.das.daser import PeerError, PeerSet
+
+    class Refusing:
+        def request(self, url, path, payload=None, raw=False):
+            raise OSError("refused")
+
+        def penalize(self, url, reason):
+            pass
+
+    clk = VirtualClock()
+    ps = PeerSet(["sim://a", "sim://b"], retries=3, backoff=0.5,
+                 client=Refusing(), clock=clk)
+    t0 = time.monotonic()
+    with pytest.raises(PeerError):
+        ps.request("/das/head")
+    assert time.monotonic() - t0 < 1.0  # no real sleeping
+    assert clk.monotonic() == 0.5 + 1.0  # two backoff rounds, doubled
+
+
+def test_daser_defaults_to_system_clock(tmp_path):
+    from celestia_app_tpu.chain import light
+    from celestia_app_tpu.das.checkpoint import CheckpointStore
+    from celestia_app_tpu.das.daser import DASer
+
+    trust = light.TrustedState(height=0, header_hash=b"", validators={},
+                               powers={})
+    d = DASer(["http://127.0.0.1:1"],
+              light.LightClient("clk-test", trust),
+              CheckpointStore(str(tmp_path / "cp.json")))
+    assert d.clock is SYSTEM
+    assert d.peers.clock is SYSTEM
+
+
+# -- mempool TTL stamps through the injected clock --------------------------
+
+
+def test_mempool_ttl_expires_on_virtual_time():
+    from celestia_app_tpu.mempool.pool import CATPool
+
+    clk = VirtualClock()
+    pool = CATPool(ttl_blocks=10_000, ttl_seconds=30.0, clock=clk)
+    pool.add(b"tx-virtual", height=1)
+    assert len(pool) == 1
+    # real time passes, virtual time does not: no expiry
+    assert pool.expire(height=1) == []
+    clk.sleep(31.0)  # half a minute of chain time, instantly
+    dropped = pool.expire(height=1)
+    assert [e.raw for e in dropped] == [b"tx-virtual"]
+    assert len(pool) == 0
+
+
+def test_mempool_defaults_to_system_clock():
+    from celestia_app_tpu.mempool.pool import CATPool
+
+    pool = CATPool()
+    assert pool.clock is SYSTEM
+    pool.add(b"tx-system", height=1)
+    # stamps come from the system clock now
+    entry = pool.entries()[0]
+    assert abs(entry.time_added - time.time()) < 60.0
