@@ -1139,6 +1139,48 @@ def measure_admission(n_sigs: int = 512, n_senders: int = 32,
         "scalar_verified": counters.get("admission.sig_scalar_verified", 0),
     }), flush=True)
 
+    # -- 3) traffic plane: the commitment half of phase 1 ----------------
+    # a PFB burst through the same two-phase path, reported as the
+    # commitment.* counter deltas (FORMATS §12.3; the throughput
+    # head-to-head lives in --txsim — this line is the admission block's
+    # counter surface)
+    from celestia_app_tpu.da.blob import Blob
+    from celestia_app_tpu.da.namespace import Namespace
+
+    rng_np = np.random.default_rng(1)
+    blob_raws = []
+    # same lane count as the ingest burst (senders x txs_per_sender =
+    # 512), so the phase-1 SIG batch reuses part 1/2's compiled bucket
+    # and the measured rate prices admission, not a fresh jit compile
+    for seq in range(ingest_txs_per_sender):
+        for i, a in enumerate(addrs):
+            blobs = [Blob(Namespace.v0(bytes([i + 1, (seq % 250) + 1]) * 5),
+                          rng_np.integers(0, 256, 700, dtype=np.uint8)
+                          .tobytes())]
+            blob_raws.append(signer.create_pay_for_blobs(
+                a, blobs, fee=300_000, gas_limit=5_000_000))
+            signer.accounts[a].sequence += 1
+    c0 = telemetry.snapshot().get("counters", {})
+    t0 = time.perf_counter()
+    blob_res = node.broadcast_txs(blob_raws)
+    burst_s = time.perf_counter() - t0
+    c1 = telemetry.snapshot().get("counters", {})
+
+    def delta(name: str) -> int:
+        return c1.get(name, 0) - c0.get(name, 0)
+
+    print(json.dumps({
+        "metric": "admission_commitment_batch",
+        "value": round(len(blob_raws) / burst_s, 1),
+        "unit": "blob-txs/s",
+        "n_blob_txs": len(blob_raws),
+        "admitted": sum(1 for r in blob_res if r.code == 0),
+        "commitment_batch_dispatches": delta("commitment.batch_dispatches"),
+        "commitment_batch_lanes": delta("commitment.batch_lanes"),
+        "commitment_cache_hits": delta("commitment.cache_hits"),
+        "commitment_recomputes": delta("commitment.recomputes"),
+    }), flush=True)
+
 
 def measure_mempool(n_senders: int = 16, txs_per_sender: int = 32) -> None:
     """Mempool plane microbench: CAT pool ingest (CheckTx + admission) and
@@ -2004,6 +2046,268 @@ def measure_serve() -> None:
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+def measure_txsim() -> None:
+    """Traffic-plane bench (--txsim). Three BENCH JSON lines:
+
+      {"metric": "blobs_per_sec", ...}  sustained blob load: N concurrent
+          txsim sequences (tools/txsim.run_load — one Signer account and
+          one persistent keep-alive HttpNodeClient each) submit PFB
+          blobs over HTTP against a live in-process devnet whose
+          producer commits blocks on an interval; every tx is
+          confirm-polled, so the number is END-TO-END admission->commit
+          blob throughput. Carries admission_commit p50/p99 and the run's
+          acceptance counts.
+      {"metric": "admission_commit_p99_ms", ...}  the same run's p99
+          submit->commit latency as its own metric line.
+      {"metric": "commitment_validate_per_sec", ...}  the tentpole's
+          head-to-head: admission commitment validation CACHED (one
+          batched prevalidation dispatch filling the
+          VerifiedCommitmentCache, then per-tx lookups) vs the COLD
+          per-tx host path (per-blob subtree-root MMRs in host Python,
+          the reference's ValidateBlobTx shape) over the same
+          >= 64-pending-blob set — acceptance is >= 3x at >= 64 blobs.
+
+    Backend labeling follows FORMATS §12.2 ("cpu-fallback" on CPU).
+    Env knobs: CELESTIA_BENCH_TXSIM_SEQUENCES (8), _TXS (8: per
+    sequence), _BLOBS (128: head-to-head pending set),
+    _BLOCK_TIME (0.2 s).
+    """
+    import jax
+
+    from celestia_app_tpu import appconsts
+    from celestia_app_tpu.chain import admission
+    from celestia_app_tpu.chain import blob_validation
+    from celestia_app_tpu.chain.app import App
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.chain.node import Node
+    from celestia_app_tpu.client.tx_client import Signer
+    from celestia_app_tpu.da import blob as blob_mod
+    from celestia_app_tpu.da.blob import Blob
+    from celestia_app_tpu.da.namespace import Namespace
+    from celestia_app_tpu.service.server import NodeService
+    from celestia_app_tpu.tools import txsim
+    from celestia_app_tpu.utils import telemetry
+
+    platform = jax.devices()[0].platform
+    backend = "cpu-fallback" if platform == "cpu" else platform
+    n_seq = int(os.environ.get("CELESTIA_BENCH_TXSIM_SEQUENCES", "8"))
+    txs_per_seq = int(os.environ.get("CELESTIA_BENCH_TXSIM_TXS", "8"))
+    n_blobs = int(os.environ.get("CELESTIA_BENCH_TXSIM_BLOBS", "128"))
+    block_time = float(os.environ.get("CELESTIA_BENCH_TXSIM_BLOCK_TIME",
+                                      "0.2"))
+
+    # -- 1) sustained load against a live devnet -------------------------
+    import shutil
+    import tempfile
+
+    chain = "txsim-bench"
+    privs = [PrivateKey.from_seed(b"txsim-bench-%d" % i)
+             for i in range(n_seq)]
+    addrs = [p.public_key().address() for p in privs]
+    tmp = tempfile.mkdtemp(prefix="txsim-bench-")
+    app = app_w = app_c = None
+    try:
+        # a data_dir so /abci_query path=tx (the confirm-polling route)
+        # has a block store to resolve against — like any real devnet home
+        app = App(chain_id=chain, engine="auto",
+                  data_dir=os.path.join(tmp, "data"))
+        app.init_chain({
+            "time_unix": 1_700_000_000.0,
+            "accounts": [{"address": a.hex(), "balance": 10**14}
+                         for a in addrs],
+            "validators": [{"operator": addrs[0].hex(), "power": 10}],
+        })
+        node = Node(app)
+        svc = NodeService(node, port=0)
+        svc.serve_background()
+        url = f"http://127.0.0.1:{svc.port}"
+        signer = Signer(chain)
+        for i, p in enumerate(privs):
+            signer.add_account(p, number=i)
+
+        def produce():
+            with svc.lock:
+                node.produce_block()
+
+        # warm the block pipeline's jit buckets before the measured window
+        # (a live devnet is warm; the submit->commit latency must price the
+        # traffic plane, not the first blocks' one-time compiles)
+        rng_w = np.random.default_rng(9)
+        for _r in range(3):
+            for i, a in enumerate(addrs[:2]):
+                wblobs = [Blob(Namespace.v0(bytes([99, i + 1]) * 5),
+                               rng_w.integers(
+                                   0, 256, int(rng_w.integers(100, 2000)),
+                                   dtype=np.uint8).tobytes())]
+                wraw = signer.create_pay_for_blobs(
+                    a, wblobs, fee=300_000, gas_limit=5_000_000)
+                if node.broadcast_tx(wraw).code == 0:
+                    signer.accounts[a].sequence += 1
+            produce()
+
+        driver = txsim.BlockDriver(produce, block_time=block_time)
+        driver.start()
+        c0 = telemetry.snapshot().get("counters", {})
+        try:
+            rep = txsim.run_load(
+                [url], signer, addrs,
+                txsim.LoadConfig(blob_sequences=n_seq,
+                                 txs_per_sequence=txs_per_seq,
+                                 blob_sizes=(100, 2000), blobs_per_pfb=(1, 2),
+                                 confirm_timeout_s=60.0, seed=0),
+            )
+        finally:
+            driver.stop()
+            svc.shutdown()
+        c1 = telemetry.snapshot().get("counters", {})
+
+        def delta(name: str) -> int:
+            return c1.get(name, 0) - c0.get(name, 0)
+
+        print(json.dumps({
+            "metric": "blobs_per_sec",
+            "value": rep.blobs_per_sec,
+            "unit": "blobs/s",
+            "sequences": rep.sequences,
+            "txs_per_sequence": txs_per_seq,
+            "pfbs_submitted": rep.pfbs_submitted,
+            "pfbs_accepted": rep.pfbs_accepted,
+            "pfbs_confirmed": rep.pfbs_confirmed,
+            "blobs_confirmed": rep.blobs_confirmed,
+            "bytes_submitted": rep.bytes_submitted,
+            "admission_commit_p50_ms": rep.admission_commit_p50_ms,
+            "admission_commit_p99_ms": rep.admission_commit_p99_ms,
+            "blocks_produced": driver.blocks,
+            "block_time_s": block_time,
+            "resyncs": rep.resyncs,
+            "errors": rep.errors,
+            "commitment_cache_hits": delta("commitment.cache_hits"),
+            "commitment_recomputes": delta("commitment.recomputes"),
+            "backend": backend,
+        }), flush=True)
+        print(json.dumps({
+            "metric": "admission_commit_p99_ms",
+            "value": rep.admission_commit_p99_ms,
+            "unit": "ms",
+            "p50_ms": rep.admission_commit_p50_ms,
+            "sequences": rep.sequences,
+            "confirmed": rep.pfbs_confirmed + rep.sends_confirmed,
+            "backend": backend,
+        }), flush=True)
+
+        # -- 2) cached vs cold commitment-validation throughput --------------
+        # COLD is the reference's shape: every validation phase recomputes
+        # each blob's commitment per tx in host Python (ValidateBlobTx in
+        # both CheckTx and ProcessProposal). CACHED is this PR's shape: ONE
+        # batched prevalidation dispatch at admission fills the
+        # VerifiedCommitmentCache, and every validation phase after it
+        # (CheckTx -> Prepare -> Process -> replay — 3+ passes per tx) is a
+        # lookup + byte-compare. `value` is the per-pass cached validation
+        # throughput (what each phase now pays); `admission_dispatch_s` and
+        # `incl_dispatch_per_sec` price the one-time batch honestly.
+        threshold = appconsts.subtree_root_threshold(1)
+        # devnet-scale blobs (the reference txsim submits up to ~100 KB);
+        # commitment cost scales with shares, so the size range is the knob
+        # that decides how much each phase's recompute used to cost
+        blob_lo_hi = [int(x) for x in os.environ.get(
+            "CELESTIA_BENCH_TXSIM_BLOB_BYTES", "1000-16000").split("-")]
+
+        def blob_tx_set(tag: bytes):
+            # same seed per set: identical shapes (jit buckets stay warm
+            # across sets), distinct namespaces keep the cache keys apart
+            rng = np.random.default_rng(2)
+            signer2 = Signer(chain)
+            for i, p in enumerate(privs):
+                signer2.add_account(p, number=i)
+            raws = []
+            for i in range(n_blobs):
+                a = addrs[i % len(addrs)]
+                size = int(rng.integers(blob_lo_hi[0], blob_lo_hi[1] + 1))
+                blobs = [Blob(Namespace.v0(tag + bytes([i % 251, i // 251]) * 4),
+                              rng.integers(0, 256, size, dtype=np.uint8)
+                              .tobytes())]
+                raws.append(signer2.create_pay_for_blobs(
+                    a, blobs, fee=300_000, gas_limit=5_000_000))
+                signer2.accounts[a].sequence += 1
+            return [blob_mod.try_unmarshal_blob_tx(r) for r in raws], raws
+
+        # warm the jit shape buckets so the dispatch number is steady-state
+        # (the one-time compile is reported separately, like --admission)
+        _warm_btxs, warm_raws = blob_tx_set(b"wa")
+        app_w = App(chain_id=chain, engine="auto")
+        t0 = time.perf_counter()
+        admission.prevalidate_commitments(app_w, warm_raws)
+        compile_s = time.perf_counter() - t0
+
+        from celestia_app_tpu.da import commitment as commitment_mod
+
+        cold_btxs, _ = blob_tx_set(b"co")
+        cold_items = [(btx.blobs[0], btx) for btx in cold_btxs]
+        # the commitment-validation component alone — the work the cache
+        # eliminates from every phase (per-blob host subtree-root MMR +
+        # byte-compare, the reference's ValidateBlobTx recompute):
+        t0 = time.perf_counter()
+        for blob, btx in cold_items:
+            want = commitment_mod.create_commitment(blob, threshold)
+            assert want is not None
+        cold_s = time.perf_counter() - t0
+        # and the whole validate_blob_tx (decode + gates + commitment), the
+        # end-to-end per-phase cost:
+        t0 = time.perf_counter()
+        for btx in cold_btxs:
+            blob_validation.validate_blob_tx(btx, threshold)  # per-tx host
+        cold_full_s = time.perf_counter() - t0
+
+        cached_btxs, cached_raws = blob_tx_set(b"ca")
+        app_c = App(chain_id=chain, engine="auto")
+        t0 = time.perf_counter()
+        admission.prevalidate_commitments(app_c, cached_raws)
+        dispatch_s = time.perf_counter() - t0
+        cache = app_c.commitment_cache
+        t0 = time.perf_counter()
+        for btx in cached_btxs:
+            blob = btx.blobs[0]
+            got = cache.hit(cache.key(blob.namespace.raw, blob.share_version,
+                                      blob.data, threshold))
+            assert got is not None
+        cached_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for btx in cached_btxs:
+            blob_validation.validate_blob_tx(btx, threshold, cache=cache)
+        cached_full_s = time.perf_counter() - t0
+
+        cold_per_sec = n_blobs / cold_s
+        cached_per_sec = n_blobs / cached_s
+        print(json.dumps({
+            "metric": "commitment_validate_per_sec",
+            "value": round(cached_per_sec, 1),
+            "unit": "blobs/s",
+            "cold_per_sec": round(cold_per_sec, 1),
+            "vs_cold": round(cached_per_sec / cold_per_sec, 2),
+            "full_validate_per_sec": round(n_blobs / cached_full_s, 1),
+            "full_validate_cold_per_sec": round(n_blobs / cold_full_s, 1),
+            "full_vs_cold": round(cold_full_s / cached_full_s, 2),
+            "pending_blobs": n_blobs,
+            "blob_bytes": blob_lo_hi,
+            "admission_dispatch_s": round(dispatch_s, 4),
+            "incl_dispatch_per_sec": round(
+                n_blobs / (dispatch_s + cached_s), 1),
+            "compile_s": round(max(0.0, compile_s - dispatch_s), 2),
+            "path": "one-batched-dispatch+cache-lookups vs per-tx-host",
+            "backend": backend,
+        }), flush=True)
+    finally:
+        # a failed run must not strand the temp block store or a
+        # flock-holding App (review hardening)
+        for a in (app, app_w, app_c):
+            if a is not None:
+                try:
+                    a.close()
+                except Exception:
+                    pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 # -- mode registry (--list prints it) ----------------------------------------
 # name -> (runner, emitted metrics, one-line description). The default
 # invocation (no flag) runs the deadline-driven headline measurement
@@ -2298,6 +2602,12 @@ MODES = {
              "state_sync_join_s, blocksync_blocks_per_sec, "
              "snapshot_serve_ms",
              "sync plane: chunked state-sync join vs full replay"),
+    "txsim": (measure_txsim,
+              "blobs_per_sec, admission_commit_p99_ms, "
+              "commitment_validate_per_sec",
+              "traffic plane: sustained confirm-polled blob load at a "
+              "live devnet + cached vs cold admission commitment "
+              "validation"),
     "serve": (measure_serve,
               "samples_served_per_sec, sampler_round_trips_per_height, "
               "p99_sample_ms, pack_hit_ratio",

@@ -23,6 +23,21 @@ off, hot, or cold — prevalidation is an optimization plane, never an
 authority, and any failure inside it degrades to the scalar path (counted
 in telemetry, `admission.prevalidate_errors`).
 
+The traffic plane (ISSUE 15) extends phase 1 with the SAME pattern for
+blob share commitments: the reference recomputes each blob's commitment
+in both CheckTx and ProcessProposal (`ValidateBlobTx`), and this repo
+used to pay a per-blob host-Python subtree-root MMR at CheckTx and then
+recompute every one of them again in ProcessProposal's batch.
+`prevalidate` now also computes ALL uncached pending blobs' commitments
+in one `da/commitment_device` dispatch and fills the App's
+`VerifiedCommitmentCache`; `blob_validation.validate_blob_tx` consults
+it before paying a host recompute, so a commitment checked at CheckTx
+is NEVER recomputed at PrepareProposal, ProcessProposal, FinalizeBlock,
+or WAL replay. The cache maps blob content to its COMPUTED-TRUE
+commitment — a hit can only skip a recompute that would have produced
+the identical bytes, so a Byzantine tx whose claimed commitment
+mismatches is rejected by the same byte-compare, warm cache or cold.
+
 Telemetry (the counters the tier-1 no-re-verification test pins):
   admission.sig_cache_hits       ante skipped a verify via the cache
   admission.sig_scalar_verified  ante ran a scalar verify (cache miss)
@@ -31,6 +46,14 @@ Telemetry (the counters the tier-1 no-re-verification test pins):
   admission.batch_verified       lanes that verified and were cached
   admission.batch_rejected       lanes that failed batch verification
   admission.prevalidate_below_batch  batches too small for the device
+
+Commitment counters (the tier-1 no-recompute test pins; FORMATS §20):
+  commitment.cache_hits          a validation consumed a cached commitment
+  commitment.recomputes          a validation paid a commitment compute
+                                 (per blob; host path or a cold batch)
+  commitment.batch_dispatches    prevalidation commitment batches
+  commitment.batch_lanes         blobs computed by prevalidation batches
+  commitment.prevalidate_below_batch  commitment batches below the gate
 """
 
 from __future__ import annotations
@@ -102,21 +125,231 @@ class VerifiedSigCache:
                 self._keys.popitem(last=False)
 
 
-def extract_sig_item(app, raw: bytes, store=None):
+COMMITMENT_CACHE_MAX = 16384
+
+
+def commitment_key(namespace: bytes, share_version: int, data: bytes,
+                   subtree_root_threshold: int) -> bytes:
+    """The commitment-cache key: sha256 over the length-framed
+    (namespace, share-version, blob bytes, threshold) tuple — exactly
+    the inputs `da/commitment.create_commitment` hashes from, framed so
+    no two distinct blobs can collide by concatenation ambiguity (two
+    blobs sharing a byte prefix must never share a key). The integer
+    fields encode as decimal bytes: total over ANY int, so an
+    adversarial tx carrying an out-of-range share version (the wire
+    varint is unbounded; Blob() does not validate on construction) can
+    never make prevalidation's key pass raise and knock the whole
+    window's honest blobs off the batch — it just gets a key, and the
+    ante's own validation rejects the blob later."""
+    h = hashlib.sha256()
+    for part in (namespace, b"%d" % share_version, data,
+                 b"%d" % subtree_root_threshold):
+        h.update(len(part).to_bytes(4, "big"))
+        h.update(part)
+    return h.digest()
+
+
+class VerifiedCommitmentCache:
+    """Bounded LRU of blob-content keys -> their COMPUTED share
+    commitment (32 bytes).
+
+    Lives on the App beside the VerifiedSigCache; CheckTx, both proposal
+    phases, and replay share it. Values are pure functions of the key
+    (the MMR-of-NMT-subtree-roots over the blob's shares), so the cache
+    survives rollbacks and reloads untouched, and a hit can only skip a
+    recompute that would have produced identical bytes — the Byzantine
+    mismatch case still rejects on the same byte-compare."""
+
+    def __init__(self, maxsize: int = COMMITMENT_CACHE_MAX):
+        self.maxsize = maxsize
+        self._lock = threading.Lock()
+        self._map: OrderedDict[bytes, bytes] = OrderedDict()  # guarded-by: _lock
+
+    key = staticmethod(commitment_key)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+    def hit(self, key: bytes) -> bytes | None:
+        """The cached commitment, counting the hit (a validation skipped
+        a recompute), or None on a miss."""
+        with self._lock:
+            value = self._map.get(key)
+            if value is not None:
+                self._map.move_to_end(key)
+                telemetry.incr("commitment.cache_hits")
+            return value
+
+    def contains(self, key: bytes) -> bool:
+        """Membership probe WITHOUT the hit counter or LRU refresh —
+        prevalidation's dedup uses this so `commitment.cache_hits`
+        keeps meaning "a validation skipped a recompute"."""
+        with self._lock:
+            return key in self._map
+
+    def put(self, key: bytes, commitment: bytes) -> None:
+        with self._lock:
+            self._map[key] = commitment
+            self._map.move_to_end(key)
+            while len(self._map) > self.maxsize:
+                self._map.popitem(last=False)
+
+
+def status_block(app) -> dict:
+    """The admission/traffic block both HTTP status surfaces serve
+    (/status and /consensus/status, FORMATS §12.3/§20.3): the verified-
+    sig and verified-commitment cache economics plus any co-resident
+    txsim load's counters. Counters are process-wide (exactly what
+    /metrics exposes); the cache sizes are this App's."""
+    counters = telemetry.snapshot().get("counters", {})
+
+    def g(name: str) -> int:
+        return counters.get(name, 0)
+
+    sig_cache = getattr(app, "sig_cache", None)
+    commitment_cache = getattr(app, "commitment_cache", None)
+    return {
+        "sig_cache_hits": g("admission.sig_cache_hits"),
+        "sig_scalar_verified": g("admission.sig_scalar_verified"),
+        "batch_verified": g("admission.batch_verified"),
+        "batch_rejected": g("admission.batch_rejected"),
+        "sig_cache_size": len(sig_cache) if sig_cache is not None else 0,
+        "commitment": {
+            "cache_hits": g("commitment.cache_hits"),
+            "recomputes": g("commitment.recomputes"),
+            "batch_dispatches": g("commitment.batch_dispatches"),
+            "batch_lanes": g("commitment.batch_lanes"),
+            "cache_size": (len(commitment_cache)
+                           if commitment_cache is not None else 0),
+        },
+        "txsim": {
+            "submitted": g("txsim.submitted"),
+            "accepted": g("txsim.accepted"),
+            "confirmed": g("txsim.confirmed"),
+            "rejected": g("txsim.rejected"),
+            "resyncs": g("txsim.resyncs"),
+            "errors": g("txsim.errors"),
+        },
+    }
+
+
+# sentinel for a raw whose BlobTx envelope failed to parse: both phase-1
+# halves skip it (the ante rejects it with its own error later)
+_UNDECODABLE = object()
+
+
+def _unmarshal_batch(raws) -> list:
+    """One envelope parse per raw, shared by both phase-1 halves (the
+    sig half and the commitment half must not each pay a full BlobTx
+    decode over devnet-scale blobs). Entries: a BlobTx, None (a plain
+    tx), or _UNDECODABLE."""
+    from celestia_app_tpu.da import blob as blob_mod
+
+    out = []
+    for raw in raws:
+        try:
+            out.append(blob_mod.try_unmarshal_blob_tx(raw))
+        except ValueError:
+            out.append(_UNDECODABLE)
+    return out
+
+
+def extract_blob_items(raws, btxs=None):
+    """Every blob of every decodable BlobTx in `raws`, in block order.
+    Undecodable entries are skipped — the ante/validate path remains the
+    authority and rejects them with its own error. `btxs` optionally
+    supplies the pre-parsed envelopes (_unmarshal_batch)."""
+    if btxs is None:
+        btxs = _unmarshal_batch(raws)
+    blobs = []
+    for btx in btxs:
+        if btx is not None and btx is not _UNDECODABLE:
+            blobs.extend(btx.blobs)
+    return blobs
+
+
+def prevalidate_commitments(app, raws, btxs=None) -> int:
+    """Phase 1, commitment half: compute the share commitments of every
+    pending blob not already in the App's verified-commitment cache in
+    ONE batched dispatch (da/commitment_device via
+    blob_validation.batch_commitments — device-class engines take the
+    vmapped SHA-256 subtree-root MMR launch, host engines the host
+    loop), and cache the results. Returns how many blobs were computed.
+    Never raises and never rejects: a blob that skips the batch simply
+    meets `validate_blob_tx`'s per-blob host compute later, with
+    identical bytes (counted `commitment.recomputes`)."""
+    from celestia_app_tpu import appconsts
+
+    cache = getattr(app, "commitment_cache", None)
+    if cache is None or not raws:
+        return 0
+    threshold = appconsts.subtree_root_threshold(app.app_version)
+    pending = []
+    keys = []
+    seen: set[bytes] = set()
+    for blob in extract_blob_items(raws, btxs=btxs):
+        try:
+            # stateless per-blob gate (share version, namespace, empty
+            # data): an adversarial blob must not reach the batch
+            # compute, where its malformed shape would throw the WHOLE
+            # window's honest blobs back onto the per-tx host path —
+            # the ante rejects the tx itself later with its own error
+            blob.validate()
+        except ValueError:
+            continue
+        key = commitment_key(blob.namespace.raw, blob.share_version,
+                             blob.data, threshold)
+        if key in seen or cache.contains(key):
+            continue
+        seen.add(key)
+        pending.append(blob)
+        keys.append(key)
+    if not pending:
+        return 0
+    if len(pending) < MIN_DEVICE_BATCH:
+        telemetry.incr("commitment.prevalidate_below_batch")
+        return 0
+    from celestia_app_tpu.chain import blob_validation
+
+    try:
+        commitments = blob_validation.batch_commitments(
+            pending, threshold, engine=getattr(app, "engine", "host"))
+    except Exception as e:
+        # the per-blob host path in validate_blob_tx stays authoritative
+        telemetry.incr("admission.prevalidate_errors")
+        from celestia_app_tpu import obs
+
+        obs.get_logger("chain.admission").error(
+            "batch commitment prevalidation failed; per-blob host path "
+            "takes over", err=e,
+        )
+        return 0
+    telemetry.incr("commitment.batch_dispatches")
+    telemetry.incr("commitment.batch_lanes", by=len(pending))
+    for key, commitment in zip(keys, commitments):
+        cache.put(key, commitment)
+    return len(pending)
+
+
+def extract_sig_item(app, raw: bytes, store=None, btx=_UNDECODABLE):
     """(pubkey, signature, sign-doc bytes) for one raw tx, or None when
     the tx cannot be prevalidated — undecodable, policy-rejected sig
     shape (non-64-byte or high-S, which `PublicKey.verify` refuses before
     any curve math), or a proto tx whose signer account does not exist
     yet (its sign doc needs the account number ensure_account will only
     assign inside the ante). None is never an error: the ante remains
-    the authority and simply verifies those txs on its scalar path."""
+    the authority and simply verifies those txs on its scalar path.
+    `btx` optionally supplies the pre-parsed envelope (prevalidate's
+    shared parse); the _UNDECODABLE default means "parse here"."""
     from celestia_app_tpu.chain.crypto import _N, PublicKey
     from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
     from celestia_app_tpu.chain.tx import decode_tx
     from celestia_app_tpu.da import blob as blob_mod
 
     try:
-        btx = blob_mod.try_unmarshal_blob_tx(raw)
+        if btx is _UNDECODABLE:  # standalone call: parse the envelope
+            btx = blob_mod.try_unmarshal_blob_tx(raw)
         tx = decode_tx(btx.tx if btx is not None else raw)
     except ValueError:
         return None
@@ -139,15 +372,35 @@ def extract_sig_item(app, raw: bytes, store=None):
     return (tx.pubkey, sig, doc)
 
 
-def prevalidate(app, raws, *, check_state: bool = False) -> int:
+def prevalidate(app, raws, *, check_state: bool = False,
+                commitments: bool = True) -> int:
     """Phase 1: batch-verify the signatures of `raws` that are not
     already in the App's verified-sig cache, in one device dispatch, and
-    cache the successes. Returns how many lanes verified. Never raises
-    and never rejects anything — a tx that fails (or skips) batch
-    verification simply meets the ante's scalar verify later and fails
-    THERE, with identical semantics."""
+    cache the successes — and batch-compute the share commitments of
+    their uncached blobs the same way (prevalidate_commitments).
+    Returns how many signature lanes verified. Never raises and never
+    rejects anything — a tx that fails (or skips) batch verification
+    simply meets the ante's scalar verify later and fails THERE, with
+    identical semantics.
+
+    ``commitments=False`` skips the commitment half: ProcessProposal
+    passes it because its own `resolve_commitments` already does ONE
+    keyed pass through the cache (running both would hash every blob's
+    bytes twice), and WAL replay passes it because delivery under a
+    commit certificate validates no commitments at all."""
+    if not raws:
+        return 0
+    btxs = _unmarshal_batch(raws)  # ONE envelope parse per raw, both halves
+    # commitment half first (its own try: a commitment failure must not
+    # cost the signature batch, and vice versa — both halves degrade
+    # independently to their scalar/host paths)
+    if commitments:
+        try:
+            prevalidate_commitments(app, raws, btxs=btxs)
+        except Exception:
+            telemetry.incr("admission.prevalidate_errors")
     cache = getattr(app, "sig_cache", None)
-    if cache is None or not raws:
+    if cache is None:
         return 0
     from celestia_app_tpu.ops import secp256k1 as fast
 
@@ -162,9 +415,11 @@ def prevalidate(app, raws, *, check_state: bool = False) -> int:
     items: list[tuple[bytes, bytes, bytes]] = []
     keys: list[bytes] = []
     seen: set[bytes] = set()
-    for raw in raws:
+    for raw, btx in zip(raws, btxs):
+        if btx is _UNDECODABLE:
+            continue  # malformed envelope: the ante rejects it itself
         try:
-            item = extract_sig_item(app, raw, store=store)
+            item = extract_sig_item(app, raw, store=store, btx=btx)
         except Exception:
             # prevalidation NEVER raises (callers may run it outside the
             # service lock, racing commits): an unexpected extraction

@@ -39,7 +39,7 @@ from celestia_app_tpu.utils import telemetry
 from celestia_app_tpu.chain.block import Block, Header, TxResult
 from celestia_app_tpu.chain.blob_validation import (
     BlobTxError,
-    batch_commitments,
+    resolve_commitments,
     validate_blob_tx,
 )
 from celestia_app_tpu.chain.state import (
@@ -336,6 +336,14 @@ class App:
         # prevalidation, or scalar in the ante — is never verified again
         # in any later phase. State-independent, so rollback/load leave it.
         self.sig_cache = admission_mod.VerifiedSigCache()
+        # the traffic plane's verified-commitment cache: a blob whose
+        # share commitment was computed once — batched at admission
+        # prevalidation or per blob in validate_blob_tx — is never
+        # recomputed at CheckTx/Prepare/Process/Finalize/replay; every
+        # phase still byte-compares the cached TRUE value against the
+        # tx's claim, so Byzantine mismatches reject warm or cold.
+        # State-independent (pure content hash), like the sig cache.
+        self.commitment_cache = admission_mod.VerifiedCommitmentCache()
         # the block plane's extend-once machinery (da/edscache.py):
         # a content-addressed LRU of (EDS, DAH, data root) keyed by the
         # ODS share bytes — prepare, process, finalize/commit, the query
@@ -566,7 +574,8 @@ class App:
         try:
             btx = blob_mod.try_unmarshal_blob_tx(raw)  # single parse
             if btx is not None:
-                tx, _ = validate_blob_tx(btx, threshold)
+                tx, _ = validate_blob_tx(btx, threshold,
+                                         cache=self.commitment_cache)
             else:
                 tx = decode_tx(raw)
                 if any(isinstance(m, MsgPayForBlobs) for m in tx.body.msgs):
@@ -621,7 +630,8 @@ class App:
                 continue
             if btx is not None:
                 try:
-                    validate_blob_tx(btx, threshold)
+                    validate_blob_tx(btx, threshold,
+                                     cache=self.commitment_cache)
                     blob_candidates.append((raw, PfbEntry(btx.tx, btx.blobs)))
                 except (BlobTxError, ValueError):
                     continue
@@ -766,8 +776,11 @@ class App:
         # admission plane, phase 1: verify the whole block's signatures
         # in one batched dispatch; the per-tx ante runs below then hit
         # the verified-sig cache (CheckTx-admitted txs are already in it
-        # and are not re-verified here at all)
-        admission_mod.prevalidate(self, block.txs)
+        # and are not re-verified here at all). commitments=False: the
+        # resolve_commitments pass below is THE one keyed trip through
+        # the commitment cache for this block — running the prevalidate
+        # half too would sha256 every blob's bytes twice
+        admission_mod.prevalidate(self, block.txs, commitments=False)
         normal_txs: list[bytes] = []
         pfb_entries: list[PfbEntry] = []
         # Batch all blob commitments of the block in one device pass
@@ -787,8 +800,14 @@ class App:
             elif seen_blob_scan:
                 # cheap reject before paying the device commitment batch
                 raise ValueError("normal tx after blob tx (ordering violation)")
-        all_commitments = batch_commitments(all_blobs, threshold,
-                                            engine=self.engine)
+        # traffic plane: commitments resolve through the verified-
+        # commitment cache — CheckTx-admitted blobs (and the proposer's
+        # own PrepareProposal pass) cost lookups here; only a cold
+        # follower pays one batched compute, which then fills the cache
+        # for finalize/replay/its own later proposals.
+        all_commitments = resolve_commitments(all_blobs, threshold,
+                                              engine=self.engine,
+                                              cache=self.commitment_cache)
         cursor = 0
         for i, raw in enumerate(block.txs):
             if i in parsed:

@@ -418,7 +418,8 @@ class ValidatorNode:
 
     def add_txs(self, raws) -> list:
         """Batched admission (admission plane phase 1 + per-tx CheckTx):
-        an ingest burst pays ONE signature dispatch, not one per tx."""
+        an ingest burst pays ONE signature dispatch and ONE blob-
+        commitment dispatch, not one of each per tx."""
         from celestia_app_tpu.chain import admission
 
         # TTL stamp comes from the pool's injected clock (see add_tx)
@@ -431,8 +432,10 @@ class ValidatorNode:
 
     def prevalidate_txs(self, raws) -> int:
         """Admission plane phase 1 ALONE: batch-verify the signatures of
-        not-yet-pooled txs into the verified-sig cache. Stateless and
-        never raises, so the reactor runs it OUTSIDE the service lock —
+        not-yet-pooled txs into the verified-sig cache (and batch their
+        blobs' share commitments into the verified-commitment cache —
+        the traffic plane's half). Stateless and never raises, so the
+        reactor runs it OUTSIDE the service lock —
         the first qualifying batch pays the kernel's jit compile, which
         must not stall the consensus loop (a racing commit at worst
         costs a cache miss, never a wrong verdict)."""
@@ -944,10 +947,13 @@ class ValidatorNode:
             # admission plane: one batched dispatch verifies the whole
             # replayed block's signatures (replay skips process_proposal,
             # where the live path prevalidates); the delivery ante below
-            # hits the verified-sig cache instead of re-verifying per tx
+            # hits the verified-sig cache instead of re-verifying per tx.
+            # commitments=False: delivery under a commit certificate
+            # validates no blob commitments, so the commitment batch
+            # would be pure wasted hashing on the recovery path
             from celestia_app_tpu.chain import admission
 
-            admission.prevalidate(self.app, block.txs)
+            admission.prevalidate(self.app, block.txs, commitments=False)
             results = self.app.finalize_block(block)
             self.app.commit(block)
             self.certificates[height] = cert

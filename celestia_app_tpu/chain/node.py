@@ -94,9 +94,11 @@ class Node:
                              check_fn=self.app.check_tx)
 
     def broadcast_txs(self, raws) -> list[TxResult]:
-        """Batched BroadcastMode_SYNC: one stateless signature
-        prevalidation dispatch (admission plane phase 1), then the usual
-        per-tx stateful CheckTx admission hitting the verified-sig cache."""
+        """Batched BroadcastMode_SYNC: one stateless prevalidation pass
+        (admission plane phase 1 — a batched signature dispatch AND a
+        batched blob-commitment dispatch), then the usual per-tx
+        stateful CheckTx admission hitting the verified-sig and
+        verified-commitment caches."""
         from celestia_app_tpu.chain import admission
 
         return self.pool.add_batch(

@@ -1840,6 +1840,12 @@ def cmd_txsim(args) -> int:
 
     from celestia_app_tpu import appconsts as _consts
 
+    if args.url:
+        return _txsim_load(args)
+    if not args.home:
+        print("ERROR: txsim needs --home (paced mode) or --url "
+              "(sustained-load mode)", file=sys.stderr)
+        return 1
     app, cfg = _make_app(args.home)
     node = Node(app, **_mempool_kwargs(cfg))
     from celestia_app_tpu.chain.state import Context, InfiniteGasMeter
@@ -1871,6 +1877,51 @@ def cmd_txsim(args) -> int:
         blobs_per_pfb=tuple(int(x) for x in args.blobs_per_pfb.split("-")),
         validators=validators,
     )
+    print(json.dumps(rep.as_dict(), indent=2))
+    return 0
+
+
+def _txsim_load(args) -> int:
+    """Sustained-load txsim against a live devnet (tools/txsim.run_load):
+    N concurrent sequences over persistent keep-alive connections, each
+    confirm-polling its txs to commit. Accounts are the standard derive
+    keys ("0", "1", ...), resolved against the node's auth state — fund
+    them first (`init` funds 0..9 by default)."""
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.client.tx_client import HttpNodeClient, Signer
+    from celestia_app_tpu.tools import txsim
+
+    probe = HttpNodeClient(args.url[0])
+    status = probe.status()
+    signer = Signer(status["chain_id"])
+    accounts = []
+    n_seq = args.blob_sequences + args.send_sequences
+    for i in range(max(args.accounts, n_seq)):
+        pk = PrivateKey.from_seed(str(i).encode())
+        addr = pk.public_key().address()
+        out = probe._post("/abci_query", {"path": "auth/account",
+                                          "data": {"address": addr.hex()}})
+        acc = out.get("account")
+        if acc is None:
+            print(f"ERROR: derive key {i} ({addr.hex()}) has no funded "
+                  f"account on the node; fund it first", file=sys.stderr)
+            probe.close()
+            return 1
+        signer.add_account(pk, acc["number"], acc["sequence"])
+        accounts.append(addr)
+    probe.close()
+    lo, hi = (float(x) for x in args.gas_prices.split("-"))
+    cfg = txsim.LoadConfig(
+        blob_sequences=args.blob_sequences,
+        send_sequences=args.send_sequences,
+        txs_per_sequence=args.txs_per_sequence,
+        blob_sizes=tuple(int(x) for x in args.blob_sizes.split("-")),
+        blobs_per_pfb=tuple(int(x) for x in args.blobs_per_pfb.split("-")),
+        gas_prices=(lo, hi),
+        seed=args.seed,
+        confirm_timeout_s=args.confirm_timeout,
+    )
+    rep = txsim.run_load(args.url, signer, accounts, cfg)
     print(json.dumps(rep.as_dict(), indent=2))
     return 0
 
@@ -2301,8 +2352,17 @@ def main(argv=None) -> int:
     p.add_argument("--home", required=True)
     p.set_defaults(fn=cmd_blockscan)
 
-    p = sub.add_parser("txsim")
-    p.add_argument("--home", required=True)
+    p = sub.add_parser(
+        "txsim",
+        help="tx load generator (tools/txsim.py): paced in-process "
+             "rounds against --home, or the sustained-load engine "
+             "(concurrent keep-alive sequences, confirm-polling) "
+             "against a live devnet via --url")
+    p.add_argument("--home",
+                   help="paced mode: the node home to drive in-process")
+    p.add_argument("--url", action="append", default=None,
+                   help="load mode: devnet service URL (repeatable; "
+                        "sequences round-robin over them)")
     p.add_argument("--rounds", type=int, default=5)
     p.add_argument("--accounts", type=int, default=3)
     p.add_argument("--blob-sequences", type=int, default=2)
@@ -2310,6 +2370,12 @@ def main(argv=None) -> int:
     p.add_argument("--stake-sequences", type=int, default=0)
     p.add_argument("--blob-sizes", default="100-2000")
     p.add_argument("--blobs-per-pfb", default="1-3")
+    p.add_argument("--txs-per-sequence", type=int, default=8,
+                   help="load mode: txs each sequence submits")
+    p.add_argument("--gas-prices", default="0.002-0.02",
+                   help="load mode: uniform gas-price draw LO-HI")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--confirm-timeout", type=float, default=60.0)
     p.set_defaults(fn=cmd_txsim)
 
     p = sub.add_parser(

@@ -118,23 +118,96 @@ class Signer:
 class HttpNodeClient:
     """Remote node transport: the same surface TxClient needs, over the
     HTTP JSON service (service/server.py) — the reference's gRPC remote
-    mode (pkg/user/tx_client.go:320-330 BroadcastMode_SYNC + Simulate)."""
+    mode (pkg/user/tx_client.go:320-330 BroadcastMode_SYNC + Simulate).
+
+    Holds ONE persistent HTTP/1.1 keep-alive connection (the serving
+    plane's dasload pattern): a submit-then-poll client issues many
+    small requests, and a fresh TCP connect per request dominates the
+    round-trip on small blobs under sustained load. A torn socket (idle
+    reaper, server restart) reconnects transparently once per request
+    (`txclient.reconnects`). One connection, one lock: the client is
+    thread-safe but callers wanting concurrency (tools/txsim.py) give
+    each sequence its own client."""
 
     def __init__(self, base_url: str, timeout: float = 30.0):
-        from celestia_app_tpu.net.transport import (
-            PeerClient, TransportConfig,
-        )
+        import threading
+        import urllib.parse
 
         self.base_url = base_url.rstrip("/")
+        p = urllib.parse.urlparse(self.base_url)
+        self._host = p.hostname
+        self._port = p.port or (443 if p.scheme == "https" else 80)
+        self._tls = p.scheme == "https"
         self.timeout = timeout
-        self.client = PeerClient(
-            TransportConfig(timeout=timeout, retries=2),
-            name="tx-client",
-        )
+        self._lock = threading.Lock()
+        self._conn = None  # guarded-by: _lock
+
+    def _new_conn(self):
+        import http.client
+
+        if self._tls:
+            return http.client.HTTPSConnection(self._host, self._port,
+                                               timeout=self.timeout)
+        return http.client.HTTPConnection(self._host, self._port,
+                                          timeout=self.timeout)
+
+    def _request(self, method: str, path: str,
+                 payload: dict | None = None) -> dict:
+        import http.client
+        import json as json_mod
+
+        body = None
+        headers = {}
+        if payload is not None:
+            body = json_mod.dumps(payload).encode()
+            headers["Content-Type"] = "application/json"
+        # one keep-alive connection IS the serialization point: HTTP/1.1
+        # cannot multiplex, so requests must queue on the client's own
+        # lock (callers wanting concurrency hold one client per
+        # sequence); no node/service lock is ever involved
+        with self._lock:  # lint: disable=blocking-under-lock
+            for attempt in (0, 1):
+                if self._conn is None:
+                    self._conn = self._new_conn()
+                    if attempt:
+                        telemetry.incr("txclient.reconnects")
+                try:
+                    self._conn.request(method, path, body=body,
+                                       headers=headers)
+                    r = self._conn.getresponse()
+                    data = r.read()
+                    status = r.status
+                    break
+                except (OSError, http.client.HTTPException):
+                    # keep-alive races are normal: one clean reconnect
+                    try:
+                        self._conn.close()
+                    finally:
+                        self._conn = None
+                    if attempt:
+                        raise
+        try:
+            out = json_mod.loads(data)
+        except ValueError:
+            out = {"error": data.decode("utf-8", "replace")}
+        if status >= 400:
+            # OSError family, like the urllib HTTPError the PeerClient
+            # transport used to raise — existing callers (cli das --url)
+            # catch OSError to degrade gracefully
+            raise OSError(
+                f"{method} {path} -> {status}: {out.get('error', out)}")
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                finally:
+                    self._conn = None
 
     def _post(self, path: str, payload: dict) -> dict:
-        return self.client.post(self.base_url, path, payload,
-                                timeout=self.timeout)
+        return self._request("POST", path, payload)
 
     def broadcast_tx(self, raw: bytes):
         import base64
@@ -174,8 +247,7 @@ class HttpNodeClient:
         return out
 
     def status(self) -> dict:
-        return self.client.get(self.base_url, "/status",
-                               timeout=self.timeout)
+        return self._request("GET", "/status")
 
 
 class GrpcNodeClient:

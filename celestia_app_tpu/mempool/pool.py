@@ -299,12 +299,14 @@ class CATPool:
     def add_batch(self, raws, *, height: int, now: float | None = None,
                   check_fn=None, prevalidate_fn=None) -> list[TxResult]:
         """Two-phase batched admission (the ROADMAP's two-phase admit):
-        phase 1 runs the caller's STATELESS signature prevalidation over
-        the not-yet-pooled txs as one batch — one device dispatch filling
-        the verified-sig cache (chain/admission.py) — and phase 2 runs
-        the standard stateful per-tx admission, whose CheckTx then hits
-        the cache instead of re-verifying each signature. Results align
-        with `raws`; dedup/eviction semantics are exactly `add`'s."""
+        phase 1 runs the caller's STATELESS prevalidation over the
+        not-yet-pooled txs as one batch — one device dispatch filling
+        the verified-sig cache plus one filling the verified-commitment
+        cache (chain/admission.py) — and phase 2 runs the standard
+        stateful per-tx admission, whose CheckTx then hits the caches
+        instead of re-verifying each signature and recomputing each
+        blob's share commitment. Results align with `raws`;
+        dedup/eviction semantics are exactly `add`'s."""
         if prevalidate_fn is not None:
             # membership probe outside phase 2's lock holds; a racing
             # duplicate only costs a cache lookup, never a double-verify

@@ -132,6 +132,14 @@ class NodeService:
                             pool = getattr(service.node, "pool", None)
                             if pool is not None:
                                 out["mempool"] = pool.stats()
+                            # admission + traffic plane counters (the
+                            # same block /consensus/status serves)
+                            from celestia_app_tpu.chain import (
+                                admission as admission_mod,
+                            )
+
+                            out["admission"] = admission_mod.status_block(
+                                service.node.app)
                         self._send(200, out)
                     elif self.path == "/metrics":
                         # Prometheus text exposition (the reference's

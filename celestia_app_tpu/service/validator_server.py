@@ -392,6 +392,12 @@ class ValidatorService:
 
     # -- handlers (under self.lock) --------------------------------------
 
+    @staticmethod
+    def _admission_status(app) -> dict:
+        from celestia_app_tpu.chain import admission as admission_mod
+
+        return admission_mod.status_block(app)
+
     def _status(self) -> dict:
         v = self.vnode
         out = {
@@ -409,6 +415,12 @@ class ValidatorService:
             "mempool_stats": v.pool.stats(),
             "locked": v.locked_block.header.hash().hex()
             if v.locked_block is not None else None,
+            # admission plane + traffic plane: the verified-sig and
+            # verified-commitment cache behavior (FORMATS §12.3/§20.3)
+            # plus any co-resident txsim load's counters — process-wide
+            # (the same numbers /metrics exposes), surfaced here so an
+            # operator sees admission economics next to the mempool
+            "admission": self._admission_status(v.app),
         }
         if self.reactor is not None:
             out["reactor"] = {

@@ -1,25 +1,43 @@
 """txsim: transaction load generator (reference test/txsim/run.go analog).
 
-Drives a node with a configurable mix of sequences — send sequences, blob
-sequences with size/count distributions (test/txsim/blob.go's ranges), and
-stake sequences alternating delegate/undelegate against the validator set
-(test/txsim/stake.go) — either in-process (Node object) or over the HTTP
-service. Reports per-type submission counts, acceptance, and blocks
-produced.
+Two engines:
 
-Usage (CLI): python -m celestia_app_tpu txsim --blob-sequences 2 \
-    --send-sequences 2 --stake-sequences 1 --blob-sizes 100-2000 \
-    --blobs-per-pfb 1-3 --rounds 5
+- **Sustained load** (`run_load`, the traffic plane — ISSUE 15): N
+  concurrent SEQUENCES, each owning one `client/tx_client` Signer
+  account and ONE persistent keep-alive `HttpNodeClient`, submitting
+  PFB blobs (sizes, namespaces, and gas prices drawn from configurable
+  distributions) or sends over HTTP against a LIVE devnet, each tx
+  confirm-polled to commit. Reports end-to-end ``blobs_per_sec``,
+  admission->commit p50/p99 latency, and per-type
+  submitted/accepted/confirmed counts (mirrored into the process-wide
+  ``txsim.*`` telemetry counters). This is the reference's
+  `test/txsim` shape: sequences are independent nonce lanes, so the
+  fleet saturates admission without self-inflicted sequence races.
+- **Paced rounds** (`run`, the original in-process loop): one tx per
+  sequence per round against a Node object, a block produced between
+  rounds — deterministic, good for fixtures; stake sequences
+  (delegate/undelegate alternation, test/txsim/stake.go) live here.
+
+Usage (CLI):
+  python -m celestia_app_tpu txsim --home DIR --rounds 5        # paced
+  python -m celestia_app_tpu txsim --url http://127.0.0.1:26658 \
+      --blob-sequences 8 --txs-per-sequence 16                  # load
 """
 
 from __future__ import annotations
 
 import dataclasses
+import threading
+import time
 
 import numpy as np
 
 from celestia_app_tpu.da.blob import Blob
 from celestia_app_tpu.da.namespace import Namespace
+# ONE percentile convention across the load harnesses: dasload's
+# (nearest-rank over the sorted sample) — a fix there fixes both reports
+from celestia_app_tpu.tools.dasload import _percentile
+from celestia_app_tpu.utils import telemetry
 
 
 @dataclasses.dataclass
@@ -137,3 +155,275 @@ def run(
         rep.blocks += 1
         rep.rounds += 1
     return rep
+
+
+# ---------------------------------------------------------------------------
+# the sustained-load engine (the traffic plane)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LoadConfig:
+    """Knobs of one sustained-load run. Sequences split into blob
+    sequences (PFB submitters) first, then send sequences; each owns
+    one account, so `blob_sequences + send_sequences` accounts are
+    required. Distributions are uniform over inclusive ranges, drawn
+    per tx from the sequence's own seeded rng (runs are reproducible
+    per (seed, sequence) regardless of thread interleaving)."""
+
+    blob_sequences: int = 4
+    send_sequences: int = 0
+    txs_per_sequence: int = 8
+    blob_sizes: tuple[int, int] = (100, 2000)
+    blobs_per_pfb: tuple[int, int] = (1, 2)
+    # gas-price draw: the fee rides fee = gas_limit * price + 1, so a
+    # spread exercises the pool's priority ordering under load
+    gas_prices: tuple[float, float] = (0.002, 0.02)
+    namespaces: int = 2  # distinct namespaces per blob sequence
+    confirm_timeout_s: float = 60.0
+    poll_interval_s: float = 0.05
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class LoadReport:
+    sequences: int = 0
+    wall_s: float = 0.0
+    pfbs_submitted: int = 0
+    pfbs_accepted: int = 0
+    pfbs_confirmed: int = 0
+    sends_submitted: int = 0
+    sends_accepted: int = 0
+    sends_confirmed: int = 0
+    blobs_submitted: int = 0
+    blobs_confirmed: int = 0
+    bytes_submitted: int = 0
+    blobs_per_sec: float = 0.0
+    txs_per_sec: float = 0.0
+    admission_commit_p50_ms: float = 0.0
+    admission_commit_p99_ms: float = 0.0
+    resyncs: int = 0
+    errors: int = 0
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+
+
+class _LoadStats:
+    """The run's shared tally (lock-guarded; sequences report per tx).
+    Mirrors into the process-wide `txsim.*` telemetry counters so a
+    co-located node's /metrics (and the bench) see the load shape."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies_ms: list = []  # guarded-by: lock
+        self.report = LoadReport()    # guarded-by: lock
+
+    def note_submit(self, kind: str, accepted: bool, n_blobs: int = 0,
+                    n_bytes: int = 0) -> None:
+        with self.lock:
+            r = self.report
+            if kind == "pfb":
+                r.pfbs_submitted += 1
+                r.pfbs_accepted += int(accepted)
+                r.blobs_submitted += n_blobs
+                r.bytes_submitted += n_bytes
+            else:
+                r.sends_submitted += 1
+                r.sends_accepted += int(accepted)
+        telemetry.incr("txsim.submitted")
+        telemetry.incr("txsim.accepted" if accepted else "txsim.rejected")
+
+    def note_confirm(self, kind: str, dt_ms: float, n_blobs: int) -> None:
+        with self.lock:
+            r = self.report
+            self.latencies_ms.append(dt_ms)
+            if kind == "pfb":
+                r.pfbs_confirmed += 1
+                r.blobs_confirmed += n_blobs
+            else:
+                r.sends_confirmed += 1
+        telemetry.incr("txsim.confirmed")
+
+    def note_resync(self) -> None:
+        with self.lock:
+            self.report.resyncs += 1
+        telemetry.incr("txsim.resyncs")
+
+    def note_error(self) -> None:
+        with self.lock:
+            self.report.errors += 1
+        telemetry.incr("txsim.errors")
+
+
+def _confirm(client, raw: bytes, cfg: LoadConfig) -> bool:
+    """Poll the tx to commit within the confirm budget (the reference's
+    ConfirmTx loop, paced for devnet block times)."""
+    deadline = time.perf_counter() + cfg.confirm_timeout_s
+    while True:
+        out = client.confirm_tx(raw, attempts=1)
+        if out.get("found"):
+            return True
+        if time.perf_counter() >= deadline:
+            return False
+        time.sleep(cfg.poll_interval_s)
+
+
+def _sequence_worker(seq: int, kind: str, client, signer, addr: bytes,
+                     peers: list, cfg: LoadConfig,
+                     barrier: threading.Barrier,
+                     stats: _LoadStats) -> None:
+    """One sequence: an independent nonce lane submitting
+    cfg.txs_per_sequence txs of its kind, each confirm-polled. A
+    rejected tx resyncs the sequence number once from the node's error
+    (app/errors/nonce_mismatch.go parity) before moving on."""
+    from celestia_app_tpu.chain import modules
+    from celestia_app_tpu.chain.tx import MsgSend
+    from celestia_app_tpu.client.tx_client import parse_expected_sequence
+
+    rng = np.random.default_rng(cfg.seed * 65537 + seq)
+    try:
+        barrier.wait()
+    except threading.BrokenBarrierError:
+        return
+    for _i in range(cfg.txs_per_sequence):
+        try:
+            if kind == "pfb":
+                n_blobs = int(rng.integers(cfg.blobs_per_pfb[0],
+                                           cfg.blobs_per_pfb[1] + 1))
+                blobs = []
+                for _b in range(n_blobs):
+                    size = int(rng.integers(cfg.blob_sizes[0],
+                                            cfg.blob_sizes[1] + 1))
+                    ns_id = 1 + int(rng.integers(0, max(1, cfg.namespaces)))
+                    ns = Namespace.v0(bytes([seq + 1, ns_id]) * 5)
+                    blobs.append(Blob(ns, rng.integers(
+                        0, 256, size, dtype=np.uint8).tobytes()))
+                n_bytes = sum(len(b.data) for b in blobs)
+                gas = int(modules.estimate_pfb_gas(
+                    [len(b.data) for b in blobs]) * 1.2)
+            else:
+                blobs, n_blobs, n_bytes = [], 0, 0
+                gas = 100_000
+            price = float(rng.uniform(cfg.gas_prices[0], cfg.gas_prices[1]))
+            fee = max(1, int(gas * price) + 1)
+
+            def make_raw() -> bytes:
+                if kind == "pfb":
+                    return signer.create_pay_for_blobs(
+                        addr, blobs, fee=fee, gas_limit=gas)
+                to = peers[(seq + 1) % len(peers)]
+                return signer.create_tx(
+                    addr, [MsgSend(addr, to, 1 + int(rng.integers(1000)))],
+                    fee=fee, gas_limit=gas,
+                ).encode()
+
+            raw = make_raw()
+            t0 = time.perf_counter()
+            res = client.broadcast_tx(raw)
+            if res.code != 0:
+                expected = parse_expected_sequence(res.log)
+                if expected is not None:
+                    # one resync + resubmit: a restarted node or a
+                    # dropped confirm can leave the local lane ahead
+                    signer.accounts[addr].sequence = expected
+                    stats.note_resync()
+                    raw = make_raw()
+                    t0 = time.perf_counter()
+                    res = client.broadcast_tx(raw)
+            accepted = res.code == 0
+            stats.note_submit(kind, accepted, n_blobs, n_bytes)
+            if not accepted:
+                continue
+            signer.accounts[addr].sequence += 1
+            if _confirm(client, raw, cfg):
+                stats.note_confirm(
+                    kind, (time.perf_counter() - t0) * 1e3, n_blobs)
+        except Exception:
+            stats.note_error()
+    close = getattr(client, "close", None)
+    if close is not None:
+        close()
+
+
+def run_load(urls: list, signer, accounts: list, cfg: LoadConfig,
+             client_factory=None) -> LoadReport:
+    """Drive `blob_sequences + send_sequences` concurrent sequences at a
+    live devnet (sequences round-robin over `urls`; someone else — the
+    devnet's reactor or a BlockDriver — produces blocks) and return the
+    aggregate LoadReport. `accounts` are the signer-registered sequence
+    owners, one per sequence. `client_factory(url)` overrides the
+    transport (tests); the default is one persistent-connection
+    HttpNodeClient per sequence."""
+    from celestia_app_tpu.client.tx_client import HttpNodeClient
+
+    n_seq = cfg.blob_sequences + cfg.send_sequences
+    if len(accounts) < n_seq:
+        raise ValueError(
+            f"need {n_seq} accounts (one per sequence), got {len(accounts)}")
+    if client_factory is None:
+        client_factory = HttpNodeClient
+    stats = _LoadStats()
+    barrier = threading.Barrier(n_seq + 1)
+    threads = []
+    for seq in range(n_seq):
+        kind = "pfb" if seq < cfg.blob_sequences else "send"
+        client = client_factory(urls[seq % len(urls)])
+        threads.append(threading.Thread(
+            target=_sequence_worker,
+            args=(seq, kind, client, signer, accounts[seq], accounts, cfg,
+                  barrier, stats),
+            daemon=True,
+        ))
+    for t in threads:
+        t.start()
+    barrier.wait()  # every connection is up: the clock starts here
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+    rep = stats.report
+    rep.sequences = n_seq
+    rep.wall_s = round(wall_s, 3)
+    if wall_s > 0:
+        rep.blobs_per_sec = round(rep.blobs_confirmed / wall_s, 2)
+        rep.txs_per_sec = round(
+            (rep.pfbs_confirmed + rep.sends_confirmed) / wall_s, 2)
+    lat = sorted(stats.latencies_ms)
+    rep.admission_commit_p50_ms = round(_percentile(lat, 0.50), 3)
+    rep.admission_commit_p99_ms = round(_percentile(lat, 0.99), 3)
+    return rep
+
+
+class BlockDriver(threading.Thread):
+    """Background block producer for harness runs where no autonomous
+    reactor drives the chain (bench/tests): calls `produce()` every
+    `block_time` seconds until stopped. `produce` owns its own locking
+    (e.g. `with svc.lock: node.produce_block()`)."""
+
+    def __init__(self, produce, block_time: float = 0.2):
+        super().__init__(daemon=True)
+        self._produce = produce
+        self._block_time = block_time
+        # NOT named _stop: threading.Thread owns a private _stop method
+        # that join() calls on a finished thread
+        self._halt = threading.Event()
+        self.blocks = 0
+        self.errors = 0
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            try:
+                self._produce()
+                self.blocks += 1
+            except Exception:
+                # an empty-mempool or mid-shutdown round is not fatal to
+                # the driver; the harness reads .errors for visibility
+                self.errors += 1
+            self._halt.wait(self._block_time)
+
+    def stop(self) -> None:
+        self._halt.set()
+        self.join(timeout=30)
