@@ -2046,6 +2046,180 @@ def measure_serve() -> None:
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+def measure_read() -> None:
+    """Read-plane bench (--read). One BENCH JSON line:
+
+      {"metric": "namespace_queries_per_sec", "value": <batched qps>,
+       "single_queries_per_sec": ..., "batched_vs_single_ratio": ...,
+       "single_p50_ms"/"single_p99_ms"/"batch_p50_ms"/"batch_p99_ms",
+       "pack_queries_per_sec", "pack_vs_live_ratio", "present_ratio",
+       "readers", "batch", "backend"}
+
+    Three measurements against one in-process devnet carrying real PFB
+    blob blocks (many distinct namespaces per height):
+
+    - **single baseline**: `tools/blobload.py` drives N concurrent
+      persistent-connection followers, each resolving one namespace per
+      `GET /blob/get` round-trip — the per-request host reference loop
+      (da/namespace_data.get_namespace_data per query).
+    - **batched**: the same query stream folded ``batch`` queries per
+      `POST /blob/namespaces` round-trip — one engine-gated batched
+      search (da/namespace_device.py) resolves each height's whole
+      batch. ``batched_vs_single_ratio`` is the ISSUE 16 gate (>= 5x at
+      batch >= 64).
+    - **pack-served**: static blob-pack chunk reads (sha256-verified),
+      the CDN path; ``pack_vs_live_ratio`` is pack qps over single qps.
+
+    Backend labeling follows FORMATS §12.2 ("cpu-fallback" on CPU).
+    Env knobs: CELESTIA_BENCH_READ_READERS (64), _REQUESTS (6),
+    _BATCH (64), _BLOCKS (3), _NS (48 distinct namespaces).
+    """
+    import resource
+    import shutil
+    import tempfile
+
+    import jax
+
+    from celestia_app_tpu.chain import consensus as cons
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.client.tx_client import Signer
+    from celestia_app_tpu.da.blob import Blob
+    from celestia_app_tpu.da.namespace import Namespace
+    from celestia_app_tpu.service.server import NodeService
+    from celestia_app_tpu.tools import blobload
+
+    platform = jax.devices()[0].platform
+    backend = "cpu-fallback" if platform == "cpu" else platform
+    readers = int(os.environ.get("CELESTIA_BENCH_READ_READERS", "64"))
+    requests = int(os.environ.get("CELESTIA_BENCH_READ_REQUESTS", "6"))
+    batch = int(os.environ.get("CELESTIA_BENCH_READ_BATCH", "64"))
+    blocks = int(os.environ.get("CELESTIA_BENCH_READ_BLOCKS", "3"))
+    n_ns = int(os.environ.get("CELESTIA_BENCH_READ_NS", "48"))
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < 4 * readers:
+        resource.setrlimit(resource.RLIMIT_NOFILE,
+                           (min(4 * readers, hard), hard))
+
+    chain_id = "read-bench"
+    tmp = tempfile.mkdtemp(prefix="read-bench-")
+    try:
+        n_accounts = 8
+        privs = [PrivateKey.from_seed(b"read-bench-%d" % i)
+                 for i in range(n_accounts)]
+        addrs = [p.public_key().address() for p in privs]
+        genesis = {
+            "time_unix": 1_700_000_000.0,
+            "accounts": [{"address": a.hex(), "balance": 10**14}
+                         for a in addrs],
+            "validators": [{
+                "operator": addrs[0].hex(),
+                "power": 10,
+                "pubkey": privs[0].public_key().compressed.hex(),
+            }],
+        }
+        vnode = cons.ValidatorNode(
+            "read", privs[0], genesis, chain_id,
+            data_dir=os.path.join(tmp, "read", "data"),
+            da_scheme="rs2d-nmt", pack_keep=0)
+        signer = Signer(chain_id)
+        for i, p in enumerate(privs):
+            signer.add_account(p, number=i)
+        svc = NodeService(vnode, port=0)
+        svc.serve_background()
+        url = f"http://127.0.0.1:{svc.port}"
+
+        namespaces = [Namespace.v0(bytes([1 + i // 200, 1 + i % 200]) * 5)
+                      for i in range(n_ns)]
+        rng = np.random.default_rng(16)
+
+        def pfb_blobs(height):
+            # every namespace present at every height, blobs spread
+            # over the accounts so each block carries n_accounts PFBs
+            per_acct = [[] for _ in range(n_accounts)]
+            for i, ns in enumerate(namespaces):
+                size = int(rng.integers(400, 1200))
+                per_acct[i % n_accounts].append(
+                    Blob(ns, rng.integers(0, 256, size,
+                                          dtype=np.uint8).tobytes()))
+            return per_acct
+
+        for _ in range(blocks):
+            height = vnode.app.height + 1
+            for a, blobs in zip(addrs, pfb_blobs(height)):
+                raw = signer.create_pay_for_blobs(
+                    a, blobs, fee=300_000, gas_limit=50_000_000)
+                signer.accounts[a].sequence += 1
+                vnode.add_tx(raw)
+            last_cert = vnode.certificates.get(height - 1)
+            block = vnode.propose(t=1_700_000_000.0 + height)
+            bh = block.header.hash()
+            vote = vnode._signed(height, bh, "precommit", 0)
+            cert = cons.CommitCertificate(height, bh, (vote,), 0)
+            vnode.apply(block, cert, absent_cert=last_cert)
+            vnode.clear_lock()
+        vnode.app.da_warmer.wait_idle(60)
+        # the warmer coalesces under rapid commits; builds are
+        # idempotent for the heights it did reach
+        heights = list(range(1, vnode.app.height + 1))
+        for h in heights:
+            vnode.app.blob_pack_store.build(
+                h, svc.das_core._entry(h).cache_entry)
+        ns_hex = [ns.raw.hex() for ns in namespaces]
+
+        single = blobload.run_load(url, heights, ns_hex,
+                                   readers=readers, requests=requests,
+                                   mode="single")
+        print(f"single: {single['namespace_queries_per_sec']}/s "
+              f"p99 {single['p99_ms']}ms errors {single['errors']}",
+              file=sys.stderr, flush=True)
+        batched = blobload.run_load(url, heights, ns_hex,
+                                    readers=max(2, readers // 8),
+                                    requests=requests, mode="batch",
+                                    batch=batch)
+        print(f"batch({batch}): "
+              f"{batched['namespace_queries_per_sec']}/s "
+              f"p99 {batched['p99_ms']}ms errors {batched['errors']}",
+              file=sys.stderr, flush=True)
+        pack = blobload.run_load(url, heights, ns_hex,
+                                 readers=readers, requests=requests,
+                                 mode="pack")
+        print(f"pack: {pack['namespace_queries_per_sec']}/s "
+              f"p99 {pack['p99_ms']}ms errors {pack['errors']}",
+              file=sys.stderr, flush=True)
+
+        single_qps = single["namespace_queries_per_sec"]
+        batch_qps = batched["namespace_queries_per_sec"]
+        pack_qps = pack["namespace_queries_per_sec"]
+        print(json.dumps({
+            "metric": "namespace_queries_per_sec",
+            "value": batch_qps,
+            "unit": "queries/s",
+            "single_queries_per_sec": single_qps,
+            "batched_vs_single_ratio": round(
+                batch_qps / max(1e-9, single_qps), 2),
+            "single_p50_ms": single["p50_ms"],
+            "single_p99_ms": single["p99_ms"],
+            "batch_p50_ms": batched["p50_ms"],
+            "batch_p99_ms": batched["p99_ms"],
+            "pack_queries_per_sec": pack_qps,
+            "pack_vs_live_ratio": round(
+                pack_qps / max(1e-9, single_qps), 2),
+            "present_ratio": batched["present_ratio"],
+            "heights": len(heights),
+            "namespaces": n_ns,
+            "readers": readers,
+            "batch": batch,
+            "single_errors": single["errors"],
+            "batch_errors": batched["errors"],
+            "pack_errors": pack["errors"],
+            "backend": backend,
+        }), flush=True)
+        svc.shutdown()
+        vnode.app.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def measure_txsim() -> None:
     """Traffic-plane bench (--txsim). Three BENCH JSON lines:
 
@@ -2613,6 +2787,11 @@ MODES = {
               "p99_sample_ms, pack_hit_ratio",
               "serving plane: pack-served vs live sampling under "
               "thousand-sampler load"),
+    "read": (measure_read,
+             "namespace_queries_per_sec, batched_vs_single_ratio, "
+             "pack_vs_live_ratio, p99 per mode",
+             "read plane: batched vs per-request namespace resolution "
+             "+ static blob packs under concurrent followers"),
     "analyze": (measure_analyze,
                 "analyze_cold_wall_s, analyze_warm_wall_s",
                 "full-tree static analysis (call-graph taint included) "

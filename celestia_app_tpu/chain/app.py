@@ -371,6 +371,18 @@ class App:
                              packs_mod.PACK_DIRNAME),
                 keep=pack_keep,
             )
+        # read plane (das/blob_packs.py): per-namespace blob-read packs
+        # under <home>/blobpacks, built at warm time beside the sample
+        # packs, same keep-newest-N bound
+        self.blob_pack_store = None
+        if self.db is not None and pack_keep is not None:
+            from celestia_app_tpu.das import blob_packs as blob_packs_mod
+
+            self.blob_pack_store = blob_packs_mod.BlobPackStore(
+                os.path.join(os.path.dirname(os.path.abspath(self.db.dir)),
+                             blob_packs_mod.BLOB_PACK_DIRNAME),
+                keep=pack_keep,
+            )
         self.ante = ante_mod.AnteHandler(
             self.auth, self.bank, self.blob, self.minfee, min_gas_price,
             feegrant=self.feegrant, ibc=self.ibc,
@@ -1213,6 +1225,7 @@ class App:
                 self.height, entry, self.da_seed_listeners,
                 engine=self.engine, traces=self.traces,
                 chain_id=self.chain_id, pack_store=self.pack_store,
+                blob_pack_store=self.blob_pack_store,
             )
         return self.last_app_hash
 

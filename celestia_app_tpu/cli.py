@@ -831,6 +831,32 @@ def cmd_das_serve(args) -> int:
     return 0
 
 
+def cmd_blob_serve(args) -> int:
+    """Read-plane sidecar over a full node's home (das/blob_server.py):
+    answers rollup readers — GET /blob/get, batched POST
+    /blob/namespaces, static blob-pack chunks — plus the /das/* routes a
+    verifying follower needs for headers. Deployable next to (or instead
+    of) the full node process; any number can front one home."""
+    from celestia_app_tpu.das.blob_server import BlobCore, BlobService
+    from celestia_app_tpu.das.server import SampleCore
+
+    app, _cfg = _make_app(args.home)
+    core = SampleCore(app, cache_heights=args.cache_heights)
+    blob_core = BlobCore(core)
+    if getattr(args, "no_packs", False):
+        blob_core.pack_store = None
+    svc = BlobService(blob_core, port=args.listen)
+    packs_on = blob_core.pack_store is not None
+    print(f"blob-serve: http on :{svc.port} (height {app.height}, "
+          f"engine={getattr(app, 'engine', 'host')}, "
+          f"packs={'on' if packs_on else 'off'})", flush=True)
+    try:
+        svc.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_das_follow(args) -> int:
     """DASer daemon (das/daser.py): follow a chain as a light node —
     verify headers by commit certificate (chain/light.py), sample every
@@ -892,6 +918,78 @@ def cmd_das_follow(args) -> int:
         return 0
     print(json.dumps({"halted": daser.cp.halted}), flush=True)
     return 1
+
+
+def cmd_blob_follow(args) -> int:
+    """Rollup follower daemon (client/follower.py): track ONE namespace
+    across heights as a verifying light client — headers by commit
+    certificate (chain/light.py), every inclusion/absence proof checked
+    against the certified data root, progress checkpointed under
+    --home/blob/. Exit codes: 0 clean stop, 1 verification refusal,
+    2 bad invocation."""
+    from celestia_app_tpu.chain.light import LightClient, TrustedState
+    from celestia_app_tpu.client.follower import (
+        BlobFollower,
+        FollowerConfig,
+        FollowerError,
+    )
+    from celestia_app_tpu.das.checkpoint import CheckpointStore
+
+    if not args.peer:
+        print("error: blob-follow needs at least one --peer",
+              file=sys.stderr)
+        return 2
+    try:
+        namespace = bytes.fromhex(args.namespace)
+    except ValueError:
+        namespace = b""
+    if len(namespace) != 29:
+        print("error: --namespace must be 29 bytes of hex",
+              file=sys.stderr)
+        return 2
+    genesis_path = os.path.join(args.home, "genesis.json")
+    if not os.path.exists(genesis_path):
+        print(f"error: no genesis.json under {args.home} (trust root)",
+              file=sys.stderr)
+        return 2
+    with open(genesis_path) as f:
+        genesis = json.load(f)
+    validators, powers = {}, {}
+    for v in genesis.get("validators", []):
+        if "pubkey" not in v:
+            print("error: genesis validators need pubkeys for light "
+                  "verification", file=sys.stderr)
+            return 2
+        op = bytes.fromhex(v["operator"])
+        validators[op] = bytes.fromhex(v["pubkey"])
+        powers[op] = int(v["power"])
+    light = LightClient(args.chain_id, TrustedState(
+        height=0, header_hash=b"", validators=validators, powers=powers,
+    ))
+    store = CheckpointStore(os.path.join(args.home, "blob",
+                                         "checkpoint.json"))
+    follower = BlobFollower(
+        list(args.peer), namespace, light, store,
+        cfg=FollowerConfig(prefer_packs=not getattr(args, "no_packs",
+                                                    False)),
+        name="blob-follow",
+    )
+    try:
+        while True:
+            try:
+                out = follower.sync()
+            except FollowerError as e:
+                print(json.dumps({"refused": str(e)}), flush=True)
+                return 1
+            for h, blobs in sorted(follower.pop_blobs().items()):
+                print(json.dumps({"height": h, "blobs": [
+                    b.hex() for b in blobs]}), flush=True)
+            print(json.dumps(out), flush=True)
+            if args.once and out["next_height"] > out["head"] >= 1:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 def cmd_verify(args) -> int:
@@ -1940,6 +2038,23 @@ def cmd_dasload(args) -> int:
     return dasload.main(argv)
 
 
+def cmd_blobload(args) -> int:
+    """Read-plane load harness (tools/blobload.py): drive N concurrent
+    persistent-connection namespace readers at a devnet's /blob/*
+    surface and print the JSON report (namespace_queries_per_sec,
+    p99_ms, present_ratio, pack_hit_ratio)."""
+    from celestia_app_tpu.tools import blobload
+
+    argv = ["--url", args.url, "--readers", str(args.readers),
+            "--requests", str(args.requests), "--mode", args.mode,
+            "--batch", str(args.batch)]
+    if args.heights:
+        argv += ["--heights", args.heights]
+    if args.namespaces:
+        argv += ["--namespaces", args.namespaces]
+    return blobload.main(argv)
+
+
 def _git_changed_package_files(pkg_root: str) -> set[str] | None:
     """Package-relative paths of .py files changed vs HEAD (staged,
     unstaged, and untracked), or None when git is unavailable."""
@@ -2150,6 +2265,20 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_das_serve)
 
     p = sub.add_parser(
+        "blob-serve",
+        help="read-plane sidecar over a node home (das/blob_server.py): "
+             "GET /blob/get + batched POST /blob/namespaces + static "
+             "blob-pack chunks for rollup readers")
+    p.add_argument("--home", required=True)
+    p.add_argument("--listen", type=int, default=26661)
+    p.add_argument("--cache-heights", type=int, default=4,
+                   help="LRU square-cache depth (per-height row trees)")
+    p.add_argument("--no-packs", action="store_true",
+                   help="disable static blob-pack serving (GET /blob/pack"
+                        "*) even when <home>/blobpacks holds packs")
+    p.set_defaults(fn=cmd_blob_serve)
+
+    p = sub.add_parser(
         "das-follow",
         help="DASer light-node daemon (das/daser.py): follow headers by "
              "commit certificate, sample every height, checkpoint under "
@@ -2174,6 +2303,29 @@ def main(argv=None) -> int:
                    help="never fetch advertised proof-pack chunks; "
                         "sample via live /das/samples only")
     p.set_defaults(fn=cmd_das_follow)
+
+    p = sub.add_parser(
+        "blob-follow",
+        help="rollup follower daemon (client/follower.py): track one "
+             "namespace as a verifying light client — certified "
+             "headers, checked inclusion/absence proofs, checkpoint "
+             "under --home/blob/")
+    p.add_argument("--home", required=True,
+                   help="holds genesis.json (the trust root) and the "
+                        "blob/checkpoint.json progress record")
+    p.add_argument("--chain-id", default="celestia-tpu-1")
+    p.add_argument("--peer", action="append",
+                   help="serving peer URL (repeatable)")
+    p.add_argument("--namespace", required=True,
+                   help="29-byte namespace hex to follow")
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="seconds between sweeps")
+    p.add_argument("--once", action="store_true",
+                   help="exit 0 once caught up to the served head")
+    p.add_argument("--no-packs", action="store_true",
+                   help="never read advertised blob-pack chunks; resolve "
+                        "via live /blob/get only")
+    p.set_defaults(fn=cmd_blob_follow)
 
     p = sub.add_parser(
         "verify",
@@ -2393,6 +2545,25 @@ def main(argv=None) -> int:
                    help="comma-separated heights (default: last 8 below "
                         "the served head)")
     p.set_defaults(fn=cmd_dasload)
+
+    p = sub.add_parser(
+        "blobload",
+        help="read-plane load harness (tools/blobload.py): concurrent "
+             "persistent-connection namespace readers against a devnet's "
+             "/blob/* surface; prints the JSON report")
+    p.add_argument("--url", required=True)
+    p.add_argument("--readers", type=int, default=256)
+    p.add_argument("--requests", type=int, default=4)
+    p.add_argument("--mode", choices=("single", "batch", "pack"),
+                   default="batch")
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--heights", default="",
+                   help="comma-separated heights (default: last 4 below "
+                        "the served head)")
+    p.add_argument("--namespaces", default="",
+                   help="comma-separated namespace hex (default: the "
+                        "heights' packed namespaces)")
+    p.set_defaults(fn=cmd_blobload)
 
     p = sub.add_parser(
         "analyze",

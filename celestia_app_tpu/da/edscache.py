@@ -638,12 +638,14 @@ class ProverWarmer:
 
     def schedule(self, height: int, entry: EdsCacheEntry, listeners,
                  engine: str = "auto", traces=None,
-                 chain_id: str = "", pack_store=None) -> None:
+                 chain_id: str = "", pack_store=None,
+                 blob_pack_store=None) -> None:
         with self._lock:
             if self._pending is not None:
                 telemetry.incr("edscache.warm_coalesced")
             self._pending = (height, entry, tuple(listeners), engine,
-                             traces, chain_id, pack_store)
+                             traces, chain_id, pack_store,
+                             blob_pack_store)
             self._idle.clear()
             if not self._worker_alive:
                 self._worker_alive = True
@@ -661,7 +663,7 @@ class ProverWarmer:
                     self._idle.set()
                     return
             (height, entry, listeners, engine, traces, chain_id,
-             pack_store) = item
+             pack_store, blob_pack_store) = item
             log = obs.get_logger("da.edscache")
             try:
                 # the warm span joins the height's deterministic trace, so
@@ -708,6 +710,24 @@ class ProverWarmer:
                 except Exception as e:
                     telemetry.incr("packs.build_errors")
                     log.error("proof-pack build failed", height=height,
+                              err=e)
+            if blob_pack_store is not None:
+                # read plane (das/blob_packs.py): warm time is also when
+                # the height's per-namespace blob pack is precomputed —
+                # provers are built, so each namespace's response is
+                # index arithmetic + JSON + fsync. Same contract as the
+                # sample packs: counted on failure, never fatal, live
+                # queries keep serving.
+                try:
+                    with obs.span(
+                        "blobpacks.build", traces=traces,
+                        trace_id=obs.trace_id_for(chain_id, height),
+                        height=height, scheme=entry.scheme,
+                    ):
+                        blob_pack_store.build(height, entry)
+                except Exception as e:
+                    telemetry.incr("blobpacks.build_errors")
+                    log.error("blob-pack build failed", height=height,
                               err=e)
 
     def wait_idle(self, timeout: float | None = None) -> bool:

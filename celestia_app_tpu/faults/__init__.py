@@ -65,6 +65,13 @@ The fault-point catalog (the names production code fires today):
                                 that is never served and gets pruned;
                                 the node stays servable via live
                                 assembly
+  blobpacks.mid_write           das/blob_packs.py, after EACH blob-pack
+                                chunk is durably written, before the
+                                manifest (ctx: height, data_root,
+                                index) — same torn-pack contract as
+                                packs.mid_write: never advertised,
+                                never served, live /blob/get keeps
+                                answering
 
 docs/DESIGN.md "The fault plane" and docs/FORMATS.md §9 are the normative
 descriptions of the catalog and the /faults/* admin surface.

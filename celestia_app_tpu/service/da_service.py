@@ -169,13 +169,17 @@ class DACore:
             raise DAError(
                 f"invalid share range [{start}, {end}) for a {k}x{k} square"
             )
+        # namespace parsing + extraction live on the read plane's shared
+        # helpers (da/namespace_device.py) — one codec for every caller
+        from celestia_app_tpu.da import namespace_device as nsdev
+
         try:
-            namespace = bytes.fromhex(payload.get("namespace", ""))
+            namespace = nsdev.decode_namespace(payload.get("namespace", ""))
         except ValueError:
             raise DAError("namespace must be hex") from None
         if not namespace:
-            namespace = eds.squares[start // k, start % k].tobytes(
-            )[:appconsts.NAMESPACE_SIZE]
+            namespace = nsdev.share_namespace(eds.squares[start // k,
+                                                          start % k])
         pf = proof_mod.new_share_inclusion_proof(eds, dah, start, end,
                                                  namespace)
         return {

@@ -66,6 +66,12 @@ class NodeService:
         from celestia_app_tpu.das.server import SampleCore
 
         self.das_core = SampleCore(node.app, app_lock=self.lock)
+        # the read plane (das/blob_server.py): batched namespace reads
+        # + blob-pack static serving over the SAME entry cache, so the
+        # two planes share one single-flight build per height
+        from celestia_app_tpu.das.blob_server import BlobCore
+
+        self.blob_core = BlobCore(self.das_core)
         # block plane: every commit hands its EDS/DAH cache entry to this
         # serving core on the warmer's background thread (App.commit ->
         # ProverWarmer -> seed_cache_entry), so the first /das/sample
@@ -140,6 +146,12 @@ class NodeService:
 
                             out["admission"] = admission_mod.status_block(
                                 service.node.app)
+                            # read plane counters (blob.* / blobpacks.*)
+                            from celestia_app_tpu.das import (
+                                blob_server as blob_server_mod,
+                            )
+
+                            out["blob"] = blob_server_mod.status_block()
                         self._send(200, out)
                     elif self.path == "/metrics":
                         # Prometheus text exposition (the reference's
@@ -172,6 +184,31 @@ class NodeService:
                             )
                             if isinstance(out, bytes):
                                 # /das/pack/chunk: raw static bytes
+                                self._send_raw(200, out)
+                            else:
+                                self._send(200, out)
+                        except SampleError as e:
+                            self._send(404 if "not served" in str(e)
+                                       else 400, {"error": str(e)})
+                    elif self.path.startswith("/blob/"):
+                        # the read plane (das/blob_server.py): namespace
+                        # reads + blob-pack static serving; BlobError is
+                        # a SampleError, so one handler covers both
+                        from urllib.parse import parse_qs, urlparse
+
+                        from celestia_app_tpu.das.server import SampleError
+                        from celestia_app_tpu.das.blob_server import (
+                            route_blob,
+                        )
+
+                        parsed = urlparse(self.path)
+                        try:
+                            out = route_blob(
+                                service.blob_core, "GET", parsed.path,
+                                parse_qs(parsed.query),
+                            )
+                            if isinstance(out, bytes):
+                                # /blob/pack/chunk: raw static bytes
                                 self._send_raw(200, out)
                             else:
                                 self._send(200, out)
@@ -290,6 +327,24 @@ class NodeService:
                         try:
                             self._send(200, route_das(
                                 service.das_core, "POST",
+                                urlparse(self.path).path, {}, payload,
+                            ))
+                        except SampleError as e:
+                            self._send(404 if "not served" in str(e)
+                                       else 400, {"error": str(e)})
+                    elif self.path.startswith("/blob/"):
+                        from urllib.parse import urlparse
+
+                        from celestia_app_tpu.das.server import (
+                            SampleError,
+                        )
+                        from celestia_app_tpu.das.blob_server import (
+                            route_blob,
+                        )
+
+                        try:
+                            self._send(200, route_blob(
+                                service.blob_core, "POST",
                                 urlparse(self.path).path, {}, payload,
                             ))
                         except SampleError as e:

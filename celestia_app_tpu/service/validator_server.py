@@ -92,6 +92,11 @@ class ValidatorService:
 
         self.das_core = SampleCore(vnode.app, app_lock=self.lock)
         vnode.app.add_da_seed_listener(self.das_core.seed_cache_entry)
+        # read plane: validators answer namespace reads off the SAME
+        # commit-seeded entry cache — no second build path
+        from celestia_app_tpu.das.blob_server import BlobCore
+
+        self.blob_core = BlobCore(self.das_core)
         # sync plane: the snapshot set this process serves for chunked
         # state sync (<home>/snapshots, written by the reactor's interval
         # hook / the CLI start loop); None for in-memory nodes — /sync/*
@@ -269,6 +274,33 @@ class ValidatorService:
                         except SampleError as e:
                             self._send(404 if "not served" in str(e)
                                        else 400, {"error": str(e)})
+                    elif self.path.startswith("/blob/"):
+                        # read plane (das/blob_server.py): namespace
+                        # reads + blob-pack static serving; BlobError
+                        # is a SampleError, so one handler covers both
+                        from urllib.parse import parse_qs, urlparse
+
+                        from celestia_app_tpu.das.server import (
+                            SampleError,
+                        )
+                        from celestia_app_tpu.das.blob_server import (
+                            route_blob,
+                        )
+
+                        parsed = urlparse(self.path)
+                        try:
+                            out = route_blob(
+                                service.blob_core, "GET", parsed.path,
+                                parse_qs(parsed.query),
+                            )
+                            if isinstance(out, bytes):
+                                # /blob/pack/chunk: raw static bytes
+                                self._send_raw(200, out)
+                            else:
+                                self._send(200, out)
+                        except SampleError as e:
+                            self._send(404 if "not served" in str(e)
+                                       else 400, {"error": str(e)})
                     elif self.path.split("?", 1)[0] \
                             == "/consensus/snapshot":
                         # DEPRECATED one-shot pull (FORMATS §15.4), now a
@@ -363,6 +395,23 @@ class ValidatorService:
                             self._send(404 if "not served" in str(e)
                                        else 400, {"error": str(e)})
                         return
+                    if self.path == "/blob/namespaces":
+                        from celestia_app_tpu.das.server import (
+                            SampleError,
+                        )
+                        from celestia_app_tpu.das.blob_server import (
+                            route_blob,
+                        )
+
+                        try:
+                            self._send(200, route_blob(
+                                service.blob_core, "POST", self.path,
+                                {}, payload,
+                            ))
+                        except SampleError as e:
+                            self._send(404 if "not served" in str(e)
+                                       else 400, {"error": str(e)})
+                        return
                     route = {
                         "/broadcast_tx": service._broadcast_tx,
                         "/consensus/propose": service._propose,
@@ -398,6 +447,12 @@ class ValidatorService:
 
         return admission_mod.status_block(app)
 
+    @staticmethod
+    def _blob_status() -> dict:
+        from celestia_app_tpu.das import blob_server as blob_server_mod
+
+        return blob_server_mod.status_block()
+
     def _status(self) -> dict:
         v = self.vnode
         out = {
@@ -421,6 +476,8 @@ class ValidatorService:
             # (the same numbers /metrics exposes), surfaced here so an
             # operator sees admission economics next to the mempool
             "admission": self._admission_status(v.app),
+            # read plane counters (blob.* / blobpacks.*) — process-wide
+            "blob": self._blob_status(),
         }
         if self.reactor is not None:
             out["reactor"] = {
