@@ -63,24 +63,27 @@ class LightClient:
 
     def submit_fraud_proof(self, commitments, proof) -> bool:
         """A gossiped incorrect-coding fraud proof against a block's DA
-        commitments: a da/fraud.BadEncodingProof against a DAH, or a
-        da/cmt.CmtFraudProof against CmtCommitments — the proof type
-        selects the codec (da/codec.py). If it VERIFIES — the committed
-        roots carry an invalid codeword — the data root
-        (``commitments.hash()``, whichever scheme) is condemned and any
-        header carrying it will be refused. Returns whether the proof
-        checked out."""
+        commitments. The proof's TYPE selects the codec through the
+        registry (each codec declares its ``fraud_proof_type`` —
+        da/codec.py), so a new scheme's proofs are dispatchable by
+        registration alone. If it VERIFIES — the committed roots carry
+        an invalid codeword — the data root (``commitments.hash()``,
+        whichever scheme) is condemned and any header carrying it will
+        be refused. Returns whether the proof checked out."""
         from celestia_app_tpu.da import codec as dacodec
-        from celestia_app_tpu.da import fraud
 
-        if isinstance(proof, fraud.BadEncodingProof):
-            codec = dacodec.get(dacodec.RS2D_NAME)
-        else:
-            from celestia_app_tpu.da import cmt
-
-            if not isinstance(proof, cmt.CmtFraudProof):
-                return False
-            codec = dacodec.get(dacodec.CMT_NAME)
+        codec = None
+        for sid in dacodec.registered_ids():
+            candidate = dacodec.by_id(sid)
+            try:
+                proof_type = candidate.fraud_proof_type()
+            except NotImplementedError:
+                continue
+            if isinstance(proof, proof_type):
+                codec = candidate
+                break
+        if codec is None:
+            return False
         try:
             ok = codec.verify_fraud_proof(commitments, proof)
         except Exception:

@@ -527,6 +527,9 @@ class CmtCodec(codec_mod.Codec):
     def verify_fraud_proof(self, commitments, proof) -> bool:
         return verify_fraud(commitments, proof)
 
+    def fraud_proof_type(self) -> type:
+        return CmtFraudProof
+
     def fraud_cells(self, commitments, location) -> list[tuple]:
         layer, equation = location
         return [(layer, m)

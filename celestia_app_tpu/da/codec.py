@@ -41,9 +41,11 @@ import math
 # rs2d-nmt for back-compat), JSON surfaces carry the name.
 SCHEME_RS2D = 0
 SCHEME_CMT = 1
+SCHEME_PCMT = 2
 
 RS2D_NAME = "rs2d-nmt"
 CMT_NAME = "cmt-ldpc"
+PCMT_NAME = "pcmt-polar"
 
 
 class CodecError(ValueError):
@@ -173,6 +175,13 @@ class Codec:
         commitments commit an invalid codeword."""
         raise NotImplementedError
 
+    def fraud_proof_type(self) -> type:
+        """The scheme's fraud-proof class. Gossip surfaces (the light
+        client's submit_fraud_proof) resolve the codec from the proof's
+        TYPE via the registry — adding a scheme never grows an if-chain
+        there."""
+        raise NotImplementedError
+
     def fraud_cells(self, commitments, location) -> list[tuple]:
         """The sample cells a light node must hold (served + verified)
         to assemble the fraud proof for ``location`` — what the DASer's
@@ -230,6 +239,16 @@ def _ensure_builtin() -> None:
         from celestia_app_tpu.da import codec_rs2d  # noqa: F401
     if CMT_NAME not in _REGISTRY:
         from celestia_app_tpu.da import cmt  # noqa: F401
+    if PCMT_NAME not in _REGISTRY:
+        from celestia_app_tpu.da import pcmt  # noqa: F401
+
+
+def _registered_desc() -> str:
+    """'id=name' listing for unknown-scheme errors: whoever hits a wire
+    id or name this build does not carry should see exactly what it
+    DOES carry (tests pin both the id and the names appear)."""
+    return ", ".join(
+        f"{i}={_BY_ID[i].name}" for i in sorted(_BY_ID))
 
 
 def get(name: str) -> Codec:
@@ -238,7 +257,8 @@ def get(name: str) -> Codec:
     codec = _REGISTRY.get(name)
     if codec is None:
         raise CodecError(
-            f"unknown DA scheme {name!r} (have {sorted(_REGISTRY)})")
+            f"unknown DA scheme {name!r} "
+            f"(registered: {_registered_desc()})")
     return codec
 
 
@@ -248,7 +268,8 @@ def by_id(scheme_id: int) -> Codec:
     codec = _BY_ID.get(scheme_id)
     if codec is None:
         raise CodecError(
-            f"unknown DA scheme id {scheme_id} (have {sorted(_BY_ID)})")
+            f"unknown DA scheme id {scheme_id} "
+            f"(registered: {_registered_desc()})")
     return codec
 
 
@@ -259,3 +280,11 @@ def default() -> Codec:
 def names() -> list[str]:
     _ensure_builtin()
     return sorted(_REGISTRY)
+
+
+def registered_ids() -> list[int]:
+    """Sorted wire ids of every registered scheme — what the shared
+    conformance suite (tests/test_codec_iface.py) parametrizes over, so
+    a new scheme is conformance-covered by registration alone."""
+    _ensure_builtin()
+    return sorted(_BY_ID)

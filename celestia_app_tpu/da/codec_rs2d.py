@@ -188,5 +188,10 @@ class Rs2dNmtCodec(codec_mod.Codec):
 
         return fraud.verify_befp(commitments, proof)
 
+    def fraud_proof_type(self) -> type:
+        from celestia_app_tpu.da import fraud
+
+        return fraud.BadEncodingProof
+
 
 codec_mod.register(Rs2dNmtCodec())

@@ -1,0 +1,532 @@
+"""GF(2) polar transform kernels for the Polar Coded Merkle Tree scheme.
+
+The PCMT construction (arXiv:2201.07287) replaces the CMT's sparse LDGM
+layers with polar codes: layer data is placed on the information set of
+the n x n polar transform G_N = F^{(x) log2 n} (F = [[1,0],[1,1]]), and
+the *pruned factor graph* of the transform — every intermediate stage
+value that survives known-zero simplification — is what gets committed
+and sampled. Degree-3 XOR checks between committed classes give the same
+two properties the LDGM code gave the CMT: peeling repair from a symbol
+subset, and one-violated-equation incorrect-coding fraud proofs. This
+module is the code itself, scheme-agnostic:
+
+- **Frozen set (informed design, arXiv:2301.08295).** The information
+  set is the n_data most reliable synthetic channels under a Q32
+  fixed-point Bhattacharyya recursion for BEC(1/2) — pure u64 integer
+  arithmetic, so every node derives the identical set with no float in
+  sight (det-float scope). Ties are broken toward HIGHER Hamming weight
+  first (the informed-design bias: high-weight rows give the pruned
+  graph better stopping-set geometry), then by a sha256-keyed
+  deterministic shuffle exactly like ops/ldpc.parity_indices derives its
+  permutations — nothing rides the wire, verifiers recompute everything
+  from (n, n_data) alone. The resulting set is *up-closed* under bitwise
+  domination (asserted), which is what makes the two-transform encode
+  below systematic.
+
+- **Pruned factor graph.** Stage values v_s[i] (s = 0..m, i = 0..n-1)
+  with v_{s+1}[i] = v_s[i] ^ v_s[i | 2^s] when bit s of i is clear and
+  v_{s+1}[i] = v_s[i] when set. Equal-value chains collapse to one
+  committed class; frozen inputs are known zero and propagate; checks
+  that lose members to zeros/cancellation degrade (degree 1 forces a
+  zero, degree 2 merges two classes) until a fixpoint of degree-3 checks
+  over non-zero classes remains. The committed classes (canonically
+  ordered by minimum node id) are the layer's coded symbols; the
+  deduplicated check list is its parity-equation set.
+
+- **Encode.** Systematic double transform: scatter data onto A, apply
+  G, re-mask to A, apply G again — up-closure of A makes x[A] == data
+  exactly (G restricted to A is an involution). Host engine: numpy
+  XOR butterflies. Device engine: one jitted dispatch per (n_data,
+  sym_bytes) bucket — the first transform as ONE dense GF(2) bit-matmul
+  (G @ bits) & 1 on the MXU for n <= POLAR_MATMUL_MAX_N (the ops/rs.py
+  / ops/ldpc.py playbook; above that the same algebra runs as in-jit
+  reshape-XOR butterfly stages), the second as butterfly stages with
+  per-stage gathers of the committed representatives. Bit-identical by
+  exact integer algebra (pinned in tests/test_codec_iface.py).
+
+- **Peeling (successive-cancellation) decode.** Iterative degree-1
+  resolution over the pruned checks: per sweep, every check with
+  exactly one unknown member resolves it to the XOR of its two known
+  members; contended targets go to the LOWEST check index via a
+  commutative scatter-min. The device engine runs the whole peel as
+  masked gather/scatter sweeps inside one ``lax.while_loop`` dispatch
+  with only commutative (.min/.max) updates, so host numpy and device
+  recover byte-identical values even from *inconsistent* (fraud)
+  inputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+
+import numpy as np
+
+FROZEN_TAG = b"pcmt/frozen"
+
+# Largest transform size whose first pass runs as a dense n x n bit-
+# matmul on the device engine (int8 generator: 64 MB at 8192). Larger
+# transforms use the identical-algebra in-jit butterfly stages — the
+# k=128 base layer (n = 32768) would need a 1 GB generator for a
+# transform the butterflies do in m=15 XOR passes.
+POLAR_MATMUL_MAX_N = 8192
+
+_Q32_CAP = np.uint64((1 << 32) - 1)
+
+
+def reliability(n: int) -> np.ndarray:
+    """(n,) u64 Q32 fixed-point Bhattacharyya parameters of the n
+    synthetic channels for BEC(1/2): z(-) = 2z - z^2 (minus/upper
+    branch), z(+) = z^2, natural bit order (index bit s = branch taken
+    at level s). Lower is more reliable. Pure wrapping-u64 arithmetic —
+    deterministic across platforms, no float."""
+    z = np.array([1 << 31], dtype=np.uint64)
+    m = n.bit_length() - 1
+    with np.errstate(over="ignore"):
+        for _ in range(m):
+            z2 = (z * z) >> np.uint64(32)
+            minus = np.minimum(np.uint64(2) * z - z2, _Q32_CAP)
+            z = np.concatenate([minus, z2])
+    return z
+
+
+def _popcounts(n: int) -> np.ndarray:
+    v = np.arange(n, dtype=np.uint64)
+    pc = np.zeros(n, dtype=np.int64)
+    for s in range(max(1, n.bit_length() - 1)):
+        pc += ((v >> np.uint64(s)) & np.uint64(1)).astype(np.int64)
+    return pc
+
+
+def _tie_keys(n: int) -> np.ndarray:
+    """sha256-derived u64 tie-break keys (the ops/ldpc.parity_indices
+    discipline: seeded hashing is the one sanctioned entropy source)."""
+    keys = np.empty(n, dtype=np.uint64)
+    nb = n.to_bytes(8, "big")
+    for i in range(n):
+        h = hashlib.sha256(
+            FROZEN_TAG + nb + i.to_bytes(8, "big")).digest()[:8]
+        keys[i] = int.from_bytes(h, "big")
+    return keys
+
+
+def info_set(n: int, n_data: int) -> np.ndarray:
+    """The information set A: the n_data channel indices picked by
+    (reliability asc, Hamming weight desc, sha256 key asc, index asc),
+    returned sorted ascending. Up-closed under bitwise domination (every
+    superset-mask of a member is a member) — the property behind the
+    systematic two-transform encode; violations would be a construction
+    bug, so they raise."""
+    z = reliability(n)
+    pc = _popcounts(n)
+    keys = _tie_keys(n)
+    order = np.lexsort((np.arange(n), keys, -pc, z))
+    a = np.sort(order[:n_data]).astype(np.int64)
+    in_a = np.zeros(n, dtype=bool)
+    in_a[a] = True
+    for s in range(n.bit_length() - 1):
+        up = a | np.int64(1 << s)
+        if not in_a[up].all():
+            raise AssertionError(
+                f"info set not up-closed at n={n}, n_data={n_data}")
+    return a
+
+
+@dataclasses.dataclass(frozen=True)
+class PolarGeometry:
+    """The pruned factor graph of one (n_data -> n) polar layer — a pure
+    function of n_data, canonical across nodes (node ids are s*n + i;
+    class representative = minimum node id; checks deduplicated and
+    lexicographically ordered)."""
+
+    n: int
+    m: int
+    n_data: int
+    A: np.ndarray  # (n_data,) i64 information set, ascending
+    C: int  # committed class count
+    reps: np.ndarray  # (C, 2) i32 (stage, position) representative
+    checks: np.ndarray  # (n_checks, 3) i32 committed-class triples
+    data_class: np.ndarray  # (n_data,) i32 committed index of data t
+
+
+def _compress(p: np.ndarray) -> np.ndarray:
+    while True:
+        p2 = p[p]
+        if np.array_equal(p2, p):
+            return p2
+        p = p2
+
+
+@functools.lru_cache(maxsize=32)
+def geometry(n_data: int) -> PolarGeometry:
+    """Build the pruned factor graph for an n_data-symbol layer
+    (n = smallest power of two >= 2*n_data, rate <= 1/2).
+
+    Simplification runs set-at-a-time to a fixpoint: map check members
+    to class roots, drop known-zero members, GF(2)-cancel duplicate
+    members, then degree 0 drops the check, degree 1 forces its member
+    zero, degree 2 merges the pair (kept until the merge lands so
+    chained equalities in one round are not lost). The inferences are
+    monotone, so the fixpoint — and with it the committed geometry — is
+    unique regardless of sweep order."""
+    if n_data < 1:
+        raise ValueError(f"n_data must be >= 1, got {n_data}")
+    n = 1
+    while n < 2 * n_data:
+        n *= 2
+    m = n.bit_length() - 1
+    a = info_set(n, n_data)
+    nn = (m + 1) * n
+    inf = nn  # sentinel for a cancelled/absent member
+
+    ii = np.arange(n, dtype=np.int64)
+    p = np.arange(nn, dtype=np.int64)
+    for s in range(m):
+        sel = ii[(ii >> s) & 1 == 1]
+        p[(s + 1) * n + sel] = s * n + sel  # equal-value chain link
+    p = _compress(p)
+
+    zero = np.zeros(nn, dtype=bool)
+    frozen = np.ones(n, dtype=bool)
+    frozen[a] = False
+    zero[p[ii[frozen]]] = True
+
+    row_parts = []
+    for s in range(m):
+        sel = ii[(ii >> s) & 1 == 0]
+        row_parts.append(np.stack(
+            [(s + 1) * n + sel, s * n + sel, s * n + (sel | (1 << s))],
+            axis=1))
+    rows = np.concatenate(row_parts, axis=0)
+
+    while True:
+        changed = False
+        p = _compress(p)
+        safe = np.minimum(rows, nn - 1)
+        r = np.where(rows < inf, p[safe], inf)
+        zr = (rows < inf) & zero[np.minimum(r, nn - 1)]
+        r = np.where(zr, inf, r)
+        r.sort(axis=1)
+        eq01 = (r[:, 0] == r[:, 1]) & (r[:, 0] < inf)
+        eq12 = (r[:, 1] == r[:, 2]) & (r[:, 1] < inf)
+        all3 = eq01 & eq12
+        out = r.copy()
+        pair01 = eq01 & ~all3
+        out[pair01, 0] = r[pair01, 2]
+        out[pair01 | eq12 | all3, 2] = inf
+        out[pair01 | (eq12 & ~all3) | all3, 1] = inf
+        out.sort(axis=1)
+        r = out
+        deg = (r < inf).sum(axis=1)
+        ones = r[deg == 1, 0]
+        if ones.size:
+            if not zero[ones].all():
+                changed = True
+            zero[ones] = True
+        two = r[deg == 2, :2]
+        if len(two):
+            lo = two.min(axis=1)
+            hi = two.max(axis=1)
+            before = p[hi].copy()
+            np.minimum.at(p, hi, lo)
+            if not np.array_equal(before, p[hi]):
+                changed = True
+            # zero flows across the merge in both directions
+            zmerge = zero[lo] | zero[hi]
+            zero[lo] |= zmerge
+            zero[hi] |= zmerge
+        keep = deg >= 2
+        if not keep.all():
+            changed = True
+        rows = r[keep]
+        if not changed:
+            break
+
+    p = _compress(p)
+    # propagate zero flags to final roots
+    zero_roots = np.zeros(nn, dtype=bool)
+    np.logical_or.at(zero_roots, p, zero)
+    zero = zero_roots
+
+    deg = (rows < inf).sum(axis=1)
+    if (deg != 3).any():
+        raise AssertionError("unconsumed sub-degree-3 check at fixpoint")
+    final = p[rows]
+    if zero[final].any():
+        raise AssertionError("zero member survived simplification")
+    final.sort(axis=1)
+    final = np.unique(final, axis=0)
+
+    x_roots = p[m * n + ii]
+    if zero[x_roots].any():
+        raise AssertionError("coded position forced zero by frozen set")
+    committed = np.unique(np.concatenate([x_roots, final.ravel()]))
+    cidx = np.full(nn, -1, dtype=np.int64)
+    cidx[committed] = np.arange(len(committed))
+    checks = cidx[final].astype(np.int32)
+    data_class = cidx[p[m * n + a]].astype(np.int32)
+    if len(np.unique(data_class)) != n_data:
+        raise AssertionError("data positions share a committed class")
+    reps = np.stack([committed // n, committed % n],
+                    axis=1).astype(np.int32)
+    for arr in (a, reps, checks, data_class):
+        arr.setflags(write=False)
+    return PolarGeometry(n=n, m=m, n_data=n_data, A=a,
+                         C=len(committed), reps=reps, checks=checks,
+                         data_class=data_class)
+
+
+# ---------------------------------------------------------------------------
+# encode
+# ---------------------------------------------------------------------------
+
+
+def _butterfly_stages(v: np.ndarray, m: int) -> list[np.ndarray]:
+    """All m+1 stage arrays of the transform, stage 0 = input."""
+    out = v.copy()
+    stages = [out.copy()]
+    for s in range(m):
+        w = out.reshape(-1, 2, 1 << s, out.shape[1])
+        w[:, 0] ^= w[:, 1]
+        stages.append(out.copy())
+    return stages
+
+
+def encode_host(data: np.ndarray) -> np.ndarray:
+    """(n_data, S) u8 data -> (C, S) u8 committed class values, pure
+    numpy XOR butterflies (the host engine)."""
+    g = geometry(data.shape[0])
+    s_bytes = data.shape[1]
+    t = np.zeros((g.n, s_bytes), dtype=np.uint8)
+    t[g.A] = data
+    w = _butterfly_stages(t, g.m)[-1]
+    u = np.zeros_like(w)
+    u[g.A] = w[g.A]
+    stages = _butterfly_stages(u, g.m)
+    if not np.array_equal(stages[-1][g.A], data):
+        raise AssertionError("systematic property failed")  # impossible
+    vals = np.empty((g.C, s_bytes), dtype=np.uint8)
+    for s in range(g.m + 1):
+        sel = g.reps[:, 0] == s
+        if sel.any():
+            vals[sel] = stages[s][g.reps[sel, 1]]
+    return vals
+
+
+@functools.lru_cache(maxsize=32)
+def jitted_encode(n_data: int, sym_bytes: int):
+    """Compiled device encode for one layer geometry: (n_data, S) u8 ->
+    (C, S) u8 in ONE dispatch. First transform is the dense GF(2)
+    bit-matmul (G @ bits) & 1 for n <= POLAR_MATMUL_MAX_N (the
+    ops/ldpc.jitted_encode playbook with the polar generator as the bit
+    matrix); the second transform unrolls the m butterfly stages and
+    gathers each stage's committed representatives."""
+    import jax
+    import jax.numpy as jnp
+
+    from celestia_app_tpu.obs import jax_profile
+
+    jax_profile.note_compile("polar.encode", (n_data, sym_bytes))
+    g = geometry(n_data)
+    n, m = g.n, g.m
+    a = jnp.asarray(g.A)
+    use_matmul = n <= POLAR_MATMUL_MAX_N
+    if use_matmul:
+        jj = np.arange(n, dtype=np.int64)
+        # G[i, j] = 1 iff i is a bitwise subset of j (x = G v matches
+        # the butterfly orientation: x[i] = XOR of v over supersets)
+        gen = jnp.asarray(
+            ((jj[:, None] & jj[None, :]) == jj[:, None]).astype(np.int8))
+    stage_sel = [np.flatnonzero(g.reps[:, 0] == s) for s in range(m + 1)]
+    stage_pos = [g.reps[idx, 1] for idx in stage_sel]
+
+    def butterfly(x, s):
+        w = x.reshape(-1, 2, 1 << s, x.shape[-1])
+        return jnp.concatenate([w[:, 0] ^ w[:, 1], w[:, 1]],
+                               axis=1).reshape(x.shape)
+
+    def run(data: jax.Array) -> jax.Array:
+        t = jnp.zeros((n, sym_bytes), jnp.uint8).at[a].set(data)
+        if use_matmul:
+            shifts = jnp.arange(8, dtype=jnp.uint8)
+            bits = ((t[..., None] >> shifts) & 1).reshape(n, -1)
+            wb = jnp.einsum("ij,js->is", gen, bits.astype(jnp.int8),
+                            preferred_element_type=jnp.int32) & 1
+            by = wb.reshape(n, sym_bytes, 8).astype(jnp.uint8)
+            weights = (1 << jnp.arange(8, dtype=jnp.uint8))
+            w = jnp.sum(by * weights, axis=-1).astype(jnp.uint8)
+        else:
+            w = t
+            for s in range(m):
+                w = butterfly(w, s)
+        x = jnp.zeros((n, sym_bytes), jnp.uint8).at[a].set(w[a])
+        vals = jnp.zeros((g.C, sym_bytes), jnp.uint8)
+        for s in range(m + 1):
+            if len(stage_sel[s]):
+                vals = vals.at[jnp.asarray(stage_sel[s])].set(
+                    x[jnp.asarray(stage_pos[s])])
+            if s < m:
+                x = butterfly(x, s)
+        return vals
+
+    return jax.jit(run)
+
+
+def encode(data: np.ndarray, engine: str = "auto") -> np.ndarray:
+    """Engine-gated committed-class encode; both paths bit-identical."""
+    from celestia_app_tpu.ops import ldpc
+
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    if engine == "auto" and not ldpc.auto_wants_device():
+        return encode_host(data)
+    if engine in ("device", "auto"):
+        try:
+            import jax.numpy as jnp
+
+            run = jitted_encode(data.shape[0], data.shape[1])
+            return np.asarray(run(jnp.asarray(data)))
+        except Exception:
+            if engine == "device":
+                raise
+            from celestia_app_tpu.utils import telemetry
+
+            telemetry.incr("app.device_path_fallback")
+    return encode_host(data)
+
+
+# ---------------------------------------------------------------------------
+# peeling (successive-cancellation) decode
+# ---------------------------------------------------------------------------
+
+
+def peel_host(n_data: int, vals: np.ndarray, known: np.ndarray
+              ) -> tuple[np.ndarray, np.ndarray, int]:
+    """Peel erasures out of one committed layer on the host.
+
+    ``vals`` is (C, S) u8, ``known`` (C,) bool; unknown positions are
+    normalized to zero so both engines see identical state. Returns
+    (vals, known, sweeps). Resolution rule (shared with the device
+    sweep): per sweep every degree-3 check with exactly one unknown
+    member resolves it to the XOR of the known two; contended targets
+    go to the LOWEST check index."""
+    g = geometry(n_data)
+    checks = g.checks
+    known = np.asarray(known, dtype=bool).copy()
+    vals = np.where(known[:, None],
+                    np.ascontiguousarray(vals, dtype=np.uint8), 0)
+    n_checks = len(checks)
+    sweeps = 0
+    while n_checks:
+        kn = known[checks]  # (nc, 3)
+        resolvable = kn.sum(axis=1) == 2
+        if not resolvable.any():
+            break
+        sweeps += 1
+        masked = vals * known[:, None]
+        eqxor = (masked[checks[:, 0]] ^ masked[checks[:, 1]]
+                 ^ masked[checks[:, 2]])
+        tgt = checks[np.arange(n_checks), np.argmin(kn, axis=1)]
+        eq_ids = np.flatnonzero(resolvable)
+        best = np.full(g.C, n_checks, dtype=np.int64)
+        np.minimum.at(best, tgt[resolvable], eq_ids)
+        chosen = best[tgt[resolvable]] == eq_ids
+        vals[tgt[resolvable][chosen]] = eqxor[resolvable][chosen]
+        known[tgt[resolvable][chosen]] = True
+    return vals, known, sweeps
+
+
+@functools.lru_cache(maxsize=32)
+def jitted_peel(n_data: int, sym_bytes: int):
+    """Compiled whole-peel program for one layer geometry: a
+    lax.while_loop of masked gather/scatter sweeps entirely on device —
+    gather each check's member knowledge, XOR its known members, pick
+    one check per contended target with a commutative scatter-min, and
+    land the resolved symbols with commutative scatter-max updates
+    (unknown state is all-zero, so max IS assignment). One dispatch
+    peels to fixpoint, byte-identical to peel_host even on inconsistent
+    fraud inputs."""
+    import jax
+    import jax.numpy as jnp
+
+    from celestia_app_tpu.obs import jax_profile
+
+    jax_profile.note_compile("polar.peel", (n_data, sym_bytes))
+    g = geometry(n_data)
+    checks = jnp.asarray(g.checks.astype(np.int32))
+    n_checks = len(g.checks)
+
+    def body(state):
+        vals, known, _progressed, sweeps = state
+        kn = known[checks]  # (nc, 3) u8 0/1
+        resolvable = kn.astype(jnp.int32).sum(axis=1) == 2
+        masked = vals * known[:, None]
+        eqxor = (masked[checks[:, 0]] ^ masked[checks[:, 1]]
+                 ^ masked[checks[:, 2]])
+        tgt = checks[jnp.arange(n_checks), jnp.argmin(kn, axis=1)]
+        eqid = jnp.where(resolvable, jnp.arange(n_checks), n_checks)
+        best = jnp.full((g.C,), n_checks, dtype=jnp.int32) \
+            .at[tgt].min(eqid.astype(jnp.int32))
+        chosen = resolvable & (jnp.arange(n_checks) == best[tgt])
+        vals = vals.at[tgt].max(
+            jnp.where(chosen[:, None], eqxor, 0))
+        known = known.at[tgt].max(chosen.astype(jnp.uint8))
+        return vals, known, chosen.any(), sweeps + 1
+
+    def run(vals: jax.Array, known: jax.Array):
+        state = (vals.astype(jnp.uint8), known.astype(jnp.uint8),
+                 jnp.bool_(True), jnp.int32(0))
+        if n_checks == 0:
+            return state[0], state[1].astype(jnp.bool_), jnp.int32(0)
+        vals, kn, _p, sweeps = jax.lax.while_loop(
+            lambda s: s[2], body, state)
+        return vals, kn.astype(jnp.bool_), sweeps
+
+    return jax.jit(run)
+
+
+def peel(n_data: int, vals: np.ndarray, known: np.ndarray,
+         engine: str = "auto") -> tuple[np.ndarray, np.ndarray, int]:
+    """Engine-gated peeling; device and host are bit-identical (pinned
+    in tests/test_codec_iface.py, including on inconsistent inputs)."""
+    from celestia_app_tpu.ops import ldpc
+
+    known = np.asarray(known, dtype=bool)
+    vals = np.where(known[:, None],
+                    np.ascontiguousarray(vals, dtype=np.uint8), 0)
+    if engine == "auto" and not ldpc.auto_wants_device():
+        return peel_host(n_data, vals, known)
+    if engine in ("device", "auto"):
+        try:
+            import jax.numpy as jnp
+
+            run = jitted_peel(n_data, vals.shape[1])
+            v, kn, sweeps = run(jnp.asarray(vals), jnp.asarray(known))
+            return (np.asarray(v), np.asarray(kn),
+                    max(0, int(sweeps) - 1))  # final sweep: no progress
+        except Exception:
+            if engine == "device":
+                raise
+            from celestia_app_tpu.utils import telemetry
+
+            telemetry.incr("app.device_path_fallback")
+    return peel_host(n_data, vals, known)
+
+
+def check_equations(n_data: int, vals: np.ndarray,
+                    known: np.ndarray) -> np.ndarray:
+    """Check audit over one committed layer: ascending ids of VIOLATED
+    checks among those with every member known. A violation on
+    fully-verified members is exactly an incorrect-coding fraud
+    (da/pcmt.py carries the lowest attributable one as the proof's
+    equation)."""
+    g = geometry(n_data)
+    if not len(g.checks):
+        return np.zeros(0, dtype=np.int64)
+    known = np.asarray(known, dtype=bool)
+    full = known[g.checks].all(axis=1)
+    vals = np.ascontiguousarray(vals, dtype=np.uint8)
+    eqxor = (vals[g.checks[:, 0]] ^ vals[g.checks[:, 1]]
+             ^ vals[g.checks[:, 2]])
+    bad = full & eqxor.any(axis=1)
+    return np.flatnonzero(bad).astype(np.int64)

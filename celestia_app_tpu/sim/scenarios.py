@@ -124,25 +124,12 @@ def _install_incorrect_coding(sim: Simulation, op: dict,
     def inject(s: Simulation, committer) -> None:
         scheme = s.spec.scheme
         ods = _ods(k, seed=5)
-        if scheme == dacodec.CMT_NAME:
-            from celestia_app_tpu.da import cmt as cmt_mod
-
-            bad_eq = 3
-            entry = malicious.cmt_bad_parity_entry(ods, equation=bad_eq)
-            comm = entry.commitments
-            members = set(cmt_mod.equation_members(comm, 0, bad_eq))
-            candidates = [i for i in range(comm.n_base)
-                          if i not in members]
-            withheld = [(0, i) for i in
-                        candidates[: comm.n_base // 4]]
-            wire_scheme = dacodec.SCHEME_CMT
-        else:
-            bad_row = 1
-            entry = malicious.rs2d_bad_parity_entry(ods, row=bad_row)
-            # half the bad row withheld: samplers escalate, yet the
-            # orthogonal-proof BEFP still finds its k members
-            withheld = [(bad_row, j) for j in range(k)]
-            wire_scheme = 0
+        # the scheme-keyed committed-non-codeword fixture: entry +
+        # provable location + a withholding set that forces escalation
+        # while keeping the fraud equation's members served — one hook,
+        # no per-scheme branches here (testing/malicious.py)
+        entry, _location, withheld, wire_scheme = \
+            malicious.incorrect_coding_fixture(scheme, ods)
         app0 = committer.vnode.app  # the one node sure to hold `after`
         header = Header(
             chain_id=s.chain_id, height=bad_h,

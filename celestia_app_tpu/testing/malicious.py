@@ -132,6 +132,90 @@ def cmt_bad_parity_entry(ods: np.ndarray, equation: int,
     return cmt.CmtEntry(commitments, layers, hash_lists)
 
 
+def pcmt_bad_parity_entry(ods: np.ndarray, equation: int | None = None,
+                          xor_byte: int = 0x5A,
+                          engine: str = "host"):
+    """Malicious PCMT producer (codec plane, da/pcmt.py): polar-encode
+    the ODS honestly, corrupt ONE non-data committed class BEFORE
+    hashing, and grow the whole hash tree over the result — the
+    commitments bind the corrupt class, sampling alone verifies it, and
+    only the SC peeling decoder's check audit can convict. With
+    ``equation`` the corrupt class is that check's lowest non-data
+    member; by default it is the lowest check-constrained non-data
+    class. The provable location — (0, lowest check containing the
+    corrupt class), which is what ``repair`` raises when that check's
+    members are all served — rides on the entry as
+    ``entry.fraud_location``."""
+    from celestia_app_tpu.da import pcmt
+    from celestia_app_tpu.ops import polar
+
+    k = ods.shape[0]
+    g = polar.geometry(k * k)
+    data = np.ascontiguousarray(ods, dtype=np.uint8).reshape(
+        k * k, appconsts.SHARE_SIZE)
+    base = polar.encode(data, engine).copy()
+    is_data = np.zeros(g.C, dtype=bool)
+    is_data[g.data_class] = True
+    if equation is None:
+        in_check = np.zeros(g.C, dtype=bool)
+        in_check[g.checks.ravel()] = True
+        target = int(np.flatnonzero(~is_data & in_check)[0])
+    else:
+        cand = [int(x) for x in g.checks[equation] if not is_data[x]]
+        if not cand:
+            raise ValueError(
+                f"check {equation} has only data members; pick another")
+        target = min(cand)
+    base[target, 0] ^= xor_byte
+    entry = pcmt.build_from_base(ods, base, engine)
+    containing = np.flatnonzero((g.checks == target).any(axis=1))
+    entry.fraud_location = (0, int(containing[0]))
+    return entry
+
+
+def incorrect_coding_fixture(scheme: str, ods: np.ndarray,
+                             engine: str = "host"):
+    """THE scheme-keyed committed-non-codeword fixture: returns (entry,
+    location, withheld_cells, wire_id) for any registered scheme — the
+    one hook sim/scenarios.py and bench.py drive, so judging a new
+    codec needs a fixture here and no if-chains there. ``location`` is
+    what the scheme's repair provably raises; ``withheld_cells`` is a
+    quarter-ish withholding set that forces samplers to escalate while
+    keeping the fraud location's members served (the proof must stay
+    assemblable from served symbols)."""
+    k = ods.shape[0]
+    if scheme == "rs2d-nmt":
+        entry = rs2d_bad_parity_entry(ods, row=1)
+        # half the bad row withheld: samplers escalate, yet the
+        # orthogonal-proof BEFP still finds its k members
+        return entry, ("row", 1), [(1, j) for j in range(k)], 0
+    if scheme == "cmt-ldpc":
+        from celestia_app_tpu.da import cmt as cmt_mod
+
+        bad_eq = 3
+        entry = cmt_bad_parity_entry(ods, equation=bad_eq,
+                                     engine=engine)
+        comm = entry.commitments
+        members = set(cmt_mod.equation_members(comm, 0, bad_eq))
+        candidates = [i for i in range(comm.n_base)
+                      if i not in members]
+        withheld = [(0, i) for i in candidates[: comm.n_base // 4]]
+        return entry, (0, bad_eq), withheld, 1
+    if scheme == "pcmt-polar":
+        from celestia_app_tpu.da import pcmt as pcmt_mod
+
+        entry = pcmt_bad_parity_entry(ods, engine=engine)
+        location = entry.fraud_location
+        comm = entry.commitments
+        members = set(pcmt_mod.equation_members(
+            comm, location[0], location[1]))
+        candidates = [i for i in range(comm.n_base)
+                      if i not in members]
+        withheld = [(0, i) for i in candidates[: comm.n_base // 4]]
+        return entry, location, withheld, 2
+    raise ValueError(f"no malicious fixture for scheme {scheme!r}")
+
+
 def rs2d_bad_parity_entry(ods: np.ndarray, row: int = 1,
                           xor_byte: int = 0x5A):
     """Malicious 2D-RS producer (codec plane): extend honestly, corrupt

@@ -37,14 +37,14 @@ sys.path.insert(0, os.path.dirname(__file__))
 from test_consensus_multinode import CHAIN, _genesis  # noqa: E402
 
 
-def _cmt_network(tmp_path, n=2):
+def _scheme_network(tmp_path, scheme, n=2):
     privs = [PrivateKey.from_seed(bytes([i + 1])) for i in range(n)]
     genesis = _genesis(privs)
     nodes = [
         consensus.ValidatorNode(
             f"val{i}", privs[i], genesis, CHAIN,
             data_dir=str(tmp_path / f"val{i}"),
-            da_scheme="cmt-ldpc",
+            da_scheme=scheme,
         )
         for i in range(n)
     ]
@@ -53,6 +53,10 @@ def _cmt_network(tmp_path, n=2):
     for i, p in enumerate(privs):
         signer.add_account(p, number=i)
     return net, signer, privs
+
+
+def _cmt_network(tmp_path, n=2):
+    return _scheme_network(tmp_path, "cmt-ldpc", n=n)
 
 
 def _trust(net) -> light.TrustedState:
@@ -186,6 +190,122 @@ def test_cmt_devnet_commits_samples_and_condemns_fraud(tmp_path):
         # ---- halted checkpoint survives restart -----------------------
         reborn = DASer([url], light.LightClient(CHAIN, _trust(net)),
                        store, cfg=cfg, name="cmt-post-halt")
+        assert reborn.halted
+        assert reborn.sync() == {"halted": out["halted"]}
+    finally:
+        svc.shutdown()
+
+
+def test_pcmt_devnet_commits_samples_and_condemns_fraud(tmp_path):
+    """The ISSUE 17 acceptance story: the same 2-validator devnet
+    running WHOLESALE on wire id 2 — headers commit pcmt-polar, the
+    DASer verifies layered batch-subtree sample proofs over real HTTP,
+    and a certified withheld+mis-coded block is condemned through the
+    SC peeling decoder's one-check fraud path. The DASer code is
+    byte-identical to the CMT run: only the registered codec differs."""
+    net, signer, privs = _scheme_network(tmp_path, "pcmt-polar")
+    a0 = privs[0].public_key().address()
+    a1 = privs[1].public_key().address()
+    t = 1_700_000_000.0
+    tx = signer.create_tx(a0, [MsgSend(a0, a1, 100)],
+                          fee=2000, gas_limit=100_000)
+    assert net.broadcast_tx(tx.encode())
+    blk, cert = net.produce_height(t=t + 10)
+    assert blk is not None and cert is not None
+    assert blk.header.da_scheme == dacodec.SCHEME_PCMT
+    assert len({n.app.last_app_hash for n in net.nodes}) == 1
+
+    node = net.nodes[0]
+    svc = NodeService(node, port=0)
+    svc.serve_background()
+    url = f"http://127.0.0.1:{svc.port}"
+    codec = dacodec.get("pcmt-polar")
+    try:
+        # ---- wholesale sampling over real HTTP ------------------------
+        cfg = DASerConfig(samples_per_header=8, workers=2, job_size=2,
+                          retries=2, backoff=0.01)
+        store = CheckpointStore(str(tmp_path / "daser" / "cp.json"))
+        d = DASer([url], light.LightClient(CHAIN, _trust(net)), store,
+                  cfg=cfg, rng=np.random.default_rng(42), name="pcmt-d0")
+        out = d.sync()
+        assert out["halted"] is None
+        assert out["head"] == 1 and out["sampled"] == [1]
+        rep = d.reports[1]
+        assert rep["status"] == "sampled"
+        assert rep["scheme"] == "pcmt-polar"
+        assert rep["confidence"] == codec.confidence(8)
+
+        # ---- the byzantine height: certified, withheld, mis-coded ----
+        k = 4
+        rng = np.random.RandomState(5)
+        ods = rng.randint(0, 256, size=(k, k, appconsts.SHARE_SIZE),
+                          dtype=np.uint8)
+        entry, location, withheld_cells, wire_id = \
+            malicious.incorrect_coding_fixture("pcmt-polar", ods)
+        assert wire_id == dacodec.SCHEME_PCMT
+        comm = entry.commitments
+        app = node.app
+        bad_h = app.height + 1
+        header = Header(
+            chain_id=CHAIN, height=bad_h, time_unix=1_700_000_999.0,
+            data_hash=entry.data_root, square_size=k,
+            app_hash=b"\x77" * 32, proposer=node.address,
+            app_version=app.app_version,
+            last_block_hash=app.last_block_hash,
+            validators_hash=validators_hash_of(
+                [(n.address, 10) for n in net.nodes]),
+            da_scheme=dacodec.SCHEME_PCMT,
+        )
+        votes = tuple(
+            consensus.Vote(
+                bad_h, header.hash(), n.address,
+                n.priv.sign(consensus.Vote.sign_bytes(
+                    CHAIN, bad_h, header.hash(), "precommit", 0)),
+                "precommit", 0,
+            )
+            for n in net.nodes
+        )
+        cert = consensus.CommitCertificate(bad_h, header.hash(), votes, 0)
+        svc.das_core.seed_scheme_entry(bad_h, entry)
+        # the fixture's withholding set forces escalation while leaving
+        # the violated check's members served (proof stays assemblable)
+        withheld = set(withheld_cells)
+        svc.das_core.withhold(bad_h, withheld)
+
+        peers = PeerSet([url], timeout=5.0, retries=2, backoff=0.01)
+        base_source = http_header_source(peers)
+
+        def source(h):
+            if h == bad_h:
+                return header, cert
+            return base_source(h)
+
+        hunter = DASer(
+            peers, light.LightClient(CHAIN, _trust(net)), store,
+            cfg=cfg, header_source=source,
+            rng=np.random.default_rng(
+                _seed_hitting_cmt(comm.n_base, withheld, 8)),
+            name="pcmt-hunter",
+        )
+        out = hunter.sync()
+        assert out["halted"] is not None
+        assert out["halted"]["height"] == bad_h
+        assert out["halted"]["reason"] == "bad-encoding"
+        assert out["halted"]["data_root"] == entry.data_root.hex()
+        rep = hunter.reports[bad_h]
+        assert rep["status"] == "fraud"
+        assert rep["location"] == list(location)
+        # the verified one-check proof condemned the root: the
+        # certified header would now be refused outright
+        assert entry.data_root in hunter.light.condemned_roots
+        fresh = light.LightClient(CHAIN, _trust(net))
+        fresh.condemned_roots.add(entry.data_root)
+        with pytest.raises(light.LightClientError, match="condemned"):
+            fresh.update(header, cert)
+
+        # ---- halted checkpoint survives restart -----------------------
+        reborn = DASer([url], light.LightClient(CHAIN, _trust(net)),
+                       store, cfg=cfg, name="pcmt-post-halt")
         assert reborn.halted
         assert reborn.sync() == {"halted": out["halted"]}
     finally:

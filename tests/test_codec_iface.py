@@ -32,10 +32,12 @@ from celestia_app_tpu.da import codec as dacodec
 from celestia_app_tpu.da import dah as dah_mod
 from celestia_app_tpu.da import edscache as edscache_mod
 from celestia_app_tpu.da import sampling
-from celestia_app_tpu.ops import ldpc
+from celestia_app_tpu.ops import ldpc, polar
 from celestia_app_tpu.testing import malicious
 
-SCHEMES = ("rs2d-nmt", "cmt-ldpc")
+# registry-driven (ISSUE 17): registering a codec IS opting into the
+# whole conformance suite — no hand-listed scheme pair to forget
+SCHEMES = tuple(dacodec.by_id(i).name for i in dacodec.registered_ids())
 ENGINES = ("host", "device")  # device == jax-cpu under tier-1
 
 # generated pre-refactor (see module docstring); identical on both
@@ -59,6 +61,10 @@ FROZEN_MIN_ROOT = {
     # CMT empty-block root: pure function of (tail share, q, d, root_max)
     "cmt-ldpc":
         "b14c97a1825a294c0cd9727539c36e8a7b14976b2dd29e7895b79075f1425da7",
+    # PCMT empty-block root: pure function of (tail share, Q, ROOT_MAX,
+    # the polar frozen-set construction and the DOMAIN string)
+    "pcmt-polar":
+        "ea8f58f171338ec6e9acb8d41651279bdae26755a3e24835d5415a70f4af04e1",
 }
 # wire-stability pins for the new scheme: these change IFF the CMT
 # construction (ldpc tables, layer plan, domain string) changes — which
@@ -66,6 +72,13 @@ FROZEN_MIN_ROOT = {
 FROZEN_CMT_ROOT = {
     4: "ecb93696cccd83f43aa92b324296a17fce6c5b3b24c136f50b1e3ed57e3b36da",
     8: "e8bb3e85b5bfae79438fd436acd1afa22d002a679395c861bb9fba59dfb893ea",
+}
+# same contract for wire id 2: a changed root means the polar frozen-set
+# construction, pruned-graph geometry, layer plan, or domain changed —
+# a consensus break that must be deliberate
+FROZEN_PCMT_ROOT = {
+    4: "30cd7537522eb44d4daf235a253a29f8336f694626039a4e85b505605fb15986",
+    8: "fe7c3a6cd47a6cb58244971c39e663f46456c8e8e5fb0b47e00c9f1a5a9154cd",
 }
 
 
@@ -81,15 +94,14 @@ def _commitments(codec, entry, k):
 
 
 def _bad_entry(scheme: str, ods: np.ndarray):
-    """(malicious entry, commitments, fraud location) per scheme: a
-    producer that commits an invalid codeword sampling alone verifies
-    (the ONE shared fixture set, testing/malicious.py — the --codec
-    bench uses the same constructors)."""
-    if scheme == "cmt-ldpc":
-        entry = malicious.cmt_bad_parity_entry(ods, equation=3)
-        return entry, entry.commitments, (0, 3)
-    entry = malicious.rs2d_bad_parity_entry(ods, row=1)
-    return entry, entry.dah, ("row", 1)
+    """(malicious entry, commitments, fraud location) per scheme, via
+    THE scheme-keyed fixture (malicious.incorrect_coding_fixture — the
+    same constructor sim/scenarios.py and the --codec bench drive), so
+    a new codec's fraud conformance needs a fixture there and nothing
+    here. ``entry.dah`` is every scheme's commitments object."""
+    entry, location, _withheld, _wire = malicious.incorrect_coding_fixture(
+        scheme, ods)
+    return entry, entry.dah, location
 
 
 # ---------------------------------------------------------------------------
@@ -144,6 +156,13 @@ def test_cmt_roots_pinned():
             == want
 
 
+def test_pcmt_roots_pinned():
+    codec = dacodec.get("pcmt-polar")
+    for k, want in FROZEN_PCMT_ROOT.items():
+        assert codec.compute_entry(_ods(k), "host").data_root.hex() \
+            == want
+
+
 # ---------------------------------------------------------------------------
 # 2. the shared conformance suite, parametrized over schemes
 # ---------------------------------------------------------------------------
@@ -159,7 +178,7 @@ def test_encode_commit_deterministic_and_engine_identical(scheme, k):
     d = codec.compute_entry(ods, "device")
     assert a.data_root == b.data_root == d.data_root
     assert codec.commitments_doc(a) == codec.commitments_doc(d)
-    if scheme == "cmt-ldpc":
+    if hasattr(a, "layers"):  # cmt-ldpc and pcmt-polar
         # bit-identical all the way down: every layer's coded symbols
         # and hash lists, not just the root
         for la, ld in zip(a.layers, d.layers):
@@ -216,8 +235,8 @@ def test_sample_proof_roundtrip_and_tamper_rejection(scheme):
                     comm, {**doc, "steps": steps}) is None
     # wire accounting is exact and positive
     doc = codec.open_sample(entry, probe[0])
-    wire = (codec.sample_wire_bytes(doc, comm)
-            if scheme == "cmt-ldpc" else codec.sample_wire_bytes(doc))
+    wire = (codec.sample_wire_bytes(doc)
+            if scheme == "rs2d-nmt" else codec.sample_wire_bytes(doc, comm))
     assert wire > appconsts.SHARE_SIZE
 
 
@@ -311,6 +330,74 @@ def test_cmt_repair_detects_and_attributes_bad_encoding():
     assert not isinstance(exc2.value, cmt_mod.CmtBadEncodingError)
 
 
+def test_pcmt_repair_detects_and_attributes_bad_encoding():
+    """The SC peeling decoder's fraud path end to end at the codec
+    level: a committed bad base-layer class surfaces as
+    PcmtBadEncodingError with the exact (layer, equation) the fixture
+    predicted, only when every check member was served."""
+    from celestia_app_tpu.da import pcmt as pcmt_mod
+
+    k = 8
+    codec = dacodec.get("pcmt-polar")
+    ods = _ods(k)
+    entry, comm, (layer, eq) = _bad_entry("pcmt-polar", ods)
+    space = codec.sample_space(comm)
+    samples = {}
+    for cell in space:
+        got = codec.verify_sample(comm, codec.open_sample(entry, cell))
+        assert got is not None  # sampling alone cannot see the fraud
+        samples[cell] = got[1]
+    with pytest.raises(pcmt_mod.PcmtBadEncodingError) as exc:
+        codec.repair(comm, samples, "host")
+    assert (exc.value.layer, exc.value.equation) == (layer, eq)
+    # withholding a member of the bad check: inconsistency remains but
+    # is no longer attributable — unavailable, not fraud
+    members = pcmt_mod.equation_members(comm, layer, eq)
+    short = {c: s for c, s in samples.items() if c != (0, members[0])}
+    with pytest.raises(ValueError) as exc2:
+        codec.repair(comm, short, "host")
+    assert not isinstance(exc2.value, pcmt_mod.PcmtBadEncodingError)
+
+
+def test_pcmt_multilayer_proof_walk_and_step_tamper():
+    """k=16 is the smallest square whose PCMT telescopes (2 layers):
+    the sample proof carries one batch-subtree step, and tampering any
+    sibling on the walk must kill verification. (k=4/8 are single-layer
+    — their proofs have zero steps — so the shared roundtrip test at
+    k=8 never exercises this path for pcmt.)"""
+    import base64
+
+    from celestia_app_tpu.da import pcmt as pcmt_mod
+
+    k = 16
+    codec = dacodec.get("pcmt-polar")
+    ods = _ods(k)
+    entry = codec.compute_entry(ods, "host")
+    comm = _commitments(codec, entry, k)
+    assert len(comm.plan) == 2
+    space = codec.sample_space(comm)
+    for cell in (space[0], space[len(space) // 2], space[-1]):
+        doc = codec.open_sample(entry, cell)
+        assert len(doc["steps"]) == 1
+        assert len(doc["steps"][0]) == pcmt_mod.LOG2Q
+        got = codec.verify_sample(comm, doc)
+        assert got is not None and got[0] == cell
+        for s in range(pcmt_mod.LOG2Q):
+            steps = [list(st) for st in doc["steps"]]
+            sib = bytearray(base64.b64decode(steps[0][s]))
+            sib[0] ^= 1
+            steps[0][s] = base64.b64encode(bytes(sib)).decode()
+            assert codec.verify_sample(
+                comm, {**doc, "steps": steps}) is None
+    # wire accounting: symbol + varints + LOG2Q siblings per step
+    doc = codec.open_sample(entry, space[0])
+    want = (len(base64.b64decode(doc["symbol"]))
+            + pcmt_mod.LOG2Q * pcmt_mod.HASH_BYTES + 2)
+    assert codec.sample_wire_bytes(doc, comm) == want
+    assert codec.hashes_per_sample_verify(comm) \
+        == 1 + (pcmt_mod.LOG2Q + 1)
+
+
 # ---------------------------------------------------------------------------
 # the LDPC kernels: engine identity + construction determinism
 # ---------------------------------------------------------------------------
@@ -355,6 +442,66 @@ def test_ldpc_construction_deterministic_and_regular():
     m = ldpc.membership(256)
     assert m.shape == (256, 512)
     assert (m.sum(axis=1) == ldpc.DEGREE + 1).all()
+
+
+# ---------------------------------------------------------------------------
+# the polar kernels: engine identity + construction determinism
+# ---------------------------------------------------------------------------
+
+
+def test_polar_encode_and_peel_host_device_identical():
+    n_data = 64
+    g = polar.geometry(n_data)
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, 256, size=(n_data, 64), dtype=np.uint8)
+    coded_h = polar.encode_host(data)
+    coded_d = polar.encode(data, "device")
+    assert np.array_equal(coded_h, coded_d)
+    # systematic: the data classes carry the data verbatim
+    assert np.array_equal(coded_h[g.data_class], data)
+    known = np.ones(g.C, dtype=bool)
+    known[rng.choice(g.C, size=g.C // 4, replace=False)] = False
+    syms = np.where(known[:, None], coded_h, 0).astype(np.uint8)
+    out_h, kn_h, _ = polar.peel_host(n_data, syms, known)
+    out_d, kn_d, _ = polar.peel(n_data, syms, known, "device")
+    assert np.array_equal(out_h, out_d)
+    assert np.array_equal(kn_h, kn_d)
+    assert kn_h.all() and np.array_equal(out_h, coded_h)
+    # identity must hold on INCONSISTENT input too (fraud repair runs
+    # the decoder over a committed non-codeword)
+    bad = coded_h.copy()
+    target = int(g.checks[3, 0])
+    bad[target, 0] ^= 0xFF
+    syms2 = np.where(known[:, None], bad, 0).astype(np.uint8)
+    out_h2, kn_h2, _ = polar.peel_host(n_data, syms2, known)
+    out_d2, kn_d2, _ = polar.peel(n_data, syms2, known, "device")
+    assert np.array_equal(out_h2, out_d2)
+    assert np.array_equal(kn_h2, kn_d2)
+    viol = polar.check_equations(n_data, bad, np.ones(g.C, dtype=bool))
+    assert viol.size > 0 and 3 in set(int(v) for v in viol)
+
+
+def test_polar_construction_deterministic_and_well_formed():
+    g = polar.geometry(64)
+    assert g is polar.geometry(64)  # cached, immutable
+    # every surviving check is degree-3 over committed classes; no
+    # forced-zero class survived pruning
+    assert g.checks.shape[1] == 3
+    assert (g.checks >= 0).all() and (g.checks < g.C).all()
+    for row in g.checks:
+        assert len(set(int(x) for x in row)) == 3
+    # data classes are distinct committed classes
+    assert len(set(int(x) for x in g.data_class)) == g.n_data
+    # the informed frozen set is up-closed under bitwise domination
+    # (superset rows are always at least as reliable)
+    a = set(int(x) for x in g.A)
+    for i in g.A:
+        for b in range(g.m):
+            assert int(i) | (1 << b) in a
+    # the committed-class counts the layer plans and docs rely on
+    assert polar.geometry(16).C == 76
+    assert polar.geometry(64).C == 431
+    assert polar.geometry(256).C == 2227
 
 
 @pytest.mark.slow
@@ -429,6 +576,55 @@ def test_process_proposal_rejects_scheme_mismatch():
     assert rs_app.process_proposal(forged_block) is False
 
 
+def test_process_proposal_refuses_unregistered_id_before_encode():
+    """ISSUE 17 satellite: a header carrying a wire id NO build
+    registers is refused up front — the scheme check runs before any
+    encode work, so the node never pays for (or crashes in) a codec it
+    does not have."""
+    import sys
+    sys.path.insert(0, "tests")
+    from test_consensus_multinode import CHAIN, _genesis
+
+    from celestia_app_tpu.chain.app import App
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.utils import telemetry
+
+    privs = [PrivateKey.from_seed(bytes([9]))]
+    proposer = privs[0].public_key().address()
+    app = App(chain_id=CHAIN, engine="host")
+    app.init_chain(_genesis(privs))
+    prop = app.prepare_proposal([], t=1_700_000_010.0,
+                                proposer=proposer)
+    forged = dataclasses.replace(prop.block.header, da_scheme=7)
+    forged_block = dataclasses.replace(prop.block, header=forged)
+    c0 = telemetry.snapshot()["counters"].get("da.extend_runs", 0)
+    assert app.process_proposal(forged_block) is False
+    c1 = telemetry.snapshot()["counters"].get("da.extend_runs", 0)
+    assert c1 == c0  # refused BEFORE any encode dispatch
+
+
+def test_snapshot_bootstrap_refuses_unregistered_scheme():
+    """A manifest naming a scheme this build does not register is
+    refused loudly before any chunk verification or store work."""
+    import sys
+    sys.path.insert(0, "tests")
+    from test_consensus_multinode import CHAIN, _genesis
+
+    from celestia_app_tpu.chain import consensus
+    from celestia_app_tpu.chain.app import App
+    from celestia_app_tpu.chain.crypto import PrivateKey
+
+    privs = [PrivateKey.from_seed(bytes([9]))]
+    app = App(chain_id=CHAIN, engine="host")
+    app.init_chain(_genesis(privs))
+    manifest, chunks = consensus.snapshot_app_chunks(app)
+    forged = {**manifest, "da_scheme": "quux-codec"}
+    before = app.last_app_hash
+    with pytest.raises(ValueError, match="quux-codec"):
+        consensus.state_sync_bootstrap(app, forged, chunks)
+    assert app.last_app_hash == before  # nothing was adopted
+
+
 def test_edscache_keys_are_scheme_disjoint():
     ods = _ods(4)
     cache = edscache_mod.EdsCache(max_entries=4)
@@ -493,3 +689,20 @@ def test_confidence_is_per_scheme_on_the_codec_interface():
     with pytest.raises(dacodec.CodecError):
         dacodec.get("no-such-scheme")
     assert dacodec.by_id(0) is rs and dacodec.by_id(1) is cm
+    assert dacodec.by_id(2) is dacodec.get("pcmt-polar")
+    assert dacodec.registered_ids() == [0, 1, 2]
+
+
+def test_unknown_scheme_errors_name_the_id_and_list_registered():
+    """ISSUE 17 satellite: whoever hits a wire id or name this build
+    does not carry sees exactly what it DOES carry."""
+    with pytest.raises(dacodec.CodecError) as exc:
+        dacodec.by_id(7)
+    msg = str(exc.value)
+    assert "7" in msg
+    for part in ("0=rs2d-nmt", "1=cmt-ldpc", "2=pcmt-polar"):
+        assert part in msg
+    with pytest.raises(dacodec.CodecError) as exc2:
+        dacodec.get("no-such-scheme")
+    msg2 = str(exc2.value)
+    assert "no-such-scheme" in msg2 and "2=pcmt-polar" in msg2

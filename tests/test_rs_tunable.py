@@ -1,0 +1,107 @@
+"""Tunable-rate RS (ops/rs_tunable.py): MDS round-trip, engine
+identity, and the closed-form protocol analytics the --codec bench
+sweeps (ISSUE 17)."""
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu import appconsts
+from celestia_app_tpu.ops import rs_tunable as rst
+
+
+def _data(k, d=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, 256, size=(k, d), dtype=np.uint8)
+
+
+def test_field_tables_are_a_group():
+    # exp/log invert each other over the multiplicative group
+    for a in (1, 2, 7, 0x53, 0xCA, 255):
+        assert rst.gf_mul(a, rst.gf_inv(a)) == 1
+    assert rst.gf_mul(0, 7) == 0 and rst.gf_mul(7, 0) == 0
+    with pytest.raises(ZeroDivisionError):
+        rst.gf_inv(0)
+
+
+@pytest.mark.parametrize("k,n", [(4, 6), (4, 8), (4, 12), (8, 11),
+                                 (8, 24), (16, 20)])
+def test_any_k_of_n_roundtrip(k, n):
+    """The MDS property at swept rates: ANY k of the n shards recover
+    the full codeword bit-for-bit — including all-parity subsets."""
+    data = _data(k, seed=k * 100 + n)
+    coded = rst.extend_axis(data, n, "host")
+    assert coded.shape == (n, data.shape[1])
+    assert np.array_equal(coded[:k], data)  # systematic
+    rng = np.random.RandomState(7)
+    subsets = [list(range(k)),            # data alone
+               list(range(n - k, n))]     # tail (all/mostly parity)
+    for _ in range(3):
+        subsets.append(
+            sorted(int(x) for x in rng.choice(n, size=k, replace=False)))
+    for use in subsets:
+        wiped = np.zeros_like(coded)
+        wiped[use] = coded[use]
+        rec = rst.recover_axis(wiped, use, k)
+        assert np.array_equal(rec, coded), use
+
+
+@pytest.mark.parametrize("k,n", [(4, 8), (8, 11), (8, 24)])
+def test_encode_host_device_identical(k, n):
+    data = _data(k, d=64, seed=3)
+    h = rst.encode_axis(data, n, "host")
+    d = rst.encode_axis(data, n, "device")
+    assert np.array_equal(h, d)
+
+
+def test_extend_2d_rectangle_and_engine_identity():
+    k = 4
+    rng = np.random.RandomState(5)
+    ods = rng.randint(0, 256, size=(k, k, appconsts.SHARE_SIZE),
+                      dtype=np.uint8)
+    rect_h = rst.extend_2d(ods, 6, 10, "host")
+    rect_d = rst.extend_2d(ods, 6, 10, "device")
+    assert rect_h.shape == (6, 10, appconsts.SHARE_SIZE)
+    assert np.array_equal(rect_h, rect_d)
+    assert np.array_equal(rect_h[:k, :k], ods)  # systematic corner
+    # every row is a codeword of the column code and vice versa: erase
+    # beyond-threshold-minus-one per axis and recover
+    for r in range(6):
+        use = [0, 2, 7, 9]
+        wiped = np.zeros_like(rect_h[r])
+        wiped[use] = rect_h[r][use]
+        assert np.array_equal(
+            rst.recover_axis(wiped, use, k), rect_h[r])
+    for c in range(10):
+        col = rect_h[:, c, :]
+        use = [1, 3, 4, 5]
+        wiped = np.zeros_like(col)
+        wiped[use] = col[use]
+        assert np.array_equal(rst.recover_axis(wiped, use, k), col)
+
+
+def test_field_cap_is_loud():
+    with pytest.raises(ValueError, match="point budget"):
+        rst.encode_matrix(128, 257)
+    with pytest.raises(ValueError, match="k < n"):
+        rst.encode_matrix(8, 8)
+    with pytest.raises(ValueError):
+        rst.recover_axis(np.zeros((8, 4), dtype=np.uint8), [0, 1], 4)
+
+
+def test_analytics_rate_monotonicity():
+    """The paper's trade: stretching an axis raises the catch
+    probability (fewer samples to 99%) and lowers the rate."""
+    a2 = rst.analytics(8, 16, 16)   # the production rate-1/2 point
+    a3 = rst.analytics(8, 24, 24)
+    a_low = rst.analytics(8, 11, 11)
+    assert a2["rate"] == pytest.approx(0.25)
+    assert a2["min_unrecoverable"] == 81  # (k+1)^2
+    assert a2["catch_probability"] == pytest.approx(81 / 256)
+    assert a_low["rate"] > a2["rate"] > a3["rate"]
+    assert a_low["catch_probability"] < a2["catch_probability"] \
+        < a3["catch_probability"]
+    assert a_low["samples_99"] >= a2["samples_99"] >= a3["samples_99"]
+    assert a3["commitment_bytes"] > a2["commitment_bytes"]
+    # rectangles decouple the axes
+    rect = rst.analytics(8, 12, 24)
+    assert rect["min_unrecoverable"] == (12 - 7) * (24 - 7)
