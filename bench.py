@@ -2752,33 +2752,60 @@ def measure_scenario() -> None:
     timeline per cell. Pure host/CPU work (consensus + sampling +
     repair at small k): no relay involvement, no backend probe.
 
-    Knobs: CELESTIA_BENCH_SCENARIO_{VALIDATORS,LIGHTS,HEIGHTS,SEED} and
-    CELESTIA_BENCH_SCENARIOS (comma list to sub-select)."""
+    The network-scale cells (ISSUE 18): `long-soak` (resource-churn
+    soak under seeded PFB traffic + asymmetric per-message faults) and
+    `fleet-scale` (1000+ continuation-driven lights over 1000+ virtual
+    blocks, run TWICE per seed — the line carries
+    verdict_bytes_identical) run on rs2d-nmt only: their subject is the
+    scenario plane's scale and determinism, not the codec matrix.
+
+    Knobs: CELESTIA_BENCH_SCENARIO_{VALIDATORS,LIGHTS,HEIGHTS,SEED},
+    CELESTIA_BENCH_SCENARIOS (comma list to sub-select), and
+    CELESTIA_BENCH_FLEET_{LIGHTS,HEIGHTS} for the fleet-scale cell."""
     import tempfile
 
     from celestia_app_tpu.sim import run_scenario, scenario_spec
+    from celestia_app_tpu.sim.scenarios import verdict_bytes
 
     n_val = int(os.environ.get("CELESTIA_BENCH_SCENARIO_VALIDATORS", "8"))
     n_light = int(os.environ.get("CELESTIA_BENCH_SCENARIO_LIGHTS", "64"))
     heights = int(os.environ.get("CELESTIA_BENCH_SCENARIO_HEIGHTS", "5"))
     seed = int(os.environ.get("CELESTIA_BENCH_SCENARIO_SEED", "0"))
+    fleet_lights = int(os.environ.get("CELESTIA_BENCH_FLEET_LIGHTS",
+                                      "1000"))
+    fleet_heights = int(os.environ.get("CELESTIA_BENCH_FLEET_HEIGHTS",
+                                       "1000"))
     names = [s for s in os.environ.get(
         "CELESTIA_BENCH_SCENARIOS",
-        "honest,withhold-threshold,incorrect-coding,partition-churn",
+        "honest,withhold-threshold,incorrect-coding,partition-churn,"
+        "long-soak,fleet-scale",
     ).split(",") if s]
     from celestia_app_tpu.da import codec as dacodec
 
     schemes = [dacodec.by_id(i).name for i in dacodec.registered_ids()]
+    # the network-scale cells benchmark the scenario plane itself
+    # (continuation fleet scale, soak churn, verdict determinism), not
+    # the codec matrix — one scheme carries the claim
+    single_scheme = {"long-soak", "fleet-scale"}
     for scenario in names:
-        for scheme in schemes:
-            doc = scenario_spec(scenario, scheme=scheme, seed=seed,
-                                validators=n_val, light_nodes=n_light,
-                                heights=heights)
+        for scheme in (["rs2d-nmt"] if scenario in single_scheme
+                       else schemes):
+            if scenario == "fleet-scale":
+                doc = scenario_spec(scenario, scheme=scheme, seed=seed,
+                                    light_nodes=fleet_lights,
+                                    heights=fleet_heights)
+            elif scenario == "long-soak":
+                doc = scenario_spec(scenario, scheme=scheme, seed=seed)
+            else:
+                doc = scenario_spec(scenario, scheme=scheme, seed=seed,
+                                    validators=n_val,
+                                    light_nodes=n_light,
+                                    heights=heights)
             t0 = time.perf_counter()
             v = run_scenario(doc, workdir=tempfile.mkdtemp(
                 prefix=f"bench-sim-{scenario}-"))
             wall = time.perf_counter() - t0
-            print(json.dumps({
+            line = {
                 "metric": "scenario_verdict",
                 "scenario": scenario,
                 "scheme": scheme,
@@ -2794,9 +2821,26 @@ def measure_scenario() -> None:
                 "unavailable_reports": v["unavailable_reports"],
                 "events": v["events"],
                 "trace_digest": v["trace_digest"],
+                "sim_lights": v["sim_lights"],
+                "sim_virtual_blocks": v["sim_virtual_blocks"],
+                "peak_rss_bytes": v["peak_rss_bytes"],
                 "wall_s": round(wall, 3),
                 "backend": "host",
-            }), flush=True)
+            }
+            # per-op verdict blocks, present when the scenario arms them
+            for block in ("traffic", "spam", "soak", "asym_msgs"):
+                if v.get(block):
+                    line[block] = v[block]
+            if scenario == "fleet-scale":
+                # the determinism claim IS the benchmark: same seed,
+                # second full run, byte-identical canonical verdict
+                t0 = time.perf_counter()
+                v2 = run_scenario(doc, workdir=tempfile.mkdtemp(
+                    prefix=f"bench-sim-{scenario}-"))
+                line["rerun_wall_s"] = round(time.perf_counter() - t0, 3)
+                line["verdict_bytes_identical"] = (
+                    verdict_bytes(v) == verdict_bytes(v2))
+            print(json.dumps(line), flush=True)
 
 
 MODES = {
@@ -2823,11 +2867,14 @@ MODES = {
               "fault plane: WAL crash replay + partition-heal liveness"),
     "scenario": (measure_scenario,
                  "scenario_verdict: blocks_to_detection, liveness_gap_s, "
-                 "false_condemnation_rate, recovery_s (per scenario x "
-                 "registered scheme: rs2d-nmt, cmt-ldpc, pcmt-polar)",
+                 "false_condemnation_rate, recovery_s, sim_lights, "
+                 "sim_virtual_blocks, peak_rss_bytes (per scenario x "
+                 "registered scheme: rs2d-nmt, cmt-ldpc, pcmt-polar) + "
+                 "the long-soak and fleet-scale network cells",
                  "scenario plane: seeded virtual-time adversarial matrix "
                  "over the validator + light-node fleet, judged on "
-                 "every registered wire id under identical seeds"),
+                 "every registered wire id under identical seeds, plus "
+                 "1000-light fleet determinism and long-horizon soak"),
     "sync": (measure_sync,
              "state_sync_join_s, blocksync_blocks_per_sec, "
              "snapshot_serve_ms",

@@ -95,6 +95,9 @@ class VerifiedSigCache:
         self.maxsize = maxsize
         self._lock = threading.Lock()
         self._keys: OrderedDict[bytes, None] = OrderedDict()  # guarded-by: _lock
+        # LRU churn evidence: soak verdicts assert the cap actually
+        # cycled (per-instance, unlike the process-global telemetry)
+        self.evictions = 0  # guarded-by: _lock
 
     key = staticmethod(sig_key)
 
@@ -123,6 +126,8 @@ class VerifiedSigCache:
             self._keys.move_to_end(key)
             while len(self._keys) > self.maxsize:
                 self._keys.popitem(last=False)
+                self.evictions += 1
+                telemetry.incr("admission.sig_cache_evictions")
 
 
 COMMITMENT_CACHE_MAX = 16384
@@ -164,6 +169,8 @@ class VerifiedCommitmentCache:
         self.maxsize = maxsize
         self._lock = threading.Lock()
         self._map: OrderedDict[bytes, bytes] = OrderedDict()  # guarded-by: _lock
+        # LRU churn evidence for soak verdicts (see VerifiedSigCache)
+        self.evictions = 0  # guarded-by: _lock
 
     key = staticmethod(commitment_key)
 
@@ -194,6 +201,8 @@ class VerifiedCommitmentCache:
             self._map.move_to_end(key)
             while len(self._map) > self.maxsize:
                 self._map.popitem(last=False)
+                self.evictions += 1
+                telemetry.incr("commitment.cache_evictions")
 
 
 def status_block(app) -> dict:

@@ -544,6 +544,9 @@ class EdsCache:
             collections.OrderedDict()  # guarded-by: _lock
         self._by_root: dict[bytes, bytes] = {}  # guarded-by: _lock
         self._nbytes = 0  # charged-byte total  # guarded-by: _lock
+        # LRU churn evidence for soak verdicts: per-instance (the
+        # process-global telemetry counter aggregates every cache)
+        self.evictions = 0  # guarded-by: _lock
 
     def get(self, key: bytes) -> EdsCacheEntry | None:
         with self._lock:
@@ -576,6 +579,7 @@ class EdsCache:
                 _, old = self._entries.popitem(last=False)
                 self._by_root.pop(old.data_root, None)
                 self._nbytes -= entry_nbytes(old)
+                self.evictions += 1
                 telemetry.incr("edscache.evictions")
             return kept
 

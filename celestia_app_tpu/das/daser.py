@@ -51,7 +51,6 @@ import base64
 import dataclasses
 import queue as queue_mod
 import threading
-import time
 
 import numpy as np
 
@@ -88,6 +87,11 @@ class DASerConfig:
     # as live assembly, a tampered chunk penalizes the peer and falls
     # back to live /das/samples. No-op against pack-less peers.
     prefer_packs: bool = True
+    # keep only the newest N per-height reports (0 = unbounded). The
+    # checkpoint, not `reports`, is the durable record; a long-horizon
+    # fleet (1000+ samplers over thousands of virtual blocks in one
+    # process) bounds this so memory stays O(fleet), not O(fleet*chain).
+    report_keep: int = 0
 
 
 class PeerSet:
@@ -231,7 +235,7 @@ class DASer:
         self.header_source = header_source or http_header_source(self.peers)
         # the light node's OWN entropy — a withholder that can predict
         # coordinates serves exactly the sampled cells and nothing else
-        self.rng = rng if rng is not None else np.random.default_rng()
+        self.rng = rng if rng is not None else np.random.default_rng()  # lint: disable=det-rng
         # height -> (data_root hex, ods square size), from VERIFIED headers
         self._roots: dict[int, tuple[str, int]] = {}
         # this light node's OWN span plane (obs/spans.py): rows carry the
@@ -572,7 +576,7 @@ class DASer:
     def _sample_height_inner(self, height: int, root_hex: str,
                              square_size: int, rng=None) -> dict:
         rng = rng if rng is not None else self.rng
-        t0 = time.perf_counter()
+        t0 = telemetry.start_timer()
         try:
             codec, commitments, pack = self._fetch_commitments(
                 height, root_hex, square_size)
@@ -727,7 +731,7 @@ class DASer:
                 trace_id=obs.trace_id_for(self.light.chain_id, h),
                 height=h, node=self.name, window=len(job),
             ) as sp:
-                t0 = time.perf_counter()
+                t0 = telemetry.start_timer()
                 rep = self._sample_cells(h, codec, commitments, root_hex,
                                          draws[h], pack,
                                          prefetched=prefetched)
@@ -978,25 +982,46 @@ class DASer:
                     pend.append((h, *self._roots[h]))
         return pend
 
+    def _sweep_job(self, job, rng) -> dict[int, dict]:
+        """One catch-up job end to end on the caller's thread — the unit
+        both sweep drivers (threaded workers and the continuation's
+        steps) execute identically. A multi-height job goes out as one
+        WINDOW (batched headers + grouped samples, serving plane §17.1);
+        a single-height job walks the per-height path with the stop/halt
+        gates the threaded worker always honored."""
+        if len(job) > 1:
+            return self._sample_window(job, rng)
+        reps: dict[int, dict] = {}
+        for h, root_hex, size in job:
+            if self._stop.is_set() or self._halted_evt.is_set():
+                break
+            reps[h] = self._sample_height(h, root_hex, size, rng=rng)
+        return reps
+
+    def begin_sweep(self) -> "SweepCont":
+        """A sweep as an explicit continuation: drive it with
+        ``step()`` until False. Scheduler-friendly — a fleet of
+        thousands of samplers interleaves one bounded unit of work per
+        event instead of pinning an OS thread each (sim/engine.py)."""
+        return SweepCont(self)
+
     def sync(self) -> dict:
         """One full sweep: follow the head through the light client, then
         catch up over every pending height with the bounded worker pool,
         fold results into the checkpoint, and persist it. Returns a
-        summary {"head", "sample_from", "sampled", "failed", "halted"}."""
-        with self._lock:
-            if self.cp.halted is not None:
-                return {"halted": self.cp.halted}
-        self._advance_head()
-        with self._lock:
-            if self.cp.halted is not None:
-                # a condemned root surfaced during following
-                return {"halted": self.cp.halted}
-        pending = self._pending_heights()
-        results: dict[int, dict] = {}
-        if pending:
+        summary {"head", "sample_from", "sampled", "failed", "halted"}.
+
+        A thin threaded driver over the SweepCont phases: the plan and
+        fold steps run here on the caller's thread; the job list is
+        drained by the worker pool racing a queue, each worker executing
+        the same ``_sweep_job`` unit the continuation steps through
+        (pinned equivalent at workers=1 in tests/test_daser_cont.py)."""
+        cont = self.begin_sweep()
+        cont.step()  # plan: halted gate, head follow, job split, rngs
+        if cont.phase == "jobs":
             jobs: queue_mod.Queue = queue_mod.Queue()
-            for i in range(0, len(pending), self.cfg.job_size):
-                jobs.put(pending[i:i + self.cfg.job_size])
+            for job in cont.jobs:
+                jobs.put(job)
 
             def worker(rng) -> None:
                 while not self._stop.is_set() \
@@ -1005,49 +1030,23 @@ class DASer:
                         job = jobs.get_nowait()
                     except queue_mod.Empty:
                         return
-                    if len(job) > 1:
-                        # the serving-plane catch-up rewrite: the whole
-                        # job goes out as one multi-height window
-                        # (batched headers + grouped samples) instead of
-                        # one request per height
-                        reps = self._sample_window(job, rng)
-                        with self._lock:
-                            results.update(reps)
-                            self.reports.update(reps)
-                        continue
-                    for h, root_hex, size in job:
-                        if self._stop.is_set() \
-                                or self._halted_evt.is_set():
-                            return
-                        rep = self._sample_height(h, root_hex, size,
-                                                  rng=rng)
-                        with self._lock:
-                            results[h] = rep
-                            self.reports[h] = rep
+                    reps = self._sweep_job(job, rng)
+                    with self._lock:
+                        cont.results.update(reps)
+                        self.reports.update(reps)
 
-            n_workers = min(self.cfg.workers, len(pending))
-            # one independent child generator per worker (spawn keys off
-            # the parent's seed sequence, so a seeded DASer stays
-            # deterministic while workers never share bit-generator state)
             threads = [
                 threading.Thread(target=worker, args=(child,), daemon=True)
-                for child in self.rng.spawn(n_workers)
+                for child in cont.rngs
             ]
             for t in threads:
                 t.start()
             for t in threads:
                 t.join()
-        self._fold(results)
-        with self._lock:
-            return {
-                "head": self.cp.network_head,
-                "sample_from": self.cp.sample_from,
-                "sampled": sorted(h for h, r in results.items()
-                                  if r["status"] in ("sampled",
-                                                     "recovered")),
-                "failed": sorted(self.cp.failed),
-                "halted": self.cp.halted,
-            }
+            cont.phase = "fold"
+        while cont.step():
+            pass
+        return cont.summary
 
     def _fold(self, results: dict[int, dict]) -> None:
         """Checkpoint bookkeeping: completed heights clear from the failed
@@ -1073,6 +1072,13 @@ class DASer:
                 [self.cp.sample_from] + sorted(self.cp.failed)[:1])
             for h in [h for h in self._roots if h < floor]:
                 del self._roots[h]
+            keep = self.cfg.report_keep
+            if keep > 0 and len(self.reports) > keep:
+                # oldest-height reports go first; anything below the
+                # watermark is already durably dispositioned in the
+                # checkpoint and never re-swept
+                for h in sorted(self.reports)[:len(self.reports) - keep]:
+                    del self.reports[h]
             doc = self.cp.to_json()
         # fsync outside the lock (blocking-under-lock): status polls and
         # worker folds must not stall on the checkpoint flush
@@ -1101,3 +1107,110 @@ class DASer:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
+
+
+class SweepCont:
+    """One sweep of a DASer as an explicit continuation.
+
+    The sweep's state machine — plan (halted gate + head follow + job
+    split) → one catch-up job per step → fold (checkpoint + summary) —
+    lives in this object instead of a per-DASer OS thread, so a
+    scheduler advances thousands of samplers by calling ``step()`` one
+    bounded unit at a time (sim/engine.SimLightNode). ``sync()`` drives
+    the identical phases with its worker pool racing the job list; at
+    ``workers=1`` the two drivers execute the exact same request/rng
+    sequence (the tier-1 equivalence pin).
+
+    Phases: ``plan`` → ``jobs`` → ``fold`` → ``done``. ``step()``
+    returns True while more work remains; ``summary`` holds the sweep's
+    return dict once done. The per-job rng lanes spawn off the DASer's
+    parent generator with the same ``min(workers, len(pending))`` count
+    the threaded pool uses, so a seeded DASer's parent stream stays
+    byte-identical under either driver."""
+
+    def __init__(self, daser: DASer):
+        self.daser = daser
+        self.phase = "plan"
+        self.jobs: list[list[tuple[int, str, int]]] = []
+        self.rngs: list = []
+        # written under the DASER's lock (a foreign lock, out of the
+        # lexical guarded-by rule's model): sync()'s worker threads
+        # merge job results here concurrently; the continuation driver
+        # is single-threaded and _fold runs strictly after the last job
+        self.results: dict[int, dict] = {}
+        self.summary: dict | None = None
+        self._ji = 0  # next job index (continuation driver only)
+
+    @property
+    def done(self) -> bool:
+        return self.phase == "done"
+
+    def step(self) -> bool:
+        """Run one bounded unit of the sweep; True while more remain."""
+        if self.phase == "plan":
+            self._plan()
+        elif self.phase == "jobs":
+            self._job()
+        elif self.phase == "fold":
+            self._fold()
+        return self.phase != "done"
+
+    def _finish(self, summary: dict) -> None:
+        self.summary = summary
+        self.phase = "done"
+
+    def _plan(self) -> None:
+        d = self.daser
+        with d._lock:
+            if d.cp.halted is not None:
+                self._finish({"halted": d.cp.halted})
+                return
+        d._advance_head()
+        with d._lock:
+            if d.cp.halted is not None:
+                # a condemned root surfaced during following
+                self._finish({"halted": d.cp.halted})
+                return
+        pending = d._pending_heights()
+        if not pending:
+            self.phase = "fold"
+            return
+        self.jobs = [pending[i:i + d.cfg.job_size]
+                     for i in range(0, len(pending), d.cfg.job_size)]
+        # one independent child generator per worker lane (spawn keys
+        # off the parent's seed sequence, so a seeded DASer stays
+        # deterministic while lanes never share bit-generator state)
+        self.rngs = list(d.rng.spawn(min(d.cfg.workers, len(pending))))
+        self.phase = "jobs"
+
+    def _job(self) -> None:
+        d = self.daser
+        if self._ji >= len(self.jobs) or d._stop.is_set() \
+                or d._halted_evt.is_set():
+            self.phase = "fold"
+            return
+        job = self.jobs[self._ji]
+        # round-robin lane assignment: job i runs on lane i % n — at
+        # workers=1 this is the threaded pool's exact FIFO order
+        rng = self.rngs[self._ji % len(self.rngs)]
+        self._ji += 1
+        reps = d._sweep_job(job, rng)
+        with d._lock:
+            self.results.update(reps)
+            d.reports.update(reps)
+        if self._ji >= len(self.jobs):
+            self.phase = "fold"
+
+    def _fold(self) -> None:
+        d = self.daser
+        d._fold(self.results)
+        with d._lock:
+            self._finish({
+                "head": d.cp.network_head,
+                "sample_from": d.cp.sample_from,
+                "sampled": sorted(h for h, r in self.results.items()
+                                  if r["status"] in ("sampled",
+                                                     "recovered")),
+                "failed": sorted(d.cp.failed),
+                "halted": d.cp.halted,
+            })

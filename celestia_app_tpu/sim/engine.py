@@ -40,8 +40,11 @@ caches whose contents are content-addressed; verdicts never read them.
 
 from __future__ import annotations
 
+import copy
 import dataclasses
+import hashlib
 import os
+import sys
 import urllib.parse
 
 import numpy as np
@@ -63,6 +66,27 @@ from celestia_app_tpu.sim.scheduler import Scheduler
 # ---------------------------------------------------------------------------
 
 
+@dataclasses.dataclass
+class AsymRule:
+    """One deterministic per-message asymmetric fault (FORMATS §19.1
+    ``asym_fault`` op). Matched by PREFIX on (requester name, serving
+    peer name, wire path); whether a matched message actually faults is
+    a pure function of ``sha256(seed|src|dst|path|msg-index)`` — the
+    per-message determinism the continuation-DASer makes possible (no
+    thread interleaving decides which request draws the fault). Applies
+    only to SimTransport requests (the light fleet's plane); consensus
+    vote gossip is never asymmetrically faulted — see the module
+    docstring's determinism contract."""
+
+    kind: str          # "drop" | "delay" | "corrupt"
+    src: str = ""      # requester name prefix ("" = any)
+    dst: str = ""      # serving peer name prefix
+    path: str = ""     # wire path prefix (query string excluded)
+    prob: float = 1.0
+    delay: float = 0.05  # virtual seconds (kind="delay")
+    seed: int = 0
+
+
 class SimNet:
     """Who can reach whom, and how late. Registered handlers answer the
     wire routes for ``sim://<name>`` URLs; partition groups / down sets /
@@ -77,6 +101,72 @@ class SimNet:
         # light-node eclipse: name -> allowed peer names (None = all)
         self.allowed: dict[str, set[str] | None] = {}
         self.dropped = 0
+        # per-message asymmetric faults: armed rules, the per-(src, dst,
+        # path) message counters that key them, and fired-fault tallies
+        self.asym_rules: list[AsymRule] = []
+        self.asym_index: dict[tuple[str, str, str], int] = {}
+        self.asym_hits: dict[str, int] = {}
+
+    # -- asymmetric per-message faults ----------------------------------
+
+    def asym_match(self, src: str, dst: str, path: str) -> AsymRule | None:
+        """The first armed rule that fires for THIS message, advancing
+        the (src, dst, path) message index either way so arming or
+        removing one rule never re-keys another's decisions."""
+        key = (src, dst, path)
+        idx = self.asym_index.get(key, 0)
+        self.asym_index[key] = idx + 1
+        for rule in self.asym_rules:
+            if not (src.startswith(rule.src) and dst.startswith(rule.dst)
+                    and path.startswith(rule.path)):
+                continue
+            digest = hashlib.sha256(
+                f"{rule.seed}|{src}|{dst}|{path}|{idx}".encode()).digest()
+            if int.from_bytes(digest[:8], "big") / 2.0**64 < rule.prob:
+                self.asym_hits[rule.kind] = \
+                    self.asym_hits.get(rule.kind, 0) + 1
+                return rule
+        return None
+
+    @staticmethod
+    def tamper(doc, src: str, dst: str, path: str, idx: int):
+        """Deterministically corrupt one served value: flip one
+        character of the first long-enough string (or one byte of a
+        bytes value), chosen by the same message key that fired the
+        rule. Structure stays parseable — the damage must be caught by
+        VERIFICATION (proof/commitment checks), not by a JSON error."""
+        doc = copy.deepcopy(doc)
+        targets: list[tuple] = []
+
+        def walk(node, setter):
+            if isinstance(node, str) and len(node) >= 16:
+                targets.append((node, setter))
+            elif isinstance(node, (bytes, bytearray)) and len(node) >= 1:
+                targets.append((node, setter))
+            elif isinstance(node, dict):
+                for k in sorted(node):
+                    walk(node[k], lambda v, n=node, k=k: n.__setitem__(k, v))
+            elif isinstance(node, list):
+                for i, item in enumerate(node):
+                    walk(item, lambda v, n=node, i=i: n.__setitem__(i, v))
+
+        box = [doc]
+        walk(doc, lambda v: box.__setitem__(0, v))
+        if not targets:
+            return doc
+        digest = hashlib.sha256(
+            f"tamper|{src}|{dst}|{path}|{idx}".encode()).digest()
+        value, setter = targets[int.from_bytes(digest[:4], "big")
+                                % len(targets)]
+        pos = int.from_bytes(digest[4:8], "big") % len(value)
+        if isinstance(value, str):
+            repl = "0" if value[pos] != "0" else "1"
+            setter(value[:pos] + repl + value[pos + 1:])
+        else:
+            flipped = bytearray(value)
+            flipped[pos] ^= 0xFF
+            setter(bytes(flipped))
+        return box[0]
 
     def register(self, name: str, router) -> str:
         url = f"sim://{name}"
@@ -140,6 +230,16 @@ class SimTransport:
             raise TransportError(f"unknown sim peer {url}")
         parsed = urllib.parse.urlparse(path)
         query = urllib.parse.parse_qs(parsed.query)
+        # the per-message asymmetric fault point: keyed by the message
+        # index this request draws (query excluded so the key space
+        # stays bounded); drop raises before the route runs, delay costs
+        # virtual seconds, corrupt tampers the served doc after
+        rule = self.net.asym_match(self.owner, dst, parsed.path)
+        msg_idx = self.net.asym_index[(self.owner, dst, parsed.path)] - 1
+        if rule is not None and rule.kind == "drop":
+            raise TransportError(f"asym fault: drop {url}{parsed.path}")
+        if rule is not None and rule.kind == "delay":
+            self.net.sched.clock.sleep(rule.delay)
         method = "GET" if payload is None else "POST"
         try:
             out = router(method, parsed.path, query, payload)
@@ -149,6 +249,9 @@ class SimTransport:
             raise ValueError(str(e)) from None
         if action == "duplicate":
             out = router(method, parsed.path, query, payload)
+        if rule is not None and rule.kind == "corrupt":
+            out = self.net.tamper(out, self.owner, dst, parsed.path,
+                                  msg_idx)
         return out
 
     def get(self, url: str, path: str, **kw):
@@ -169,6 +272,19 @@ class SimTransport:
         shared `snapshot` spelling would alias this class into the
         state-snapshot call graph the analysis plane walks."""
         return {"penalties": dict(self.penalties)}
+
+
+def peak_rss_bytes() -> int:
+    """This process's peak resident set in bytes (the verdict's memory
+    number: scale claims need one). Linux ru_maxrss is KiB, macOS is
+    bytes; 0 where getrusage is unavailable. NOT run-deterministic —
+    verdict_bytes excludes it from the byte-identity form."""
+    try:
+        import resource
+    except ImportError:
+        return 0
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return int(peak) * (1 if sys.platform == "darwin" else 1024)
 
 
 class MemoryCheckpointStore:
@@ -633,8 +749,11 @@ class SimLightNode:
 
         cfg = DASerConfig(
             samples_per_header=spec.samples_per_header,
-            workers=1, job_size=4, retries=2, backoff=0.02,
-            prefer_packs=False,
+            workers=1, job_size=spec.light_job_size, retries=2,
+            backoff=0.02, prefer_packs=False,
+            # long-horizon runs: the checkpoint is the durable record;
+            # reports and the span tables stay O(1) per light
+            report_keep=64,
         )
         # one independent child stream per light node off the scenario
         # seed: sampler draws are seeded end to end, never ambient
@@ -644,22 +763,44 @@ class SimLightNode:
             MemoryCheckpointStore(), cfg=cfg, header_source=source,
             rng=rng, name=self.name, clock=sim.sched.clock,
         )
+        self.daser.traces.MAX_ROWS = 256
         self._seen: dict[int, str] = {}  # height -> last reported status
+        self._cont = None  # the in-flight SweepCont (continuation mode)
         self.halt: dict | None = None
 
     def sweep(self) -> None:
+        """One CONTINUATION STEP of the current sweep — not a whole
+        sweep per event. Each firing advances the DASer's SweepCont by
+        one bounded unit (plan, one catch-up job, or fold) and yields
+        the timeline back, so a 1000-light fleet interleaves at job
+        granularity under the scheduler's seeded tiebreaks instead of
+        each light monopolizing an instant (or an OS thread)."""
         if self.name in self.sim.net.down:
+            self._cont = None  # a downed node abandons its sweep
             self._reschedule()
             return
         if self.daser.halted:
             self._note_halt()
             return  # terminal: no more sweeps for this node
-        self.daser.sync()
-        for h in sorted(self.daser.reports):
-            rep = self.daser.reports[h]
+        if self._cont is None:
+            self._cont = self.daser.begin_sweep()
+        if self._cont.step():
+            self.sim.sched.call_after(0.0, self.sweep,
+                                      f"{self.name}.step")
+            return
+        cont, self._cont = self._cont, None
+        for h in sorted(cont.results):
+            rep = cont.results[h]
             if self._seen.get(h) != rep["status"]:
                 self._seen[h] = rep["status"]
                 self.sim._note_report(self, h, rep)
+        # drop dedup entries below the never-resampled floor (heights
+        # the checkpoint durably completed): _seen stays O(window)
+        with self.daser._lock:
+            floor = min([self.daser.cp.sample_from]
+                        + sorted(self.daser.cp.failed)[:1])
+        for h in [h for h in self._seen if h < floor]:
+            del self._seen[h]
         if self.daser.halted:
             self._note_halt()
             return
@@ -699,6 +840,13 @@ class SimSpec:
     sweep_interval: float = 1.0
     latency: tuple[float, float] = (0.005, 0.02)
     duration: float = 0.0  # 0 = auto from heights
+    # network-scale knobs (FORMATS §19.1): catch-up job width for the
+    # light fleet's continuation sweeps, the scheduler's runaway bound
+    # (0 = its default), and trace-row retention (0 = unbounded; the
+    # streamed digest is unaffected either way)
+    light_job_size: int = 4
+    max_events: int = 0
+    trace_keep: int = 0
     ops: tuple = ()
     # fault-registry arms (faults.arm_from_spec shape): armed for the
     # run with the registry reseeded to the scenario seed, so
@@ -720,6 +868,19 @@ class SimSpec:
         return self.heights * per + 2 * (
             ccfg.timeout_propose + ccfg.timeout_prevote
             + ccfg.timeout_precommit) + 6.0 + 0.15 * self.light_nodes
+
+    def extra_accounts(self) -> int:
+        """Funded non-validator accounts the ops program needs: traffic
+        generator lanes plus soak stale lanes. Zero for every spec
+        without those ops, so existing scenarios' genesis (and therefore
+        their consensus bytes) stay exactly as they were."""
+        n = 0
+        for op in self.ops:
+            if op.get("op") == "traffic":
+                n += int(op.get("sequences", 2))
+            elif op.get("op") == "soak":
+                n += int(op.get("stale_lanes", 1))
+        return n
 
     @staticmethod
     def from_dict(doc: dict) -> "SimSpec":
@@ -746,6 +907,7 @@ class Simulation:
         self.spec = spec
         self.ccfg = ccfg or SimConsensusConfig()
         self.chain_id = f"sim-{spec.name}"
+        self.workdir = workdir
         self.sched = Scheduler(spec.seed)
         self.net = SimNet(self.sched, spec.latency)
         self.forged_headers: dict[int, tuple] = {}
@@ -758,6 +920,9 @@ class Simulation:
         self.light_halts: list[dict] = []
         self.divergence: list[str] = []
         self._commit_hooks: dict[int, list] = {}  # height -> [fn(sim)]
+        # fn(sim, committer, height, block) at every height's FIRST
+        # commit (the traffic plane's confirmation watcher)
+        self.commit_listeners: list = []
         self._tx_seq = 0
 
         # validator identities are a function of the SLOT, never the
@@ -765,12 +930,20 @@ class Simulation:
         # fault-free consensus bytes stay seed-invariant (satellite pin)
         privs = [PrivateKey.from_seed(f"sim-val-{i}".encode())
                  for i in range(spec.validators)]
+        # traffic/stale-lane accounts: slot-keyed like the validators,
+        # present ONLY when the ops program asks (extra_accounts), so a
+        # spec without traffic ops keeps byte-identical genesis
+        self.traffic_privs = [
+            PrivateKey.from_seed(f"sim-traffic-{i}".encode())
+            for i in range(spec.extra_accounts())
+        ]
+        self._traffic_cursor = 0  # claim_traffic_accounts allocation
         genesis = {
             "time_unix": self.sched.clock.epoch,
             "accounts": [
                 {"address": p.public_key().address().hex(),
                  "balance": 10**13}
-                for p in privs
+                for p in privs + self.traffic_privs
             ],
             "validators": [
                 {"operator": p.public_key().address().hex(), "power": 10,
@@ -811,7 +984,7 @@ class Simulation:
         from celestia_app_tpu.client.tx_client import Signer
 
         self.signer = Signer(self.chain_id)
-        for i, p in enumerate(privs):
+        for i, p in enumerate(privs + self.traffic_privs):
             self.signer.add_account(p, number=i)
 
     # -- schedule-time helpers ------------------------------------------
@@ -824,6 +997,17 @@ class Simulation:
 
     def validator_by_index(self, i: int) -> SimValidator:
         return self.validators[i % len(self.validators)]
+
+    def claim_traffic_accounts(self, n: int) -> list[PrivateKey]:
+        """Allocate `n` of the pre-funded traffic accounts to an op
+        installer (ops claim in install order; SimSpec.extra_accounts
+        sized the pool with the same per-op arithmetic)."""
+        got = self.traffic_privs[self._traffic_cursor:
+                                 self._traffic_cursor + n]
+        if len(got) < n:
+            raise ValueError("traffic account pool exhausted")
+        self._traffic_cursor += n
+        return got
 
     def at(self, t: float, fn, label: str) -> None:
         self.sched.call_at(t, fn, label)
@@ -872,6 +1056,8 @@ class Simulation:
             self.commit_times[height] = round(t, 9)
             self.block_hashes[height] = bh
             self.app_hashes[height] = ah
+            for fn in self.commit_listeners:
+                fn(self, val, height, block)
         else:
             if (self.block_hashes[height], self.app_hashes[height]) \
                     != (bh, ah):
@@ -926,7 +1112,9 @@ class Simulation:
             self.sched.call_at(
                 0.5 + spec.sweep_interval * self.sched.rng.random(),  # lint: disable=det-rng
                 ln.sweep, f"{ln.name}.sweep")
-        self.sched.run(until=spec.auto_duration(self.ccfg))
+        self.sched.trace_keep = spec.trace_keep
+        kw = ({"max_events": spec.max_events} if spec.max_events else {})
+        self.sched.run(until=spec.auto_duration(self.ccfg), **kw)
         if self.divergence:
             raise AssertionError(
                 "consensus divergence in simulation: "

@@ -46,6 +46,12 @@ class Scheduler:
         self._seq = 0
         self.executed = 0
         self.trace: list[tuple[float, str]] = []
+        # the digest streams: every trace row folds into this running
+        # sha256 at append time, so `trace` itself can be bounded
+        # (trace_keep) on network-scale runs (1000+ lights emit millions
+        # of rows) without weakening the determinism witness
+        self._trace_hash = hashlib.sha256()
+        self.trace_keep = 0  # keep only the newest N rows (0=unbounded)
 
     # -- scheduling ------------------------------------------------------
 
@@ -61,11 +67,17 @@ class Scheduler:
 
     # -- the run loop ----------------------------------------------------
 
+    def _trace_row(self, t: float, label: str) -> None:
+        self._trace_hash.update(f"{t:.9f} {label}\n".encode())
+        self.trace.append((t, label))
+        if self.trace_keep > 0 and len(self.trace) > 2 * self.trace_keep:
+            del self.trace[:len(self.trace) - self.trace_keep]
+
     def note(self, label: str) -> None:
         """Append a trace row at the current instant (scenario hooks and
         node decisions use this so verdict-relevant transitions are part
         of the determinism witness, not only event firings)."""
-        self.trace.append((round(self.clock.monotonic(), 9), label))
+        self._trace_row(round(self.clock.monotonic(), 9), label)
 
     def run(self, until: float, max_events: int = 2_000_000) -> None:
         """Execute events in (time, tiebreak, seq) order until the heap
@@ -79,7 +91,7 @@ class Scheduler:
             self.clock.advance_to(t)
             self.executed += 1
             if label:
-                self.trace.append((round(t, 9), label))
+                self._trace_row(round(t, 9), label)
             fn()
         if (self.executed >= max_events and self._heap
                 and self._heap[0][0] <= until):
@@ -94,7 +106,6 @@ class Scheduler:
     # -- the determinism witness ----------------------------------------
 
     def trace_digest(self) -> str:
-        h = hashlib.sha256()
-        for t, label in self.trace:
-            h.update(f"{t:.9f} {label}\n".encode())
-        return h.hexdigest()
+        """sha256 over EVERY row ever appended (streamed, so bounding
+        `trace` via trace_keep never changes the digest)."""
+        return self._trace_hash.copy().hexdigest()

@@ -1,0 +1,230 @@
+"""Network-scale scenario plane (ISSUE 18).
+
+The tentpole pins: (1) the continuation-style DASer sweep
+(``DASer.begin_sweep``/``SweepCont.step``) is behaviorally IDENTICAL to
+the threaded ``sync()`` driver on both schemes' sampling paths — same
+reports, same checkpoint, same summary; (2) adversarial traffic (spam
+floods through real admission, seeded PFB lanes, per-message asymmetric
+faults) and long-horizon soak churn run inside virtual time with
+byte-identical verdicts per seed; (3) the slow tier scales the same
+machinery to 1000+ real lights over 1000+ virtual blocks in one process,
+twice, and the verdict bytes match exactly under a bounded peak RSS.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from celestia_app_tpu.chain import light
+from celestia_app_tpu.das.checkpoint import CheckpointStore
+from celestia_app_tpu.das.daser import DASer, DASerConfig
+from celestia_app_tpu.service.server import NodeService
+from celestia_app_tpu.sim import scenarios
+
+sys.path.insert(0, os.path.dirname(__file__))
+from test_codec_devnet import _scheme_network, _trust  # noqa: E402
+from test_consensus_multinode import CHAIN  # noqa: E402
+from test_das import _chain  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# continuation-DASer == threaded-DASer (the refactor's behavior pin)
+# ---------------------------------------------------------------------------
+
+
+def _serving_node(tmp_path, scheme, blocks=3):
+    if scheme == "rs2d-nmt":
+        net, _, _ = _chain(tmp_path, blocks=blocks)
+    else:
+        from celestia_app_tpu.chain.tx import MsgSend
+
+        net, signer, privs = _scheme_network(tmp_path, scheme)
+        a0 = privs[0].public_key().address()
+        a1 = privs[1].public_key().address()
+        t = 1_700_000_000.0
+        for i in range(blocks):
+            tx = signer.create_tx(a0, [MsgSend(a0, a1, 100 + i)],
+                                  fee=2000, gas_limit=100_000)
+            assert net.broadcast_tx(tx.encode())
+            signer.accounts[a0].sequence += 1
+            t += 10.0
+            blk, cert = net.produce_height(t=t)
+            assert blk is not None and cert is not None
+    return net
+
+
+@pytest.mark.parametrize("scheme", ["rs2d-nmt", "cmt-ldpc"])
+@pytest.mark.parametrize("job_size", [1, 4])
+def test_continuation_sweep_equals_threaded_sync(tmp_path, scheme,
+                                                 job_size,
+                                                 racecheck_guard):
+    """Two same-seed DASers over one serving node: the threaded sync()
+    driver and a bare begin_sweep()/step() loop must produce identical
+    reports, checkpoints, and summaries — sync() IS a thin driver over
+    the same continuation steps (workers=1: the threaded path's only
+    deterministic configuration, and the one the sim fleet runs)."""
+    net = _serving_node(tmp_path, scheme, blocks=3)
+    svc = NodeService(net.nodes[0], port=0)
+    svc.serve_background()
+    url = f"http://127.0.0.1:{svc.port}"
+    try:
+        cfg = DASerConfig(samples_per_header=4, workers=1,
+                          job_size=job_size, retries=2, backoff=0.01)
+
+        def make(tag):
+            return DASer(
+                [url], light.LightClient(CHAIN, _trust(net)),
+                CheckpointStore(str(tmp_path / tag / "cp.json")),
+                cfg=cfg, rng=np.random.default_rng(7), name=tag,
+            )
+
+        threaded = make("threaded")
+        out_threaded = threaded.sync()
+
+        stepped = make("stepped")
+        cont = stepped.begin_sweep()
+        steps = 0
+        while cont.step():
+            steps += 1
+            assert steps < 10_000, "continuation failed to terminate"
+        assert cont.done
+
+        assert out_threaded == cont.summary
+        assert out_threaded["head"] == 3
+        assert threaded.reports == stepped.reports
+        assert threaded.reports[1]["status"] == "sampled"
+        assert (threaded.store.load().to_json()
+                == stepped.store.load().to_json())
+
+        # a second sweep from the carried checkpoint is a no-op on both
+        out2 = threaded.sync()
+        cont2 = stepped.begin_sweep()
+        while cont2.step():
+            pass
+        assert out2 == cont2.summary
+        assert out2["sampled"] == []
+    finally:
+        svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# adversarial traffic in virtual time
+# ---------------------------------------------------------------------------
+
+
+def test_spam_flood_rejected_by_real_admission():
+    """The rewritten spam op floods BATCHES through add_txs (admission
+    prevalidation + CheckTx + pool byte gate): every junk and oversized
+    tx must be rejected at the door, none pooled, none committed, while
+    real injected load keeps committing."""
+    doc = scenarios.scenario_spec("spam-flood", "rs2d-nmt", 0,
+                                  validators=4, light_nodes=8)
+    v = scenarios.run_scenario(doc)
+    assert v["spam"]["sent"] > 0
+    assert v["spam"]["admitted"] == 0
+    assert v["spam"]["rejected"] == v["spam"]["sent"]
+    assert v["spam"]["pool_rejected"] >= v["spam"]["sent"]
+    assert v["heights_committed"] == doc["heights"]
+    assert v["light_halts"] == 0
+
+
+def test_long_soak_cycles_resources_and_stays_deterministic():
+    """A compressed long-soak cell: seeded PFB lanes through real
+    admission, per-message asymmetric corrupt+delay faults on the light
+    fleet, and every tracked resource (EDS/sig/commitment LRUs, mempool
+    TTL, snapshot keep-N, pack prune) cycling >= 2x — with zero false
+    condemnations, and the whole verdict byte-identical across two
+    same-seed runs (peak_rss_bytes excluded by verdict_bytes)."""
+    def run():
+        return scenarios.run_scenario(scenarios.scenario_spec(
+            "long-soak", "rs2d-nmt", 0,
+            validators=4, light_nodes=8, heights=14,
+            ops=[
+                {"op": "traffic", "t": 0.8, "every": 0.9,
+                 "sequences": 2},
+                {"op": "asym_fault", "kind": "corrupt", "src": "light",
+                 "prob": 0.2},
+                {"op": "asym_fault", "kind": "delay", "src": "light",
+                 "prob": 0.15, "delay": 0.05},
+                {"op": "soak", "eds_entries": 2, "sig_cache": 12,
+                 "commitment_cache": 8, "ttl_blocks": 2,
+                 "expire_every": 0.9, "snapshot_every": 3,
+                 "snapshot_keep": 2, "pack_every": 2, "pack_keep": 2,
+                 "stale_every": 0.6},
+            ]))
+
+    v1 = run()
+    soak = v1["soak"]
+    for resource in ("eds_evictions", "sig_evictions",
+                     "commitment_evictions", "mempool_expired",
+                     "snapshot_writes", "pack_builds"):
+        assert soak[resource] >= 2, (resource, soak)
+    assert soak["snapshot_prunes"] >= 2
+    assert soak["pack_prunes"] >= 2
+    # asymmetric per-message faults actually fired, on BOTH rules
+    assert v1["asym_msgs"].get("corrupt", 0) > 0
+    assert v1["asym_msgs"].get("delay", 0) > 0
+    # graceful degradation: traffic landed, nothing was condemned
+    assert v1["traffic"]["accepted"] > 0
+    assert v1["traffic"]["confirmed"] > 0
+    assert v1["false_condemnation_rate"] == 0.0
+    assert v1["light_halts"] == 0
+    assert v1["heights_committed"] == 14
+    # schema satellites: the three new fields are present everywhere
+    assert v1["sim_lights"] == 8
+    assert v1["sim_virtual_blocks"] == 14
+    assert v1["peak_rss_bytes"] > 0
+
+    v2 = run()
+    assert scenarios.verdict_bytes(v1) == scenarios.verdict_bytes(v2)
+
+
+def test_asym_drop_faults_are_deterministic_and_survivable():
+    """Per-message drops keyed by (src, dst, path, msg-index) under the
+    op's seed: the light fleet absorbs them through retries + peer
+    rotation, verdicts stay clean, and two same-seed runs byte-match."""
+    def run(seed):
+        return scenarios.run_scenario(scenarios.scenario_spec(
+            "honest", "rs2d-nmt", seed,
+            validators=4, light_nodes=8, heights=4,
+            ops=[{"op": "asym_fault", "kind": "drop", "src": "light",
+                  "prob": 0.2}]))
+
+    v1 = run(3)
+    assert v1["asym_msgs"].get("drop", 0) > 0
+    assert v1["light_halts"] == 0
+    assert v1["false_condemnation_rate"] == 0.0
+    assert v1["heights_committed"] == 4
+    v2 = run(3)
+    assert scenarios.verdict_bytes(v1) == scenarios.verdict_bytes(v2)
+    # a different seed reorders the world: same survivability verdict,
+    # different event tape
+    v3 = run(4)
+    assert v3["light_halts"] == 0
+    assert v3["trace_digest"] != v1["trace_digest"]
+
+
+# ---------------------------------------------------------------------------
+# the network-scale cell (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_scale_1000_lights_1000_blocks_byte_identical():
+    """THE acceptance cell: 1000 real continuation-driven DASer lights
+    following 1000 virtual blocks in one process, run twice with the
+    same seed — verdicts byte-identical, peak RSS bounded."""
+    def run():
+        return scenarios.run_scenario(
+            scenarios.scenario_spec("fleet-scale", "rs2d-nmt", 0))
+
+    v1 = run()
+    assert v1["sim_lights"] == 1000
+    assert v1["sim_virtual_blocks"] >= 1000
+    assert v1["light_halts"] == 0
+    assert v1["false_condemnation_rate"] == 0.0
+    assert v1["peak_rss_bytes"] < 4 * 2**30, v1["peak_rss_bytes"]
+    v2 = run()
+    assert scenarios.verdict_bytes(v1) == scenarios.verdict_bytes(v2)
