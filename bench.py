@@ -1297,17 +1297,123 @@ def measure_mempool(n_senders: int = 16, txs_per_sender: int = 32) -> None:
     }))
 
 
+def measure_slo(heights: int = 3) -> None:
+    """Fleet SLO verdict bench (--slo): spin a live 2-validator HTTP
+    devnet, let it commit, quiesce the reactors, then run the fleet-wide
+    SLO engine (tools/fleetmon.py) against it — and prove the verdict is
+    DETERMINISTIC: two scrapes of the same quiesced fleet state must
+    produce byte-identical verdicts. One BENCH JSON line:
+
+      {"metric": "slo_verdict_pass", "value": 1|0, "deterministic": ...}
+    """
+    import threading  # noqa: F401  (ValidatorService spawns threads)
+
+    from celestia_app_tpu.chain.crypto import PrivateKey
+    from celestia_app_tpu.chain.reactor import ReactorConfig
+    from celestia_app_tpu.chain import consensus as cons
+    from celestia_app_tpu.service.validator_server import ValidatorService
+    from celestia_app_tpu.tools import fleetmon
+
+    privs = [PrivateKey.from_seed(b"slo-%d" % i) for i in range(2)]
+    genesis = {
+        "time_unix": 1_700_000_000.0,
+        "accounts": [
+            {"address": p.public_key().address().hex(), "balance": 10**12}
+            for p in privs
+        ],
+        "validators": [
+            {"operator": p.public_key().address().hex(), "power": 10,
+             "pubkey": p.public_key().compressed.hex()}
+            for p in privs
+        ],
+    }
+    nodes = [cons.ValidatorNode(f"val{i}", p, genesis, "slo-bench")
+             for i, p in enumerate(privs)]
+    services = [ValidatorService(v) for v in nodes]
+    for s in services:
+        s.serve_background()
+    urls = [f"http://127.0.0.1:{s.port}" for s in services]
+    cfg = dict(timeout_propose=5.0, timeout_prevote=2.5,
+               timeout_precommit=2.5, timeout_delta=0.5,
+               block_interval=0.05, poll=0.01, gossip_timeout=1.5,
+               sync_grace=0.5, breaker_reset=1.5)
+    try:
+        for i, s in enumerate(services):
+            s.attach_reactor([u for j, u in enumerate(urls) if j != i],
+                             ReactorConfig(**cfg))
+        deadline = time.monotonic() + 120
+        while (time.monotonic() < deadline
+               and min(n.app.height for n in nodes) < heights):
+            time.sleep(0.05)
+        # quiesce: stop consensus, keep the HTTP planes serving — the
+        # fleet state under judgment must hold still between scrapes
+        for s in services:
+            if s.reactor is not None:
+                s.reactor.stop()
+        rules = fleetmon.normalize_rules([
+            {"name": "fleet-height", "source": "status", "path": "height",
+             "op": ">=", "value": heights, "agg": "each"},
+            {"name": "no-http-500", "metric": "http.500",
+             "op": "==", "value": 0},
+            {"name": "no-breaker-flaps", "metric": "net.breaker_open",
+             "op": "==", "value": 0},
+            {"name": "no-collector-errors",
+             "metric": "telemetry.collector_errors",
+             "op": "==", "value": 0},
+            {"name": "commit-p99-budget", "metric": "commit",
+             "kind": "p99", "op": "<=", "value": 60.0},
+        ])
+        v1 = fleetmon.evaluate(rules, fleetmon.scrape_fleet(
+            urls, with_availability=False))
+        v2 = fleetmon.evaluate(rules, fleetmon.scrape_fleet(
+            urls, with_availability=False))
+        deterministic = (fleetmon.verdict_bytes(v1)
+                         == fleetmon.verdict_bytes(v2))
+        print(json.dumps({
+            "metric": "slo_verdict_pass",
+            "value": 1 if v1["pass"] else 0,
+            "unit": "bool",
+            "deterministic": deterministic,
+            "rules": len(rules),
+            "failed": v1["failed"],
+            "fleet_height": min(n.app.height for n in nodes),
+        }), flush=True)
+    finally:
+        for s in services:
+            try:
+                s.shutdown()
+            except Exception:
+                pass
+
+
+def run_compare() -> None:
+    """Bench trajectory gate (--compare): align the repo's committed
+    BENCH_*.json rounds (tools/benchdiff.py), print the per-metric
+    trajectory, and exit 2 when the newest comparable sample of any
+    metric regressed beyond tolerance — the CI gate over the committed
+    perf history. cpu-fallback rounds never compare against hardware."""
+    from celestia_app_tpu.tools import benchdiff
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    raise SystemExit(benchdiff.main(["--dir", here]))
+
+
 def measure_obs(blocks: int = 40, senders: int = 8) -> None:
     """Observability-plane overhead bench (--obs): the produce-block hot
-    path with full span + histogram instrumentation vs the same path with
-    spans disabled (the CELESTIA_OBS=off gate, flipped in-process via
-    obs.set_enabled). One BENCH JSON line:
+    path with the FULL boundary observatory armed — spans + histograms +
+    the transfer-ledger rows (obs/xfer.py, they follow the spans gate),
+    per-site lock wait/hold profiling (racecheck, CELESTIA_LOCKPROF
+    semantics flipped in-process), and a running GIL-pressure sampler
+    (obs/gil.py) — vs the same path with everything off. One BENCH JSON
+    line:
 
       {"metric": "obs_overhead_pct", ...}
 
     Each measured block carries real ante-checked MsgSend txs so the
     denominator is a representative block, not an empty square."""
     from celestia_app_tpu import obs as obs_mod
+    from celestia_app_tpu.obs import gil
+    from celestia_app_tpu.tools.analyze import racecheck
     from celestia_app_tpu.chain.app import App
     from celestia_app_tpu.chain.crypto import PrivateKey
     from celestia_app_tpu.chain.node import Node
@@ -1317,8 +1423,8 @@ def measure_obs(blocks: int = 40, senders: int = 8) -> None:
     privs = [PrivateKey.from_seed(b"obs-%d" % i) for i in range(senders)]
     addrs = [p.public_key().address() for p in privs]
 
-    def run(n_blocks: int) -> float:
-        """Fresh node; per-block ms over n_blocks tx-bearing blocks."""
+    def run(n_blocks: int) -> list:
+        """Fresh node; per-block ms for n_blocks tx-bearing blocks."""
         app = App(chain_id="obs-bench", engine="host")
         app.init_chain({
             "time_unix": 1_700_000_000.0,
@@ -1344,22 +1450,63 @@ def measure_obs(blocks: int = 40, senders: int = 8) -> None:
         t_block = 1_700_000_001.0
         submit_round()
         node.produce_block(t=t_block)  # warm caches outside the clock
-        t0 = time.perf_counter()
+        per_block = []
         for _ in range(n_blocks):
             t_block += 1.0
+            t0 = time.perf_counter()
             submit_round()
             node.produce_block(t=t_block)
-        return (time.perf_counter() - t0) / n_blocks * 1e3
+            per_block.append((time.perf_counter() - t0) * 1e3)
+        return per_block
 
-    # off first, then on: any residual warm-up penalizes the
-    # INSTRUMENTED side, keeping the reported overhead conservative
-    obs_mod.set_enabled(False)
-    try:
-        off_ms = min(run(blocks) for _ in range(3))
+    # INTERLEAVED off/on arms, compared at the per-block p10 floor: on
+    # a shared box the run-to-run load swing dwarfs a single-digit
+    # overhead (observed >60% spread across identical runs, and a load
+    # spike in any single block poisons a per-run mean). Interleaving
+    # gives both arms the same shot at the quiet windows; the low
+    # percentile of each arm's per-block times keeps only those, which
+    # is the number the <5% gate is actually about — what the
+    # observatory adds to a block, not what the neighbors add to the
+    # box. The ON side arms the whole observatory per pair: span rows +
+    # xfer ledger rows (spans gate), lock wait/hold profiling (locks
+    # created by the instrumented Apps are born AFTER install, so they
+    # are tracked), and the GIL oversleep sampler.
+    def run_off(n: int) -> list:
+        obs_mod.set_enabled(False)
+        return run(n)
+
+    def run_on(n: int) -> list:
         obs_mod.set_enabled(True)
-        on_ms = min(run(blocks) for _ in range(3))
+        racecheck.install()
+        racecheck.set_order_tracking(False)
+        racecheck.set_profiling(True)
+        gil.start("bench")
+        try:
+            return run(n)
+        finally:
+            gil.stop_all()
+            racecheck.set_profiling(False)
+            racecheck.uninstall()
+
+    off_blocks, on_blocks = [], []
+    try:
+        run_off(4)  # discard: allocator/caches warm on nobody's clock
+        for pair in range(4):
+            # alternate which arm goes first so neither systematically
+            # inherits the colder (or busier) half of its pair
+            if pair % 2 == 0:
+                off_blocks += run_off(blocks)
+                on_blocks += run_on(blocks)
+            else:
+                on_blocks += run_on(blocks)
+                off_blocks += run_off(blocks)
     finally:
         obs_mod.set_enabled(None)  # back to the CELESTIA_OBS env gate
+
+    def floor(xs: list) -> float:
+        return sorted(xs)[len(xs) // 10]  # p10: the quiet-window block
+
+    off_ms, on_ms = floor(off_blocks), floor(on_blocks)
     overhead_pct = (on_ms - off_ms) / off_ms * 100.0
     print(json.dumps({
         "metric": "obs_overhead_pct",
@@ -2901,6 +3048,15 @@ MODES = {
                 "cold vs incremental-cache warm"),
     "obs": (measure_obs, "obs_overhead_pct",
             "observability overhead on the produce-block path"),
+    "slo": (measure_slo,
+            "slo_verdict_pass (+ deterministic verdict-bytes check)",
+            "fleet-wide SLO verdict engine (tools/fleetmon.py) judged "
+            "against a live, then quiesced, 2-validator HTTP devnet"),
+    "compare": (run_compare,
+                "per-metric trajectory across committed BENCH_*.json "
+                "rounds; exit 2 on regression beyond tolerance",
+                "bench history differ (tools/benchdiff.py): align "
+                "rounds, flag regressions, CI-usable exit code"),
     "mesh": (measure_mesh,
              "extend_commit_256_ms, blocks_per_sec_batched, "
              "mesh_scaling_blocks_per_sec",

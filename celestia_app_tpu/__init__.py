@@ -32,10 +32,17 @@ __version__ = "0.1.0"
 # CELESTIA_RACE=1 wraps threading.Lock/RLock before any submodule
 # creates one, so chaos/stress runs — including their subprocess
 # nodes, which inherit the env — record lock acquisition order and
-# surface ABBA inversions. See tools/analyze/racecheck.py.
+# surface ABBA inversions. CELESTIA_LOCKPROF=1 installs the SAME
+# wrapper but for contention profiling (per-creation-site lock.wait
+# histograms + hold gauges in /metrics) — order bookkeeping stays off
+# unless CELESTIA_RACE asks for it. See tools/analyze/racecheck.py.
 import os as _os
 
-if _os.environ.get("CELESTIA_RACE", "").strip() == "1":
+_race = _os.environ.get("CELESTIA_RACE", "").strip() == "1"
+_lockprof = _os.environ.get("CELESTIA_LOCKPROF", "").strip() == "1"
+if _race or _lockprof:
     from celestia_app_tpu.tools.analyze import racecheck as _racecheck
 
     _racecheck.install()
+    _racecheck.set_order_tracking(_race)
+    _racecheck.set_profiling(_lockprof)

@@ -27,6 +27,7 @@ import time as time_mod
 
 from celestia_app_tpu import appconsts
 from celestia_app_tpu import obs
+from celestia_app_tpu.obs import xfer
 from celestia_app_tpu.chain import admission as admission_mod
 from celestia_app_tpu.chain import ante as ante_mod
 from celestia_app_tpu.chain import blobstream as blobstream_mod
@@ -358,6 +359,13 @@ class App:
         # service/consensus lock
         self.da_seed_listeners: list = []
         self.da_warmer = edscache_mod.ProverWarmer()
+        # boundary observatory (obs/xfer.py): cumulative ledger mark at
+        # the previous commit — each commit's delta is the ROADMAP-item-2
+        # gauge host_bytes_crossed_per_block (surfaced in /metrics and
+        # the status reactor block); process-wide totals, so in-process
+        # multi-node tests read it on single-node fixtures
+        self._xfer_mark = xfer.bytes_crossed()
+        self.last_host_bytes_crossed = 0
         # serving plane (das/packs.py): disk-backed nodes precompute a
         # static proof pack per warm height under <home>/packs, pruned
         # keep-newest-N; in-memory nodes serve live assembly only.
@@ -1197,6 +1205,18 @@ class App:
                 del self._history[h]
         self._check_state = None  # baseapp resetState on commit
         telemetry.measure_since("commit", t0)
+        # boundary observatory: bytes that crossed the host<->device
+        # boundary since the previous commit — THE gauge ROADMAP item 2
+        # (zero-copy blob path) optimizes against. Process-wide ledger
+        # totals, so on a host-engine node this reads 0 and on a multi-
+        # node in-process net the proposer's commit attributes the
+        # whole net's traffic (documented in FORMATS; devnets are
+        # per-process, where the attribution is exact).
+        crossed = xfer.bytes_crossed()
+        self.last_host_bytes_crossed = crossed - self._xfer_mark
+        self._xfer_mark = crossed
+        telemetry.gauge("xfer.host_bytes_crossed_per_block",
+                        self.last_host_bytes_crossed)
         # BlockSummary trace row (celestia-core pkg/trace analog, §5.1):
         # what the e2e benchmark tooling scrapes per block. PER-NODE table
         # (self.traces): multi-node in-process networks must not interleave

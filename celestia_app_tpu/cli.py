@@ -1927,6 +1927,20 @@ def cmd_timeline(args) -> int:
         + (["--since", str(args.since)] if args.since else [])
         + ["--limit", str(args.limit), "--last", str(args.last)]
         + (["--json"] if args.json else [])
+        + (["--no-xfer"] if getattr(args, "no_xfer", False) else [])
+    )
+
+
+def cmd_fleetmon(args) -> int:
+    """Fleet-wide SLO verdict (tools/fleetmon.py): scrape every node,
+    evaluate the declarative rule file, print one deterministic verdict
+    JSON; exit code 2 on violation so CI can gate on it."""
+    from celestia_app_tpu.tools import fleetmon
+
+    return fleetmon.main(
+        ["--nodes", args.nodes, "--rules", args.rules]
+        + (["--no-availability"] if args.no_availability else [])
+        + (["--out", args.out] if args.out else [])
     )
 
 
@@ -2498,7 +2512,25 @@ def main(argv=None) -> int:
                    help="render the N most recent heights (text mode)")
     p.add_argument("--json", action="store_true",
                    help="dump merged spans as JSON")
+    p.add_argument("--no-xfer", action="store_true",
+                   help="skip the transfer-ledger rows (/trace/xfer)")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser(
+        "fleetmon",
+        help="fleet-wide SLO verdict (tools/fleetmon.py): scrape "
+             "/metrics + status from every node, evaluate a declarative "
+             "rule file, exit 0 pass / 2 violation",
+    )
+    p.add_argument("--nodes", required=True,
+                   help="comma-separated node/validator service URLs")
+    p.add_argument("--rules", required=True,
+                   help="SLO rule file (JSON, FORMATS §22.1)")
+    p.add_argument("--no-availability", action="store_true",
+                   help="skip the /das/availability scrape")
+    p.add_argument("--out", default=None,
+                   help="also write the verdict JSON to this file")
+    p.set_defaults(fn=cmd_fleetmon)
 
     p = sub.add_parser("blockscan")
     p.add_argument("--home", required=True)

@@ -65,11 +65,13 @@ import collections
 import hashlib
 import os
 import threading
+import weakref
 
 import numpy as np
 
 from celestia_app_tpu import obs
 from celestia_app_tpu.da.dah import DataAvailabilityHeader, ExtendedDataSquare
+from celestia_app_tpu.obs import xfer
 from celestia_app_tpu.utils import telemetry
 
 # bounded LRU: at k=128 one entry holds ~32 MB of EDS plus ~24 MB of lazy
@@ -293,7 +295,9 @@ class DeviceEntry(EdsCacheEntry):
         with self._eds_lock:
             if self._eds is None:
                 t0 = telemetry.start_timer()
-                self._eds = ExtendedDataSquare(np.asarray(self._eds_dev))
+                self._eds = ExtendedDataSquare(
+                    xfer.to_host(self._eds_dev, "edscache.eds")
+                )
                 self._crossing("eds")
                 telemetry.measure_since("edscache.host_fetch", t0)
             return self._eds
@@ -336,8 +340,9 @@ class DeviceEntry(EdsCacheEntry):
         crossing per orientation)."""
         levels = self._device_levels(col)
         t0 = telemetry.start_timer()
-        out = [(np.asarray(m), np.asarray(x), np.asarray(v))
-               for m, x, v in levels]
+        site = "edscache.col_levels" if col else "edscache.levels"
+        out = [tuple(triple)
+               for triple in xfer.to_host(list(levels), site)]
         self._crossing("col_levels" if col else "levels")
         telemetry.measure_since("edscache.host_fetch", t0)
         return out
@@ -435,20 +440,20 @@ def compute_entry(ods: np.ndarray, engine: str = "auto",
                 telemetry.incr("mesh.engine_fallbacks")
     if engine in ("device", "auto", "mesh"):
         try:
-            import jax.numpy as jnp
-
             from celestia_app_tpu.da import eds as eds_mod
 
             eds_arr, rows, cols, root = eds_mod.jitted_pipeline(
                 ods.shape[0]
-            )(jnp.asarray(ods))
+            )(xfer.to_device(ods, "edscache.compute_entry"))
+            eds_h, rows_h, cols_h, root_h = xfer.to_host(
+                (eds_arr, rows, cols, root), "edscache.compute_entry"
+            )
             dah = DataAvailabilityHeader(
-                row_roots=tuple(bytes(r) for r in np.asarray(rows)),
-                col_roots=tuple(bytes(c) for c in np.asarray(cols)),
+                row_roots=tuple(bytes(r) for r in rows_h),
+                col_roots=tuple(bytes(c) for c in cols_h),
             )
             return EdsCacheEntry(
-                ExtendedDataSquare(np.asarray(eds_arr)), dah,
-                bytes(np.asarray(root)),
+                ExtendedDataSquare(eds_h), dah, bytes(root_h),
             )
         except Exception:
             if engine in ("device", "mesh"):
@@ -539,6 +544,7 @@ class EdsCache:
                             else max_entries)
         self.max_bytes = (DEFAULT_MAX_BYTES if max_bytes is None
                           else max_bytes)
+        _caches.add(self)  # the residency gauge collector walks live caches
         self._lock = threading.Lock()
         self._entries: collections.OrderedDict[bytes, EdsCacheEntry] = \
             collections.OrderedDict()  # guarded-by: _lock
@@ -616,9 +622,44 @@ class EdsCache:
         with self._lock:
             return self._nbytes
 
+    def residency_counts(self) -> dict[str, int]:
+        """Resident entries bucketed by ``residency()`` state — the
+        scrape-time source of the ``edscache.resident_entries{state=…}``
+        gauges (PR 13 exposed the splits only inside /das/availability
+        records; fleetmon and external scrapers need them in /metrics)."""
+        with self._lock:
+            entries = list(self._entries.values())
+        counts = {"host": 0, "device": 0, "device+host": 0}
+        for entry in entries:
+            state = entry.residency()
+            counts[state] = counts.get(state, 0) + 1
+        return counts
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)
+
+
+# Scrape-time residency gauges: every live cache in the process (weakly
+# held — a dropped cache stops being counted) contributes its per-state
+# entry counts. Registered once at import; the collector runs before
+# each snapshot()/prometheus(), so /metrics always reflects the current
+# device/host split without a background thread.
+_caches: "weakref.WeakSet[EdsCache]" = weakref.WeakSet()
+
+
+def _residency_collector() -> None:
+    counts = {"host": 0, "device": 0, "device+host": 0}
+    for cache in list(_caches):
+        for state, n in cache.residency_counts().items():
+            counts[state] = counts.get(state, 0) + n
+    for state, n in sorted(counts.items()):
+        telemetry.gauge(
+            "edscache.resident_entries", n, labels={"state": state}
+        )
+
+
+telemetry.register_collector(_residency_collector)
 
 
 class ProverWarmer:

@@ -789,6 +789,10 @@ class SampleService:
 
         self._httpd = Server((host, port), Handler)
         self.port = self._httpd.server_address[1]
+        # GIL-pressure sampler for this serving plane (no-op unless
+        # CELESTIA_OBS is on): gil.pressure{service="das"} in /metrics
+        from celestia_app_tpu.obs import gil
+        gil.start("das")
 
     def serve_background(self):
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)

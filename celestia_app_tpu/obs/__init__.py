@@ -1,6 +1,6 @@
 """The observability plane: spans, structured logging, JAX profiling.
 
-Three sub-modules, one import surface (``from celestia_app_tpu import
+Five sub-modules, one import surface (``from celestia_app_tpu import
 obs``):
 
 - ``obs.spans`` — context-manager span API over the columnar TraceTables
@@ -11,6 +11,14 @@ obs``):
   instead of calling ``print`` (lint-enforced).
 - ``obs.jax_profile`` — the compile-vs-execute split for the jitted
   pipelines, device gauges, and the /debug/profile capture worker.
+- ``obs.xfer`` — the host↔device transfer ledger: every device_put /
+  device_get in the tree routes through ``xfer.to_device``/``to_host``
+  so bytes, calls, and latency are attributed per call-site label, and
+  ``xfer.no_implicit_transfers()`` turns stray implicit copies into
+  hard errors for tier-1 residency pins.
+- ``obs.gil`` — GIL-pressure oversleep samplers per HTTP service and
+  the ``process.peak_rss_bytes`` /metrics gauge (collector registers on
+  import of this package).
 
 Histograms/labels/Prometheus exposition live in utils/telemetry.py (the
 metric registry predates this package and everything already imports it).
@@ -18,6 +26,7 @@ docs/DESIGN.md "The observability plane" has the span model; FORMATS §10
 the wire formats.
 """
 
+from celestia_app_tpu.obs import gil  # noqa: F401  (registers the peak-RSS collector)
 from celestia_app_tpu.obs.log import get_logger  # noqa: F401
 from celestia_app_tpu.obs.spans import (  # noqa: F401
     NOOP,
@@ -36,4 +45,10 @@ from celestia_app_tpu.obs.spans import (  # noqa: F401
     set_enabled,
     span,
     trace_id_for,
+)
+from celestia_app_tpu.obs.xfer import (  # noqa: F401
+    ImplicitTransferError,
+    no_implicit_transfers,
+    to_device,
+    to_host,
 )

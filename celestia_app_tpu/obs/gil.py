@@ -1,0 +1,143 @@
+"""Process-pressure observables: the GIL sampler + the peak-RSS gauge.
+
+ROADMAP item 5 (escaping the GIL for the HTTP serving planes) has so
+far rested on an inference — pack-vs-live serving ratios — rather than
+a measured contention number. This module produces that number the way
+scheduler-latency probes do: an **oversleep-drift sampler**. A daemon
+thread asks for a fixed short sleep (`INTERVAL_S`); under CPython, a
+thread waking from `sleep()` must reacquire the GIL before it runs
+again, so the drift between requested and actual sleep is a direct
+sample of how long runnable threads in THIS process wait for the
+interpreter (plus OS scheduler noise, which is the same for every
+service and cancels in comparisons). Each service starts one sampler
+under its own label:
+
+- histogram ``gil.oversleep{service=…}`` — per-wake drift seconds
+  (p50/p99 in /metrics via the registry's bucket ladder);
+- gauge ``gil.pressure{service=…}`` — EWMA of drift/interval (0 ≈
+  idle interpreter; 1.0 means wakes are delayed by a full interval).
+
+Sampling is ``CELESTIA_OBS``-gated (the spans gate — `start` is a
+no-op when observability is off) and costs one mostly-sleeping thread
+per service: ~20 wakes/s of a few µs each (the interval sits well
+above CPython's 5 ms switch interval on purpose — a probe at the
+switch interval competes for the GIL instead of observing it), which
+is what ``bench.py --obs`` arms when it measures the observatory's
+overhead.
+
+The peak-RSS collector rides along because it is the same kind of
+process-level pressure number: PR 18 tracked ``peak_rss_bytes`` only
+inside scenario verdicts; registering a scrape-time collector here
+makes it a proper /metrics gauge (``process.peak_rss_bytes``) for
+fleetmon and external scrapers. The collector registers at import —
+importing the obs package is enough, no sampler needed.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+from celestia_app_tpu.utils import telemetry
+
+# 50 ms: an order of magnitude above CPython's 5 ms switch interval, so
+# the probe samples GIL pressure instead of synchronizing with the
+# switcher and creating it (a 5 ms probe costs ~10% wall on a busy
+# interpreter; 50 ms is noise-level and still ~20 samples/s).
+INTERVAL_S = 0.05
+
+_lock = threading.Lock()
+_samplers: dict = {}  # service -> _Sampler  # guarded-by: _lock
+
+telemetry.set_help(
+    "gil.oversleep",
+    "sampler oversleep drift (GIL+scheduler wait) per wake (seconds)",
+)
+telemetry.set_help(
+    "gil.pressure",
+    "EWMA of oversleep drift / requested interval (0=idle interpreter)",
+)
+telemetry.set_help(
+    "process.peak_rss_bytes", "peak resident set size of this process"
+)
+
+
+def peak_rss_bytes() -> int:
+    """Peak resident set of this process in bytes (Linux ru_maxrss is
+    KiB, macOS bytes; 0 where getrusage is unavailable)."""
+    try:
+        import resource
+    except ImportError:  # non-POSIX
+        return 0
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return int(peak) * (1 if sys.platform == "darwin" else 1024)
+
+
+def _rss_collector() -> None:
+    telemetry.gauge("process.peak_rss_bytes", peak_rss_bytes())
+
+
+telemetry.register_collector(_rss_collector)
+
+
+class _Sampler(threading.Thread):
+    """One oversleep probe: sleep INTERVAL_S in a loop, record the
+    drift. Daemon — it must never hold a process open."""
+
+    def __init__(self, service: str):
+        super().__init__(name=f"gil-sampler-{service}", daemon=True)
+        self.service = service
+        self._stop = threading.Event()
+        self._ewma = 0.0
+
+    def run(self) -> None:
+        labels = {"service": self.service}
+        while True:
+            t0 = time.perf_counter()  # lint: disable=det-wallclock — the probe IS a clock measurement; feeds telemetry only
+            if self._stop.wait(INTERVAL_S):
+                return
+            drift = (time.perf_counter() - t0) - INTERVAL_S  # lint: disable=det-wallclock — probe measurement, telemetry only
+            drift = max(drift, 0.0)
+            telemetry.observe("gil.oversleep", drift, labels=labels)
+            self._ewma = 0.9 * self._ewma + 0.1 * (drift / INTERVAL_S)
+            telemetry.gauge("gil.pressure", round(self._ewma, 6),
+                            labels=labels)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def start(service: str) -> bool:
+    """Start the sampler for `service` (idempotent per label). No-op —
+    returns False — when observability is gated off (CELESTIA_OBS),
+    same gate as span recording."""
+    from celestia_app_tpu.obs import spans
+
+    if not spans.enabled():
+        return False
+    with _lock:
+        s = _samplers.get(service)
+        if s is not None and s.is_alive():
+            return False
+        s = _Sampler(service)
+        _samplers[service] = s
+        s.start()
+        return True
+
+
+def stop_all() -> None:
+    """Stop every sampler (tests, bench teardown). Threads exit at
+    their next wake (≤ INTERVAL_S)."""
+    with _lock:
+        samplers = list(_samplers.values())
+        _samplers.clear()
+    for s in samplers:
+        s.stop()
+
+
+def running() -> list[str]:
+    with _lock:
+        return sorted(
+            name for name, s in _samplers.items() if s.is_alive()
+        )

@@ -199,16 +199,17 @@ def eds_axis_roots(slabs: np.ndarray, indices, k: int) -> np.ndarray:
     # when active for this square size — the level-synchronous reduction
     # is per-tree, so jit partitions it cleanly by input sharding and
     # the roots come back bit-identical (tests/test_mesh_plane.py)
+    from celestia_app_tpu.obs import xfer
     from celestia_app_tpu.parallel import mesh_engine
 
     slabs_dev = mesh_engine.maybe_shard_batch(slabs, k)
     idx_dev = mesh_engine.maybe_shard_batch(idx, k)
     if slabs_dev is slabs:
-        slabs_dev = jnp.asarray(slabs)
+        slabs_dev = xfer.to_device(slabs, "ops.roots_dispatch")
     if idx_dev is idx:
-        idx_dev = jnp.asarray(idx)
+        idx_dev = xfer.to_device(idx, "ops.roots_dispatch")
     out = jitted_eds_axis_roots(k, bucket)(slabs_dev, idx_dev)
-    out = np.asarray(out)[:n]
+    out = xfer.to_host(out, "ops.roots_fetch")[:n]
     _EXEC_BUCKETS.add((k, bucket))
     return out
 

@@ -281,6 +281,8 @@ class _RepairAxesRunner:
             return pow2_bucket(n) in self._buckets
 
     def __call__(self, symbols_batch) -> np.ndarray:
+        from celestia_app_tpu.obs import xfer
+
         batch = np.asarray(symbols_batch)
         n = batch.shape[0]
         bucket = pow2_bucket(n)
@@ -300,8 +302,8 @@ class _RepairAxesRunner:
 
             dev_batch = mesh_engine.maybe_shard_batch(batch, self._k)
         if dev_batch is batch:
-            dev_batch = jnp.asarray(batch)
-        out = np.asarray(self._run(dev_batch))[:n]
+            dev_batch = xfer.to_device(batch, "ops.repair_dispatch")
+        out = xfer.to_host(self._run(dev_batch), "ops.repair_fetch")[:n]
         with self._lock:
             self._buckets.add(bucket)
         return out

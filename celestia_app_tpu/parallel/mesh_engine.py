@@ -50,6 +50,7 @@ import os
 
 import numpy as np
 
+from celestia_app_tpu.obs import xfer
 from celestia_app_tpu.utils import telemetry
 
 # k=256 is the reference's streaming target (ROADMAP item 4 / SURVEY
@@ -181,7 +182,8 @@ def maybe_shard_batch(batch: np.ndarray, k: int):
         sharding = NamedSharding(
             _flat_mesh(n_dev), P("data", *([None] * (batch.ndim - 1)))
         )
-        out = jax.device_put(batch, sharding)
+        out = xfer.to_device(batch, "mesh.shard_batch",
+                             placement=sharding)
         telemetry.incr("mesh.batch_shards")
         return out
     except Exception:
@@ -198,11 +200,16 @@ def maybe_shard_batch(batch: np.ndarray, k: int):
 
 def _run_sharded(mesh, ods_batch: np.ndarray, k: int):
     """One sharded dispatch over a (B, k, k, 512) batch. Returns device
-    (eds, row_roots, col_roots, data_roots) with the EDS left sharded."""
+    (eds, row_roots, col_roots, data_roots) with the EDS left sharded.
+    The upload is explicit (the pipeline's own input sharding) so the
+    transfer ledger counts it instead of jit doing it silently."""
     from celestia_app_tpu.parallel import sharded_eds
 
     run = sharded_eds.jitted_sharded_pipeline(mesh, k)
-    return run(ods_batch)
+    return run(xfer.to_device(
+        ods_batch, "mesh.sharded_dispatch",
+        placement=sharded_eds.input_sharding(mesh),
+    ))
 
 
 def compute_entry_mesh(ods: np.ndarray):
@@ -227,8 +234,6 @@ def compute_entries_batched(ods_batch: np.ndarray,
     single-chip vmapped pipeline otherwise. Counts ``da.extend_runs``
     once per block (the per-(node, height) accounting every tier-1 pin
     asserts on) plus one ``mesh.batched_dispatches``."""
-    import jax
-
     b, k = int(ods_batch.shape[0]), int(ods_batch.shape[1])
     mesh = mesh_for_batch(k, b)
     use_mesh = mesh is not None and (engine == "mesh"
@@ -240,12 +245,13 @@ def compute_entries_batched(ods_batch: np.ndarray,
         from celestia_app_tpu.da import eds as eds_mod
 
         eds_dev, rows, cols, roots = eds_mod.jitted_pipeline_batched(k)(
-            jax.device_put(ods_batch)
+            xfer.to_device(ods_batch, "mesh.batched_dispatch")
         )
     # ONE small host fetch for the whole batch's commitments (B x 4k
     # roots + B x 32 data roots); the EDS slabs stay on device
-    rows_h, cols_h, roots_h = (np.asarray(rows), np.asarray(cols),
-                               np.asarray(roots))
+    rows_h, cols_h, roots_h = xfer.to_host(
+        (rows, cols, roots), "mesh.batched_commitments"
+    )
     telemetry.incr("da.extend_runs", b)
     telemetry.incr("mesh.batched_dispatches")
     telemetry.incr("mesh.batched_blocks", b)
@@ -262,8 +268,9 @@ def _device_entry(eds_dev, rows, cols, root, fetched: bool = False):
     from celestia_app_tpu.da.dah import DataAvailabilityHeader
 
     if not fetched:
-        rows, cols, root = np.asarray(rows), np.asarray(cols), \
-            np.asarray(root)
+        rows, cols, root = xfer.to_host(
+            (rows, cols, root), "mesh.entry_commitments"
+        )
     dah = DataAvailabilityHeader(
         row_roots=tuple(bytes(r) for r in rows),
         col_roots=tuple(bytes(c) for c in cols),

@@ -211,11 +211,19 @@ def sharded_pipeline_fn(mesh: Mesh, k: int):
     return run
 
 
+def input_sharding(mesh: Mesh) -> NamedSharding:
+    """THE pipeline input placement — (B over ``data``, rows over
+    ``seq``). Exposed so dispatchers (parallel/mesh_engine._run_sharded)
+    can upload the batch EXPLICITLY through the transfer ledger instead
+    of letting jit move it silently; using the jitted program's own
+    in_sharding makes the explicit put a no-op at dispatch time."""
+    return NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS, None, None))
+
+
 @functools.lru_cache(maxsize=None)
 def _jitted(mesh: Mesh, k: int):
     fn = sharded_pipeline_fn(mesh, k)
-    in_sharding = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS, None, None))
-    return jax.jit(fn, in_shardings=in_sharding)
+    return jax.jit(fn, in_shardings=input_sharding(mesh))
 
 
 def jitted_sharded_pipeline(mesh: Mesh, k: int):

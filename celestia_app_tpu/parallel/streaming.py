@@ -18,6 +18,7 @@ import time
 import numpy as np
 
 from celestia_app_tpu.da import eds as eds_mod
+from celestia_app_tpu.obs import xfer
 from celestia_app_tpu.utils import telemetry
 
 
@@ -29,8 +30,6 @@ def _fetch_pending(pending):
     benchable. A fetch that arrives before the device finished counts
     ``streaming.overlap_stalls`` — the host outran the device, so the
     pipeline is device-bound there."""
-    import jax
-
     ready = getattr(pending[3], "is_ready", None)
     if ready is not None:
         try:
@@ -41,7 +40,7 @@ def _fetch_pending(pending):
             # stall counter just degrades to "unknown" there
             telemetry.incr("streaming.readiness_unsupported")
     t0 = telemetry.start_timer()
-    out = jax.device_get(pending)
+    out = xfer.to_host(pending, "streaming.fetch")
     telemetry.measure_since("streaming.fetch", t0)
     return out
 
@@ -55,8 +54,6 @@ def stream_blocks(layout_fn, n_blocks: int, k: int, *, pipeline=None):
     ``streaming.blocks_in_flight`` gauges the pipeline depth (1 while a
     dispatch is outstanding); see `_fetch_pending` for the fetch-side
     counters."""
-    import jax
-
     if n_blocks <= 0:
         return []
     run = pipeline if pipeline is not None else eds_mod.jitted_pipeline(k)
@@ -64,7 +61,8 @@ def stream_blocks(layout_fn, n_blocks: int, k: int, *, pipeline=None):
     pending = None
     for i in range(n_blocks):
         ods = layout_fn(i)  # host: lay out block i
-        out = run(jax.device_put(ods))  # device: async dispatch
+        # device: async dispatch (upload counted by the transfer ledger)
+        out = run(xfer.to_device(ods, "streaming.dispatch"))
         telemetry.gauge("streaming.blocks_in_flight", 1)
         if pending is not None:
             roots.append(bytes(_fetch_pending(pending)[3]))  # block on i-1
@@ -145,7 +143,8 @@ def bench_stream_mesh(k: int | None = None, n_batches: int = 3,
         )
 
     warm = layout(0)
-    np.asarray(run(warm)[3])  # fetch: block_until_ready lies on the relay
+    # fetch: block_until_ready lies on the relay
+    xfer.to_host(run(warm)[3], "streaming.warm")
     t0 = time.perf_counter()
     roots = stream_blocks_mesh(layout, n_batches, mesh, k, pipeline=run)
     dt = time.perf_counter() - t0
@@ -177,14 +176,15 @@ def bench_stream_batched(k: int | None = None, batch: int = 4,
     jitted = eds_mod.jitted_pipeline_batched(k)
 
     def run(batch_arr):
-        return jitted(jax.device_put(batch_arr))
+        return jitted(xfer.to_device(batch_arr, "streaming.dispatch"))
 
     def layout(i: int):
         return np.stack(
             [_synthetic_layout(k, i * batch + j) for j in range(batch)]
         )
 
-    np.asarray(run(layout(0))[3])  # warm the compile (fetch: see bench.py)
+    # warm the compile out of the measurement (fetch: see bench.py)
+    xfer.to_host(run(layout(0))[3], "streaming.warm")
     t0 = time.perf_counter()
     roots = _stream_batches(layout, n_batches, run)
     dt = time.perf_counter() - t0
@@ -215,7 +215,8 @@ def bench_stream(k: int | None = None, n_blocks: int = 6) -> dict:
     # warm the compile out of the measurement (root FETCH, not
     # block_until_ready — the latter is a no-op on the axon relay)
     warm = _synthetic_layout(k, 0)
-    np.asarray(run(jax.device_put(warm))[3])
+    xfer.to_host(run(xfer.to_device(warm, "streaming.dispatch"))[3],
+                 "streaming.warm")
 
     # serial attribution: host layout cost, device cost
     t0 = time.perf_counter()
@@ -223,7 +224,8 @@ def bench_stream(k: int | None = None, n_blocks: int = 6) -> dict:
     host_ms = (time.perf_counter() - t0) * 1000 / n_blocks
     t0 = time.perf_counter()
     for ods in layouts:
-        np.asarray(run(jax.device_put(ods))[3])
+        xfer.to_host(run(xfer.to_device(ods, "streaming.dispatch"))[3],
+                     "streaming.fetch")
     device_ms = (time.perf_counter() - t0) * 1000 / n_blocks
 
     # streamed: layout of block i+1 overlaps device work on block i

@@ -469,6 +469,10 @@ class NodeService:
 
         self.httpd = Server((host, port), Handler)
         self.port = self.httpd.server_address[1]
+        # GIL-pressure sampler for this serving plane (no-op unless
+        # CELESTIA_OBS is on): gil.pressure{service="node"} in /metrics
+        from celestia_app_tpu.obs import gil
+        gil.start("node")
 
     def serve_background(self) -> threading.Thread:
         th = threading.Thread(target=self.httpd.serve_forever, daemon=True)

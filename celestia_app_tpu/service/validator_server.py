@@ -438,6 +438,10 @@ class ValidatorService:
 
         self.httpd = Server((host, port), Handler)
         self.port = self.httpd.server_address[1]
+        # GIL-pressure sampler for this serving plane (no-op unless
+        # CELESTIA_OBS is on): gil.pressure{service="validator"}
+        from celestia_app_tpu.obs import gil
+        gil.start("validator")
 
     # -- handlers (under self.lock) --------------------------------------
 
@@ -490,6 +494,12 @@ class ValidatorService:
                 "statesync_errors": self.reactor.statesync_errors,
                 "blocksync_fetch_errors":
                     self.reactor.blocksync_fetch_errors,
+                # boundary observatory: ledger bytes the LAST committed
+                # block moved across the host<->device boundary —
+                # ROADMAP item 2's per-block gauge, beside the round
+                # state an operator already watches
+                "host_bytes_crossed_per_block":
+                    v.app.last_host_bytes_crossed,
             }
             out["mempool_gossip"] = dict(self.reactor.mempool_gossip.stats)
             # per-peer transport health: breaker state, success/failure

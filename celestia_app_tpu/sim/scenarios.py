@@ -262,6 +262,8 @@ def _install_ops(sim: Simulation) -> dict:
             sim.at(t, join, f"op.statesync_join val={idx}")
         elif name == "crash_storm":
             _install_crash_storm(sim, op, expect)
+        elif name == "slo":
+            _install_slo(sim, op, expect)
         else:
             raise ValueError(f"unknown scenario op {name!r}")
     return expect
@@ -306,6 +308,33 @@ def _install_spam(sim: Simulation, op: dict, expect: dict) -> None:
         return {"spam": {**{k: state[k] for k in
                             ("sent", "rejected", "admitted")},
                          "pool_rejected": pool_rejected}}
+
+    expect["collectors"].append(collect)
+
+
+def _install_slo(sim: Simulation, op: dict, expect: dict) -> None:
+    """Fleet-wide SLO judging inside the scenario plane
+    (tools/fleetmon.py): the op carries its rule list inline
+    ({"op": "slo", "rules": [...]}, FORMATS §22.1), the telemetry
+    registry is baselined at install time, and the verdict evaluates the
+    RUN'S DELTA — counters accumulated by earlier cells in the same
+    process never leak into this scenario's verdict. The whole verdict
+    joins `verdict_of`, so rules here should pin sim-deterministic
+    families (counters, count/sum of deterministic histograms); latency
+    quantile budgets belong to the HTTP fleetmon against a live devnet,
+    where verdict bytes are compared per fleet STATE, not per seed."""
+    from celestia_app_tpu.tools import fleetmon
+    from celestia_app_tpu.utils import telemetry
+
+    rules = fleetmon.normalize_rules(op.get("rules") or [])
+    base = telemetry.export()
+
+    def collect(s: Simulation) -> dict:
+        node = fleetmon.registry_node(base=base)
+        verdict = fleetmon.evaluate(rules, {"nodes": {"sim": node}})
+        s.sched.note(f"op.slo pass={verdict['pass']} "
+                     f"failed={len(verdict['failed'])}")
+        return {"slo": verdict}
 
     expect["collectors"].append(collect)
 

@@ -18,14 +18,38 @@ get coverage of every lock in the package from the first import. The
 chaos and stress tier-1 tests run under the flag and assert
 ``violations() == []`` at teardown.
 
-This module must stay dependency-free (it is imported from the package
-root before anything else).
+**Contention profiling (the boundary observatory).** The same wrapper
+is the process's only chokepoint that sees every acquire, so it doubles
+as the lock-contention profiler (``set_profiling(True)``, env
+``CELESTIA_LOCKPROF=1``). The hot path is kept nearly free: every
+outermost acquire first tries a non-blocking acquire — success means
+zero wait, and only two plain dict/float updates under the module's raw
+state lock happen (no telemetry, no clock beyond the hold stamp).
+Only a CONTENDED acquire (the try failed) measures its wait and records
+it into the per-creation-site histogram ``lock.wait{site=…}`` — so the
+p50/p99 in /metrics are quantiles of the waits that actually blocked,
+which is the number contention triage needs. Hold times and totals
+aggregate locally per site and are published at scrape time by a
+registered collector as gauges: ``lock.acquires{site=…}``,
+``lock.contended{site=…}``, ``lock.hold_s{site=…}`` (last) and
+``lock.hold_max_s{site=…}``. Profiling and ABBA order-tracking are
+independent gates (``set_order_tracking``): a bench can profile
+contention without paying the frame-walking order bookkeeping, and
+vice versa. Recording routes through utils/telemetry, whose registry
+lock is itself tracked when installed early — a thread-local
+``in_prof`` flag breaks that recursion (the registry's own lock is
+never profiled).
+
+This module must stay dependency-free at import (it is imported from
+the package root before anything else); telemetry is imported lazily,
+on the first profiled acquire.
 """
 
 from __future__ import annotations
 
 import os
 import threading
+import time
 
 _orig_lock = threading.Lock
 _orig_rlock = threading.RLock
@@ -37,6 +61,101 @@ _edges: dict[tuple[str, str], dict] = {}   # (site_a, site_b) -> evidence
 _violations: list[dict] = []
 _installed = False
 _tls = threading.local()
+
+# independent gates over the shared wrapper: ABBA order bookkeeping
+# (the original racecheck) vs wait/hold telemetry (the observatory)
+_track_order = True
+_profile = False
+_telemetry = None  # lazily-imported utils.telemetry module
+
+
+def _tele():
+    global _telemetry
+    if _telemetry is None:
+        from celestia_app_tpu.utils import telemetry
+
+        _telemetry = telemetry
+    return _telemetry
+
+
+def set_profiling(value: bool) -> None:
+    """Gate the wait/hold telemetry on tracked locks (affects existing
+    wrappers immediately; pair with `install()` so locks ARE wrapped).
+    Enabling also registers the scrape-time collector that publishes
+    the locally-aggregated per-site stats as gauges."""
+    global _profile
+    _profile = bool(value)
+    if _profile:
+        try:
+            _tele().register_collector(_publish_lock_stats)
+        except Exception:
+            pass  # headless installs without the registry still profile
+
+
+def profiling() -> bool:
+    return _profile
+
+
+def set_order_tracking(value: bool) -> None:
+    """Gate the ABBA order bookkeeping (frame walk per outermost
+    acquire) — profiling-only installs turn it off."""
+    global _track_order
+    _track_order = bool(value)
+
+
+def _record_wait(site: str, wait_s: float) -> None:
+    # in_prof breaks recursion: telemetry's own registry lock is a
+    # tracked lock when installed early, and recording through it must
+    # not re-enter the profiler
+    _tls.in_prof = True
+    try:
+        _tele().observe("lock.wait", wait_s, labels={"site": site})
+    except Exception:
+        pass  # profiling must never take down the locked path
+    finally:
+        _tls.in_prof = False
+
+
+# per-site local aggregates, published only at scrape time — the hot
+# path never touches the telemetry registry for these.
+# site -> [acquires, contended, hold_last_s, hold_max_s]
+_prof_stats: dict[str, list] = {}  # guarded-by: _state_lock
+
+
+def _prof_stat(site: str) -> list:
+    st = _prof_stats.get(site)
+    if st is None:
+        st = _prof_stats[site] = [0, 0, 0.0, 0.0]
+    return st
+
+
+def _publish_lock_stats() -> None:
+    """Telemetry collector: fold the local per-site aggregates into the
+    registry as gauges, once per scrape (not once per acquire)."""
+    _tls.in_prof = True
+    try:
+        tele = _tele()
+        with _state_lock:
+            snap = {site: list(st) for site, st in _prof_stats.items()}
+        for site, (acq, cont, last, mx) in snap.items():
+            labels = {"site": site}
+            tele.gauge("lock.acquires", acq, labels=labels)
+            tele.gauge("lock.contended", cont, labels=labels)
+            tele.gauge("lock.hold_s", last, labels=labels)
+            tele.gauge("lock.hold_max_s", mx, labels=labels)
+    except Exception:
+        pass  # a broken scrape must never take down the locked path
+    finally:
+        _tls.in_prof = False
+
+
+def prof_stats() -> dict:
+    """{site: {"acquires", "contended", "hold_last_s", "hold_max_s"}} —
+    the local aggregates, for tests and in-process consumers."""
+    with _state_lock:
+        return {site: {"acquires": st[0], "contended": st[1],
+                       "hold_last_s": st[2], "hold_max_s": st[3]}
+                for site, st in _prof_stats.items()}
 
 
 def _site(depth_hint: int = 2) -> str:
@@ -153,19 +272,61 @@ class _TrackedLock:
     # -- the lock protocol ----------------------------------------------
 
     def acquire(self, blocking: bool = True, timeout: float = -1):
-        ok = self._race_inner.acquire(blocking, timeout)
+        # profile only the OUTERMOST acquire (RLock reentry never
+        # blocks), and never while recording a previous sample
+        prof = (_profile and self._depth() == 0
+                and not getattr(_tls, "in_prof", False))
+        if prof:
+            # fast path: an uncontended acquire succeeds the
+            # non-blocking try — zero wait, no clock pair, no telemetry
+            ok = self._race_inner.acquire(False)
+            contended = not ok
+            if contended and blocking:
+                t0 = time.perf_counter()
+                ok = self._race_inner.acquire(blocking, timeout)
+                if ok:
+                    _record_wait(self._race_site,
+                                 time.perf_counter() - t0)
+        else:
+            ok = self._race_inner.acquire(blocking, timeout)
         if ok:
-            if self._depth() == 0:
-                _note_acquire(self)
-            self._set_depth(self._depth() + 1)
+            d = self._depth()
+            if d == 0:
+                if prof:
+                    self._race_depth_tls.t_acq = time.perf_counter()
+                    self._race_depth_tls.contended = contended
+                if _track_order:
+                    _note_acquire(self)
+            self._set_depth(d + 1)
         return ok
+
+    def _flush_hold(self, t_acq: float) -> None:
+        """Fold one acquire/release pair into the local per-site stats
+        (one raw-lock take per pair; telemetry sees nothing here)."""
+        hold_s = time.perf_counter() - t_acq
+        contended = getattr(self._race_depth_tls, "contended", False)
+        self._race_depth_tls.contended = False
+        with _state_lock:
+            st = _prof_stat(self._race_site)
+            st[0] += 1
+            if contended:
+                st[1] += 1
+            st[2] = hold_s
+            if hold_s > st[3]:
+                st[3] = hold_s
 
     def release(self) -> None:
         d = self._depth()
+        t_acq = getattr(self._race_depth_tls, "t_acq", None) \
+            if d <= 1 else None
         self._race_inner.release()
         self._set_depth(max(0, d - 1))
         if d <= 1:
-            _note_release(self)
+            if _track_order:
+                _note_release(self)
+            if t_acq is not None:
+                self._race_depth_tls.t_acq = None
+                self._flush_hold(t_acq)
 
     def __enter__(self):
         self.acquire()
@@ -195,9 +356,17 @@ class _TrackedLock:
     def _release_save(self):
         inner_save = getattr(self._race_inner, "_release_save", None)
         d = self._depth()
+        # a cond.wait hands the lock back: close the hold interval here
+        # (the wait itself is deliberately NOT recorded as lock.wait —
+        # waiting on a condition is not mutex contention)
+        t_acq = getattr(self._race_depth_tls, "t_acq", None)
+        if t_acq is not None:
+            self._race_depth_tls.t_acq = None
+            self._flush_hold(t_acq)
         state = inner_save() if inner_save else self._race_inner.release()
         self._set_depth(0)
-        _note_release(self)
+        if _track_order:
+            _note_release(self)
         return (state, d)
 
     def _acquire_restore(self, saved) -> None:
@@ -208,7 +377,10 @@ class _TrackedLock:
             inner_restore(state)
         else:
             self._race_inner.acquire()
-        if d > 0:
+        if _profile and not getattr(_tls, "in_prof", False):
+            # hold resumes when cond.wait reacquires
+            self._race_depth_tls.t_acq = time.perf_counter()
+        if d > 0 and _track_order:
             _note_acquire(self)
         self._set_depth(d)
 
@@ -265,6 +437,10 @@ def enabled_by_env() -> bool:
     return os.environ.get("CELESTIA_RACE", "").strip() == "1"
 
 
+def profile_enabled_by_env() -> bool:
+    return os.environ.get("CELESTIA_LOCKPROF", "").strip() == "1"
+
+
 def violations() -> list[dict]:
     with _state_lock:
         return [dict(v) for v in _violations]
@@ -279,3 +455,4 @@ def reset() -> None:
     with _state_lock:
         _edges.clear()
         _violations.clear()
+        _prof_stats.clear()

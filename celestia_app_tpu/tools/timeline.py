@@ -11,10 +11,19 @@ structure.
 
 Library surface (used by tests and the CLI `timeline` command):
   scrape(urls)                 {node_label: [span rows]} over HTTP
+  scrape_xfers(urls)           {node_label: [transfer ledger rows]}
   merge_spans(rows_by_node)    {trace_id: [rows tagged with "node"]}
   heights_of(merged)           {height: trace_id} for rows carrying one
   render_waterfall(rows)       the text waterfall for one trace
   collect(urls, height=None)   scrape + merge (+ height filter)
+
+The boundary observatory's transfer ledger (obs/xfer.py) writes its
+rows into the same per-App trace tables under the ``xfer`` table,
+stamped with the covering span's trace id — `collect` scrapes them too
+(``/trace/xfer``) and folds each one into its height's waterfall as a
+leaf named ``xfer:<site> <dir> <bytes>B`` under the span that covered
+the transfer, so a block's host↔device traffic renders inline with its
+compute spans (and rides the --json dump for machine consumers).
 
 The renderer needs only row dicts — in-process TraceTables output works
 the same as scraped JSON, so a light node that serves no HTTP (an
@@ -27,6 +36,7 @@ from __future__ import annotations
 import json
 
 from celestia_app_tpu.obs import SPAN_TABLE
+from celestia_app_tpu.obs.xfer import XFER_TABLE
 
 BAR_WIDTH = 40
 
@@ -55,6 +65,43 @@ def scrape(urls: list[str], since: int = 0,
         except (OSError, ValueError, KeyError):
             out[label] = []
     return out
+
+
+def fetch_node_xfers(url: str, since: int = 0, limit: int = 10_000,
+                     client=None) -> list[dict]:
+    """Pull one node's transfer-ledger rows (obs/xfer.py) over HTTP."""
+    from celestia_app_tpu.net import transport
+
+    client = client or transport.DEFAULT
+    doc = client.get(url.rstrip("/"),
+                     f"/trace/{XFER_TABLE}?since={since}&limit={limit}")
+    return list(doc.get("rows", []))
+
+
+def scrape_xfers(urls: list[str], since: int = 0,
+                 limit: int = 10_000) -> dict[str, list[dict]]:
+    """{node_label: ledger rows}; unreachable nodes yield []."""
+    out: dict[str, list[dict]] = {}
+    for url in urls:
+        label = url.rstrip("/")
+        try:
+            out[label] = fetch_node_xfers(url, since=since, limit=limit)
+        except (OSError, ValueError, KeyError):
+            out[label] = []
+    return out
+
+
+def _xfer_as_span_row(row: dict) -> dict:
+    """A ledger row shaped like a leaf span: named by call site + bytes,
+    parented (via parent_id) under the span that covered the transfer.
+    It carries no span_id — the renderer indents it one level below its
+    parent."""
+    return {
+        **row,
+        "table": XFER_TABLE,
+        "name": (f"xfer:{row.get('site', '?')} {row.get('dir', '?')} "
+                 f"{int(row.get('bytes', 0))}B"),
+    }
 
 
 def merge_spans(rows_by_node: dict[str, list[dict]]) -> dict[str, list[dict]]:
@@ -111,6 +158,15 @@ def render_waterfall(rows: list[dict], width: int = BAR_WIDTH) -> str:
                 for r in rows)
     total_s = max(t_end - t0, 1e-9)
     depths = _depths(rows)
+
+    def row_depth(row: dict) -> int:
+        sid = row.get("span_id")
+        if sid in depths:
+            return depths[sid]
+        # ledger rows (and any span-id-less leaf): one level below the
+        # parent span that covered them; orphans sit at the root
+        return depths.get(row.get("parent_id"), -1) + 1
+
     tid = rows[0].get("trace_id", "?")
     heights = {r["height"] for r in rows if isinstance(r.get("height"), int)}
     head = f"trace {tid}"
@@ -119,13 +175,13 @@ def render_waterfall(rows: list[dict], width: int = BAR_WIDTH) -> str:
     lines = [head,
              f"{'offset':>10}  {'dur':>9}  span"]
     for row in sorted(rows, key=lambda r: (r.get("start_unix", 0.0),
-                                           depths.get(r.get("span_id"), 0))):
+                                           row_depth(r))):
         off_s = row.get("start_unix", 0.0) - t0
         dur_s = row.get("dur_ms", 0.0) / 1e3
         lo = min(int(off_s / total_s * width), width - 1)
         hi = min(max(int((off_s + dur_s) / total_s * width), lo + 1), width)
         bar = " " * lo + "#" * (hi - lo) + " " * (width - hi)
-        indent = "  " * depths.get(row.get("span_id"), 0)
+        indent = "  " * row_depth(row)
         name = row.get("name", "?")
         node = row.get("node", "")
         lines.append(
@@ -137,10 +193,19 @@ def render_waterfall(rows: list[dict], width: int = BAR_WIDTH) -> str:
 
 
 def collect(urls: list[str], height: int | None = None,
-            since: int = 0, limit: int = 10_000) -> dict:
+            since: int = 0, limit: int = 10_000,
+            xfers: bool = True) -> dict:
     """Scrape + merge a devnet; optionally keep only the given height's
-    trace. Returns {"traces": {trace_id: rows}, "heights": {h: tid}}."""
-    merged = merge_spans(scrape(urls, since=since, limit=limit))
+    trace. With `xfers` (default) the transfer-ledger rows of every node
+    join their heights' traces as leaf rows (table == "xfer").
+    Returns {"traces": {trace_id: rows}, "heights": {h: tid}}."""
+    rows_by_node = scrape(urls, since=since, limit=limit)
+    if xfers:
+        for node, xrows in scrape_xfers(urls, since=since,
+                                        limit=limit).items():
+            rows_by_node[node] = (rows_by_node.get(node, [])
+                                  + [_xfer_as_span_row(r) for r in xrows])
+    merged = merge_spans(rows_by_node)
     heights = heights_of(merged)
     if height is not None:
         tid = heights.get(height)
@@ -176,9 +241,12 @@ def main(argv=None) -> int:
                     help="render the N most recent heights (text mode)")
     ap.add_argument("--json", action="store_true",
                     help="dump the merged span rows as JSON instead")
+    ap.add_argument("--no-xfer", action="store_true",
+                    help="skip the transfer-ledger rows (/trace/xfer)")
     args = ap.parse_args(argv)
     doc = collect([u for u in args.nodes.split(",") if u],
-                  height=args.height, since=args.since, limit=args.limit)
+                  height=args.height, since=args.since, limit=args.limit,
+                  xfers=not args.no_xfer)
     if args.json:
         print(json.dumps(doc, indent=2, sort_keys=True))
     else:

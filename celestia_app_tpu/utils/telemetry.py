@@ -199,6 +199,23 @@ class Registry:
             }
         return out
 
+    def export(self) -> dict:
+        """Raw registry state for in-process metric consumers (the SLO
+        engine's sim adapter, tools/fleetmon.py): counters/gauges by
+        series key, timers WITH their bucket arrays, and the
+        series-key -> (family, labels) map. snapshot() serves human
+        surfaces and drops the buckets; this keeps them so a consumer
+        can diff two exports and compute quantiles over the delta."""
+        self._collect()
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "timers": {k: {**v, "buckets": list(v["buckets"])}
+                           for k, v in self.timers.items()},
+                "series": dict(self._series),
+            }
+
     def reset(self) -> None:
         with self._lock:
             self.counters.clear()
@@ -364,6 +381,7 @@ observe = _global.observe
 measure_since = _global.measure_since
 quantiles = _global.quantiles
 snapshot = _global.snapshot
+export = _global.export
 prometheus = _global.prometheus
 reset = _global.reset
 set_help = _global.set_help
