@@ -898,16 +898,24 @@ def measure_analyze(reps: int = 3) -> None:
     Budget: < 10 s cold on CPU (pure-AST work). One BENCH JSON line:
 
       {"metric": "analyze_wall_s", ...,
-       "analyze_cold_wall_s": F, "analyze_warm_wall_s": F}
+       "analyze_cold_wall_s": F, "analyze_warm_wall_s": F,
+       "analyze_effects_cold_wall_s": F, "analyze_effects_warm_wall_s": F}
+
+    The effect pass (ISSUE 20: xfer-reach + lock-order +
+    guarded-by-flow over the SCC summary fixpoint) is timed separately
+    with its own cold/warm pair and the same warm ≤ cold/3 gate — the
+    fragment cache must absorb the v4 effect facts too.
     """
     import os
     import tempfile
 
     from celestia_app_tpu.tools.analyze import run_analysis
 
+    effect_rules = {"xfer-reach", "lock-order", "guarded-by-flow"}
     cache_path = os.path.join(tempfile.gettempdir(),
                               f"analyze_bench_cache_{os.getpid()}.json")
     best_cold = best_warm = None
+    best_ecold = best_ewarm = None
     rep = None
     try:
         for _ in range(reps):
@@ -920,6 +928,17 @@ def measure_analyze(reps: int = 3) -> None:
                          else min(best_cold, cold.wall_s))
             best_warm = (warm.wall_s if best_warm is None
                          else min(best_warm, warm.wall_s))
+        for _ in range(reps):
+            if os.path.exists(cache_path):
+                os.unlink(cache_path)
+            ecold = run_analysis(cache=cache_path,
+                                 only_rules=set(effect_rules))
+            ewarm = run_analysis(cache=cache_path,
+                                 only_rules=set(effect_rules))
+            best_ecold = (ecold.wall_s if best_ecold is None
+                          else min(best_ecold, ecold.wall_s))
+            best_ewarm = (ewarm.wall_s if best_ewarm is None
+                          else min(best_ewarm, ewarm.wall_s))
     finally:
         if os.path.exists(cache_path):
             os.unlink(cache_path)
@@ -929,6 +948,8 @@ def measure_analyze(reps: int = 3) -> None:
         "analyze_cold_wall_s": round(best_cold, 3),
         "analyze_warm_wall_s": round(best_warm, 3),
         "warm_speedup": round(best_cold / max(best_warm, 1e-9), 1),
+        "analyze_effects_cold_wall_s": round(best_ecold, 3),
+        "analyze_effects_warm_wall_s": round(best_ewarm, 3),
         "files_scanned": rep.files_scanned,
         "rules_run": len(rep.rules_run),
         "violations": len(rep.violations),
@@ -937,6 +958,7 @@ def measure_analyze(reps: int = 3) -> None:
         "budget_s": 10.0,
         "within_budget": best_cold < 10.0,
         "warm_within_third": best_warm <= best_cold / 3.0,
+        "effects_warm_within_third": best_ewarm <= best_ecold / 3.0,
     }))
 
 
@@ -3043,9 +3065,10 @@ MODES = {
              "read plane: batched vs per-request namespace resolution "
              "+ static blob packs under concurrent followers"),
     "analyze": (measure_analyze,
-                "analyze_cold_wall_s, analyze_warm_wall_s",
-                "full-tree static analysis (call-graph taint included) "
-                "cold vs incremental-cache warm"),
+                "analyze_cold_wall_s, analyze_warm_wall_s, "
+                "analyze_effects_cold_wall_s, analyze_effects_warm_wall_s",
+                "full-tree static analysis (call-graph taint + effect "
+                "system) cold vs incremental-cache warm"),
     "obs": (measure_obs, "obs_overhead_pct",
             "observability overhead on the produce-block path"),
     "slo": (measure_slo,

@@ -2137,6 +2137,15 @@ def cmd_analyze(args) -> int:
         print(scopes_report(rep.program,
                             config if config else load_config()))
         return 1 if rep.errors else 0
+    if args.effects:
+        from celestia_app_tpu.tools.analyze.effects import describe_symbol
+
+        if rep.program is None:
+            print("analyze: --effects needs the interprocedural rules "
+                  "enabled (they link the call graph)", file=sys.stderr)
+            return 2
+        print(describe_symbol(rep.program, args.effects))
+        return 1 if rep.errors else 0
     if args.changed:
         changed = _git_changed_package_files(rep.root)
         if changed is None:
@@ -2624,6 +2633,13 @@ def main(argv=None) -> int:
                    help="report only violations in files changed vs "
                         "git HEAD (dev loop; the full tree still "
                         "feeds the call graph)")
+    p.add_argument("--effects", metavar="QUALNAME", default=None,
+                   help="print one symbol's computed effect summary "
+                        "(nearest unledgered host sink with its path, "
+                        "transitive lock acquisitions, required-held "
+                        "locks, escaping exceptions) instead of "
+                        "violations; accepts path.py::Qual.name or a "
+                        "unique ::symbol suffix")
     p.add_argument("--no-cache", action="store_true",
                    help="disable the per-file incremental result cache "
                         "(.analyze_cache.json)")

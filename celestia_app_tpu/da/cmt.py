@@ -120,7 +120,8 @@ class CmtCommitments:
 def _hash_symbols(symbols: np.ndarray, engine: str) -> np.ndarray:
     """(n, S) u8 -> (n, 32) u8 sha256 digests, engine-gated (vmapped
     device SHA-256 vs hashlib over memoryview slices), bit-identical."""
-    symbols = np.ascontiguousarray(symbols, dtype=np.uint8)
+    # host coded symbols (np.concatenate output), never a device value
+    symbols = np.ascontiguousarray(symbols, dtype=np.uint8)  # lint: disable=xfer-reach
     if engine == "auto" and not ldpc.auto_wants_device():
         # CPU "auto": OpenSSL SHA-NI via hashlib beats the jnp scan path
         # by far (same gating reasoning as ops/ldpc.auto_wants_device)
@@ -129,11 +130,13 @@ def _hash_symbols(symbols: np.ndarray, engine: str) -> np.ndarray:
         return fast_host._sha_many(symbols)
     if engine in ("device", "auto"):
         try:
-            import jax.numpy as jnp
-
+            from celestia_app_tpu.obs import xfer
             from celestia_app_tpu.ops import sha256 as sha_mod
 
-            return np.asarray(sha_mod.sha256(jnp.asarray(symbols)))
+            return xfer.to_host(
+                sha_mod.sha256(
+                    xfer.to_device(symbols, "cmt.hash_symbols")),
+                "cmt.hash_symbols")
         except Exception:
             if engine == "device":
                 raise
@@ -188,7 +191,9 @@ def build_layers(ods: np.ndarray,
     """The encode pipeline: ODS -> CmtEntry. Layer j's coded symbols are
     [data || ldpc parity]; its hash list feeds layer j+1's data."""
     k = ods.shape[0]
-    data = np.ascontiguousarray(ods, dtype=np.uint8).reshape(
+    # the ODS argument is host bytes by codec contract (admission hands
+    # the encode pipeline numpy shares)
+    data = np.ascontiguousarray(ods, dtype=np.uint8).reshape(  # lint: disable=xfer-reach
         k * k, appconsts.SHARE_SIZE)
     layers: list[np.ndarray] = []
     hash_lists: list[np.ndarray] = []

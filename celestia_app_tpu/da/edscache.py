@@ -192,8 +192,10 @@ class EdsCacheEntry:
         (r,c)->(c,r)), so a col-axis prover is a row prover over this
         pair. The ONE copy of the construction both col-prover builds
         (base and device-resident) share."""
+        # the HOST entry class: self.eds.squares is numpy here (the
+        # device-resident twin overrides the col-prover path wholesale)
         eds_t = ExtendedDataSquare(
-            np.ascontiguousarray(np.swapaxes(self.eds.squares, 0, 1))
+            np.ascontiguousarray(np.swapaxes(self.eds.squares, 0, 1))  # lint: disable=xfer-reach
         )
         dah_t = DataAvailabilityHeader(
             row_roots=self.dah.col_roots,

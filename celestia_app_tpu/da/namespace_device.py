@@ -90,9 +90,16 @@ def leaf_namespaces(prover) -> np.ndarray:
     read straight off the prover's resident level-0 ``mins`` (an NMT
     leaf's min IS its namespace) — no ODS materialization, which on a
     mesh DeviceEntry would cost a device→host crossing."""
+    from celestia_app_tpu.obs import xfer
+
     mins = prover.levels[0][0]
     k = prover.k
-    return np.ascontiguousarray(mins[:k, :k].reshape(k * k, NS))
+    # a mesh DeviceEntry keeps `mins` resident: the k×k corner crosses
+    # the boundary counted; host provers pass through copy-free
+    sub = xfer.ensure_host(mins[:k, :k], "namespace.leaf_mins")
+    # reshape of the strided corner always lands in fresh C-order
+    # memory (and a materialized device slice is already contiguous)
+    return sub.reshape(k * k, NS)
 
 
 # -- the batched search -----------------------------------------------------

@@ -55,13 +55,23 @@ class BlockProver:
         self.eds = eds
         self.dah = dah
         self.k = eds.width // 2
+        from celestia_app_tpu.obs import xfer
+
         if levels is None:
-            levels = _jitted_row_levels(self.k)(jnp.asarray(eds.squares))
+            levels = xfer.to_host(
+                _jitted_row_levels(self.k)(
+                    xfer.to_device(eds.squares, "proof.row_levels")),
+                "proof.row_levels")
         # [(mins, maxs, vs)] with node counts 2k, k, ..., 1 per row tree;
         # `levels` may be precomputed on the host (utils/fast_host
-        # nmt_levels_fast) by engines that must not touch jax
+        # nmt_levels_fast) by engines that must not touch jax — only a
+        # device-resident level crosses the boundary, and it crosses
+        # counted (obs.xfer.ensure_host)
         self.levels = [
-            (np.asarray(m), np.asarray(x), np.asarray(v)) for m, x, v in levels
+            (xfer.ensure_host(m, "proof.levels"),
+             xfer.ensure_host(x, "proof.levels"),
+             xfer.ensure_host(v, "proof.levels"))
+            for m, x, v in levels
         ]
         all_roots = list(dah.row_roots) + list(dah.col_roots)
         _, self._root_proofs = merkle_host.proofs_from_leaves(all_roots)

@@ -174,9 +174,9 @@ def to_device(value, site: str, *, placement=None):
     t0 = telemetry.start_timer()
     with _explicit():
         if placement is not None:
-            out = jax.device_put(value, placement)
+            out = jax.device_put(value, placement)  # xfer: ledger
         else:
-            out = jax.device_put(value)
+            out = jax.device_put(value)  # xfer: ledger
     _account("h2d", site, nbytes_of(value), t0)
     return out
 
@@ -188,9 +188,26 @@ def to_host(value, site: str):
 
     t0 = telemetry.start_timer()
     with _explicit():
-        out = jax.device_get(value)
+        out = jax.device_get(value)  # xfer: ledger
     _account("d2h", site, nbytes_of(out), t0)
     return out
+
+
+def ensure_host(value, site: str):
+    """Materialize-if-device: a device value comes back through the
+    counted d2h path (`to_host`, attributed to `site`); anything
+    already host passes through ``np.asarray`` unchanged and counts
+    NOTHING — the helper for boundary-normalization call sites whose
+    inputs are only sometimes device-resident (a fake ledger row for a
+    zero-copy host read would be worse than none)."""
+    try:
+        import jax
+    except ImportError:
+        jax = None
+    if jax is not None and isinstance(value, jax.Array):
+        return to_host(value, site)
+    with _explicit():
+        return np.asarray(value)  # xfer: ledger
 
 
 # -- the residency pin -------------------------------------------------------

@@ -182,15 +182,17 @@ def auto_wants_device() -> bool:
 def encode(data: np.ndarray, engine: str = "auto", degree: int = DEGREE,
            tag: bytes = b"cmt") -> np.ndarray:
     """Engine-gated parity encode; both paths bit-identical."""
-    data = np.ascontiguousarray(data, dtype=np.uint8)
+    # host shares in, by contract (build_layers hands numpy symbols)
+    data = np.ascontiguousarray(data, dtype=np.uint8)  # lint: disable=xfer-reach
     if engine == "auto" and not auto_wants_device():
         return encode_host(data, degree=degree, tag=tag)
     if engine in ("device", "auto"):
         try:
-            import jax.numpy as jnp
+            from celestia_app_tpu.obs import xfer
 
             run = jitted_encode(data.shape[0], data.shape[1], degree, tag)
-            return np.asarray(run(jnp.asarray(data)))
+            return xfer.to_host(
+                run(xfer.to_device(data, "ldpc.encode")), "ldpc.encode")
         except Exception:
             if engine == "device":
                 raise

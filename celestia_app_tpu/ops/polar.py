@@ -377,15 +377,17 @@ def encode(data: np.ndarray, engine: str = "auto") -> np.ndarray:
     """Engine-gated committed-class encode; both paths bit-identical."""
     from celestia_app_tpu.ops import ldpc
 
-    data = np.ascontiguousarray(data, dtype=np.uint8)
+    # host shares in, by contract (build_layers hands numpy symbols)
+    data = np.ascontiguousarray(data, dtype=np.uint8)  # lint: disable=xfer-reach
     if engine == "auto" and not ldpc.auto_wants_device():
         return encode_host(data)
     if engine in ("device", "auto"):
         try:
-            import jax.numpy as jnp
+            from celestia_app_tpu.obs import xfer
 
             run = jitted_encode(data.shape[0], data.shape[1])
-            return np.asarray(run(jnp.asarray(data)))
+            return xfer.to_host(
+                run(xfer.to_device(data, "polar.encode")), "polar.encode")
         except Exception:
             if engine == "device":
                 raise

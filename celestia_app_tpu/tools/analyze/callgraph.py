@@ -13,6 +13,18 @@ half and re-derive only what changed:
    ``with self.<lock>`` frames with the calls made inside them, and
    the class table (methods, bases, attribute types).
 
+   Since v4 the fragment also carries the per-function **effect
+   facts** the bottom-up effect system (``effects.py``) folds into
+   transitive summaries: host↔device boundary sites (raw
+   ``jax.device_get``/``device_put``, the ``np.asarray`` family,
+   ``.item()``, and the counted ``obs.xfer`` helpers — a ``# xfer:
+   ledger`` line marker declares a raw site as ledger-internal), every
+   lock ``with``-frame with its lexical span, accesses to ``#
+   guarded-by:`` fields with their held/unheld verdict, raised
+   exception types with broad/narrow ``except`` shield spans, and a
+   file-level ``imports_jax`` flag (``np.asarray`` can only
+   materialize a device value in a file that can hold one).
+
 2. ``Program(fragments)`` — links descriptors into concrete edges
    against the global definition table. Resolution order:
 
@@ -45,6 +57,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import re
 
 from celestia_app_tpu.tools.analyze.engine import FileContext
 from celestia_app_tpu.tools.analyze.rules_determinism import (
@@ -54,8 +67,12 @@ from celestia_app_tpu.tools.analyze.rules_determinism import (
     _dict_iter_call,
     _HASH_FUNCS,
 )
+from celestia_app_tpu.tools.analyze.rules_locks import (
+    _guarded_attrs,
+    _holds_lock,
+)
 
-FRAGMENT_VERSION = 3
+FRAGMENT_VERSION = 4
 
 PACKAGE = "celestia_app_tpu"
 
@@ -99,6 +116,82 @@ _BLOCK_NET_PREFIX = ("urllib.request.", "http.client.", "subprocess.")
 # the passes can never disagree on which functions are jitted
 JIT_WRAPPERS = {"jax.jit", "jit", "pl.pallas_call", "jax.pmap"}
 _BLOCK_JIT = JIT_WRAPPERS
+
+# host↔device boundary sites (the ``xfer-reach`` effect facts).
+# d2h-raw / h2d-raw are uncounted crossings wherever they appear;
+# asarray / item only *can* materialize a device value in a file that
+# imports jax (the fragment's ``imports_jax`` flag — the rule checks
+# it); ledgered sites are the counted obs/xfer helpers and are what
+# every reachable crossing must route through.
+_XFER_D2H_RAW = {"jax.device_get"}
+_XFER_H2D_RAW = {"jax.device_put"}
+_XFER_ASARRAY = {"numpy.asarray", "numpy.array",
+                 "numpy.ascontiguousarray"}
+_XFER_LEDGERED = {
+    "celestia_app_tpu.obs.xfer.to_host",
+    "celestia_app_tpu.obs.xfer.to_device",
+    "celestia_app_tpu.obs.xfer.ensure_host",
+}
+# a raw site INSIDE the ledger implementation itself (obs/xfer.py's
+# own device_put/device_get/np.asarray) declares itself with this line
+# marker — the static twin of the runtime `_explicit()` thread flag
+_XFER_MARKER_RE = re.compile(r"#\s*xfer:\s*ledger\b")
+
+
+def _classify_xfer(name: str | None, attr: str | None,
+                   ) -> tuple[str, str] | None:
+    """(kind, what) when a resolved call is a host↔device boundary
+    site: a raw crossing, an asarray-family materialization, a host
+    sync, or one of the counted ledger helpers."""
+    if name is not None:
+        if name in _XFER_D2H_RAW:
+            return ("d2h-raw", name)
+        if name in _XFER_H2D_RAW:
+            return ("h2d-raw", name)
+        if name in _XFER_ASARRAY:
+            return ("asarray", name)
+        if name in _XFER_LEDGERED:
+            return ("ledgered", name.rsplit(".", 1)[-1])
+    if attr == "item":
+        return ("item", ".item")
+    return None
+
+
+def _imports_jax(ctx: FileContext) -> bool:
+    """True when the file imports jax anywhere (module level or inside
+    a function — the engine-gated lazy-import idiom counts)."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "jax" or a.name.startswith("jax.")
+                   for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "jax" or mod.startswith("jax."):
+                return True
+    return False
+
+
+def _broad_handler_types(handler: ast.ExceptHandler,
+                         ctx: FileContext) -> list[str]:
+    """The exception names a handler shields: ``["*"]`` for bare /
+    Exception / BaseException handlers, the resolved type names
+    otherwise (unresolvable entries are dropped — conservative: an
+    unknown handler shields nothing)."""
+    if handler.type is None:
+        return ["*"]
+    types = (handler.type.elts if isinstance(handler.type, ast.Tuple)
+             else [handler.type])
+    out: list[str] = []
+    for t in types:
+        name = ctx.resolve(t)
+        if name in ("Exception", "BaseException", "builtins.Exception",
+                    "builtins.BaseException"):
+            return ["*"]
+        if name is not None:
+            out.append(name.rsplit(".", 1)[-1])
+    return out
+
 
 # jit-impurity body findings (shared with rules_effects via
 # ``impure_findings`` below)
@@ -357,9 +450,11 @@ def build_fragment(ctx: FileContext) -> dict:
                         and isinstance(t.value, ast.Name)
                         and t.value.id == "self"):
                     attr_types[t.attr] = vname
-        classes[node.name] = {"bases": bases, "attr_types": attr_types}
+        classes[node.name] = {"bases": bases, "attr_types": attr_types,
+                              "guarded": _guarded_attrs(node, ctx)}
 
     jitted_nodes = jitted_fn_nodes(ctx)
+    file_imports_jax = _imports_jax(ctx)
 
     fn_nodes = [n for n in quals
                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
@@ -385,8 +480,16 @@ def build_fragment(ctx: FileContext) -> dict:
             "impure": impure_findings(fn, ctx, f"{qual}()"),
             "jitted": fn in jitted_nodes,
             "locks": [],
+            # effect facts (fragment v4; consumed by effects.py)
+            "xfer": [],      # [kind, line, what]
+            "frames": [],    # [lockname, is_self, start, end]
+            "guarded": [],   # [attr, lockname, line, held]
+            "raises": [],    # [exc name, line]
+            "shielded": [],  # [start, end, name-or-"*"]
         }
         lock_blocks: dict[tuple, dict] = {}
+        cls_guarded = (classes.get(cls_name, {}).get("guarded", {})
+                       if cls_name else {})
 
         def _lock_entry(frame):
             if frame not in lock_blocks:
@@ -405,6 +508,19 @@ def build_fragment(ctx: FileContext) -> dict:
                 continue
             if _enclosing_function(node, ctx, quals) is not fn:
                 continue
+            # guarded-field access (read or write): the guarded-by-flow
+            # seed facts — held is the LEXICAL verdict; the effect
+            # system supplies the interprocedural one. Checked BEFORE
+            # the call/ref dispatch: `self.X` attribute nodes
+            # short-circuit that chain with `continue`
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and node.attr in cls_guarded):
+                g_lock = cls_guarded[node.attr]
+                info["guarded"].append(
+                    [node.attr, g_lock, node.lineno,
+                     1 if _holds_lock(node, g_lock, ctx) else 0])
             if isinstance(node, ast.Call):
                 name = ctx.resolve(node.func)
                 attr = (node.func.attr
@@ -412,6 +528,15 @@ def build_fragment(ctx: FileContext) -> dict:
                 src = _classify_source(name)
                 if src is not None:
                     info["sources"].append([src[0], node.lineno, src[1]])
+                xf = _classify_xfer(name, attr)
+                if xf is not None:
+                    xkind, xwhat = xf
+                    if (xkind != "ledgered"
+                            and node.lineno <= len(ctx.lines)
+                            and _XFER_MARKER_RE.search(
+                                ctx.lines[node.lineno - 1])):
+                        xkind = "ledgered"
+                    info["xfer"].append([xkind, node.lineno, xwhat])
                 blk = _classify_blocking(name, attr)
                 frame = _lock_frame(node, ctx)
                 if blk is not None:
@@ -464,6 +589,38 @@ def build_fragment(ctx: FileContext) -> dict:
                                   ast.Assign)):
                         info["refs"].append(["local", node.id,
                                              node.lineno])
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                # every lock with-frame, with its lexical span — the
+                # effect system derives held-at-line sets and the
+                # static lock-acquisition graph from these
+                for item in node.items:
+                    e = item.context_expr
+                    lockname = None
+                    is_self = 0
+                    if (isinstance(e, ast.Attribute)
+                            and isinstance(e.value, ast.Name)
+                            and e.value.id == "self"):
+                        lockname, is_self = e.attr, 1
+                    elif isinstance(e, ast.Name):
+                        lockname = e.id
+                    if lockname is not None and "lock" in lockname.lower():
+                        info["frames"].append(
+                            [lockname, is_self, node.lineno,
+                             node.end_lineno or node.lineno])
+            elif isinstance(node, ast.Raise) and node.exc is not None:
+                exc = node.exc
+                ename = (ctx.resolve(exc.func)
+                         if isinstance(exc, ast.Call)
+                         else ctx.resolve(exc))
+                if ename:
+                    info["raises"].append(
+                        [ename.rsplit(".", 1)[-1], node.lineno])
+            elif isinstance(node, ast.Try):
+                lo = node.body[0].lineno
+                hi = node.body[-1].end_lineno or node.body[-1].lineno
+                for h in node.handlers:
+                    for tname in _broad_handler_types(h, ctx):
+                        info["shielded"].append([lo, hi, tname])
             # os.environ read outside a call (subscript / in-test)
             if (isinstance(node, ast.Attribute)
                     and ctx.resolve(node) == "os.environ"):
@@ -481,6 +638,7 @@ def build_fragment(ctx: FileContext) -> dict:
     return {
         "version": FRAGMENT_VERSION,
         "path": ctx.path,
+        "imports_jax": file_imports_jax,
         "functions": functions,
         "classes": classes,
         "pragmas": {str(k): sorted(v) for k, v in ctx.pragmas.items()},
@@ -504,6 +662,13 @@ class Node:
     blocking: list          # [kind, line, what]
     impure: list            # [line, col, msg]
     locks: list             # resolved at link time
+    # effect facts (fragment v4; consumed by effects.py)
+    cls: str | None = None      # enclosing class name (lock identity)
+    xfer: list = dataclasses.field(default_factory=list)
+    frames: list = dataclasses.field(default_factory=list)
+    guarded: list = dataclasses.field(default_factory=list)
+    raises_: list = dataclasses.field(default_factory=list)
+    shielded: list = dataclasses.field(default_factory=list)
 
 
 class Program:
@@ -519,6 +684,8 @@ class Program:
         self._classes: dict[tuple[str, str], dict] = {}
         # method name -> [node ids] (the attr-fallback index)
         self._by_method: dict[str, list[str]] = {}
+        # file path -> does it import jax (fragment v4 flag)
+        self.imports_jax: dict[str, bool] = {}
         self._link()
 
     # -- def tables ------------------------------------------------------
@@ -533,6 +700,7 @@ class Program:
     def _link(self) -> None:
         for path, frag in self.fragments.items():
             self._mods[self._module_of(path)] = path
+            self.imports_jax[path] = bool(frag.get("imports_jax"))
             for cname, cinfo in frag.get("classes", {}).items():
                 self._classes[(path, cname)] = cinfo
             for qual, info in frag.get("functions", {}).items():
@@ -545,6 +713,12 @@ class Program:
                     blocking=info.get("blocking", []),
                     impure=info.get("impure", []),
                     locks=[],
+                    cls=info.get("class"),
+                    xfer=info.get("xfer", []),
+                    frames=info.get("frames", []),
+                    guarded=info.get("guarded", []),
+                    raises_=info.get("raises", []),
+                    shielded=info.get("shielded", []),
                 )
                 parts = qual.split(".")
                 if len(parts) == 2:  # Class.method — the only shape
@@ -564,11 +738,14 @@ class Program:
                     out.extend(self._resolve(
                         path, qual, info, [kind, name, line],
                         ref=True))
+                # distinct (target, line) pairs: the effect system
+                # needs EVERY call line (a second call to the same
+                # helper outside a lock frame is a different fact)
                 seen = set()
                 uniq = []
                 for tgt, line in out:
-                    if tgt not in seen:
-                        seen.add(tgt)
+                    if (tgt, line) not in seen:
+                        seen.add((tgt, line))
                         uniq.append((tgt, line))
                 self.edges[nid] = uniq
                 node = self.nodes[nid]

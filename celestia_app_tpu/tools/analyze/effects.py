@@ -1,0 +1,691 @@
+"""The interprocedural effect system: whole-program residency,
+lock-order, and guarded-by proofs over the call graph.
+
+`callgraph.build_fragment` (v4) records per-function effect FACTS —
+host-materialization sites (`xfer`), lock with-frames with lexical
+spans (`frames`), guarded-attribute accesses (`guarded`), raise sites
+(`raises`) and try-shield spans (`shielded`). This module turns those
+facts into per-function effect SUMMARIES propagated bottom-up through
+a fixpoint over the SCC condensation of the linked call graph
+(`tarjan_sccs` emits components callees-first, so each summary is
+computed after everything it calls — cycles iterate to a fixpoint
+inside their component). The lattice per function:
+
+- ``host``  — the nearest UNLEDGERED host-materialization sink this
+  function can reach (hop distance + next-hop pointer, so the full
+  sink path reconstructs by chasing summaries). Sites routed through
+  ``obs.xfer`` (or carrying a ``# xfer: ledger`` marker) are exempt;
+  ``np.asarray``-family and ``.item()`` sinks only count in files that
+  import jax — elsewhere they cannot touch a device value.
+- ``acquires`` — every lock this function may take, directly or
+  transitively, with the first call hop toward the acquiring frame.
+- ``required`` — locks a callee path *assumes held*: a ``*_locked``
+  helper touching a ``# guarded-by:`` attribute without holding the
+  lock pushes the obligation to its callers; exempt (``*_locked``)
+  callers propagate it further, ``__init__`` clears it (happens-before
+  publication), anyone else must hold the lock at the call site.
+- ``escapes`` — exception type names that can escape, own raises plus
+  callee escapes, minus spans shielded by matching/broad handlers.
+
+Three rules consume the summaries (and the BFS the taint engine
+already provides):
+
+- **xfer-reach** — the static twin of the runtime transfer ledger:
+  any unledgered host-materialization sink reachable from the warmed
+  produce/serve roots in analyze.toml is an error with the full call
+  path. Allow entries are traversal BARRIERS (same semantics as
+  det-reach), so every entry is load-bearing and deletion-testable.
+- **lock-order** — the static ABBA detector: lock-acquisition edges
+  from lexical frame nesting and from held-frame × callee-acquires
+  summaries; every 2-lock cycle reports BOTH acquisition paths, and
+  larger strongly-connected lock sets report the whole component.
+  Known inversions live in the ``ledger`` option (shared with the
+  runtime racecheck waiver surface); unmatched ledger entries are
+  stale errors.
+- **guarded-by-flow** — interprocedural guarded-by: a call into a
+  path that assumes a lock held, from a function that cannot hold it,
+  is an error at the call line (the lexical rules_locks check only
+  sees the syntactic enclosing function).
+
+``analyze --effects <qualified-name>`` prints one symbol's computed
+summary (`describe_symbol`) with the reconstructed sink path.
+"""
+
+from __future__ import annotations
+
+import re
+
+from celestia_app_tpu.tools.analyze.config import AnalyzeConfig, RuleConfig
+from celestia_app_tpu.tools.analyze.engine import (
+    ProgramRule,
+    Violation,
+    _in_scope,
+    register,
+)
+from celestia_app_tpu.tools.analyze.taint import _barrier, _resolve_roots
+
+_SINK_KINDS = {
+    "d2h-raw": "raw jax.device_get",
+    "h2d-raw": "raw jax.device_put",
+    "asarray": "np.asarray-family host materialization",
+    "item": ".item() host sync",
+}
+# asarray/.item only materialize device bytes when the file can hold a
+# jax.Array at all — gating on the import kills the numpy-only noise
+_JAX_ONLY_KINDS = {"asarray", "item"}
+
+
+# ---------------------------------------------------------------------------
+# SCC condensation
+# ---------------------------------------------------------------------------
+
+
+def tarjan_sccs(program):
+    """Strongly connected components of the call graph, emitted in
+    REVERSE topological order (every component after all components it
+    calls into) — the natural order for bottom-up summary computation.
+    Iterative: the call graph is deeper than CPython's recursion
+    limit wants to be responsible for."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    onstack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = 0
+    for start in sorted(program.nodes):
+        if start in index:
+            continue
+        work: list[tuple[str, int]] = [(start, 0)]
+        while work:
+            nid, ei = work[-1]
+            if ei == 0:
+                index[nid] = low[nid] = counter
+                counter += 1
+                stack.append(nid)
+                onstack.add(nid)
+            edges = program.edges.get(nid, [])
+            advanced = False
+            while ei < len(edges):
+                tgt = edges[ei][0]
+                ei += 1
+                if tgt not in program.nodes:
+                    continue
+                if tgt not in index:
+                    work[-1] = (nid, ei)
+                    work.append((tgt, 0))
+                    advanced = True
+                    break
+                if tgt in onstack:
+                    low[nid] = min(low[nid], index[tgt])
+            if advanced:
+                continue
+            work.pop()
+            if low[nid] == index[nid]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    comp.append(w)
+                    if w == nid:
+                        break
+                sccs.append(sorted(comp))
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[nid])
+    return sccs
+
+
+# ---------------------------------------------------------------------------
+# summaries
+# ---------------------------------------------------------------------------
+
+
+class EffectSummary:
+    """One function's computed effects. ``host`` is the nearest
+    unledgered sink as (dist, sink_nid, sink_line, kind, what, via)
+    where ``via`` is the next-hop node id (None when the sink is an
+    own site); ``acquires`` maps lock id -> (dist, line, via);
+    ``required`` maps lock id -> (attr, line, via); ``escapes`` is the
+    set of escaping exception type names."""
+
+    __slots__ = ("host", "acquires", "required", "escapes")
+
+    def __init__(self):
+        self.host = None
+        self.acquires: dict[str, tuple] = {}
+        self.required: dict[str, tuple] = {}
+        self.escapes: set[str] = set()
+
+
+def _lock_id(node, lockname: str, is_self: int) -> str:
+    """Stable lock identity. ``self.<attr>`` locks key on the owning
+    class (two classes' ``_lock`` attributes are different locks);
+    bare-name locks are file-scoped."""
+    if is_self:
+        return f"{node.path}::{node.cls or 'self'}.{lockname}"
+    return f"{node.path}::{lockname}"
+
+
+def _is_exempt(qual: str) -> bool:
+    """Mirrors the lexical lock-guard exemption: any ``*_locked``
+    qualname component means 'my caller holds the lock'."""
+    return any(part.endswith("_locked") for part in qual.split("."))
+
+
+def _held_at(node, line: int, lock: str) -> bool:
+    """Does `node` lexically hold `lock` at `line`? Exact lock-id
+    match, plus a same-attribute relaxation for self-locks: a held
+    ``self._lock`` satisfies a required ``<AnyClass>._lock`` — through
+    inheritance it IS the same object, and the fragment cannot see
+    subclass relationships across files."""
+    want_attr = lock.rsplit(".", 1)[-1] if "." in lock.split("::")[-1] else None
+    for lockname, is_self, start, end in node.frames:
+        if not (start <= line <= end):
+            continue
+        if _lock_id(node, lockname, is_self) == lock:
+            return True
+        if is_self and want_attr is not None and lockname == want_attr:
+            return True
+    return False
+
+
+def _shielded(node, line: int, name: str) -> bool:
+    tail = name.rsplit(".", 1)[-1]
+    for lo, hi, t in node.shielded:
+        if lo <= line <= hi and (t == "*" or t.rsplit(".", 1)[-1] == tail):
+            return True
+    return False
+
+
+def _own_host_sinks(program, node):
+    jaxy = program.imports_jax.get(node.path, False)
+    out = []
+    for kind, line, what in node.xfer:
+        if kind == "ledgered":
+            continue
+        if kind in _JAX_ONLY_KINDS and not jaxy:
+            continue
+        out.append((0, node.id, int(line), kind, what, None))
+    return out
+
+
+def _host_key(cand):
+    return (cand[0], cand[1], cand[2], cand[3], cand[4], cand[5] or "")
+
+
+def _seed(program, summaries, nid) -> None:
+    node = program.nodes[nid]
+    s = summaries[nid] = EffectSummary()
+    own = _own_host_sinks(program, node)
+    if own:
+        s.host = min(own, key=_host_key)
+    for lockname, is_self, start, end in node.frames:
+        lock = _lock_id(node, lockname, is_self)
+        cand = (0, int(start), None)
+        cur = s.acquires.get(lock)
+        if cur is None or cand < (cur[0], cur[1], cur[2] or ""):
+            s.acquires[lock] = cand
+    if _is_exempt(node.qual):
+        for attr, lockname, line, held in node.guarded:
+            if held:
+                continue
+            lock = f"{node.path}::{node.cls or 'self'}.{lockname}"
+            cand = (attr, int(line), None)
+            cur = s.required.get(lock)
+            if cur is None or (cand[1], cand[0]) < (cur[1], cur[0]):
+                s.required[lock] = cand
+    for excname, line in node.raises_:
+        if not _shielded(node, int(line), excname):
+            s.escapes.add(excname.rsplit(".", 1)[-1])
+
+
+def _flow(program, summaries, nid) -> bool:
+    """Fold current callee summaries into `nid`'s; True if changed."""
+    node = program.nodes[nid]
+    s = summaries[nid]
+    changed = False
+    exempt = _is_exempt(node.qual)
+    is_init = node.qual.split(".")[-1] == "__init__"
+    for tgt, line in program.edges.get(nid, []):
+        t = summaries.get(tgt)
+        if t is None:
+            continue
+        if t.host is not None:
+            cand = (t.host[0] + 1, t.host[1], t.host[2],
+                    t.host[3], t.host[4], tgt)
+            if s.host is None or _host_key(cand) < _host_key(s.host):
+                s.host = cand
+                changed = True
+        for lock, (d, _bl, _via) in t.acquires.items():
+            cand = (d + 1, int(line), tgt)
+            cur = s.acquires.get(lock)
+            if cur is None or (cand[0], cand[1], cand[2] or "") < (
+                    cur[0], cur[1], cur[2] or ""):
+                s.acquires[lock] = cand
+                changed = True
+        if exempt and not is_init:
+            for lock, (attr, _al, _via) in t.required.items():
+                if _held_at(node, int(line), lock):
+                    continue
+                cand = (attr, int(line), tgt)
+                cur = s.required.get(lock)
+                if cur is None or (cand[1], cand[0]) < (cur[1], cur[0]):
+                    s.required[lock] = cand
+                    changed = True
+        for name in t.escapes:
+            if name not in s.escapes and not _shielded(
+                    node, int(line), name):
+                s.escapes.add(name)
+                changed = True
+    return changed
+
+
+def _required_chain(summaries, nid, lock) -> list[str]:
+    chain = [nid]
+    cur = nid
+    while True:
+        ent = summaries[cur].required.get(lock)
+        if ent is None or ent[2] is None:
+            break
+        cur = ent[2]
+        chain.append(cur)
+    return chain
+
+
+def _acquire_chain(summaries, nid, lock) -> list[str]:
+    chain = [nid]
+    cur = nid
+    while True:
+        ent = summaries[cur].acquires.get(lock)
+        if ent is None or ent[2] is None:
+            break
+        cur = ent[2]
+        chain.append(cur)
+    return chain
+
+
+def _host_chain(summaries, nid) -> list[str]:
+    chain = [nid]
+    cur = nid
+    while True:
+        h = summaries[cur].host
+        if h is None or h[5] is None:
+            break
+        cur = h[5]
+        chain.append(cur)
+    return chain
+
+
+def compute_summaries(program):
+    """(summaries, guarded_reports) — memoized on the program object so
+    the three effect rules and ``--effects`` share one fixpoint."""
+    cached = getattr(program, "_effect_summaries", None)
+    if cached is not None:
+        return cached
+    summaries: dict[str, EffectSummary] = {}
+    for nid in program.nodes:
+        _seed(program, summaries, nid)
+    for scc in tarjan_sccs(program):
+        while True:
+            changed = False
+            for nid in scc:
+                changed |= _flow(program, summaries, nid)
+            if not changed:
+                break
+    # the guarded-by obligation surfaces at the FIRST caller that can
+    # neither hold the lock nor push the obligation further up
+    reports = []
+    seen: set[tuple[str, str, str]] = set()
+    for nid in sorted(program.nodes):
+        node = program.nodes[nid]
+        if _is_exempt(node.qual) or node.qual.split(".")[-1] == "__init__":
+            continue
+        for tgt, line in sorted(program.edges.get(nid, []),
+                                key=lambda e: (e[1], e[0])):
+            t = summaries.get(tgt)
+            if t is None:
+                continue
+            for lock in sorted(t.required):
+                attr, _al, _via = t.required[lock]
+                if _held_at(node, int(line), lock):
+                    continue
+                key = (nid, lock, attr)
+                if key in seen:
+                    continue
+                seen.add(key)
+                reports.append({
+                    "path": node.path, "line": int(line),
+                    "caller": nid, "callee": tgt,
+                    "lock": lock, "attr": attr,
+                    "chain": [nid] + _required_chain(summaries, tgt, lock),
+                })
+    program._effect_summaries = (summaries, reports)
+    return summaries, reports
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+@register
+class XferReachRule(ProgramRule):
+    id = "xfer-reach"
+    help = ("host-materialization sinks (raw device_get/device_put, "
+            "np.asarray-family, .item()) reachable from the warmed "
+            "produce/serve roots must route through the counted "
+            "obs.xfer helpers — the static twin of the runtime "
+            "transfer ledger")
+
+    def check_program(self, program, config: AnalyzeConfig,
+                      rcfg: RuleConfig):
+        if not rcfg.options.get("roots"):
+            yield Violation(
+                rule=self.id, severity="error", path="analyze.toml",
+                line=0, col=0,
+                message=("xfer-reach is enabled but [rules.xfer-reach] "
+                         "configures no roots — an empty root set makes "
+                         "the residency proof a silent no-op"),
+            )
+            return
+        roots, missing = _resolve_roots(program, rcfg, self.id)
+        for v in missing:
+            yield v
+        visited, parents = program.reachable(roots, _barrier(rcfg.allow))
+        for nid in sorted(visited):
+            node = program.nodes[nid]
+            jaxy = program.imports_jax.get(node.path, False)
+            for kind, line, what in node.xfer:
+                if kind == "ledgered":
+                    continue
+                if kind in _JAX_ONLY_KINDS and not jaxy:
+                    continue
+                chain = program.call_path(parents, nid)
+                root_qual = program.nodes[chain[0]].qual
+                yield Violation(
+                    rule=self.id, severity="error", path=node.path,
+                    line=int(line), col=0,
+                    message=(f"{_SINK_KINDS.get(kind, kind)} ({what}) "
+                             f"in {node.qual}() is reachable from "
+                             f"warmed root {root_qual}() — route it "
+                             "through obs.xfer (to_device/to_host/"
+                             "ensure_host) so the transfer ledger "
+                             "counts it, or add a reasoned allow "
+                             "entry"),
+                    call_path=chain,
+                    effect={"kind": kind, "what": what,
+                            "sink": nid, "root": chain[0]},
+                )
+
+
+_LEDGER_RE = re.compile(
+    r"^\s*(?P<a>\S+)\s*<->\s*(?P<b>\S+)\s*:\s*(?P<reason>.+?)\s*$")
+
+
+def _lock_graph(program, summaries, rcfg):
+    """Lock-acquisition edges: (A, B) -> provenance of the first
+    deterministic witness that B can be acquired while A is held."""
+    edges: dict[tuple[str, str], dict] = {}
+
+    def record(a, b, prov):
+        if a != b and (a, b) not in edges:
+            edges[(a, b)] = prov
+
+    for nid in sorted(program.nodes):
+        node = program.nodes[nid]
+        if not node.frames or not _in_scope(node.path, rcfg):
+            continue
+        for lockname, is_self, start, end in node.frames:
+            a = _lock_id(node, lockname, is_self)
+            for ln2, is2, s2, e2 in node.frames:
+                if start < s2 and e2 <= end:
+                    record(a, _lock_id(node, ln2, is2),
+                           {"holder": nid, "frame_line": int(start),
+                            "line": int(s2), "callee": None})
+            for tgt, line in program.edges.get(nid, []):
+                if not (start <= line <= end):
+                    continue
+                t = summaries.get(tgt)
+                if t is None:
+                    continue
+                for b in t.acquires:
+                    record(a, b,
+                           {"holder": nid, "frame_line": int(start),
+                            "line": int(line), "callee": tgt})
+    return edges
+
+
+def _graph_sccs(nodes, adj):
+    """Tarjan over a small generic digraph (the lock graph)."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    onstack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = 0
+    for start in sorted(nodes):
+        if start in index:
+            continue
+        work = [(start, 0)]
+        while work:
+            n, ei = work[-1]
+            if ei == 0:
+                index[n] = low[n] = counter
+                counter += 1
+                stack.append(n)
+                onstack.add(n)
+            succ = adj.get(n, [])
+            advanced = False
+            while ei < len(succ):
+                m = succ[ei]
+                ei += 1
+                if m not in index:
+                    work[-1] = (n, ei)
+                    work.append((m, 0))
+                    advanced = True
+                    break
+                if m in onstack:
+                    low[n] = min(low[n], index[m])
+            if advanced:
+                continue
+            work.pop()
+            if low[n] == index[n]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    comp.append(w)
+                    if w == n:
+                        break
+                sccs.append(sorted(comp))
+            if work:
+                p = work[-1][0]
+                low[p] = min(low[p], low[n])
+    return sccs
+
+
+@register
+class LockOrderRule(ProgramRule):
+    id = "lock-order"
+    help = ("static ABBA detection: lock-acquisition edges from held "
+            "with-frames × call edges; every cycle reports both full "
+            "acquisition paths. Known inversions are waived in the "
+            "rule's ledger (shared with the runtime racecheck waiver "
+            "surface); unmatched ledger entries are stale errors")
+
+    def check_program(self, program, config: AnalyzeConfig,
+                      rcfg: RuleConfig):
+        summaries, _ = compute_summaries(program)
+        edges = _lock_graph(program, summaries, rcfg)
+        adj: dict[str, list[str]] = {}
+        locks: set[str] = set()
+        for (a, b) in edges:
+            adj.setdefault(a, []).append(b)
+            locks.update((a, b))
+        for succ in adj.values():
+            succ.sort()
+
+        ledger: dict[frozenset, str] = {}
+        bad_entries: list[str] = []
+        for raw in rcfg.options.get("ledger", []):
+            m = _LEDGER_RE.match(str(raw))
+            if m is None:
+                bad_entries.append(str(raw))
+                continue
+            ledger[frozenset((m.group("a"), m.group("b")))] = (
+                m.group("reason"))
+        for raw in bad_entries:
+            yield Violation(
+                rule=self.id, severity="error", path="analyze.toml",
+                line=0, col=0,
+                message=(f"unparseable lock-order ledger entry {raw!r} "
+                         "— format is '<lockA> <-> <lockB> : <reason>'"),
+            )
+
+        def describe(prov, lock):
+            chain = [prov["holder"]]
+            if prov["callee"] is not None:
+                chain += _acquire_chain(summaries, prov["callee"], lock)
+            quals = " -> ".join(
+                program.nodes[n].qual for n in chain
+                if n in program.nodes)
+            return chain, quals
+
+        matched: set[frozenset] = set()
+        for scc in _graph_sccs(locks, adj):
+            if len(scc) < 2:
+                continue
+            if len(scc) == 2:
+                a, b = scc
+                ab, ba = edges[(a, b)], edges[(b, a)]
+                ab_chain, ab_q = describe(ab, b)
+                ba_chain, ba_q = describe(ba, a)
+                pair = frozenset((a, b))
+                reason = ledger.get(pair)
+                if reason is not None:
+                    matched.add(pair)
+                yield Violation(
+                    rule=self.id, severity="error",
+                    path=ab["holder"].split("::")[0],
+                    line=ab["line"], col=0,
+                    message=(f"lock-order inversion: {a} then {b} "
+                             f"(via {ab_q}) but {b} then {a} "
+                             f"(via {ba_q}) — two threads interleaving "
+                             "these acquisition paths deadlock"),
+                    waived=reason is not None,
+                    waiver_reason=reason,
+                    call_path=ab_chain,
+                    effect={"cycle": [a, b],
+                            "ab": {"line": ab["line"],
+                                   "chain": ab_chain},
+                            "ba": {"line": ba["line"],
+                                   "chain": ba_chain}},
+                )
+            else:
+                first = edges[(scc[0], next(
+                    m for m in adj.get(scc[0], []) if m in scc))]
+                yield Violation(
+                    rule=self.id, severity="error",
+                    path=first["holder"].split("::")[0],
+                    line=first["line"], col=0,
+                    message=("lock-order cycle over "
+                             f"{len(scc)} locks: {', '.join(scc)} — "
+                             "no consistent acquisition order exists"),
+                    effect={"cycle": list(scc)},
+                )
+        for pair in sorted(ledger, key=sorted):
+            if pair not in matched:
+                a, b = sorted(pair)
+                yield Violation(
+                    rule=self.id, severity="error", path="analyze.toml",
+                    line=0, col=0,
+                    message=(f"stale lock-order ledger entry "
+                             f"'{a} <-> {b}' — the static cycle it "
+                             "waives no longer exists; the inversion "
+                             "ledger must track the code"),
+                )
+
+
+@register
+class GuardedByFlowRule(ProgramRule):
+    id = "guarded-by-flow"
+    help = ("interprocedural guarded-by: calling a *_locked path that "
+            "touches a '# guarded-by:' attribute, from a function that "
+            "neither holds the lock nor is itself *_locked, mutates "
+            "the field lock-free on every interleaving")
+
+    def check_program(self, program, config: AnalyzeConfig,
+                      rcfg: RuleConfig):
+        _summaries, reports = compute_summaries(program)
+        for rep in reports:
+            if not _in_scope(rep["path"], rcfg):
+                continue
+            callee = program.nodes[rep["callee"]]
+            caller = program.nodes[rep["caller"]]
+            yield Violation(
+                rule=self.id, severity="error", path=rep["path"],
+                line=rep["line"], col=0,
+                message=(f"call to {callee.qual}() reaches an access "
+                         f"of self.{rep['attr']} (guarded-by "
+                         f"{rep['lock']}) but {caller.qual}() holds no "
+                         "lock here and is not *_locked — acquire the "
+                         "lock around the call or rename the caller "
+                         "*_locked to push the obligation up"),
+                call_path=rep["chain"],
+                effect={"lock": rep["lock"], "attr": rep["attr"],
+                        "chain": rep["chain"]},
+            )
+
+
+# ---------------------------------------------------------------------------
+# --effects
+# ---------------------------------------------------------------------------
+
+
+def describe_symbol(program, entry: str) -> str:
+    """Human-readable computed summary for ``analyze --effects``."""
+    nid = program.resolve_entry(entry)
+    if nid is None:
+        return (f"--effects: {entry!r} not found in the call graph "
+                "(use path.py::Qual.name, or a unique ::symbol suffix)")
+    summaries, _ = compute_summaries(program)
+    s = summaries[nid]
+    node = program.nodes[nid]
+    out = [f"effect summary for {nid} (line {node.line})"]
+    own = _own_host_sinks(program, node)
+    if own:
+        out.append("  own host sinks:")
+        for _d, _nid, line, kind, what, _via in own:
+            out.append(f"    line {line}: {_SINK_KINDS.get(kind, kind)}"
+                       f" ({what})")
+    if s.host is not None:
+        chain = _host_chain(summaries, nid)
+        sink = program.nodes.get(s.host[1])
+        sink_q = sink.qual if sink else s.host[1]
+        out.append(f"  nearest unledgered sink: "
+                   f"{_SINK_KINDS.get(s.host[3], s.host[3])} "
+                   f"({s.host[4]}) in {sink_q}() at "
+                   f"{s.host[1].split('::')[0]}:{s.host[2]}, "
+                   f"{s.host[0]} hop(s)")
+        out.append("    sink path: " + " -> ".join(chain))
+    else:
+        out.append("  host: clean (no unledgered sink reachable)")
+    if s.acquires:
+        out.append("  acquires:")
+        for lock in sorted(s.acquires):
+            d, line, _via = s.acquires[lock]
+            chain = _acquire_chain(summaries, nid, lock)
+            out.append(f"    {lock} ({d} hop(s), line {line}): "
+                       + " -> ".join(chain))
+    else:
+        out.append("  acquires: none")
+    if s.required:
+        out.append("  requires held:")
+        for lock in sorted(s.required):
+            attr, line, _via = s.required[lock]
+            out.append(f"    {lock} (guards self.{attr}, line {line})")
+    if s.escapes:
+        out.append("  escapes: " + ", ".join(sorted(s.escapes)))
+    else:
+        out.append("  escapes: none")
+    return "\n".join(out)

@@ -40,6 +40,10 @@ class Violation:
     # lock, transitive jit-purity) attach the root→sink chain of
     # "path::qualname" node ids; per-file rules leave it None
     call_path: list[str] | None = None
+    # effect rules (xfer-reach, lock-order, guarded-by-flow) attach a
+    # structured effect-path payload (sink kind, lock cycle with both
+    # acquisition chains, guarded attr + obligation chain)
+    effect: dict | None = None
 
     def __str__(self) -> str:
         tag = "waived" if self.waived else self.severity
@@ -186,6 +190,7 @@ def register(rule_cls: type[Rule]) -> type[Rule]:
 def _load_rules() -> None:
     # importing the rule modules populates REGISTRY via @register
     from celestia_app_tpu.tools.analyze import (  # noqa: F401
+        effects,
         rules_determinism,
         rules_effects,
         rules_locks,

@@ -48,6 +48,7 @@ on the first profiled acquire.
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
 
@@ -201,23 +202,26 @@ def _note_acquire(lock: "_TrackedLock") -> None:
                 _edges[(a, b)] = {"thread": me, "stack": my_stack}
             rev = _edges.get((b, a))
             if rev is not None and not _already_reported(a, b):
-                import sys
-
                 fwd_stack = " < ".join(rev.get("stack", []))
                 rev_stack = " < ".join(my_stack)
-                msg = (
-                    f"RACECHECK: lock-order inversion: {b} -> {a} "
-                    f"(thread {rev['thread']}; acquired {fwd_stack}) vs "
-                    f"{a} -> {b} (thread {me}; acquired {rev_stack}) | "
-                    f"locks created at A={a}, B={b} "
-                    "(site@site = lock-creation@acquisition)"
-                )
-                try:
-                    # one greppable stderr line — chaos subprocess logs
-                    # are asserted clean of it (tests/test_chaos.py)
-                    sys.stderr.write(msg + "\n")
-                except Exception:
-                    pass
+                waiver = _waiver_reason_locked(a, b)
+                if waiver is None:
+                    import sys
+
+                    msg = (
+                        f"RACECHECK: lock-order inversion: {b} -> {a} "
+                        f"(thread {rev['thread']}; acquired {fwd_stack})"
+                        f" vs {a} -> {b} (thread {me}; acquired "
+                        f"{rev_stack}) | locks created at A={a}, B={b} "
+                        "(site@site = lock-creation@acquisition)"
+                    )
+                    try:
+                        # one greppable stderr line — chaos subprocess
+                        # logs are asserted clean of it
+                        # (tests/test_chaos.py)
+                        sys.stderr.write(msg + "\n")
+                    except Exception:
+                        pass
                 _violations.append({
                     "first": b, "then": a,
                     "thread_forward": rev["thread"],
@@ -225,6 +229,8 @@ def _note_acquire(lock: "_TrackedLock") -> None:
                     "first_rev": a, "then_rev": b,
                     "thread_reverse": me,
                     "stack_reverse": list(my_stack),
+                    "waived": waiver is not None,
+                    "waiver_reason": waiver,
                     "message": (
                         f"lock-order inversion: {b} -> {a} "
                         f"(thread {rev['thread']}; acquired {fwd_stack})"
@@ -441,9 +447,93 @@ def profile_enabled_by_env() -> bool:
     return os.environ.get("CELESTIA_LOCKPROF", "").strip() == "1"
 
 
-def violations() -> list[dict]:
+# -- the shared inversion ledger --------------------------------------------
+# The static lock-order rule (tools/analyze/effects.py) and this
+# runtime detector must never silently disagree about the set of KNOWN
+# inversions: both read the same [rules.lock-order] ledger entries from
+# analyze.toml ("<lockA> <-> <lockB> : <reason>", lock ids
+# "<pkg-relative-path>::<attr>"). Runtime lock classes are creation
+# sites (file:line), so matching is by the FILE pair the two lock
+# classes were created in — the finest unit both detectors share.
+
+_LEDGER_RE = re.compile(
+    r"^\s*(?P<a>\S+)\s*<->\s*(?P<b>\S+)\s*:\s*(?P<reason>.+?)\s*$")
+
+_ledger_raw: list[str] = []                        # guarded-by: _state_lock
+_ledger: list[tuple[frozenset, str]] = []          # guarded-by: _state_lock
+
+
+def set_waiver_ledger(entries) -> int:
+    """Install the known-inversion ledger (the [rules.lock-order]
+    ``ledger`` entries from analyze.toml, verbatim). Raises ValueError
+    on an unparseable entry — a typo'd waiver that silently matches
+    nothing is exactly the disagreement this surface exists to
+    prevent. Returns the number of entries installed."""
+    parsed: list[tuple[frozenset, str]] = []
+    for raw in entries:
+        m = _LEDGER_RE.match(str(raw))
+        if m is None:
+            raise ValueError(
+                f"unparseable lock-order ledger entry {raw!r} — format "
+                "is '<lockA> <-> <lockB> : <reason>'")
+        fa = m.group("a").split("::")[0]
+        fb = m.group("b").split("::")[0]
+        parsed.append((frozenset((fa, fb)), m.group("reason")))
     with _state_lock:
-        return [dict(v) for v in _violations]
+        _ledger_raw[:] = [str(r) for r in entries]
+        _ledger[:] = parsed
+    return len(parsed)
+
+
+def load_waiver_ledger_from_config(config_path: str | None = None) -> int:
+    """Read the [rules.lock-order] ledger out of analyze.toml (the
+    committed one by default) and install it — the one call that keeps
+    the runtime detector's waiver set equal to the static rule's."""
+    from celestia_app_tpu.tools.analyze import (
+        default_config_path,
+        load_config,
+    )
+
+    if config_path is None:
+        config_path = default_config_path()
+    cfg = load_config(config_path)
+    return set_waiver_ledger(
+        cfg.rule("lock-order").options.get("ledger", []))
+
+
+def waiver_ledger() -> list[str]:
+    """The installed ledger entries, verbatim."""
+    with _state_lock:
+        return list(_ledger_raw)
+
+
+def _site_file(site: str) -> str:
+    f = site.rsplit(":", 1)[0]
+    if f.startswith("celestia_app_tpu/"):
+        f = f[len("celestia_app_tpu/"):]
+    return f
+
+
+def _waiver_reason_locked(site_a: str, site_b: str) -> str | None:
+    """Ledger reason covering the (site_a, site_b) inversion, or None.
+    Caller holds _state_lock."""
+    pair = frozenset((_site_file(site_a), _site_file(site_b)))
+    for files, reason in _ledger:
+        if files == pair:
+            return reason
+    return None
+
+
+def violations(include_waived: bool = False) -> list[dict]:
+    with _state_lock:
+        return [dict(v) for v in _violations
+                if include_waived or not v.get("waived")]
+
+
+def waived_violations() -> list[dict]:
+    """Inversions that occurred but are covered by the ledger."""
+    with _state_lock:
+        return [dict(v) for v in _violations if v.get("waived")]
 
 
 def edges() -> list[tuple[str, str]]:

@@ -3,7 +3,7 @@
 The JSON schema (normative — docs/FORMATS.md §11):
 
     {
-      "version": 2,
+      "version": 3,
       "root": "<analyzed directory>",
       "config": "<analyze.toml path or null>",
       "summary": {
@@ -15,13 +15,19 @@ The JSON schema (normative — docs/FORMATS.md §11):
         {"rule": str, "severity": "error"|"warning", "path": str,
          "line": int, "col": int, "message": str,
          "waived": bool, "waiver_reason": str|null,
-         "call_path": ["path::qualname", ...]}, ...
+         "call_path": ["path::qualname", ...],
+         "effect": {...}|null}, ...
       ]
     }
 
 ``call_path`` is the root→sink chain of call-graph node ids for the
 interprocedural rules (det-reach, scope-drift, blocking-under-lock,
-transitive jit-purity) and ``[]`` for per-file rules.
+transitive jit-purity, xfer-reach, lock-order, guarded-by-flow) and
+``[]`` for per-file rules. ``effect`` (v3) is the effect-system
+rules' structured payload — xfer-reach: ``{kind, what, sink, root}``;
+lock-order: ``{cycle, ab: {line, chain}, ba: {line, chain}}`` (both
+acquisition paths); guarded-by-flow: ``{lock, attr, chain}`` — and
+``null`` for every other rule.
 """
 
 from __future__ import annotations
@@ -30,7 +36,7 @@ import json
 
 from celestia_app_tpu.tools.analyze.engine import Report
 
-JSON_VERSION = 2
+JSON_VERSION = 3
 
 
 def to_json(report: Report) -> dict:
@@ -54,6 +60,7 @@ def to_json(report: Report) -> dict:
                 "line": v.line, "col": v.col, "message": v.message,
                 "waived": v.waived, "waiver_reason": v.waiver_reason,
                 "call_path": list(v.call_path or ()),
+                "effect": v.effect,
             }
             for v in report.violations
         ],

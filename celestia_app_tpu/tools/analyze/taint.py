@@ -85,7 +85,7 @@ def _barrier(allow: list[str]):
     return stop
 
 
-def _resolve_roots(program, rcfg: RuleConfig):
+def _resolve_roots(program, rcfg: RuleConfig, rule_id: str = "det-reach"):
     """(resolved node ids, [missing-entry violations])."""
     roots: list[str] = []
     missing: list[Violation] = []
@@ -93,9 +93,9 @@ def _resolve_roots(program, rcfg: RuleConfig):
         nid = program.resolve_entry(str(entry))
         if nid is None:
             missing.append(Violation(
-                rule="det-reach", severity="error",
+                rule=rule_id, severity="error",
                 path=str(entry).split("::")[0], line=0, col=0,
-                message=(f"det-reach root {entry!r} not found in the "
+                message=(f"{rule_id} root {entry!r} not found in the "
                          "call graph (stale analyze.toml entry, or the "
                          "function moved — the root ledger must track "
                          "the code)"),

@@ -1,0 +1,16 @@
+"""BAD: the *_locked helper touches a guarded field assuming its lock
+held; refresh() calls it holding nothing and is not *_locked itself —
+the lexical lock-guard rule cannot see across the call."""
+import threading
+
+
+class Counters:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._totals = {}  # guarded-by: _lock
+
+    def _bump_locked(self, key):
+        self._totals[key] = self._totals.get(key, 0) + 1
+
+    def refresh(self, key):
+        return self._bump_locked(key)  # VIOLATION guarded-by-flow

@@ -1,0 +1,25 @@
+"""GOOD: every path into the *_locked helper either holds the lock at
+the call site or is itself *_locked (pushing the obligation up to a
+caller that does hold it)."""
+import threading
+
+
+class Counters:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._totals = {}  # guarded-by: _lock
+
+    def _bump_locked(self, key):
+        self._totals[key] = self._totals.get(key, 0) + 1
+
+    def _roll_up_locked(self, keys):
+        for k in keys:
+            self._bump_locked(k)
+
+    def refresh(self, key):
+        with self._lock:
+            return self._bump_locked(key)
+
+    def sweep(self, keys):
+        with self._lock:
+            self._roll_up_locked(keys)

@@ -1,0 +1,23 @@
+"""BAD: ABBA — forward() nests b inside a lexically; reverse() holds b
+and reaches a through a helper call (the call-graph half of the edge
+set). One cycle, reported once with both acquisition paths."""
+import threading
+
+order_lock_a = threading.Lock()
+order_lock_b = threading.Lock()
+
+
+def forward():
+    with order_lock_a:
+        with order_lock_b:  # VIOLATION lock-order (a->b vs b->a)
+            pass
+
+
+def reverse():
+    with order_lock_b:
+        _grab_a()
+
+
+def _grab_a():
+    with order_lock_a:
+        pass

@@ -1,0 +1,22 @@
+"""GOOD: one consistent global acquisition order — a before b on every
+path, lexical and through the helper call alike."""
+import threading
+
+order_lock_a = threading.Lock()
+order_lock_b = threading.Lock()
+
+
+def forward():
+    with order_lock_a:
+        with order_lock_b:
+            pass
+
+
+def also_forward():
+    with order_lock_a:
+        _grab_b()
+
+
+def _grab_b():
+    with order_lock_b:
+        pass

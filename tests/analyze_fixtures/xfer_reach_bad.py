@@ -1,0 +1,19 @@
+"""BAD: unledgered host-materialization sinks reachable from the
+configured warmed root (fixture config roots
+``xfer_reach_bad.py::produce_root``)."""
+import jax
+import numpy as np
+
+
+def produce_root(ods):
+    dev = _extend(ods)
+    return _materialize(dev)
+
+
+def _extend(ods):
+    return jax.device_put(ods)  # VIOLATION xfer-reach (raw h2d)
+
+
+def _materialize(dev):
+    host = jax.device_get(dev)  # VIOLATION xfer-reach (raw d2h)
+    return np.asarray(host)  # VIOLATION xfer-reach (asarray, jax file)

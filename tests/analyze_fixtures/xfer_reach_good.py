@@ -1,0 +1,25 @@
+"""GOOD: the same produce path with every boundary crossing routed
+through the counted obs.xfer ledger helpers — and one raw sink that is
+NOT reachable from the configured root, pinning that the rule proves
+reachability rather than grepping the file."""
+import jax
+
+from celestia_app_tpu.obs import xfer
+
+
+def produce_root(ods):
+    dev = _extend(ods)
+    return _materialize(dev)
+
+
+def _extend(ods):
+    return xfer.to_device(ods, "fixture.extend")
+
+
+def _materialize(dev):
+    return xfer.to_host(dev, "fixture.materialize")
+
+
+def offline_tool(dev):
+    # unreachable from produce_root: outside the residency proof
+    return jax.device_get(dev)

@@ -51,6 +51,8 @@ RULES = [
     "print-call", "raw-urlopen",
     # the interprocedural family (ISSUE 12)
     "det-reach", "scope-drift", "blocking-under-lock",
+    # the effect system (ISSUE 20)
+    "xfer-reach", "lock-order", "guarded-by-flow",
 ]
 
 
@@ -72,6 +74,10 @@ def _fixture_config() -> AnalyzeConfig:
     cfg.rules["det-fixture"] = RuleConfig(include=[
         "scope_drift_good.py", "det_reach_bad.py", "det_reach_good.py",
     ])
+    cfg.rules["xfer-reach"] = RuleConfig(options={"roots": [
+        "xfer_reach_bad.py::produce_root",
+        "xfer_reach_good.py::produce_root",
+    ]})
     return cfg
 
 
@@ -300,16 +306,18 @@ def test_json_report_schema(tmp_path):
     rep = run_analysis(root=str(tmp_path), config=AnalyzeConfig(),
                        only_rules={"det-wallclock"})
     doc = to_json(rep)
-    assert doc["version"] == 2
+    assert doc["version"] == 3
     assert set(doc["summary"]) == {"files_scanned", "rules_run", "errors",
                                    "warnings", "waived", "wall_s",
                                    "cache_hits", "cache_misses"}
     (v,) = doc["violations"]
     assert set(v) == {"rule", "severity", "path", "line", "col",
-                      "message", "waived", "waiver_reason", "call_path"}
+                      "message", "waived", "waiver_reason", "call_path",
+                      "effect"}
     assert v["rule"] == "det-wallclock" and v["path"] == "m.py"
     assert v["line"] == 5 and v["waived"] is False
     assert v["call_path"] == []  # per-file rules carry no chain
+    assert v["effect"] is None  # only the effect rules attach payloads
     json.dumps(doc)  # round-trippable
 
 
@@ -323,7 +331,7 @@ def test_cli_analyze_json_subprocess():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     doc = json.loads(proc.stdout)
-    assert doc["version"] == 2 and doc["summary"]["errors"] == 0
+    assert doc["version"] == 3 and doc["summary"]["errors"] == 0
     assert doc["summary"]["files_scanned"] > 100
 
 
@@ -710,6 +718,15 @@ def test_cli_rule_comma_list_and_unknown_exit_2(tmp_path):
     assert proc.returncode == 2, proc.stdout + proc.stderr
     assert "unknown rule(s): bogus" in proc.stderr
     assert "det-reach" in proc.stderr  # the registry listing
+    # EVERY unknown name reports at once — one round-trip to a clean
+    # command line, not one error per retry
+    proc = subprocess.run(
+        [sys.executable, "-m", "celestia_app_tpu", "analyze",
+         "--root", str(tmp_path),
+         "--rule", "bogus1,det-wallclock,bogus2"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "unknown rule(s): bogus1, bogus2" in proc.stderr
     # comma-separated list runs both rules
     proc = subprocess.run(
         [sys.executable, "-m", "celestia_app_tpu", "analyze",
@@ -719,6 +736,307 @@ def test_cli_rule_comma_list_and_unknown_exit_2(tmp_path):
     assert proc.returncode == 1, proc.stdout + proc.stderr
     doc = json.loads(proc.stdout)
     assert doc["summary"]["rules_run"] == ["det-rng", "det-wallclock"]
+
+
+# ---------------------------------------------------------------------------
+# the effect system (ISSUE 20): xfer-reach, lock-order, guarded-by-flow
+# ---------------------------------------------------------------------------
+
+EFFECT_RULES = {"xfer-reach", "lock-order", "guarded-by-flow"}
+
+
+def test_xfer_reach_call_path_and_effect_payload():
+    """Every finding carries the root→sink chain and a typed effect
+    payload; the good fixture's raw sink is NOT reachable from the
+    configured root — the rule proves reachability, not file greps."""
+    rep = _run_fixture("xfer-reach", only={"xfer-reach"})
+    bad = [v for v in rep.violations if v.path == "xfer_reach_bad.py"]
+    assert len(bad) == 3, [str(v) for v in bad]
+    assert {v.effect["kind"] for v in bad} == {
+        "h2d-raw", "d2h-raw", "asarray"}
+    for v in bad:
+        assert v.call_path[0] == "xfer_reach_bad.py::produce_root"
+        assert v.effect["root"] == "xfer_reach_bad.py::produce_root"
+        assert v.effect["sink"] == v.call_path[-1]
+        assert "obs.xfer" in v.message  # the fix is named in the text
+    assert not [v for v in rep.violations
+                if v.path == "xfer_reach_good.py"]
+
+
+def test_xfer_reach_empty_and_missing_roots_are_errors(tmp_path):
+    """An effect rule that silently checks nothing is worse than none:
+    an empty root set and a root that no longer resolves both fail."""
+    (tmp_path / "m.py").write_text("def f():\n    return 1\n")
+    cfg = AnalyzeConfig(rules={"xfer-reach": RuleConfig()})
+    rep = run_analysis(root=str(tmp_path), config=cfg,
+                       only_rules={"xfer-reach"})
+    assert any("no roots" in v.message for v in rep.errors), (
+        [str(v) for v in rep.errors])
+    cfg = AnalyzeConfig(rules={"xfer-reach": RuleConfig(
+        options={"roots": ["m.py::gone"]})})
+    rep = run_analysis(root=str(tmp_path), config=cfg,
+                       only_rules={"xfer-reach"})
+    assert any("not found" in v.message and "m.py::gone" in v.message
+               for v in rep.errors), [str(v) for v in rep.errors]
+
+
+@pytest.mark.parametrize("entry", [
+    "da/edscache.py::cache_key",
+    "ops/rs.py::extend_square_np",
+    "ops/polar.py::reliability",
+    "parallel/mesh.py::make_mesh",
+])
+def test_xfer_reach_deleting_allow_entry_fails(entry):
+    """The anti-rot matrix, extended to the new allow list: every
+    committed xfer-reach barrier is load-bearing — strip one and the
+    rule surfaces an error naming a sink in that entry's file."""
+    cfg = load_config()
+    assert entry in cfg.rule("xfer-reach").allow
+    cfg.rule("xfer-reach").allow.remove(entry)
+    rep = run_analysis(config=cfg, only_rules={"xfer-reach"})
+    target = entry.split("::")[0]
+    hits = [v for v in rep.errors
+            if v.rule == "xfer-reach" and v.path == target]
+    assert hits, (entry, [str(v) for v in rep.errors][:5])
+    assert all(v.call_path and v.effect for v in hits)
+
+
+def test_lock_order_reports_both_acquisition_paths():
+    """One ABBA cycle = one finding carrying BOTH full acquisition
+    chains — the lexical nesting half and the call-graph half."""
+    rep = _run_fixture("lock-order", only={"lock-order"})
+    (v,) = [x for x in rep.violations if x.path == "lock_order_bad.py"]
+    a = "lock_order_bad.py::order_lock_a"
+    b = "lock_order_bad.py::order_lock_b"
+    assert v.effect["cycle"] == [a, b]
+    assert v.effect["ab"]["chain"] == ["lock_order_bad.py::forward"]
+    assert v.effect["ba"]["chain"] == ["lock_order_bad.py::reverse",
+                                       "lock_order_bad.py::_grab_a"]
+    assert "forward" in v.message and "_grab_a" in v.message
+    assert not v.waived
+
+
+def test_lock_order_ledger_waives_stale_and_unparseable():
+    """A ledger entry naming the cycle's two locks downgrades it to
+    waived (reason attached); an entry matching nothing and an entry
+    that does not parse are both errors — the inversion ledger cannot
+    rot in either direction."""
+    a = "lock_order_bad.py::order_lock_a"
+    b = "lock_order_bad.py::order_lock_b"
+    cfg = _fixture_config()
+    cfg.rules["lock-order"] = RuleConfig(options={"ledger": [
+        f"{b} <-> {a} : fixture: deliberate ABBA pair"]})
+    rep = run_analysis(root=FIXTURES, config=cfg,
+                       only_rules={"lock-order"})
+    waived = [v for v in rep.waived if v.rule == "lock-order"]
+    assert len(waived) == 1  # entry order is insensitive (b <-> a)
+    assert waived[0].waiver_reason == "fixture: deliberate ABBA pair"
+    assert not [v for v in rep.errors if v.rule == "lock-order"]
+    cfg.rules["lock-order"] = RuleConfig(options={"ledger": [
+        f"{a} <-> {b} : fixture: deliberate ABBA pair",
+        "x.py::gone_a <-> x.py::gone_b : fixture: stale entry",
+        "not a ledger entry",
+    ]})
+    rep = run_analysis(root=FIXTURES, config=cfg,
+                       only_rules={"lock-order"})
+    msgs = [v.message for v in rep.errors]
+    assert any("stale lock-order ledger entry" in m and "gone_a" in m
+               for m in msgs), msgs
+    assert any("unparseable lock-order ledger entry" in m
+               for m in msgs), msgs
+
+
+def test_guarded_by_flow_call_path_and_payload():
+    rep = _run_fixture("guarded-by-flow", only={"guarded-by-flow"})
+    (v,) = [x for x in rep.violations
+            if x.path == "guarded_by_flow_bad.py"]
+    assert v.line == 16  # AT the unguarded call site
+    assert v.call_path == [
+        "guarded_by_flow_bad.py::Counters.refresh",
+        "guarded_by_flow_bad.py::Counters._bump_locked",
+    ]
+    assert v.effect["attr"] == "_totals"
+    assert v.effect["lock"].endswith("Counters._lock")
+    assert "_bump_locked" in v.message
+
+
+def test_changed_filter_and_full_tree_effect_gate(tmp_path):
+    """Satellite: the tier-1 gate and the dev loop in one test.
+    (a) The three effect rules run over the FULL package tree with
+    zero unwaived findings — xfer-reach proving no unledgered host-
+    materialization sink is reachable from any warmed root. (b) The
+    `--changed` flag filters the report to violations touching
+    git-changed files (the full tree still feeds the call graph)."""
+    rep = run_analysis(only_rules=set(EFFECT_RULES))
+    assert sorted(rep.rules_run) == sorted(EFFECT_RULES)
+    assert [str(v) for v in rep.errors] == []
+    # not even waived: the warmed produce path is residency-clean
+    assert [v for v in rep.violations if v.rule == "xfer-reach"] == []
+
+    pkg = tmp_path / "pkg"
+    (pkg / "chain").mkdir(parents=True)
+    (pkg / "chain" / "app.py").write_text("def f():\n    return 1\n")
+    (pkg / "chain" / "state.py").write_text(
+        "import time\n\n\ndef g():\n    return time.time()\n")
+    git = ["git", "-C", str(tmp_path)]
+    for argv in (git + ["init", "-q"],
+                 git + ["add", "-A"],
+                 git + ["-c", "user.name=t", "-c", "user.email=t@t",
+                        "commit", "-qm", "seed"]):
+        subprocess.run(argv, check=True, timeout=30,
+                       capture_output=True)
+    # state.py's violation is COMMITTED (not changed); edit app.py
+    (pkg / "chain" / "app.py").write_text(
+        "import time\n\n\ndef f():\n    return time.time()\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "celestia_app_tpu", "analyze",
+         "--root", str(pkg), "--changed", "--json", "--no-cache",
+         "--rule", "det-wallclock"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert {v["path"] for v in doc["violations"]} == {"chain/app.py"}
+
+
+def test_lock_order_ledger_matches_racecheck_waivers():
+    """THE cross-check (satellite): one committed ledger, two
+    detectors. Every waived static cycle corresponds 1:1 to a
+    [rules.lock-order] ledger entry (unmatched entries are stale
+    errors, so the reverse direction is pinned by the gate), and the
+    runtime racecheck loads exactly the same entries from the same
+    section — the two detectors cannot silently disagree about the
+    set of known inversions."""
+    cfg = load_config()
+    entries = [str(e) for e in
+               cfg.rule("lock-order").options.get("ledger", [])]
+    rep = run_analysis(only_rules={"lock-order"})
+    cycles = [v for v in rep.violations if v.rule == "lock-order"
+              and v.effect and "cycle" in v.effect]
+    waived = [v for v in cycles if v.waived]
+    stale = [v for v in rep.errors
+             if "stale lock-order ledger" in v.message]
+    assert len(waived) == len(entries) and not stale, (
+        [str(v) for v in cycles], entries)
+    try:
+        n = racecheck.load_waiver_ledger_from_config()
+        assert n == len(entries)
+        assert racecheck.waiver_ledger() == entries
+    finally:
+        racecheck.set_waiver_ledger([])
+
+
+def test_racecheck_waiver_ledger_covers_runtime_abba(racecheck_installed):
+    """The runtime half consumes the SAME entry format, matching by
+    creation-site file pair: an installed entry downgrades a live ABBA
+    inversion to waived — excluded from violations() so chaos/stress
+    assertions stay strict — while waived_violations() keeps the
+    forensic record; an unparseable entry refuses to install."""
+    with pytest.raises(ValueError):
+        racecheck.set_waiver_ledger(["not a ledger entry"])
+    try:
+        racecheck.set_waiver_ledger([
+            "tests/test_analyze.py <-> tests/test_analyze.py"
+            " : fixture: the deliberate ABBA below"])
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def t1():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def t2():
+            with lock_b:
+                with lock_a:
+                    pass
+
+        for fn in (t1, t2):
+            th = threading.Thread(target=fn)
+            th.start()
+            th.join()
+        assert racecheck.violations() == []  # waived: excluded
+        w = racecheck.waived_violations()
+        assert len(w) == 1 and w[0]["waived"] is True
+        assert w[0]["waiver_reason"] == (
+            "fixture: the deliberate ABBA below")
+        assert racecheck.violations(include_waived=True) == w
+    finally:
+        racecheck.set_waiver_ledger([])
+
+
+def test_effect_rules_warm_cold_identity(tmp_path):
+    """Interprocedural effect rules are never cached: a warm run
+    re-links and re-derives them from cached fragments, byte-identical
+    to a cold run and to a fresh uncached one."""
+    cache = str(tmp_path / "cache.json")
+    cfg = _fixture_config()
+
+    def norm(rep):
+        doc = to_json(rep)
+        for k in ("wall_s", "cache_hits", "cache_misses"):
+            doc["summary"].pop(k)
+        return json.dumps(doc, sort_keys=True)
+
+    cold = run_analysis(root=FIXTURES, config=cfg, cache=cache,
+                        only_rules=set(EFFECT_RULES))
+    assert cold.cache_misses > 0
+    warm = run_analysis(root=FIXTURES, config=cfg, cache=cache,
+                        only_rules=set(EFFECT_RULES))
+    assert warm.cache_misses == 0 and warm.cache_hits > 0
+    fresh = run_analysis(root=FIXTURES, config=cfg,
+                         only_rules=set(EFFECT_RULES))
+    assert norm(warm) == norm(cold) == norm(fresh)
+
+
+def test_cache_invalidated_by_rule_set_upgrade(tmp_path, monkeypatch):
+    """Satellite (upgrade bugfix): the cache key folds in a sha256
+    over every tools/analyze/*.py source (effects.py included), so
+    adding or editing ANY rule module invalidates stale per-file
+    entries instead of serving results computed by the old rules."""
+    from celestia_app_tpu.tools.analyze import cache as cache_mod
+
+    src_dir = os.path.dirname(cache_mod.__file__)
+    assert "effects.py" in os.listdir(src_dir)  # the hash covers it
+    (tmp_path / "m.py").write_text(
+        "import time\n\n\ndef f():\n    return time.time()\n")
+    cache = str(tmp_path / "cache.json")
+    cfg = AnalyzeConfig()
+    run_analysis(root=str(tmp_path), config=cfg, cache=cache)
+    warm = run_analysis(root=str(tmp_path), config=cfg, cache=cache)
+    assert warm.cache_misses == 0 and warm.cache_hits == 1
+    old = cache_mod.rules_source_hash()
+    monkeypatch.setattr(cache_mod, "rules_source_hash",
+                        lambda: old + "-rule-set-upgraded")
+    rep = run_analysis(root=str(tmp_path), config=cfg, cache=cache)
+    assert rep.cache_hits == 0 and rep.cache_misses == 1
+    assert any(v.rule == "det-wallclock" for v in rep.errors)
+
+
+def test_cli_effects_prints_symbol_summary():
+    """`analyze --effects <qualname>` prints the computed summary:
+    clean residency for the ledger-routed CMT device hash, plus its
+    transitive lock acquisitions with full chains."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "celestia_app_tpu", "analyze",
+         "--effects", "da/cmt.py::_hash_symbols"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "effect summary for da/cmt.py::_hash_symbols" in proc.stdout
+    assert "host: clean" in proc.stdout
+    assert "acquires:" in proc.stdout
+    assert "obs/xfer.py::_totals_lock" in proc.stdout
+    # an unresolvable symbol degrades to a message, not a traceback
+    proc = subprocess.run(
+        [sys.executable, "-m", "celestia_app_tpu", "analyze",
+         "--effects", "no/such.py::symbol"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "not found in the call graph" in proc.stdout
 
 
 # ---------------------------------------------------------------------------
